@@ -1,0 +1,20 @@
+"""gpushare-device-plugin-tpu: TPU-native accelerator sharing for Kubernetes.
+
+A brand-new TPU-first implementation of the capabilities of the reference
+``gpushare-device-plugin`` (a Kubernetes DaemonSet that lets multiple pods
+share one accelerator by memory slice):
+
+- ``discovery``  — TPU chip / HBM enumeration (mock, jax, tpuvm+libtpu backends)
+- ``device``     — fake-device fan-out: one schedulable device per HBM unit
+- ``plugin``     — Kubernetes device-plugin v1beta1 gRPC server + registration
+- ``allocator``  — HBM binpack policy and the Allocate() flow (env injection)
+- ``cluster``    — kube-apiserver / kubelet REST clients + pod state machine
+- ``manager``    — daemon lifecycle: socket watch, signals, health, restart
+- ``extender``   — scheduler-extender half: cluster-level binpack placement
+- ``cli``        — daemon entrypoint, kubectl-inspect-tpushare, podgetter
+- ``parallel``   — pod-side JAX runtime: Mesh from injected env, shardings
+- ``models``     — demo JAX workloads (MNIST, ResNet, BERT, LLaMA-style)
+- ``ops``        — Pallas TPU kernels used by the demo workloads
+"""
+
+__version__ = "0.1.0"

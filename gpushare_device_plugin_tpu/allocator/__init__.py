@@ -1,0 +1,3 @@
+from .binpack import AssignmentError, assign_chip, available_units
+
+__all__ = ["AssignmentError", "assign_chip", "available_units"]

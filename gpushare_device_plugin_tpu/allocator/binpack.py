@@ -1,0 +1,77 @@
+"""Pure HBM binpack policy.
+
+Reference behavior: ``assignDevice`` first-fit over ascending chip index
+(``server.go:249-264``) against the availability vector from
+``getAvailableGPUs`` = per-chip capacity minus annotation-declared usage of
+running pods (``server.go:268-289``). Kept pure (no I/O) so it stays
+table-testable — the property the reference had but never tested.
+
+Additions over the reference:
+- ``policy="best-fit"``: picks the feasible chip with the least free space,
+  which strictly improves worst-case fragmentation for mixed request sizes
+  (the north-star metric is binpack utilization %).
+- unhealthy chips are excluded (reference TODO at ``server.go:267``).
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Sequence
+
+
+class AssignmentError(RuntimeError):
+    """No chip has enough free HBM units for the request."""
+
+
+def available_units(
+    capacity: Mapping[int, int],
+    used: Mapping[int, int],
+    unhealthy: Sequence[int] = (),
+) -> dict[int, int]:
+    """Free units per chip index: capacity - used, unhealthy chips removed.
+
+    ``used`` entries for unknown or out-of-range chip indices are ignored
+    (defensive: annotations are client-writable).
+    """
+    avail: dict[int, int] = {}
+    bad = set(unhealthy)
+    for idx in sorted(capacity):
+        if idx in bad:
+            continue
+        avail[idx] = max(0, capacity[idx] - used.get(idx, 0))
+    return avail
+
+
+def assign_chip(
+    request_units: int,
+    capacity: Mapping[int, int],
+    used: Mapping[int, int],
+    unhealthy: Sequence[int] = (),
+    policy: str = "first-fit",
+) -> int:
+    """Pick the chip index to host a request of ``request_units``.
+
+    Raises ``AssignmentError`` when nothing fits (the caller turns this into
+    a gRPC error -> kubelet UnexpectedAdmissionError, ``allocate.go:99-105``).
+    """
+    if request_units <= 0:
+        raise AssignmentError(f"invalid request of {request_units} units")
+    avail = available_units(capacity, used, unhealthy)
+    if policy == "first-fit":
+        # ascending chip index, first chip that fits (server.go:250-264)
+        for idx in sorted(avail):
+            if avail[idx] >= request_units:
+                return idx
+    elif policy == "best-fit":
+        # least free space among feasible chips; ties -> lowest index
+        best = None
+        for idx in sorted(avail):
+            if avail[idx] >= request_units:
+                if best is None or avail[idx] < avail[best]:
+                    best = idx
+        if best is not None:
+            return best
+    else:
+        raise ValueError(f"unknown binpack policy {policy!r}")
+    raise AssignmentError(
+        f"no chip can fit {request_units} units (available: {avail})"
+    )

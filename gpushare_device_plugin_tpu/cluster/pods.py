@@ -1,0 +1,204 @@
+"""Pod-state predicates and accessors over k8s pod JSON.
+
+Pure functions over the JSON wire form of ``v1.Pod`` (we use no kubernetes
+client library; both the apiserver and kubelet clients hand back parsed
+JSON). Mirrors the reference's ``podutils.go:38-136`` predicates and the
+candidate/used-memory accounting in ``podmanager.go:102-293``.
+
+Pod lifecycle as seen by the plugin (the "apiserver is the database" state
+machine):
+
+  Pending ──(extender assumes: writes IDX + ASSUME_TIME)──▶ assumed
+  Pending/assumed ──(Allocate(): writes ASSIGNED=true ...)──▶ assigned
+  Running(label tpu/resource=tpu-mem + IDX annotation) ──▶ counted as usage
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Mapping
+
+from .. import const
+
+Pod = Mapping[str, Any]  # parsed v1.Pod JSON
+
+
+# --- metadata accessors ----------------------------------------------------
+
+
+def name(pod: Pod) -> str:
+    return pod.get("metadata", {}).get("name", "")
+
+
+def namespace(pod: Pod) -> str:
+    return pod.get("metadata", {}).get("namespace", "default")
+
+
+def uid(pod: Pod) -> str:
+    return pod.get("metadata", {}).get("uid", "")
+
+
+def annotations(pod: Pod) -> Mapping[str, str]:
+    return pod.get("metadata", {}).get("annotations") or {}
+
+
+def labels(pod: Pod) -> Mapping[str, str]:
+    return pod.get("metadata", {}).get("labels") or {}
+
+
+def node_name(pod: Pod) -> str:
+    return pod.get("spec", {}).get("nodeName", "")
+
+
+def phase(pod: Pod) -> str:
+    return pod.get("status", {}).get("phase", "")
+
+
+def creation_timestamp(pod: Pod) -> str:
+    # RFC3339 strings sort lexicographically in time order
+    return pod.get("metadata", {}).get("creationTimestamp", "")
+
+
+def sort_key_by_creation(pod: Pod) -> tuple[str, str, str]:
+    """Oldest first; name/namespace tiebreak for determinism.
+
+    The reference sorts by CreationTimestamp only (``podmanager.go:281-293``)
+    which leaves same-instant pods in arbitrary order — one of the two sides
+    of the documented allocation race (SURVEY.md section 3.2); the tiebreak
+    removes the nondeterminism on our side.
+    """
+    return (creation_timestamp(pod), namespace(pod), name(pod))
+
+
+# --- resource accounting ---------------------------------------------------
+
+
+def _quantity(v: Any) -> int:
+    """Parse an extended-resource quantity (always a bare integer)."""
+    try:
+        return int(str(v))
+    except (TypeError, ValueError):
+        return 0
+
+
+def mem_units_of_container(container: Mapping[str, Any], resource: str = const.RESOURCE_MEM) -> int:
+    limits = container.get("resources", {}).get("limits") or {}
+    return _quantity(limits.get(resource))
+
+
+def mem_units_of_pod(pod: Pod, resource: str = const.RESOURCE_MEM) -> int:
+    """Sum of ``aliyun.com/tpu-mem`` container *limits* (``podutils.go:127-136``)."""
+    return sum(
+        mem_units_of_container(c, resource)
+        for c in pod.get("spec", {}).get("containers", [])
+    )
+
+
+def core_chips_of_pod(pod: Pod) -> int:
+    return mem_units_of_pod(pod, resource=const.RESOURCE_CORE)
+
+
+# --- share-pod state predicates (podutils.go:84-124) -----------------------
+
+
+def is_tpu_share_pod(pod: Pod) -> bool:
+    return mem_units_of_pod(pod) > 0
+
+
+def is_assumed(pod: Pod) -> bool:
+    """The scheduler extender wrote an assume-time annotation."""
+    return const.ENV_ASSUME_TIME in annotations(pod)
+
+
+def is_assigned(pod: Pod) -> bool:
+    """Plugin has completed Allocate() for this pod.
+
+    Reference semantics (``podutils.go:108-124``): the annotation must be
+    present AND not literally "false".
+    """
+    v = annotations(pod).get(const.ENV_ASSIGNED_FLAG)
+    return v is not None and v != "false"
+
+
+def chip_idx_from_annotation(pod: Pod) -> int:
+    """Assigned chip index, -1 when absent/garbled (``podutils.go:38-62``)."""
+    v = annotations(pod).get(const.ENV_MEM_IDX)
+    if v is None:
+        return -1
+    try:
+        return int(v)
+    except ValueError:
+        return -1
+
+
+def assume_time_from_annotation(pod: Pod) -> int:
+    v = annotations(pod).get(const.ENV_ASSUME_TIME)
+    try:
+        return int(v) if v is not None else 0
+    except ValueError:
+        return 0
+
+
+# --- aggregate views -------------------------------------------------------
+
+
+def candidate_pods(pods: Iterable[Pod], this_node: str) -> list[Pod]:
+    """Pending tpushare pods on this node awaiting Allocate, oldest first.
+
+    Reference: ``getCandidatePods`` (``podmanager.go:247-269``) — tpushare
+    pods that are not yet (assumed AND assigned); pods scheduled to other
+    nodes are skipped; duplicates (by UID) dropped.
+    """
+    seen: set[str] = set()
+    out: list[Pod] = []
+    for pod in pods:
+        # Unscheduled pods (empty nodeName) are never candidates: Allocate
+        # runs only after kubelet admitted the pod to *this* node
+        # (reference warns+skips on mismatch, podmanager.go:200-205).
+        if node_name(pod) != this_node:
+            continue
+        if uid(pod) in seen:
+            continue
+        seen.add(uid(pod))
+        if not is_tpu_share_pod(pod):
+            continue
+        if is_assumed(pod) and is_assigned(pod):
+            continue
+        out.append(pod)
+    out.sort(key=sort_key_by_creation)
+    return out
+
+
+def used_units_by_chip(pods: Iterable[Pod]) -> dict[int, int]:
+    """Annotation-declared HBM usage of *Running* labeled pods per chip index.
+
+    Reference: ``getPodUsedGPUMemory`` (``podmanager.go:102-115``) — only
+    pods in phase Running and bearing the resource label are counted; the
+    declared chip index comes from the IDX annotation and the amount is the
+    pod's summed limits.
+    """
+    used: dict[int, int] = {}
+    for pod in pods:
+        if phase(pod) != "Running":
+            continue
+        if labels(pod).get(const.LABEL_RESOURCE_KEY) != const.LABEL_RESOURCE_VALUE:
+            continue
+        idx = chip_idx_from_annotation(pod)
+        if idx < 0:
+            continue
+        used[idx] = used.get(idx, 0) + mem_units_of_pod(pod)
+    return used
+
+
+def used_chips(pods: Iterable[Pod]) -> set[int]:
+    """Chip indices exclusively held by Running tpu-core pods."""
+    out: set[int] = set()
+    for pod in pods:
+        if phase(pod) != "Running":
+            continue
+        n = core_chips_of_pod(pod)
+        if n <= 0:
+            continue
+        idx = chip_idx_from_annotation(pod)
+        if idx >= 0:
+            out.update(range(idx, idx + n))
+    return out

@@ -1,0 +1,92 @@
+"""Resource names, annotation/env keys and wire constants.
+
+TPU-native analog of the reference's ``pkg/gpu/nvidia/const.go:8-38``: the
+resource-name pair, the unix-socket name, the pod selector label, and the
+annotation/env key family used to persist allocation decisions in the
+Kubernetes API ("apiserver is the database").
+"""
+
+from __future__ import annotations
+
+import enum
+
+# --- Extended resource names (reference: const.go:11-12) -------------------
+# Fractional HBM, counted in memory units (1 fake device per unit).
+RESOURCE_MEM = "aliyun.com/tpu-mem"
+# Whole-chip resource for pods that want exclusive chips.
+RESOURCE_CORE = "aliyun.com/tpu-core"
+# Physical chip count, patched into node status (reference: gpu-count).
+RESOURCE_COUNT = "aliyun.com/tpu-count"
+
+# GPU names kept for the mixed-fleet scheduler-extender path (BASELINE cfg 5).
+RESOURCE_GPU_MEM = "aliyun.com/gpu-mem"
+RESOURCE_GPU_COUNT = "aliyun.com/gpu-count"
+
+# --- Device-plugin sockets (reference: const.go:13) ------------------------
+DEVICE_PLUGIN_PATH = "/var/lib/kubelet/device-plugins/"
+KUBELET_SOCKET = DEVICE_PLUGIN_PATH + "kubelet.sock"
+MEM_SOCKET_NAME = "aliyuntpushare.sock"
+CORE_SOCKET_NAME = "aliyuntpucore.sock"
+API_VERSION = "v1beta1"
+
+# --- Pod selector label (reference: const.go:17-18) ------------------------
+LABEL_RESOURCE_KEY = "tpu/resource"
+LABEL_RESOURCE_VALUE = "tpu-mem"
+
+# --- Annotation / env key family (reference: const.go:27-34) ---------------
+ENV_MEM_IDX = "ALIYUN_COM_TPU_MEM_IDX"  # assigned physical chip index
+ENV_MEM_POD = "ALIYUN_COM_TPU_MEM_POD"  # this pod's tpu-mem request
+ENV_MEM_CONTAINER = "ALIYUN_COM_TPU_MEM_CONTAINER"  # container's request
+ENV_MEM_DEV = "ALIYUN_COM_TPU_MEM_DEV"  # total units on assigned chip
+ENV_ASSIGNED_FLAG = "ALIYUN_COM_TPU_MEM_ASSIGNED"  # "false" until kubelet admits
+ENV_ASSUME_TIME = "ALIYUN_COM_TPU_MEM_ASSUME_TIME"  # ns timestamp of assignment
+
+# --- TPU workload env (analog of NVIDIA_VISIBLE_DEVICES, const.go:27) ------
+ENV_TPU_VISIBLE_CHIPS = "TPU_VISIBLE_CHIPS"
+ENV_TPU_PROCESS_BOUNDS = "TPU_PROCESS_BOUNDS"
+ENV_TPU_CHIPS_PER_PROCESS_BOUNDS = "TPU_CHIPS_PER_PROCESS_BOUNDS"
+# Cooperative HBM cap for the JAX/XLA client in the pod (the TPU analog of the
+# reference's cGPU isolation toggle, podmanager.go:59-72: there is no hardware
+# fence, the runtime must self-limit).
+ENV_XLA_MEM_FRACTION = "TPU_HBM_LIMIT_FRACTION"
+ENV_XLA_PYTHON_MEM_FRACTION = "XLA_PYTHON_CLIENT_MEM_FRACTION"
+
+# Node label that disables the cooperative HBM cap (reference: const.go:35,
+# label cgpu.disable.isolation=true read at podmanager.go:59-72).
+LABEL_DISABLE_ISOLATION = "ctpu.disable.isolation"
+
+# --- Scheduler-extender annotation (reference: cmd/inspect/main.go:23) -----
+# JSON map[containerName]map[chipIdx]memUnits written by the extender at bind
+# time; the inspect CLI prefers it for per-chip attribution.
+ANN_EXTENDER_ALLOCATION = "scheduler.framework.tpushare.allocation"
+
+# Optimistic-lock conflict marker in apiserver patch errors
+# (reference: const.go:15).
+OPTIMISTIC_LOCK_ERROR_MSG = "the object has been modified; please apply your changes to the latest version and try again"
+
+
+class MemoryUnit(str, enum.Enum):
+    """Granularity of one fake device (reference: const.go:8,37-38)."""
+
+    GiB = "GiB"
+    MiB = "MiB"
+
+    @property
+    def num_bytes(self) -> int:
+        return 1 << 30 if self is MemoryUnit.GiB else 1 << 20
+
+
+def translate_memory_units(value: str | None) -> MemoryUnit:
+    """Validate a ``--memory-unit`` flag value, defaulting to GiB.
+
+    Reference: ``cmd/nvidia/main.go:67-78``.
+    """
+    if value is None or value == "":
+        return MemoryUnit.GiB
+    try:
+        return MemoryUnit(value)
+    except ValueError:
+        raise ValueError(
+            f"invalid memory unit {value!r}: expected one of "
+            f"{[u.value for u in MemoryUnit]}"
+        ) from None

@@ -1,0 +1,13 @@
+from .fanout import (
+    DeviceInventory,
+    FakeDevice,
+    extract_real_chip_id,
+    generate_fake_device_id,
+)
+
+__all__ = [
+    "DeviceInventory",
+    "FakeDevice",
+    "extract_real_chip_id",
+    "generate_fake_device_id",
+]

@@ -1,0 +1,62 @@
+"""TPU chip / HBM enumeration backends.
+
+Replaces the reference's L0 NVML layer (``pkg/gpu/nvidia/nvidia.go:47-91`` +
+the vendored cgo shim): on TPU-VM hosts there is no NVML; chips are found via
+``/dev/accel*`` device files, TPU-VM metadata env, or libtpu through the
+native ``tpuinfo`` C++ shim. A config-driven mock backend enables the full
+Register -> ListAndWatch -> Allocate cycle on CPU-only clusters (the test
+capability the reference lacks, SURVEY.md section 4).
+"""
+
+from .base import ChipHealth, DiscoveryBackend, TpuChip, TpuTopology
+from .mock import MockBackend
+
+__all__ = [
+    "ChipHealth",
+    "DiscoveryBackend",
+    "TpuChip",
+    "TpuTopology",
+    "MockBackend",
+    "from_name",
+]
+
+
+def from_name(name: str, **kwargs) -> DiscoveryBackend:
+    """Build a backend by flag value (``--discovery=mock|jax|tpuvm|auto``)."""
+    if name == "mock":
+        return MockBackend(**kwargs)
+    if name == "jax":
+        from .jaxdev import JaxBackend
+
+        return JaxBackend(**kwargs)
+    if name == "tpuvm":
+        from .tpuvm import TpuVmBackend
+
+        return TpuVmBackend(**kwargs)
+    if name == "auto":
+        # Best real backend that probes OK; else an empty mock, which makes
+        # the daemon park (reference behavior on driverless nodes,
+        # gpumanager.go:36-47) instead of crash-looping. Backend-specific
+        # kwargs are not forwarded in auto mode; any probe failure falls
+        # through rather than crashing.
+        for load in (_load_tpuvm, _load_jax):
+            try:
+                be = load()
+                if be.probe():
+                    return be
+            except Exception:
+                continue
+        return MockBackend(num_chips=0)
+    raise ValueError(f"unknown discovery backend {name!r}")
+
+
+def _load_tpuvm() -> DiscoveryBackend:
+    from .tpuvm import TpuVmBackend
+
+    return TpuVmBackend()
+
+
+def _load_jax() -> DiscoveryBackend:
+    from .jaxdev import JaxBackend
+
+    return JaxBackend()

@@ -1,0 +1,83 @@
+"""Discovery data model shared by all backends.
+
+The reference collapses discovery to: per-GPU UUID, ``/dev/nvidia<i>`` path,
+total memory, and an XID-event health feed (``nvidia.go:53-91,102-154``).
+The TPU model carries the same essentials plus slice topology, which TPU
+workloads need for ``TPU_PROCESS_BOUNDS`` injection (multi-host slices:
+each host's DaemonSet advertises only local chips, SURVEY.md section 2).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Callable, Iterator, Protocol, Sequence
+
+
+class ChipHealth(enum.Enum):
+    HEALTHY = "Healthy"
+    UNHEALTHY = "Unhealthy"
+
+
+@dataclasses.dataclass(frozen=True)
+class TpuChip:
+    """One physical TPU chip on this host."""
+
+    id: str  # stable unique ID (UUID-like), e.g. "tpu-v4-host0-chip2"
+    index: int  # local chip index, the value injected as TPU_VISIBLE_CHIPS
+    device_path: str  # /dev/accel<N> (or "" when virtual)
+    hbm_bytes: int  # total HBM on this chip
+    health: ChipHealth = ChipHealth.HEALTHY
+
+
+@dataclasses.dataclass(frozen=True)
+class TpuTopology:
+    """Host-local view of the slice topology.
+
+    ``process_bounds`` / ``chips_per_process_bounds`` are the strings a JAX
+    workload needs to form its mesh (e.g. v4-32: 4 hosts -> "2,2,1" bounds);
+    empty strings mean single-host default.
+    """
+
+    generation: str = "v4"  # "v4", "v5e", "v5p", ...
+    chips_per_host: int = 4
+    host_index: int = 0
+    num_hosts: int = 1
+    process_bounds: str = ""
+    chips_per_process_bounds: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class HealthEvent:
+    """A health transition for one chip (or all chips when ``chip_id=None``).
+
+    Analog of an NVML XID critical event (``nvidia.go:121-152``): events
+    without a device attribution mark every chip unhealthy.
+    """
+
+    chip_id: str | None
+    health: ChipHealth
+    reason: str = ""
+
+
+class DiscoveryBackend(Protocol):
+    """Chip enumeration + health feed. Implementations: mock, jax, tpuvm."""
+
+    def probe(self) -> bool:
+        """Cheap check whether this backend can run on this host."""
+        ...
+
+    def chips(self) -> Sequence[TpuChip]:
+        """Enumerate local chips. Stable order by ``index``."""
+        ...
+
+    def topology(self) -> TpuTopology:
+        ...
+
+    def watch_health(self, stop: Callable[[], bool]) -> Iterator[HealthEvent]:
+        """Yield health transitions until ``stop()`` returns True.
+
+        Implementations poll; callers run this in a thread (reference runs
+        ``watchXIDs`` with a 5 s event wait, ``nvidia.go:121-128``).
+        """
+        ...

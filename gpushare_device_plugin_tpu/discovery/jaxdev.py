@@ -1,0 +1,90 @@
+"""Discovery backend backed by a live JAX runtime.
+
+Useful on TPU-VM hosts where importing jax is acceptable (e.g. the bench
+harness or a sidecar): chips come from ``jax.local_devices()`` and HBM from
+``memory_stats()['bytes_limit']``. The production daemon prefers the tpuvm
+backend (no jax import, no TPU runtime lock — a JAX client holds the chips
+while alive, which a DaemonSet must never do for the node's workloads).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Iterator, Sequence
+
+from .base import ChipHealth, HealthEvent, TpuChip, TpuTopology
+
+_DEFAULT_HBM = 16 << 30  # conservative fallback when memory_stats is absent
+
+
+class JaxBackend:
+    def __init__(self, hbm_bytes: int | None = None):
+        self._hbm_override = hbm_bytes
+        self._devices = None
+
+    def _jax(self):
+        import jax  # deferred: only this backend needs it
+
+        return jax
+
+    def probe(self) -> bool:
+        try:
+            jax = self._jax()
+            return any(d.platform == "tpu" for d in jax.local_devices())
+        except Exception:
+            return False
+
+    def _local_devices(self):
+        if self._devices is None:
+            self._devices = list(self._jax().local_devices())
+        return self._devices
+
+    def chips(self) -> Sequence[TpuChip]:
+        out = []
+        for i, dev in enumerate(self._local_devices()):
+            hbm = self._hbm_override
+            if hbm is None:
+                try:
+                    stats = dev.memory_stats() or {}
+                    hbm = int(stats.get("bytes_limit", _DEFAULT_HBM))
+                except Exception:
+                    hbm = _DEFAULT_HBM
+            out.append(
+                TpuChip(
+                    id=f"jax-{dev.platform}-{dev.id}",
+                    index=i,
+                    device_path=f"/dev/accel{i}",
+                    hbm_bytes=hbm,
+                )
+            )
+        return out
+
+    def topology(self) -> TpuTopology:
+        jax = self._jax()
+        devs = self._local_devices()
+        kind = devs[0].device_kind if devs else "unknown"
+        return TpuTopology(
+            generation=str(kind),
+            chips_per_host=len(devs),
+            host_index=jax.process_index(),
+            num_hosts=jax.process_count(),
+        )
+
+    def watch_health(self, stop: Callable[[], bool]) -> Iterator[HealthEvent]:
+        """Liveness poll: a trivial device_put doubles as a runtime heartbeat."""
+        jax = self._jax()
+        last_ok = True
+        while not stop():
+            try:
+                jax.device_put(0, self._local_devices()[0]).block_until_ready()
+                ok = True
+            except Exception:
+                ok = False
+            if ok != last_ok:
+                yield HealthEvent(
+                    chip_id=None,
+                    health=ChipHealth.HEALTHY if ok else ChipHealth.UNHEALTHY,
+                    reason="jax-runtime-heartbeat",
+                )
+                last_ok = ok
+            time.sleep(5.0)

@@ -1,0 +1,201 @@
+"""TPU-VM discovery backend: /dev/accel* + metadata env + optional native shim.
+
+The production analog of the reference's NVML layer (``nvidia.go:47-91``)
+without any ML-runtime import: chip device files are enumerated from
+``/dev`` (``accel0..N`` on TPU-VM; ``vfio/*`` on newer images), HBM per chip
+comes from the accelerator-type metadata (``TPU_ACCELERATOR_TYPE`` /
+``ACCELERATOR_TYPE`` env on TPU-VMs, e.g. ``v4-8``), and — when the native
+``libtpuinfo`` C++ shim is built (``native/``) — from libtpu itself via
+ctypes. The shim is optional by design, mirroring the reference's lazy
+``dlopen`` of libnvidia-ml (``nvml_dl.c:21-27``) so one DaemonSet image runs
+on non-TPU nodes and simply parks.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import re
+import time
+from typing import Callable, Iterator, Sequence
+
+from .base import ChipHealth, HealthEvent, TpuChip, TpuTopology
+
+# Per-chip HBM by TPU generation (public Cloud TPU specs).
+HBM_BY_GENERATION = {
+    "v2": 8 << 30,
+    "v3": 16 << 30,
+    "v4": 32 << 30,
+    "v5e": 16 << 30,
+    "v5litepod": 16 << 30,
+    "v5p": 95 << 30,
+    "v6e": 32 << 30,
+}
+# Chips per host by generation (full-host TPU-VMs).
+CHIPS_PER_HOST = {"v2": 4, "v3": 4, "v4": 4, "v5e": 8, "v5litepod": 8, "v5p": 4, "v6e": 8}
+
+ENV_ACCEL_TYPE = ("TPU_ACCELERATOR_TYPE", "ACCELERATOR_TYPE")
+ENV_WORKER_ID = ("TPU_WORKER_ID", "WORKER_ID")
+ENV_HBM_OVERRIDE = "TPUSHARE_HBM_GIB"
+
+
+def parse_accelerator_type(accel: str) -> tuple[str, int]:
+    """``"v4-32" -> ("v4", 32)`` (generation, total cores in slice)."""
+    m = re.fullmatch(r"(v\d+[a-z]*(?:pod)?)-(\d+)", accel.strip())
+    if not m:
+        return "", 0
+    return m.group(1), int(m.group(2))
+
+
+class TpuVmBackend:
+    def __init__(
+        self,
+        dev_glob: str = "/dev/accel*",
+        vfio_glob: str = "/dev/vfio/[0-9]*",
+        env: dict | None = None,
+        native_lib: str | None = None,
+    ):
+        self._dev_glob = dev_glob
+        self._vfio_glob = vfio_glob
+        self._env = env if env is not None else dict(os.environ)
+        self._native = None
+        self._native_lib = native_lib
+        self._native_tried = False
+
+    # --- native shim (optional) -------------------------------------------
+
+    def _load_native(self):
+        if self._native_tried:
+            return self._native
+        self._native_tried = True
+        try:
+            from ..native import tpuinfo
+
+            self._native = tpuinfo.load(self._native_lib)
+        except Exception:
+            self._native = None
+        return self._native
+
+    # --- enumeration -------------------------------------------------------
+
+    def _device_paths(self) -> list[str]:
+        paths = sorted(
+            glob.glob(self._dev_glob),
+            key=lambda p: int(re.sub(r"\D", "", p) or 0),
+        )
+        if paths:
+            return paths
+        return sorted(
+            glob.glob(self._vfio_glob),
+            key=lambda p: int(re.sub(r"\D", "", p) or 0),
+        )
+
+    def _accel_type(self) -> str:
+        for key in ENV_ACCEL_TYPE:
+            if self._env.get(key):
+                return self._env[key]
+        return ""
+
+    def _hbm_bytes(self) -> int:
+        override = self._env.get(ENV_HBM_OVERRIDE)
+        if override:
+            try:
+                return int(override) << 30
+            except ValueError:
+                pass  # garbled operator env: fall through to real sources
+        native = self._load_native()
+        if native is not None:
+            hbm = native.hbm_bytes_per_chip()
+            if hbm > 0:
+                return hbm
+        gen, _ = parse_accelerator_type(self._accel_type())
+        return HBM_BY_GENERATION.get(gen, 16 << 30)
+
+    def probe(self) -> bool:
+        return bool(self._device_paths())
+
+    def chips(self) -> Sequence[TpuChip]:
+        hbm = self._hbm_bytes()
+        gen, _ = parse_accelerator_type(self._accel_type())
+        host = self._worker_id()
+        return [
+            TpuChip(
+                id=f"tpu-{gen or 'unknown'}-host{host}-chip{i}",
+                index=i,
+                device_path=path,
+                hbm_bytes=hbm,
+            )
+            for i, path in enumerate(self._device_paths())
+        ]
+
+    def _worker_id(self) -> int:
+        for key in ENV_WORKER_ID:
+            v = self._env.get(key)
+            if v is not None:
+                try:
+                    return int(v)
+                except ValueError:
+                    pass
+        return 0
+
+    def topology(self) -> TpuTopology:
+        gen, cores = parse_accelerator_type(self._accel_type())
+        local = len(self._device_paths())
+        chips_per_host = CHIPS_PER_HOST.get(gen, local or 4)
+        # v2/v3/v4/v5p accelerator-types count TensorCores (2 per chip);
+        # v5e/v5litepod/v6e count chips. So v4-32 = 16 chips = 4 hosts.
+        cores_per_chip = 2 if gen in ("v2", "v3", "v4", "v5p") else 1
+        total_chips = cores // cores_per_chip
+        num_hosts = max(1, total_chips // chips_per_host) if total_chips else 1
+        return TpuTopology(
+            generation=gen or "unknown",
+            chips_per_host=local or chips_per_host,
+            host_index=self._worker_id(),
+            num_hosts=num_hosts,
+        )
+
+    # --- health ------------------------------------------------------------
+
+    def watch_health(self, stop: Callable[[], bool]) -> Iterator[HealthEvent]:
+        """Device-file liveness poll (5 s, matching ``nvidia.go:128``).
+
+        A chip whose device file disappears (driver reset, host maintenance
+        event) is marked unhealthy; it recovers when the file returns — the
+        recovery path the reference never implemented (FIXME ``server.go:184``).
+        The native shim, when present, adds a libtpu liveness check for the
+        whole host.
+        """
+        state: dict[str, bool] = {}
+        seen: dict[str, str] = {}  # chip id -> device path, sticky
+        native_ok = True
+        while not stop():
+            native = self._load_native()
+            if native is not None:
+                ok = native.runtime_healthy()
+                if ok != native_ok:
+                    yield HealthEvent(
+                        chip_id=None,
+                        health=ChipHealth.HEALTHY if ok else ChipHealth.UNHEALTHY,
+                        reason="libtpu-runtime",
+                    )
+                    native_ok = ok
+            # Re-enumerate each cycle so chips appearing after a late driver
+            # init get watched; keep previously-seen chips in ``seen`` so a
+            # vanished device file (no longer globbed) still reports
+            # unhealthy and recovers when it returns.
+            for chip in self.chips():
+                seen.setdefault(chip.id, chip.device_path)
+            for cid, path in seen.items():
+                ok = os.path.exists(path)
+                if ok != state.get(cid, True):
+                    yield HealthEvent(
+                        chip_id=cid,
+                        health=ChipHealth.HEALTHY if ok else ChipHealth.UNHEALTHY,
+                        reason="device-file",
+                    )
+                state[cid] = ok
+            # stop-aware wait: 5 s poll period, 0.1 s stop latency
+            for _ in range(50):
+                if stop():
+                    return
+                time.sleep(0.1)

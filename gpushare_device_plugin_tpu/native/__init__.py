@@ -1,0 +1,1 @@
+"""Native (C++) helpers. See ``tpuinfo.py`` for the libtpu discovery shim."""

@@ -1,0 +1,58 @@
+"""Leveled logging with glog-style verbosity tiers.
+
+The reference logs through glog with ``--v`` verbosity (V(1) lifecycle,
+V(4)/V(6) per-decision detail; DaemonSet runs ``--v=5``). We map that onto
+stdlib logging: ``V(n)`` messages are emitted at DEBUG with a per-module
+verbosity gate, so ``--v=5`` shows V(1)..V(5).
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+_VERBOSITY = 0
+
+
+def setup(verbosity: int = 0, stream=None) -> None:
+    global _VERBOSITY
+    _VERBOSITY = verbosity
+    logging.basicConfig(
+        level=logging.DEBUG if verbosity > 0 else logging.INFO,
+        stream=stream or sys.stderr,
+        format="%(levelname).1s%(asctime)s %(name)s] %(message)s",
+        datefmt="%m%d %H:%M:%S",
+        force=True,  # re-apply on verbosity reload / under pytest handlers
+    )
+
+
+def verbosity() -> int:
+    return _VERBOSITY
+
+
+class Logger:
+    """Thin wrapper adding ``.v(n)`` gated verbose logging."""
+
+    def __init__(self, name: str):
+        self._log = logging.getLogger(name)
+
+    def info(self, msg: str, *args) -> None:
+        self._log.info(msg, *args)
+
+    def warning(self, msg: str, *args) -> None:
+        self._log.warning(msg, *args)
+
+    def error(self, msg: str, *args) -> None:
+        self._log.error(msg, *args)
+
+    def fatal(self, msg: str, *args) -> None:
+        self._log.critical(msg, *args)
+        raise SystemExit(255)
+
+    def v(self, level: int, msg: str, *args) -> None:
+        if _VERBOSITY >= level:
+            self._log.debug(msg, *args)
+
+
+def get_logger(name: str) -> Logger:
+    return Logger(name)

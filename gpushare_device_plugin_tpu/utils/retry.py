@@ -1,0 +1,49 @@
+"""Bounded-retry helper.
+
+The reference hand-rolls retry loops with fixed budgets (kubelet ``/pods``:
+8 x 100ms, ``podmanager.go:143-147``; apiserver list: 3 x 1s,
+``podmanager.go:164-169``; inspect CLI: 5 x 100ms). Centralised here so each
+call site states its budget declaratively.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, TypeVar
+
+T = TypeVar("T")
+
+
+class RetryError(RuntimeError):
+    def __init__(self, attempts: int, last: Exception):
+        super().__init__(f"all {attempts} attempts failed: {last}")
+        self.attempts = attempts
+        self.last = last
+
+
+def retry(
+    fn: Callable[[], T],
+    *,
+    attempts: int,
+    delay_s: float,
+    retryable: Callable[[Exception], bool] = lambda e: True,
+    sleep: Callable[[float], None] = time.sleep,
+) -> T:
+    """Call ``fn`` up to ``attempts`` times, sleeping ``delay_s`` between tries.
+
+    Only ``Exception`` is caught — KeyboardInterrupt/SystemExit propagate so
+    signal handling in the daemon stays intact.
+    """
+    if attempts < 1:
+        raise ValueError(f"attempts must be >= 1, got {attempts}")
+    last: Exception | None = None
+    for i in range(attempts):
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001 - wrapped in RetryError below
+            last = e
+            if not retryable(e) or i == attempts - 1:
+                break
+            sleep(delay_s)
+    assert last is not None
+    raise RetryError(attempts, last) from last

@@ -1,0 +1,15 @@
+"""Test session config.
+
+JAX-facing tests run on a virtual 8-device CPU mesh (multi-chip hardware is
+not available in CI); these env vars must be set before jax initializes, so
+they are set at conftest import time.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

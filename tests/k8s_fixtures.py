@@ -1,0 +1,68 @@
+"""Builders for k8s pod/node JSON used across tests."""
+
+from __future__ import annotations
+
+import itertools
+
+from gpushare_device_plugin_tpu import const
+
+_uid_counter = itertools.count(1)
+
+
+def make_pod(
+    name: str,
+    tpu_mem: int = 0,
+    *,
+    namespace: str = "default",
+    node: str = "node-a",
+    phase: str = "Pending",
+    created: str = "2026-01-01T00:00:00Z",
+    annotations: dict | None = None,
+    labels: dict | None = None,
+    tpu_core: int = 0,
+    containers: list[int] | None = None,
+    uid: str | None = None,
+) -> dict:
+    """A minimal v1.Pod JSON. ``containers`` splits tpu_mem across containers."""
+    limits_list = containers if containers is not None else ([tpu_mem] if tpu_mem else [0])
+    ctrs = []
+    for i, mem in enumerate(limits_list):
+        limits = {}
+        if mem:
+            limits[const.RESOURCE_MEM] = str(mem)
+        if tpu_core and i == 0:
+            limits[const.RESOURCE_CORE] = str(tpu_core)
+        ctrs.append(
+            {
+                "name": f"c{i}",
+                "image": "busybox",
+                "resources": {"limits": limits},
+            }
+        )
+    return {
+        "metadata": {
+            "name": name,
+            "namespace": namespace,
+            "uid": uid or f"uid-{next(_uid_counter)}",
+            "creationTimestamp": created,
+            "annotations": annotations or {},
+            "labels": labels or {},
+        },
+        "spec": {"nodeName": node, "containers": ctrs},
+        "status": {"phase": phase},
+    }
+
+
+def assigned_running_pod(name: str, tpu_mem: int, chip_idx: int, **kw) -> dict:
+    """A pod that Allocate() has processed and kubelet has started."""
+    ann = {
+        const.ENV_MEM_IDX: str(chip_idx),
+        const.ENV_ASSIGNED_FLAG: "true",
+        const.ENV_ASSUME_TIME: "1700000000000000000",
+    }
+    ann.update(kw.pop("annotations", {}))
+    labels = {const.LABEL_RESOURCE_KEY: const.LABEL_RESOURCE_VALUE}
+    labels.update(kw.pop("labels", {}))
+    return make_pod(
+        name, tpu_mem, phase="Running", annotations=ann, labels=labels, **kw
+    )

@@ -1,0 +1,91 @@
+"""Table tests for the binpack policy (reference: server.go:249-289)."""
+
+import pytest
+
+from gpushare_device_plugin_tpu.allocator import (
+    AssignmentError,
+    assign_chip,
+    available_units,
+)
+
+CAP4x32 = {0: 32, 1: 32, 2: 32, 3: 32}
+
+
+def test_available_units_subtracts_usage():
+    avail = available_units(CAP4x32, {0: 30, 2: 5})
+    assert avail == {0: 2, 1: 32, 2: 27, 3: 32}
+
+
+def test_available_units_clamps_overcommit():
+    # annotations are client-writable; never go negative
+    assert available_units({0: 4}, {0: 9}) == {0: 0}
+
+
+def test_available_units_ignores_unknown_chip():
+    assert available_units({0: 4}, {7: 3}) == {0: 4}
+
+
+def test_available_units_excludes_unhealthy():
+    # reference TODO server.go:267 — unhealthy chips must not receive pods
+    assert available_units(CAP4x32, {}, unhealthy=[1, 3]) == {0: 32, 2: 32}
+
+
+def test_first_fit_ascending_index():
+    assert assign_chip(2, CAP4x32, {}) == 0
+    # 2 units don't fit in 1 free unit on chip 0 -> next chip
+    assert assign_chip(2, CAP4x32, {0: 31}) == 1
+    assert assign_chip(2, CAP4x32, {0: 31, 1: 31}) == 2
+
+
+def test_first_fit_exact_fit():
+    assert assign_chip(32, CAP4x32, {0: 1}) == 1
+
+
+def test_no_fit_raises():
+    with pytest.raises(AssignmentError):
+        assign_chip(33, CAP4x32, {})
+    with pytest.raises(AssignmentError):
+        assign_chip(1, {0: 4}, {0: 4})
+
+
+def test_invalid_request_raises():
+    with pytest.raises(AssignmentError):
+        assign_chip(0, CAP4x32, {})
+    with pytest.raises(AssignmentError):
+        assign_chip(-3, CAP4x32, {})
+
+
+def test_best_fit_prefers_tightest_chip():
+    # first-fit would pick chip 0 (32 free); best-fit picks chip 2 (4 free)
+    used = {1: 30, 2: 28}
+    assert assign_chip(4, CAP4x32, used, policy="best-fit") == 2
+    # request that only fits the emptiest chip
+    assert assign_chip(31, CAP4x32, used, policy="best-fit") == 0
+
+
+def test_best_fit_tie_lowest_index():
+    assert assign_chip(4, {0: 8, 1: 8}, {}, policy="best-fit") == 0
+
+
+def test_best_fit_reduces_fragmentation_vs_first_fit():
+    # Heterogeneous host: first-fit burns the big chip on a small request,
+    # stranding a later whole-chip request that best-fit can still place.
+    cap = {0: 32, 1: 16}
+    ff_used: dict[int, int] = {}
+    bf_used: dict[int, int] = {}
+    for req in (16,):
+        i = assign_chip(req, cap, ff_used, policy="first-fit")
+        ff_used[i] = ff_used.get(i, 0) + req
+        j = assign_chip(req, cap, bf_used, policy="best-fit")
+        bf_used[j] = bf_used.get(j, 0) + req
+    # first-fit burned the big chip; best-fit kept it whole
+    assert ff_used == {0: 16}
+    assert bf_used == {1: 16}
+    with pytest.raises(AssignmentError):
+        assign_chip(32, cap, ff_used)
+    assert assign_chip(32, cap, bf_used, policy="best-fit") == 0
+
+
+def test_unknown_policy():
+    with pytest.raises(ValueError):
+        assign_chip(1, CAP4x32, {}, policy="worst-fit")

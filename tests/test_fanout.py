@@ -1,0 +1,95 @@
+"""Table tests for the fake-device fan-out (reference: nvidia.go:26-91)."""
+
+import pytest
+
+from gpushare_device_plugin_tpu.const import MemoryUnit, translate_memory_units
+from gpushare_device_plugin_tpu.device import (
+    DeviceInventory,
+    extract_real_chip_id,
+    generate_fake_device_id,
+)
+from gpushare_device_plugin_tpu.discovery import MockBackend
+from gpushare_device_plugin_tpu.discovery.base import ChipHealth, TpuChip
+
+
+def test_fake_id_roundtrip():
+    fid = generate_fake_device_id("tpu-v4-host0-chip3", 17)
+    assert fid == "tpu-v4-host0-chip3-_-17"
+    assert extract_real_chip_id(fid) == "tpu-v4-host0-chip3"
+
+
+def test_fake_id_roundtrip_with_sep_in_chip_id():
+    # rsplit keeps chip ids containing the separator safe
+    fid = generate_fake_device_id("weird-_-chip", 2)
+    assert extract_real_chip_id(fid) == "weird-_-chip"
+
+
+@pytest.mark.parametrize(
+    "value,expected",
+    [("", MemoryUnit.GiB), (None, MemoryUnit.GiB), ("GiB", MemoryUnit.GiB), ("MiB", MemoryUnit.MiB)],
+)
+def test_translate_memory_units(value, expected):
+    assert translate_memory_units(value) is expected
+
+
+def test_translate_memory_units_invalid():
+    with pytest.raises(ValueError):
+        translate_memory_units("KiB")
+
+
+def test_fanout_counts_gib():
+    inv = DeviceInventory(MockBackend(num_chips=4, hbm_bytes=32 << 30).chips())
+    devs = inv.mem_fake_devices()
+    assert len(devs) == 4 * 32
+    assert inv.total_units() == 128
+    assert inv.units_by_index() == {0: 32, 1: 32, 2: 32, 3: 32}
+    # ordered by chip index then unit index
+    assert devs[0].id.endswith("chip0-_-0")
+    assert devs[32].id.endswith("chip1-_-0")
+
+
+def test_fanout_counts_mib():
+    chips = MockBackend(num_chips=1, hbm_bytes=1 << 30).chips()
+    inv = DeviceInventory(chips, unit=MemoryUnit.MiB)
+    assert inv.total_units() == 1024
+
+
+def test_fanout_heterogeneous_chips():
+    # Fix vs reference nvidia.go:71-74: per-chip capacity, no first-chip latch.
+    chips = [
+        TpuChip(id="a", index=0, device_path="/dev/accel0", hbm_bytes=16 << 30),
+        TpuChip(id="b", index=1, device_path="/dev/accel1", hbm_bytes=32 << 30),
+    ]
+    inv = DeviceInventory(chips)
+    assert inv.units_of("a") == 16
+    assert inv.units_of("b") == 32
+    assert inv.units_by_index() == {0: 16, 1: 32}
+
+
+def test_inventory_maps_and_core_devices():
+    chips = MockBackend(num_chips=2, hbm_bytes=8 << 30).chips()
+    inv = DeviceInventory(chips)
+    assert inv.index_of(chips[1].id) == 1
+    assert inv.id_of_index(0) == chips[0].id
+    cores = inv.core_devices()
+    assert [c.id for c in cores] == [chips[0].id, chips[1].id]
+    assert all(c.healthy for c in cores)
+
+
+def test_health_overlay():
+    chips = MockBackend(num_chips=2, hbm_bytes=2 << 30).chips()
+    inv = DeviceInventory(chips)
+    overlay = {chips[0].id: ChipHealth.UNHEALTHY}
+    devs = inv.mem_fake_devices(health=overlay)
+    sick = [d for d in devs if not d.healthy]
+    assert len(sick) == 2
+    assert all(d.chip_id == chips[0].id for d in sick)
+
+
+def test_duplicate_chip_rejected():
+    chips = [
+        TpuChip(id="a", index=0, device_path="", hbm_bytes=1 << 30),
+        TpuChip(id="a", index=1, device_path="", hbm_bytes=1 << 30),
+    ]
+    with pytest.raises(ValueError):
+        DeviceInventory(chips)

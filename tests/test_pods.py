@@ -1,0 +1,107 @@
+"""Table tests for pod predicates/accounting (reference: podutils.go, podmanager.go)."""
+
+from gpushare_device_plugin_tpu import const
+from gpushare_device_plugin_tpu.cluster import pods as P
+
+from k8s_fixtures import assigned_running_pod, make_pod
+
+
+def test_mem_units_sums_container_limits():
+    pod = make_pod("p", containers=[2, 3, 0])
+    assert P.mem_units_of_pod(pod) == 5
+
+
+def test_mem_units_garbled_quantity_is_zero():
+    pod = make_pod("p", 2)
+    pod["spec"]["containers"][0]["resources"]["limits"][const.RESOURCE_MEM] = "2GiB"
+    assert P.mem_units_of_pod(pod) == 0
+
+
+def test_is_tpu_share_pod():
+    assert P.is_tpu_share_pod(make_pod("p", 1))
+    assert not P.is_tpu_share_pod(make_pod("p", 0))
+
+
+def test_assumed_and_assigned_predicates():
+    pod = make_pod("p", 2)
+    assert not P.is_assumed(pod)
+    assert not P.is_assigned(pod)
+    pod["metadata"]["annotations"][const.ENV_ASSUME_TIME] = "123"
+    assert P.is_assumed(pod)
+    pod["metadata"]["annotations"][const.ENV_ASSIGNED_FLAG] = "false"
+    assert not P.is_assigned(pod)  # literal "false" => not assigned
+    pod["metadata"]["annotations"][const.ENV_ASSIGNED_FLAG] = "true"
+    assert P.is_assigned(pod)
+
+
+def test_chip_idx_annotation_parse():
+    assert P.chip_idx_from_annotation(make_pod("p", 1)) == -1
+    pod = make_pod("p", 1, annotations={const.ENV_MEM_IDX: "3"})
+    assert P.chip_idx_from_annotation(pod) == 3
+    pod = make_pod("p", 1, annotations={const.ENV_MEM_IDX: "oops"})
+    assert P.chip_idx_from_annotation(pod) == -1
+
+
+def test_candidate_pods_filter_and_order():
+    newer = make_pod("newer", 2, created="2026-01-02T00:00:00Z")
+    older = make_pod("older", 2, created="2026-01-01T00:00:00Z")
+    other_node = make_pod("elsewhere", 2, node="node-b")
+    non_share = make_pod("plain", 0)
+    done = make_pod(
+        "done",
+        2,
+        annotations={
+            const.ENV_ASSUME_TIME: "1",
+            const.ENV_ASSIGNED_FLAG: "true",
+        },
+    )
+    # assumed but NOT assigned -> still a candidate (extender wrote IDX,
+    # Allocate hasn't run yet)
+    assumed_only = make_pod(
+        "assumed", 2, created="2026-01-03T00:00:00Z",
+        annotations={const.ENV_ASSUME_TIME: "1"},
+    )
+    got = P.candidate_pods(
+        [newer, older, other_node, non_share, done, assumed_only], "node-a"
+    )
+    assert [P.name(p) for p in got] == ["older", "newer", "assumed"]
+
+
+def test_candidate_pods_dedup_by_uid():
+    a = make_pod("a", 2, uid="same")
+    b = make_pod("a", 2, uid="same")
+    assert len(P.candidate_pods([a, b], "node-a")) == 1
+
+
+def test_candidate_same_timestamp_deterministic():
+    a = make_pod("b-pod", 2, created="2026-01-01T00:00:00Z")
+    b = make_pod("a-pod", 2, created="2026-01-01T00:00:00Z")
+    got = P.candidate_pods([a, b], "node-a")
+    assert [P.name(p) for p in got] == ["a-pod", "b-pod"]
+
+
+def test_used_units_by_chip_counts_only_running_labeled():
+    running = assigned_running_pod("r1", 4, chip_idx=0)
+    running2 = assigned_running_pod("r2", 2, chip_idx=0)
+    other_chip = assigned_running_pod("r3", 8, chip_idx=2)
+    pending = make_pod(
+        "pend", 4,
+        annotations={const.ENV_MEM_IDX: "1"},
+        labels={const.LABEL_RESOURCE_KEY: const.LABEL_RESOURCE_VALUE},
+    )
+    unlabeled = assigned_running_pod("r4", 4, chip_idx=1)
+    del unlabeled["metadata"]["labels"][const.LABEL_RESOURCE_KEY]
+    no_idx = assigned_running_pod("r5", 4, chip_idx=3)
+    del no_idx["metadata"]["annotations"][const.ENV_MEM_IDX]
+
+    used = P.used_units_by_chip([running, running2, other_chip, pending, unlabeled, no_idx])
+    assert used == {0: 6, 2: 8}
+
+
+def test_used_chips_from_core_pods():
+    p = make_pod(
+        "core", tpu_core=2, phase="Running",
+        annotations={const.ENV_MEM_IDX: "1"},
+    )
+    assert P.used_chips([p]) == {1, 2}
+    assert P.used_chips([make_pod("none", 1, phase="Running")]) == set()

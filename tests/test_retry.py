@@ -1,0 +1,43 @@
+import pytest
+
+from gpushare_device_plugin_tpu.utils.retry import RetryError, retry
+
+
+def test_retry_succeeds_after_failures():
+    calls = []
+
+    def fn():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ValueError("boom")
+        return "ok"
+
+    assert retry(fn, attempts=8, delay_s=0, sleep=lambda s: None) == "ok"
+    assert len(calls) == 3
+
+
+def test_retry_exhausts_budget():
+    def fn():
+        raise ValueError("always")
+
+    with pytest.raises(RetryError) as ei:
+        retry(fn, attempts=3, delay_s=0, sleep=lambda s: None)
+    assert ei.value.attempts == 3
+
+
+def test_retry_non_retryable_stops_immediately():
+    calls = []
+
+    def fn():
+        calls.append(1)
+        raise KeyError("fatal")
+
+    with pytest.raises(RetryError):
+        retry(
+            fn,
+            attempts=5,
+            delay_s=0,
+            retryable=lambda e: not isinstance(e, KeyError),
+            sleep=lambda s: None,
+        )
+    assert len(calls) == 1

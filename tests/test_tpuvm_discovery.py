@@ -1,0 +1,77 @@
+"""tpuvm discovery backend against a fake /dev tree (no real TPU needed)."""
+
+import pytest
+
+from gpushare_device_plugin_tpu.discovery.tpuvm import (
+    TpuVmBackend,
+    parse_accelerator_type,
+)
+
+
+@pytest.fixture
+def fake_dev(tmp_path):
+    for i in range(4):
+        (tmp_path / f"accel{i}").touch()
+    return str(tmp_path / "accel*")
+
+
+@pytest.mark.parametrize(
+    "accel,expected",
+    [
+        ("v4-8", ("v4", 8)),
+        ("v4-32", ("v4", 32)),
+        ("v5litepod-8", ("v5litepod", 8)),
+        ("v5p-128", ("v5p", 128)),
+        ("v3-8", ("v3", 8)),
+        ("garbage", ("", 0)),
+        ("", ("", 0)),
+    ],
+)
+def test_parse_accelerator_type(accel, expected):
+    assert parse_accelerator_type(accel) == expected
+
+
+def test_probe_and_chips(fake_dev):
+    be = TpuVmBackend(dev_glob=fake_dev, env={"TPU_ACCELERATOR_TYPE": "v4-8"})
+    assert be.probe()
+    chips = be.chips()
+    assert len(chips) == 4
+    assert chips[0].index == 0
+    assert chips[0].hbm_bytes == 32 << 30  # v4 spec
+    assert chips[2].device_path.endswith("accel2")
+    assert "v4" in chips[0].id
+
+
+def test_probe_false_without_devices(tmp_path):
+    be = TpuVmBackend(dev_glob=str(tmp_path / "accel*"), env={})
+    assert not be.probe()
+    assert be.chips() == []
+
+
+def test_hbm_env_override(fake_dev):
+    be = TpuVmBackend(dev_glob=fake_dev, env={"TPUSHARE_HBM_GIB": "95"})
+    assert be.chips()[0].hbm_bytes == 95 << 30
+
+
+def test_hbm_default_unknown_generation(fake_dev):
+    be = TpuVmBackend(dev_glob=fake_dev, env={})
+    assert be.chips()[0].hbm_bytes == 16 << 30
+
+
+def test_topology_multihost_v4_32(fake_dev):
+    be = TpuVmBackend(
+        dev_glob=fake_dev,
+        env={"TPU_ACCELERATOR_TYPE": "v4-32", "TPU_WORKER_ID": "2"},
+    )
+    topo = be.topology()
+    assert topo.generation == "v4"
+    assert topo.chips_per_host == 4
+    assert topo.host_index == 2
+    # v4-32 = 32 TensorCores = 16 chips = 4 hosts x 4 chips (SURVEY.md)
+    assert topo.num_hosts == 4
+
+
+def test_topology_v3_counts_cores(fake_dev):
+    # v3-8 = 8 cores = 4 chips = 1 host
+    be = TpuVmBackend(dev_glob=fake_dev, env={"ACCELERATOR_TYPE": "v3-8"})
+    assert be.topology().num_hosts == 1
