@@ -1,0 +1,21 @@
+PY ?= python
+PROTOC ?= protoc
+
+.PHONY: proto native test bench
+
+# Regenerate protobuf message classes (gRPC bindings are hand-written in
+# gpushare_device_plugin_tpu/plugin/api/api_grpc.py; grpc_tools is not
+# available in the image, protoc --python_out is enough for messages).
+proto:
+	$(PROTOC) -I gpushare_device_plugin_tpu/plugin/api \
+	  --python_out=gpushare_device_plugin_tpu/plugin/api \
+	  gpushare_device_plugin_tpu/plugin/api/deviceplugin.proto
+
+native:
+	$(MAKE) -C gpushare_device_plugin_tpu/native
+
+test:
+	$(PY) -m pytest tests/ -x -q
+
+bench:
+	$(PY) bench.py

@@ -1,0 +1,169 @@
+"""The production Allocate() flow: kubelet grant -> pod match -> chip pick
+-> apiserver persistence -> env/device payload.
+
+Faithful to the reference's critical path (``allocate.go:27-134``, traced in
+SURVEY.md section 3.2) with its failure semantics:
+
+1. The granted fake-ID lists are only *counted* (which IDs kubelet picked is
+   meaningless by design).
+2. The pending pod being admitted is identified by matching the request
+   total against candidate pods' summed limits, oldest first. Two
+   same-size pods admitted concurrently can swap allocations — a design
+   hazard inherited from the reference (``allocate.go:51-61``); harmless
+   for fungible HBM slices since both pods get *a* valid placement, and
+   the annotation write is what the rest of the system trusts.
+3. Placement: the scheduler-extender's annotation wins if the pod was
+   assumed (branch A, ``allocate.go:75-84``); otherwise first-fit binpack
+   over apiserver-derived usage (branch B, ``allocate.go:85-98``).
+4. The decision is persisted as pod annotations + the tpushare label via
+   strategic-merge patch, retried once on optimistic-lock conflicts
+   (``allocate.go:136-150``). The apiserver is the only database; restart
+   re-derives everything.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Sequence
+
+from .. import const
+from ..cluster import pods as P
+from ..cluster.apiserver import ApiError, ApiServerClient
+from ..cluster.podsource import PodSource
+from ..device.fanout import DeviceInventory
+from ..utils.log import get_logger
+from .binpack import assign_chip
+from .env import ContainerAllocation, build_mem_allocation
+
+log = get_logger("allocator.cluster")
+
+
+class AllocationFailure(RuntimeError):
+    """Raised to fail pod admission (gRPC error -> UnexpectedAdmissionError)."""
+
+
+class ClusterAllocator:
+    def __init__(
+        self,
+        inventory: DeviceInventory,
+        api: ApiServerClient,
+        pod_source: PodSource,
+        node_name: str,
+        policy: str = "first-fit",
+        disable_isolation: bool = False,
+        unhealthy_chips_fn=None,
+    ):
+        self._inv = inventory
+        self._api = api
+        self._pods = pod_source
+        self._node = node_name
+        self._policy = policy
+        self._disable_isolation = disable_isolation
+        self._unhealthy_fn = unhealthy_chips_fn or (lambda: [])
+        # serializes the whole allocate path (reference: allocate.go:42-43)
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------------
+
+    def allocate(self, granted: Sequence[Sequence[str]]) -> list[ContainerAllocation]:
+        pod_units = sum(len(ids) for ids in granted)
+        container_units = [len(ids) for ids in granted]
+        log.v(4, "Allocate: pod_units=%d per-container=%s", pod_units, container_units)
+        with self._lock:
+            pod = self._match_pending_pod(pod_units)
+            if pod is None:
+                raise AllocationFailure(
+                    f"invalid allocation request: no pending pod on {self._node} "
+                    f"requesting {pod_units} {const.RESOURCE_MEM}"
+                )
+            if P.is_assumed(pod) and not P.is_assigned(pod):
+                idx = self._assumed_chip(pod)
+                annotations = {const.ENV_ASSIGNED_FLAG: "true"}
+            else:
+                idx = self._binpack_chip(pod_units)
+                annotations = {
+                    const.ENV_MEM_IDX: str(idx),
+                    const.ENV_MEM_POD: str(pod_units),
+                    const.ENV_MEM_DEV: str(self._chip_total(idx)),
+                    const.ENV_ASSIGNED_FLAG: "true",
+                }
+            annotations[const.ENV_ASSUME_TIME] = str(time.time_ns())
+            self._persist(pod, annotations)
+        chip = self._inv.chip_by_id(self._inv.id_of_index(idx))
+        total = self._chip_total(idx)
+        log.info(
+            "allocated pod %s/%s: %d units on chip %d (%s)",
+            P.namespace(pod), P.name(pod), pod_units, idx, chip.id,
+        )
+        return [
+            build_mem_allocation(
+                chip=chip,
+                chip_total_units=total,
+                pod_units=pod_units,
+                container_units=n,
+                disable_isolation=self._disable_isolation,
+            )
+            for n in container_units
+        ]
+
+    # ------------------------------------------------------------------
+
+    def _chip_total(self, idx: int) -> int:
+        return self._inv.units_of(self._inv.id_of_index(idx))
+
+    def _match_pending_pod(self, pod_units: int):
+        """Oldest pending share pod whose total limits equal the request
+        (``allocate.go:51-61``)."""
+        candidates = P.candidate_pods(self._pods.pending_pods(), self._node)
+        log.v(4, "candidates: %s", [P.name(p) for p in candidates])
+        for pod in candidates:
+            if P.mem_units_of_pod(pod) == pod_units:
+                return pod
+        return None
+
+    def _assumed_chip(self, pod) -> int:
+        """Branch A: trust the scheduler extender's placement."""
+        idx = P.chip_idx_from_annotation(pod)
+        if idx < 0 or idx not in self._inv.units_by_index():
+            raise AllocationFailure(
+                f"pod {P.name(pod)} assumed by extender but its "
+                f"{const.ENV_MEM_IDX} annotation is invalid: {idx}"
+            )
+        log.v(4, "extender placement for %s: chip %d", P.name(pod), idx)
+        return idx
+
+    def _binpack_chip(self, pod_units: int) -> int:
+        """Branch B: first-fit over capacity minus apiserver-declared usage."""
+        used = P.used_units_by_chip(self._pods.running_share_pods())
+        try:
+            return assign_chip(
+                pod_units,
+                self._inv.units_by_index(),
+                used,
+                unhealthy=self._unhealthy_fn(),
+                policy=self._policy,
+            )
+        except Exception as e:
+            raise AllocationFailure(str(e)) from e
+
+    def _persist(self, pod, annotations: dict[str, str]) -> None:
+        """Label + annotation patch with one conflict retry
+        (``allocate.go:126,136-150``)."""
+        patch = {
+            "metadata": {
+                "annotations": annotations,
+                "labels": {const.LABEL_RESOURCE_KEY: const.LABEL_RESOURCE_VALUE},
+            }
+        }
+        ns, name = P.namespace(pod), P.name(pod)
+        try:
+            self._api.patch_pod(ns, name, patch)
+        except ApiError as e:
+            if const.OPTIMISTIC_LOCK_ERROR_MSG not in e.body and e.status != 409:
+                raise AllocationFailure(f"pod patch failed: {e}") from e
+            log.warning("patch conflict for %s/%s; retrying once", ns, name)
+            try:
+                self._api.patch_pod(ns, name, patch)
+            except ApiError as e2:
+                raise AllocationFailure(f"pod patch failed twice: {e2}") from e2

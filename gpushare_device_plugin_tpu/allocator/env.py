@@ -1,0 +1,100 @@
+"""Container payload builder: the env/devices a granted pod receives.
+
+TPU analog of the reference's response assembly (``allocate.go:109-124``):
+where the reference injects ``NVIDIA_VISIBLE_DEVICES=<idx>`` plus the
+``ALIYUN_COM_GPU_MEM_*`` family, a TPU pod needs
+
+- ``TPU_VISIBLE_CHIPS``            — which local chip(s) the process may use
+- ``TPU_PROCESS_BOUNDS`` /
+  ``TPU_CHIPS_PER_PROCESS_BOUNDS`` — single-process topology carve-out
+- the ``ALIYUN_COM_TPU_MEM_*`` bookkeeping family (idx/pod/container/dev)
+- a cooperative HBM cap (``XLA_PYTHON_CLIENT_MEM_FRACTION``) because TPU
+  HBM, like GPU memory in the reference, has no hardware fence; disabled
+  via the node label analog of cGPU's toggle (``podmanager.go:59-72``)
+
+and, unlike the reference (which never used the proto's ``devices`` field),
+an explicit ``DeviceSpec`` for ``/dev/accel<idx>`` so the container can open
+the chip without privileged mode.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+from .. import const
+from ..discovery.base import TpuChip
+
+
+@dataclasses.dataclass
+class DeviceMount:
+    container_path: str
+    host_path: str
+    permissions: str = "rw"
+
+
+@dataclasses.dataclass
+class ContainerAllocation:
+    """One container's allocation payload (maps 1:1 onto the proto)."""
+
+    envs: dict[str, str] = dataclasses.field(default_factory=dict)
+    devices: list[DeviceMount] = dataclasses.field(default_factory=list)
+    annotations: dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+def visible_chips_value(chip_indices: Sequence[int]) -> str:
+    return ",".join(str(i) for i in sorted(chip_indices))
+
+
+def build_mem_allocation(
+    *,
+    chip: TpuChip,
+    chip_total_units: int,
+    pod_units: int,
+    container_units: int,
+    disable_isolation: bool = False,
+) -> ContainerAllocation:
+    """Payload for a fractional-HBM container pinned to one chip."""
+    envs = {
+        const.ENV_TPU_VISIBLE_CHIPS: str(chip.index),
+        # one process, one chip: the standard TPU-VM carve-out
+        const.ENV_TPU_PROCESS_BOUNDS: "1,1,1",
+        const.ENV_TPU_CHIPS_PER_PROCESS_BOUNDS: "1,1,1",
+        const.ENV_MEM_IDX: str(chip.index),
+        const.ENV_MEM_POD: str(pod_units),
+        const.ENV_MEM_CONTAINER: str(container_units),
+        const.ENV_MEM_DEV: str(chip_total_units),
+    }
+    if disable_isolation:
+        envs["CTPU_DISABLE"] = "true"
+    elif chip_total_units > 0:
+        frac = min(1.0, pod_units / chip_total_units)
+        envs[const.ENV_XLA_MEM_FRACTION] = f"{frac:.4f}"
+        envs[const.ENV_XLA_PYTHON_MEM_FRACTION] = f"{frac:.4f}"
+    alloc = ContainerAllocation(envs=envs)
+    if chip.device_path:
+        alloc.devices.append(
+            DeviceMount(container_path=chip.device_path, host_path=chip.device_path)
+        )
+    return alloc
+
+
+def build_core_allocation(
+    *, chips: Sequence[TpuChip], process_bounds: str = "", chips_per_process_bounds: str = ""
+) -> ContainerAllocation:
+    """Payload for a whole-chip (``tpu-core``) container: exclusive chips,
+    no HBM cap."""
+    envs = {
+        const.ENV_TPU_VISIBLE_CHIPS: visible_chips_value([c.index for c in chips]),
+    }
+    if process_bounds:
+        envs[const.ENV_TPU_PROCESS_BOUNDS] = process_bounds
+    if chips_per_process_bounds:
+        envs[const.ENV_TPU_CHIPS_PER_PROCESS_BOUNDS] = chips_per_process_bounds
+    alloc = ContainerAllocation(envs=envs)
+    for c in chips:
+        if c.device_path:
+            alloc.devices.append(
+                DeviceMount(container_path=c.device_path, host_path=c.device_path)
+            )
+    return alloc

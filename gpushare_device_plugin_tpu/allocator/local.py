@@ -1,0 +1,94 @@
+"""Standalone allocator: binpack with in-process accounting, no Kubernetes.
+
+Used by unit tests, the bench harness, and single-node standalone mode
+(``--standalone``). The production path (``ClusterAllocator``) instead
+derives usage from the apiserver every call — restart-safe because the
+cluster is the database; this one trades that for zero dependencies.
+
+Frees are driven by ``release(pod_key)`` (bench/tests call it on pod end).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Sequence
+
+from ..device.fanout import DeviceInventory
+from .binpack import assign_chip
+from .env import ContainerAllocation, build_mem_allocation
+
+
+class LocalAllocator:
+    def __init__(
+        self,
+        inventory: DeviceInventory,
+        policy: str = "first-fit",
+        disable_isolation: bool = False,
+    ):
+        self._inv = inventory
+        self._policy = policy
+        self._disable_isolation = disable_isolation
+        self._lock = threading.Lock()
+        self._used: dict[int, int] = {}  # chip index -> units
+        self._by_pod: dict[str, tuple[int, int]] = {}  # pod key -> (chip, units)
+        self._unhealthy: set[int] = set()
+
+    def set_chip_health(self, chip_index: int, healthy: bool) -> None:
+        with self._lock:
+            if healthy:
+                self._unhealthy.discard(chip_index)
+            else:
+                self._unhealthy.add(chip_index)
+
+    def allocate(
+        self, container_counts: Sequence[int], pod_key: str | None = None
+    ) -> list[ContainerAllocation]:
+        """Place one pod: ``container_counts`` = granted fake-IDs per container.
+
+        Mirrors the Allocate contract: the request total is the pod's demand;
+        which fake IDs kubelet picked is irrelevant (``allocate.go:37-39``).
+        """
+        pod_units = sum(container_counts)
+        with self._lock:
+            idx = assign_chip(
+                pod_units,
+                self._inv.units_by_index(),
+                self._used,
+                unhealthy=sorted(self._unhealthy),
+                policy=self._policy,
+            )
+            self._used[idx] = self._used.get(idx, 0) + pod_units
+            if pod_key is not None:
+                self._by_pod[pod_key] = (idx, pod_units)
+        chip = self._inv.chip_by_id(self._inv.id_of_index(idx))
+        total = self._inv.units_of(chip.id)
+        return [
+            build_mem_allocation(
+                chip=chip,
+                chip_total_units=total,
+                pod_units=pod_units,
+                container_units=n,
+                disable_isolation=self._disable_isolation,
+            )
+            for n in container_counts
+        ]
+
+    def release(self, pod_key: str) -> None:
+        with self._lock:
+            entry = self._by_pod.pop(pod_key, None)
+            if entry is None:
+                return
+            idx, units = entry
+            self._used[idx] = max(0, self._used.get(idx, 0) - units)
+
+    def used_by_chip(self) -> dict[int, int]:
+        with self._lock:
+            return dict(self._used)
+
+    def utilization(self) -> float:
+        """Fraction of advertised HBM units currently allocated."""
+        total = self._inv.total_units()
+        if total == 0:
+            return 0.0
+        with self._lock:
+            return sum(self._used.values()) / total

@@ -1,0 +1,128 @@
+"""Daemon entrypoint: ``tpushare-device-plugin``.
+
+Reference: ``cmd/nvidia/main.go:15-78`` — flag parsing, kubelet-client
+construction with serviceaccount-token fallback, memory-unit validation,
+then hand-off to the lifecycle manager. TPU additions: ``--discovery``
+backend selection, ``--policy`` binpack choice, ``--standalone`` mode
+(no apiserver), and ``--no-core-resource``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from .. import const
+from ..cluster.apiserver import ApiServerClient
+from ..cluster.kubelet import KubeletClient
+from ..cluster.podsource import ApiServerPodSource, KubeletPodSource
+from ..discovery import from_name
+from ..manager import ManagerConfig, TpuShareManager
+from ..utils import log as logutil
+
+SA_TOKEN_PATH = "/var/run/secrets/kubernetes.io/serviceaccount/token"
+
+log = logutil.get_logger("daemon")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpushare-device-plugin",
+        description="TPU-sharing Kubernetes device plugin (fractional HBM + whole chips)",
+    )
+    # reference flag set (cmd/nvidia/main.go:15-26)
+    p.add_argument("--health-check", action="store_true",
+                   help="enable chip health monitoring into ListAndWatch")
+    p.add_argument("--memory-unit", default="GiB", choices=["GiB", "MiB"],
+                   help="granularity of one tpu-mem unit")
+    p.add_argument("--query-kubelet", action="store_true",
+                   help="source pods from kubelet /pods instead of the apiserver")
+    p.add_argument("--kubelet-address", default="127.0.0.1")
+    p.add_argument("--kubelet-port", type=int, default=10250)
+    p.add_argument("--client-cert", default="")
+    p.add_argument("--client-key", default="")
+    p.add_argument("--token", default="", help="kubelet bearer token "
+                   "(default: serviceaccount token file)")
+    p.add_argument("--timeout", type=float, default=10.0,
+                   help="kubelet/apiserver HTTP timeout seconds")
+    # TPU-native flags
+    p.add_argument("--discovery", default="auto",
+                   choices=["auto", "mock", "jax", "tpuvm"])
+    p.add_argument("--policy", default="first-fit",
+                   choices=["first-fit", "best-fit"])
+    p.add_argument("--standalone", action="store_true",
+                   help="no apiserver: in-process accounting (dev/bench)")
+    p.add_argument("--no-core-resource", action="store_true",
+                   help="do not serve the whole-chip tpu-core resource")
+    p.add_argument("--plugin-dir", default=const.DEVICE_PLUGIN_PATH)
+    p.add_argument("--node-name", default=os.environ.get("NODE_NAME", ""))
+    p.add_argument("--coredump-dir", default="/etc/kubernetes")
+    p.add_argument("-v", "--verbosity", type=int, default=0)
+    return p
+
+
+def build_kubelet_token(args) -> str:
+    """Explicit flag, else in-cluster serviceaccount token
+    (``cmd/nvidia/main.go:28-53``)."""
+    if args.token:
+        return args.token
+    if os.path.exists(SA_TOKEN_PATH):
+        with open(SA_TOKEN_PATH) as f:
+            return f.read().strip()
+    return ""
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    logutil.setup(args.verbosity)
+
+    backend = from_name(args.discovery)
+    cfg = ManagerConfig(
+        plugin_dir=args.plugin_dir,
+        node_name=args.node_name,
+        memory_unit=const.translate_memory_units(args.memory_unit),
+        policy=args.policy,
+        health_check=args.health_check,
+        standalone=args.standalone,
+        serve_core_resource=not args.no_core_resource,
+        coredump_dir=args.coredump_dir,
+    )
+
+    api_client = None
+    pod_source = None
+    if not args.standalone:
+        if not args.node_name:
+            log.fatal("NODE_NAME env (or --node-name) is required in cluster mode")
+        try:
+            api_client = ApiServerClient.from_env(timeout_s=args.timeout)
+        except Exception as e:  # bad/garbled kubeconfig, missing SA, etc.
+            log.fatal(f"apiserver config failed: {e} (use --standalone for no-cluster mode)")
+        apisrc = ApiServerPodSource(api_client, args.node_name)
+        if args.query_kubelet:
+            cert = None
+            if args.client_cert and args.client_key:
+                cert = (args.client_cert, args.client_key)
+            kubelet = KubeletClient(
+                host=args.kubelet_address,
+                port=args.kubelet_port,
+                token=build_kubelet_token(args),
+                client_cert=cert,
+                timeout_s=args.timeout,
+            )
+            pod_source = KubeletPodSource(kubelet, apisrc, args.node_name)
+        else:
+            pod_source = apisrc
+
+    manager = TpuShareManager(backend, cfg, api_client=api_client, pod_source=pod_source)
+    manager.install_signal_handlers()
+    log.info(
+        "tpushare-device-plugin starting: discovery=%s policy=%s standalone=%s",
+        args.discovery, args.policy, args.standalone,
+    )
+    manager.run()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

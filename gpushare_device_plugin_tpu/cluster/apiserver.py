@@ -1,0 +1,235 @@
+"""Minimal kube-apiserver REST client.
+
+No kubernetes client library is vendored; the plugin needs only a handful
+of verbs (list pods/nodes with selectors, strategic-merge patch pod, patch
+node status), all plain REST+JSON. Config resolution mirrors the reference
+(``podmanager.go:29-57``): ``$KUBECONFIG`` file if set, else the in-cluster
+serviceaccount (token + CA + ``KUBERNETES_SERVICE_HOST/PORT``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Mapping
+
+import requests
+
+from ..utils.log import get_logger
+
+log = get_logger("cluster.apiserver")
+
+SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+STRATEGIC_MERGE = "application/strategic-merge-patch+json"
+MERGE_PATCH = "application/merge-patch+json"
+
+
+class ApiError(RuntimeError):
+    def __init__(self, status: int, body: str):
+        super().__init__(f"apiserver HTTP {status}: {body[:300]}")
+        self.status = status
+        self.body = body
+
+
+class ApiServerClient:
+    def __init__(
+        self,
+        base_url: str,
+        token: str = "",
+        ca_file: str | None = None,
+        client_cert: tuple[str, str] | None = None,
+        insecure: bool = False,
+        timeout_s: float = 10.0,
+    ):
+        self.base_url = base_url.rstrip("/")
+        self._timeout = timeout_s
+        self._session = requests.Session()
+        if token:
+            self._session.headers["Authorization"] = f"Bearer {token}"
+        if client_cert:
+            self._session.cert = client_cert
+        self._session.verify = False if insecure else (ca_file or True)
+
+    # --- construction ------------------------------------------------------
+
+    @classmethod
+    def from_env(cls, timeout_s: float = 10.0) -> "ApiServerClient":
+        """$KUBECONFIG file if set (reference honors it first), else in-cluster."""
+        kubeconfig = os.environ.get("KUBECONFIG", "")
+        if kubeconfig and os.path.exists(kubeconfig):
+            return cls.from_kubeconfig(kubeconfig, timeout_s=timeout_s)
+        return cls.in_cluster(timeout_s=timeout_s)
+
+    @classmethod
+    def in_cluster(cls, timeout_s: float = 10.0) -> "ApiServerClient":
+        host = os.environ.get("KUBERNETES_SERVICE_HOST", "")
+        port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+        if not host:
+            raise RuntimeError(
+                "not in cluster: KUBERNETES_SERVICE_HOST unset and no KUBECONFIG"
+            )
+        token = ""
+        token_path = os.path.join(SA_DIR, "token")
+        if os.path.exists(token_path):
+            with open(token_path) as f:
+                token = f.read().strip()
+        ca = os.path.join(SA_DIR, "ca.crt")
+        return cls(
+            f"https://{host}:{port}",
+            token=token,
+            ca_file=ca if os.path.exists(ca) else None,
+            insecure=not os.path.exists(ca),
+            timeout_s=timeout_s,
+        )
+
+    @classmethod
+    def from_kubeconfig(cls, path: str, timeout_s: float = 10.0) -> "ApiServerClient":
+        import base64
+        import tempfile
+
+        import yaml
+
+        with open(path) as f:
+            cfg = yaml.safe_load(f) or {}
+
+        def materialize(data_b64: str, suffix: str) -> str:
+            """Inline *-data credentials (kind/minikube/GKE kubeconfigs) ->
+            temp file, since requests wants paths."""
+            f = tempfile.NamedTemporaryFile(
+                mode="wb", suffix=suffix, delete=False, prefix="tpushare-kc-"
+            )
+            f.write(base64.b64decode(data_b64))
+            f.close()
+            return f.name
+
+        ctx_name = cfg.get("current-context", "")
+        ctx = {}
+        for item in cfg.get("contexts", []) or []:
+            if item.get("name") == ctx_name:
+                ctx = item.get("context", {}) or {}
+        cluster = {}
+        for item in cfg.get("clusters", []) or []:
+            if item.get("name") == ctx.get("cluster"):
+                cluster = item.get("cluster", {}) or {}
+        user = {}
+        for item in cfg.get("users", []) or []:
+            if item.get("name") == ctx.get("user"):
+                user = item.get("user", {}) or {}
+
+        server = cluster.get("server", "https://127.0.0.1:6443")
+        insecure = bool(cluster.get("insecure-skip-tls-verify", False))
+        ca_file = cluster.get("certificate-authority")
+        if not ca_file and cluster.get("certificate-authority-data"):
+            ca_file = materialize(cluster["certificate-authority-data"], ".crt")
+        token = user.get("token", "")
+        cert_file = user.get("client-certificate")
+        key_file = user.get("client-key")
+        if not cert_file and user.get("client-certificate-data"):
+            cert_file = materialize(user["client-certificate-data"], ".crt")
+        if not key_file and user.get("client-key-data"):
+            key_file = materialize(user["client-key-data"], ".key")
+        cert = (cert_file, key_file) if cert_file and key_file else None
+        return cls(
+            server,
+            token=token,
+            ca_file=ca_file,
+            client_cert=cert,
+            insecure=insecure,
+            timeout_s=timeout_s,
+        )
+
+    # --- raw verbs ----------------------------------------------------------
+
+    def _get(self, path: str, params: Mapping[str, str] | None = None) -> dict:
+        r = self._session.get(
+            self.base_url + path, params=params or {}, timeout=self._timeout
+        )
+        if r.status_code != 200:
+            raise ApiError(r.status_code, r.text)
+        return r.json()
+
+    def _patch(self, path: str, body: Any, content_type: str) -> dict:
+        r = self._session.patch(
+            self.base_url + path,
+            data=json.dumps(body),
+            headers={"Content-Type": content_type},
+            timeout=self._timeout,
+        )
+        if r.status_code not in (200, 201):
+            raise ApiError(r.status_code, r.text)
+        return r.json()
+
+    # --- typed helpers ------------------------------------------------------
+
+    def list_pods(
+        self,
+        namespace: str | None = None,
+        field_selector: str = "",
+        label_selector: str = "",
+    ) -> list[dict]:
+        path = (
+            f"/api/v1/namespaces/{namespace}/pods" if namespace else "/api/v1/pods"
+        )
+        params = {}
+        if field_selector:
+            params["fieldSelector"] = field_selector
+        if label_selector:
+            params["labelSelector"] = label_selector
+        return self._get(path, params).get("items", [])
+
+    def get_pod(self, namespace: str, name: str) -> dict:
+        return self._get(f"/api/v1/namespaces/{namespace}/pods/{name}")
+
+    def patch_pod(self, namespace: str, name: str, patch: dict) -> dict:
+        """Strategic-merge patch (reference: ``allocate.go:136-150``)."""
+        return self._patch(
+            f"/api/v1/namespaces/{namespace}/pods/{name}", patch, STRATEGIC_MERGE
+        )
+
+    def bind_pod(self, namespace: str, name: str, node: str) -> None:
+        """POST pods/{name}/binding — used by the scheduler extender."""
+        body = {
+            "apiVersion": "v1",
+            "kind": "Binding",
+            "metadata": {"name": name, "namespace": namespace},
+            "target": {"apiVersion": "v1", "kind": "Node", "name": node},
+        }
+        r = self._session.post(
+            f"{self.base_url}/api/v1/namespaces/{namespace}/pods/{name}/binding",
+            data=json.dumps(body),
+            headers={"Content-Type": "application/json"},
+            timeout=self._timeout,
+        )
+        if r.status_code not in (200, 201):
+            raise ApiError(r.status_code, r.text)
+
+    def list_nodes(self, label_selector: str = "") -> list[dict]:
+        params = {"labelSelector": label_selector} if label_selector else {}
+        return self._get("/api/v1/nodes", params).get("items", [])
+
+    def get_node(self, name: str) -> dict:
+        return self._get(f"/api/v1/nodes/{name}")
+
+    def patch_node_status(self, name: str, capacity: Mapping[str, str]) -> dict:
+        """Merge extended resources into node Status.Capacity/Allocatable.
+
+        Reference: ``patchGPUCount`` via nodeutil.PatchNodeStatus
+        (``podmanager.go:74-99``).
+        """
+        body = {
+            "status": {
+                "capacity": dict(capacity),
+                "allocatable": dict(capacity),
+            }
+        }
+        return self._patch(f"/api/v1/nodes/{name}/status", body, MERGE_PATCH)
+
+    def create_event(self, namespace: str, event: dict) -> None:
+        r = self._session.post(
+            f"{self.base_url}/api/v1/namespaces/{namespace}/events",
+            data=json.dumps(event),
+            headers={"Content-Type": "application/json"},
+            timeout=self._timeout,
+        )
+        if r.status_code not in (200, 201):
+            log.warning("event create failed: HTTP %s", r.status_code)

@@ -1,0 +1,46 @@
+"""Direct kubelet REST client: ``GET https://<node>:10250/pods/``.
+
+Reference: ``pkg/kubelet/client/client.go:39-134`` — a bearer-token HTTPS
+GET with TLS verification deliberately skipped (the kubelet serving cert is
+rarely signed for the node IP; the reference strips the CA for the same
+reason, ``client.go:79-83``). Returns the kubelet's authoritative local
+pod list, which the Allocate path prefers for freshness when
+``--query-kubelet`` is set.
+"""
+
+from __future__ import annotations
+
+import urllib3
+import requests
+
+from ..utils.log import get_logger
+
+log = get_logger("cluster.kubelet")
+
+urllib3.disable_warnings(urllib3.exceptions.InsecureRequestWarning)
+
+
+class KubeletClient:
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 10250,
+        token: str = "",
+        client_cert: tuple[str, str] | None = None,
+        timeout_s: float = 10.0,
+        scheme: str = "https",
+    ):
+        self.base_url = f"{scheme}://{host}:{port}"
+        self._timeout = timeout_s
+        self._session = requests.Session()
+        self._session.verify = False  # kubelet serving certs: see module doc
+        if token:
+            self._session.headers["Authorization"] = f"Bearer {token}"
+        if client_cert:
+            self._session.cert = client_cert
+
+    def get_node_running_pods(self) -> list[dict]:
+        """The kubelet's local ``v1.PodList`` (``client.go:119-134``)."""
+        r = self._session.get(f"{self.base_url}/pods/", timeout=self._timeout)
+        r.raise_for_status()
+        return r.json().get("items", [])

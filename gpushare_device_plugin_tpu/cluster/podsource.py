@@ -1,0 +1,106 @@
+"""Pod sourcing for the Allocate path, with the reference's retry budgets.
+
+Two backends (reference: ``podmanager.go:127-245``):
+- kubelet ``/pods`` with 8 x 100 ms retries, falling back to the apiserver
+  (``podmanager.go:141-157``) — fresher, preferred with ``--query-kubelet``;
+- apiserver LIST with field selector
+  ``spec.nodeName=<node>,status.phase=Pending`` and 3 x 1 s retries
+  (``podmanager.go:159-176``).
+"""
+
+from __future__ import annotations
+
+from typing import Protocol
+
+from ..utils.log import get_logger
+from ..utils.retry import RetryError, retry
+from . import pods as P
+from .apiserver import ApiServerClient
+from .kubelet import KubeletClient
+
+log = get_logger("cluster.podsource")
+
+KUBELET_RETRIES = 8
+KUBELET_DELAY_S = 0.1
+APISERVER_RETRIES = 3
+APISERVER_DELAY_S = 1.0
+
+
+class PodSource(Protocol):
+    def pending_pods(self) -> list[dict]:
+        """Pods on this node that may be awaiting allocation."""
+        ...
+
+    def running_share_pods(self) -> list[dict]:
+        """Running pods bearing the tpushare label (usage accounting)."""
+        ...
+
+
+class ApiServerPodSource:
+    def __init__(self, client: ApiServerClient, node_name: str):
+        self._c = client
+        self._node = node_name
+
+    def pending_pods(self) -> list[dict]:
+        return retry(
+            lambda: self._c.list_pods(
+                field_selector=f"spec.nodeName={self._node},status.phase=Pending"
+            ),
+            attempts=APISERVER_RETRIES,
+            delay_s=APISERVER_DELAY_S,
+        )
+
+    def running_share_pods(self) -> list[dict]:
+        from .. import const
+
+        return retry(
+            lambda: self._c.list_pods(
+                field_selector=f"spec.nodeName={self._node}",
+                label_selector=f"{const.LABEL_RESOURCE_KEY}={const.LABEL_RESOURCE_VALUE}",
+            ),
+            attempts=APISERVER_RETRIES,
+            delay_s=APISERVER_DELAY_S,
+        )
+
+
+class KubeletPodSource:
+    """Kubelet-first with apiserver fallback (``podmanager.go:141-157``)."""
+
+    def __init__(
+        self,
+        kubelet: KubeletClient,
+        fallback: ApiServerPodSource,
+        node_name: str,
+    ):
+        self._kubelet = kubelet
+        self._fallback = fallback
+        self._node = node_name
+
+    def _kubelet_pods(self) -> list[dict]:
+        return retry(
+            self._kubelet.get_node_running_pods,
+            attempts=KUBELET_RETRIES,
+            delay_s=KUBELET_DELAY_S,
+        )
+
+    def pending_pods(self) -> list[dict]:
+        try:
+            pods = self._kubelet_pods()
+        except RetryError as e:
+            log.warning("kubelet /pods failed (%s); falling back to apiserver", e)
+            return self._fallback.pending_pods()
+        # kubelet reports all local pods; keep the pending ones
+        return [p for p in pods if P.phase(p) == "Pending"]
+
+    def running_share_pods(self) -> list[dict]:
+        from .. import const
+
+        try:
+            pods = self._kubelet_pods()
+        except RetryError:
+            return self._fallback.running_share_pods()
+        return [
+            p
+            for p in pods
+            if P.labels(p).get(const.LABEL_RESOURCE_KEY) == const.LABEL_RESOURCE_VALUE
+        ]

@@ -1,0 +1,3 @@
+from .lifecycle import ManagerConfig, TpuShareManager
+
+__all__ = ["ManagerConfig", "TpuShareManager"]

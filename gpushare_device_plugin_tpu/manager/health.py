@@ -1,0 +1,79 @@
+"""Health watcher thread: discovery backend events -> plugin streams.
+
+Reference: the ``watchXIDs`` goroutine feeding the unhealthy channel
+(``nvidia.go:102-154`` -> ``server.go:207-225``), opt-in via
+``--health-check``. Differences by design: transitions flow in both
+directions (recovery supported) and also update the allocator's
+unhealthy-chip set so binpack stops targeting sick chips
+(closing the reference's TODO at ``server.go:267``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Iterable
+
+from ..discovery.base import ChipHealth, DiscoveryBackend, HealthEvent
+from ..utils.log import get_logger
+
+log = get_logger("manager.health")
+
+
+class HealthWatcher:
+    def __init__(
+        self,
+        backend: DiscoveryBackend,
+        sinks: Iterable[Callable[[str | None, ChipHealth], None]],
+    ):
+        """``sinks``: callables like ``plugin.set_chip_health`` invoked per event."""
+        self._backend = backend
+        self._sinks = list(sinks)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._unhealthy_ids: set[str] = set()
+        self._lock = threading.Lock()
+
+    def unhealthy_ids(self) -> set[str]:
+        with self._lock:
+            return set(self._unhealthy_ids)
+
+    def _handle(self, event: HealthEvent) -> None:
+        log.info(
+            "health: chip=%s -> %s (%s)",
+            event.chip_id or "ALL", event.health.value, event.reason,
+        )
+        with self._lock:
+            if event.chip_id is None:
+                if event.health == ChipHealth.UNHEALTHY:
+                    self._unhealthy_ids.update(
+                        c.id for c in self._backend.chips()
+                    )
+                else:
+                    self._unhealthy_ids.clear()
+            elif event.health == ChipHealth.UNHEALTHY:
+                self._unhealthy_ids.add(event.chip_id)
+            else:
+                self._unhealthy_ids.discard(event.chip_id)
+        for sink in self._sinks:
+            try:
+                sink(event.chip_id, event.health)
+            except Exception as e:  # a dead sink must not kill the watcher
+                log.warning("health sink failed: %s", e)
+
+    def start(self) -> None:
+        def run():
+            try:
+                for event in self._backend.watch_health(self._stop.is_set):
+                    if self._stop.is_set():
+                        return
+                    self._handle(event)
+            except Exception as e:
+                log.error("health watcher died: %s", e)
+
+        self._thread = threading.Thread(target=run, daemon=True, name="health-watch")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)

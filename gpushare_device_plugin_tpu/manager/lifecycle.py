@@ -1,0 +1,246 @@
+"""Daemon lifecycle manager.
+
+Reference: ``sharedGPUManager.Run`` (``gpumanager.go:33-108``):
+- park forever (never crash-loop) on nodes without accelerators,
+- serve the device plugin(s),
+- rebuild + re-register when kubelet restarts (socket watcher) or on SIGHUP,
+- SIGQUIT dumps all-thread stacks to a file,
+- other signals stop the plugins and exit.
+
+This manager owns both resource plugins (tpu-mem fan-out and whole-chip
+tpu-core), the health watcher, and — in cluster mode — the node-capacity
+patch at (re)build time.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import signal
+import threading
+from typing import Sequence
+
+from .. import const
+from ..allocator.env import build_core_allocation
+from ..allocator.local import LocalAllocator
+from ..device.fanout import DeviceInventory
+from ..discovery.base import DiscoveryBackend
+from ..plugin.server import PluginConfig, TpuSharePlugin
+from ..utils.log import get_logger
+from ..utils.stacktrace import coredump
+from .health import HealthWatcher
+from .watchers import SocketWatcher
+
+log = get_logger("manager")
+
+
+@dataclasses.dataclass
+class ManagerConfig:
+    plugin_dir: str = const.DEVICE_PLUGIN_PATH
+    node_name: str = ""
+    memory_unit: const.MemoryUnit = const.MemoryUnit.GiB
+    policy: str = "first-fit"
+    health_check: bool = False
+    # No apiserver: LocalAllocator accounting. Dev/bench only — without the
+    # apiserver there is no pod-lifecycle feed, so standalone allocations
+    # are never reclaimed until the daemon restarts.
+    standalone: bool = False
+    serve_core_resource: bool = True
+    disable_isolation: bool = False
+    coredump_dir: str = "/etc/kubernetes"
+
+
+class TpuShareManager:
+    def __init__(
+        self,
+        backend: DiscoveryBackend,
+        config: ManagerConfig,
+        api_client=None,
+        pod_source=None,
+    ):
+        self._backend = backend
+        self._cfg = config
+        self._api = api_client
+        self._pod_source = pod_source
+        self._plugins: list[TpuSharePlugin] = []
+        self._health: HealthWatcher | None = None
+        self._restart = threading.Event()
+        self._stop = threading.Event()
+        self._park = threading.Event()
+
+    # ------------------------------------------------------------------
+
+    def _build_inventory(self) -> DeviceInventory | None:
+        if not self._backend.probe():
+            return None
+        chips = self._backend.chips()
+        if not chips:
+            return None
+        return DeviceInventory(chips, unit=self._cfg.memory_unit)
+
+    def _build_allocator(self, inventory: DeviceInventory, unhealthy_fn):
+        if self._cfg.standalone or self._api is None:
+            log.warning(
+                "standalone mode: allocations are accounted in-process and "
+                "never reclaimed on pod deletion (dev/bench only)"
+            )
+            local = LocalAllocator(
+                inventory,
+                policy=self._cfg.policy,
+                disable_isolation=self._cfg.disable_isolation,
+            )
+            return lambda granted: local.allocate([len(g) for g in granted])
+        from ..allocator.cluster import ClusterAllocator
+
+        cluster = ClusterAllocator(
+            inventory,
+            self._api,
+            self._pod_source,
+            self._cfg.node_name,
+            policy=self._cfg.policy,
+            disable_isolation=self._cfg.disable_isolation,
+            unhealthy_chips_fn=unhealthy_fn,
+        )
+        return cluster.allocate
+
+    def _build_core_allocate_fn(self, inventory: DeviceInventory):
+        """Whole-chip allocator for the tpu-core resource.
+
+        Unlike tpu-mem, core device IDs *are* the real chip ids, so the
+        granted IDs are honored directly.
+        """
+        topo = self._backend.topology()
+
+        def allocate(granted: Sequence[Sequence[str]]):
+            out = []
+            for ids in granted:
+                chips = [inventory.chip_by_id(cid) for cid in ids]
+                out.append(
+                    build_core_allocation(
+                        chips=chips,
+                        process_bounds=topo.process_bounds,
+                        chips_per_process_bounds=topo.chips_per_process_bounds,
+                    )
+                )
+            return out
+
+        return allocate
+
+    def _build_plugins(self, inventory: DeviceInventory) -> list[TpuSharePlugin]:
+        plugins: list[TpuSharePlugin] = []
+        mem_plugin = TpuSharePlugin(
+            inventory,
+            allocate_fn=None,  # late-bound: the allocator reads this
+            # plugin's live health view for unhealthy-chip exclusion
+            config=PluginConfig(
+                resource_name=const.RESOURCE_MEM,
+                socket_name=const.MEM_SOCKET_NAME,
+                plugin_dir=self._cfg.plugin_dir,
+            ),
+        )
+        mem_plugin.set_allocate_fn(
+            self._build_allocator(
+                inventory, unhealthy_fn=mem_plugin.unhealthy_chip_indices
+            )
+        )
+        plugins.append(mem_plugin)
+        if self._cfg.serve_core_resource:
+            core_plugin = TpuSharePlugin(
+                inventory,
+                allocate_fn=self._build_core_allocate_fn(inventory),
+                config=PluginConfig(
+                    resource_name=const.RESOURCE_CORE,
+                    socket_name=const.CORE_SOCKET_NAME,
+                    plugin_dir=self._cfg.plugin_dir,
+                ),
+                devices_fn=inventory.core_devices,
+            )
+            plugins.append(core_plugin)
+        return plugins
+
+    # ------------------------------------------------------------------
+
+    def _serve_all(self) -> None:
+        inventory = self._build_inventory()
+        assert inventory is not None
+        if self._api is not None and self._cfg.node_name:
+            from ..cluster.node import patch_chip_count
+
+            try:
+                patch_chip_count(self._api, self._cfg.node_name, inventory.chip_count)
+            except Exception as e:
+                log.warning("node capacity patch failed: %s", e)
+        self._plugins = self._build_plugins(inventory)
+        for plugin in self._plugins:
+            plugin.serve()
+        if self._cfg.health_check:
+            self._health = HealthWatcher(
+                self._backend,
+                sinks=[p.set_chip_health for p in self._plugins],
+            )
+            self._health.start()
+
+    def _stop_all(self) -> None:
+        if self._health is not None:
+            self._health.stop()
+            self._health = None
+        for plugin in self._plugins:
+            try:
+                plugin.stop()
+            except Exception as e:
+                log.warning("plugin stop failed: %s", e)
+        self._plugins = []
+
+    # ------------------------------------------------------------------
+
+    def install_signal_handlers(self) -> None:
+        signal.signal(signal.SIGHUP, lambda *_: self.trigger_restart("SIGHUP"))
+        signal.signal(signal.SIGINT, lambda *_: self.trigger_stop("SIGINT"))
+        signal.signal(signal.SIGTERM, lambda *_: self.trigger_stop("SIGTERM"))
+        try:
+            signal.signal(
+                signal.SIGQUIT,
+                lambda *_: log.info("stack dump: %s", coredump(self._cfg.coredump_dir)),
+            )
+        except (OSError, ValueError):
+            pass
+
+    def trigger_restart(self, reason: str = "") -> None:
+        log.info("restart requested (%s)", reason or "socket watcher")
+        self._restart.set()
+        self._park.set()
+
+    def trigger_stop(self, reason: str = "") -> None:
+        log.info("stop requested (%s)", reason)
+        self._stop.set()
+        self._restart.set()
+        self._park.set()
+
+    def run(self) -> None:
+        """Blocking main loop; returns only on stop."""
+        if self._build_inventory() is None:
+            # No TPUs here: park forever instead of crash-looping, so the
+            # DaemonSet stays green on heterogenous fleets
+            # (gpumanager.go:36-47 semantics).
+            log.info("no TPU chips found on this node; parking")
+            self._park.wait()
+            return
+        watcher = SocketWatcher(
+            path=f"{self._cfg.plugin_dir.rstrip('/')}/kubelet.sock"
+        )
+        watcher.start(on_recreate=lambda: self.trigger_restart("kubelet restart"))
+        try:
+            while not self._stop.is_set():
+                self._restart.clear()
+                try:
+                    self._serve_all()
+                except Exception as e:
+                    log.error("serve failed: %s; retrying in 5s", e)
+                    self._stop_all()
+                    if self._stop.wait(5.0):
+                        break
+                    continue
+                self._restart.wait()
+                self._stop_all()
+        finally:
+            watcher.stop()
+            self._stop_all()

@@ -1,0 +1,52 @@
+"""Kubelet-socket watcher.
+
+Kubelet forgets all device plugins on restart, recreating its socket; the
+plugin must detect that and re-register (reference: fsnotify Create event on
+``kubelet.sock`` -> full rebuild, ``gpumanager.go:83-87``). No fsnotify
+binding is available here, so we watch the socket's inode: a new inode (or
+fresh existence) at the same path means kubelet restarted.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Callable
+
+
+class SocketWatcher:
+    def __init__(self, path: str, poll_interval_s: float = 0.5):
+        self._path = path
+        self._interval = poll_interval_s
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def _signature(self) -> tuple[int, int] | None:
+        """(inode, ctime_ns): inode alone is unreliable — filesystems reuse
+        inodes immediately after unlink+create."""
+        try:
+            st = os.stat(self._path)
+            return (st.st_ino, st.st_ctime_ns)
+        except OSError:
+            return None
+
+    def start(self, on_recreate: Callable[[], None]) -> None:
+        """Invoke ``on_recreate`` whenever the socket is recreated (new
+        signature or fresh appearance) — the kubelet-restart signal."""
+        last = self._signature()
+
+        def run():
+            nonlocal last
+            while not self._stop.wait(self._interval):
+                cur = self._signature()
+                if cur is not None and cur != last:
+                    on_recreate()
+                last = cur
+
+        self._thread = threading.Thread(target=run, daemon=True, name="sock-watch")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)

@@ -1,0 +1,3 @@
+from .server import PluginConfig, TpuSharePlugin
+
+__all__ = ["PluginConfig", "TpuSharePlugin"]

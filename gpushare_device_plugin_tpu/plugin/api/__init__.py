@@ -1,0 +1,19 @@
+from . import deviceplugin_pb2 as pb
+from .api_grpc import (
+    DevicePluginServicer,
+    DevicePluginStub,
+    RegistrationServicer,
+    RegistrationStub,
+    add_device_plugin_servicer,
+    add_registration_servicer,
+)
+
+__all__ = [
+    "pb",
+    "DevicePluginServicer",
+    "DevicePluginStub",
+    "RegistrationServicer",
+    "RegistrationStub",
+    "add_device_plugin_servicer",
+    "add_registration_servicer",
+]

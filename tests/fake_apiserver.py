@@ -1,0 +1,211 @@
+"""In-memory fake kube-apiserver (HTTP) for allocator/CLI/extender tests.
+
+Implements just the REST surface the plugin uses: pod LIST with field/label
+selectors, pod GET/PATCH (strategic-merge on metadata), node GET/LIST/status
+PATCH, pod binding, events. Also doubles as a fake kubelet ``/pods``
+endpoint (same JSON shape).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+
+def _match_field_selector(pod: dict, selector: str) -> bool:
+    for clause in selector.split(","):
+        if not clause:
+            continue
+        key, _, value = clause.partition("=")
+        if key == "spec.nodeName":
+            if pod.get("spec", {}).get("nodeName", "") != value:
+                return False
+        elif key == "status.phase":
+            if pod.get("status", {}).get("phase", "") != value:
+                return False
+        elif key == "metadata.name":
+            if pod.get("metadata", {}).get("name", "") != value:
+                return False
+    return True
+
+
+def _match_label_selector(obj: dict, selector: str) -> bool:
+    labels = obj.get("metadata", {}).get("labels") or {}
+    for clause in selector.split(","):
+        if not clause:
+            continue
+        key, _, value = clause.partition("=")
+        if labels.get(key) != value:
+            return False
+    return True
+
+
+class FakeApiServer:
+    def __init__(self):
+        self.pods: dict[tuple[str, str], dict] = {}  # (ns, name) -> pod
+        self.nodes: dict[str, dict] = {}
+        self.events: list[dict] = []
+        self.bindings: list[tuple[str, str, str]] = []  # (ns, pod, node)
+        self.patch_log: list[tuple[str, dict]] = []
+        # fail the next N pod patches with a 409 conflict (retry testing)
+        self.conflicts_to_inject = 0
+        self._server: ThreadingHTTPServer | None = None
+        self._lock = threading.Lock()
+
+    # --- state helpers ----------------------------------------------------
+
+    def add_pod(self, pod: dict) -> None:
+        meta = pod["metadata"]
+        self.pods[(meta.get("namespace", "default"), meta["name"])] = pod
+
+    def add_node(self, name: str, labels: dict | None = None, capacity: dict | None = None, allocatable: dict | None = None) -> None:
+        self.nodes[name] = {
+            "metadata": {"name": name, "labels": labels or {}},
+            "status": {
+                "capacity": capacity or {},
+                "allocatable": allocatable if allocatable is not None else dict(capacity or {}),
+            },
+        }
+
+    @property
+    def port(self) -> int:
+        assert self._server is not None
+        return self._server.server_address[1]
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    # --- lifecycle --------------------------------------------------------
+
+    def start(self) -> None:
+        store = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def _send(self, code: int, body: dict):
+                data = json.dumps(body).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _read_body(self) -> dict:
+                n = int(self.headers.get("Content-Length", "0"))
+                return json.loads(self.rfile.read(n) or b"{}")
+
+            def do_GET(self):
+                u = urlparse(self.path)
+                q = {k: v[0] for k, v in parse_qs(u.query).items()}
+                parts = [p for p in u.path.split("/") if p]
+                with store._lock:
+                    # kubelet-style /pods/
+                    if u.path.rstrip("/") == "/pods":
+                        items = list(store.pods.values())
+                        return self._send(200, {"kind": "PodList", "items": items})
+                    if parts[:2] == ["api", "v1"]:
+                        rest = parts[2:]
+                        if rest == ["pods"]:
+                            items = [
+                                p
+                                for p in store.pods.values()
+                                if _match_field_selector(p, q.get("fieldSelector", ""))
+                                and _match_label_selector(p, q.get("labelSelector", ""))
+                            ]
+                            return self._send(200, {"items": items})
+                        if rest == ["nodes"]:
+                            items = [
+                                n
+                                for n in store.nodes.values()
+                                if _match_label_selector(n, q.get("labelSelector", ""))
+                            ]
+                            return self._send(200, {"items": items})
+                        if len(rest) == 2 and rest[0] == "nodes":
+                            node = store.nodes.get(rest[1])
+                            if node is None:
+                                return self._send(404, {"message": "not found"})
+                            return self._send(200, node)
+                        if len(rest) == 4 and rest[0] == "namespaces" and rest[2] == "pods":
+                            pod = store.pods.get((rest[1], rest[3]))
+                            if pod is None:
+                                return self._send(404, {"message": "not found"})
+                            return self._send(200, pod)
+                return self._send(404, {"message": f"unhandled GET {u.path}"})
+
+            def do_PATCH(self):
+                u = urlparse(self.path)
+                parts = [p for p in u.path.split("/") if p]
+                body = self._read_body()
+                with store._lock:
+                    store.patch_log.append((u.path, body))
+                    rest = parts[2:] if parts[:2] == ["api", "v1"] else []
+                    if len(rest) == 4 and rest[0] == "namespaces" and rest[2] == "pods":
+                        if store.conflicts_to_inject > 0:
+                            store.conflicts_to_inject -= 1
+                            return self._send(
+                                409,
+                                {"message": "Operation cannot be fulfilled: "
+                                 "the object has been modified; please apply your "
+                                 "changes to the latest version and try again"},
+                            )
+                        pod = store.pods.get((rest[1], rest[3]))
+                        if pod is None:
+                            return self._send(404, {"message": "not found"})
+                        meta_patch = body.get("metadata", {})
+                        meta = pod.setdefault("metadata", {})
+                        for key in ("annotations", "labels"):
+                            if key in meta_patch:
+                                merged = dict(meta.get(key) or {})
+                                for k, v in (meta_patch[key] or {}).items():
+                                    if v is None:
+                                        merged.pop(k, None)
+                                    else:
+                                        merged[k] = v
+                                meta[key] = merged
+                        return self._send(200, pod)
+                    if len(rest) == 3 and rest[0] == "nodes" and rest[2] == "status":
+                        node = store.nodes.get(rest[1])
+                        if node is None:
+                            return self._send(404, {"message": "not found"})
+                        st = node.setdefault("status", {})
+                        for key in ("capacity", "allocatable"):
+                            if key in body.get("status", {}):
+                                merged = dict(st.get(key) or {})
+                                merged.update(body["status"][key])
+                                st[key] = merged
+                        return self._send(200, node)
+                return self._send(404, {"message": f"unhandled PATCH {u.path}"})
+
+            def do_POST(self):
+                u = urlparse(self.path)
+                parts = [p for p in u.path.split("/") if p]
+                body = self._read_body()
+                with store._lock:
+                    rest = parts[2:] if parts[:2] == ["api", "v1"] else []
+                    if len(rest) == 5 and rest[2] == "pods" and rest[4] == "binding":
+                        ns, pod_name = rest[1], rest[3]
+                        node = body.get("target", {}).get("name", "")
+                        store.bindings.append((ns, pod_name, node))
+                        pod = store.pods.get((ns, pod_name))
+                        if pod is not None:
+                            pod.setdefault("spec", {})["nodeName"] = node
+                        return self._send(201, {"status": "Success"})
+                    if len(rest) == 3 and rest[2] == "events":
+                        store.events.append(body)
+                        return self._send(201, body)
+                return self._send(404, {"message": f"unhandled POST {u.path}"})
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        t = threading.Thread(target=self._server.serve_forever, daemon=True)
+        t.start()
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None

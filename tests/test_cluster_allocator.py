@@ -1,0 +1,169 @@
+"""ClusterAllocator against the fake apiserver/kubelet (reference: allocate.go flow)."""
+
+import pytest
+
+from gpushare_device_plugin_tpu import const
+from gpushare_device_plugin_tpu.allocator.cluster import (
+    AllocationFailure,
+    ClusterAllocator,
+)
+from gpushare_device_plugin_tpu.cluster.apiserver import ApiServerClient
+from gpushare_device_plugin_tpu.cluster.kubelet import KubeletClient
+from gpushare_device_plugin_tpu.cluster.node import isolation_disabled, patch_chip_count
+from gpushare_device_plugin_tpu.cluster.podsource import (
+    ApiServerPodSource,
+    KubeletPodSource,
+)
+from gpushare_device_plugin_tpu.device import DeviceInventory
+from gpushare_device_plugin_tpu.discovery import MockBackend
+
+from fake_apiserver import FakeApiServer
+from k8s_fixtures import assigned_running_pod, make_pod
+
+NODE = "node-a"
+
+
+@pytest.fixture
+def api():
+    srv = FakeApiServer()
+    srv.add_node(NODE)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def make_allocator(api_srv, policy="first-fit", query_kubelet=False, **kw):
+    client = ApiServerClient(api_srv.url)
+    apisrc = ApiServerPodSource(client, NODE)
+    if query_kubelet:
+        kubelet = KubeletClient(host="127.0.0.1", port=api_srv.port, scheme="http")
+        src = KubeletPodSource(kubelet, apisrc, NODE)
+    else:
+        src = apisrc
+    inv = DeviceInventory(MockBackend(num_chips=4, hbm_bytes=32 << 30).chips())
+    return ClusterAllocator(inv, client, src, NODE, policy=policy, **kw), client
+
+
+def granted(n):
+    """kubelet grants n fake IDs (contents are irrelevant by design)."""
+    return [[f"fake-{i}" for i in range(n)]]
+
+
+def test_binpack_branch_allocates_and_persists(api):
+    api.add_pod(make_pod("trainer", 4, node=NODE))
+    alloc, client = make_allocator(api)
+    res = alloc.allocate(granted(4))
+    assert res[0].envs[const.ENV_TPU_VISIBLE_CHIPS] == "0"
+    assert res[0].envs[const.ENV_MEM_POD] == "4"
+    # decision persisted to the apiserver (the database)
+    pod = client.get_pod("default", "trainer")
+    ann = pod["metadata"]["annotations"]
+    assert ann[const.ENV_MEM_IDX] == "0"
+    assert ann[const.ENV_ASSIGNED_FLAG] == "true"
+    assert const.ENV_ASSUME_TIME in ann
+    assert pod["metadata"]["labels"][const.LABEL_RESOURCE_KEY] == "tpu-mem"
+
+
+def test_usage_accounting_from_running_pods(api):
+    # chip 0 nearly full from running pods; new pod must land on chip 1
+    api.add_pod(assigned_running_pod("busy1", 30, chip_idx=0, node=NODE))
+    api.add_pod(make_pod("new", 4, node=NODE))
+    alloc, _ = make_allocator(api)
+    res = alloc.allocate(granted(4))
+    assert res[0].envs[const.ENV_TPU_VISIBLE_CHIPS] == "1"
+
+
+def test_extender_assumed_branch_wins(api):
+    # scheduler extender assumed chip 2; binpack would have said chip 0
+    api.add_pod(
+        make_pod(
+            "assumed", 4, node=NODE,
+            annotations={
+                const.ENV_ASSUME_TIME: "123",
+                const.ENV_MEM_IDX: "2",
+            },
+        )
+    )
+    alloc, client = make_allocator(api)
+    res = alloc.allocate(granted(4))
+    assert res[0].envs[const.ENV_TPU_VISIBLE_CHIPS] == "2"
+    ann = client.get_pod("default", "assumed")["metadata"]["annotations"]
+    assert ann[const.ENV_ASSIGNED_FLAG] == "true"
+
+
+def test_assumed_with_garbage_idx_fails_admission(api):
+    api.add_pod(
+        make_pod(
+            "bad", 4, node=NODE,
+            annotations={const.ENV_ASSUME_TIME: "123", const.ENV_MEM_IDX: "99"},
+        )
+    )
+    alloc, _ = make_allocator(api)
+    with pytest.raises(AllocationFailure, match="invalid"):
+        alloc.allocate(granted(4))
+
+
+def test_no_matching_pod_fails_admission(api):
+    api.add_pod(make_pod("small", 2, node=NODE))
+    alloc, _ = make_allocator(api)
+    with pytest.raises(AllocationFailure, match="no pending pod"):
+        alloc.allocate(granted(4))  # request size mismatch
+
+
+def test_oldest_pod_matched_first(api):
+    api.add_pod(make_pod("younger", 4, node=NODE, created="2026-01-02T00:00:00Z"))
+    api.add_pod(make_pod("older", 4, node=NODE, created="2026-01-01T00:00:00Z"))
+    alloc, client = make_allocator(api)
+    alloc.allocate(granted(4))
+    older = client.get_pod("default", "older")["metadata"]["annotations"]
+    younger = client.get_pod("default", "younger")["metadata"].get("annotations", {})
+    assert const.ENV_ASSIGNED_FLAG in older
+    assert const.ENV_ASSIGNED_FLAG not in younger
+
+
+def test_patch_conflict_retried_once(api):
+    api.add_pod(make_pod("trainer", 4, node=NODE))
+    api.conflicts_to_inject = 1
+    alloc, client = make_allocator(api)
+    alloc.allocate(granted(4))  # succeeds on the retry
+    ann = client.get_pod("default", "trainer")["metadata"]["annotations"]
+    assert ann[const.ENV_ASSIGNED_FLAG] == "true"
+
+
+def test_patch_conflict_twice_fails(api):
+    api.add_pod(make_pod("trainer", 4, node=NODE))
+    api.conflicts_to_inject = 2
+    alloc, _ = make_allocator(api)
+    with pytest.raises(AllocationFailure, match="twice"):
+        alloc.allocate(granted(4))
+
+
+def test_unhealthy_chips_excluded(api):
+    api.add_pod(make_pod("trainer", 4, node=NODE))
+    alloc, _ = make_allocator(api, unhealthy_chips_fn=lambda: [0, 1])
+    res = alloc.allocate(granted(4))
+    assert res[0].envs[const.ENV_TPU_VISIBLE_CHIPS] == "2"
+
+
+def test_kubelet_pod_source_path(api):
+    # same flow, pods sourced via the kubelet /pods endpoint
+    api.add_pod(make_pod("trainer", 4, node=NODE))
+    api.add_pod(assigned_running_pod("busy", 31, chip_idx=0, node=NODE))
+    alloc, _ = make_allocator(api, query_kubelet=True)
+    res = alloc.allocate(granted(4))
+    assert res[0].envs[const.ENV_TPU_VISIBLE_CHIPS] == "1"
+
+
+def test_isolation_disabled_label(api):
+    assert not isolation_disabled(ApiServerClient(api.url), NODE)
+    api.add_node("node-b", labels={const.LABEL_DISABLE_ISOLATION: "true"})
+    assert isolation_disabled(ApiServerClient(api.url), "node-b")
+
+
+def test_patch_chip_count_skips_noop(api):
+    client = ApiServerClient(api.url)
+    patch_chip_count(client, NODE, 4)
+    assert api.nodes[NODE]["status"]["capacity"][const.RESOURCE_COUNT] == "4"
+    patches_before = len(api.patch_log)
+    patch_chip_count(client, NODE, 4)  # no-op: same value
+    assert len(api.patch_log) == patches_before

@@ -1,0 +1,160 @@
+"""Lifecycle manager e2e: park, serve both resources, kubelet restart, health."""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from gpushare_device_plugin_tpu import const
+from gpushare_device_plugin_tpu.cluster.apiserver import ApiServerClient
+from gpushare_device_plugin_tpu.cluster.podsource import ApiServerPodSource
+from gpushare_device_plugin_tpu.discovery import MockBackend
+from gpushare_device_plugin_tpu.manager import ManagerConfig, TpuShareManager
+
+from fake_apiserver import FakeApiServer
+from fake_kubelet import FakeKubelet
+from k8s_fixtures import make_pod
+
+NODE = "node-a"
+
+
+def run_manager_bg(manager):
+    t = threading.Thread(target=manager.run, daemon=True)
+    t.start()
+    return t
+
+
+def test_parks_without_chips(tmp_path):
+    manager = TpuShareManager(
+        MockBackend(num_chips=0),
+        ManagerConfig(plugin_dir=str(tmp_path), standalone=True),
+    )
+    t = run_manager_bg(manager)
+    time.sleep(0.2)
+    assert t.is_alive()  # parked, not crashed
+    manager.trigger_stop("test")
+    t.join(timeout=2)
+    assert not t.is_alive()
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    api = FakeApiServer()
+    api.add_node(NODE)
+    api.start()
+    kubelet = FakeKubelet(str(tmp_path))
+    kubelet.start()
+    client = ApiServerClient(api.url)
+    manager = TpuShareManager(
+        MockBackend(num_chips=4, hbm_bytes=32 << 30),
+        ManagerConfig(
+            plugin_dir=str(tmp_path),
+            node_name=NODE,
+            health_check=True,
+        ),
+        api_client=client,
+        pod_source=ApiServerPodSource(client, NODE),
+    )
+    t = run_manager_bg(manager)
+    yield api, kubelet, manager, client
+    manager.trigger_stop("test")
+    t.join(timeout=5)
+    kubelet.stop()
+
+
+def test_manager_serves_both_resources_and_allocates(cluster):
+    api, kubelet, manager, client = cluster
+
+    regs = {}
+    for _ in range(2):
+        reg = kubelet.wait_for_registration()
+        regs[reg.resource_name] = reg
+    assert set(regs) == {const.RESOURCE_MEM, const.RESOURCE_CORE}
+
+    # node capacity patched with physical chip count
+    node = client.get_node(NODE)
+    assert node["status"]["capacity"][const.RESOURCE_COUNT] == "4"
+
+    # mem fan-out: 128 fake devices; core: 4 chip devices
+    kubelet.begin_watch(const.RESOURCE_MEM, regs[const.RESOURCE_MEM].endpoint)
+    kubelet.begin_watch(const.RESOURCE_CORE, regs[const.RESOURCE_CORE].endpoint)
+    mem_devs = kubelet.wait_for_devices(const.RESOURCE_MEM)
+    core_devs = kubelet.wait_for_devices(const.RESOURCE_CORE)
+    assert len(mem_devs) == 128
+    assert len(core_devs) == 4
+
+    # a pending pod gets allocated through the real cluster flow
+    api.add_pod(make_pod("trainer", 4, node=NODE))
+    resp = kubelet.allocate(
+        regs[const.RESOURCE_MEM].endpoint, [[d.ID for d in mem_devs[:4]]]
+    )
+    envs = resp.container_responses[0].envs
+    assert envs[const.ENV_TPU_VISIBLE_CHIPS] == "0"
+    ann = client.get_pod("default", "trainer")["metadata"]["annotations"]
+    assert ann[const.ENV_ASSIGNED_FLAG] == "true"
+
+    # whole-chip allocation honors granted chip IDs
+    resp = kubelet.allocate(
+        regs[const.RESOURCE_CORE].endpoint, [[core_devs[2].ID]]
+    )
+    envs = resp.container_responses[0].envs
+    assert envs[const.ENV_TPU_VISIBLE_CHIPS] == "2"
+
+
+def test_kubelet_restart_triggers_reregistration(cluster, tmp_path):
+    api, kubelet, manager, client = cluster
+    for _ in range(2):
+        kubelet.wait_for_registration()
+
+    # simulate kubelet restart: recreate its socket (new inode)
+    kubelet.stop()
+    kubelet2 = FakeKubelet(kubelet.plugin_dir)
+    kubelet2.start()
+    try:
+        regs = set()
+        for _ in range(2):
+            regs.add(kubelet2.wait_for_registration(timeout=10).resource_name)
+        assert regs == {const.RESOURCE_MEM, const.RESOURCE_CORE}
+    finally:
+        kubelet2.stop()
+
+
+def test_health_file_drives_listandwatch(tmp_path):
+    health_file = str(tmp_path / "health.json")
+    kubelet = FakeKubelet(str(tmp_path / "plugins"))
+    kubelet.start()
+    backend = MockBackend(
+        num_chips=2, hbm_bytes=4 << 30, health_file=health_file, poll_interval_s=0.02
+    )
+    manager = TpuShareManager(
+        backend,
+        ManagerConfig(
+            plugin_dir=str(tmp_path / "plugins"),
+            standalone=True,
+            health_check=True,
+            serve_core_resource=False,
+        ),
+    )
+    t = run_manager_bg(manager)
+    try:
+        reg = kubelet.wait_for_registration()
+        kubelet.begin_watch(reg.resource_name, reg.endpoint)
+        devs = kubelet.wait_for_devices(const.RESOURCE_MEM)
+        assert all(d.health == "Healthy" for d in devs)
+
+        chip0 = backend.chips()[0].id
+        with open(health_file, "w") as f:
+            json.dump({chip0: "Unhealthy"}, f)
+        devs = kubelet.wait_for_devices(const.RESOURCE_MEM, timeout=10)
+        assert sum(d.health == "Unhealthy" for d in devs) == 4
+
+        with open(health_file, "w") as f:
+            json.dump({}, f)
+        devs = kubelet.wait_for_devices(const.RESOURCE_MEM, timeout=10)
+        assert all(d.health == "Healthy" for d in devs)
+    finally:
+        manager.trigger_stop("test")
+        t.join(timeout=5)
+        kubelet.stop()

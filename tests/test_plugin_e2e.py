@@ -1,0 +1,160 @@
+"""E2E: Register -> ListAndWatch -> Allocate over real gRPC unix sockets.
+
+Full cycle with the in-process fake kubelet, mock discovery, and the
+standalone allocator — the BASELINE config-1 scenario without a cluster.
+"""
+
+import random
+
+import pytest
+
+from gpushare_device_plugin_tpu import const
+from gpushare_device_plugin_tpu.allocator.local import LocalAllocator
+from gpushare_device_plugin_tpu.device import DeviceInventory
+from gpushare_device_plugin_tpu.discovery import MockBackend
+from gpushare_device_plugin_tpu.discovery.base import ChipHealth
+from gpushare_device_plugin_tpu.plugin import PluginConfig, TpuSharePlugin
+
+from fake_kubelet import FakeKubelet
+
+
+@pytest.fixture
+def stack(tmp_path):
+    """fake kubelet + mem plugin on a 4x32GiB mock host."""
+    plugin_dir = str(tmp_path)
+    kubelet = FakeKubelet(plugin_dir)
+    kubelet.start()
+
+    inv = DeviceInventory(MockBackend(num_chips=4, hbm_bytes=32 << 30).chips())
+    allocator = LocalAllocator(inv)
+    plugin = TpuSharePlugin(
+        inv,
+        allocate_fn=lambda granted: allocator.allocate([len(g) for g in granted]),
+        config=PluginConfig(plugin_dir=plugin_dir),
+    )
+    plugin.serve()
+    yield kubelet, plugin, inv, allocator
+    plugin.stop()
+    kubelet.stop()
+
+
+def grant_ids(devs, n, exclude=()):
+    """Pick n healthy fake-device IDs arbitrarily, like kubelet would."""
+    pool = [d.ID for d in devs if d.health == "Healthy" and d.ID not in exclude]
+    return random.sample(pool, n)
+
+
+def test_register_listandwatch_allocate(stack):
+    kubelet, plugin, inv, allocator = stack
+
+    # 1. plugin registered itself
+    reg = kubelet.wait_for_registration()
+    assert reg.resource_name == const.RESOURCE_MEM
+    assert reg.version == "v1beta1"
+    assert reg.endpoint == const.MEM_SOCKET_NAME
+
+    # 2. kubelet consumes ListAndWatch: 4 chips x 32 GiB = 128 fake devices
+    kubelet.begin_watch(reg.resource_name, reg.endpoint)
+    devs = kubelet.wait_for_devices(const.RESOURCE_MEM)
+    assert len(devs) == 128
+    assert all(d.health == "Healthy" for d in devs)
+
+    # 3. pod requesting 2 GiB: kubelet grants 2 arbitrary fake IDs
+    resp = kubelet.allocate(reg.endpoint, [grant_ids(devs, 2)])
+    assert len(resp.container_responses) == 1
+    envs = resp.container_responses[0].envs
+    assert envs[const.ENV_TPU_VISIBLE_CHIPS] == "0"  # first-fit -> chip 0
+    assert envs[const.ENV_MEM_POD] == "2"
+    assert envs[const.ENV_MEM_DEV] == "32"
+    assert envs[const.ENV_TPU_PROCESS_BOUNDS] == "1,1,1"
+    assert float(envs[const.ENV_XLA_PYTHON_MEM_FRACTION]) == pytest.approx(2 / 32)
+    # the chip's device file is passed through explicitly
+    assert resp.container_responses[0].devices[0].host_path == "/dev/accel0"
+
+
+def test_allocation_counts_ids_not_contents(stack):
+    kubelet, plugin, inv, allocator = stack
+    reg = kubelet.wait_for_registration()
+    kubelet.begin_watch(reg.resource_name, reg.endpoint)
+    devs = kubelet.wait_for_devices(const.RESOURCE_MEM)
+
+    # grant IDs that all belong to chip 3's fan-out; the allocator must
+    # still count-and-binpack (chip 0), not follow the granted IDs
+    chip3_ids = [d.ID for d in devs if "chip3" in d.ID][:4]
+    resp = kubelet.allocate(reg.endpoint, [chip3_ids])
+    assert resp.container_responses[0].envs[const.ENV_TPU_VISIBLE_CHIPS] == "0"
+
+
+def test_binpack_two_pods_one_chip_then_spill(stack):
+    kubelet, plugin, inv, allocator = stack
+    reg = kubelet.wait_for_registration()
+    kubelet.begin_watch(reg.resource_name, reg.endpoint)
+    devs = kubelet.wait_for_devices(const.RESOURCE_MEM)
+
+    # ResNet-50 (16) + BERT (16) co-scheduled on chip 0 (BASELINE config 3)
+    r1 = kubelet.allocate(reg.endpoint, [grant_ids(devs, 16)])
+    r2 = kubelet.allocate(reg.endpoint, [grant_ids(devs, 16)])
+    assert r1.container_responses[0].envs[const.ENV_TPU_VISIBLE_CHIPS] == "0"
+    assert r2.container_responses[0].envs[const.ENV_TPU_VISIBLE_CHIPS] == "0"
+    # a 20-unit pod no longer fits chip 0 -> spills to chip 1
+    r3 = kubelet.allocate(reg.endpoint, [grant_ids(devs, 20)])
+    assert r3.container_responses[0].envs[const.ENV_TPU_VISIBLE_CHIPS] == "1"
+    assert allocator.used_by_chip() == {0: 32, 1: 20}
+
+
+def test_multi_container_pod(stack):
+    kubelet, plugin, inv, allocator = stack
+    reg = kubelet.wait_for_registration()
+    kubelet.begin_watch(reg.resource_name, reg.endpoint)
+    devs = kubelet.wait_for_devices(const.RESOURCE_MEM)
+
+    resp = kubelet.allocate(reg.endpoint, [grant_ids(devs, 3), grant_ids(devs, 5)])
+    assert len(resp.container_responses) == 2
+    for cresp, expected in zip(resp.container_responses, ("3", "5")):
+        assert cresp.envs[const.ENV_MEM_CONTAINER] == expected
+        assert cresp.envs[const.ENV_MEM_POD] == "8"
+        # both containers pinned to the same chip
+        assert cresp.envs[const.ENV_TPU_VISIBLE_CHIPS] == "0"
+
+
+def test_allocate_overcommit_fails_admission(stack):
+    import grpc
+
+    kubelet, plugin, inv, allocator = stack
+    reg = kubelet.wait_for_registration()
+    kubelet.begin_watch(reg.resource_name, reg.endpoint)
+    devs = kubelet.wait_for_devices(const.RESOURCE_MEM)
+
+    # 33 > any single chip's 32: gRPC error -> UnexpectedAdmissionError
+    with pytest.raises(grpc.RpcError) as ei:
+        kubelet.allocate(reg.endpoint, [grant_ids(devs, 33)])
+    assert ei.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+
+def test_health_transition_and_recovery_streamed(stack):
+    kubelet, plugin, inv, allocator = stack
+    reg = kubelet.wait_for_registration()
+    kubelet.begin_watch(reg.resource_name, reg.endpoint)
+    kubelet.wait_for_devices(const.RESOURCE_MEM)
+
+    chip0 = inv.chips()[0]
+    plugin.set_chip_health(chip0.id, ChipHealth.UNHEALTHY)
+    devs = kubelet.wait_for_devices(const.RESOURCE_MEM)
+    sick = [d for d in devs if d.health == "Unhealthy"]
+    assert len(sick) == 32
+    assert all(d.ID.startswith(chip0.id) for d in sick)
+
+    # recovery (the reference's FIXME server.go:184: no way back) works here
+    plugin.set_chip_health(chip0.id, ChipHealth.HEALTHY)
+    devs = kubelet.wait_for_devices(const.RESOURCE_MEM)
+    assert all(d.health == "Healthy" for d in devs)
+
+
+def test_plugin_restart_reregisters(stack, tmp_path):
+    kubelet, plugin, inv, allocator = stack
+    kubelet.wait_for_registration()
+    plugin.stop()
+    # kubelet restart scenario: plugin re-serves and re-registers
+    plugin.serve()
+    reg = kubelet.wait_for_registration()
+    assert reg.resource_name == const.RESOURCE_MEM
