@@ -1,0 +1,70 @@
+"""Table rendering for the inspect CLI (reference: ``cmd/inspect/display.go``).
+
+Summary: per-node per-chip ``used/total`` plus the cluster utilization
+total — the north-star metric line (``display.go:231-241``). Details adds
+per-pod rows with chip attribution.
+"""
+
+from __future__ import annotations
+
+from io import StringIO
+
+from .nodeinfo import PENDING_IDX, NodeInfo, infer_unit
+
+
+def _table(rows: list[list[str]]) -> str:
+    if not rows:
+        return ""
+    widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
+    out = []
+    for r in rows:
+        out.append("  ".join(cell.ljust(w) for cell, w in zip(r, widths)).rstrip())
+    return "\n".join(out)
+
+
+def render_summary(infos: list[NodeInfo]) -> str:
+    unit = infer_unit(infos)
+    buf = StringIO()
+    rows = [["NAME", "IPADDRESS", f"TPU Memory ({unit})"]]
+    for info in infos:
+        chips = ", ".join(
+            f"chip{d.index}: {d.used_units}/{d.total_units}"
+            for d in sorted(info.devices.values(), key=lambda d: d.index)
+        )
+        rows.append([info.name, info.address, chips])
+    buf.write(_table(rows))
+    buf.write("\n")
+    total = sum(i.total_units for i in infos)
+    used = sum(i.used_units for i in infos)
+    pct = (100.0 * used / total) if total else 0.0
+    buf.write("-" * 40 + "\n")
+    buf.write(
+        f"Allocated/Total TPU Memory ({unit}) In Cluster:\n{used}/{total} ({pct:.0f}%)\n"
+    )
+    pending = sum(i.pending_units for i in infos)
+    if pending:
+        buf.write(f"Pending (unattributed) TPU Memory ({unit}): {pending}\n")
+    return buf.getvalue()
+
+
+def render_details(infos: list[NodeInfo]) -> str:
+    unit = infer_unit(infos)
+    buf = StringIO()
+    for info in infos:
+        buf.write(f"NAME: {info.name} ({info.address})\n")
+        rows = [["NAMESPACE", "NAME", f"TPU MEMORY ({unit})", "CHIPS"]]
+        for pod in sorted(info.pods, key=lambda p: (p.namespace, p.name)):
+            chips = ", ".join(
+                ("pending" if idx == PENDING_IDX else f"chip{idx}") + f":{units}"
+                for idx, units in sorted(pod.units_by_chip.items())
+            )
+            rows.append([pod.namespace, pod.name, str(pod.total_units), chips])
+        buf.write(_table(rows))
+        buf.write("\n")
+        buf.write(
+            f"Allocated : {info.used_units} ({(100.0 * info.used_units / info.total_units) if info.total_units else 0:.0f}%)\n"
+        )
+        buf.write(f"Total     : {info.total_units}\n")
+        buf.write("\n")
+    buf.write(render_summary(infos))
+    return buf.getvalue()

@@ -1,0 +1,64 @@
+"""``kubectl-inspect-tpushare``: cluster TPU-share utilization report.
+
+Reference: ``cmd/inspect/main.go:31-74`` — optional node-name argument
+narrows the report; ``-d`` shows per-pod details. Reads only the apiserver
+(kubeconfig from ``$KUBECONFIG``/``~/.kube/config``, else in-cluster), with
+the reference CLI's 5 x 100 ms list retry budget (``podinfo.go:24,64-69``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from ..cluster.apiserver import ApiServerClient
+from ..utils.retry import retry
+from .display import render_details, render_summary
+from .nodeinfo import build_all_node_infos
+
+LIST_RETRIES = 5
+LIST_DELAY_S = 0.1
+
+
+def _client(timeout_s: float = 10.0) -> ApiServerClient:
+    return ApiServerClient.from_env(timeout_s=timeout_s)
+
+
+def gather(client: ApiServerClient, node_name: str = "") -> tuple[list, list]:
+    nodes = retry(client.list_nodes, attempts=LIST_RETRIES, delay_s=LIST_DELAY_S)
+    if node_name:
+        nodes = [n for n in nodes if n.get("metadata", {}).get("name") == node_name]
+        if not nodes:
+            raise SystemExit(f"error: node {node_name!r} not found")
+    pods = retry(client.list_pods, attempts=LIST_RETRIES, delay_s=LIST_DELAY_S)
+    return nodes, pods
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="kubectl-inspect-tpushare",
+        description="Display TPU-share HBM utilization across the cluster",
+    )
+    p.add_argument("node", nargs="?", default="", help="restrict to one node")
+    p.add_argument("-d", "--details", action="store_true", help="per-pod rows")
+    args = p.parse_args(argv)
+
+    try:
+        client = _client()
+        nodes, pods = gather(client, args.node)
+    except SystemExit:
+        raise
+    except Exception as e:  # config errors or exhausted list retries
+        print(f"error: cannot reach the cluster: {e}", file=sys.stderr)
+        return 1
+    infos = build_all_node_infos(nodes, pods)
+    if not infos:
+        print("no shared-TPU nodes found (allocatable aliyun.com/tpu-mem is 0 everywhere)")
+        return 0
+    out = render_details(infos) if args.details else render_summary(infos)
+    sys.stdout.write(out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

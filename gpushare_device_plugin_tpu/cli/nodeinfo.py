@@ -1,0 +1,163 @@
+"""Cluster TPU-share utilization model for the inspect CLI.
+
+Reference: ``cmd/inspect/nodeinfo.go`` + ``podinfo.go`` — shared nodes are
+those advertising allocatable ``tpu-mem`` > 0; per-chip usage is attributed
+from the scheduler-extender's per-container allocation annotation when
+present (``GetAllocation``, ``nodeinfo.go:244-271``), else from the
+``..._IDX`` annotation with the pod's summed limits; pods whose chip can't
+be determined land in a "pending" bucket (devIdx -1, ``nodeinfo.go:136-139``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+from .. import const
+from ..cluster import pods as P
+from ..cluster.noderes import chip_capacity_vector
+
+PENDING_IDX = -1
+
+
+@dataclasses.dataclass
+class PodUsage:
+    namespace: str
+    name: str
+    units_by_chip: dict[int, int]  # PENDING_IDX for unattributed
+
+    @property
+    def total_units(self) -> int:
+        return sum(self.units_by_chip.values())
+
+
+@dataclasses.dataclass
+class DeviceInfo:
+    index: int
+    total_units: int
+    used_units: int = 0
+
+
+@dataclasses.dataclass
+class NodeInfo:
+    name: str
+    address: str
+    devices: dict[int, DeviceInfo]
+    pods: list[PodUsage]
+    pending_units: int = 0
+
+    @property
+    def total_units(self) -> int:
+        return sum(d.total_units for d in self.devices.values())
+
+    @property
+    def used_units(self) -> int:
+        return sum(d.used_units for d in self.devices.values())
+
+
+def is_shared_tpu_node(node: dict) -> bool:
+    """Allocatable ``aliyun.com/tpu-mem`` > 0 (``podinfo.go:213-221``)."""
+    try:
+        alloc = node.get("status", {}).get("allocatable", {})
+        return int(str(alloc.get(const.RESOURCE_MEM, "0"))) > 0
+    except ValueError:
+        return False
+
+
+def node_address(node: dict) -> str:
+    for addr in node.get("status", {}).get("addresses", []) or []:
+        if addr.get("type") == "InternalIP":
+            return addr.get("address", "")
+    return ""
+
+
+def pod_allocation(pod: dict) -> dict[int, int]:
+    """Per-chip units for one pod.
+
+    Priority 1: extender annotation (JSON ``{container: {chipIdx: units}}``,
+    ``nodeinfo.go:244-271``). Priority 2: IDX annotation x summed limits.
+    Fallback: everything pending.
+    """
+    ann = P.annotations(pod)
+    raw = ann.get(const.ANN_EXTENDER_ALLOCATION)
+    if raw:
+        try:
+            per_container = json.loads(raw)
+            out: dict[int, int] = {}
+            for chip_map in per_container.values():
+                for idx_str, units in chip_map.items():
+                    idx = int(idx_str)
+                    out[idx] = out.get(idx, 0) + int(units)
+            if out:
+                return out
+        except (ValueError, AttributeError, TypeError):
+            pass  # garbled annotation: fall through to IDX
+    total = P.mem_units_of_pod(pod)
+    if total <= 0:
+        return {}
+    idx = P.chip_idx_from_annotation(pod)
+    if idx < 0 or not P.is_assigned(pod):
+        return {PENDING_IDX: total}
+    return {idx: total}
+
+
+def build_node_info(node: dict, pods: list[dict]) -> NodeInfo:
+    """Pods must already be filtered to this node's active share pods."""
+    capacity = chip_capacity_vector(node, const.RESOURCE_MEM, const.RESOURCE_COUNT)
+    info = NodeInfo(
+        name=node.get("metadata", {}).get("name", ""),
+        address=node_address(node),
+        devices={
+            i: DeviceInfo(index=i, total_units=per) for i, per in capacity.items()
+        },
+        pods=[],
+    )
+    for pod in pods:
+        usage = pod_allocation(pod)
+        if not usage:
+            continue
+        info.pods.append(
+            PodUsage(namespace=P.namespace(pod), name=P.name(pod), units_by_chip=usage)
+        )
+        for idx, units in usage.items():
+            if idx == PENDING_IDX:
+                info.pending_units += units
+            elif idx in info.devices:
+                info.devices[idx].used_units += units
+            else:
+                # annotation points at a chip the node doesn't advertise
+                info.devices[idx] = DeviceInfo(
+                    index=idx, total_units=0, used_units=units
+                )
+    return info
+
+
+def build_all_node_infos(nodes: list[dict], pods: list[dict]) -> list[NodeInfo]:
+    """Shared nodes only; active (not Succeeded/Failed) share pods grouped
+    by node (``buildAllNodeInfos``, ``nodeinfo.go:46-93``)."""
+    infos = []
+    for node in nodes:
+        if not is_shared_tpu_node(node):
+            continue
+        name = node.get("metadata", {}).get("name", "")
+        node_pods = [
+            p
+            for p in pods
+            if P.node_name(p) == name
+            and P.phase(p) not in ("Succeeded", "Failed")
+            and P.mem_units_of_pod(p) > 0
+        ]
+        infos.append(build_node_info(node, node_pods))
+    return infos
+
+
+def infer_unit(infos: list[NodeInfo]) -> str:
+    """Heuristic from the reference (``setUnit``, ``nodeinfo.go:227-243``):
+    per-chip capacity > 100 reads as MiB, else GiB."""
+    for info in infos:
+        for dev in info.devices.values():
+            if dev.total_units > 100:
+                return "MiB"
+            if dev.total_units > 0:
+                return "GiB"
+    return "GiB"

@@ -54,10 +54,18 @@ class ApiServerClient:
 
     @classmethod
     def from_env(cls, timeout_s: float = 10.0) -> "ApiServerClient":
-        """$KUBECONFIG file if set (reference honors it first), else in-cluster."""
+        """$KUBECONFIG if set, else ~/.kube/config if present, else in-cluster.
+
+        One resolution order for every binary (daemon, extender, CLIs) —
+        the reference's CLIs had their own slightly different kubeInit
+        (``cmd/inspect/podinfo.go:27-46``), a divergence not worth keeping.
+        """
         kubeconfig = os.environ.get("KUBECONFIG", "")
         if kubeconfig and os.path.exists(kubeconfig):
             return cls.from_kubeconfig(kubeconfig, timeout_s=timeout_s)
+        default = os.path.expanduser("~/.kube/config")
+        if os.path.exists(default):
+            return cls.from_kubeconfig(default, timeout_s=timeout_s)
         return cls.in_cluster(timeout_s=timeout_s)
 
     @classmethod

@@ -1,0 +1,205 @@
+"""Scheduler-extender placement logic (pure functions).
+
+The reference device plugin relies on a *separate* gpushare-scheduler-extender
+repo for cluster-level binpack placement (``README.md:14``; the plugin reads
+its ``..._IDX`` annotation in branch A of Allocate, ``allocate.go:75-84``).
+This module is our in-repo equivalent: node filtering, binpack scoring, and
+the bind-time chip decision — generalized over resource names so one
+extender serves TPU (``aliyun.com/tpu-mem``) and GPU (``aliyun.com/gpu-mem``)
+nodes in a mixed fleet (BASELINE config 5).
+
+A pod counts against a node's chips when it is active (phase not
+Succeeded/Failed) and carries the IDX annotation — i.e. running workloads
+AND extender-assumed pods whose kubelet admission is still in flight.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+
+from .. import const
+from ..allocator.binpack import AssignmentError, assign_chip
+from ..cluster import pods as P
+from ..cluster.noderes import chip_capacity_vector
+
+# resource name -> annotation/label vocabulary
+RESOURCE_FAMILIES = {
+    const.RESOURCE_MEM: {
+        "count": const.RESOURCE_COUNT,
+        "idx": const.ENV_MEM_IDX,
+        "pod": const.ENV_MEM_POD,
+        "dev": const.ENV_MEM_DEV,
+        "assigned": const.ENV_ASSIGNED_FLAG,
+        "assume": const.ENV_ASSUME_TIME,
+    },
+    const.RESOURCE_GPU_MEM: {
+        "count": const.RESOURCE_GPU_COUNT,
+        "idx": "ALIYUN_COM_GPU_MEM_IDX",
+        "pod": "ALIYUN_COM_GPU_MEM_POD",
+        "dev": "ALIYUN_COM_GPU_MEM_DEV",
+        "assigned": "ALIYUN_COM_GPU_MEM_ASSIGNED",
+        "assume": "ALIYUN_COM_GPU_MEM_ASSUME_TIME",
+    },
+}
+
+
+def pod_resource(pod: dict) -> str | None:
+    """Which share resource this pod requests (tpu-mem preferred)."""
+    for resource in RESOURCE_FAMILIES:
+        if P.mem_units_of_pod(pod, resource=resource) > 0:
+            return resource
+    return None
+
+
+@dataclasses.dataclass
+class NodeView:
+    name: str
+    resource: str
+    capacity: dict[int, int]  # chip index -> units
+    used: dict[int, int]
+
+    def free(self) -> dict[int, int]:
+        return {
+            i: self.capacity[i] - self.used.get(i, 0) for i in self.capacity
+        }
+
+
+def node_capacity(node: dict, resource: str) -> dict[int, int]:
+    """Per-chip capacity from node status (shared helper with the inspect CLI)."""
+    return chip_capacity_vector(node, resource, RESOURCE_FAMILIES[resource]["count"])
+
+
+def group_pods_by_node(pods: list[dict]) -> dict[str, list[dict]]:
+    """Group once per request so per-node accounting doesn't rescan the
+    whole cluster pod list for every node."""
+    by_node: dict[str, list[dict]] = {}
+    for pod in pods:
+        by_node.setdefault(P.node_name(pod), []).append(pod)
+    return by_node
+
+
+def node_usage(node_pods: list[dict], resource: str) -> dict[int, int]:
+    """Units held per chip by active annotated pods (pods pre-filtered to
+    one node via ``group_pods_by_node``)."""
+    family = RESOURCE_FAMILIES[resource]
+    used: dict[int, int] = {}
+    for pod in node_pods:
+        if P.phase(pod) in ("Succeeded", "Failed"):
+            continue
+        idx_raw = P.annotations(pod).get(family["idx"])
+        if idx_raw is None:
+            continue
+        try:
+            idx = int(idx_raw)
+        except ValueError:
+            continue
+        if idx < 0:
+            continue
+        used[idx] = used.get(idx, 0) + P.mem_units_of_pod(pod, resource=resource)
+    return used
+
+
+def build_node_view(
+    node: dict, pods_by_node: dict[str, list[dict]], resource: str
+) -> NodeView:
+    name = node.get("metadata", {}).get("name", "")
+    return NodeView(
+        name=name,
+        resource=resource,
+        capacity=node_capacity(node, resource),
+        used=node_usage(pods_by_node.get(name, []), resource),
+    )
+
+
+def node_fits(view: NodeView, request_units: int) -> bool:
+    """A single chip must hold the whole request (no cross-chip spreading
+    for fractional pods — same constraint the device plugin enforces)."""
+    return any(f >= request_units for f in view.free().values())
+
+
+def filter_nodes(
+    pod: dict, nodes: list[dict], pods: list[dict]
+) -> tuple[list[str], dict[str, str]]:
+    """-> (fitting node names, failed node -> reason)."""
+    resource = pod_resource(pod)
+    if resource is None:
+        # not a share pod: everything passes (we shouldn't be called, but
+        # the scheduler may still route the pod through the extender)
+        return [n.get("metadata", {}).get("name", "") for n in nodes], {}
+    request = P.mem_units_of_pod(pod, resource=resource)
+    by_node = group_pods_by_node(pods)
+    fits, failed = [], {}
+    for node in nodes:
+        view = build_node_view(node, by_node, resource)
+        name = view.name
+        if not view.capacity:
+            failed[name] = f"node does not advertise {resource}"
+        elif not node_fits(view, request):
+            failed[name] = (
+                f"no single chip with {request} free units of {resource} "
+                f"(free: {view.free()})"
+            )
+        else:
+            fits.append(name)
+    return fits, failed
+
+
+def score_node(view: NodeView, request_units: int) -> int:
+    """Binpack score 0-10: prefer the node whose tightest feasible chip
+    leaves the least slack (consolidates fragments, keeps big chips whole)."""
+    feasible = [f for f in view.free().values() if f >= request_units]
+    if not feasible:
+        return 0
+    best = min(feasible)
+    cap = max(view.capacity.values(), default=0)
+    if cap <= 0:
+        return 0
+    return round(10 * (1 - (best - request_units) / cap))
+
+
+def prioritize_nodes(
+    pod: dict, nodes: list[dict], pods: list[dict]
+) -> dict[str, int]:
+    resource = pod_resource(pod)
+    if resource is None:
+        return {n.get("metadata", {}).get("name", ""): 0 for n in nodes}
+    request = P.mem_units_of_pod(pod, resource=resource)
+    by_node = group_pods_by_node(pods)
+    return {
+        (v := build_node_view(n, by_node, resource)).name: score_node(v, request)
+        for n in nodes
+    }
+
+
+def choose_chip(
+    pod: dict, node: dict, pods: list[dict], policy: str = "best-fit"
+) -> tuple[str, int, dict[str, str]]:
+    """Bind-time decision: -> (resource, chip index, annotations to write).
+
+    Raises ``AssignmentError`` when nothing fits anymore (the scheduler
+    will retry the pod).
+    """
+    resource = pod_resource(pod)
+    if resource is None:
+        raise AssignmentError("pod requests no share resource")
+    family = RESOURCE_FAMILIES[resource]
+    request = P.mem_units_of_pod(pod, resource=resource)
+    view = build_node_view(node, group_pods_by_node(pods), resource)
+    idx = assign_chip(request, view.capacity, view.used, policy=policy)
+    containers = pod.get("spec", {}).get("containers", [])
+    alloc_map = {
+        c.get("name", f"c{i}"): {str(idx): P.mem_units_of_container(c, resource)}
+        for i, c in enumerate(containers)
+        if P.mem_units_of_container(c, resource) > 0
+    }
+    annotations = {
+        family["idx"]: str(idx),
+        family["pod"]: str(request),
+        family["dev"]: str(view.capacity.get(idx, 0)),
+        family["assigned"]: "false",  # plugin flips to true at admission
+        family["assume"]: str(time.time_ns()),
+        const.ANN_EXTENDER_ALLOCATION: json.dumps(alloc_map),
+    }
+    return resource, idx, annotations

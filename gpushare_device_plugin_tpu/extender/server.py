@@ -1,0 +1,217 @@
+"""HTTP scheduler-extender server.
+
+Speaks the kube-scheduler extender webhook protocol (the v1 JSON API the
+scheduler's ``extenders`` policy config points at):
+
+- ``POST /scheduler/filter``      ExtenderArgs -> ExtenderFilterResult
+- ``POST /scheduler/prioritize``  ExtenderArgs -> HostPriorityList
+- ``POST /scheduler/bind``        ExtenderBindingArgs -> ExtenderBindingResult
+
+Bind both persists the chip decision (IDX/assume-time/per-container
+allocation annotations — exactly what Allocate's branch A and the inspect
+CLI read) and creates the v1 Binding. Serialized by a single lock so two
+same-size pods cannot race a chip (the in-flight one is visible to the next
+decision via its annotations-in-apiserver plus a short local cache).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..allocator.binpack import AssignmentError
+from ..cluster.apiserver import ApiError, ApiServerClient
+from ..utils.log import get_logger
+from ..utils import log as logutil
+from . import logic
+
+log = get_logger("extender")
+
+
+class ExtenderCore:
+    def __init__(self, api: ApiServerClient, policy: str = "best-fit"):
+        self._api = api
+        self._policy = policy
+        # RLock: bind() holds it across its whole decision and calls
+        # _active_pods(), which also touches the in-flight cache
+        self._lock = threading.RLock()
+        # (ns, name) -> (node, annotations, stamp): decisions made here that
+        # the apiserver may not reflect yet when the next filter runs
+        self._inflight: dict[tuple[str, str], tuple[str, dict, float]] = {}
+        self._inflight_ttl_s = 60.0
+
+    # --- helpers ----------------------------------------------------------
+
+    def _active_pods(self) -> list[dict]:
+        pods = self._api.list_pods()
+        out = []
+        for pod in pods:
+            if pod.get("status", {}).get("phase") in ("Succeeded", "Failed"):
+                continue
+            out.append(pod)
+        # overlay in-flight decisions not yet visible in the list
+        now = time.monotonic()
+        with self._lock:
+            self._inflight = {
+                k: v for k, v in self._inflight.items()
+                if now - v[2] < self._inflight_ttl_s
+            }
+            inflight = dict(self._inflight)
+        by_key = {(p.get("metadata", {}).get("namespace", "default"),
+                   p.get("metadata", {}).get("name", "")): p for p in out}
+        for (ns, name), (node, ann, _) in inflight.items():
+            pod = by_key.get((ns, name))
+            if pod is not None:
+                meta = pod.setdefault("metadata", {})
+                merged = dict(meta.get("annotations") or {})
+                merged.update(ann)
+                meta["annotations"] = merged
+                pod.setdefault("spec", {}).setdefault("nodeName", node)
+        return out
+
+    def _nodes_from_args(self, args: dict) -> list[dict]:
+        if args.get("nodes") and args["nodes"].get("items"):
+            return args["nodes"]["items"]
+        names = args.get("nodenames") or args.get("nodeNames") or []
+        nodes = []
+        for name in names:
+            try:
+                nodes.append(self._api.get_node(name))
+            except ApiError:
+                continue
+        return nodes
+
+    # --- webhook verbs ----------------------------------------------------
+
+    def filter(self, args: dict) -> dict:
+        pod = args.get("pod") or {}
+        nodes = self._nodes_from_args(args)
+        fits, failed = logic.filter_nodes(pod, nodes, self._active_pods())
+        log.v(4, "filter %s: fits=%s failed=%s",
+              pod.get("metadata", {}).get("name"), fits, list(failed))
+        return {
+            "nodes": {"items": [n for n in nodes
+                                if n.get("metadata", {}).get("name") in fits]},
+            "nodenames": fits,
+            "failedNodes": failed,
+            "error": "",
+        }
+
+    def prioritize(self, args: dict) -> list[dict]:
+        pod = args.get("pod") or {}
+        nodes = self._nodes_from_args(args)
+        scores = logic.prioritize_nodes(pod, nodes, self._active_pods())
+        return [{"host": host, "score": score} for host, score in scores.items()]
+
+    def bind(self, args: dict) -> dict:
+        ns = args.get("podNamespace", "default")
+        name = args.get("podName", "")
+        node_name = args.get("node", "")
+        with self._lock:
+            try:
+                pod = self._api.get_pod(ns, name)
+                node = self._api.get_node(node_name)
+                _, idx, annotations = logic.choose_chip(
+                    pod, node, self._active_pods(), policy=self._policy
+                )
+                self._api.patch_pod(ns, name, {"metadata": {"annotations": annotations}})
+                self._api.bind_pod(ns, name, node_name)
+                self._inflight[(ns, name)] = (node_name, annotations, time.monotonic())
+            except (ApiError, AssignmentError) as e:
+                log.warning("bind %s/%s -> %s failed: %s", ns, name, node_name, e)
+                return {"error": str(e)}
+        log.info("bound %s/%s -> %s chip %d", ns, name, node_name, idx)
+        return {"error": ""}
+
+
+class ExtenderHTTPServer:
+    def __init__(self, core: ExtenderCore, host: str = "0.0.0.0", port: int = 32766):
+        self._core = core
+        self._host = host
+        self._port = port
+        self._server: ThreadingHTTPServer | None = None
+
+    @property
+    def port(self) -> int:
+        assert self._server is not None
+        return self._server.server_address[1]
+
+    def start(self) -> None:
+        core = self._core
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                log.v(6, fmt, *args)
+
+            def _send(self, code: int, body) -> None:
+                data = json.dumps(body).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                if self.path in ("/version", "/healthz"):
+                    return self._send(200, {"version": "v1", "ok": True})
+                return self._send(404, {"error": "not found"})
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", "0"))
+                try:
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                except json.JSONDecodeError:
+                    return self._send(400, {"error": "bad json"})
+                try:
+                    if self.path == "/scheduler/filter":
+                        return self._send(200, core.filter(body))
+                    if self.path == "/scheduler/prioritize":
+                        return self._send(200, core.prioritize(body))
+                    if self.path == "/scheduler/bind":
+                        return self._send(200, core.bind(body))
+                except Exception as e:  # keep the webhook alive
+                    log.error("extender verb %s failed: %s", self.path, e)
+                    return self._send(200, {"error": str(e)})
+                return self._send(404, {"error": f"unknown path {self.path}"})
+
+        self._server = ThreadingHTTPServer((self._host, self._port), Handler)
+        t = threading.Thread(target=self._server.serve_forever, daemon=True)
+        t.start()
+        log.info("scheduler extender listening on %s:%d", self._host, self.port)
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="tpushare-scheduler-extender")
+    p.add_argument("--port", type=int, default=32766)
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--policy", default="best-fit", choices=["first-fit", "best-fit"])
+    p.add_argument("--timeout", type=float, default=10.0)
+    p.add_argument("-v", "--verbosity", type=int, default=0)
+    args = p.parse_args(argv)
+    logutil.setup(args.verbosity)
+    try:
+        api = ApiServerClient.from_env(timeout_s=args.timeout)
+    except Exception as e:
+        log.fatal(f"apiserver config failed: {e}")
+    server = ExtenderHTTPServer(ExtenderCore(api, policy=args.policy),
+                                host=args.host, port=args.port)
+    server.start()
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,159 @@
+"""Scheduler-extender: pure logic tables + HTTP webhook e2e."""
+
+import json
+
+import pytest
+import requests
+
+from gpushare_device_plugin_tpu import const
+from gpushare_device_plugin_tpu.cluster.apiserver import ApiServerClient
+from gpushare_device_plugin_tpu.extender import logic
+from gpushare_device_plugin_tpu.extender.server import ExtenderCore, ExtenderHTTPServer
+
+from fake_apiserver import FakeApiServer
+from k8s_fixtures import assigned_running_pod, make_pod
+
+
+def shared_node(name, chips=4, units=32, resource=const.RESOURCE_MEM):
+    count_key = logic.RESOURCE_FAMILIES[resource]["count"]
+    cap = {resource: str(chips * units), count_key: str(chips)}
+    return {
+        "metadata": {"name": name, "labels": {}},
+        "status": {"capacity": dict(cap), "allocatable": dict(cap)},
+    }
+
+
+# --- pure logic ------------------------------------------------------------
+
+
+def test_pod_resource_detection():
+    assert logic.pod_resource(make_pod("p", 2)) == const.RESOURCE_MEM
+    gpu_pod = make_pod("p", 0)
+    gpu_pod["spec"]["containers"][0]["resources"]["limits"] = {
+        const.RESOURCE_GPU_MEM: "2"
+    }
+    assert logic.pod_resource(gpu_pod) == const.RESOURCE_GPU_MEM
+    assert logic.pod_resource(make_pod("p", 0)) is None
+
+
+def test_filter_requires_single_chip_fit():
+    nodes = [shared_node("full", chips=2, units=8), shared_node("free", chips=2, units=8)]
+    pods = [
+        assigned_running_pod("r1", 6, chip_idx=0, node="full"),
+        assigned_running_pod("r2", 6, chip_idx=1, node="full"),
+    ]
+    pod = make_pod("new", 4, node="")
+    fits, failed = logic.filter_nodes(pod, nodes, pods)
+    # "full" has 2+2 free spread over two chips: 4 doesn't fit a single chip
+    assert fits == ["free"]
+    assert "full" in failed and "no single chip" in failed["full"]
+
+
+def test_filter_non_advertising_node():
+    pod = make_pod("new", 4, node="")
+    fits, failed = logic.filter_nodes(pod, [{"metadata": {"name": "cpu"}, "status": {}}], [])
+    assert fits == []
+    assert "does not advertise" in failed["cpu"]
+
+
+def test_prioritize_prefers_tight_fit():
+    # node-a chip has exactly 4 free (tight), node-b is empty (loose)
+    nodes = [shared_node("tight", chips=1, units=8), shared_node("loose", chips=1, units=8)]
+    pods = [assigned_running_pod("r", 4, chip_idx=0, node="tight")]
+    scores = logic.prioritize_nodes(make_pod("new", 4, node=""), nodes, pods)
+    assert scores["tight"] > scores["loose"]
+
+
+def test_choose_chip_annotations():
+    node = shared_node("n", chips=2, units=8)
+    pods = [assigned_running_pod("r", 7, chip_idx=0, node="n")]
+    pod = make_pod("new", 4, node="n", containers=[3, 1])
+    resource, idx, ann = logic.choose_chip(pod, node, pods)
+    assert resource == const.RESOURCE_MEM
+    assert idx == 1  # chip 0 has only 1 free
+    assert ann[const.ENV_MEM_IDX] == "1"
+    assert ann[const.ENV_ASSIGNED_FLAG] == "false"
+    alloc = json.loads(ann[const.ANN_EXTENDER_ALLOCATION])
+    assert alloc == {"c0": {"1": 3}, "c1": {"1": 1}}
+
+
+def test_choose_chip_gpu_family():
+    node = shared_node("g", chips=1, units=16, resource=const.RESOURCE_GPU_MEM)
+    pod = make_pod("new", 0, node="g")
+    pod["spec"]["containers"][0]["resources"]["limits"] = {const.RESOURCE_GPU_MEM: "4"}
+    resource, idx, ann = logic.choose_chip(pod, node, [])
+    assert resource == const.RESOURCE_GPU_MEM
+    assert ann["ALIYUN_COM_GPU_MEM_IDX"] == "0"
+
+
+# --- HTTP e2e --------------------------------------------------------------
+
+
+@pytest.fixture
+def stack():
+    api = FakeApiServer()
+    api.start()
+    core = ExtenderCore(ApiServerClient(api.url))
+    http = ExtenderHTTPServer(core, host="127.0.0.1", port=0)
+    http.start()
+    yield api, f"http://127.0.0.1:{http.port}"
+    http.stop()
+    api.stop()
+
+
+def test_filter_bind_roundtrip(stack):
+    api, url = stack
+    api.nodes["node-a"] = shared_node("node-a")
+    api.nodes["node-b"] = shared_node("node-b")
+    pod = make_pod("trainer", 8, node="")
+    api.add_pod(pod)
+
+    r = requests.post(f"{url}/scheduler/filter", json={
+        "pod": pod, "nodenames": ["node-a", "node-b", "ghost"]})
+    body = r.json()
+    assert sorted(body["nodenames"]) == ["node-a", "node-b"]
+
+    r = requests.post(f"{url}/scheduler/prioritize", json={
+        "pod": pod, "nodenames": ["node-a", "node-b"]})
+    assert {e["host"] for e in r.json()} == {"node-a", "node-b"}
+
+    r = requests.post(f"{url}/scheduler/bind", json={
+        "podName": "trainer", "podNamespace": "default", "node": "node-a"})
+    assert r.json()["error"] == ""
+    # binding created and annotations persisted
+    assert api.bindings == [("default", "trainer", "node-a")]
+    stored = api.pods[("default", "trainer")]
+    ann = stored["metadata"]["annotations"]
+    assert ann[const.ENV_MEM_IDX] == "0"
+    assert ann[const.ENV_ASSIGNED_FLAG] == "false"
+    assert stored["spec"]["nodeName"] == "node-a"
+
+
+def test_bind_sequential_pods_pack_same_chip(stack):
+    api, url = stack
+    api.nodes["node-a"] = shared_node("node-a", chips=2, units=32)
+    for name in ("p1", "p2"):
+        api.add_pod(make_pod(name, 8, node=""))
+        r = requests.post(f"{url}/scheduler/bind", json={
+            "podName": name, "podNamespace": "default", "node": "node-a"})
+        assert r.json()["error"] == ""
+    a1 = api.pods[("default", "p1")]["metadata"]["annotations"][const.ENV_MEM_IDX]
+    a2 = api.pods[("default", "p2")]["metadata"]["annotations"][const.ENV_MEM_IDX]
+    # second pod sees the first (assumed) pod's usage and packs with it
+    assert a1 == a2 == "0"
+
+
+def test_bind_overcommit_errors(stack):
+    api, url = stack
+    api.nodes["node-a"] = shared_node("node-a", chips=1, units=8)
+    api.add_pod(make_pod("big", 9, node=""))
+    r = requests.post(f"{url}/scheduler/bind", json={
+        "podName": "big", "podNamespace": "default", "node": "node-a"})
+    assert "no chip can fit" in r.json()["error"]
+    assert api.bindings == []
+
+
+def test_health_endpoints(stack):
+    _, url = stack
+    assert requests.get(f"{url}/healthz").json()["ok"] is True
+    assert requests.post(f"{url}/scheduler/filter", data="{bad json").status_code == 400

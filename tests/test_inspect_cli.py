@@ -1,0 +1,127 @@
+"""Inspect CLI against the fake apiserver (reference: cmd/inspect)."""
+
+import json
+
+import pytest
+
+from gpushare_device_plugin_tpu import const
+from gpushare_device_plugin_tpu.cli import inspect as inspect_cli
+from gpushare_device_plugin_tpu.cli.nodeinfo import (
+    PENDING_IDX,
+    build_all_node_infos,
+    infer_unit,
+    pod_allocation,
+)
+from gpushare_device_plugin_tpu.cluster.apiserver import ApiServerClient
+
+from fake_apiserver import FakeApiServer
+from k8s_fixtures import assigned_running_pod, make_pod
+
+
+@pytest.fixture
+def api():
+    srv = FakeApiServer()
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def shared_node(name, chips=4, units_per_chip=32, ip="10.0.0.1"):
+    node = {
+        "metadata": {"name": name, "labels": {}},
+        "status": {
+            "capacity": {
+                const.RESOURCE_MEM: str(chips * units_per_chip),
+                const.RESOURCE_COUNT: str(chips),
+            },
+            "allocatable": {
+                const.RESOURCE_MEM: str(chips * units_per_chip),
+                const.RESOURCE_COUNT: str(chips),
+            },
+            "addresses": [{"type": "InternalIP", "address": ip}],
+        },
+    }
+    return node
+
+
+def test_pod_allocation_priority():
+    # extender annotation wins over IDX
+    pod = assigned_running_pod(
+        "p", 4, chip_idx=1,
+        annotations={const.ANN_EXTENDER_ALLOCATION: json.dumps({"c0": {"2": 3, "3": 1}})},
+    )
+    assert pod_allocation(pod) == {2: 3, 3: 1}
+    # IDX fallback
+    pod = assigned_running_pod("p", 4, chip_idx=1)
+    assert pod_allocation(pod) == {1: 4}
+    # unassigned -> pending bucket
+    pod = make_pod("p", 4)
+    assert pod_allocation(pod) == {PENDING_IDX: 4}
+    # garbled extender annotation -> IDX fallback
+    pod = assigned_running_pod(
+        "p", 4, chip_idx=0, annotations={const.ANN_EXTENDER_ALLOCATION: "not-json"}
+    )
+    assert pod_allocation(pod) == {0: 4}
+
+
+def test_build_node_infos_and_unit(api):
+    nodes = [shared_node("node-a"), {"metadata": {"name": "cpu-only"}, "status": {}}]
+    pods = [
+        assigned_running_pod("r1", 6, chip_idx=0, node="node-a"),
+        assigned_running_pod("r2", 2, chip_idx=1, node="node-a"),
+        make_pod("pending", 4, node="node-a"),
+        make_pod("done", 4, node="node-a", phase="Succeeded"),
+        make_pod("other-node", 4, node="node-b"),
+    ]
+    infos = build_all_node_infos(nodes, pods)
+    assert len(infos) == 1  # cpu-only node filtered out
+    info = infos[0]
+    assert info.total_units == 128
+    assert info.used_units == 8
+    assert info.devices[0].used_units == 6
+    assert info.devices[1].used_units == 2
+    assert info.pending_units == 4
+    assert infer_unit(infos) == "GiB"
+
+
+def test_cli_summary_end_to_end(api, capsys, monkeypatch):
+    api.add_node("ignored")  # non-shared node
+    api.nodes["node-a"] = shared_node("node-a")
+    api.add_pod(assigned_running_pod("r1", 16, chip_idx=0, node="node-a"))
+    api.add_pod(assigned_running_pod("r2", 16, chip_idx=0, node="node-a"))
+    monkeypatch.setattr(inspect_cli, "_client", lambda: ApiServerClient(api.url))
+
+    rc = inspect_cli.main([])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "node-a" in out
+    assert "chip0: 32/32" in out
+    assert "32/128 (25%)" in out  # the north-star cluster line
+
+
+def test_cli_details_and_node_filter(api, capsys, monkeypatch):
+    api.nodes["node-a"] = shared_node("node-a")
+    api.nodes["node-b"] = shared_node("node-b", ip="10.0.0.2")
+    api.add_pod(assigned_running_pod("r1", 4, chip_idx=2, node="node-a"))
+    monkeypatch.setattr(inspect_cli, "_client", lambda: ApiServerClient(api.url))
+
+    rc = inspect_cli.main(["-d", "node-a"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "r1" in out and "chip2:4" in out
+    assert "node-b" not in out
+
+
+def test_cli_no_shared_nodes(api, capsys, monkeypatch):
+    api.add_node("plain")
+    monkeypatch.setattr(inspect_cli, "_client", lambda: ApiServerClient(api.url))
+    rc = inspect_cli.main([])
+    assert rc == 0
+    assert "no shared-TPU nodes" in capsys.readouterr().out
+
+
+def test_cli_unknown_node_errors(api, monkeypatch):
+    api.nodes["node-a"] = shared_node("node-a")
+    monkeypatch.setattr(inspect_cli, "_client", lambda: ApiServerClient(api.url))
+    with pytest.raises(SystemExit, match="not found"):
+        inspect_cli.main(["nope"])
