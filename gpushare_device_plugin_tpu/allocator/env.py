@@ -68,7 +68,11 @@ def build_mem_allocation(
     if disable_isolation:
         envs["CTPU_DISABLE"] = "true"
     elif chip_total_units > 0:
-        frac = min(1.0, pod_units / chip_total_units)
+        # Per-container, not per-pod: each container is its own XLA client
+        # process; capping every container at the pod's total fraction would
+        # let a 2-container pod preallocate double its entitlement.
+        units = container_units if container_units > 0 else pod_units
+        frac = min(1.0, units / chip_total_units)
         envs[const.ENV_XLA_MEM_FRACTION] = f"{frac:.4f}"
         envs[const.ENV_XLA_PYTHON_MEM_FRACTION] = f"{frac:.4f}"
     alloc = ContainerAllocation(envs=envs)

@@ -1,0 +1,20 @@
+"""Pod-side JAX parallel runtime.
+
+This is the *workload* half of the framework: the device plugin injects
+topology env vars at container admission (``TPU_VISIBLE_CHIPS``,
+``TPU_PROCESS_BOUNDS``, ``ALIYUN_COM_TPU_MEM_*`` — the TPU analog of the
+reference's ``NVIDIA_VISIBLE_DEVICES`` injection, ``allocate.go:109-124``),
+and this package consumes them: cooperative HBM capping, mesh construction
+over the granted chips, sharding rules, and ring attention for
+sequence-parallel long-context work.
+
+The reference has no workload-side runtime at all (SURVEY.md section 2,
+"parallelism strategies — explicitly absent"); this package is the
+TPU-native completion of the story: a pod that was binpacked onto a
+fractional HBM slice needs to (a) self-limit its XLA client allocation and
+(b) build its `jax.sharding.Mesh` from what the plugin granted.
+"""
+
+from .podenv import PodTpuEnv, configure_jax_from_env  # noqa: F401
+from .mesh import MeshSpec, make_mesh, batch_sharding  # noqa: F401
+from .ring import ring_attention  # noqa: F401

@@ -1,0 +1,102 @@
+"""Device-mesh construction and sharding rules.
+
+TPU-first design: one `jax.sharding.Mesh` with named axes
+
+- ``dp``   — data parallelism (pure replication of params),
+- ``fsdp`` — fully-sharded data parallelism (params sharded, data sharded),
+- ``tp``   — tensor parallelism (matmul dims sharded; collectives ride ICI),
+- ``sp``   — sequence parallelism (ring attention, ``parallel/ring.py``).
+
+XLA inserts the collectives (psum/all-gather/reduce-scatter) from the
+NamedSharding annotations; nothing here hand-schedules communication.
+The plugin side of the story is only env injection (SURVEY.md section 2,
+"distributed communication backend — explicitly absent" in the reference;
+on TPU the mesh axes map onto the ICI torus that libtpu exposes from
+``TPU_PROCESS_BOUNDS``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Logical mesh shape. Any axis may be 1 (inactive)."""
+
+    dp: int = 1
+    fsdp: int = 1
+    tp: int = 1
+    sp: int = 1
+
+    @property
+    def size(self) -> int:
+        return self.dp * self.fsdp * self.tp * self.sp
+
+    @property
+    def axis_names(self) -> tuple[str, ...]:
+        return ("dp", "fsdp", "tp", "sp")
+
+    @classmethod
+    def auto(cls, n_devices: int, *, max_tp: int = 4, want_sp: bool = False) -> "MeshSpec":
+        """Factor ``n_devices`` into a sensible (dp, fsdp, tp[, sp]) shape.
+
+        Heuristic, TPU-flavored: tp gets the smallest power-of-two up to
+        ``max_tp`` (tp collectives are the most latency-sensitive, keep the
+        group small/ICI-adjacent); sp (when requested) takes a factor of 2;
+        fsdp absorbs the rest; dp only appears when fsdp would exceed 8.
+        """
+        rem = n_devices
+        tp = 1
+        while tp * 2 <= max_tp and rem % 2 == 0:
+            tp *= 2
+            rem //= 2
+        sp = 1
+        if want_sp and rem % 2 == 0 and rem > 1:
+            sp = 2
+            rem //= 2
+        fsdp, dp = rem, 1
+        while fsdp > 8 and fsdp % 2 == 0:
+            fsdp //= 2
+            dp *= 2
+        return cls(dp=dp, fsdp=fsdp, tp=tp, sp=sp)
+
+
+def make_mesh(
+    spec: MeshSpec | None = None,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build the mesh over ``devices`` (default: all local JAX devices)."""
+    devs = list(devices) if devices is not None else list(jax.devices())
+    if spec is None:
+        spec = MeshSpec.auto(len(devs))
+    if spec.size != len(devs):
+        raise ValueError(
+            f"mesh spec {spec} needs {spec.size} devices, have {len(devs)}"
+        )
+    arr = np.array(devs).reshape(spec.dp, spec.fsdp, spec.tp, spec.sp)
+    return Mesh(arr, spec.axis_names)
+
+
+def batch_sharding(mesh: Mesh, *, seq_parallel: bool = False) -> NamedSharding:
+    """Sharding for ``[batch, seq]`` token arrays.
+
+    Batch shards over (dp, fsdp) — fsdp is ZeRO-style, it shards params AND
+    acts as extra data parallelism; the sequence dim shards over sp when
+    ring attention is in play.
+    """
+    return NamedSharding(
+        mesh, P(("dp", "fsdp"), "sp" if seq_parallel else None)
+    )
+
+
+def local_batch_size(global_batch: int, mesh: Mesh) -> int:
+    dp_total = mesh.shape["dp"] * mesh.shape["fsdp"]
+    if global_batch % dp_total:
+        raise ValueError(f"global batch {global_batch} not divisible by dp*fsdp={dp_total}")
+    return global_batch // dp_total

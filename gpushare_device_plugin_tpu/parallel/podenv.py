@@ -1,0 +1,122 @@
+"""Consume plugin-injected env inside a workload pod.
+
+The Allocate() hot path (``allocator/env.py``; reference ``allocate.go:109-124``)
+injects:
+
+- ``TPU_VISIBLE_CHIPS`` — comma-separated local chip indices granted to the
+  container (analog of ``NVIDIA_VISIBLE_DEVICES``),
+- ``TPU_PROCESS_BOUNDS`` / ``TPU_CHIPS_PER_PROCESS_BOUNDS`` — multi-host
+  slice topology strings libtpu uses to form the global mesh,
+- ``ALIYUN_COM_TPU_MEM_{IDX,POD,CONTAINER,DEV}`` — the HBM-unit accounting
+  annotations mirrored into env,
+- ``TPU_HBM_LIMIT_FRACTION`` — cooperative HBM cap (there is no hardware
+  fence for fractional HBM, same as GPU memory in the reference; the cGPU
+  analog toggle is the ``ctpu.disable.isolation`` node label,
+  ``podmanager.go:59-72``).
+
+``configure_jax_from_env()`` translates these into the env vars the JAX/XLA
+TPU client actually reads and must run **before** ``import jax`` initializes
+a backend.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Mapping
+
+from .. import const
+
+
+@dataclasses.dataclass(frozen=True)
+class PodTpuEnv:
+    """Parsed view of the plugin-injected container env."""
+
+    visible_chips: tuple[int, ...]  # local chip indices granted
+    chip_index: int  # primary assigned chip (MEM_IDX), -1 if unset
+    mem_units_container: int  # this container's HBM units
+    mem_units_chip: int  # total units on the assigned chip
+    process_bounds: str  # "" on single-host
+    chips_per_process_bounds: str
+    hbm_fraction: float  # cooperative cap in (0, 1]
+
+    @property
+    def exclusive(self) -> bool:
+        """Whole chip(s) granted — no HBM cap needed."""
+        return self.hbm_fraction >= 0.999
+
+    @classmethod
+    def from_env(cls, env: Mapping[str, str] | None = None) -> "PodTpuEnv":
+        e = os.environ if env is None else env
+
+        def _int(key: str, default: int) -> int:
+            try:
+                return int(e.get(key, ""))
+            except ValueError:
+                return default
+
+        chips_raw = e.get(const.ENV_TPU_VISIBLE_CHIPS, "")
+        visible = tuple(
+            int(tok) for tok in chips_raw.split(",") if tok.strip().isdigit()
+        )
+        container_units = _int(const.ENV_MEM_CONTAINER, 0)
+        chip_units = _int(const.ENV_MEM_DEV, 0)
+        explicit = None
+        frac_raw = e.get(const.ENV_XLA_MEM_FRACTION, "")
+        if frac_raw:
+            try:
+                explicit = min(1.0, max(0.0, float(frac_raw)))
+            except ValueError:
+                explicit = None
+        if container_units > 0 and chip_units > 0:
+            derived = min(1.0, container_units / chip_units)
+            # The container never gets more than its own units' fraction,
+            # whatever the explicit env says (defense against a stale or
+            # pod-level value in a multi-container pod).
+            fraction = min(explicit, derived) if explicit is not None else derived
+        else:
+            fraction = explicit if explicit is not None else 1.0
+        return cls(
+            visible_chips=visible,
+            chip_index=_int(const.ENV_MEM_IDX, -1),
+            mem_units_container=container_units,
+            mem_units_chip=chip_units,
+            process_bounds=e.get(const.ENV_TPU_PROCESS_BOUNDS, ""),
+            chips_per_process_bounds=e.get(
+                const.ENV_TPU_CHIPS_PER_PROCESS_BOUNDS, ""
+            ),
+            hbm_fraction=fraction,
+        )
+
+
+def configure_jax_from_env(
+    env: Mapping[str, str] | None = None,
+    *,
+    headroom: float = 0.95,
+) -> dict[str, str]:
+    """Compute (and apply to ``os.environ``) the JAX/XLA client settings.
+
+    Returns the dict of settings so callers (and tests) can inspect them.
+    ``headroom`` shaves the cooperative cap so two co-scheduled pods whose
+    fractions sum to 1.0 don't collide on allocator slack — the fractional
+    sharing here is cooperative, exactly like the reference's GPU memory
+    sharing (no hardware fence; SURVEY.md section 7 "hard parts" (d)).
+    """
+    pod = PodTpuEnv.from_env(env)
+    settings: dict[str, str] = {}
+    if not pod.exclusive:
+        settings["XLA_PYTHON_CLIENT_MEM_FRACTION"] = f"{pod.hbm_fraction * headroom:.3f}"
+        # Pre-allocating the full fraction up-front keeps co-tenants honest:
+        # a pod that exceeds its slice OOMs itself, not its neighbor.
+        settings["XLA_PYTHON_CLIENT_PREALLOCATE"] = "true"
+    if pod.process_bounds:
+        settings[const.ENV_TPU_PROCESS_BOUNDS] = pod.process_bounds
+    if pod.chips_per_process_bounds:
+        settings[const.ENV_TPU_CHIPS_PER_PROCESS_BOUNDS] = pod.chips_per_process_bounds
+    if pod.visible_chips:
+        settings[const.ENV_TPU_VISIBLE_CHIPS] = ",".join(
+            str(i) for i in pod.visible_chips
+        )
+    for k, v in settings.items():
+        os.environ[k] = v
+    return settings

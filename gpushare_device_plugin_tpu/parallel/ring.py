@@ -1,0 +1,139 @@
+"""Ring attention: sequence-parallel exact attention over a mesh axis.
+
+Long-context support for workloads scheduled by the plugin: the sequence is
+sharded over the ``sp`` mesh axis; K/V blocks rotate around the ring via
+``jax.lax.ppermute`` (ICI neighbor exchange on TPU) while each device keeps
+its Q block and maintains an online-softmax accumulator — so attention over
+a sequence of length S costs O(S/n) memory per chip and the K/V transfer
+overlaps with the block matmuls (MXU work) under XLA's async collectives.
+
+This is compiler-friendly by construction: a `lax.fori_loop` of static
+trip-count ``n`` (the sp axis size), static block shapes, no host control
+flow. The reference has no long-context machinery at all (SURVEY.md
+section 5: absent); this is the TPU-native capability its workloads need.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _online_update(o, m, l, s, v):
+    """One online-softmax accumulation step.
+
+    o: [B,H,Tq,D] weighted-value accumulator, m: [B,H,Tq] running max,
+    l: [B,H,Tq] running denominator, s: [B,H,Tq,Tk] scores (may be -inf),
+    v: [B,Tk,H,D].
+    """
+    s_max = jnp.max(s, axis=-1)
+    m_new = jnp.maximum(m, s_max)
+    # Rows fully masked so far have m_new == -inf; substitute 0 so the exps
+    # below produce exact zeros instead of NaN ((-inf) - (-inf)).
+    m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+    p = jnp.exp(s - m_safe[..., None])  # [B,H,Tq,Tk]
+    alpha = jnp.exp(jnp.where(jnp.isneginf(m), -jnp.inf, m - m_safe))
+    l_new = alpha * l + jnp.sum(p, axis=-1)
+    o_new = alpha[..., None] * o + jnp.einsum("bhqk,bkhd->bhqd", p, v)
+    return o_new, m_new, l_new
+
+
+def ring_attention_block(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str = "sp",
+    causal: bool = True,
+    scale: float | None = None,
+) -> jax.Array:
+    """Per-shard ring attention body — call *inside* ``shard_map``.
+
+    q: [B, Tq, H, D] local query block; k, v: [B, Tk, H, D] local K/V block.
+    Returns [B, Tq, H, D]. Global sequence order is block-major: device i of
+    the ``axis_name`` ring holds positions [i*T, (i+1)*T).
+    """
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    sc = scale if scale is not None else 1.0 / math.sqrt(D)
+    q_pos = idx * Tq + jnp.arange(Tq)  # global query positions
+
+    # send-to-next permutation: after step i each device holds block (idx-i)%n
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    # Derive the zero accumulators from q so they inherit q's shard-varying
+    # axes (shard_map's VMA check requires loop-carry types to be stable).
+    zero = jnp.transpose(q, (0, 2, 1, 3)).astype(jnp.float32) * 0.0  # [B,H,Tq,D]
+    o = zero
+    m = zero[..., 0] - jnp.inf  # [B,H,Tq] all -inf
+    l = zero[..., 0]
+
+    def body(i, carry):
+        o, m, l, k, v = carry
+        src = (idx - i) % n  # which global block this k/v is
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * sc
+        if causal:
+            k_pos = src * Tk + jnp.arange(Tk)
+            mask = q_pos[:, None] >= k_pos[None, :]  # [Tq, Tk]
+            s = jnp.where(mask[None, None], s, -jnp.inf)
+        o, m, l = _online_update(o, m, l, s, v)
+        k = jax.lax.ppermute(k, axis_name, perm)
+        v = jax.lax.ppermute(v, axis_name, perm)
+        return o, m, l, k, v
+
+    o, m, l, _, _ = jax.lax.fori_loop(0, n, body, (o, m, l, k, v))
+    l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows (shouldn't occur causally)
+    out = (o / l[..., None]).astype(q.dtype)  # [B,H,Tq,D]
+    return jnp.transpose(out, (0, 2, 1, 3))  # [B,Tq,H,D]
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    axis_name: str = "sp",
+    causal: bool = True,
+    scale: float | None = None,
+    batch_axes: tuple[str, ...] | None = None,
+    head_axes: str | tuple[str, ...] | None = None,
+) -> jax.Array:
+    """Sequence-parallel attention over ``mesh``'s ``axis_name`` ring.
+
+    Arrays are global ``[B, S, H, D]``; the sequence dim is (or will be)
+    sharded over ``axis_name``, the batch dim over ``batch_axes`` and the
+    heads dim over ``head_axes`` (tensor parallelism composes with the ring:
+    each (tp, sp) pair works on its own head/sequence tile).
+    Wraps :func:`ring_attention_block` in ``shard_map``.
+    """
+    bspec = batch_axes if batch_axes else None
+    spec = P(bspec, axis_name, head_axes, None)
+    fn = functools.partial(
+        ring_attention_block, axis_name=axis_name, causal=causal, scale=scale
+    )
+    return jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+    )(q, k, v)
+
+
+def full_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool = True,
+    scale: float | None = None,
+) -> jax.Array:
+    """Plain single-device attention — the correctness oracle for the ring."""
+    B, S, H, D = q.shape
+    sc = scale if scale is not None else 1.0 / math.sqrt(D)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * sc
+    if causal:
+        mask = jnp.tril(jnp.ones((S, S), dtype=bool))
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v).astype(q.dtype)
+    return out

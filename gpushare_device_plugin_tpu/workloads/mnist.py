@@ -1,0 +1,96 @@
+"""MNIST-scale MLP demo workload (BASELINE.md config 2).
+
+The "one JAX MNIST pod requesting 4 GiB tpu-mem" scenario: a small
+classifier whose training step data-parallelizes over whatever chips the
+plugin granted (``parallel.podenv`` + a (dp,) mesh). Data is synthetic
+(zero-egress image — no dataset downloads): class-conditional Gaussian
+blobs, which the MLP must separate, so the loss curve is a real training
+signal for e2e smoke tests.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+IMAGE_DIM = 784
+N_CLASSES = 10
+
+
+def init_params(rng: jax.Array, hidden: int = 128):
+    k1, k2 = jax.random.split(rng)
+    return {
+        "w1": jax.random.normal(k1, (IMAGE_DIM, hidden)) / jnp.sqrt(IMAGE_DIM),
+        "b1": jnp.zeros((hidden,)),
+        "w2": jax.random.normal(k2, (hidden, N_CLASSES)) / jnp.sqrt(hidden),
+        "b2": jnp.zeros((N_CLASSES,)),
+    }
+
+
+def forward(params, images):
+    h = jax.nn.relu(images @ params["w1"] + params["b1"])
+    return h @ params["w2"] + params["b2"]
+
+
+def loss_fn(params, images, labels):
+    logits = forward(params, images)
+    return jnp.mean(
+        optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+    )
+
+
+def make_train_step(mesh: Mesh | None = None, lr: float = 1e-2):
+    """Jitted (params, opt_state, images, labels) -> (params, opt_state, loss).
+
+    With a mesh, params replicate and the batch shards over every mesh axis
+    (pure DP — the right parallelism at this model scale).
+    """
+    opt = optax.sgd(lr, momentum=0.9)
+
+    def step(params, opt_state, images, labels):
+        loss, grads = jax.value_and_grad(loss_fn)(params, images, labels)
+        updates, opt_state = opt.update(grads, opt_state)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    if mesh is None:
+        return jax.jit(step), opt
+    rep = NamedSharding(mesh, P())
+    data = NamedSharding(mesh, P(tuple(mesh.axis_names)))
+    return (
+        jax.jit(
+            step,
+            in_shardings=(rep, None, data, data),
+            out_shardings=(rep, None, None),
+            donate_argnums=(0, 1),
+        ),
+        opt,
+    )
+
+
+def synthetic_batch(rng: jax.Array, batch: int):
+    """Class-conditional Gaussian blobs in pixel space.
+
+    The class prototypes come from a fixed key so every batch samples the
+    *same* 10-class problem — fresh per-step rngs stay learnable.
+    """
+    k_label, k_noise = jax.random.split(rng)
+    labels = jax.random.randint(k_label, (batch,), 0, N_CLASSES)
+    protos = jax.random.normal(jax.random.key(42), (N_CLASSES, IMAGE_DIM))
+    images = protos[labels] + 0.3 * jax.random.normal(k_noise, (batch, IMAGE_DIM))
+    return images.astype(jnp.float32), labels.astype(jnp.int32)
+
+
+def train(steps: int = 50, batch: int = 256, mesh: Mesh | None = None, seed: int = 0):
+    """Tiny training loop; returns final loss (for smoke tests / demo pod)."""
+    rng = jax.random.key(seed)
+    params = init_params(rng)
+    step_fn, opt = make_train_step(mesh)
+    opt_state = opt.init(params)
+    loss = None
+    for i in range(steps):
+        images, labels = synthetic_batch(jax.random.fold_in(jax.random.key(seed + 1), i), batch)
+        params, opt_state, loss = step_fn(params, opt_state, images, labels)
+    return float(loss)

@@ -1,0 +1,242 @@
+"""Flagship workload: Llama-style decoder LM, sharded TPU-first.
+
+Design notes (TPU/XLA):
+- **scan over layers** — one compiled layer body, `lax.scan` over stacked
+  layer params: compile time independent of depth, XLA pipelines the MXU
+  matmuls.
+- **remat** — the scan body is wrapped in `jax.checkpoint`, trading FLOPs
+  for HBM (essential for fractional-HBM pods whose XLA client is capped by
+  the plugin's cooperative limit, ``parallel/podenv.py``).
+- **sharding** — params carry NamedShardings over the (dp, fsdp, tp, sp)
+  mesh (``parallel/mesh.py``); activations get
+  `with_sharding_constraint`; XLA inserts all collectives. fsdp is
+  ZeRO-style: param dims shard over ``fsdp`` and the batch shards over
+  ``(dp, fsdp)``.
+- **long context** — `seq_parallel=True` switches attention to the ring
+  implementation (``parallel/ring.py``), sequence sharded over ``sp``.
+- **bfloat16 compute** — params are kept f32 (optimizer quality), cast to
+  ``cfg.compute_dtype`` for the matmuls so they land on the MXU in bf16.
+
+The reference has no model code (SURVEY.md section 2); this is the workload
+half the TPU framework adds.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..parallel.mesh import batch_sharding
+from ..parallel.ring import full_attention, ring_attention
+
+Params = dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab: int = 256
+    d_model: int = 128
+    n_layers: int = 2
+    n_heads: int = 4
+    d_ff: int = 352
+    max_seq: int = 256
+    rope_theta: float = 10000.0
+    compute_dtype: Any = jnp.bfloat16
+    remat: bool = True
+    seq_parallel: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+# --- init -------------------------------------------------------------------
+
+def init_params(rng: jax.Array, cfg: TransformerConfig) -> Params:
+    k_embed, k_layers, k_out = jax.random.split(rng, 3)
+    d, H, Dh, F, L = cfg.d_model, cfg.n_heads, cfg.head_dim, cfg.d_ff, cfg.n_layers
+
+    def norm(key, shape, fan_in):
+        return (jax.random.normal(key, shape) / jnp.sqrt(fan_in)).astype(jnp.float32)
+
+    ks = jax.random.split(k_layers, 4)
+    return {
+        "embed": norm(k_embed, (cfg.vocab, d), d),
+        "layers": {
+            # stacked on leading L for lax.scan
+            "wqkv": norm(ks[0], (L, d, 3, H, Dh), d),
+            "wo": norm(ks[1], (L, H, Dh, d), d),
+            "wi": norm(ks[2], (L, d, 2, F), d),  # [gate, up]
+            "wdown": norm(ks[3], (L, F, d), F),
+            "ln1": jnp.ones((L, d), jnp.float32),
+            "ln2": jnp.ones((L, d), jnp.float32),
+        },
+        "final_norm": jnp.ones((d,), jnp.float32),
+        "out": norm(k_out, (d, cfg.vocab), d),
+    }
+
+
+def param_specs(cfg: TransformerConfig) -> Params:
+    """PartitionSpec pytree matching :func:`init_params`.
+
+    tp shards heads / mlp-hidden / vocab; fsdp shards the model dim
+    (ZeRO-style — XLA all-gathers per layer under the scan).
+    """
+    return {
+        "embed": P("tp", "fsdp"),
+        "layers": {
+            "wqkv": P(None, "fsdp", None, "tp", None),
+            "wo": P(None, "tp", None, "fsdp"),
+            "wi": P(None, "fsdp", None, "tp"),
+            "wdown": P(None, "tp", "fsdp"),
+            "ln1": P(None, None),
+            "ln2": P(None, None),
+        },
+        "final_norm": P(None),
+        "out": P("fsdp", "tp"),
+    }
+
+
+def param_shardings(mesh: Mesh, cfg: TransformerConfig) -> Params:
+    return jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec),
+        param_specs(cfg),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def shard_params(params: Params, mesh: Mesh, cfg: TransformerConfig) -> Params:
+    return jax.device_put(params, param_shardings(mesh, cfg))
+
+
+# --- model ------------------------------------------------------------------
+
+def _rms_norm(x, weight, eps=1e-6):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + eps)).astype(x.dtype) * weight.astype(x.dtype)
+
+
+def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """x: [B, T, H, Dh]; positions: [T] global token positions."""
+    Dh = x.shape[-1]
+    half = Dh // 2
+    freqs = 1.0 / (theta ** (jnp.arange(half, dtype=jnp.float32) / half))
+    angles = positions[:, None].astype(jnp.float32) * freqs[None, :]  # [T, half]
+    cos = jnp.cos(angles)[None, :, None, :].astype(x.dtype)
+    sin = jnp.sin(angles)[None, :, None, :].astype(x.dtype)
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+
+
+def _layer(x, lp, cfg: TransformerConfig, positions, mesh: Mesh | None):
+    """One decoder block. x: [B, T, d] global arrays (auto-SPMD)."""
+    dt = cfg.compute_dtype
+    h = _rms_norm(x, lp["ln1"])
+    qkv = jnp.einsum("btd,dchn->btchn", h, lp["wqkv"].astype(dt))
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [B,T,H,Dh]
+    q = _rope(q, positions, cfg.rope_theta)
+    k = _rope(k, positions, cfg.rope_theta)
+    if cfg.seq_parallel:
+        if mesh is None:
+            raise ValueError("seq_parallel=True requires a mesh")
+        # Only attention needs manual collectives (the K/V ring over sp);
+        # everything around it stays auto-sharded SPMD.
+        attn = ring_attention(
+            q, k, v, mesh, axis_name="sp", causal=True,
+            batch_axes=("dp", "fsdp"), head_axes="tp",
+        )
+    else:
+        attn = full_attention(q, k, v, causal=True)
+    x = x + jnp.einsum("bthn,hnd->btd", attn, lp["wo"].astype(dt))
+    h = _rms_norm(x, lp["ln2"])
+    gate_up = jnp.einsum("btd,dcf->btcf", h, lp["wi"].astype(dt))
+    ff = jax.nn.silu(gate_up[:, :, 0]) * gate_up[:, :, 1]
+    x = x + jnp.einsum("btf,fd->btd", ff, lp["wdown"].astype(dt))
+    return x
+
+
+def forward(
+    params: Params,
+    tokens: jax.Array,
+    cfg: TransformerConfig,
+    mesh: Mesh | None = None,
+) -> jax.Array:
+    """tokens: [B, S] int32 (global) -> logits [B, S, vocab] (f32)."""
+    dt = cfg.compute_dtype
+    B, S = tokens.shape
+    positions = jnp.arange(S)
+    x = params["embed"].astype(dt)[tokens]
+    layer_fn = functools.partial(_layer, cfg=cfg, positions=positions, mesh=mesh)
+    if cfg.remat:
+        layer_fn = jax.checkpoint(layer_fn)
+    x = jax.lax.scan(lambda c, lp: (layer_fn(c, lp), None), x, params["layers"])[0]
+    x = _rms_norm(x, params["final_norm"])
+    return jnp.einsum("btd,dv->btv", x, params["out"].astype(dt)).astype(jnp.float32)
+
+
+def loss_fn(
+    params: Params,
+    tokens: jax.Array,
+    cfg: TransformerConfig,
+    mesh: Mesh | None = None,
+) -> jax.Array:
+    """Next-token cross-entropy, mean over [B, S-1]."""
+    logits = forward(params, tokens, cfg, mesh)
+    targets = tokens[:, 1:]
+    lp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+    nll = -jnp.take_along_axis(lp, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll)
+
+
+# --- training ---------------------------------------------------------------
+
+def make_optimizer(lr: float = 3e-4) -> optax.GradientTransformation:
+    return optax.adamw(lr, weight_decay=0.01)
+
+
+def make_train_step(mesh: Mesh, cfg: TransformerConfig, optimizer=None):
+    """Jitted sharded train step: (params, opt_state, tokens) -> (params, opt_state, loss).
+
+    Data shards [('dp','fsdp'), 'sp'] — batch over data axes, sequence over
+    the ring axis. Params/opt-state keep their NamedShardings (donated).
+    """
+    opt = optimizer or make_optimizer()
+    pspecs = param_specs(cfg)
+    psh = jax.tree.map(
+        lambda s: NamedSharding(mesh, s), pspecs, is_leaf=lambda x: isinstance(x, P)
+    )
+    data_sh = batch_sharding(mesh, seq_parallel=cfg.seq_parallel)
+
+    def step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg, mesh)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return jax.jit(
+        step,
+        in_shardings=(psh, None, data_sh),
+        out_shardings=(psh, None, None),
+        donate_argnums=(0, 1),
+    )
+
+
+def init_train_state(rng: jax.Array, mesh: Mesh, cfg: TransformerConfig, optimizer=None):
+    """Sharded (params, opt_state) ready for :func:`make_train_step`."""
+    opt = optimizer or make_optimizer()
+    params = shard_params(init_params(rng, cfg), mesh, cfg)
+    opt_state = opt.init(params)
+    return params, opt_state
+
+
+def demo_batch(rng: jax.Array, batch: int, seq: int, vocab: int) -> jax.Array:
+    """Synthetic structured tokens (zero-egress image: no dataset downloads)."""
+    base = jax.random.randint(rng, (batch, 1), 0, vocab // 2)
+    ramp = jnp.arange(seq)[None, :]
+    return ((base + ramp) % vocab).astype(jnp.int32)

@@ -7,9 +7,29 @@ they are set at conftest import time.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import sys
+
+import pytest
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _pin_cpu_platform():
+    """Pin jax to CPU at the config level.
+
+    The axon sitecustomize registers the TPU platform unconditionally
+    (ignores JAX_PLATFORMS). Runs after collection — so jax is in
+    sys.modules iff some collected test module imported it — and before
+    any test body triggers backend init. Non-jax test runs never pay the
+    jax import.
+    """
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        jax.config.update("jax_platforms", "cpu")
+    yield

@@ -1,0 +1,150 @@
+"""Pod-side parallel runtime: env consumption, mesh building, ring attention.
+
+Runs on the virtual 8-device CPU mesh (conftest.py sets
+xla_force_host_platform_device_count=8).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from gpushare_device_plugin_tpu import const
+from gpushare_device_plugin_tpu.parallel import (
+    MeshSpec,
+    PodTpuEnv,
+    configure_jax_from_env,
+    make_mesh,
+    ring_attention,
+)
+from gpushare_device_plugin_tpu.parallel.mesh import local_batch_size
+from gpushare_device_plugin_tpu.parallel.ring import full_attention
+
+
+# --- podenv -----------------------------------------------------------------
+
+def injected_env(chips="1", container=8, dev=32, bounds=""):
+    env = {
+        const.ENV_TPU_VISIBLE_CHIPS: chips,
+        const.ENV_MEM_IDX: chips.split(",")[0] if chips else "-1",
+        const.ENV_MEM_CONTAINER: str(container),
+        const.ENV_MEM_DEV: str(dev),
+    }
+    if bounds:
+        env[const.ENV_TPU_PROCESS_BOUNDS] = bounds
+    return env
+
+
+def test_podenv_parses_fractional_grant():
+    pod = PodTpuEnv.from_env(injected_env(chips="2", container=8, dev=32))
+    assert pod.visible_chips == (2,)
+    assert pod.chip_index == 2
+    assert pod.hbm_fraction == pytest.approx(0.25)
+    assert not pod.exclusive
+
+
+def test_podenv_explicit_fraction_is_upper_bound():
+    # Explicit env caps the derived fraction but can never raise it — a
+    # stale pod-level value must not let one container grab the pod's total.
+    env = injected_env(container=8, dev=32)
+    env[const.ENV_XLA_MEM_FRACTION] = "0.5"
+    assert PodTpuEnv.from_env(env).hbm_fraction == pytest.approx(0.25)
+    env[const.ENV_XLA_MEM_FRACTION] = "0.125"
+    assert PodTpuEnv.from_env(env).hbm_fraction == pytest.approx(0.125)
+
+
+def test_podenv_whole_chip_is_exclusive():
+    pod = PodTpuEnv.from_env(injected_env(container=32, dev=32))
+    assert pod.exclusive
+
+
+def test_configure_jax_sets_mem_fraction(monkeypatch):
+    monkeypatch.delenv("XLA_PYTHON_CLIENT_MEM_FRACTION", raising=False)
+    settings = configure_jax_from_env(injected_env(container=8, dev=32))
+    # 0.25 * 0.95 headroom
+    assert float(settings["XLA_PYTHON_CLIENT_MEM_FRACTION"]) == pytest.approx(0.2375, abs=1e-3)
+    assert settings["XLA_PYTHON_CLIENT_PREALLOCATE"] == "true"
+
+
+def test_configure_jax_exclusive_no_cap(monkeypatch):
+    monkeypatch.delenv("XLA_PYTHON_CLIENT_MEM_FRACTION", raising=False)
+    settings = configure_jax_from_env(
+        injected_env(chips="0,1,2,3", container=32, dev=32, bounds="2,2,1")
+    )
+    assert "XLA_PYTHON_CLIENT_MEM_FRACTION" not in settings
+    assert settings[const.ENV_TPU_PROCESS_BOUNDS] == "2,2,1"
+    assert settings[const.ENV_TPU_VISIBLE_CHIPS] == "0,1,2,3"
+
+
+# --- mesh -------------------------------------------------------------------
+
+def test_mesh_spec_auto_factors():
+    spec = MeshSpec.auto(8)
+    assert spec.size == 8
+    assert spec.tp == 4  # tp takes the small power of two first
+    spec_sp = MeshSpec.auto(8, want_sp=True)
+    assert spec_sp.size == 8 and spec_sp.sp == 2
+
+
+def test_make_mesh_and_batch_math():
+    mesh = make_mesh(MeshSpec(dp=2, fsdp=2, tp=2))
+    assert mesh.shape == {"dp": 2, "fsdp": 2, "tp": 2, "sp": 1}
+    assert local_batch_size(16, mesh) == 4
+    with pytest.raises(ValueError):
+        local_batch_size(6, mesh)
+
+
+def test_make_mesh_size_mismatch():
+    with pytest.raises(ValueError):
+        make_mesh(MeshSpec(dp=3, fsdp=1, tp=1), devices=jax.devices()[:2])
+
+
+# --- ring attention ---------------------------------------------------------
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_attention_matches_full(causal):
+    devs = np.array(jax.devices()).reshape(8)
+    mesh = Mesh(devs, ("sp",))
+    B, S, H, D = 2, 32, 4, 8
+    rng = jax.random.key(0)
+    kq, kk, kv = jax.random.split(rng, 3)
+    q = jax.random.normal(kq, (B, S, H, D), dtype=jnp.float32)
+    k = jax.random.normal(kk, (B, S, H, D), dtype=jnp.float32)
+    v = jax.random.normal(kv, (B, S, H, D), dtype=jnp.float32)
+
+    expected = full_attention(q, k, v, causal=causal)
+    got = ring_attention(q, k, v, mesh, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=2e-5)
+
+
+def test_ring_attention_with_tp_heads():
+    devs = np.array(jax.devices()).reshape(2, 2, 2)
+    mesh = Mesh(devs, ("dp", "tp", "sp"))
+    B, S, H, D = 2, 16, 4, 8
+    rng = jax.random.key(1)
+    kq, kk, kv = jax.random.split(rng, 3)
+    q = jax.random.normal(kq, (B, S, H, D))
+    k = jax.random.normal(kk, (B, S, H, D))
+    v = jax.random.normal(kv, (B, S, H, D))
+    expected = full_attention(q, k, v, causal=True)
+    got = ring_attention(
+        q, k, v, mesh, causal=True, batch_axes=("dp",), head_axes="tp"
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=2e-5)
+
+
+def test_ring_attention_jit_grad():
+    """Ring attention must be differentiable under jit (training path)."""
+    devs = np.array(jax.devices()).reshape(8)
+    mesh = Mesh(devs, ("sp",))
+    B, S, H, D = 1, 16, 2, 4
+    rng = jax.random.key(2)
+    q = jax.random.normal(rng, (B, S, H, D))
+
+    def loss(q):
+        return jnp.sum(ring_attention(q, q, q, mesh) ** 2)
+
+    g = jax.jit(jax.grad(loss))(q)
+    assert g.shape == q.shape
+    assert bool(jnp.all(jnp.isfinite(g)))

@@ -1,0 +1,86 @@
+"""Workload training steps on the virtual 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gpushare_device_plugin_tpu.parallel import MeshSpec, make_mesh
+from gpushare_device_plugin_tpu.workloads import mnist
+from gpushare_device_plugin_tpu.workloads.transformer import (
+    TransformerConfig,
+    demo_batch,
+    forward,
+    init_params,
+    init_train_state,
+    loss_fn,
+    make_train_step,
+    shard_params,
+)
+
+TINY = TransformerConfig(
+    vocab=64, d_model=32, n_layers=2, n_heads=4, d_ff=64, max_seq=32,
+    compute_dtype=jnp.float32,  # f32 on CPU test mesh; bf16 on TPU
+)
+
+
+def test_forward_shapes_single_device():
+    params = init_params(jax.random.key(0), TINY)
+    tokens = demo_batch(jax.random.key(1), 2, 16, TINY.vocab)
+    logits = forward(params, tokens, TINY)
+    assert logits.shape == (2, 16, TINY.vocab)
+    assert bool(jnp.all(jnp.isfinite(logits)))
+
+
+def test_train_step_decreases_loss_fsdp_tp():
+    mesh = make_mesh(MeshSpec(dp=1, fsdp=2, tp=4))
+    params, opt_state = init_train_state(jax.random.key(0), mesh, TINY)
+    step = make_train_step(mesh, TINY)
+    tokens = demo_batch(jax.random.key(1), 8, 32, TINY.vocab)
+    first = None
+    for _ in range(10):
+        params, opt_state, loss = step(params, opt_state, tokens)
+        first = float(loss) if first is None else first
+    assert float(loss) < first
+
+
+def test_train_step_seq_parallel_ring():
+    cfg = TransformerConfig(
+        vocab=64, d_model=32, n_layers=1, n_heads=4, d_ff=64, max_seq=32,
+        compute_dtype=jnp.float32, seq_parallel=True,
+    )
+    mesh = make_mesh(MeshSpec(dp=1, fsdp=2, tp=2, sp=2))
+    params, opt_state = init_train_state(jax.random.key(0), mesh, cfg)
+    step = make_train_step(mesh, cfg)
+    tokens = demo_batch(jax.random.key(1), 4, 32, cfg.vocab)
+    params, opt_state, loss = step(params, opt_state, tokens)
+    assert bool(jnp.isfinite(loss))
+
+
+def test_seq_parallel_loss_matches_dense():
+    """Ring-attention loss == full-attention loss on identical params/data."""
+    cfg_sp = TransformerConfig(
+        vocab=32, d_model=16, n_layers=1, n_heads=2, d_ff=32, max_seq=16,
+        compute_dtype=jnp.float32, seq_parallel=True, remat=False,
+    )
+    cfg_dense = TransformerConfig(
+        vocab=32, d_model=16, n_layers=1, n_heads=2, d_ff=32, max_seq=16,
+        compute_dtype=jnp.float32, seq_parallel=False, remat=False,
+    )
+    mesh = make_mesh(MeshSpec(dp=1, fsdp=2, tp=2, sp=2))
+    params = init_params(jax.random.key(0), cfg_dense)
+    tokens = demo_batch(jax.random.key(1), 2, 16, cfg_dense.vocab)
+    dense = loss_fn(params, tokens, cfg_dense)
+    sp = loss_fn(shard_params(params, mesh, cfg_sp), tokens, cfg_sp, mesh)
+    np.testing.assert_allclose(float(sp), float(dense), rtol=1e-5)
+
+
+def test_mnist_learns():
+    loss = mnist.train(steps=40, batch=128)
+    assert loss < 0.5
+
+
+def test_mnist_dp_mesh():
+    mesh = make_mesh(MeshSpec(dp=8))
+    loss = mnist.train(steps=10, batch=64, mesh=mesh)
+    assert np.isfinite(loss)
