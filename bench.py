@@ -1,0 +1,130 @@
+"""North-star benchmark: pod Allocate() p50 latency through the full stack.
+
+Drives the complete admission path on one simulated 4-chip x 32 GiB host
+(BASELINE.md config 1/3 shape): in-process fake kubelet grants fake-device
+IDs over **real gRPC on a unix socket** to the real plugin server, whose
+ClusterAllocator lists pending pods from an in-process apiserver over
+**real HTTP**, matches the pod, first-fit binpacks the chip, and persists
+annotations with a strategic-merge PATCH — the reference's hot path
+(``allocate.go:27-134``) end to end, nothing mocked below the wire.
+
+Prints ONE JSON line:
+    {"metric": "allocate_p50_latency", "value": <ms>, "unit": "ms",
+     "vs_baseline": <x>}
+
+The reference publishes no benchmark numbers at all (README.md:1-16;
+BASELINE.json "published": {}). The only latency anchor in its code is the
+allocate-path kubelet-poll retry tick of 100 ms (``podmanager.go:26,143-147``)
+— the granularity its own Allocate() tolerates — so ``vs_baseline`` is
+reported as 100 ms / p50 (higher is better, >1 means finer than the
+reference's own retry tick). Secondary numbers (p99, throughput, final HBM
+binpack utilization) go to stderr.
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent / "tests"))
+
+from gpushare_device_plugin_tpu import const
+from gpushare_device_plugin_tpu.allocator.cluster import ClusterAllocator
+from gpushare_device_plugin_tpu.cluster.apiserver import ApiServerClient
+from gpushare_device_plugin_tpu.cluster.podsource import ApiServerPodSource
+from gpushare_device_plugin_tpu.device import DeviceInventory
+from gpushare_device_plugin_tpu.discovery import MockBackend
+from gpushare_device_plugin_tpu.plugin import PluginConfig, TpuSharePlugin
+
+from fake_apiserver import FakeApiServer
+from fake_kubelet import FakeKubelet
+from k8s_fixtures import make_pod
+
+NODE = "bench-node"
+CHIPS = 4
+HBM_GIB = 32
+ROUNDS = 20
+# Pod sizes per fill round: [16,8,4,2,2] fills one 32-unit chip exactly;
+# four repetitions pack the host 128/128 (first-fit lands them chip by chip).
+POD_SIZES = [16, 8, 4, 2, 2] * CHIPS
+
+
+def main() -> None:
+    tmp = tempfile.mkdtemp(prefix="tpushare-bench-")
+    api = FakeApiServer()
+    api.add_node(NODE)
+    api.start()
+    kubelet = FakeKubelet(tmp)
+    kubelet.start()
+
+    client = ApiServerClient(api.url)
+    inv = DeviceInventory(MockBackend(num_chips=CHIPS, hbm_bytes=HBM_GIB << 30).chips())
+    allocator = ClusterAllocator(
+        inv, client, ApiServerPodSource(client, NODE), NODE
+    )
+    plugin = TpuSharePlugin(
+        inv, allocate_fn=allocator.allocate, config=PluginConfig(plugin_dir=tmp)
+    )
+    plugin.serve()
+    reg = kubelet.wait_for_registration()
+    assert reg.resource_name == const.RESOURCE_MEM
+
+    latencies: list[float] = []
+    units_per_chip = inv.units_by_index()
+    total_units = sum(units_per_chip.values())
+    peak_used = 0
+    pod_seq = 0
+    t_all0 = time.perf_counter()
+    for _ in range(ROUNDS):
+        running: list[str] = []
+        used = 0
+        for size in POD_SIZES:
+            name = f"bench-{pod_seq}"
+            pod_seq += 1
+            api.add_pod(make_pod(name, size, node=NODE))
+            t0 = time.perf_counter()
+            resp = kubelet.allocate(reg.endpoint, [[f"g{i}" for i in range(size)]])
+            latencies.append((time.perf_counter() - t0) * 1e3)
+            assert resp.container_responses[0].envs[const.ENV_TPU_VISIBLE_CHIPS]
+            # kubelet starts the container: phase Running, so the next
+            # allocation's usage accounting sees this pod.
+            api.pods[("default", name)]["status"]["phase"] = "Running"
+            running.append(name)
+            used += size
+        peak_used = max(peak_used, used)
+        # Fill round complete: workload pods finish, host drains.
+        for name in running:
+            api.pods.pop(("default", name), None)
+    wall = time.perf_counter() - t_all0
+
+    plugin.stop()
+    kubelet.stop()
+    api.stop()
+
+    p50 = statistics.median(latencies)
+    p99 = statistics.quantiles(latencies, n=100)[98]
+    util = 100.0 * peak_used / total_units
+    print(
+        f"pods={len(latencies)} p50={p50:.3f}ms p99={p99:.3f}ms "
+        f"throughput={len(latencies) / wall:.1f} pods/s "
+        f"peak_binpack_utilization={util:.1f}%",
+        file=sys.stderr,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "allocate_p50_latency",
+                "value": round(p50, 3),
+                "unit": "ms",
+                "vs_baseline": round(100.0 / p50, 1),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

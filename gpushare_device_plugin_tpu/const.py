@@ -50,6 +50,7 @@ ENV_TPU_CHIPS_PER_PROCESS_BOUNDS = "TPU_CHIPS_PER_PROCESS_BOUNDS"
 # fence, the runtime must self-limit).
 ENV_XLA_MEM_FRACTION = "TPU_HBM_LIMIT_FRACTION"
 ENV_XLA_PYTHON_MEM_FRACTION = "XLA_PYTHON_CLIENT_MEM_FRACTION"
+ENV_XLA_PYTHON_PREALLOCATE = "XLA_PYTHON_CLIENT_PREALLOCATE"
 
 # Node label that disables the cooperative HBM cap (reference: const.go:35,
 # label cgpu.disable.isolation=true read at podmanager.go:59-72).

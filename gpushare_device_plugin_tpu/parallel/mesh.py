@@ -46,10 +46,10 @@ class MeshSpec:
     def auto(cls, n_devices: int, *, max_tp: int = 4, want_sp: bool = False) -> "MeshSpec":
         """Factor ``n_devices`` into a sensible (dp, fsdp, tp[, sp]) shape.
 
-        Heuristic, TPU-flavored: tp gets the smallest power-of-two up to
-        ``max_tp`` (tp collectives are the most latency-sensitive, keep the
-        group small/ICI-adjacent); sp (when requested) takes a factor of 2;
-        fsdp absorbs the rest; dp only appears when fsdp would exceed 8.
+        Heuristic, TPU-flavored: tp greedily takes the largest power-of-two
+        factor up to ``max_tp`` (bounded so tp collectives stay
+        ICI-adjacent); sp (when requested) takes a factor of 2; fsdp absorbs
+        the rest; dp only appears when fsdp would exceed 8.
         """
         rem = n_devices
         tp = 1

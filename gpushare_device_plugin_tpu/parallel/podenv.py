@@ -94,21 +94,25 @@ def configure_jax_from_env(
     *,
     headroom: float = 0.95,
 ) -> dict[str, str]:
-    """Compute (and apply to ``os.environ``) the JAX/XLA client settings.
+    """Compute the JAX/XLA client settings from the injected env.
 
-    Returns the dict of settings so callers (and tests) can inspect them.
+    With ``env=None`` (the in-pod case) the settings are also applied to
+    ``os.environ``; with an explicit mapping the call is pure — inspection
+    and tests don't pollute the process environment.
+
     ``headroom`` shaves the cooperative cap so two co-scheduled pods whose
     fractions sum to 1.0 don't collide on allocator slack — the fractional
     sharing here is cooperative, exactly like the reference's GPU memory
     sharing (no hardware fence; SURVEY.md section 7 "hard parts" (d)).
     """
+    apply = env is None
     pod = PodTpuEnv.from_env(env)
     settings: dict[str, str] = {}
     if not pod.exclusive:
-        settings["XLA_PYTHON_CLIENT_MEM_FRACTION"] = f"{pod.hbm_fraction * headroom:.3f}"
+        settings[const.ENV_XLA_PYTHON_MEM_FRACTION] = f"{pod.hbm_fraction * headroom:.3f}"
         # Pre-allocating the full fraction up-front keeps co-tenants honest:
         # a pod that exceeds its slice OOMs itself, not its neighbor.
-        settings["XLA_PYTHON_CLIENT_PREALLOCATE"] = "true"
+        settings[const.ENV_XLA_PYTHON_PREALLOCATE] = "true"
     if pod.process_bounds:
         settings[const.ENV_TPU_PROCESS_BOUNDS] = pod.process_bounds
     if pod.chips_per_process_bounds:
@@ -117,6 +121,7 @@ def configure_jax_from_env(
         settings[const.ENV_TPU_VISIBLE_CHIPS] = ",".join(
             str(i) for i in pod.visible_chips
         )
-    for k, v in settings.items():
-        os.environ[k] = v
+    if apply:
+        for k, v in settings.items():
+            os.environ[k] = v
     return settings

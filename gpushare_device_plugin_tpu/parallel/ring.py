@@ -36,7 +36,7 @@ def _online_update(o, m, l, s, v):
     # below produce exact zeros instead of NaN ((-inf) - (-inf)).
     m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
     p = jnp.exp(s - m_safe[..., None])  # [B,H,Tq,Tk]
-    alpha = jnp.exp(jnp.where(jnp.isneginf(m), -jnp.inf, m - m_safe))
+    alpha = jnp.exp(m - m_safe)  # m_safe is finite, so m=-inf -> alpha=0
     l_new = alpha * l + jnp.sum(p, axis=-1)
     o_new = alpha[..., None] * o + jnp.einsum("bhqk,bkhd->bhqd", p, v)
     return o_new, m_new, l_new

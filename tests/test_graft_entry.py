@@ -1,0 +1,20 @@
+"""Driver contract: __graft_entry__.entry / dryrun_multichip."""
+
+import sys
+from pathlib import Path
+
+import jax
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import __graft_entry__ as graft  # noqa: E402
+
+
+def test_entry_compiles_and_runs():
+    fn, args = graft.entry()
+    out = jax.jit(fn)(*args)
+    assert out.shape == (4, 128, 256)
+
+
+def test_dryrun_multichip_8():
+    graft.dryrun_multichip(8)
