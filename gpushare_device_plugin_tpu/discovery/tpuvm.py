@@ -57,6 +57,10 @@ class TpuVmBackend:
     ):
         self._dev_glob = dev_glob
         self._vfio_glob = vfio_glob
+        # An explicit env dict makes metadata lookups hermetic: the native
+        # shim reads the *process* env, so its metadata-derived values
+        # (HBM) are only trusted when no override dict was given.
+        self._env_overridden = env is not None
         self._env = env if env is not None else dict(os.environ)
         self._native = None
         self._native_lib = native_lib
@@ -103,11 +107,12 @@ class TpuVmBackend:
                 return int(override) << 30
             except ValueError:
                 pass  # garbled operator env: fall through to real sources
-        native = self._load_native()
-        if native is not None:
-            hbm = native.hbm_bytes_per_chip()
-            if hbm > 0:
-                return hbm
+        if not self._env_overridden:
+            native = self._load_native()
+            if native is not None:
+                hbm = native.hbm_bytes_per_chip()
+                if hbm > 0:
+                    return hbm
         gen, _ = parse_accelerator_type(self._accel_type())
         return HBM_BY_GENERATION.get(gen, 16 << 30)
 

@@ -1,0 +1,249 @@
+/* libtpuinfo implementation. See tpuinfo.h for the contract and
+ * SURVEY.md section 2 ("Native components") for the reference mapping. */
+
+#include "tpuinfo.h"
+
+#include <dirent.h>
+#include <dlfcn.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/stat.h>
+
+#include <algorithm>
+#include <cstdint>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Generation {
+  const char* name;
+  int64_t hbm_bytes;
+};
+
+/* Public Cloud TPU per-chip HBM specs (mirrors discovery/tpuvm.py). */
+const Generation kGenerations[] = {
+    {"v2", 8LL << 30},   {"v3", 16LL << 30},       {"v4", 32LL << 30},
+    {"v5e", 16LL << 30}, {"v5litepod", 16LL << 30}, {"v5p", 95LL << 30},
+    {"v6e", 32LL << 30},
+};
+
+std::mutex g_mu;
+bool g_initialized = false;
+void* g_libtpu = nullptr;
+bool g_libtpu_tried = false;
+std::vector<tpuinfo_chip_t> g_chips;
+int64_t g_hbm_bytes = 0;
+char g_error[256] = "";
+char g_generation[32] = "";
+
+void set_error(const char* msg) {
+  snprintf(g_error, sizeof(g_error), "%s", msg);
+}
+
+std::string env_or(const char* key, const char* fallback) {
+  const char* v = getenv(key);
+  return v && *v ? v : fallback;
+}
+
+/* "v5e-8" / "v4-32" -> generation prefix before the dash. */
+std::string parse_generation() {
+  std::string accel = env_or("TPU_ACCELERATOR_TYPE", "");
+  if (accel.empty()) accel = env_or("ACCELERATOR_TYPE", "");
+  size_t dash = accel.find('-');
+  if (dash == std::string::npos) return "";
+  return accel.substr(0, dash);
+}
+
+int64_t hbm_from_generation(const std::string& gen) {
+  for (const auto& g : kGenerations)
+    if (gen == g.name) return g.hbm_bytes;
+  return 0;
+}
+
+/* Numeric suffix of "accel7" -> 7; -1 when the name doesn't match. */
+int accel_index(const char* name, const char* prefix) {
+  size_t plen = strlen(prefix);
+  if (strncmp(name, prefix, plen) != 0) return -1;
+  const char* digits = name + plen;
+  if (!*digits) return -1;
+  for (const char* p = digits; *p; ++p)
+    if (*p < '0' || *p > '9') return -1;
+  return atoi(digits);
+}
+
+/* Scan <root> for entries named <prefix><N>. Returns sorted indices. */
+std::vector<int> scan_dir(const std::string& root, const char* prefix) {
+  std::vector<int> found;
+  DIR* d = opendir(root.c_str());
+  if (!d) return found;
+  while (struct dirent* e = readdir(d)) {
+    int idx = accel_index(e->d_name, prefix);
+    if (idx >= 0) found.push_back(idx);
+  }
+  closedir(d);
+  std::sort(found.begin(), found.end());
+  return found;
+}
+
+/* Read an integer out of a sysfs file; 0 on any failure. */
+int64_t read_sysfs_int(const std::string& path) {
+  FILE* f = fopen(path.c_str(), "r");
+  if (!f) return 0;
+  long long v = 0;
+  int n = fscanf(f, "%lld", &v);
+  fclose(f);
+  return n == 1 && v > 0 ? (int64_t)v : 0;
+}
+
+void try_load_libtpu() {
+  if (g_libtpu_tried) return;
+  g_libtpu_tried = true;
+  std::string path = env_or("TPUINFO_LIBTPU_PATH", "libtpu.so");
+  /* Lazy, optional — the nvml_dl.c pattern: absence is not an error,
+   * the host simply has no TPU runtime installed. */
+  g_libtpu = dlopen(path.c_str(), RTLD_LAZY | RTLD_LOCAL);
+}
+
+int64_t discover_hbm(const std::string& sysfs_root, const std::vector<int>& chips,
+                     bool accel_style) {
+  /* 1. operator override */
+  std::string override_gib = env_or("TPUSHARE_HBM_GIB", "");
+  if (!override_gib.empty()) {
+    long long gib = atoll(override_gib.c_str());
+    if (gib > 0) return gib << 30;
+  }
+  /* 2. sysfs (accel driver), first chip: chips are homogeneous per host.
+   * Only meaningful for accel-numbered devices — vfio group numbers do
+   * not key /sys/class/accel. */
+  if (accel_style && !chips.empty()) {
+    char path[1024];
+    snprintf(path, sizeof(path), "%s/class/accel/accel%d/device/hbm_bytes",
+             sysfs_root.c_str(), chips[0]);
+    int64_t v = read_sysfs_int(path);
+    if (v > 0) return v;
+  }
+  /* 3. generation table */
+  return hbm_from_generation(g_generation);
+}
+
+int rescan_locked() {
+  std::string dev_root = env_or("TPUINFO_DEV_ROOT", "/dev");
+  std::string sysfs_root = env_or("TPUINFO_SYSFS_ROOT", "/sys");
+  std::string gen = parse_generation();
+  snprintf(g_generation, sizeof(g_generation), "%s", gen.c_str());
+
+  g_chips.clear();
+  std::vector<int> indices = scan_dir(dev_root, "accel");
+  bool accel_style = !indices.empty();
+  const char* fmt = "%s/accel%d";
+  if (indices.empty()) {
+    indices = scan_dir(dev_root + "/vfio", "");
+    fmt = "%s/vfio/%d";
+  }
+  g_hbm_bytes = discover_hbm(sysfs_root, indices, accel_style);
+  for (size_t i = 0; i < indices.size(); ++i) {
+    tpuinfo_chip_t chip;
+    memset(&chip, 0, sizeof(chip));
+    /* Key index and id on the device number, not the scan position:
+     * sparse numbering (accel1 lost to a driver reset) must not renumber
+     * the surviving chips across rescans. */
+    chip.index = (int32_t)indices[i];
+    chip.hbm_bytes = g_hbm_bytes;
+    int n = snprintf(chip.device_path, sizeof(chip.device_path), fmt,
+                     dev_root.c_str(), indices[i]);
+    if (n < 0 || (size_t)n >= sizeof(chip.device_path)) {
+      set_error("device path truncated (dev root too long)");
+      g_chips.clear();
+      return TPUINFO_ERR_BAD_INDEX;
+    }
+    snprintf(chip.id, sizeof(chip.id), "tpu-%s-chip%d",
+             gen.empty() ? "unknown" : gen.c_str(), indices[i]);
+    g_chips.push_back(chip);
+  }
+  return TPUINFO_OK;
+}
+
+}  // namespace
+
+extern "C" {
+
+int tpuinfo_init(void) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  try_load_libtpu();
+  int rc = rescan_locked();
+  g_initialized = (rc == TPUINFO_OK);
+  return rc;
+}
+
+int tpuinfo_rescan(void) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  if (!g_initialized) {
+    set_error("tpuinfo_rescan before tpuinfo_init");
+    return TPUINFO_ERR_NOT_INITIALIZED;
+  }
+  return rescan_locked();
+}
+
+int tpuinfo_chip_count(void) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  return g_initialized ? (int)g_chips.size() : TPUINFO_ERR_NOT_INITIALIZED;
+}
+
+int tpuinfo_chip(int i, tpuinfo_chip_t* out) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  if (!g_initialized) {
+    set_error("tpuinfo_chip before tpuinfo_init");
+    return TPUINFO_ERR_NOT_INITIALIZED;
+  }
+  if (i < 0 || (size_t)i >= g_chips.size() || out == nullptr) {
+    set_error("chip index out of range");
+    return TPUINFO_ERR_BAD_INDEX;
+  }
+  *out = g_chips[i];
+  return TPUINFO_OK;
+}
+
+int64_t tpuinfo_hbm_bytes_per_chip(void) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  return g_initialized ? g_hbm_bytes : 0;
+}
+
+int tpuinfo_runtime_healthy(void) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  if (!g_initialized) return 0;
+  /* Health = every discovered device file still present. A vanished
+   * /dev/accel<N> (driver reset, maintenance event) is the TPU analog of
+   * an NVML XID critical event (nvidia.go:121-152). libtpu being loaded
+   * is informative but not required: discovery must work in the plugin
+   * container where only device files are mounted. */
+  struct stat st;
+  for (const auto& chip : g_chips)
+    if (stat(chip.device_path, &st) != 0) return 0;
+  return 1;
+}
+
+int tpuinfo_libtpu_loaded(void) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  return g_libtpu != nullptr ? 1 : 0;
+}
+
+const char* tpuinfo_error(void) { return g_error; }
+
+const char* tpuinfo_generation(void) { return g_generation; }
+
+void tpuinfo_shutdown(void) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  if (g_libtpu) {
+    dlclose(g_libtpu);
+    g_libtpu = nullptr;
+  }
+  g_libtpu_tried = false;
+  g_chips.clear();
+  g_initialized = false;
+  g_error[0] = '\0';
+}
+
+}  /* extern "C" */

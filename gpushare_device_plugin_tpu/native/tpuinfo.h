@@ -1,0 +1,79 @@
+/* libtpuinfo — TPU chip discovery shim, C ABI.
+ *
+ * TPU-native counterpart of the reference's NVML dlopen shim
+ * (vendor/.../nvml_dl.c:21-27): the DaemonSet image must run on ANY node,
+ * so libtpu is dlopen'd lazily and every capability degrades gracefully —
+ * on a non-TPU node tpuinfo_init() succeeds with zero chips and the Go/C++
+ * caller parks, mirroring gpumanager.go:36-47's wait-forever behavior.
+ *
+ * Discovery sources, in order:
+ *   1. device files   <dev_root>/accel<N> (TPU-VM v4+) or <dev_root>/vfio/<N>
+ *   2. sysfs          <sysfs_root>/class/accel/accel<N>/device/... (HBM, when
+ *                     the accel driver exposes it)
+ *   3. env            TPU_ACCELERATOR_TYPE / ACCELERATOR_TYPE generation
+ *                     table, TPUSHARE_HBM_GIB override
+ *   4. libtpu.so      liveness only (dlopen + symbol probe) — the runtime
+ *                     health signal, the analog of NVML XID watching.
+ *
+ * Roots are overridable via TPUINFO_DEV_ROOT / TPUINFO_SYSFS_ROOT /
+ * TPUINFO_LIBTPU_PATH so the whole shim is testable on any machine.
+ */
+
+#ifndef TPUSHARE_TPUINFO_H_
+#define TPUSHARE_TPUINFO_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#define TPUINFO_API __attribute__((visibility("default")))
+
+#define TPUINFO_OK 0
+#define TPUINFO_ERR_NOT_INITIALIZED -1
+#define TPUINFO_ERR_BAD_INDEX -2
+
+typedef struct tpuinfo_chip {
+  int32_t index;          /* device number N of /dev/accel<N> (stable) */
+  int64_t hbm_bytes;      /* total HBM; 0 = unknown */
+  char device_path[512];  /* /dev/accel<N> or /dev/vfio/<N> */
+  char id[64];            /* stable id keyed on N, e.g. "tpu-v5e-chip2" */
+} tpuinfo_chip_t;
+
+/* Scan devices, read metadata, lazily try libtpu. Never fails on a
+ * TPU-less host; returns TPUINFO_OK with chip_count()==0. Idempotent. */
+TPUINFO_API int tpuinfo_init(void);
+
+/* Number of chips found by the last init/rescan. */
+TPUINFO_API int tpuinfo_chip_count(void);
+
+/* Fill *out for chip i. */
+TPUINFO_API int tpuinfo_chip(int i, tpuinfo_chip_t* out);
+
+/* HBM per chip in bytes (chips are homogeneous on a host); 0 = unknown. */
+TPUINFO_API int64_t tpuinfo_hbm_bytes_per_chip(void);
+
+/* 1 if the TPU runtime looks usable: libtpu loadable (when present) and
+ * every discovered device file still exists. 0 otherwise. */
+TPUINFO_API int tpuinfo_runtime_healthy(void);
+
+/* 1 if libtpu.so was dlopen'd successfully. */
+TPUINFO_API int tpuinfo_libtpu_loaded(void);
+
+/* Re-scan device files (chips can appear after late driver init). */
+TPUINFO_API int tpuinfo_rescan(void);
+
+/* Last error string (static storage), "" if none. */
+TPUINFO_API const char* tpuinfo_error(void);
+
+/* Accelerator generation string, e.g. "v5e"; "" if unknown. */
+TPUINFO_API const char* tpuinfo_generation(void);
+
+TPUINFO_API void tpuinfo_shutdown(void);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* TPUSHARE_TPUINFO_H_ */

@@ -1,0 +1,123 @@
+"""ctypes loader for the libtpuinfo C++ shim (``tpuinfo.cpp``).
+
+The Python side of the native boundary — analogous to the reference's cgo
+``bindings.go`` over ``nvml_dl.c``. ``load()`` returns a :class:`NativeTpuInfo`
+or raises; callers treat the shim as optional (``discovery/tpuvm.py``
+falls back to pure-Python enumeration when loading fails), mirroring the
+reference's build trick of linking with unresolved symbols allowed
+(``Dockerfile:8``) so images run on driverless nodes.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import dataclasses
+import os
+from pathlib import Path
+
+ENV_LIBRARY = "TPUINFO_LIBRARY"
+_DEFAULT_NAME = "libtpuinfo.so"
+
+
+class _ChipStruct(ctypes.Structure):
+    _fields_ = [
+        ("index", ctypes.c_int32),
+        ("hbm_bytes", ctypes.c_int64),
+        ("device_path", ctypes.c_char * 512),
+        ("id", ctypes.c_char * 64),
+    ]
+
+
+@dataclasses.dataclass(frozen=True)
+class NativeChip:
+    index: int
+    hbm_bytes: int
+    device_path: str
+    id: str
+
+
+class NativeTpuInfo:
+    """Owned handle over an initialized libtpuinfo."""
+
+    def __init__(self, lib: ctypes.CDLL):
+        self._lib = lib
+        lib.tpuinfo_init.restype = ctypes.c_int
+        lib.tpuinfo_chip_count.restype = ctypes.c_int
+        lib.tpuinfo_chip.restype = ctypes.c_int
+        lib.tpuinfo_chip.argtypes = [ctypes.c_int, ctypes.POINTER(_ChipStruct)]
+        lib.tpuinfo_hbm_bytes_per_chip.restype = ctypes.c_int64
+        lib.tpuinfo_runtime_healthy.restype = ctypes.c_int
+        lib.tpuinfo_libtpu_loaded.restype = ctypes.c_int
+        lib.tpuinfo_rescan.restype = ctypes.c_int
+        lib.tpuinfo_error.restype = ctypes.c_char_p
+        lib.tpuinfo_generation.restype = ctypes.c_char_p
+        rc = lib.tpuinfo_init()
+        if rc != 0:
+            raise OSError(f"tpuinfo_init failed: rc={rc} {self.error()}")
+
+    def error(self) -> str:
+        return (self._lib.tpuinfo_error() or b"").decode()
+
+    def generation(self) -> str:
+        return (self._lib.tpuinfo_generation() or b"").decode()
+
+    def chip_count(self) -> int:
+        return max(0, self._lib.tpuinfo_chip_count())
+
+    def chips(self) -> list[NativeChip]:
+        out = []
+        for i in range(self.chip_count()):
+            c = _ChipStruct()
+            if self._lib.tpuinfo_chip(i, ctypes.byref(c)) == 0:
+                out.append(
+                    NativeChip(
+                        index=c.index,
+                        hbm_bytes=c.hbm_bytes,
+                        device_path=c.device_path.decode(),
+                        id=c.id.decode(),
+                    )
+                )
+        return out
+
+    def hbm_bytes_per_chip(self) -> int:
+        return self._lib.tpuinfo_hbm_bytes_per_chip()
+
+    def runtime_healthy(self) -> bool:
+        return bool(self._lib.tpuinfo_runtime_healthy())
+
+    def libtpu_loaded(self) -> bool:
+        return bool(self._lib.tpuinfo_libtpu_loaded())
+
+    def rescan(self) -> None:
+        self._lib.tpuinfo_rescan()
+
+    def shutdown(self) -> None:
+        self._lib.tpuinfo_shutdown()
+
+
+def _candidates() -> list[str]:
+    paths = []
+    env = os.environ.get(ENV_LIBRARY)
+    if env:
+        paths.append(env)
+    paths.append(str(Path(__file__).resolve().parent / _DEFAULT_NAME))
+    paths.append(_DEFAULT_NAME)  # system search path
+    return paths
+
+
+def load(path: str | None = None) -> NativeTpuInfo:
+    """Load + init libtpuinfo.
+
+    An explicit ``path`` is authoritative (no fallback — a caller that
+    names a library wants exactly that library); otherwise try
+    ``$TPUINFO_LIBRARY``, the package dir, then the system search path.
+    """
+    if path:
+        return NativeTpuInfo(ctypes.CDLL(path))
+    last_err: Exception | None = None
+    for cand in _candidates():
+        try:
+            return NativeTpuInfo(ctypes.CDLL(cand))
+        except OSError as e:
+            last_err = e
+    raise OSError(f"libtpuinfo not loadable: {last_err}")

@@ -1,0 +1,140 @@
+"""libtpuinfo C++ shim: build, ctypes load, enumeration, health, fallback.
+
+Builds the shared library with the in-tree Makefile (skipped when no C++
+toolchain is available) and exercises it against a fabricated /dev +
+/sys tree — the native analog of the mock discovery backend.
+"""
+
+import shutil
+import subprocess
+from pathlib import Path
+
+import pytest
+
+from gpushare_device_plugin_tpu.discovery.tpuvm import TpuVmBackend
+from gpushare_device_plugin_tpu.native import tpuinfo
+
+NATIVE_DIR = Path(__file__).resolve().parent.parent / "gpushare_device_plugin_tpu" / "native"
+
+
+@pytest.fixture(scope="module")
+def libpath():
+    cxx = next((c for c in ("g++", "c++") if shutil.which(c)), None)
+    if cxx is None:
+        pytest.skip("no C++ toolchain")
+    subprocess.run(["make", "-s", "-C", str(NATIVE_DIR), f"CXX={cxx}"], check=True)
+    return str(NATIVE_DIR / "libtpuinfo.so")
+
+
+@pytest.fixture
+def fake_host(tmp_path, monkeypatch):
+    """4 accel device files + sysfs HBM of 32 GiB, v5e metadata."""
+    dev = tmp_path / "dev"
+    dev.mkdir()
+    for i in range(4):
+        (dev / f"accel{i}").touch()
+    sysdev = tmp_path / "sys/class/accel/accel0/device"
+    sysdev.mkdir(parents=True)
+    (sysdev / "hbm_bytes").write_text(str(32 << 30))
+    monkeypatch.setenv("TPUINFO_DEV_ROOT", str(dev))
+    monkeypatch.setenv("TPUINFO_SYSFS_ROOT", str(tmp_path / "sys"))
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5e-8")
+    monkeypatch.delenv("TPUSHARE_HBM_GIB", raising=False)
+    return dev
+
+
+def test_enumerates_chips(libpath, fake_host):
+    n = tpuinfo.load(libpath)
+    try:
+        chips = n.chips()
+        assert [c.index for c in chips] == [0, 1, 2, 3]
+        assert chips[0].device_path == str(fake_host / "accel0")
+        assert chips[0].id == "tpu-v5e-chip0"
+        # sysfs value (32 GiB) beats the v5e generation table (16 GiB)
+        assert n.hbm_bytes_per_chip() == 32 << 30
+        assert n.generation() == "v5e"
+    finally:
+        n.shutdown()
+
+
+def test_health_tracks_device_files(libpath, fake_host):
+    n = tpuinfo.load(libpath)
+    try:
+        assert n.runtime_healthy()
+        (fake_host / "accel1").unlink()
+        assert not n.runtime_healthy()
+        (fake_host / "accel1").touch()
+        assert n.runtime_healthy()
+    finally:
+        n.shutdown()
+
+
+def test_generation_table_fallback(libpath, fake_host, monkeypatch):
+    """No sysfs entry -> per-generation HBM table."""
+    monkeypatch.setenv("TPUINFO_SYSFS_ROOT", "/nonexistent")
+    n = tpuinfo.load(libpath)
+    try:
+        assert n.hbm_bytes_per_chip() == 16 << 30  # v5e
+    finally:
+        n.shutdown()
+
+
+def test_hbm_env_override_wins(libpath, fake_host, monkeypatch):
+    monkeypatch.setenv("TPUSHARE_HBM_GIB", "8")
+    n = tpuinfo.load(libpath)
+    try:
+        assert n.hbm_bytes_per_chip() == 8 << 30
+    finally:
+        n.shutdown()
+
+
+def test_tpu_less_host_zero_chips(libpath, tmp_path, monkeypatch):
+    """init succeeds with no devices — the park-forever contract."""
+    (tmp_path / "dev").mkdir()
+    monkeypatch.setenv("TPUINFO_DEV_ROOT", str(tmp_path / "dev"))
+    monkeypatch.setenv("TPUINFO_SYSFS_ROOT", str(tmp_path))
+    monkeypatch.delenv("TPU_ACCELERATOR_TYPE", raising=False)
+    monkeypatch.delenv("ACCELERATOR_TYPE", raising=False)
+    n = tpuinfo.load(libpath)
+    try:
+        assert n.chip_count() == 0
+        assert n.hbm_bytes_per_chip() == 0
+    finally:
+        n.shutdown()
+
+
+def test_rescan_picks_up_new_chip(libpath, fake_host):
+    n = tpuinfo.load(libpath)
+    try:
+        assert n.chip_count() == 4
+        (fake_host / "accel4").touch()
+        n.rescan()
+        assert n.chip_count() == 5
+    finally:
+        n.shutdown()
+
+
+def test_tpuvm_backend_uses_native_hbm(libpath, fake_host, monkeypatch):
+    """TpuVmBackend (process env, no override dict) prefers the shim's
+    sysfs-derived HBM over its own generation table."""
+    monkeypatch.setenv("ACCELERATOR_TYPE", "v5e-8")  # table would say 16 GiB
+    be = TpuVmBackend(dev_glob=str(fake_host / "accel*"), native_lib=libpath)
+    chips = be.chips()
+    assert len(chips) == 4
+    assert chips[0].hbm_bytes == 32 << 30  # sysfs via native shim
+
+
+def test_tpuvm_backend_env_dict_is_hermetic(libpath, fake_host):
+    """An explicit env dict must not be bypassed by the native shim's
+    process-env metadata (testability contract of TpuVmBackend)."""
+    be = TpuVmBackend(
+        dev_glob=str(fake_host / "accel*"),
+        native_lib=libpath,
+        env={"ACCELERATOR_TYPE": "v3-8"},
+    )
+    assert be.chips()[0].hbm_bytes == 16 << 30  # v3 table, not shim's 32 GiB sysfs
+
+
+def test_load_failure_raises(tmp_path):
+    with pytest.raises(OSError):
+        tpuinfo.load(str(tmp_path / "missing.so"))
