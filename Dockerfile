@@ -1,0 +1,27 @@
+# Two-stage build, reference analog Dockerfile:1-24 — but with ZERO GPU
+# dependency: no nvidia runtime, no driver-time link tricks. The native
+# libtpuinfo shim dlopen()s libtpu lazily at runtime (tpuinfo.cpp), so the
+# same image runs on TPU-VM nodes and plain CPU nodes (where the daemon
+# simply parks, gpumanager.go:36-47 semantics).
+
+FROM python:3.12-slim AS builder
+RUN apt-get update && apt-get install -y --no-install-recommends g++ make \
+    && rm -rf /var/lib/apt/lists/*
+WORKDIR /src
+COPY . .
+# python:3.12 images ship pip without setuptools; preinstall the build
+# backend since --no-build-isolation skips build requirements.
+# [jax] extra: the demo pods run JAX workloads from this same image.
+RUN pip install --no-cache-dir setuptools wheel \
+    && make -C gpushare_device_plugin_tpu/native \
+    && pip install --no-cache-dir --prefix=/install --no-build-isolation ".[jax]"
+
+FROM python:3.12-slim
+# grpcio + protobuf come from the wheel install in the builder stage.
+COPY --from=builder /install /usr/local
+COPY --from=builder /src/gpushare_device_plugin_tpu/native/libtpuinfo.so \
+    /usr/local/lib/python3.12/site-packages/gpushare_device_plugin_tpu/native/libtpuinfo.so
+ENV PYTHONUNBUFFERED=1
+# The daemon; the same image serves the extender and the inspect CLI
+# (command: overrides in the manifests).
+ENTRYPOINT ["tpushare-device-plugin"]

@@ -174,7 +174,10 @@ class TpuVmBackend:
         seen: dict[str, str] = {}  # chip id -> device path, sticky
         native_ok = True
         while not stop():
-            native = self._load_native()
+            # Same hermeticity gate as _hbm_bytes: the shim reads the
+            # process env, so its health feed is only meaningful when this
+            # backend does too.
+            native = None if self._env_overridden else self._load_native()
             if native is not None:
                 ok = native.runtime_healthy()
                 if ok != native_ok:

@@ -89,7 +89,11 @@ class NativeTpuInfo:
         return bool(self._lib.tpuinfo_libtpu_loaded())
 
     def rescan(self) -> None:
-        self._lib.tpuinfo_rescan()
+        rc = self._lib.tpuinfo_rescan()
+        if rc != 0:
+            # A failed rescan clears the C-side chip list; surfacing the
+            # error beats silently de-advertising every chip.
+            raise OSError(f"tpuinfo_rescan failed: rc={rc} {self.error()}")
 
     def shutdown(self) -> None:
         self._lib.tpuinfo_shutdown()

@@ -1,0 +1,65 @@
+"""Deployment artifacts stay well-formed and wired to real entry points."""
+
+import importlib
+from pathlib import Path
+
+import pytest
+import yaml
+
+ROOT = Path(__file__).resolve().parent.parent
+
+MANIFESTS = sorted(
+    list((ROOT / "deploy").glob("*.yaml"))
+    + list((ROOT / "demo").glob("**/*.yaml"))
+)
+
+
+@pytest.mark.parametrize("path", MANIFESTS, ids=lambda p: str(p.relative_to(ROOT)))
+def test_manifest_parses(path):
+    docs = [d for d in yaml.safe_load_all(path.read_text()) if d]
+    assert docs, f"{path} is empty"
+    for doc in docs:
+        assert "kind" in doc and "apiVersion" in doc
+
+
+def test_daemonset_mounts_device_plugin_dir():
+    docs = list(yaml.safe_load_all((ROOT / "deploy/device-plugin-ds.yaml").read_text()))
+    ds = next(d for d in docs if d and d["kind"] == "DaemonSet")
+    spec = ds["spec"]["template"]["spec"]
+    paths = {v["hostPath"]["path"] for v in spec["volumes"]}
+    assert "/var/lib/kubelet/device-plugins" in paths
+    assert "/dev" in paths
+    assert spec["containers"][0]["command"][0] == "tpushare-device-plugin"
+
+
+def test_demo_pods_request_tpu_resources():
+    seen = set()
+    for path in (ROOT / "demo").glob("**/*.yaml"):
+        for doc in yaml.safe_load_all(path.read_text()):
+            if not doc or doc["kind"] not in ("StatefulSet", "Job"):
+                continue
+            spec = doc["spec"]["template"]["spec"]
+            limits = spec["containers"][0]["resources"]["limits"]
+            seen.update(limits)
+    assert "aliyun.com/tpu-mem" in seen
+    assert "aliyun.com/tpu-core" in seen
+
+
+def test_demo_commands_reference_importable_modules():
+    """Inline python in demo pods must only import modules that exist."""
+    for mod in (
+        "gpushare_device_plugin_tpu.parallel",
+        "gpushare_device_plugin_tpu.workloads.mnist",
+        "gpushare_device_plugin_tpu.workloads.transformer",
+    ):
+        importlib.import_module(mod)
+
+
+def test_console_scripts_importable():
+    import tomllib
+
+    scripts = tomllib.loads((ROOT / "pyproject.toml").read_text())["project"]["scripts"]
+    assert scripts, "no console scripts declared"
+    for target in scripts.values():
+        mod, func = target.split(":")
+        assert hasattr(importlib.import_module(mod), func)
