@@ -38,6 +38,9 @@ class FakeKubelet(RegistrationServicer):
         # resource name -> latest device list from ListAndWatch
         self.devices: dict[str, list[pb.Device]] = {}
         self.device_updates: "queue.Queue[tuple[str, list[pb.Device]]]" = queue.Queue()
+        # resource name -> FIFO of updates consumed off the shared queue while
+        # waiting for a different resource (see wait_for_devices)
+        self._unclaimed_updates: dict[str, list[list[pb.Device]]] = {}
 
     # --- Registration service -------------------------------------------
 
@@ -97,17 +100,31 @@ class FakeKubelet(RegistrationServicer):
     def wait_for_registration(self, timeout: float = 5.0) -> pb.RegisterRequest:
         return self.registrations.get(timeout=timeout)
 
-    def wait_for_devices(self, resource_name: str, timeout: float = 5.0) -> list[pb.Device]:
+    def wait_for_devices(self, resource_name: str, timeout: float = 10.0) -> list[pb.Device]:
+        """Consume the next update for `resource_name` from its stream.
+
+        Updates for *other* resources pulled off the shared queue are not
+        discarded (each ListAndWatch stream sends its initial list exactly
+        once, so dropping one would make a later wait for it hang): the
+        latest list per resource is kept in `self.devices`, and an update
+        seen here before it was asked for satisfies a later call.
+        """
         import time
 
+        pending = self._unclaimed_updates.get(resource_name)
+        if pending:
+            return pending.pop(0)
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             try:
-                name, devs = self.device_updates.get(timeout=deadline - time.monotonic())
+                name, devs = self.device_updates.get(
+                    timeout=max(0.0, deadline - time.monotonic())
+                )
             except queue.Empty:
                 break
             if name == resource_name:
                 return devs
+            self._unclaimed_updates.setdefault(name, []).append(devs)
         raise TimeoutError(f"no device update for {resource_name}")
 
     def allocate(
