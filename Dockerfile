@@ -4,19 +4,23 @@
 # same image runs on TPU-VM nodes and plain CPU nodes (where the daemon
 # simply parks, gpumanager.go:36-47 semantics).
 
-FROM python:3.12-slim AS builder
+# linux/amd64 only: jax[tpu]'s libtpu wheels are manylinux x86_64 (TPU-VM
+# hosts are x86_64); build with --platform=linux/amd64 on arm64 machines.
+FROM --platform=linux/amd64 python:3.12-slim AS builder
 RUN apt-get update && apt-get install -y --no-install-recommends g++ make \
     && rm -rf /var/lib/apt/lists/*
 WORKDIR /src
 COPY . .
 # python:3.12 images ship pip without setuptools; preinstall the build
 # backend since --no-build-isolation skips build requirements.
-# [jax] extra: the demo pods run JAX workloads from this same image.
+# [tpu] extra: the demo pods run JAX workloads from this same image, and
+# jax[tpu] ships the TPU PJRT plugin + libtpu so they actually see the
+# chips (plain jax would silently fall back to CPU on a TPU-VM node).
 RUN pip install --no-cache-dir setuptools wheel \
     && make -C gpushare_device_plugin_tpu/native \
-    && pip install --no-cache-dir --prefix=/install --no-build-isolation ".[jax]"
+    && pip install --no-cache-dir --prefix=/install --no-build-isolation ".[tpu]"
 
-FROM python:3.12-slim
+FROM --platform=linux/amd64 python:3.12-slim
 # grpcio + protobuf come from the wheel install in the builder stage.
 COPY --from=builder /install /usr/local
 COPY --from=builder /src/gpushare_device_plugin_tpu/native/libtpuinfo.so \

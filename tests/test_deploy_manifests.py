@@ -32,17 +32,36 @@ def test_daemonset_mounts_device_plugin_dir():
     assert spec["containers"][0]["command"][0] == "tpushare-device-plugin"
 
 
+def iter_demo_pod_specs():
+    """Yield (path, pod spec) for every demo workload's pod template."""
+    for path in sorted((ROOT / "demo").glob("**/*.yaml")):
+        for doc in yaml.safe_load_all(path.read_text()):
+            if not doc or doc["kind"] == "Service":
+                continue
+            if doc["kind"] == "Pod":
+                yield path, doc["spec"]
+            else:  # Job / StatefulSet / Deployment / ... all use a template
+                yield path, doc["spec"]["template"]["spec"]
+
+
 def test_demo_pods_request_tpu_resources():
     seen = set()
-    for path in (ROOT / "demo").glob("**/*.yaml"):
-        for doc in yaml.safe_load_all(path.read_text()):
-            if not doc or doc["kind"] not in ("StatefulSet", "Job"):
-                continue
-            spec = doc["spec"]["template"]["spec"]
-            limits = spec["containers"][0]["resources"]["limits"]
-            seen.update(limits)
+    for _, spec in iter_demo_pod_specs():
+        seen.update(spec["containers"][0]["resources"]["limits"])
     assert "aliyun.com/tpu-mem" in seen
     assert "aliyun.com/tpu-core" in seen
+
+
+def test_demo_pods_tolerate_tpu_taint():
+    """TPU node pools are tainted google.com/tpu:NoSchedule; tpu-mem/-core
+    requests don't trigger GKE's automatic toleration injection, so every
+    demo workload must carry the toleration explicitly or stay Pending."""
+    checked = 0
+    for path, spec in iter_demo_pod_specs():
+        keys = {t["key"] for t in spec.get("tolerations", [])}
+        assert "google.com/tpu" in keys, f"{path}: missing TPU taint toleration"
+        checked += 1
+    assert checked >= 3  # binpack StatefulSet + smoke Job + flagship Job
 
 
 def test_demo_commands_reference_importable_modules():
