@@ -1,0 +1,13 @@
+"""TPU Pallas kernels for workload hot ops.
+
+The reference schedules containers and has no compute kernels at all
+(SURVEY.md section 2: the only native code is the NVML cgo shim); the
+workloads *this* plugin co-schedules spend their FLOPs in attention, so the
+hot op gets a hand-written TPU kernel: a flash-attention forward/backward
+pair that streams K/V through VMEM instead of materializing the [S, S]
+score matrix in HBM.
+"""
+
+from .flash_attention import flash_attention
+
+__all__ = ["flash_attention"]

@@ -36,11 +36,11 @@ def iter_demo_pod_specs():
     """Yield (path, pod spec) for every demo workload's pod template."""
     for path in sorted((ROOT / "demo").glob("**/*.yaml")):
         for doc in yaml.safe_load_all(path.read_text()):
-            if not doc or doc["kind"] == "Service":
+            if not doc:
                 continue
             if doc["kind"] == "Pod":
                 yield path, doc["spec"]
-            else:  # Job / StatefulSet / Deployment / ... all use a template
+            elif "template" in doc.get("spec", {}):  # Job/StatefulSet/Deployment/...
                 yield path, doc["spec"]["template"]["spec"]
 
 

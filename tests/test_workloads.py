@@ -84,3 +84,23 @@ def test_mnist_dp_mesh():
     mesh = make_mesh(MeshSpec(dp=8))
     loss = mnist.train(steps=10, batch=64, mesh=mesh)
     assert np.isfinite(loss)
+
+
+def test_flash_attention_loss_matches_plain():
+    """attention="flash" (Pallas kernel, interpreted on CPU) == plain path,
+    both single-device and sharded under shard_map."""
+    base = dict(
+        vocab=32, d_model=16, n_layers=1, n_heads=2, d_ff=32, max_seq=16,
+        compute_dtype=jnp.float32, remat=False,
+    )
+    cfg_flash = TransformerConfig(**base, attention="flash")
+    cfg_plain = TransformerConfig(**base, attention="plain")
+    params = init_params(jax.random.key(0), cfg_plain)
+    tokens = demo_batch(jax.random.key(1), 4, 16, cfg_plain.vocab)
+    plain = loss_fn(params, tokens, cfg_plain)
+    flash = loss_fn(params, tokens, cfg_flash)
+    np.testing.assert_allclose(float(flash), float(plain), rtol=1e-5)
+
+    mesh = make_mesh(MeshSpec(dp=2, fsdp=2, tp=2))
+    sharded = loss_fn(shard_params(params, mesh, cfg_flash), tokens, cfg_flash, mesh)
+    np.testing.assert_allclose(float(sharded), float(plain), rtol=1e-5)
