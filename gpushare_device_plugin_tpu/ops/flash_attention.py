@@ -143,7 +143,7 @@ def _fwd(q, k, v, *, scale, causal, block_q, block_k, interpret):
         ],
         interpret=interpret,
     )(q, k, v)
-    return o, lse  # [BH, S]
+    return o, lse  # o: [BH, S, Dh]; lse: [BH, S, STAT_LANES] (lane-broadcast)
 
 
 # ---------------------------------------------------------------------------

@@ -1,11 +1,15 @@
 """Demo JAX workloads — the pods the plugin schedules.
 
-These are the BASELINE.md config workloads: an MNIST-scale MLP (config 2)
-and a Llama-style decoder transformer (configs 3-4, the flagship) whose
-training step shards over a dp/fsdp/tp(+sp) `jax.sharding.Mesh` built from
-the topology the plugin injected (``parallel/podenv.py``). The reference
-repo ships only YAML demo pods (``demo/binpack-1/``); here the demo
-workloads are first-class, testable code.
+These are the BASELINE.md config workloads: an MNIST-scale MLP (config 2),
+a ResNet-50 classifier and a BERT-style MLM encoder (config 3's two
+binpacked pods), and a Llama-style decoder transformer (configs 3-4, the
+flagship) whose training step shards over a dp/fsdp/tp(+sp)
+`jax.sharding.Mesh` built from the topology the plugin injected
+(``parallel/podenv.py``). The reference repo ships only YAML demo pods
+(``demo/binpack-1/``); here the demo workloads are first-class, testable
+code.
 """
 
+from .bert import BertConfig  # noqa: F401
+from .resnet import ResNetConfig  # noqa: F401
 from .transformer import TransformerConfig  # noqa: F401

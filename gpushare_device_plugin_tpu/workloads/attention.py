@@ -1,0 +1,73 @@
+"""Shared attention-implementation selection for the demo workloads.
+
+One place for the trace-time gate that decides between the Pallas flash
+kernel (``ops/flash_attention.py``) and plain softmax attention, and for
+the shard_map wrapper that runs the kernel per-shard over the
+(dp, fsdp, tp) mesh axes — used by both the decoder flagship
+(``workloads/transformer.py``) and the BERT encoder (``workloads/bert.py``).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops import flash_attention
+from ..parallel.ring import full_attention
+
+
+def use_flash(attention: str, q: jax.Array, mesh: Mesh | None) -> bool:
+    """Pick the attention implementation at trace time (shapes are static).
+
+    "auto" engages the kernel only when every constraint of the shard_map
+    route holds (batch divisible by dp*fsdp, heads by tp, sequence by the
+    kernel block) — otherwise it silently keeps the always-correct plain
+    path. "flash" skips the checks so a misfit config fails loudly.
+    """
+    if attention == "flash":
+        return True
+    if attention == "plain":
+        return False
+    if attention != "auto":
+        raise ValueError(f"unknown attention={attention!r}: expected auto|flash|plain")
+    if jax.default_backend() != "tpu":
+        return False
+    B, S, H = q.shape[0], q.shape[1], q.shape[2]
+    # Kernel blocks shrink to min(128, S); Mosaic needs the sublane (block)
+    # dim 8-divisible, so S must be a multiple of 128 or itself 8-aligned.
+    if (S % 128 if S > 128 else S % 8):
+        return False
+    if mesh is not None:
+        data = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
+        if B % data or H % mesh.shape.get("tp", 1):
+            return False
+    return True
+
+
+def flash_or_plain(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    attention: str,
+    causal: bool,
+    mesh: Mesh | None,
+) -> jax.Array:
+    """Dispatch [B, S, H, Dh] attention to flash (per-shard) or plain."""
+    if not use_flash(attention, q, mesh):
+        return full_attention(q, k, v, causal=causal)
+    if mesh is None:
+        return flash_attention(q, k, v, causal=causal)
+    # XLA cannot partition a custom call, so the kernel runs per-shard
+    # under shard_map: batch over the data axes, heads over tp, sequence
+    # replicated (sp-sharded sequences go through ring_attention instead).
+    spec = P(("dp", "fsdp"), None, "tp", None)
+    return jax.shard_map(
+        functools.partial(flash_attention, causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        # pallas_call outputs carry no varying-mesh-axes metadata;
+        # the spec above is the full truth here (no collectives).
+        check_vma=False,
+    )(q, k, v)

@@ -1,0 +1,251 @@
+"""BERT-style bidirectional encoder with an MLM head (BASELINE.md config 3).
+
+Design notes (TPU/XLA):
+- **scan over layers** — identical to the decoder flagship
+  (``workloads/transformer.py``): stacked layer params under one compiled
+  `lax.scan` body.
+- **non-causal flash attention** — reuses the Pallas kernel
+  (``ops/flash_attention.py``) with ``causal=False`` on TPU; plain softmax
+  attention elsewhere.
+- **bf16 compute / f32 params**, MLM head tied to the token embedding
+  (the classic BERT weight tying — one big [d, vocab] matmul on the MXU).
+- **sharding** — same (dp, fsdp, tp, sp) mesh rules as the decoder: fsdp
+  ZeRO-shards the model dim, tp shards heads/ffn/vocab, batch shards over
+  (dp, fsdp).
+
+The reference has no model code (SURVEY.md section 2); this is the second
+workload of the two-pods-on-one-host demo (BASELINE.md config 3: ResNet-50
++ BERT-base HBM-binpacked onto one v4-8 host).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..parallel.mesh import batch_sharding
+from .attention import flash_or_plain
+
+Params = dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab: int = 256
+    d_model: int = 128
+    n_layers: int = 2
+    n_heads: int = 4
+    d_ff: int = 512
+    max_seq: int = 512
+    n_segments: int = 2
+    compute_dtype: Any = jnp.bfloat16
+    remat: bool = True
+    attention: str = "auto"  # auto | flash | plain
+    mask_token_id: int = 1  # [MASK] for demo MLM batches
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def bert_base(vocab: int = 30522) -> BertConfig:
+    """The BERT-base (L=12, H=768, A=12) shape."""
+    return BertConfig(
+        vocab=vocab, d_model=768, n_layers=12, n_heads=12, d_ff=3072, max_seq=512
+    )
+
+
+# --- init -------------------------------------------------------------------
+
+
+def init_params(rng: jax.Array, cfg: BertConfig) -> Params:
+    k_tok, k_pos, k_seg, k_layers = jax.random.split(rng, 4)
+    d, H, Dh, F, L = cfg.d_model, cfg.n_heads, cfg.head_dim, cfg.d_ff, cfg.n_layers
+
+    def norm(key, shape, fan_in):
+        return (jax.random.normal(key, shape) / jnp.sqrt(fan_in)).astype(jnp.float32)
+
+    ks = jax.random.split(k_layers, 4)
+    return {
+        "embed": norm(k_tok, (cfg.vocab, d), d),
+        "pos_embed": norm(k_pos, (cfg.max_seq, d), d),
+        "seg_embed": norm(k_seg, (cfg.n_segments, d), d),
+        "embed_ln": {"scale": jnp.ones((d,), jnp.float32),
+                     "bias": jnp.zeros((d,), jnp.float32)},
+        "layers": {
+            "wqkv": norm(ks[0], (L, d, 3, H, Dh), d),
+            "wo": norm(ks[1], (L, H, Dh, d), d),
+            "wi": norm(ks[2], (L, d, F), d),
+            "wdown": norm(ks[3], (L, F, d), F),
+            "ln1": {"scale": jnp.ones((L, d), jnp.float32),
+                    "bias": jnp.zeros((L, d), jnp.float32)},
+            "ln2": {"scale": jnp.ones((L, d), jnp.float32),
+                    "bias": jnp.zeros((L, d), jnp.float32)},
+        },
+        # MLM head: dense + layernorm, output projection tied to `embed`.
+        "mlm": {
+            "dense": norm(jax.random.fold_in(rng, 7), (d, d), d),
+            "ln": {"scale": jnp.ones((d,), jnp.float32),
+                   "bias": jnp.zeros((d,), jnp.float32)},
+            "out_bias": jnp.zeros((cfg.vocab,), jnp.float32),
+        },
+    }
+
+
+def param_specs(cfg: BertConfig) -> Params:
+    ln = {"scale": P(None), "bias": P(None)}
+    layer_ln = {"scale": P(None, None), "bias": P(None, None)}
+    return {
+        "embed": P("tp", "fsdp"),
+        "pos_embed": P(None, "fsdp"),
+        "seg_embed": P(None, "fsdp"),
+        "embed_ln": ln,
+        "layers": {
+            "wqkv": P(None, "fsdp", None, "tp", None),
+            "wo": P(None, "tp", None, "fsdp"),
+            "wi": P(None, "fsdp", "tp"),
+            "wdown": P(None, "tp", "fsdp"),
+            "ln1": layer_ln,
+            "ln2": layer_ln,
+        },
+        "mlm": {"dense": P("fsdp", "tp"), "ln": ln, "out_bias": P("tp")},
+    }
+
+
+def param_shardings(mesh: Mesh, cfg: BertConfig) -> Params:
+    return jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec), param_specs(cfg),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def shard_params(params: Params, mesh: Mesh, cfg: BertConfig) -> Params:
+    return jax.device_put(params, param_shardings(mesh, cfg))
+
+
+# --- model ------------------------------------------------------------------
+
+
+def _layer_norm(x, p, eps=1e-6):
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    y = (xf - mean) * jax.lax.rsqrt(var + eps)
+    return (y * p["scale"] + p["bias"]).astype(x.dtype)
+
+
+def _layer(x, lp, cfg: BertConfig, mesh: Mesh | None):
+    """One post-LN encoder block. x: [B, T, d]."""
+    dt = cfg.compute_dtype
+    qkv = jnp.einsum("btd,dchn->btchn", x, lp["wqkv"].astype(dt))
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    attn = flash_or_plain(
+        q, k, v, attention=cfg.attention, causal=False, mesh=mesh
+    )
+    x = _layer_norm(
+        x + jnp.einsum("bthn,hnd->btd", attn, lp["wo"].astype(dt)), lp["ln1"]
+    )
+    ff = jax.nn.gelu(jnp.einsum("btd,df->btf", x, lp["wi"].astype(dt)))
+    x = _layer_norm(
+        x + jnp.einsum("btf,fd->btd", ff, lp["wdown"].astype(dt)), lp["ln2"]
+    )
+    return x
+
+
+def forward(
+    params: Params,
+    tokens: jax.Array,
+    cfg: BertConfig,
+    mesh: Mesh | None = None,
+    segments: jax.Array | None = None,
+) -> jax.Array:
+    """tokens: [B, S] int32 -> contextual embeddings [B, S, d]."""
+    dt = cfg.compute_dtype
+    B, S = tokens.shape
+    x = params["embed"].astype(dt)[tokens]
+    x = x + params["pos_embed"].astype(dt)[:S][None]
+    if segments is not None:
+        x = x + params["seg_embed"].astype(dt)[segments]
+    x = _layer_norm(x, params["embed_ln"])
+    layer_fn = functools.partial(_layer, cfg=cfg, mesh=mesh)
+    if cfg.remat:
+        layer_fn = jax.checkpoint(layer_fn)
+    x = jax.lax.scan(lambda c, lp: (layer_fn(c, lp), None), x, params["layers"])[0]
+    return x
+
+
+def mlm_logits(params: Params, hidden: jax.Array, cfg: BertConfig) -> jax.Array:
+    """[B, S, d] -> [B, S, vocab] via the tied-embedding MLM head."""
+    dt = cfg.compute_dtype
+    h = jax.nn.gelu(jnp.einsum("btd,de->bte", hidden, params["mlm"]["dense"].astype(dt)))
+    h = _layer_norm(h, params["mlm"]["ln"])
+    logits = jnp.einsum("btd,vd->btv", h, params["embed"].astype(dt))
+    return logits.astype(jnp.float32) + params["mlm"]["out_bias"]
+
+
+def loss_fn(
+    params: Params,
+    tokens: jax.Array,
+    targets: jax.Array,
+    mask: jax.Array,
+    cfg: BertConfig,
+    mesh: Mesh | None = None,
+) -> jax.Array:
+    """Masked-LM cross-entropy over positions where ``mask`` is 1."""
+    hidden = forward(params, tokens, cfg, mesh)
+    logits = mlm_logits(params, hidden, cfg)
+    lp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(lp, targets[..., None], axis=-1)[..., 0]
+    denom = jnp.maximum(jnp.sum(mask), 1.0)
+    return jnp.sum(nll * mask) / denom
+
+
+# --- training ---------------------------------------------------------------
+
+
+def make_optimizer(lr: float = 1e-4) -> optax.GradientTransformation:
+    return optax.adamw(lr, weight_decay=0.01)
+
+
+def make_train_step(mesh: Mesh, cfg: BertConfig, optimizer=None):
+    """(params, opt_state, tokens, targets, mask) -> (params, opt_state, loss)."""
+    opt = optimizer or make_optimizer()
+    psh = param_shardings(mesh, cfg)
+    data_sh = batch_sharding(mesh)
+
+    def step(params, opt_state, tokens, targets, mask):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, targets, mask, cfg, mesh)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return jax.jit(
+        step,
+        in_shardings=(psh, None, data_sh, data_sh, data_sh),
+        out_shardings=(psh, None, None),
+        donate_argnums=(0, 1),
+    )
+
+
+def init_train_state(rng: jax.Array, mesh: Mesh, cfg: BertConfig, optimizer=None):
+    opt = optimizer or make_optimizer()
+    params = shard_params(init_params(rng, cfg), mesh, cfg)
+    opt_state = opt.init(params)
+    return params, opt_state
+
+
+def demo_batch(rng: jax.Array, batch: int, seq: int, cfg: BertConfig):
+    """Synthetic MLM batch: (tokens, targets, mask), 15% positions masked."""
+    k_tok, k_mask = jax.random.split(rng)
+    base = jax.random.randint(k_tok, (batch, 1), 2, cfg.vocab // 2)
+    ramp = jnp.arange(seq)[None, :]
+    targets = ((base + ramp) % (cfg.vocab - 2) + 2).astype(jnp.int32)
+    mask = (jax.random.uniform(k_mask, (batch, seq)) < 0.15).astype(jnp.float32)
+    tokens = jnp.where(mask == 1.0, cfg.mask_token_id, targets).astype(jnp.int32)
+    return tokens, targets, mask

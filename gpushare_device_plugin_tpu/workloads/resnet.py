@@ -1,0 +1,322 @@
+"""ResNet-50 image classifier, TPU-first (BASELINE.md config 3 workload).
+
+Design notes (TPU/XLA):
+- **NHWC + HWIO** layouts throughout — the native layouts for TPU convs;
+  XLA lowers ``lax.conv_general_dilated`` straight onto the MXU without
+  transposes.
+- **bf16 compute, f32 params/stats** — kernels are cast to
+  ``cfg.compute_dtype`` per-use; batch-norm statistics stay f32.
+- **scan over the identical tail blocks of each stage** — the first block
+  of a stage changes shape (stride/projection), the remaining ``n-1`` are
+  shape-identical, so their params stack on a leading axis and run under
+  one compiled `lax.scan` body: compile time stays flat as depth grows.
+- **sharding** — batch shards over the data axes ``(dp, fsdp)``; the
+  classifier head shards over ``tp``; conv kernels shard their output
+  channel over ``fsdp`` (ZeRO-style, XLA all-gathers per block).
+
+Functional batch-norm: ``forward`` takes and returns an explicit
+``state`` pytree (running mean/var), train mode computes batch statistics
+and folds them into the running averages — no mutation, jit-pure.
+
+The reference has no model code (SURVEY.md section 2 — it schedules
+containers); this is part of the workload half the TPU framework adds,
+exercised by the two-pods-on-one-host demo (BASELINE.md config 3).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+Params = dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    # (3, 4, 6, 3) is ResNet-50; tests use a tiny (1, 1, 1, 1) net.
+    stage_sizes: tuple[int, ...] = (3, 4, 6, 3)
+    width: int = 64
+    num_classes: int = 1000
+    compute_dtype: Any = jnp.bfloat16
+    bn_momentum: float = 0.9
+    bn_eps: float = 1e-5
+
+    @property
+    def stage_features(self) -> tuple[int, ...]:
+        return tuple(self.width * (2**i) for i in range(len(self.stage_sizes)))
+
+
+# --- init -------------------------------------------------------------------
+
+
+def _conv_init(key, kh, kw, cin, cout):
+    fan_in = kh * kw * cin
+    return (jax.random.normal(key, (kh, kw, cin, cout)) / jnp.sqrt(fan_in)).astype(
+        jnp.float32
+    )
+
+
+def _bn_init(c):
+    return {"scale": jnp.ones((c,), jnp.float32), "bias": jnp.zeros((c,), jnp.float32)}
+
+
+def _bn_state_init(c):
+    return {"mean": jnp.zeros((c,), jnp.float32), "var": jnp.ones((c,), jnp.float32)}
+
+
+def _bottleneck_init(key, cin, cmid, *, project):
+    """Bottleneck: 1x1 reduce -> 3x3 -> 1x1 expand (x4), optional projection."""
+    ks = jax.random.split(key, 4)
+    cout = cmid * 4
+    p = {
+        "conv1": _conv_init(ks[0], 1, 1, cin, cmid),
+        "bn1": _bn_init(cmid),
+        "conv2": _conv_init(ks[1], 3, 3, cmid, cmid),
+        "bn2": _bn_init(cmid),
+        "conv3": _conv_init(ks[2], 1, 1, cmid, cout),
+        "bn3": _bn_init(cout),
+    }
+    if project:
+        p["proj"] = _conv_init(ks[3], 1, 1, cin, cout)
+        p["bn_proj"] = _bn_init(cout)
+    return p
+
+
+def _bottleneck_state(cmid, *, project):
+    s = {
+        "bn1": _bn_state_init(cmid),
+        "bn2": _bn_state_init(cmid),
+        "bn3": _bn_state_init(cmid * 4),
+    }
+    if project:
+        s["bn_proj"] = _bn_state_init(cmid * 4)
+    return s
+
+
+def init_params(rng: jax.Array, cfg: ResNetConfig) -> tuple[Params, Params]:
+    """Returns (params, state) — state is the running batch-norm statistics."""
+    n_stages = len(cfg.stage_sizes)
+    keys = jax.random.split(rng, n_stages + 2)
+    params: Params = {
+        "stem": {"conv": _conv_init(keys[0], 7, 7, 3, cfg.width), "bn": _bn_init(cfg.width)},
+    }
+    state: Params = {"stem": {"bn": _bn_state_init(cfg.width)}}
+    cin = cfg.width
+    for i, (n_blocks, cmid) in enumerate(zip(cfg.stage_sizes, cfg.stage_features)):
+        bks = jax.random.split(keys[i + 1], n_blocks)
+        head = _bottleneck_init(bks[0], cin, cmid, project=True)
+        stage = {"head": head}
+        sstate = {"head": _bottleneck_state(cmid, project=True)}
+        if n_blocks > 1:
+            # Tail blocks are shape-identical: stack on a leading axis for scan.
+            tails = [
+                _bottleneck_init(bk, cmid * 4, cmid, project=False)
+                for bk in bks[1:]
+            ]
+            stage["tail"] = jax.tree.map(lambda *xs: jnp.stack(xs), *tails)
+            tstates = [_bottleneck_state(cmid, project=False) for _ in bks[1:]]
+            sstate["tail"] = jax.tree.map(lambda *xs: jnp.stack(xs), *tstates)
+        params[f"stage{i}"] = stage
+        state[f"stage{i}"] = sstate
+        cin = cmid * 4
+    params["head"] = {
+        "kernel": (
+            jax.random.normal(keys[-1], (cin, cfg.num_classes)) / jnp.sqrt(cin)
+        ).astype(jnp.float32),
+        "bias": jnp.zeros((cfg.num_classes,), jnp.float32),
+    }
+    return params, state
+
+
+def param_specs(cfg: ResNetConfig) -> Params:
+    """PartitionSpec pytree matching :func:`init_params`'s params.
+
+    Conv kernels ZeRO-shard their output channel over ``fsdp``; the dense
+    classifier shards classes over ``tp``. BN vectors stay replicated.
+    """
+
+    def spec_for(path, leaf):
+        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        parent = path[-2].key if len(path) > 1 and hasattr(path[-2], "key") else ""
+        rank = leaf.ndim
+        if parent in ("bn1", "bn2", "bn3", "bn_proj", "bn"):
+            return P(*([None] * rank))
+        if name == "kernel":
+            return P("fsdp", "tp")
+        if name == "bias":
+            return P("tp")
+        # conv kernels: [(L,)? kh, kw, cin, cout] -> shard cout over fsdp
+        return P(*([None] * (rank - 1)), "fsdp")
+
+    params, _ = jax.eval_shape(lambda: init_params(jax.random.key(0), cfg))
+    return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
+def shard_params(params: Params, state: Params, mesh: Mesh, cfg: ResNetConfig):
+    psh = jax.tree.map(
+        lambda s: NamedSharding(mesh, s), param_specs(cfg),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    replicated = NamedSharding(mesh, P())
+    return (
+        jax.device_put(params, psh),
+        jax.device_put(state, jax.tree.map(lambda _: replicated, state)),
+    )
+
+
+# --- model ------------------------------------------------------------------
+
+
+def _conv(x, kernel, *, stride=1, dtype=None):
+    return jax.lax.conv_general_dilated(
+        x,
+        kernel.astype(dtype or x.dtype),
+        window_strides=(stride, stride),
+        padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def _batch_norm(x, p, s, *, train, momentum, eps):
+    """Returns (y, new_state). Statistics in f32 regardless of compute dtype."""
+    if train:
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=(0, 1, 2))
+        var = jnp.var(xf, axis=(0, 1, 2))
+        new_s = {
+            "mean": momentum * s["mean"] + (1 - momentum) * mean,
+            "var": momentum * s["var"] + (1 - momentum) * var,
+        }
+    else:
+        mean, var = s["mean"], s["var"]
+        new_s = s
+    inv = jax.lax.rsqrt(var + eps) * p["scale"]
+    y = (x.astype(jnp.float32) - mean) * inv + p["bias"]
+    return y.astype(x.dtype), new_s
+
+
+def _bottleneck(x, p, s, cfg: ResNetConfig, *, stride=1, train):
+    bn = functools.partial(
+        _batch_norm, train=train, momentum=cfg.bn_momentum, eps=cfg.bn_eps
+    )
+    dt = cfg.compute_dtype
+    ns = {}
+    h = _conv(x, p["conv1"], dtype=dt)
+    h, ns["bn1"] = bn(h, p["bn1"], s["bn1"])
+    h = jax.nn.relu(h)
+    h = _conv(h, p["conv2"], stride=stride, dtype=dt)
+    h, ns["bn2"] = bn(h, p["bn2"], s["bn2"])
+    h = jax.nn.relu(h)
+    h = _conv(h, p["conv3"], dtype=dt)
+    h, ns["bn3"] = bn(h, p["bn3"], s["bn3"])
+    if "proj" in p:
+        x = _conv(x, p["proj"], stride=stride, dtype=dt)
+        x, ns["bn_proj"] = bn(x, p["bn_proj"], s["bn_proj"])
+    return jax.nn.relu(x + h), ns
+
+
+def forward(
+    params: Params,
+    state: Params,
+    images: jax.Array,
+    cfg: ResNetConfig,
+    *,
+    train: bool = True,
+) -> tuple[jax.Array, Params]:
+    """images: [B, H, W, 3] -> (logits [B, classes] f32, new_state)."""
+    dt = cfg.compute_dtype
+    x = images.astype(dt)
+    new_state: Params = {}
+    x = _conv(x, params["stem"]["conv"], stride=2, dtype=dt)
+    x, stem_bn = _batch_norm(
+        x, params["stem"]["bn"], state["stem"]["bn"],
+        train=train, momentum=cfg.bn_momentum, eps=cfg.bn_eps,
+    )
+    new_state["stem"] = {"bn": stem_bn}
+    x = jax.nn.relu(x)
+    x = jax.lax.reduce_window(
+        x, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1), "SAME"
+    )
+    for i, n_blocks in enumerate(cfg.stage_sizes):
+        sp, ss = params[f"stage{i}"], state[f"stage{i}"]
+        stride = 1 if i == 0 else 2
+        x, head_ns = _bottleneck(x, sp["head"], ss["head"], cfg, stride=stride, train=train)
+        stage_ns = {"head": head_ns}
+        if n_blocks > 1:
+
+            def body(carry, block):
+                bp, bs = block
+                y, ns = _bottleneck(carry, bp, bs, cfg, train=train)
+                return y, ns
+
+            x, tail_ns = jax.lax.scan(body, x, (sp["tail"], ss["tail"]))
+            stage_ns["tail"] = tail_ns
+        new_state[f"stage{i}"] = stage_ns
+    x = jnp.mean(x.astype(jnp.float32), axis=(1, 2))  # global average pool
+    logits = x @ params["head"]["kernel"] + params["head"]["bias"]
+    return logits, new_state
+
+
+def loss_fn(params, state, images, labels, cfg: ResNetConfig):
+    logits, new_state = forward(params, state, images, cfg, train=True)
+    nll = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+    return jnp.mean(nll), new_state
+
+
+# --- training ---------------------------------------------------------------
+
+
+def make_optimizer(lr: float = 0.1) -> optax.GradientTransformation:
+    return optax.sgd(lr, momentum=0.9, nesterov=True)
+
+
+def make_train_step(mesh: Mesh, cfg: ResNetConfig, optimizer=None):
+    """(params, state, opt_state, images, labels) -> (params, state, opt_state, loss)."""
+    opt = optimizer or make_optimizer()
+    psh = jax.tree.map(
+        lambda s: NamedSharding(mesh, s), param_specs(cfg),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    lbl_sh = NamedSharding(mesh, P(("dp", "fsdp")))
+    img_sh = NamedSharding(mesh, P(("dp", "fsdp"), None, None, None))
+
+    def step(params, state, opt_state, images, labels):
+        (loss, new_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, state, images, labels, cfg
+        )
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, new_state, opt_state, loss
+
+    return jax.jit(
+        step,
+        in_shardings=(psh, None, None, img_sh, lbl_sh),
+        out_shardings=(psh, None, None, None),
+        donate_argnums=(0, 1, 2),
+    )
+
+
+def init_train_state(rng: jax.Array, mesh: Mesh, cfg: ResNetConfig, optimizer=None):
+    opt = optimizer or make_optimizer()
+    params, state = init_params(rng, cfg)
+    params, state = shard_params(params, state, mesh, cfg)
+    opt_state = opt.init(params)
+    return params, state, opt_state
+
+
+def demo_batch(rng: jax.Array, batch: int, size: int = 32):
+    """Synthetic images+labels (zero-egress image: no dataset downloads)."""
+    k_img, k_lbl = jax.random.split(rng)
+    images = jax.random.uniform(k_img, (batch, size, size, 3), jnp.float32)
+    labels = jax.random.randint(k_lbl, (batch,), 0, 10)
+    return images, labels
+
+
+def resnet50(num_classes: int = 1000) -> ResNetConfig:
+    return ResNetConfig(stage_sizes=(3, 4, 6, 3), width=64, num_classes=num_classes)

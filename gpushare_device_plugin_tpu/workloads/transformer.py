@@ -32,9 +32,9 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops import flash_attention
 from ..parallel.mesh import batch_sharding
-from ..parallel.ring import full_attention, ring_attention
+from ..parallel.ring import ring_attention
+from .attention import flash_or_plain
 
 Params = dict[str, Any]
 
@@ -140,36 +140,6 @@ def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
 
 
-def _use_flash(cfg: TransformerConfig, q: jax.Array, mesh: Mesh | None) -> bool:
-    """Pick the attention implementation at trace time (shapes are static).
-
-    "auto" engages the kernel only when every constraint of the shard_map
-    route holds (batch divisible by dp*fsdp, heads by tp, sequence by the
-    kernel block) — otherwise it silently keeps the always-correct plain
-    path. "flash" skips the checks so a misfit config fails loudly.
-    """
-    if cfg.attention == "flash":
-        return True
-    if cfg.attention == "plain":
-        return False
-    if cfg.attention != "auto":
-        raise ValueError(
-            f"unknown attention={cfg.attention!r}: expected auto|flash|plain"
-        )
-    if jax.default_backend() != "tpu":
-        return False
-    B, S, H = q.shape[0], q.shape[1], q.shape[2]
-    # Kernel blocks shrink to min(128, S); Mosaic needs the sublane (block)
-    # dim 8-divisible, so S must be a multiple of 128 or itself 8-aligned.
-    if (S % 128 if S > 128 else S % 8):
-        return False
-    if mesh is not None:
-        data = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
-        if B % data or H % mesh.shape.get("tp", 1):
-            return False
-    return True
-
-
 def _layer(x, lp, cfg: TransformerConfig, positions, mesh: Mesh | None):
     """One decoder block. x: [B, T, d] global arrays (auto-SPMD)."""
     dt = cfg.compute_dtype
@@ -187,23 +157,10 @@ def _layer(x, lp, cfg: TransformerConfig, positions, mesh: Mesh | None):
             q, k, v, mesh, axis_name="sp", causal=True,
             batch_axes=("dp", "fsdp"), head_axes="tp",
         )
-    elif _use_flash(cfg, q, mesh):
-        # XLA cannot partition a custom call, so the kernel runs per-shard
-        # under shard_map: batch over the data axes, heads over tp, sequence
-        # replicated (the sp-sharded case is the ring branch above).
-        if mesh is not None:
-            spec = P(("dp", "fsdp"), None, "tp", None)
-            attn = jax.shard_map(
-                functools.partial(flash_attention, causal=True),
-                mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-                # pallas_call outputs carry no varying-mesh-axes metadata;
-                # the spec above is the full truth here (no collectives).
-                check_vma=False,
-            )(q, k, v)
-        else:
-            attn = flash_attention(q, k, v, causal=True)
     else:
-        attn = full_attention(q, k, v, causal=True)
+        attn = flash_or_plain(
+            q, k, v, attention=cfg.attention, causal=True, mesh=mesh
+        )
     x = x + jnp.einsum("bthn,hnd->btd", attn, lp["wo"].astype(dt))
     h = _rms_norm(x, lp["ln2"])
     gate_up = jnp.einsum("btd,dcf->btcf", h, lp["wi"].astype(dt))

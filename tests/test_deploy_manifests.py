@@ -38,9 +38,14 @@ def iter_demo_pod_specs():
         for doc in yaml.safe_load_all(path.read_text()):
             if not doc:
                 continue
-            if doc["kind"] == "Pod":
+            kind = doc["kind"]
+            if kind in ("Service", "ConfigMap", "ServiceAccount"):  # not workloads
+                continue
+            if kind == "Pod":
                 yield path, doc["spec"]
-            elif "template" in doc.get("spec", {}):  # Job/StatefulSet/Deployment/...
+            elif kind == "CronJob":
+                yield path, doc["spec"]["jobTemplate"]["spec"]["template"]["spec"]
+            else:  # Job/StatefulSet/Deployment/... — KeyError = unknown kind, extend here
                 yield path, doc["spec"]["template"]["spec"]
 
 
