@@ -45,6 +45,14 @@ ENV_ASSUME_TIME = "ALIYUN_COM_TPU_MEM_ASSUME_TIME"  # ns timestamp of assignment
 ENV_TPU_VISIBLE_CHIPS = "TPU_VISIBLE_CHIPS"
 ENV_TPU_PROCESS_BOUNDS = "TPU_PROCESS_BOUNDS"
 ENV_TPU_CHIPS_PER_PROCESS_BOUNDS = "TPU_CHIPS_PER_PROCESS_BOUNDS"
+
+# --- Multi-host slice bootstrap (BASELINE cfg 4; no reference analog — the
+# reference has no comms backend, SURVEY.md section 2). One pod per host;
+# these envs parameterize jax.distributed.initialize so the per-host JAX
+# processes form one global mesh over ICI/DCN.
+ENV_COORDINATOR_ADDRESS = "TPUSHARE_COORDINATOR_ADDRESS"
+ENV_NUM_PROCESSES = "TPUSHARE_NUM_PROCESSES"
+ENV_PROCESS_ID = "TPUSHARE_PROCESS_ID"
 # Cooperative HBM cap for the JAX/XLA client in the pod (the TPU analog of the
 # reference's cGPU isolation toggle, podmanager.go:59-72: there is no hardware
 # fence, the runtime must self-limit).

@@ -15,6 +15,12 @@ fractional HBM slice needs to (a) self-limit its XLA client allocation and
 (b) build its `jax.sharding.Mesh` from what the plugin granted.
 """
 
-from .podenv import PodTpuEnv, configure_jax_from_env  # noqa: F401
+from .podenv import (  # noqa: F401
+    MultihostSpec,
+    PodTpuEnv,
+    configure_jax_from_env,
+    initialize_multihost,
+    multihost_spec,
+)
 from .mesh import MeshSpec, make_mesh, batch_sharding  # noqa: F401
 from .ring import ring_attention  # noqa: F401

@@ -28,6 +28,13 @@ from typing import Mapping
 from .. import const
 
 
+def _env_int(e: Mapping[str, str], key: str, default: int) -> int:
+    try:
+        return int(e.get(key, ""))
+    except ValueError:
+        return default
+
+
 @dataclasses.dataclass(frozen=True)
 class PodTpuEnv:
     """Parsed view of the plugin-injected container env."""
@@ -50,10 +57,7 @@ class PodTpuEnv:
         e = os.environ if env is None else env
 
         def _int(key: str, default: int) -> int:
-            try:
-                return int(e.get(key, ""))
-            except ValueError:
-                return default
+            return _env_int(e, key, default)
 
         chips_raw = e.get(const.ENV_TPU_VISIBLE_CHIPS, "")
         visible = tuple(
@@ -125,3 +129,80 @@ def configure_jax_from_env(
         for k, v in settings.items():
             os.environ[k] = v
     return settings
+
+
+@dataclasses.dataclass(frozen=True)
+class MultihostSpec:
+    """Parsed multi-host bootstrap env (BASELINE cfg 4, one pod per host)."""
+
+    coordinator_address: str
+    num_processes: int
+    process_id: int
+
+    @property
+    def is_multihost(self) -> bool:
+        return self.num_processes > 1 and bool(self.coordinator_address)
+
+
+def _ordinal_from_hostname(hostname: str) -> int | None:
+    """StatefulSet pods are named ``<name>-<ordinal>`` — a stable process id."""
+    _, _, tail = hostname.rpartition("-")
+    return int(tail) if tail.isdigit() else None
+
+
+def multihost_spec(env: Mapping[str, str] | None = None) -> MultihostSpec:
+    """Read the multi-host bootstrap contract from the container env.
+
+    ``TPUSHARE_PROCESS_ID`` defaults to the StatefulSet ordinal parsed from
+    the hostname, so the v4-32 demo (``demo/flagship/``) needs no per-pod
+    env stanzas: a headless Service gives pod 0 a stable DNS name for the
+    coordinator and ordinals give process ids. A multi-host spec with an
+    undeterminable or out-of-range process id raises rather than letting
+    every pod silently claim process 0 (which would hang the rendezvous).
+    """
+    e = os.environ if env is None else env
+    coordinator = e.get(const.ENV_COORDINATOR_ADDRESS, "")
+    num = _env_int(e, const.ENV_NUM_PROCESSES, 1)
+    pid = _env_int(e, const.ENV_PROCESS_ID, -1)
+    if pid < 0:
+        ordinal = _ordinal_from_hostname(e.get("HOSTNAME", ""))
+        if ordinal is None:
+            if num > 1 and coordinator:
+                raise ValueError(
+                    f"multi-host spec ({const.ENV_NUM_PROCESSES}={num}) but "
+                    f"no {const.ENV_PROCESS_ID} and hostname "
+                    f"{e.get('HOSTNAME', '')!r} has no StatefulSet ordinal "
+                    "suffix — cannot determine this pod's process id"
+                )
+            ordinal = 0
+        pid = ordinal
+    if num > 1 and coordinator and pid >= num:
+        raise ValueError(
+            f"process id {pid} out of range for {const.ENV_NUM_PROCESSES}={num} "
+            "(pod name ordinal and the StatefulSet replica count disagree?)"
+        )
+    return MultihostSpec(
+        coordinator_address=coordinator, num_processes=num, process_id=pid
+    )
+
+
+def initialize_multihost(env: Mapping[str, str] | None = None) -> MultihostSpec:
+    """``jax.distributed.initialize`` from the injected env (no-op single-host).
+
+    Call once, after :func:`configure_jax_from_env` and before any other JAX
+    use. On an ``n``-host slice every host's JAX process then sees all
+    ``n x chips`` devices and ``make_mesh`` builds the global mesh; XLA
+    routes mesh-axis collectives over ICI within a host/slice and DCN
+    across (the scaling-book recipe — the plugin's role ends at env
+    injection, SURVEY.md section 5 "distributed communication backend").
+    """
+    spec = multihost_spec(env)
+    if spec.is_multihost:
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=spec.coordinator_address,
+            num_processes=spec.num_processes,
+            process_id=spec.process_id,
+        )
+    return spec

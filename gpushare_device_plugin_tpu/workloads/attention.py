@@ -10,12 +10,39 @@ the shard_map wrapper that runs the kernel per-shard over the
 from __future__ import annotations
 
 import functools
+import math
 
 import jax
+import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops import flash_attention
 from ..parallel.ring import full_attention
+
+
+def grouped_full_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool,
+) -> jax.Array:
+    """Plain attention with grouped KV heads (GQA) — no repeated KV.
+
+    q: [B, S, H, Dh]; k, v: [B, S, Hkv, Dh] with H a multiple of Hkv. The
+    group dim rides inside the einsums as a broadcast axis, so full-head
+    K/V is never materialized in HBM. Numerics mirror
+    ``parallel.ring.full_attention`` (f32 scores/softmax).
+    """
+    B, S, H, Dh = q.shape
+    Hkv = k.shape[2]
+    if H == Hkv:
+        return full_attention(q, k, v, causal=causal)
+    qg = q.reshape(B, S, Hkv, H // Hkv, Dh)
+    sc = 1.0 / math.sqrt(Dh)
+    s = jnp.einsum("bqkgd,bskd->bkgqs", qg, k).astype(jnp.float32) * sc
+    if causal:
+        mask = jnp.tril(jnp.ones((S, S), dtype=bool))
+        s = jnp.where(mask[None, None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bkgqs,bskd->bqkgd", p, v).astype(q.dtype)
+    return out.reshape(B, S, H, Dh)
 
 
 def use_flash(attention: str, q: jax.Array, mesh: Mesh | None) -> bool:
@@ -55,9 +82,20 @@ def flash_or_plain(
     causal: bool,
     mesh: Mesh | None,
 ) -> jax.Array:
-    """Dispatch [B, S, H, Dh] attention to flash (per-shard) or plain."""
+    """Dispatch [B, S, H, Dh] attention to flash (per-shard) or plain.
+
+    K/V may carry fewer (grouped/GQA) heads than Q. The plain path keeps
+    them grouped end-to-end; the flash path repeats them to full heads at
+    the kernel boundary (the Pallas kernel takes matching head counts — a
+    grouped-native kernel is future work, so GQA's KV-bytes saving applies
+    to HBM-resident weights/activations but not inside the kernel call).
+    """
+    groups = q.shape[2] // k.shape[2]
     if not use_flash(attention, q, mesh):
-        return full_attention(q, k, v, causal=causal)
+        return grouped_full_attention(q, k, v, causal=causal)
+    if groups > 1:
+        k = jnp.repeat(k, groups, axis=2)
+        v = jnp.repeat(v, groups, axis=2)
     if mesh is None:
         return flash_attention(q, k, v, causal=causal)
     # XLA cannot partition a custom call, so the kernel runs per-shard

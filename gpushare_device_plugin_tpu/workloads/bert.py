@@ -234,8 +234,11 @@ def make_train_step(mesh: Mesh, cfg: BertConfig, optimizer=None):
 
 
 def init_train_state(rng: jax.Array, mesh: Mesh, cfg: BertConfig, optimizer=None):
+    """Init under jit with ``out_shardings``: weights are created in-shard
+    (see transformer.init_train_state for why)."""
     opt = optimizer or make_optimizer()
-    params = shard_params(init_params(rng, cfg), mesh, cfg)
+    psh = param_shardings(mesh, cfg)
+    params = jax.jit(lambda k: init_params(k, cfg), out_shardings=psh)(rng)
     opt_state = opt.init(params)
     return params, opt_state
 

@@ -158,14 +158,17 @@ def param_specs(cfg: ResNetConfig) -> Params:
     return jax.tree_util.tree_map_with_path(spec_for, params)
 
 
-def shard_params(params: Params, state: Params, mesh: Mesh, cfg: ResNetConfig):
-    psh = jax.tree.map(
+def param_shardings(mesh: Mesh, cfg: ResNetConfig) -> Params:
+    return jax.tree.map(
         lambda s: NamedSharding(mesh, s), param_specs(cfg),
         is_leaf=lambda x: isinstance(x, P),
     )
+
+
+def shard_params(params: Params, state: Params, mesh: Mesh, cfg: ResNetConfig):
     replicated = NamedSharding(mesh, P())
     return (
-        jax.device_put(params, psh),
+        jax.device_put(params, param_shardings(mesh, cfg)),
         jax.device_put(state, jax.tree.map(lambda _: replicated, state)),
     )
 
@@ -279,10 +282,7 @@ def make_optimizer(lr: float = 0.1) -> optax.GradientTransformation:
 def make_train_step(mesh: Mesh, cfg: ResNetConfig, optimizer=None):
     """(params, state, opt_state, images, labels) -> (params, state, opt_state, loss)."""
     opt = optimizer or make_optimizer()
-    psh = jax.tree.map(
-        lambda s: NamedSharding(mesh, s), param_specs(cfg),
-        is_leaf=lambda x: isinstance(x, P),
-    )
+    psh = param_shardings(mesh, cfg)
     lbl_sh = NamedSharding(mesh, P(("dp", "fsdp")))
     img_sh = NamedSharding(mesh, P(("dp", "fsdp"), None, None, None))
 
@@ -303,9 +303,17 @@ def make_train_step(mesh: Mesh, cfg: ResNetConfig, optimizer=None):
 
 
 def init_train_state(rng: jax.Array, mesh: Mesh, cfg: ResNetConfig, optimizer=None):
+    """Init under jit with ``out_shardings``: weights are created in-shard
+    (see transformer.init_train_state for why)."""
     opt = optimizer or make_optimizer()
-    params, state = init_params(rng, cfg)
-    params, state = shard_params(params, state, mesh, cfg)
+    psh = param_shardings(mesh, cfg)
+    ssh = jax.tree.map(
+        lambda _: NamedSharding(mesh, P()),
+        jax.eval_shape(lambda: init_params(jax.random.key(0), cfg))[1],
+    )
+    params, state = jax.jit(
+        lambda k: init_params(k, cfg), out_shardings=(psh, ssh)
+    )(rng)
     opt_state = opt.init(params)
     return params, state, opt_state
 

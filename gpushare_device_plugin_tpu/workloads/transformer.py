@@ -45,6 +45,9 @@ class TransformerConfig:
     d_model: int = 128
     n_layers: int = 2
     n_heads: int = 4
+    # Grouped-query attention: n_kv_heads < n_heads shares each KV head
+    # across n_heads/n_kv_heads query heads (Llama-3 style). None = MHA.
+    n_kv_heads: int | None = None
     d_ff: int = 352
     max_seq: int = 256
     rope_theta: float = 10000.0
@@ -61,22 +64,39 @@ class TransformerConfig:
     def head_dim(self) -> int:
         return self.d_model // self.n_heads
 
+    @property
+    def kv_heads(self) -> int:
+        kv = self.n_kv_heads if self.n_kv_heads is not None else self.n_heads
+        if self.n_heads % kv:
+            raise ValueError(f"n_heads={self.n_heads} not divisible by n_kv_heads={kv}")
+        return kv
+
+
+def llama3_8b() -> TransformerConfig:
+    """The Llama-3-8B shape (BASELINE.md config 4's v4-32 FSDP workload)."""
+    return TransformerConfig(
+        vocab=128256, d_model=4096, n_layers=32, n_heads=32, n_kv_heads=8,
+        d_ff=14336, max_seq=8192, rope_theta=500000.0,
+    )
+
 
 # --- init -------------------------------------------------------------------
 
 def init_params(rng: jax.Array, cfg: TransformerConfig) -> Params:
     k_embed, k_layers, k_out = jax.random.split(rng, 3)
     d, H, Dh, F, L = cfg.d_model, cfg.n_heads, cfg.head_dim, cfg.d_ff, cfg.n_layers
+    Hkv = cfg.kv_heads
 
     def norm(key, shape, fan_in):
         return (jax.random.normal(key, shape) / jnp.sqrt(fan_in)).astype(jnp.float32)
 
-    ks = jax.random.split(k_layers, 4)
+    ks = jax.random.split(k_layers, 5)
     return {
         "embed": norm(k_embed, (cfg.vocab, d), d),
         "layers": {
             # stacked on leading L for lax.scan
-            "wqkv": norm(ks[0], (L, d, 3, H, Dh), d),
+            "wq": norm(ks[0], (L, d, H, Dh), d),
+            "wkv": norm(ks[4], (L, d, 2, Hkv, Dh), d),  # [k, v] grouped heads
             "wo": norm(ks[1], (L, H, Dh, d), d),
             "wi": norm(ks[2], (L, d, 2, F), d),  # [gate, up]
             "wdown": norm(ks[3], (L, F, d), F),
@@ -97,7 +117,8 @@ def param_specs(cfg: TransformerConfig) -> Params:
     return {
         "embed": P("tp", "fsdp"),
         "layers": {
-            "wqkv": P(None, "fsdp", None, "tp", None),
+            "wq": P(None, "fsdp", "tp", None),
+            "wkv": P(None, "fsdp", None, "tp", None),
             "wo": P(None, "tp", None, "fsdp"),
             "wi": P(None, "fsdp", None, "tp"),
             "wdown": P(None, "tp", "fsdp"),
@@ -144,13 +165,22 @@ def _layer(x, lp, cfg: TransformerConfig, positions, mesh: Mesh | None):
     """One decoder block. x: [B, T, d] global arrays (auto-SPMD)."""
     dt = cfg.compute_dtype
     h = _rms_norm(x, lp["ln1"])
-    qkv = jnp.einsum("btd,dchn->btchn", h, lp["wqkv"].astype(dt))
-    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [B,T,H,Dh]
+    q = jnp.einsum("btd,dhn->bthn", h, lp["wq"].astype(dt))  # [B,T,H,Dh]
+    kv = jnp.einsum("btd,dchn->btchn", h, lp["wkv"].astype(dt))
+    k, v = kv[:, :, 0], kv[:, :, 1]  # [B,T,Hkv,Dh]
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
     if cfg.seq_parallel:
         if mesh is None:
             raise ValueError("seq_parallel=True requires a mesh")
+        groups = cfg.n_heads // cfg.kv_heads
+        if groups > 1:
+            # The ring circulates K/V blocks with the full head count; a
+            # grouped-native ring (circulating Hkv heads, 1/groups the ICI
+            # bytes) is future work. The plain/flash dispatch below keeps
+            # K/V grouped.
+            k = jnp.repeat(k, groups, axis=2)
+            v = jnp.repeat(v, groups, axis=2)
         # Only attention needs manual collectives (the K/V ring over sp);
         # everything around it stays auto-sharded SPMD.
         attn = ring_attention(
@@ -236,9 +266,18 @@ def make_train_step(mesh: Mesh, cfg: TransformerConfig, optimizer=None):
 
 
 def init_train_state(rng: jax.Array, mesh: Mesh, cfg: TransformerConfig, optimizer=None):
-    """Sharded (params, opt_state) ready for :func:`make_train_step`."""
+    """Sharded (params, opt_state) ready for :func:`make_train_step`.
+
+    Init runs under jit with ``out_shardings`` so every weight is created
+    directly in its shard — no host-side or single-device materialization
+    (an 8B-param f32 init would otherwise OOM one chip before training even
+    starts, and ``device_put`` cannot target non-addressable devices on
+    multi-host meshes).
+    """
     opt = optimizer or make_optimizer()
-    params = shard_params(init_params(rng, cfg), mesh, cfg)
+    psh = param_shardings(mesh, cfg)
+    params = jax.jit(lambda k: init_params(k, cfg), out_shardings=psh)(rng)
+    # zeros_like in opt.init inherits each param's sharding.
     opt_state = opt.init(params)
     return params, opt_state
 

@@ -148,3 +148,60 @@ def test_ring_attention_jit_grad():
     g = jax.jit(jax.grad(loss))(q)
     assert g.shape == q.shape
     assert bool(jnp.all(jnp.isfinite(g)))
+
+
+def test_multihost_spec_from_explicit_env():
+    from gpushare_device_plugin_tpu.parallel import multihost_spec
+
+    spec = multihost_spec({
+        const.ENV_COORDINATOR_ADDRESS: "llama3-fsdp-0.llama3-fsdp:8476",
+        const.ENV_NUM_PROCESSES: "4",
+        const.ENV_PROCESS_ID: "3",
+    })
+    assert spec.is_multihost
+    assert spec.process_id == 3
+    assert spec.num_processes == 4
+
+
+def test_multihost_spec_ordinal_from_hostname():
+    from gpushare_device_plugin_tpu.parallel import multihost_spec
+
+    spec = multihost_spec({
+        const.ENV_COORDINATOR_ADDRESS: "llama3-fsdp-0.llama3-fsdp:8476",
+        const.ENV_NUM_PROCESSES: "4",
+        "HOSTNAME": "llama3-fsdp-2",
+    })
+    assert spec.process_id == 2
+
+
+def test_multihost_spec_single_host_default():
+    from gpushare_device_plugin_tpu.parallel import (
+        initialize_multihost,
+        multihost_spec,
+    )
+
+    spec = multihost_spec({})
+    assert not spec.is_multihost
+    # no coordinator -> no jax.distributed.initialize, plain return
+    assert initialize_multihost({}) == spec
+
+
+def test_initialize_multihost_calls_jax_distributed(monkeypatch):
+    import jax
+
+    from gpushare_device_plugin_tpu.parallel import initialize_multihost
+
+    calls = {}
+    monkeypatch.setattr(
+        jax.distributed, "initialize", lambda **kw: calls.update(kw)
+    )
+    initialize_multihost({
+        const.ENV_COORDINATOR_ADDRESS: "c:1234",
+        const.ENV_NUM_PROCESSES: "2",
+        const.ENV_PROCESS_ID: "1",
+    })
+    assert calls == {
+        "coordinator_address": "c:1234",
+        "num_processes": 2,
+        "process_id": 1,
+    }

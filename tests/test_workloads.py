@@ -75,6 +75,62 @@ def test_seq_parallel_loss_matches_dense():
     np.testing.assert_allclose(float(sp), float(dense), rtol=1e-5)
 
 
+def test_gqa_forward_and_train():
+    """Grouped-query attention (n_kv_heads < n_heads) trains and matches
+    shapes; kv params carry the grouped head count."""
+    cfg = TransformerConfig(
+        vocab=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2, d_ff=64,
+        max_seq=32, compute_dtype=jnp.float32,
+    )
+    params = init_params(jax.random.key(0), cfg)
+    assert params["layers"]["wkv"].shape == (2, 32, 2, 2, cfg.head_dim)
+    logits = forward(params, demo_batch(jax.random.key(1), 2, 16, cfg.vocab), cfg)
+    assert logits.shape == (2, 16, cfg.vocab)
+
+    mesh = make_mesh(MeshSpec(dp=1, fsdp=2, tp=2, sp=2))
+    cfg_sp = TransformerConfig(
+        vocab=64, d_model=32, n_layers=1, n_heads=4, n_kv_heads=2, d_ff=64,
+        max_seq=32, compute_dtype=jnp.float32, seq_parallel=True,
+    )
+    params, opt_state = init_train_state(jax.random.key(0), mesh, cfg_sp)
+    step = make_train_step(mesh, cfg_sp)
+    tokens = demo_batch(jax.random.key(1), 4, 32, cfg_sp.vocab)
+    params, opt_state, loss = step(params, opt_state, tokens)
+    assert bool(jnp.isfinite(loss))
+
+
+def test_gqa_matches_mha_with_tiled_kv():
+    """GQA (grouped einsum path) == MHA whose wkv is explicitly tiled to
+    full heads — kv head i serves query heads [i*g, (i+1)*g)."""
+    base = dict(
+        vocab=32, d_model=16, n_layers=1, n_heads=4, d_ff=32, max_seq=16,
+        compute_dtype=jnp.float32, remat=False,
+    )
+    cfg_gqa = TransformerConfig(**base, n_kv_heads=2)
+    cfg_mha = TransformerConfig(**base)
+    params_gqa = init_params(jax.random.key(0), cfg_gqa)
+    params_mha = jax.tree.map(lambda x: x, params_gqa)
+    params_mha["layers"]["wkv"] = jnp.repeat(
+        params_gqa["layers"]["wkv"], cfg_gqa.n_heads // cfg_gqa.kv_heads, axis=3
+    )
+    tokens = demo_batch(jax.random.key(1), 2, 16, cfg_gqa.vocab)
+    np.testing.assert_allclose(
+        float(loss_fn(params_gqa, tokens, cfg_gqa)),
+        float(loss_fn(params_mha, tokens, cfg_mha)),
+        rtol=1e-6,
+    )
+
+
+def test_llama3_8b_preset():
+    from gpushare_device_plugin_tpu.workloads.transformer import llama3_8b
+
+    cfg = llama3_8b()
+    assert (cfg.d_model, cfg.n_layers, cfg.n_heads, cfg.kv_heads) == (
+        4096, 32, 32, 8,
+    )
+    assert cfg.vocab == 128256 and cfg.d_ff == 14336
+
+
 def test_mnist_learns():
     loss = mnist.train(steps=40, batch=128)
     assert loss < 0.5
