@@ -35,7 +35,7 @@ sys.path.insert(0, str(Path(__file__).resolve().parent / "tests"))
 from gpushare_device_plugin_tpu import const
 from gpushare_device_plugin_tpu.allocator.cluster import ClusterAllocator
 from gpushare_device_plugin_tpu.cluster.apiserver import ApiServerClient
-from gpushare_device_plugin_tpu.cluster.podsource import ApiServerPodSource
+from gpushare_device_plugin_tpu.cluster.informer import PodInformer
 from gpushare_device_plugin_tpu.device import DeviceInventory
 from gpushare_device_plugin_tpu.discovery import MockBackend
 from gpushare_device_plugin_tpu.plugin import PluginConfig, TpuSharePlugin
@@ -63,9 +63,10 @@ def main() -> None:
 
     client = ApiServerClient(api.url)
     inv = DeviceInventory(MockBackend(num_chips=CHIPS, hbm_bytes=HBM_GIB << 30).chips())
-    allocator = ClusterAllocator(
-        inv, client, ApiServerPodSource(client, NODE), NODE
-    )
+    # The daemon's default pod source: watch-backed informer cache (one
+    # PATCH is then the only HTTP round-trip on the Allocate hot path).
+    informer = PodInformer(client, NODE).start()
+    allocator = ClusterAllocator(inv, client, informer, NODE)
     plugin = TpuSharePlugin(
         inv, allocate_fn=allocator.allocate, config=PluginConfig(plugin_dir=tmp)
     )
@@ -91,18 +92,32 @@ def main() -> None:
             latencies.append((time.perf_counter() - t0) * 1e3)
             assert resp.container_responses[0].envs[const.ENV_TPU_VISIBLE_CHIPS]
             # kubelet starts the container: phase Running, so the next
-            # allocation's usage accounting sees this pod.
-            api.pods[("default", name)]["status"]["phase"] = "Running"
+            # allocation's usage accounting sees this pod. Wait (untimed)
+            # for the watch to deliver the transition — usage accounting is
+            # Running-only (reference parity, podmanager.go:102-115), and we
+            # are benching allocate latency, not watch propagation.
+            api.set_pod_phase("default", name, "Running")
+            deadline = time.perf_counter() + 2.0
+            while time.perf_counter() < deadline:
+                seen = {
+                    p["metadata"]["name"]
+                    for p in informer.running_share_pods()
+                    if p.get("status", {}).get("phase") == "Running"
+                }
+                if name in seen:
+                    break
+                time.sleep(0.001)
             running.append(name)
             used += size
         peak_used = max(peak_used, used)
         # Fill round complete: workload pods finish, host drains.
         for name in running:
-            api.pods.pop(("default", name), None)
+            api.delete_pod("default", name)
     wall = time.perf_counter() - t_all0
 
     plugin.stop()
     kubelet.stop()
+    informer.stop()
     api.stop()
 
     p50 = statistics.median(latencies)

@@ -73,6 +73,12 @@ class ClusterAllocator:
         with self._lock:
             pod = self._match_pending_pod(pod_units)
             if pod is None:
+                # Cached sources may lag the scheduler's bind by a watch
+                # event; one synchronous refresh closes the window before
+                # we fail the admission.
+                self._pods.refresh()
+                pod = self._match_pending_pod(pod_units)
+            if pod is None:
                 raise AllocationFailure(
                     f"invalid allocation request: no pending pod on {self._node} "
                     f"requesting {pod_units} {const.RESOURCE_MEM}"
@@ -158,12 +164,15 @@ class ClusterAllocator:
         }
         ns, name = P.namespace(pod), P.name(pod)
         try:
-            self._api.patch_pod(ns, name, patch)
+            updated = self._api.patch_pod(ns, name, patch)
         except ApiError as e:
             if const.OPTIMISTIC_LOCK_ERROR_MSG not in e.body and e.status != 409:
                 raise AllocationFailure(f"pod patch failed: {e}") from e
             log.warning("patch conflict for %s/%s; retrying once", ns, name)
             try:
-                self._api.patch_pod(ns, name, patch)
+                updated = self._api.patch_pod(ns, name, patch)
             except ApiError as e2:
                 raise AllocationFailure(f"pod patch failed twice: {e2}") from e2
+        # Cached sources must see the assignment before the MODIFIED event
+        # arrives, or the next Allocate could re-match this pod.
+        self._pods.note_pod_update(updated)

@@ -16,6 +16,7 @@ import sys
 from .. import const
 from ..cluster.apiserver import ApiServerClient
 from ..cluster.kubelet import KubeletClient
+from ..cluster.informer import PodInformer
 from ..cluster.podsource import ApiServerPodSource, KubeletPodSource
 from ..discovery import from_name
 from ..manager import ManagerConfig, TpuShareManager
@@ -38,6 +39,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="granularity of one tpu-mem unit")
     p.add_argument("--query-kubelet", action="store_true",
                    help="source pods from kubelet /pods instead of the apiserver")
+    p.add_argument("--pod-source", default="informer",
+                   choices=["informer", "list"],
+                   help="apiserver read strategy: watch-backed cache "
+                   "(informer, default) or a fresh LIST per Allocate "
+                   "(the reference's behavior); ignored with --query-kubelet")
     p.add_argument("--kubelet-address", default="127.0.0.1")
     p.add_argument("--kubelet-port", type=int, default=10250)
     p.add_argument("--client-cert", default="")
@@ -111,6 +117,8 @@ def main(argv=None) -> int:
                 timeout_s=args.timeout,
             )
             pod_source = KubeletPodSource(kubelet, apisrc, args.node_name)
+        elif args.pod_source == "informer":
+            pod_source = PodInformer(api_client, args.node_name).start()
         else:
             pod_source = apisrc
 

@@ -185,6 +185,65 @@ class ApiServerClient:
             params["labelSelector"] = label_selector
         return self._get(path, params).get("items", [])
 
+    def list_pods_with_rv(
+        self,
+        field_selector: str = "",
+        label_selector: str = "",
+    ) -> tuple[list[dict], str]:
+        """LIST returning (items, collection resourceVersion) — the seed for
+        a subsequent watch."""
+        params = {}
+        if field_selector:
+            params["fieldSelector"] = field_selector
+        if label_selector:
+            params["labelSelector"] = label_selector
+        body = self._get("/api/v1/pods", params)
+        return body.get("items", []), body.get("metadata", {}).get(
+            "resourceVersion", "0"
+        )
+
+    def watch_pods(
+        self,
+        resource_version: str = "0",
+        field_selector: str = "",
+        label_selector: str = "",
+        on_response=None,
+    ):
+        """Streamed watch: yields (event_type, pod) until the server closes
+        the connection. Raises ApiError on non-200 (e.g. 410 Gone -> relist).
+
+        ``on_response`` (if given) receives the live ``requests.Response``
+        so the caller can ``close()`` it from another thread to cancel the
+        blocking read (the informer's stop path).
+        """
+        params = {"watch": "true", "resourceVersion": resource_version}
+        if field_selector:
+            params["fieldSelector"] = field_selector
+        if label_selector:
+            params["labelSelector"] = label_selector
+        r = self._session.get(
+            self.base_url + "/api/v1/pods",
+            params=params,
+            stream=True,
+            # (connect, read) — the read timeout bounds a silent watch; the
+            # informer treats it like a server hangup and re-watches.
+            timeout=(self._timeout, max(self._timeout, 30.0)),
+        )
+        if r.status_code != 200:
+            body = r.text
+            r.close()
+            raise ApiError(r.status_code, body)
+        if on_response is not None:
+            on_response(r)
+        try:
+            for line in r.iter_lines():
+                if not line:
+                    continue
+                evt = json.loads(line)
+                yield evt.get("type", ""), evt.get("object", {})
+        finally:
+            r.close()
+
     def get_pod(self, namespace: str, name: str) -> dict:
         return self._get(f"/api/v1/namespaces/{namespace}/pods/{name}")
 

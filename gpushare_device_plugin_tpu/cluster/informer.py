@@ -1,0 +1,223 @@
+"""Watch-backed local pod cache — the client-go informer pattern, TPU-side.
+
+The reference's Allocate() hot path LISTs the apiserver (or kubelet) on
+every admission (``podmanager.go:141-190``): two HTTP round-trips per pod
+(pending candidates + usage accounting) before the PATCH. This informer
+replaces those reads with an in-memory cache maintained by a single
+list+watch stream — the idiomatic Kubernetes controller design the
+reference skipped — cutting Allocate() latency to roughly the cost of the
+one unavoidable PATCH.
+
+Consistency notes:
+- The cache is eventually consistent. A pending pod that was *just* bound
+  to this node may not have arrived on the watch when kubelet calls
+  Allocate; ``refresh()`` (called by the allocator on a match miss) does a
+  synchronous LIST to close that window, so the failure semantics are
+  never worse than the reference's always-LIST behavior.
+- After the allocator PATCHes annotations it feeds the response back via
+  ``note_pod_update()`` so the next Allocate cannot re-match a pod whose
+  MODIFIED event is still in flight.
+- Restart safety is unchanged: the apiserver remains the only database
+  (SURVEY.md section 5, checkpoint/resume); the cache is pure derivation.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import requests
+
+from .. import const
+from ..utils.log import get_logger
+from . import pods as P
+from .apiserver import ApiError, ApiServerClient
+
+log = get_logger("cluster.informer")
+
+RELIST_BACKOFF_S = 1.0
+
+
+def _rv_int(pod: dict) -> int | None:
+    rv = pod.get("metadata", {}).get("resourceVersion", "")
+    return int(rv) if isinstance(rv, str) and rv.isdigit() else None
+
+
+class PodInformer:
+    """List+watch cache of this node's pods, implementing the PodSource
+    protocol (``pending_pods``/``running_share_pods``) plus the informer
+    extras (``refresh``/``note_pod_update``)."""
+
+    def __init__(self, client: ApiServerClient, node_name: str):
+        self._c = client
+        self._node = node_name
+        self._field_selector = f"spec.nodeName={node_name}"
+        self._cache: dict[tuple[str, str], dict] = {}
+        self._lock = threading.Lock()
+        self._synced = threading.Event()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._live_response = None  # in-flight watch, closed by stop()
+
+    # --- lifecycle --------------------------------------------------------
+
+    def start(self, sync_timeout_s: float = 10.0) -> "PodInformer":
+        self._thread = threading.Thread(
+            target=self._run, name="pod-informer", daemon=True
+        )
+        self._thread.start()
+        if not self._synced.wait(sync_timeout_s):
+            log.warning(
+                "informer did not sync within %.1fs; reads fall back to "
+                "refresh-on-miss until the first LIST lands", sync_timeout_s
+            )
+        return self
+
+    def stop(self) -> None:
+        import socket as _socket
+        import time as _time
+
+        self._stop.set()
+        # The watch thread may be anywhere between issuing the GET and
+        # blocking in recv; poll briefly until the live response appears,
+        # then shutdown() its socket — close() alone cannot interrupt a
+        # blocked recv, it would wait out the whole read timeout.
+        deadline = _time.monotonic() + 2.0
+        while self._thread is not None and self._thread.is_alive():
+            resp = self._live_response
+            if resp is not None:
+                try:
+                    sock = resp.raw.connection.sock
+                    if sock is not None:
+                        sock.shutdown(_socket.SHUT_RDWR)
+                except Exception:  # noqa: BLE001 — already closed/racing
+                    pass
+                try:
+                    resp.close()
+                except Exception:  # noqa: BLE001
+                    pass
+                break
+            if _time.monotonic() > deadline:
+                break
+            _time.sleep(0.01)
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    # --- list+watch loop --------------------------------------------------
+
+    def _key(self, pod: dict) -> tuple[str, str]:
+        return P.namespace(pod), P.name(pod)
+
+    def _relist(self) -> str:
+        items, rv = self._c.list_pods_with_rv(field_selector=self._field_selector)
+        with self._lock:
+            self._cache = {self._key(p): p for p in items}
+        self._synced.set()
+        log.v(4, "informer listed %d pods at rv=%s", len(items), rv)
+        return rv
+
+    def _store_if_newer(self, key: tuple[str, str], pod: dict) -> None:
+        """Caller must hold self._lock. Drops updates whose resourceVersion
+        is not newer than the cached entry's — an in-flight older watch
+        event must not revert a pod fed in by note_pod_update()/refresh()
+        (that would re-open the re-match window those hooks close)."""
+        cached = self._cache.get(key)
+        if cached is not None:
+            old_rv, new_rv = _rv_int(cached), _rv_int(pod)
+            if old_rv is not None and new_rv is not None and new_rv <= old_rv:
+                return
+        self._cache[key] = pod
+
+    def _apply(self, etype: str, pod: dict) -> None:
+        key = self._key(pod)
+        with self._lock:
+            if etype == "DELETED":
+                self._cache.pop(key, None)
+            elif etype in ("ADDED", "MODIFIED"):
+                self._store_if_newer(key, pod)
+        # A pod moving OFF this node arrives as MODIFIED with a different
+        # nodeName (field-selector watches emit it as DELETED on a real
+        # apiserver; tolerate both shapes).
+        if etype != "DELETED" and P.node_name(pod) not in ("", self._node):
+            with self._lock:
+                self._cache.pop(key, None)
+
+    def _run(self) -> None:
+        rv = "0"
+        need_list = True
+        while not self._stop.is_set():
+            try:
+                if need_list:
+                    rv = self._relist()
+                    need_list = False
+                events = self._c.watch_pods(
+                    resource_version=rv,
+                    field_selector=self._field_selector,
+                    on_response=lambda r: setattr(self, "_live_response", r),
+                )
+                for etype, obj in events:
+                    if self._stop.is_set():
+                        return
+                    if etype == "ERROR":
+                        # In-stream failure (a real apiserver reports an
+                        # expired rv as HTTP 200 + one ERROR/Status event,
+                        # code 410). Relist to re-seed.
+                        log.v(
+                            4, "watch ERROR event (code=%s); relisting",
+                            obj.get("code"),
+                        )
+                        need_list = True
+                        break
+                    self._apply(etype, obj)
+                    rv = obj.get("metadata", {}).get("resourceVersion", rv)
+                # clean server close: re-watch from the last seen rv
+            except ApiError as e:
+                if e.status == 410:  # Gone: our rv fell out of history
+                    log.v(4, "watch rv=%s gone; relisting", rv)
+                else:
+                    log.warning("watch failed (%s); relisting", e)
+                need_list = True
+                self._stop.wait(RELIST_BACKOFF_S)
+            except requests.exceptions.Timeout:
+                # Routine idle-watch read timeout: the cache is still good —
+                # re-watch from the last seen rv, no LIST, no backoff.
+                log.v(4, "idle watch timed out; re-watching from rv=%s", rv)
+            except Exception as e:  # noqa: BLE001 — conn resets, closed resp
+                log.v(4, "watch interrupted (%s); relisting", e)
+                need_list = True
+                self._stop.wait(RELIST_BACKOFF_S)
+            finally:
+                self._live_response = None
+
+    # --- PodSource protocol ----------------------------------------------
+
+    def pending_pods(self) -> list[dict]:
+        with self._lock:
+            return [p for p in self._cache.values() if P.phase(p) == "Pending"]
+
+    def running_share_pods(self) -> list[dict]:
+        with self._lock:
+            return [
+                p
+                for p in self._cache.values()
+                if P.labels(p).get(const.LABEL_RESOURCE_KEY)
+                == const.LABEL_RESOURCE_VALUE
+            ]
+
+    # --- informer extras --------------------------------------------------
+
+    def refresh(self) -> None:
+        """Synchronous LIST — closes the just-scheduled-pod race on a match
+        miss. The watch keeps streaming independently; a deletion racing
+        this merge is healed by the next watch event or relist."""
+        items, _ = self._c.list_pods_with_rv(field_selector=self._field_selector)
+        with self._lock:
+            for p in items:
+                self._store_if_newer(self._key(p), p)
+
+    def note_pod_update(self, pod: dict) -> None:
+        """Feed a freshly-PATCHed pod straight into the cache so the next
+        read sees it before its MODIFIED event arrives."""
+        if pod:
+            with self._lock:
+                self._store_if_newer(self._key(pod), pod)

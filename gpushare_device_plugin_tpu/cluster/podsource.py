@@ -35,11 +35,29 @@ class PodSource(Protocol):
         """Running pods bearing the tpushare label (usage accounting)."""
         ...
 
+    def refresh(self) -> None:
+        """Make the next reads at least as fresh as the apiserver now.
+
+        No-op for list-backed sources (every read is a fresh LIST); the
+        informer uses it to close its watch-lag window on a match miss.
+        """
+        ...
+
+    def note_pod_update(self, pod: dict) -> None:
+        """Inform the source of a pod the caller just wrote (PATCH result)."""
+        ...
+
 
 class ApiServerPodSource:
     def __init__(self, client: ApiServerClient, node_name: str):
         self._c = client
         self._node = node_name
+
+    def refresh(self) -> None:
+        pass  # every read LISTs — always fresh
+
+    def note_pod_update(self, pod: dict) -> None:
+        pass  # ditto
 
     def pending_pods(self) -> list[dict]:
         return retry(
@@ -75,6 +93,12 @@ class KubeletPodSource:
         self._kubelet = kubelet
         self._fallback = fallback
         self._node = node_name
+
+    def refresh(self) -> None:
+        pass  # every read hits kubelet/apiserver — always fresh
+
+    def note_pod_update(self, pod: dict) -> None:
+        pass  # ditto
 
     def _kubelet_pods(self) -> list[dict]:
         return retry(

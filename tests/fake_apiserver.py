@@ -8,6 +8,7 @@ endpoint (same JSON shape).
 
 from __future__ import annotations
 
+import copy
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -53,12 +54,41 @@ class FakeApiServer:
         self.conflicts_to_inject = 0
         self._server: ThreadingHTTPServer | None = None
         self._lock = threading.Lock()
+        # --- watch machinery: a monotonically increasing resourceVersion
+        # and an event log; watch handlers block on the condition.
+        self._rv = 0
+        self._watch_log: list[tuple[int, str, dict]] = []  # (rv, type, pod)
+        self._cond = threading.Condition(self._lock)
+        self._running = False
 
     # --- state helpers ----------------------------------------------------
 
+    def _record_event(self, etype: str, pod: dict) -> None:
+        """Caller must hold self._lock."""
+        self._rv += 1
+        pod.setdefault("metadata", {})["resourceVersion"] = str(self._rv)
+        self._watch_log.append((self._rv, etype, copy.deepcopy(pod)))
+        self._cond.notify_all()
+
     def add_pod(self, pod: dict) -> None:
         meta = pod["metadata"]
-        self.pods[(meta.get("namespace", "default"), meta["name"])] = pod
+        key = (meta.get("namespace", "default"), meta["name"])
+        with self._cond:
+            etype = "MODIFIED" if key in self.pods else "ADDED"
+            self.pods[key] = pod
+            self._record_event(etype, pod)
+
+    def set_pod_phase(self, ns: str, name: str, phase: str) -> None:
+        with self._cond:
+            pod = self.pods[(ns, name)]
+            pod.setdefault("status", {})["phase"] = phase
+            self._record_event("MODIFIED", pod)
+
+    def delete_pod(self, ns: str, name: str) -> None:
+        with self._cond:
+            pod = self.pods.pop((ns, name), None)
+            if pod is not None:
+                self._record_event("DELETED", pod)
 
     def add_node(self, name: str, labels: dict | None = None, capacity: dict | None = None, allocatable: dict | None = None) -> None:
         self.nodes[name] = {
@@ -80,10 +110,21 @@ class FakeApiServer:
 
     # --- lifecycle --------------------------------------------------------
 
-    def start(self) -> None:
+    def start(self, port: int = 0) -> None:
+        """``port=0`` picks a free port; pass the previous ``self.port`` to
+        simulate an apiserver restart at the same address (state is kept —
+        it lives on this object, not the HTTP server)."""
         store = self
 
         class Handler(BaseHTTPRequestHandler):
+            # HTTP/1.1 keep-alive: a real apiserver multiplexes requests on
+            # persistent connections; without this every client call pays a
+            # TCP connect + server thread spawn, which dominates latency.
+            protocol_version = "HTTP/1.1"
+            # No Nagle: headers and body go out as separate writes; letting
+            # the kernel coalesce them trips 40ms delayed-ACK stalls.
+            disable_nagle_algorithm = True
+
             def log_message(self, fmt, *args):
                 pass
 
@@ -99,10 +140,67 @@ class FakeApiServer:
                 n = int(self.headers.get("Content-Length", "0"))
                 return json.loads(self.rfile.read(n) or b"{}")
 
+            def _stream_watch(self, q):
+                """k8s watch: chunked stream of {"type","object"} JSON lines."""
+                fs = q.get("fieldSelector", "")
+                ls = q.get("labelSelector", "")
+                try:
+                    since = int(q.get("resourceVersion", "0"))
+                except ValueError:
+                    since = 0
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+
+                def write_chunk(data: bytes):
+                    self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+                    self.wfile.flush()
+
+                # Find the starting position once; thereafter the log is
+                # append-only so a slice from `pos` is the new batch (no
+                # full-history rescan under the shared lock per event).
+                with store._cond:
+                    pos = 0
+                    while (
+                        pos < len(store._watch_log)
+                        and store._watch_log[pos][0] <= since
+                    ):
+                        pos += 1
+                try:
+                    while True:
+                        with store._cond:
+                            batch = store._watch_log[pos:]
+                            pos = len(store._watch_log)
+                            if not batch:
+                                if not store._running:
+                                    break
+                                store._cond.wait(timeout=0.25)
+                                continue
+                        for rv, etype, obj in batch:
+                            if not (
+                                _match_field_selector(obj, fs)
+                                and _match_label_selector(obj, ls)
+                            ):
+                                continue
+                            line = (
+                                json.dumps({"type": etype, "object": obj}) + "\n"
+                            ).encode()
+                            write_chunk(line)
+                    write_chunk(b"")  # terminating chunk
+                except (BrokenPipeError, ConnectionResetError):
+                    pass  # client hung up — normal watch termination
+
             def do_GET(self):
                 u = urlparse(self.path)
                 q = {k: v[0] for k, v in parse_qs(u.query).items()}
                 parts = [p for p in u.path.split("/") if p]
+                if (
+                    parts[:2] == ["api", "v1"]
+                    and parts[2:] == ["pods"]
+                    and q.get("watch") in ("true", "1")
+                ):
+                    return self._stream_watch(q)
                 with store._lock:
                     # kubelet-style /pods/
                     if u.path.rstrip("/") == "/pods":
@@ -117,7 +215,13 @@ class FakeApiServer:
                                 if _match_field_selector(p, q.get("fieldSelector", ""))
                                 and _match_label_selector(p, q.get("labelSelector", ""))
                             ]
-                            return self._send(200, {"items": items})
+                            return self._send(
+                                200,
+                                {
+                                    "items": items,
+                                    "metadata": {"resourceVersion": str(store._rv)},
+                                },
+                            )
                         if rest == ["nodes"]:
                             items = [
                                 n
@@ -167,6 +271,7 @@ class FakeApiServer:
                                     else:
                                         merged[k] = v
                                 meta[key] = merged
+                        store._record_event("MODIFIED", pod)
                         return self._send(200, pod)
                     if len(rest) == 3 and rest[0] == "nodes" and rest[2] == "status":
                         node = store.nodes.get(rest[1])
@@ -194,18 +299,23 @@ class FakeApiServer:
                         pod = store.pods.get((ns, pod_name))
                         if pod is not None:
                             pod.setdefault("spec", {})["nodeName"] = node
+                            store._record_event("MODIFIED", pod)
                         return self._send(201, {"status": "Success"})
                     if len(rest) == 3 and rest[2] == "events":
                         store.events.append(body)
                         return self._send(201, body)
                 return self._send(404, {"message": f"unhandled POST {u.path}"})
 
-        self._server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self._running = True
         t = threading.Thread(target=self._server.serve_forever, daemon=True)
         t.start()
 
     def stop(self) -> None:
         if self._server is not None:
+            with self._cond:
+                self._running = False
+                self._cond.notify_all()
             self._server.shutdown()
             self._server.server_close()
             self._server = None

@@ -33,6 +33,7 @@ class FakeKubelet(RegistrationServicer):
         self.registrations: "queue.Queue[pb.RegisterRequest]" = queue.Queue()
         self._server: grpc.Server | None = None
         self._channels: list[grpc.Channel] = []
+        self._stubs: dict[str, DevicePluginStub] = {}
         self._watch_threads: list[threading.Thread] = []
         self._watch_stop = threading.Event()
         # resource name -> latest device list from ListAndWatch
@@ -64,6 +65,7 @@ class FakeKubelet(RegistrationServicer):
         self._watch_stop.set()
         for ch in self._channels:
             ch.close()
+        self._stubs.clear()
         if self._server is not None:
             self._server.stop(0.2).wait()
             self._server = None
@@ -73,10 +75,17 @@ class FakeKubelet(RegistrationServicer):
     # --- kubelet-side driving of a registered plugin ---------------------
 
     def stub_for(self, endpoint: str) -> DevicePluginStub:
-        ch = grpc.insecure_channel(f"unix:{os.path.join(self.plugin_dir, endpoint)}")
-        grpc.channel_ready_future(ch).result(timeout=5)
-        self._channels.append(ch)
-        return DevicePluginStub(ch)
+        # One persistent channel per plugin endpoint, like the real kubelet —
+        # a fresh dial per RPC would dominate Allocate latency (~2-3 ms).
+        stub = self._stubs.get(endpoint)
+        if stub is None:
+            ch = grpc.insecure_channel(
+                f"unix:{os.path.join(self.plugin_dir, endpoint)}"
+            )
+            grpc.channel_ready_future(ch).result(timeout=5)
+            self._channels.append(ch)
+            stub = self._stubs[endpoint] = DevicePluginStub(ch)
+        return stub
 
     def begin_watch(self, resource_name: str, endpoint: str) -> None:
         """Start consuming the plugin's ListAndWatch stream in a thread."""

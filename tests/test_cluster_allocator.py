@@ -167,3 +167,58 @@ def test_patch_chip_count_skips_noop(api):
     patches_before = len(api.patch_log)
     patch_chip_count(client, NODE, 4)  # no-op: same value
     assert len(api.patch_log) == patches_before
+
+
+# --- informer-backed allocator (the daemon's default pod source) -----------
+
+
+def make_informer_allocator(api_srv, **kw):
+    from gpushare_device_plugin_tpu.cluster.informer import PodInformer
+
+    client = ApiServerClient(api_srv.url)
+    informer = PodInformer(client, NODE).start(sync_timeout_s=5)
+    inv = DeviceInventory(MockBackend(num_chips=4, hbm_bytes=32 << 30).chips())
+    return ClusterAllocator(inv, client, informer, NODE, **kw), client, informer
+
+
+def test_informer_allocate_end_to_end(api):
+    api.add_pod(make_pod("inf-pod", 4, node=NODE))
+    alloc, client, informer = make_informer_allocator(api)
+    try:
+        res = alloc.allocate(granted(4))
+        assert res[0].envs[const.ENV_TPU_VISIBLE_CHIPS] == "0"
+        ann = client.get_pod("default", "inf-pod")["metadata"]["annotations"]
+        assert ann[const.ENV_ASSIGNED_FLAG] == "true"
+    finally:
+        informer.stop()
+
+
+def test_informer_refresh_on_miss_finds_just_bound_pod(api):
+    """A pod bound after the informer's last sync is still allocatable:
+    the match miss triggers a synchronous refresh()."""
+    alloc, client, informer = make_informer_allocator(api)
+    try:
+        informer.stop()  # freeze the cache: watch lag, worst case
+        api.add_pod(make_pod("late-pod", 2, node=NODE))
+        res = alloc.allocate(granted(2))
+        assert res[0].envs[const.ENV_MEM_POD] == "2"
+    finally:
+        informer.stop()
+
+
+def test_informer_does_not_rematch_just_assigned_pod(api):
+    """Back-to-back Allocates for two same-size pods must pick different
+    pods even before the first pod's MODIFIED event lands (note_pod_update
+    covers the window)."""
+    api.add_pod(make_pod("twin-a", 2, node=NODE))
+    api.add_pod(make_pod("twin-b", 2, node=NODE))
+    alloc, client, informer = make_informer_allocator(api)
+    try:
+        alloc.allocate(granted(2))
+        alloc.allocate(granted(2))
+        ann_a = client.get_pod("default", "twin-a")["metadata"]["annotations"]
+        ann_b = client.get_pod("default", "twin-b")["metadata"]["annotations"]
+        assert ann_a.get(const.ENV_ASSIGNED_FLAG) == "true"
+        assert ann_b.get(const.ENV_ASSIGNED_FLAG) == "true"
+    finally:
+        informer.stop()

@@ -1,0 +1,170 @@
+"""PodInformer: list+watch cache semantics against the fake apiserver."""
+
+import time
+
+import pytest
+
+from gpushare_device_plugin_tpu import const
+from gpushare_device_plugin_tpu.cluster.apiserver import ApiServerClient
+from gpushare_device_plugin_tpu.cluster.informer import PodInformer
+
+from fake_apiserver import FakeApiServer
+from k8s_fixtures import make_pod
+
+NODE = "inf-node"
+
+
+@pytest.fixture()
+def api():
+    srv = FakeApiServer()
+    srv.add_node(NODE)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture()
+def informer(api):
+    inf = PodInformer(ApiServerClient(api.url), NODE).start(sync_timeout_s=5)
+    yield inf
+    inf.stop()
+
+
+def wait_until(pred, timeout=5.0, every=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(every)
+    return False
+
+
+def test_initial_list_seeds_cache(api):
+    api.add_pod(make_pod("pre-existing", 2, node=NODE))
+    inf = PodInformer(ApiServerClient(api.url), NODE).start(sync_timeout_s=5)
+    try:
+        names = [p["metadata"]["name"] for p in inf.pending_pods()]
+        assert names == ["pre-existing"]
+    finally:
+        inf.stop()
+
+
+def test_watch_add_modify_delete(api, informer):
+    api.add_pod(make_pod("w1", 2, node=NODE))
+    assert wait_until(lambda: len(informer.pending_pods()) == 1)
+
+    api.set_pod_phase("default", "w1", "Running")
+    assert wait_until(lambda: len(informer.pending_pods()) == 0)
+
+    api.delete_pod("default", "w1")
+    assert wait_until(
+        lambda: all(
+            p["metadata"]["name"] != "w1" for p in informer.running_share_pods()
+        )
+    )
+
+
+def test_running_share_pods_filters_by_label(api, informer):
+    labeled = make_pod("labeled", 2, node=NODE)
+    labeled["metadata"].setdefault("labels", {})[
+        const.LABEL_RESOURCE_KEY
+    ] = const.LABEL_RESOURCE_VALUE
+    api.add_pod(labeled)
+    api.add_pod(make_pod("unlabeled", 2, node=NODE))
+    assert wait_until(lambda: len(informer.pending_pods()) == 2)
+    names = [p["metadata"]["name"] for p in informer.running_share_pods()]
+    assert names == ["labeled"]
+
+
+def test_other_node_pods_excluded(api, informer):
+    api.add_pod(make_pod("mine", 2, node=NODE))
+    api.add_pod(make_pod("theirs", 2, node="other-node"))
+    assert wait_until(lambda: len(informer.pending_pods()) == 1)
+    assert informer.pending_pods()[0]["metadata"]["name"] == "mine"
+
+
+def test_refresh_closes_watch_lag(api):
+    """refresh() pulls pods the watch hasn't delivered yet (simulated by a
+    stopped informer thread)."""
+    inf = PodInformer(ApiServerClient(api.url), NODE).start(sync_timeout_s=5)
+    inf.stop()  # watch is dead: cache frozen
+    api.add_pod(make_pod("late", 4, node=NODE))
+    assert inf.pending_pods() == []
+    inf.refresh()
+    assert [p["metadata"]["name"] for p in inf.pending_pods()] == ["late"]
+
+
+def test_note_pod_update_overrides_stale_cache(api, informer):
+    api.add_pod(make_pod("p1", 2, node=NODE))
+    assert wait_until(lambda: len(informer.pending_pods()) == 1)
+    patched = dict(informer.pending_pods()[0])
+    patched["metadata"] = dict(patched["metadata"])
+    patched["metadata"]["annotations"] = {const.ENV_ASSIGNED_FLAG: "true"}
+    # A real PATCH response carries the apiserver's bumped resourceVersion.
+    patched["metadata"]["resourceVersion"] = str(
+        int(patched["metadata"]["resourceVersion"]) + 1
+    )
+    informer.note_pod_update(patched)
+    assert (
+        informer.pending_pods()[0]["metadata"]["annotations"][
+            const.ENV_ASSIGNED_FLAG
+        ]
+        == "true"
+    )
+
+
+def test_watch_survives_apiserver_restart(api, informer):
+    """Events keep flowing after the apiserver bounces at the same address:
+    the informer relists + rewatches."""
+    api.add_pod(make_pod("before", 2, node=NODE))
+    assert wait_until(lambda: len(informer.pending_pods()) == 1)
+    port = api.port
+    api.stop()
+    api.start(port=port)
+    api.add_pod(make_pod("after", 2, node=NODE))
+    assert wait_until(lambda: len(informer.pending_pods()) == 2, timeout=10)
+
+
+def test_stale_watch_event_does_not_revert_newer_pod(api, informer):
+    """An older in-flight event must not overwrite a pod fed in by
+    note_pod_update (the allocator's PATCH response)."""
+    api.add_pod(make_pod("p1", 2, node=NODE))
+    assert wait_until(lambda: len(informer.pending_pods()) == 1)
+    old = informer.pending_pods()[0]
+    newer = {
+        **old,
+        "metadata": {
+            **old["metadata"],
+            "resourceVersion": str(int(old["metadata"]["resourceVersion"]) + 5),
+            "annotations": {const.ENV_ASSIGNED_FLAG: "true"},
+        },
+    }
+    informer.note_pod_update(newer)
+    informer._apply("MODIFIED", old)  # stale event arrives late
+    ann = informer.pending_pods()[0]["metadata"].get("annotations", {})
+    assert ann.get(const.ENV_ASSIGNED_FLAG) == "true"
+
+
+def test_error_event_triggers_relist(api, informer):
+    """An in-stream ERROR event (rv expired on a real apiserver) relists
+    instead of looping on a frozen cache."""
+    api.add_pod(make_pod("p1", 2, node=NODE))
+    assert wait_until(lambda: len(informer.pending_pods()) == 1)
+    with api._cond:
+        api._rv += 1
+        api._watch_log.append(
+            (api._rv, "ERROR", {"kind": "Status", "code": 410})
+        )
+        api._cond.notify_all()
+    # After the relist the cache still serves (and keeps serving) events.
+    api.add_pod(make_pod("p2", 2, node=NODE))
+    assert wait_until(lambda: len(informer.pending_pods()) == 2, timeout=10)
+
+
+def test_stop_returns_promptly_on_idle_watch(api):
+    """stop() must cancel the blocking watch read, not wait out the join."""
+    inf = PodInformer(ApiServerClient(api.url), NODE).start(sync_timeout_s=5)
+    t0 = time.monotonic()
+    inf.stop()
+    assert time.monotonic() - t0 < 2.0
+    assert inf._thread is None

@@ -41,8 +41,16 @@ print(f"OK proc={jax.process_index()} loss={loss:.4f}", flush=True)
 """
 
 
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
 def test_two_process_fsdp_train_step(tmp_path):
-    port = 9917
+    port = _free_port()
     procs = []
     for pid in range(2):
         env = dict(
