@@ -175,15 +175,16 @@ class ApiServerClient:
         field_selector: str = "",
         label_selector: str = "",
     ) -> list[dict]:
-        path = (
-            f"/api/v1/namespaces/{namespace}/pods" if namespace else "/api/v1/pods"
-        )
+        if namespace is None:
+            return self.list_pods_with_rv(field_selector, label_selector)[0]
         params = {}
         if field_selector:
             params["fieldSelector"] = field_selector
         if label_selector:
             params["labelSelector"] = label_selector
-        return self._get(path, params).get("items", [])
+        return self._get(
+            f"/api/v1/namespaces/{namespace}/pods", params
+        ).get("items", [])
 
     def list_pods_with_rv(
         self,

@@ -35,6 +35,33 @@ from .apiserver import ApiError, ApiServerClient
 log = get_logger("cluster.informer")
 
 RELIST_BACKOFF_S = 1.0
+REFRESH_RETRIES = 3
+REFRESH_DELAY_S = 1.0
+
+
+def _is_read_timeout(e: Exception) -> bool:
+    """True for an idle-watch read timeout however requests surfaces it.
+
+    During streaming reads, requests wraps urllib3's ReadTimeoutError in a
+    ConnectionError (NOT a requests Timeout subclass), so both the wrapper
+    and the cause chain must be checked.
+    """
+    import urllib3.exceptions
+
+    if isinstance(e, requests.exceptions.Timeout):
+        return True
+    seen: Exception | None = e
+    for _ in range(5):
+        if seen is None:
+            return False
+        if isinstance(seen, urllib3.exceptions.ReadTimeoutError):
+            return True
+        args = getattr(seen, "args", ())
+        seen = next(
+            (a for a in args if isinstance(a, Exception)),
+            getattr(seen, "__cause__", None),
+        )
+    return False
 
 
 def _rv_int(pod: dict) -> int | None:
@@ -178,14 +205,15 @@ class PodInformer:
                     log.warning("watch failed (%s); relisting", e)
                 need_list = True
                 self._stop.wait(RELIST_BACKOFF_S)
-            except requests.exceptions.Timeout:
-                # Routine idle-watch read timeout: the cache is still good —
-                # re-watch from the last seen rv, no LIST, no backoff.
-                log.v(4, "idle watch timed out; re-watching from rv=%s", rv)
-            except Exception as e:  # noqa: BLE001 — conn resets, closed resp
-                log.v(4, "watch interrupted (%s); relisting", e)
-                need_list = True
-                self._stop.wait(RELIST_BACKOFF_S)
+            except Exception as e:  # noqa: BLE001 — timeouts, resets, closes
+                if _is_read_timeout(e):
+                    # Routine idle-watch read timeout: the cache is still
+                    # good — re-watch from the last rv, no LIST, no backoff.
+                    log.v(4, "idle watch timed out; re-watching from rv=%s", rv)
+                else:
+                    log.v(4, "watch interrupted (%s); relisting", e)
+                    need_list = True
+                    self._stop.wait(RELIST_BACKOFF_S)
             finally:
                 self._live_response = None
 
@@ -208,9 +236,18 @@ class PodInformer:
 
     def refresh(self) -> None:
         """Synchronous LIST — closes the just-scheduled-pod race on a match
-        miss. The watch keeps streaming independently; a deletion racing
-        this merge is healed by the next watch event or relist."""
-        items, _ = self._c.list_pods_with_rv(field_selector=self._field_selector)
+        miss. Retried like the list-backed source's reads (the allocator
+        calls this exactly when admission hangs on the answer, so it must
+        not be weaker than the reference's always-LIST path). The watch
+        keeps streaming independently; a deletion racing this merge is
+        healed by the next watch event or relist."""
+        from ..utils.retry import retry
+
+        items, _ = retry(
+            lambda: self._c.list_pods_with_rv(field_selector=self._field_selector),
+            attempts=REFRESH_RETRIES,
+            delay_s=REFRESH_DELAY_S,
+        )
         with self._lock:
             for p in items:
                 self._store_if_newer(self._key(p), p)

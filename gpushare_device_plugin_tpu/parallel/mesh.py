@@ -95,6 +95,57 @@ def batch_sharding(mesh: Mesh, *, seq_parallel: bool = False) -> NamedSharding:
     )
 
 
+def prune_unshardable(specs, abstract, mesh: Mesh):
+    """Drop sharding axes that don't divide the dimension they shard.
+
+    The logical->physical fallback every production sharding map needs: a
+    PartitionSpec tree is written for the model family (e.g. classifier
+    classes over ``tp``), but a particular config (10 classes, tp=4) may
+    not divide — XLA refuses such shardings outright. Any non-dividing
+    axis falls back to replication for that dimension only.
+
+    ``specs``: PartitionSpec pytree; ``abstract``: matching pytree of
+    shaped leaves (e.g. from ``jax.eval_shape``).
+    """
+    import math
+
+    def fix(spec, leaf):
+        out = []
+        for i, axis in enumerate(spec):
+            if axis is None:
+                out.append(None)
+                continue
+            axes = axis if isinstance(axis, tuple) else (axis,)
+            total = math.prod(mesh.shape[a] for a in axes)
+            ok = i < len(leaf.shape) and leaf.shape[i] % total == 0
+            out.append(axis if ok else None)
+        return P(*out)
+
+    return jax.tree.map(
+        fix, specs, abstract, is_leaf=lambda x: isinstance(x, P)
+    )
+
+
+def commit_to_mesh(tree, mesh: Mesh):
+    """Replicate onto ``mesh`` every leaf not already sharded over it.
+
+    Optimizer moments created by ``optax.init`` inherit the params'
+    NamedShardings via ``zeros_like``, but scalar counters come out pinned
+    to the default device; mixing the two breaks jit (incompatible device
+    sets) and checkpoint restores. This commits the stragglers as
+    mesh-replicated without touching already-sharded leaves.
+    """
+    replicated = NamedSharding(mesh, P())
+
+    def fix(x):
+        sh = getattr(x, "sharding", None)
+        if isinstance(sh, NamedSharding) and sh.mesh == mesh:
+            return x
+        return jax.device_put(x, replicated)
+
+    return jax.tree.map(fix, tree)
+
+
 def local_batch_size(global_batch: int, mesh: Mesh) -> int:
     dp_total = mesh.shape["dp"] * mesh.shape["fsdp"]
     if global_batch % dp_total:

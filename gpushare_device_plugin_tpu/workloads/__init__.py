@@ -12,4 +12,5 @@ code.
 
 from .bert import BertConfig  # noqa: F401
 from .resnet import ResNetConfig  # noqa: F401
+from .trainer import TrainLoopConfig, run_train_loop  # noqa: F401
 from .transformer import TransformerConfig  # noqa: F401

@@ -29,7 +29,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..parallel.mesh import batch_sharding
+from ..parallel.mesh import batch_sharding, commit_to_mesh, prune_unshardable
 from .attention import flash_or_plain
 
 Params = dict[str, Any]
@@ -119,8 +119,10 @@ def param_specs(cfg: BertConfig) -> Params:
 
 
 def param_shardings(mesh: Mesh, cfg: BertConfig) -> Params:
+    abstract = jax.eval_shape(lambda: init_params(jax.random.key(0), cfg))
+    specs = prune_unshardable(param_specs(cfg), abstract, mesh)
     return jax.tree.map(
-        lambda spec: NamedSharding(mesh, spec), param_specs(cfg),
+        lambda spec: NamedSharding(mesh, spec), specs,
         is_leaf=lambda x: isinstance(x, P),
     )
 
@@ -239,7 +241,7 @@ def init_train_state(rng: jax.Array, mesh: Mesh, cfg: BertConfig, optimizer=None
     opt = optimizer or make_optimizer()
     psh = param_shardings(mesh, cfg)
     params = jax.jit(lambda k: init_params(k, cfg), out_shardings=psh)(rng)
-    opt_state = opt.init(params)
+    opt_state = commit_to_mesh(opt.init(params), mesh)  # see transformer
     return params, opt_state
 
 

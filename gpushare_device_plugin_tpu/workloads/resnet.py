@@ -34,6 +34,8 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..parallel.mesh import commit_to_mesh, prune_unshardable
+
 Params = dict[str, Any]
 
 
@@ -159,8 +161,10 @@ def param_specs(cfg: ResNetConfig) -> Params:
 
 
 def param_shardings(mesh: Mesh, cfg: ResNetConfig) -> Params:
+    abstract, _ = jax.eval_shape(lambda: init_params(jax.random.key(0), cfg))
+    specs = prune_unshardable(param_specs(cfg), abstract, mesh)
     return jax.tree.map(
-        lambda s: NamedSharding(mesh, s), param_specs(cfg),
+        lambda s: NamedSharding(mesh, s), specs,
         is_leaf=lambda x: isinstance(x, P),
     )
 
@@ -314,7 +318,7 @@ def init_train_state(rng: jax.Array, mesh: Mesh, cfg: ResNetConfig, optimizer=No
     params, state = jax.jit(
         lambda k: init_params(k, cfg), out_shardings=(psh, ssh)
     )(rng)
-    opt_state = opt.init(params)
+    opt_state = commit_to_mesh(opt.init(params), mesh)  # see transformer
     return params, state, opt_state
 
 

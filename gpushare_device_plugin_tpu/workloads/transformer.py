@@ -32,7 +32,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..parallel.mesh import batch_sharding
+from ..parallel.mesh import batch_sharding, commit_to_mesh, prune_unshardable
 from ..parallel.ring import ring_attention
 from .attention import flash_or_plain
 
@@ -131,9 +131,11 @@ def param_specs(cfg: TransformerConfig) -> Params:
 
 
 def param_shardings(mesh: Mesh, cfg: TransformerConfig) -> Params:
+    abstract = jax.eval_shape(lambda: init_params(jax.random.key(0), cfg))
+    specs = prune_unshardable(param_specs(cfg), abstract, mesh)
     return jax.tree.map(
         lambda spec: NamedSharding(mesh, spec),
-        param_specs(cfg),
+        specs,
         is_leaf=lambda x: isinstance(x, P),
     )
 
@@ -245,10 +247,7 @@ def make_train_step(mesh: Mesh, cfg: TransformerConfig, optimizer=None):
     the ring axis. Params/opt-state keep their NamedShardings (donated).
     """
     opt = optimizer or make_optimizer()
-    pspecs = param_specs(cfg)
-    psh = jax.tree.map(
-        lambda s: NamedSharding(mesh, s), pspecs, is_leaf=lambda x: isinstance(x, P)
-    )
+    psh = param_shardings(mesh, cfg)
     data_sh = batch_sharding(mesh, seq_parallel=cfg.seq_parallel)
 
     def step(params, opt_state, tokens):
@@ -277,8 +276,10 @@ def init_train_state(rng: jax.Array, mesh: Mesh, cfg: TransformerConfig, optimiz
     opt = optimizer or make_optimizer()
     psh = param_shardings(mesh, cfg)
     params = jax.jit(lambda k: init_params(k, cfg), out_shardings=psh)(rng)
-    # zeros_like in opt.init inherits each param's sharding.
-    opt_state = opt.init(params)
+    # Moment buffers inherit each param's sharding via zeros_like; scalar
+    # counters get committed mesh-replicated (uncommitted scalars collide
+    # with mesh-sharded params after a checkpoint restore).
+    opt_state = commit_to_mesh(opt.init(params), mesh)
     return params, opt_state
 
 

@@ -168,3 +168,17 @@ def test_stop_returns_promptly_on_idle_watch(api):
     inf.stop()
     assert time.monotonic() - t0 < 2.0
     assert inf._thread is None
+
+
+def test_is_read_timeout_classification():
+    import requests
+    import urllib3.exceptions
+
+    from gpushare_device_plugin_tpu.cluster.informer import _is_read_timeout
+
+    rte = urllib3.exceptions.ReadTimeoutError(None, "/api/v1/pods", "read timed out")
+    # requests wraps streaming read timeouts in ConnectionError(rte)
+    assert _is_read_timeout(requests.exceptions.ConnectionError(rte))
+    assert _is_read_timeout(requests.exceptions.ReadTimeout())
+    assert not _is_read_timeout(requests.exceptions.ConnectionError("refused"))
+    assert not _is_read_timeout(ValueError("boom"))

@@ -205,3 +205,23 @@ def test_initialize_multihost_calls_jax_distributed(monkeypatch):
         "num_processes": 2,
         "process_id": 1,
     }
+
+
+def test_prune_unshardable_axes():
+    from gpushare_device_plugin_tpu.parallel.mesh import prune_unshardable
+
+    mesh = make_mesh(MeshSpec(dp=1, fsdp=2, tp=4))
+    specs = {
+        "kernel": jax.sharding.PartitionSpec("fsdp", "tp"),
+        "bias": jax.sharding.PartitionSpec("tp"),
+        "big": jax.sharding.PartitionSpec(("dp", "fsdp"), "tp"),
+    }
+    abstract = {
+        "kernel": jax.ShapeDtypeStruct((16, 10), jnp.float32),  # 10 % 4 != 0
+        "bias": jax.ShapeDtypeStruct((10,), jnp.float32),
+        "big": jax.ShapeDtypeStruct((8, 8), jnp.float32),
+    }
+    pruned = prune_unshardable(specs, abstract, mesh)
+    assert pruned["kernel"] == jax.sharding.PartitionSpec("fsdp", None)
+    assert pruned["bias"] == jax.sharding.PartitionSpec(None)
+    assert pruned["big"] == jax.sharding.PartitionSpec(("dp", "fsdp"), "tp")

@@ -1,0 +1,113 @@
+"""Training loop: checkpoint/resume equivalence on the virtual CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gpushare_device_plugin_tpu.parallel import MeshSpec, make_mesh
+from gpushare_device_plugin_tpu.workloads import bert, resnet
+from gpushare_device_plugin_tpu.workloads.transformer import TransformerConfig
+from gpushare_device_plugin_tpu.workloads.trainer import (
+    BertTask,
+    DecoderTask,
+    ResNetTask,
+    TrainLoopConfig,
+    run_train_loop,
+)
+
+TINY = TransformerConfig(
+    vocab=64, d_model=32, n_layers=2, n_heads=4, d_ff=64, max_seq=32,
+    compute_dtype=jnp.float32,
+)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(MeshSpec(dp=1, fsdp=2, tp=4))
+
+
+def test_loop_runs_and_loss_decreases(mesh):
+    task = DecoderTask(TINY, batch=8, seq=32)
+    losses = []
+    run_train_loop(
+        task, mesh, TrainLoopConfig(total_steps=12, log_every=1), jax.random.key(0),
+        on_metrics=lambda s, l: losses.append(l),
+    )
+    assert losses[-1] < losses[0]
+
+
+def test_resume_reproduces_uninterrupted_run(mesh, tmp_path):
+    """Interrupted-at-step-6 + resumed == one uninterrupted 12-step run,
+    to bitwise parameter equality (deterministic batches via fold_in)."""
+    task = DecoderTask(TINY, batch=4, seq=16)
+    rng = jax.random.key(7)
+
+    ref_state, ref_loss = run_train_loop(
+        task, mesh, TrainLoopConfig(total_steps=12, log_every=0), rng
+    )
+
+    ckpt = str(tmp_path / "ckpt")
+    # Run 1: "preempted" after step 5 (ckpt_every=3 -> saves at 2 and 5).
+    run_train_loop(
+        task, mesh,
+        TrainLoopConfig(total_steps=6, log_every=0, ckpt_dir=ckpt, ckpt_every=3),
+        rng,
+    )
+    # Run 2: same pod restarted; resumes from the latest checkpoint.
+    resumed_state, resumed_loss = run_train_loop(
+        task, mesh,
+        TrainLoopConfig(total_steps=12, log_every=0, ckpt_dir=ckpt, ckpt_every=3),
+        rng,
+    )
+    for a, b in zip(jax.tree.leaves(ref_state), jax.tree.leaves(resumed_state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert resumed_loss == pytest.approx(ref_loss)
+
+
+def test_resume_preserves_shardings(mesh, tmp_path):
+    task = DecoderTask(TINY, batch=4, seq=16)
+    ckpt = str(tmp_path / "ckpt")
+    run_train_loop(
+        task, mesh,
+        TrainLoopConfig(total_steps=2, log_every=0, ckpt_dir=ckpt, ckpt_every=2),
+        jax.random.key(0),
+    )
+    state, _ = run_train_loop(
+        task, mesh,
+        TrainLoopConfig(total_steps=3, log_every=0, ckpt_dir=ckpt, ckpt_every=10),
+        jax.random.key(0),
+    )
+    embed = state[0]["embed"]
+    assert embed.sharding.mesh.shape["tp"] == 4
+
+
+def test_bert_task_loop(mesh):
+    cfg = bert.BertConfig(
+        vocab=64, d_model=32, n_layers=1, n_heads=4, d_ff=64,
+        compute_dtype=jnp.float32,
+    )
+    _, loss = run_train_loop(
+        BertTask(cfg, batch=4, seq=16), mesh,
+        TrainLoopConfig(total_steps=4, log_every=0), jax.random.key(0),
+    )
+    assert np.isfinite(loss)
+
+
+def test_resnet_task_loop_with_ckpt(mesh, tmp_path):
+    cfg = resnet.ResNetConfig(
+        stage_sizes=(1, 1), width=8, num_classes=10, compute_dtype=jnp.float32
+    )
+    ckpt = str(tmp_path / "ckpt")
+    run_train_loop(
+        ResNetTask(cfg, batch=8), mesh,
+        TrainLoopConfig(total_steps=3, log_every=0, ckpt_dir=ckpt, ckpt_every=2),
+        jax.random.key(0),
+    )
+    state, loss = run_train_loop(
+        ResNetTask(cfg, batch=8), mesh,
+        TrainLoopConfig(total_steps=5, log_every=0, ckpt_dir=ckpt, ckpt_every=10),
+        jax.random.key(0),
+    )
+    assert np.isfinite(loss)
+    assert len(state) == 3  # params, bn state, opt state
