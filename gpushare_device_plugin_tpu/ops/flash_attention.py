@@ -111,7 +111,13 @@ def _fwd_kernel(
         lse_ref[0] = jnp.broadcast_to(lse, lse_ref[0].shape)
 
 
-def _fwd(q, k, v, *, scale, causal, block_q, block_k, interpret):
+def _kv_row(b, heads, kv_heads):
+    """Grid row over B*heads -> row of the grouped [B*kv_heads, S, D] K/V."""
+    groups = heads // kv_heads
+    return (b // heads) * kv_heads + (b % heads) // groups
+
+
+def _fwd(q, k, v, *, scale, causal, block_q, block_k, heads, kv_heads, interpret):
     BH, S, D = q.shape
     num_q = S // block_q
     num_kv = S // block_k
@@ -120,13 +126,16 @@ def _fwd(q, k, v, *, scale, causal, block_q, block_k, interpret):
         scale=scale, causal=causal,
         block_q=block_q, block_k=block_k, num_kv=num_kv,
     )
+    # GQA-native: K/V stay [B*kv_heads, S, D] in HBM; each query head's
+    # grid row streams its group's KV blocks directly (no repeated copy).
+    kv_map = lambda b, i, j: (_kv_row(b, heads, kv_heads), j, 0)  # noqa: E731
     o, lse = pl.pallas_call(
         kernel,
         grid=(BH, num_q, num_kv),
         in_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), kv_map),
+            pl.BlockSpec((1, block_k, D), kv_map),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
@@ -199,9 +208,13 @@ def _dkv_kernel(
     *, scale, causal, block_q, block_k, num_q,
 ):
     ki = pl.program_id(1)
-    qi = pl.program_id(2)
+    # Innermost dim fuses (group member, q block): dK/dV of one KV head sum
+    # contributions from every query head in its group, so the whole group
+    # runs under one accumulator before the single writeback.
+    qi = pl.program_id(2) % num_q
+    gq = pl.program_id(2)
 
-    @pl.when(qi == 0)
+    @pl.when(gq == 0)
     def _init():
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
@@ -236,20 +249,26 @@ def _dkv_kernel(
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
 
-    @pl.when(qi == num_q - 1)
+    @pl.when(gq == pl.num_programs(2) - 1)
     def _finalize():
         dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _bwd(q, k, v, o, lse, do, *, scale, causal, block_q, block_k, interpret):
+def _bwd(
+    q, k, v, o, lse, do, *, scale, causal, block_q, block_k, heads, kv_heads,
+    interpret,
+):
     BH, S, D = q.shape
+    BKV = k.shape[0]
+    groups = heads // kv_heads
     num_q = S // block_q
     num_kv = S // block_k
     # delta_i = rowsum(dO * O): tiny elementwise reduce, XLA fuses it.
     delta_row = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     delta = jnp.broadcast_to(delta_row[..., None], (BH, S, STAT_LANES))
 
+    kv_map = lambda b, i, j: (_kv_row(b, heads, kv_heads), j, 0)  # noqa: E731
     dq = pl.pallas_call(
         functools.partial(
             _dq_kernel, scale=scale, causal=causal,
@@ -258,8 +277,8 @@ def _bwd(q, k, v, o, lse, do, *, scale, causal, block_q, block_k, interpret):
         grid=(BH, num_q, num_kv),
         in_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), kv_map),
+            pl.BlockSpec((1, block_k, D), kv_map),
             pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, STAT_LANES), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, STAT_LANES), lambda b, i, j: (b, i, 0)),
@@ -270,27 +289,35 @@ def _bwd(q, k, v, o, lse, do, *, scale, causal, block_q, block_k, interpret):
         interpret=interpret,
     )(q, k, v, do, lse, delta)
 
+    # dK/dV grid runs over KV heads; the innermost dim is (group member,
+    # q block) so one KV head's accumulator sums its whole query group.
+    # Q-side rows for grid cell b (a KV-head row) and inner index gq:
+    #   q_row = (b // kv_heads) * heads + (b % kv_heads) * groups + gq // num_q
+    def q_map(b, j, gq):
+        row = (b // kv_heads) * heads + (b % kv_heads) * groups + gq // num_q
+        return (row, gq % num_q, 0)
+
     dk, dv = pl.pallas_call(
         functools.partial(
             _dkv_kernel, scale=scale, causal=causal,
             block_q=block_q, block_k=block_k, num_q=num_q,
         ),
-        grid=(BH, num_kv, num_q),
+        grid=(BKV, num_kv, groups * num_q),
         in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, STAT_LANES), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, STAT_LANES), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, D), q_map),
+            pl.BlockSpec((1, block_k, D), lambda b, j, gq: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, j, gq: (b, j, 0)),
+            pl.BlockSpec((1, block_q, D), q_map),
+            pl.BlockSpec((1, block_q, STAT_LANES), q_map),
+            pl.BlockSpec((1, block_q, STAT_LANES), q_map),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, j, gq: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, j, gq: (b, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((BH, S, D), k.dtype),
-            jax.ShapeDtypeStruct((BH, S, D), v.dtype),
+            jax.ShapeDtypeStruct((BKV, S, D), k.dtype),
+            jax.ShapeDtypeStruct((BKV, S, D), v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, D), jnp.float32),
@@ -306,28 +333,29 @@ def _bwd(q, k, v, o, lse, do, *, scale, causal, block_q, block_k, interpret):
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, scale, causal, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _flash(q, k, v, scale, causal, block_q, block_k, heads, kv_heads, interpret):
     o, _ = _fwd(
-        q, k, v, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k, interpret=interpret,
+        q, k, v, scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+        heads=heads, kv_heads=kv_heads, interpret=interpret,
     )
     return o
 
 
-def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, heads, kv_heads, interpret):
     o, lse = _fwd(
-        q, k, v, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k, interpret=interpret,
+        q, k, v, scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+        heads=heads, kv_heads=kv_heads, interpret=interpret,
     )
     return o, (q, k, v, o, lse)
 
 
-def _flash_bwd(scale, causal, block_q, block_k, interpret, res, do):
+def _flash_bwd(scale, causal, block_q, block_k, heads, kv_heads, interpret, res, do):
     q, k, v, o, lse = res
     dq, dk, dv = _bwd(
         q, k, v, o, lse, do, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k, interpret=interpret,
+        block_q=block_q, block_k=block_k, heads=heads, kv_heads=kv_heads,
+        interpret=interpret,
     )
     return dq, dk, dv
 
@@ -349,6 +377,12 @@ def flash_attention(
     """Flash attention over ``[B, S, H, D]`` arrays (layout of
     :func:`..parallel.ring.full_attention`, the correctness oracle).
 
+    **GQA-native**: ``k``/``v`` may carry fewer heads than ``q`` (``H`` a
+    multiple of ``Hkv``; KV head ``i`` serves query heads
+    ``[i*g, (i+1)*g)``). The grouped K/V stream through the kernel as-is —
+    no repeated copies in HBM, 1/g the KV bandwidth — and dK/dV accumulate
+    each query group inside the kernel before a single writeback.
+
     ``interpret=None`` autodetects: compiled Mosaic on TPU, Pallas
     interpreter elsewhere (CPU tests, the virtual-device mesh harness).
     Sequence length must be divisible by the (auto-shrunk) block sizes.
@@ -356,6 +390,9 @@ def flash_attention(
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     B, S, H, D = q.shape
+    Hkv = k.shape[2]
+    if H % Hkv:
+        raise ValueError(f"q heads {H} not a multiple of kv heads {Hkv}")
     block_q = min(block_q, S)
     block_k = min(block_k, S)
     if S % block_q or S % block_k:
@@ -364,8 +401,11 @@ def flash_attention(
         )
     sc = scale if scale is not None else 1.0 / math.sqrt(D)
 
-    def fold(x):  # [B, S, H, D] -> [B*H, S, D]
-        return x.transpose(0, 2, 1, 3).reshape(B * H, S, x.shape[-1])
+    def fold(x):  # [B, S, h, D] -> [B*h, S, D]
+        h = x.shape[2]
+        return x.transpose(0, 2, 1, 3).reshape(B * h, S, x.shape[-1])
 
-    o = _flash(fold(q), fold(k), fold(v), sc, causal, block_q, block_k, interpret)
+    o = _flash(
+        fold(q), fold(k), fold(v), sc, causal, block_q, block_k, H, Hkv, interpret
+    )
     return o.reshape(B, H, S, D).transpose(0, 2, 1, 3)

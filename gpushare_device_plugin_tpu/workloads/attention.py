@@ -45,13 +45,19 @@ def grouped_full_attention(
     return out.reshape(B, S, H, Dh)
 
 
-def use_flash(attention: str, q: jax.Array, mesh: Mesh | None) -> bool:
+def use_flash(
+    attention: str,
+    q: jax.Array,
+    mesh: Mesh | None,
+    kv_heads: int | None = None,
+) -> bool:
     """Pick the attention implementation at trace time (shapes are static).
 
     "auto" engages the kernel only when every constraint of the shard_map
-    route holds (batch divisible by dp*fsdp, heads by tp, sequence by the
-    kernel block) — otherwise it silently keeps the always-correct plain
-    path. "flash" skips the checks so a misfit config fails loudly.
+    route holds (batch divisible by dp*fsdp, both q and grouped-kv heads
+    by tp, sequence by the kernel block) — otherwise it silently keeps the
+    always-correct plain path. "flash" skips the checks so a misfit config
+    fails loudly.
     """
     if attention == "flash":
         return True
@@ -68,7 +74,8 @@ def use_flash(attention: str, q: jax.Array, mesh: Mesh | None) -> bool:
         return False
     if mesh is not None:
         data = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
-        if B % data or H % mesh.shape.get("tp", 1):
+        tp = mesh.shape.get("tp", 1)
+        if B % data or H % tp or (kv_heads or H) % tp:
             return False
     return True
 
@@ -84,18 +91,12 @@ def flash_or_plain(
 ) -> jax.Array:
     """Dispatch [B, S, H, Dh] attention to flash (per-shard) or plain.
 
-    K/V may carry fewer (grouped/GQA) heads than Q. The plain path keeps
-    them grouped end-to-end; the flash path repeats them to full heads at
-    the kernel boundary (the Pallas kernel takes matching head counts — a
-    grouped-native kernel is future work, so GQA's KV-bytes saving applies
-    to HBM-resident weights/activations but not inside the kernel call).
+    K/V may carry fewer (grouped/GQA) heads than Q; both paths consume
+    them grouped end-to-end (the Pallas kernel is GQA-native — KV blocks
+    stream at 1/g the bandwidth, never repeated in HBM).
     """
-    groups = q.shape[2] // k.shape[2]
-    if not use_flash(attention, q, mesh):
+    if not use_flash(attention, q, mesh, kv_heads=k.shape[2]):
         return grouped_full_attention(q, k, v, causal=causal)
-    if groups > 1:
-        k = jnp.repeat(k, groups, axis=2)
-        v = jnp.repeat(v, groups, axis=2)
     if mesh is None:
         return flash_attention(q, k, v, causal=causal)
     # XLA cannot partition a custom call, so the kernel runs per-shard

@@ -78,3 +78,62 @@ def test_jit_and_block_shrink():
     out = f(q, k, v)
     ref = full_attention(q, k, v, causal=True)
     assert jnp.allclose(out, ref, atol=2e-5)
+
+
+# --- GQA-native path (grouped KV heads stream through the kernel) ----------
+
+
+def make_gqa_qkv(key, B, S, H, Hkv, D, dtype=jnp.float32):
+    kq, kk, kv = jax.random.split(key, 3)
+    return (
+        jax.random.normal(kq, (B, S, H, D), dtype),
+        jax.random.normal(kk, (B, S, Hkv, D), dtype),
+        jax.random.normal(kv, (B, S, Hkv, D), dtype),
+    )
+
+
+def gqa_oracle(q, k, v, causal):
+    """Repeat-KV reference: kv head i serves query heads [i*g, (i+1)*g)."""
+    g = q.shape[2] // k.shape[2]
+    return full_attention(
+        q, jnp.repeat(k, g, axis=2), jnp.repeat(v, g, axis=2), causal=causal
+    )
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("H,Hkv", [(4, 2), (4, 1)])
+def test_gqa_forward_matches_repeat_oracle(causal, H, Hkv):
+    q, k, v = make_gqa_qkv(jax.random.key(3), B=2, S=128, H=H, Hkv=Hkv, D=32)
+    out = flash_attention(
+        q, k, v, causal=causal, block_q=64, block_k=64, interpret=True
+    )
+    ref = gqa_oracle(q, k, v, causal)
+    assert jnp.allclose(out, ref, atol=2e-5), float(jnp.abs(out - ref).max())
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_gqa_gradients_match_repeat_oracle(causal):
+    q, k, v = make_gqa_qkv(jax.random.key(4), B=1, S=64, H=4, Hkv=2, D=32)
+
+    def loss_flash(q, k, v):
+        out = flash_attention(
+            q, k, v, causal=causal, block_q=32, block_k=32, interpret=True
+        )
+        return jnp.sum(out * out)
+
+    def loss_ref(q, k, v):
+        out = gqa_oracle(q, k, v, causal)
+        return jnp.sum(out * out)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", gf, gr):
+        assert jnp.allclose(a, b, atol=5e-5), (
+            name, float(jnp.abs(a - b).max())
+        )
+
+
+def test_gqa_rejects_non_multiple_heads():
+    q, k, v = make_gqa_qkv(jax.random.key(5), B=1, S=64, H=4, Hkv=3, D=32)
+    with pytest.raises(ValueError, match="multiple"):
+        flash_attention(q, k, v, interpret=True)
