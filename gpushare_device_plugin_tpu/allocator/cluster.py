@@ -43,6 +43,12 @@ class AllocationFailure(RuntimeError):
     """Raised to fail pod admission (gRPC error -> UnexpectedAdmissionError)."""
 
 
+class _PodGone(RuntimeError):
+    """The matched pod 404ed on PATCH: deleted while its cache entry or
+    DELETED watch event was in flight. Internal signal — the allocator
+    evicts the stale entry and re-matches once."""
+
+
 class ClusterAllocator:
     def __init__(
         self,
@@ -83,19 +89,33 @@ class ClusterAllocator:
                     f"invalid allocation request: no pending pod on {self._node} "
                     f"requesting {pod_units} {const.RESOURCE_MEM}"
                 )
-            if P.is_assumed(pod) and not P.is_assigned(pod):
-                idx = self._assumed_chip(pod)
-                annotations = {const.ENV_ASSIGNED_FLAG: "true"}
-            else:
-                idx = self._binpack_chip(pod_units)
-                annotations = {
-                    const.ENV_MEM_IDX: str(idx),
-                    const.ENV_MEM_POD: str(pod_units),
-                    const.ENV_MEM_DEV: str(self._chip_total(idx)),
-                    const.ENV_ASSIGNED_FLAG: "true",
-                }
-            annotations[const.ENV_ASSUME_TIME] = str(time.time_ns())
-            self._persist(pod, annotations)
+            for attempt in (0, 1):
+                idx, annotations = self._place(pod, pod_units)
+                try:
+                    self._persist(pod, annotations)
+                    break
+                except _PodGone:
+                    # The matched pod was deleted with its cache entry still
+                    # live — evict it and re-match so a live same-size pod
+                    # is not failed for a ghost's sake.
+                    log.warning(
+                        "pod %s/%s vanished during persist; re-matching",
+                        P.namespace(pod), P.name(pod),
+                    )
+                    self._pods.evict(pod)
+                    if attempt:
+                        raise AllocationFailure(
+                            f"no live pending pod on {self._node} requesting "
+                            f"{pod_units} {const.RESOURCE_MEM}"
+                        ) from None
+                    self._pods.refresh()
+                    pod = self._match_pending_pod(pod_units)
+                    if pod is None:
+                        raise AllocationFailure(
+                            f"invalid allocation request: no pending pod on "
+                            f"{self._node} requesting {pod_units} "
+                            f"{const.RESOURCE_MEM}"
+                        ) from None
         chip = self._inv.chip_by_id(self._inv.id_of_index(idx))
         total = self._chip_total(idx)
         log.info(
@@ -127,6 +147,22 @@ class ClusterAllocator:
             if P.mem_units_of_pod(pod) == pod_units:
                 return pod
         return None
+
+    def _place(self, pod, pod_units: int) -> tuple[int, dict[str, str]]:
+        """Decide the chip and the annotations to persist for one pod."""
+        if P.is_assumed(pod) and not P.is_assigned(pod):
+            idx = self._assumed_chip(pod)
+            annotations = {const.ENV_ASSIGNED_FLAG: "true"}
+        else:
+            idx = self._binpack_chip(pod_units)
+            annotations = {
+                const.ENV_MEM_IDX: str(idx),
+                const.ENV_MEM_POD: str(pod_units),
+                const.ENV_MEM_DEV: str(self._chip_total(idx)),
+                const.ENV_ASSIGNED_FLAG: "true",
+            }
+        annotations[const.ENV_ASSUME_TIME] = str(time.time_ns())
+        return idx, annotations
 
     def _assumed_chip(self, pod) -> int:
         """Branch A: trust the scheduler extender's placement."""
@@ -166,12 +202,16 @@ class ClusterAllocator:
         try:
             updated = self._api.patch_pod(ns, name, patch)
         except ApiError as e:
+            if e.status == 404:
+                raise _PodGone(f"{ns}/{name}") from e
             if const.OPTIMISTIC_LOCK_ERROR_MSG not in e.body and e.status != 409:
                 raise AllocationFailure(f"pod patch failed: {e}") from e
             log.warning("patch conflict for %s/%s; retrying once", ns, name)
             try:
                 updated = self._api.patch_pod(ns, name, patch)
             except ApiError as e2:
+                if e2.status == 404:
+                    raise _PodGone(f"{ns}/{name}") from e2
                 raise AllocationFailure(f"pod patch failed twice: {e2}") from e2
         # Cached sources must see the assignment before the MODIFIED event
         # arrives, or the next Allocate could re-match this pod.

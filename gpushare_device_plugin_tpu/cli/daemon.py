@@ -122,13 +122,22 @@ def main(argv=None) -> int:
         else:
             pod_source = apisrc
 
-    manager = TpuShareManager(backend, cfg, api_client=api_client, pod_source=pod_source)
-    manager.install_signal_handlers()
-    log.info(
-        "tpushare-device-plugin starting: discovery=%s policy=%s standalone=%s",
-        args.discovery, args.policy, args.standalone,
-    )
-    manager.run()
+    try:
+        manager = TpuShareManager(
+            backend, cfg, api_client=api_client, pod_source=pod_source
+        )
+        manager.install_signal_handlers()
+        log.info(
+            "tpushare-device-plugin starting: discovery=%s policy=%s standalone=%s",
+            args.discovery, args.policy, args.standalone,
+        )
+        manager.run()
+    finally:
+        # The informer owns a watch thread + open HTTP stream; shut it down
+        # with the manager instead of abandoning it to process teardown.
+        stop = getattr(pod_source, "stop", None)
+        if callable(stop):
+            stop()
     return 0
 
 

@@ -175,7 +175,9 @@ class ApiServerClient:
         field_selector: str = "",
         label_selector: str = "",
     ) -> list[dict]:
-        if namespace is None:
+        # Any falsy namespace ("" or None) means all namespaces — "" must
+        # not build the malformed path /api/v1/namespaces//pods.
+        if not namespace:
             return self.list_pods_with_rv(field_selector, label_selector)[0]
         params = {}
         if field_selector:

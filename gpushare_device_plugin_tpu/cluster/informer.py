@@ -64,9 +64,12 @@ def _is_read_timeout(e: Exception) -> bool:
     return False
 
 
-def _rv_int(pod: dict) -> int | None:
-    rv = pod.get("metadata", {}).get("resourceVersion", "")
+def _parse_rv(rv) -> int | None:
     return int(rv) if isinstance(rv, str) and rv.isdigit() else None
+
+
+def _rv_int(pod: dict) -> int | None:
+    return _parse_rv(pod.get("metadata", {}).get("resourceVersion", ""))
 
 
 class PodInformer:
@@ -79,6 +82,9 @@ class PodInformer:
         self._node = node_name
         self._field_selector = f"spec.nodeName={node_name}"
         self._cache: dict[tuple[str, str], dict] = {}
+        # key -> rv at eviction: blocks lagging in-flight watch events from
+        # resurrecting a pod the apiserver reported gone (PATCH 404)
+        self._tombstones: dict[tuple[str, str], int] = {}
         self._lock = threading.Lock()
         self._synced = threading.Event()
         self._stop = threading.Event()
@@ -137,20 +143,56 @@ class PodInformer:
 
     def _relist(self) -> str:
         items, rv = self._c.list_pods_with_rv(field_selector=self._field_selector)
-        with self._lock:
-            self._cache = {self._key(p): p for p in items}
+        # rv-guarded merge, NOT a wholesale replace: a LIST served just
+        # before a concurrent PATCH/evict landed must not revert the
+        # note_pod_update/evict state (that would re-open the re-match
+        # window on the Allocate path).
+        self._merge_list(items, rv, gc_tombstones=True)
         self._synced.set()
         log.v(4, "informer listed %d pods at rv=%s", len(items), rv)
         return rv
+
+    def _merge_list(self, items: list[dict], rv: str, gc_tombstones: bool = False) -> None:
+        """Fold an authoritative LIST into the cache: prune absences not
+        provably newer than the LIST, keep newer cached entries.
+
+        ``gc_tombstones`` drops tombstones older than the LIST rv — only
+        valid from the watch thread itself (it re-watches from this rv, so
+        no older event can arrive); refresh() callers race the live stream
+        and must keep them.
+        """
+        list_rv = _parse_rv(rv)
+        with self._lock:
+            listed = {self._key(p) for p in items}
+            for key in [k for k in self._cache if k not in listed]:
+                cached_rv = _rv_int(self._cache[key])
+                if list_rv is None or cached_rv is None or cached_rv <= list_rv:
+                    self._cache.pop(key)
+            for key, tomb in list(self._tombstones.items()):
+                if key in listed:
+                    # present in an authoritative LIST -> live now
+                    self._tombstones.pop(key)
+                elif gc_tombstones and list_rv is not None and tomb <= list_rv:
+                    self._tombstones.pop(key)
+            for p in items:
+                self._store_if_newer(self._key(p), p)
 
     def _store_if_newer(self, key: tuple[str, str], pod: dict) -> None:
         """Caller must hold self._lock. Drops updates whose resourceVersion
         is not newer than the cached entry's — an in-flight older watch
         event must not revert a pod fed in by note_pod_update()/refresh()
         (that would re-open the re-match window those hooks close)."""
+        new_rv = _rv_int(pod)
+        tomb = self._tombstones.get(key)
+        if tomb is not None:
+            # A lagging pre-deletion event must not resurrect an evicted
+            # ghost; anything provably newer is a legitimate recreation.
+            if new_rv is None or new_rv <= tomb:
+                return
+            self._tombstones.pop(key, None)
         cached = self._cache.get(key)
         if cached is not None:
-            old_rv, new_rv = _rv_int(cached), _rv_int(pod)
+            old_rv = _rv_int(cached)
             if old_rv is not None and new_rv is not None and new_rv <= old_rv:
                 return
         self._cache[key] = pod
@@ -159,7 +201,24 @@ class PodInformer:
         key = self._key(pod)
         with self._lock:
             if etype == "DELETED":
-                self._cache.pop(key, None)
+                # rv-guarded like stores: a lagging DELETED for an old
+                # instance of the name must not evict a live recreation
+                # that refresh() already cached at a higher rv.
+                cached = self._cache.get(key)
+                ev_rv, cached_rv = _rv_int(pod), (
+                    _rv_int(cached) if cached is not None else None
+                )
+                if (
+                    cached_rv is None
+                    or ev_rv is None
+                    or cached_rv <= ev_rv
+                ):
+                    self._cache.pop(key, None)
+                # the real deletion arrived; the tombstone has served its
+                # purpose (a later recreation must not be blocked)
+                tomb = self._tombstones.get(key)
+                if tomb is not None and (ev_rv is None or ev_rv >= tomb):
+                    self._tombstones.pop(key)
             elif etype in ("ADDED", "MODIFIED"):
                 self._store_if_newer(key, pod)
         # A pod moving OFF this node arrives as MODIFIED with a different
@@ -239,18 +298,35 @@ class PodInformer:
         miss. Retried like the list-backed source's reads (the allocator
         calls this exactly when admission hangs on the answer, so it must
         not be weaker than the reference's always-LIST path). The watch
-        keeps streaming independently; a deletion racing this merge is
-        healed by the next watch event or relist."""
+        keeps streaming independently.
+
+        Deletions are reconciled too: a cached pod absent from the LIST
+        whose resourceVersion predates the LIST's collection rv is gone on
+        the server (its DELETED event is in flight or the watch is lagging)
+        and must not stay matchable — a stale pending pod matched ahead of
+        the real same-size pod turns into a 404 on PATCH and a terminal
+        UnexpectedAdmissionError for the innocent pod."""
         from ..utils.retry import retry
 
-        items, _ = retry(
+        items, rv = retry(
             lambda: self._c.list_pods_with_rv(field_selector=self._field_selector),
             attempts=REFRESH_RETRIES,
             delay_s=REFRESH_DELAY_S,
         )
+        self._merge_list(items, rv)
+
+    def evict(self, pod: dict) -> None:
+        """Drop a pod the apiserver reported gone (PATCH 404) so the next
+        match cannot pick it again ahead of a live same-size pod. A
+        tombstone at the evicted rv keeps lagging in-flight watch events
+        from re-inserting the ghost behind our back."""
+        key = self._key(pod)
         with self._lock:
-            for p in items:
-                self._store_if_newer(self._key(p), p)
+            cached = self._cache.pop(key, None)
+            rv = _rv_int(cached) if cached is not None else None
+            if rv is None:
+                rv = _rv_int(pod)
+            self._tombstones[key] = rv if rv is not None else (1 << 62)
 
     def note_pod_update(self, pod: dict) -> None:
         """Feed a freshly-PATCHed pod straight into the cache so the next

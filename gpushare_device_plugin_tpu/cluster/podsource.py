@@ -47,6 +47,14 @@ class PodSource(Protocol):
         """Inform the source of a pod the caller just wrote (PATCH result)."""
         ...
 
+    def evict(self, pod: dict) -> None:
+        """Inform the source a pod is gone on the server (e.g. PATCH 404).
+
+        No-op for list-backed sources; the informer drops it from its cache
+        so a deleted pod can't shadow a live same-size candidate.
+        """
+        ...
+
 
 class ApiServerPodSource:
     def __init__(self, client: ApiServerClient, node_name: str):
@@ -58,6 +66,9 @@ class ApiServerPodSource:
 
     def note_pod_update(self, pod: dict) -> None:
         pass  # ditto
+
+    def evict(self, pod: dict) -> None:
+        pass  # nothing cached
 
     def pending_pods(self) -> list[dict]:
         return retry(
@@ -99,6 +110,9 @@ class KubeletPodSource:
 
     def note_pod_update(self, pod: dict) -> None:
         pass  # ditto
+
+    def evict(self, pod: dict) -> None:
+        pass  # nothing cached
 
     def _kubelet_pods(self) -> list[dict]:
         return retry(

@@ -157,6 +157,33 @@ class FakeApiServer:
                     self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
                     self.wfile.flush()
 
+                def obj_key(obj: dict) -> tuple[str, str]:
+                    meta = obj.get("metadata", {})
+                    return meta.get("namespace", "default"), meta.get("name", "")
+
+                # Per-watch selector match state: a real apiserver emits
+                # DELETED when an object it previously sent stops matching
+                # the selector (e.g. spec.nodeName changes away from a
+                # field-selector watch). Seed the state from the skipped
+                # prefix so transitions across `since` are seen.
+                matched: set[tuple[str, str]] = set()
+
+                def transition(etype: str, obj: dict):
+                    """-> (emit_type, obj) or None, updating match state."""
+                    key = obj_key(obj)
+                    now = _match_field_selector(obj, fs) and _match_label_selector(obj, ls)
+                    was = key in matched
+                    if etype == "DELETED":
+                        matched.discard(key)
+                        return ("DELETED", obj) if (was or now) else None
+                    if now:
+                        matched.add(key)
+                        return (etype, obj)
+                    if was:
+                        matched.discard(key)
+                        return ("DELETED", obj)
+                    return None
+
                 # Find the starting position once; thereafter the log is
                 # append-only so a slice from `pos` is the new batch (no
                 # full-history rescan under the shared lock per event).
@@ -166,6 +193,8 @@ class FakeApiServer:
                         pos < len(store._watch_log)
                         and store._watch_log[pos][0] <= since
                     ):
+                        _, petype, pobj = store._watch_log[pos]
+                        transition(petype, pobj)  # state only, nothing emitted
                         pos += 1
                 try:
                     while True:
@@ -178,13 +207,11 @@ class FakeApiServer:
                                 store._cond.wait(timeout=0.25)
                                 continue
                         for rv, etype, obj in batch:
-                            if not (
-                                _match_field_selector(obj, fs)
-                                and _match_label_selector(obj, ls)
-                            ):
+                            emit = transition(etype, obj)
+                            if emit is None:
                                 continue
                             line = (
-                                json.dumps({"type": etype, "object": obj}) + "\n"
+                                json.dumps({"type": emit[0], "object": emit[1]}) + "\n"
                             ).encode()
                             write_chunk(line)
                     write_chunk(b"")  # terminating chunk

@@ -222,3 +222,40 @@ def test_informer_does_not_rematch_just_assigned_pod(api):
         assert ann_b.get(const.ENV_ASSIGNED_FLAG) == "true"
     finally:
         informer.stop()
+
+
+def test_deleted_pod_404_evicts_and_rematches(api):
+    """A ghost pod (deleted, DELETED event lost) matched ahead of a live
+    same-size pod must not fail the live pod's admission: the PATCH 404
+    evicts the ghost and the match retries once (ADVICE round 1, medium)."""
+    alloc, client, informer = make_informer_allocator(api)
+    try:
+        api.add_pod(make_pod("ghost", 2, node=NODE, created="2026-01-01T00:00:00Z"))
+        informer.refresh()
+        assert any(
+            p["metadata"]["name"] == "ghost" for p in informer.pending_pods()
+        )
+        informer.stop()  # freeze: the DELETED below never reaches the cache
+        api.pods.pop(("default", "ghost"))
+        api.add_pod(make_pod("real", 2, node=NODE, created="2026-01-02T00:00:00Z"))
+        res = alloc.allocate(granted(2))
+        assert res[0].envs[const.ENV_MEM_POD] == "2"
+        ann = client.get_pod("default", "real")["metadata"]["annotations"]
+        assert ann[const.ENV_ASSIGNED_FLAG] == "true"
+    finally:
+        informer.stop()
+
+
+def test_deleted_pod_404_with_no_live_candidate_fails(api):
+    alloc, client, informer = make_informer_allocator(api)
+    try:
+        api.add_pod(make_pod("ghost", 2, node=NODE))
+        informer.refresh()
+        informer.stop()
+        api.pods.pop(("default", "ghost"))
+        with pytest.raises(AllocationFailure):
+            alloc.allocate(granted(2))
+        # the ghost is gone from the cache: nothing left to match
+        assert informer.pending_pods() == []
+    finally:
+        informer.stop()

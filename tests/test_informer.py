@@ -182,3 +182,100 @@ def test_is_read_timeout_classification():
     assert _is_read_timeout(requests.exceptions.ReadTimeout())
     assert not _is_read_timeout(requests.exceptions.ConnectionError("refused"))
     assert not _is_read_timeout(ValueError("boom"))
+
+
+def test_refresh_prunes_deleted_pods(api):
+    """A pod deleted while its DELETED event was lost must not survive a
+    refresh(): the LIST is authoritative for absences (ADVICE round 1)."""
+    inf = PodInformer(ApiServerClient(api.url), NODE).start(sync_timeout_s=5)
+    api.add_pod(make_pod("ghost", 2, node=NODE))
+    assert wait_until(lambda: len(inf.pending_pods()) == 1)
+    inf.stop()  # freeze the watch: the DELETED event below is never seen
+    api.pods.pop(("default", "ghost"))  # server-side delete, no event
+    assert [p["metadata"]["name"] for p in inf.pending_pods()] == ["ghost"]
+    inf.refresh()
+    assert inf.pending_pods() == []
+
+
+def test_refresh_keeps_entries_newer_than_list(api):
+    """note_pod_update entries newer than the LIST rv survive the prune."""
+    inf = PodInformer(ApiServerClient(api.url), NODE).start(sync_timeout_s=5)
+    inf.stop()
+    fresh = make_pod("fresh", 2, node=NODE)
+    fresh["metadata"]["resourceVersion"] = "999999"
+    inf.note_pod_update(fresh)
+    inf.refresh()
+    assert [p["metadata"]["name"] for p in inf.pending_pods()] == ["fresh"]
+
+
+def test_pod_rebinding_to_other_node_evicts(api, informer):
+    """A pod whose spec.nodeName moves off this node leaves the cache; a
+    real apiserver signals this as DELETED on the field-selector watch and
+    the fake now does too."""
+    api.add_pod(make_pod("mover", 2, node=NODE))
+    assert wait_until(lambda: len(informer.pending_pods()) == 1)
+    moved = make_pod("mover", 2, node="other-node")
+    moved["metadata"]["uid"] = informer.pending_pods()[0]["metadata"]["uid"]
+    api.add_pod(moved)  # MODIFIED that no longer matches spec.nodeName=NODE
+    assert wait_until(lambda: informer.pending_pods() == [])
+
+
+def test_evict_tombstone_blocks_lagging_watch_event(api):
+    """A stale in-flight MODIFIED for an evicted ghost must not resurrect
+    it (the watch thread races the allocator's evict+refresh sequence)."""
+    inf = PodInformer(ApiServerClient(api.url), NODE).start(sync_timeout_s=5)
+    api.add_pod(make_pod("ghost", 2, node=NODE))
+    assert wait_until(lambda: len(inf.pending_pods()) == 1)
+    inf.stop()
+    ghost = inf.pending_pods()[0]
+    inf.evict(ghost)
+    assert inf.pending_pods() == []
+    # the lagging pre-deletion event arrives after the eviction
+    inf._apply("MODIFIED", ghost)
+    assert inf.pending_pods() == []
+    # a genuine recreation (higher rv) is not blocked
+    reborn = make_pod("ghost", 2, node=NODE)
+    reborn["metadata"]["resourceVersion"] = str(
+        int(ghost["metadata"]["resourceVersion"]) + 100
+    )
+    inf._apply("ADDED", reborn)
+    assert [p["metadata"]["name"] for p in inf.pending_pods()] == ["ghost"]
+
+
+def test_relist_does_not_revert_newer_note_pod_update(api):
+    """A relist whose LIST predates a concurrent PATCH must not revert the
+    note_pod_update state (re-opening the Allocate re-match window)."""
+    inf = PodInformer(ApiServerClient(api.url), NODE).start(sync_timeout_s=5)
+    api.add_pod(make_pod("p", 2, node=NODE))
+    assert wait_until(lambda: len(inf.pending_pods()) == 1)
+    inf.stop()
+    stale_items, stale_rv = ApiServerClient(api.url).list_pods_with_rv(
+        field_selector=f"spec.nodeName={NODE}"
+    )
+    # PATCH lands after the LIST was served
+    patched = dict(stale_items[0])
+    patched["metadata"] = dict(patched["metadata"])
+    patched["metadata"]["annotations"] = {"assigned": "yes"}
+    patched["metadata"]["resourceVersion"] = str(int(stale_rv) + 1)
+    inf.note_pod_update(patched)
+    inf._merge_list(stale_items, stale_rv, gc_tombstones=True)
+    assert inf.pending_pods()[0]["metadata"]["annotations"] == {"assigned": "yes"}
+
+
+def test_lagging_deleted_event_does_not_evict_recreation(api):
+    inf = PodInformer(ApiServerClient(api.url), NODE).start(sync_timeout_s=5)
+    api.add_pod(make_pod("recreate", 2, node=NODE))
+    assert wait_until(lambda: len(inf.pending_pods()) == 1)
+    inf.stop()
+    old = inf.pending_pods()[0]
+    # recreation cached by refresh() at a higher rv
+    newer = make_pod("recreate", 2, node=NODE)
+    newer["metadata"]["resourceVersion"] = str(
+        int(old["metadata"]["resourceVersion"]) + 50
+    )
+    inf.note_pod_update(newer)
+    # the old instance's DELETED finally arrives
+    inf._apply("DELETED", old)
+    assert [p["metadata"]["resourceVersion"] for p in inf.pending_pods()] == [
+        newer["metadata"]["resourceVersion"]
+    ]
