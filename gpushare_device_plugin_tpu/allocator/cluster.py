@@ -34,7 +34,7 @@ from ..cluster.podsource import PodSource
 from ..device.fanout import DeviceInventory
 from ..utils.log import get_logger
 from .binpack import assign_chip
-from .env import ContainerAllocation, build_mem_allocation
+from .env import ContainerAllocation, build_core_allocation, build_mem_allocation
 
 log = get_logger("allocator.cluster")
 
@@ -47,6 +47,40 @@ class _PodGone(RuntimeError):
     """The matched pod 404ed on PATCH: deleted while its cache entry or
     DELETED watch event was in flight. Internal signal — the allocator
     evicts the stale entry and re-matches once."""
+
+
+def persist_pod_assignment(
+    api: ApiServerClient,
+    pod_source: PodSource,
+    pod,
+    annotations: dict[str, str],
+    label_value: str,
+) -> None:
+    """Label + annotation strategic-merge patch with one conflict retry
+    (``allocate.go:126,136-150``); feeds the result back into the pod
+    source so the next Allocate cannot re-match this pod."""
+    patch = {
+        "metadata": {
+            "annotations": annotations,
+            "labels": {const.LABEL_RESOURCE_KEY: label_value},
+        }
+    }
+    ns, name = P.namespace(pod), P.name(pod)
+    try:
+        updated = api.patch_pod(ns, name, patch)
+    except ApiError as e:
+        if e.status == 404:
+            raise _PodGone(f"{ns}/{name}") from e
+        if const.OPTIMISTIC_LOCK_ERROR_MSG not in e.body and e.status != 409:
+            raise AllocationFailure(f"pod patch failed: {e}") from e
+        log.warning("patch conflict for %s/%s; retrying once", ns, name)
+        try:
+            updated = api.patch_pod(ns, name, patch)
+        except ApiError as e2:
+            if e2.status == 404:
+                raise _PodGone(f"{ns}/{name}") from e2
+            raise AllocationFailure(f"pod patch failed twice: {e2}") from e2
+    pod_source.note_pod_update(updated)
 
 
 class ClusterAllocator:
@@ -149,12 +183,16 @@ class ClusterAllocator:
         return None
 
     def _place(self, pod, pod_units: int) -> tuple[int, dict[str, str]]:
-        """Decide the chip and the annotations to persist for one pod."""
+        """Decide the chip and the annotations to persist for one pod.
+
+        One labeled-pods snapshot serves both the usage accounting and the
+        core-hold exclusion (a single LIST/cache read per placement)."""
+        snapshot = self._pods.labeled_pods()
         if P.is_assumed(pod) and not P.is_assigned(pod):
-            idx = self._assumed_chip(pod)
+            idx = self._assumed_chip(pod, snapshot)
             annotations = {const.ENV_ASSIGNED_FLAG: "true"}
         else:
-            idx = self._binpack_chip(pod_units)
+            idx = self._binpack_chip(pod_units, snapshot)
             annotations = {
                 const.ENV_MEM_IDX: str(idx),
                 const.ENV_MEM_POD: str(pod_units),
@@ -164,7 +202,7 @@ class ClusterAllocator:
         annotations[const.ENV_ASSUME_TIME] = str(time.time_ns())
         return idx, annotations
 
-    def _assumed_chip(self, pod) -> int:
+    def _assumed_chip(self, pod, snapshot: list[dict]) -> int:
         """Branch A: trust the scheduler extender's placement."""
         idx = P.chip_idx_from_annotation(pod)
         if idx < 0 or idx not in self._inv.units_by_index():
@@ -172,47 +210,195 @@ class ClusterAllocator:
                 f"pod {P.name(pod)} assumed by extender but its "
                 f"{const.ENV_MEM_IDX} annotation is invalid: {idx}"
             )
+        if idx in P.used_chips(snapshot):
+            raise AllocationFailure(
+                f"pod {P.name(pod)} assumed onto chip {idx}, but that chip "
+                f"is exclusively held by a {const.RESOURCE_CORE} pod"
+            )
         log.v(4, "extender placement for %s: chip %d", P.name(pod), idx)
         return idx
 
-    def _binpack_chip(self, pod_units: int) -> int:
-        """Branch B: first-fit over capacity minus apiserver-declared usage."""
-        used = P.used_units_by_chip(self._pods.running_share_pods())
+    def _binpack_chip(self, pod_units: int, snapshot: list[dict]) -> int:
+        """Branch B: first-fit over capacity minus apiserver-declared usage.
+
+        Chips exclusively held by assigned tpu-core pods are excluded along
+        with unhealthy ones — the two resources share one physical chip
+        accounting (the reference's single-resource model, server.go:268-289,
+        extended across both).
+        """
+        used = P.used_units_by_chip(snapshot)
+        core_held = P.used_chips(snapshot)
+        excluded = sorted(set(self._unhealthy_fn()) | core_held)
         try:
             return assign_chip(
                 pod_units,
                 self._inv.units_by_index(),
                 used,
-                unhealthy=self._unhealthy_fn(),
+                unhealthy=excluded,
                 policy=self._policy,
             )
         except Exception as e:
             raise AllocationFailure(str(e)) from e
 
     def _persist(self, pod, annotations: dict[str, str]) -> None:
-        """Label + annotation patch with one conflict retry
-        (``allocate.go:126,136-150``)."""
-        patch = {
-            "metadata": {
-                "annotations": annotations,
-                "labels": {const.LABEL_RESOURCE_KEY: const.LABEL_RESOURCE_VALUE},
-            }
-        }
-        ns, name = P.namespace(pod), P.name(pod)
+        persist_pod_assignment(
+            self._api, self._pods, pod, annotations, const.LABEL_RESOURCE_VALUE
+        )
+
+
+class ClusterCoreAllocator:
+    """Allocate() flow for the whole-chip ``tpu-core`` resource.
+
+    Unlike tpu-mem, the granted device IDs *are* real chip ids (kubelet
+    picks which chips, steered by GetPreferredAllocation), so placement is
+    validation rather than binpack: every granted chip must be healthy,
+    free of fractional-HBM usage, and not already core-held. The decision
+    is persisted as the ``ENV_CORE_IDS`` annotation + the tpu-core label so
+    restart re-derives exclusive holds from the apiserver and the mem
+    binpack can exclude these chips (accounting model: ``server.go:268-289``
+    extended across both resources).
+    """
+
+    def __init__(
+        self,
+        inventory: DeviceInventory,
+        api: ApiServerClient,
+        pod_source: PodSource,
+        node_name: str,
+        topology=None,
+        unhealthy_chips_fn=None,
+    ):
+        self._inv = inventory
+        self._api = api
+        self._pods = pod_source
+        self._node = node_name
+        self._topo = topology
+        self._unhealthy_fn = unhealthy_chips_fn or (lambda: [])
+        self._lock = threading.Lock()
+
+    def allocate(self, granted: Sequence[Sequence[str]]) -> list[ContainerAllocation]:
+        total = sum(len(ids) for ids in granted)
         try:
-            updated = self._api.patch_pod(ns, name, patch)
-        except ApiError as e:
-            if e.status == 404:
-                raise _PodGone(f"{ns}/{name}") from e
-            if const.OPTIMISTIC_LOCK_ERROR_MSG not in e.body and e.status != 409:
-                raise AllocationFailure(f"pod patch failed: {e}") from e
-            log.warning("patch conflict for %s/%s; retrying once", ns, name)
-            try:
-                updated = self._api.patch_pod(ns, name, patch)
-            except ApiError as e2:
-                if e2.status == 404:
-                    raise _PodGone(f"{ns}/{name}") from e2
-                raise AllocationFailure(f"pod patch failed twice: {e2}") from e2
-        # Cached sources must see the assignment before the MODIFIED event
-        # arrives, or the next Allocate could re-match this pod.
-        self._pods.note_pod_update(updated)
+            per_container = [
+                sorted(self._inv.index_of(cid) for cid in ids) for ids in granted
+            ]
+        except KeyError as e:
+            raise AllocationFailure(f"granted unknown chip id: {e}") from e
+        indices = sorted(i for ids in per_container for i in ids)
+        log.v(4, "core Allocate: chips %s", indices)
+        with self._lock:
+            pod = self._match_pending_pod(total)
+            if pod is None:
+                self._pods.refresh()
+                pod = self._match_pending_pod(total)
+            if pod is None:
+                raise AllocationFailure(
+                    f"invalid allocation request: no pending pod on {self._node} "
+                    f"requesting {total} {const.RESOURCE_CORE}"
+                )
+            self._check_conflicts(indices)
+            annotations = {
+                const.ENV_CORE_IDS: ",".join(str(i) for i in indices),
+                const.ENV_CORE_POD: str(total),
+                const.ENV_ASSIGNED_FLAG: "true",
+                const.ENV_ASSUME_TIME: str(time.time_ns()),
+            }
+            for attempt in (0, 1):
+                try:
+                    persist_pod_assignment(
+                        self._api, self._pods, pod, annotations, const.LABEL_CORE_VALUE
+                    )
+                    break
+                except _PodGone:
+                    log.warning(
+                        "core pod %s/%s vanished during persist; re-matching",
+                        P.namespace(pod), P.name(pod),
+                    )
+                    self._pods.evict(pod)
+                    self._pods.refresh()
+                    pod = None if attempt else self._match_pending_pod(total)
+                    if pod is None:
+                        raise AllocationFailure(
+                            f"no live pending pod on {self._node} requesting "
+                            f"{total} {const.RESOURCE_CORE}"
+                        ) from None
+        log.info(
+            "allocated core pod %s/%s: chips %s",
+            P.namespace(pod), P.name(pod), indices,
+        )
+        chips_by_id = {c.id: c for c in self._inv.chips()}
+        return [
+            build_core_allocation(
+                chips=[chips_by_id[self._inv.id_of_index(i)] for i in ids],
+                process_bounds=getattr(self._topo, "process_bounds", ""),
+                chips_per_process_bounds=getattr(
+                    self._topo, "chips_per_process_bounds", ""
+                ),
+            )
+            for ids in per_container
+        ]
+
+    def _match_pending_pod(self, total: int):
+        candidates = P.candidate_pods(
+            self._pods.pending_pods(), self._node, resource=const.RESOURCE_CORE
+        )
+        for pod in candidates:
+            if P.core_chips_of_pod(pod) == total:
+                return pod
+        return None
+
+    def _check_conflicts(self, indices: list[int]) -> None:
+        """Every granted chip must be free of other holds and healthy."""
+        snapshot = self._pods.labeled_pods()
+        mem_used = P.used_units_by_chip(snapshot)
+        core_held = P.used_chips(snapshot)
+        unhealthy = set(self._unhealthy_fn())
+        for idx in indices:
+            if idx in core_held:
+                raise AllocationFailure(
+                    f"chip {idx} is already exclusively held by another "
+                    f"{const.RESOURCE_CORE} pod"
+                )
+            if mem_used.get(idx, 0) > 0:
+                raise AllocationFailure(
+                    f"chip {idx} has {mem_used[idx]} {const.RESOURCE_MEM} units "
+                    "in use by fractional pods; cannot grant exclusively"
+                )
+            if idx in unhealthy:
+                raise AllocationFailure(f"chip {idx} is unhealthy")
+
+
+def cluster_chip_state(pod_source: PodSource):
+    """() -> (mem_used_by_chip, core_held_chips) from one snapshot."""
+
+    def state():
+        snapshot = pod_source.labeled_pods()
+        return P.used_units_by_chip(snapshot), P.used_chips(snapshot)
+
+    return state
+
+
+def preferred_core_chips(inventory: DeviceInventory, state_fn):
+    """GetPreferredAllocation hook for the core plugin: steer kubelet toward
+    chips with no fractional-HBM usage and no existing exclusive hold, so
+    core grants rarely conflict with the mem binpack.
+
+    ``state_fn() -> (mem_used_by_chip, core_held_chips)`` — cluster mode
+    passes ``cluster_chip_state(pod_source)``, standalone mode the
+    LocalAllocator's in-process view; the ranking policy lives here once.
+    """
+
+    def prefer(available_ids: list[str], size: int) -> list[str]:
+        try:
+            mem_used, core_held = state_fn()
+        except Exception as e:  # noqa: BLE001 — preference only, never fail
+            log.warning("preferred-allocation state read failed: %s", e)
+            mem_used, core_held = {}, set()
+
+        def rank(cid: str):
+            idx = inventory.index_of(cid)
+            return (idx in core_held, mem_used.get(idx, 0), idx)
+
+        return sorted(available_ids, key=rank)[:size]
+
+    return prefer

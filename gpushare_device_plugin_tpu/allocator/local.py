@@ -32,6 +32,7 @@ class LocalAllocator:
         self._used: dict[int, int] = {}  # chip index -> units
         self._by_pod: dict[str, tuple[int, int]] = {}  # pod key -> (chip, units)
         self._unhealthy: set[int] = set()
+        self._core_held: set[int] = set()  # whole-chip (tpu-core) holds
 
     def set_chip_health(self, chip_index: int, healthy: bool) -> None:
         with self._lock:
@@ -39,6 +40,29 @@ class LocalAllocator:
                 self._unhealthy.discard(chip_index)
             else:
                 self._unhealthy.add(chip_index)
+
+    def hold_chips(self, chip_indices: Sequence[int]) -> None:
+        """Exclusively hold whole chips for a tpu-core pod; fails if any
+        chip has fractional usage, an existing hold, or is unhealthy."""
+        with self._lock:
+            for idx in chip_indices:
+                if idx in self._core_held:
+                    raise RuntimeError(f"chip {idx} already exclusively held")
+                if self._used.get(idx, 0) > 0:
+                    raise RuntimeError(
+                        f"chip {idx} has {self._used[idx]} fractional units in use"
+                    )
+                if idx in self._unhealthy:
+                    raise RuntimeError(f"chip {idx} is unhealthy")
+            self._core_held.update(chip_indices)
+
+    def release_chips(self, chip_indices: Sequence[int]) -> None:
+        with self._lock:
+            self._core_held.difference_update(chip_indices)
+
+    def core_held(self) -> set[int]:
+        with self._lock:
+            return set(self._core_held)
 
     def allocate(
         self, container_counts: Sequence[int], pod_key: str | None = None
@@ -54,7 +78,7 @@ class LocalAllocator:
                 pod_units,
                 self._inv.units_by_index(),
                 self._used,
-                unhealthy=sorted(self._unhealthy),
+                unhealthy=sorted(self._unhealthy | self._core_held),
                 policy=self._policy,
             )
             self._used[idx] = self._used.get(idx, 0) + pod_units

@@ -61,6 +61,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="no apiserver: in-process accounting (dev/bench)")
     p.add_argument("--no-core-resource", action="store_true",
                    help="do not serve the whole-chip tpu-core resource")
+    p.add_argument("--disable-isolation", action="store_true",
+                   help="never inject the cooperative HBM cap (also "
+                   "settable per-node via the ctpu.disable.isolation label)")
     p.add_argument("--plugin-dir", default=const.DEVICE_PLUGIN_PATH)
     p.add_argument("--node-name", default=os.environ.get("NODE_NAME", ""))
     p.add_argument("--coredump-dir", default="/etc/kubernetes")
@@ -92,6 +95,7 @@ def main(argv=None) -> int:
         health_check=args.health_check,
         standalone=args.standalone,
         serve_core_resource=not args.no_core_resource,
+        disable_isolation=args.disable_isolation,
         coredump_dir=args.coredump_dir,
     )
 

@@ -104,6 +104,10 @@ def is_tpu_share_pod(pod: Pod) -> bool:
     return mem_units_of_pod(pod) > 0
 
 
+def is_tpu_core_pod(pod: Pod) -> bool:
+    return core_chips_of_pod(pod) > 0
+
+
 def is_assumed(pod: Pod) -> bool:
     """The scheduler extender wrote an assume-time annotation."""
     return const.ENV_ASSUME_TIME in annotations(pod)
@@ -130,6 +134,21 @@ def chip_idx_from_annotation(pod: Pod) -> int:
         return -1
 
 
+def core_ids_from_annotation(pod: Pod) -> list[int]:
+    """Chip indices exclusively held by this pod (``ENV_CORE_IDS``), []
+    when absent/garbled."""
+    v = annotations(pod).get(const.ENV_CORE_IDS)
+    if not v:
+        return []
+    out: list[int] = []
+    for part in str(v).split(","):
+        try:
+            out.append(int(part))
+        except ValueError:
+            return []
+    return out
+
+
 def assume_time_from_annotation(pod: Pod) -> int:
     v = annotations(pod).get(const.ENV_ASSUME_TIME)
     try:
@@ -141,8 +160,11 @@ def assume_time_from_annotation(pod: Pod) -> int:
 # --- aggregate views -------------------------------------------------------
 
 
-def candidate_pods(pods: Iterable[Pod], this_node: str) -> list[Pod]:
-    """Pending tpushare pods on this node awaiting Allocate, oldest first.
+def candidate_pods(
+    pods: Iterable[Pod], this_node: str, resource: str = const.RESOURCE_MEM
+) -> list[Pod]:
+    """Pending pods on this node requesting ``resource``, awaiting
+    Allocate, oldest first.
 
     Reference: ``getCandidatePods`` (``podmanager.go:247-269``) — tpushare
     pods that are not yet (assumed AND assigned); pods scheduled to other
@@ -159,7 +181,7 @@ def candidate_pods(pods: Iterable[Pod], this_node: str) -> list[Pod]:
         if uid(pod) in seen:
             continue
         seen.add(uid(pod))
-        if not is_tpu_share_pod(pod):
+        if mem_units_of_pod(pod, resource) <= 0:
             continue
         if is_assumed(pod) and is_assigned(pod):
             continue
@@ -168,19 +190,28 @@ def candidate_pods(pods: Iterable[Pod], this_node: str) -> list[Pod]:
     return out
 
 
-def used_units_by_chip(pods: Iterable[Pod]) -> dict[int, int]:
-    """Annotation-declared HBM usage of *Running* labeled pods per chip index.
+def is_active(pod: Pod) -> bool:
+    """Not terminally finished (Succeeded/Failed pods free their resources)."""
+    return phase(pod) not in ("Succeeded", "Failed")
 
-    Reference: ``getPodUsedGPUMemory`` (``podmanager.go:102-115``) — only
-    pods in phase Running and bearing the resource label are counted; the
-    declared chip index comes from the IDX annotation and the amount is the
-    pod's summed limits.
+
+def used_units_by_chip(pods: Iterable[Pod]) -> dict[int, int]:
+    """Annotation-declared HBM reservations of assigned labeled pods per
+    chip index.
+
+    Reference: ``getPodUsedGPUMemory`` (``podmanager.go:102-115``) counts
+    only phase=Running pods; we deliberately count every *assigned*,
+    non-terminal pod instead — a pod that Allocate() has placed holds its
+    reservation while it is still Pending (image pull), and Running-only
+    accounting would double-book the chip in that window.
     """
     used: dict[int, int] = {}
     for pod in pods:
-        if phase(pod) != "Running":
+        if not is_active(pod):
             continue
         if labels(pod).get(const.LABEL_RESOURCE_KEY) != const.LABEL_RESOURCE_VALUE:
+            continue
+        if not is_assigned(pod):
             continue
         idx = chip_idx_from_annotation(pod)
         if idx < 0:
@@ -190,13 +221,25 @@ def used_units_by_chip(pods: Iterable[Pod]) -> dict[int, int]:
 
 
 def used_chips(pods: Iterable[Pod]) -> set[int]:
-    """Chip indices exclusively held by Running tpu-core pods."""
+    """Chip indices exclusively held by assigned, non-terminal tpu-core
+    pods (assigned-but-Pending holds count — see ``used_units_by_chip``).
+
+    Primary source is the ``ENV_CORE_IDS`` annotation the core allocator
+    persists (kubelet may grant non-contiguous chips); legacy fallback is a
+    contiguous range from the mem IDX annotation.
+    """
     out: set[int] = set()
     for pod in pods:
-        if phase(pod) != "Running":
+        if not is_active(pod):
+            continue
+        if not is_assigned(pod):
             continue
         n = core_chips_of_pod(pod)
         if n <= 0:
+            continue
+        ids = core_ids_from_annotation(pod)
+        if ids:
+            out.update(ids)
             continue
         idx = chip_idx_from_annotation(pod)
         if idx >= 0:

@@ -32,6 +32,9 @@ API_VERSION = "v1beta1"
 # --- Pod selector label (reference: const.go:17-18) ------------------------
 LABEL_RESOURCE_KEY = "tpu/resource"
 LABEL_RESOURCE_VALUE = "tpu-mem"
+# Whole-chip pods get the same key with this value so both allocation kinds
+# are discoverable by one label key (the reference had a single resource).
+LABEL_CORE_VALUE = "tpu-core"
 
 # --- Annotation / env key family (reference: const.go:27-34) ---------------
 ENV_MEM_IDX = "ALIYUN_COM_TPU_MEM_IDX"  # assigned physical chip index
@@ -40,6 +43,12 @@ ENV_MEM_CONTAINER = "ALIYUN_COM_TPU_MEM_CONTAINER"  # container's request
 ENV_MEM_DEV = "ALIYUN_COM_TPU_MEM_DEV"  # total units on assigned chip
 ENV_ASSIGNED_FLAG = "ALIYUN_COM_TPU_MEM_ASSIGNED"  # "false" until kubelet admits
 ENV_ASSUME_TIME = "ALIYUN_COM_TPU_MEM_ASSUME_TIME"  # ns timestamp of assignment
+# Whole-chip (tpu-core) holds: comma-separated chip indices granted to the
+# pod. Persisted so restart re-derives exclusive holds from the apiserver
+# and the mem binpack can exclude core-held chips (accounting model:
+# server.go:268-289 extended across both resources).
+ENV_CORE_IDS = "ALIYUN_COM_TPU_CORE_IDS"
+ENV_CORE_POD = "ALIYUN_COM_TPU_CORE_POD"  # this pod's tpu-core request
 
 # --- TPU workload env (analog of NVIDIA_VISIBLE_DEVICES, const.go:27) ------
 ENV_TPU_VISIBLE_CHIPS = "TPU_VISIBLE_CHIPS"

@@ -63,6 +63,11 @@ class TpuShareManager:
         self._pod_source = pod_source
         self._plugins: list[TpuSharePlugin] = []
         self._health: HealthWatcher | None = None
+        self._local: LocalAllocator | None = None  # standalone accounting
+        # effective isolation toggle: config flag OR node label, re-read at
+        # every plugin (re)build (reference: podmanager.go:59-72 read at
+        # server.go:60-74)
+        self._disable_isolation = config.disable_isolation
         self._restart = threading.Event()
         self._stop = threading.Event()
         self._park = threading.Event()
@@ -83,11 +88,12 @@ class TpuShareManager:
                 "standalone mode: allocations are accounted in-process and "
                 "never reclaimed on pod deletion (dev/bench only)"
             )
-            local = LocalAllocator(
+            self._local = LocalAllocator(
                 inventory,
                 policy=self._cfg.policy,
-                disable_isolation=self._cfg.disable_isolation,
+                disable_isolation=self._disable_isolation,
             )
+            local = self._local
             return lambda granted: local.allocate([len(g) for g in granted])
         from ..allocator.cluster import ClusterAllocator
 
@@ -97,33 +103,52 @@ class TpuShareManager:
             self._pod_source,
             self._cfg.node_name,
             policy=self._cfg.policy,
-            disable_isolation=self._cfg.disable_isolation,
+            disable_isolation=self._disable_isolation,
             unhealthy_chips_fn=unhealthy_fn,
         )
         return cluster.allocate
 
-    def _build_core_allocate_fn(self, inventory: DeviceInventory):
+    def _build_core_allocate_fn(self, inventory: DeviceInventory, unhealthy_fn):
         """Whole-chip allocator for the tpu-core resource.
 
-        Unlike tpu-mem, core device IDs *are* the real chip ids, so the
-        granted IDs are honored directly.
+        Unlike tpu-mem, core device IDs *are* the real chip ids. Cluster
+        mode validates them against fractional usage / existing holds and
+        persists the decision (ClusterCoreAllocator); standalone mode
+        accounts the hold in-process so mem binpack and core grants cannot
+        double-book a chip.
         """
         topo = self._backend.topology()
+        if self._cfg.standalone or self._api is None:
+            local = self._local
 
-        def allocate(granted: Sequence[Sequence[str]]):
-            out = []
-            for ids in granted:
-                chips = [inventory.chip_by_id(cid) for cid in ids]
-                out.append(
-                    build_core_allocation(
-                        chips=chips,
-                        process_bounds=topo.process_bounds,
-                        chips_per_process_bounds=topo.chips_per_process_bounds,
+            def allocate(granted: Sequence[Sequence[str]]):
+                out = []
+                for ids in granted:
+                    chips = [inventory.chip_by_id(cid) for cid in ids]
+                    indices = [inventory.index_of(cid) for cid in ids]
+                    if local is not None:
+                        local.hold_chips(indices)  # raises on conflict
+                    out.append(
+                        build_core_allocation(
+                            chips=chips,
+                            process_bounds=topo.process_bounds,
+                            chips_per_process_bounds=topo.chips_per_process_bounds,
+                        )
                     )
-                )
-            return out
+                return out
 
-        return allocate
+            return allocate
+        from ..allocator.cluster import ClusterCoreAllocator
+
+        core = ClusterCoreAllocator(
+            inventory,
+            self._api,
+            self._pod_source,
+            self._cfg.node_name,
+            topology=topo,
+            unhealthy_chips_fn=unhealthy_fn,
+        )
+        return core.allocate
 
     def _build_plugins(self, inventory: DeviceInventory) -> list[TpuSharePlugin]:
         plugins: list[TpuSharePlugin] = []
@@ -144,15 +169,35 @@ class TpuShareManager:
         )
         plugins.append(mem_plugin)
         if self._cfg.serve_core_resource:
+            from ..allocator.cluster import cluster_chip_state, preferred_core_chips
+
+            if not (self._cfg.standalone or self._api is None):
+                state_fn = cluster_chip_state(self._pod_source)
+            else:
+                local = self._local
+
+                def state_fn():
+                    if local is None:
+                        return {}, set()
+                    return local.used_by_chip(), local.core_held()
+
+            preferred_fn = preferred_core_chips(inventory, state_fn)
+
             core_plugin = TpuSharePlugin(
                 inventory,
-                allocate_fn=self._build_core_allocate_fn(inventory),
+                allocate_fn=None,
                 config=PluginConfig(
                     resource_name=const.RESOURCE_CORE,
                     socket_name=const.CORE_SOCKET_NAME,
                     plugin_dir=self._cfg.plugin_dir,
                 ),
                 devices_fn=inventory.core_devices,
+                preferred_fn=preferred_fn,
+            )
+            core_plugin.set_allocate_fn(
+                self._build_core_allocate_fn(
+                    inventory, unhealthy_fn=core_plugin.unhealthy_chip_indices
+                )
             )
             plugins.append(core_plugin)
         return plugins
@@ -163,20 +208,37 @@ class TpuShareManager:
         inventory = self._build_inventory()
         assert inventory is not None
         if self._api is not None and self._cfg.node_name:
-            from ..cluster.node import patch_chip_count
+            from ..cluster.node import isolation_disabled, patch_chip_count
 
             try:
                 patch_chip_count(self._api, self._cfg.node_name, inventory.chip_count)
             except Exception as e:
                 log.warning("node capacity patch failed: %s", e)
+            # Node label as feature flag, re-read at every (re)build
+            # (reference: podmanager.go:59-72 read at server.go:60-74).
+            self._disable_isolation = self._cfg.disable_isolation or isolation_disabled(
+                self._api, self._cfg.node_name
+            )
+            if self._disable_isolation:
+                log.info("HBM isolation disabled (config flag or node label)")
         self._plugins = self._build_plugins(inventory)
         for plugin in self._plugins:
             plugin.serve()
         if self._cfg.health_check:
-            self._health = HealthWatcher(
-                self._backend,
-                sinks=[p.set_chip_health for p in self._plugins],
-            )
+            sinks = [p.set_chip_health for p in self._plugins]
+            if self._local is not None:
+                local, inv = self._local, inventory
+
+                def local_sink(chip_id, health):
+                    from ..discovery.base import ChipHealth
+
+                    ok = health == ChipHealth.HEALTHY
+                    ids = [c.id for c in inv.chips()] if chip_id is None else [chip_id]
+                    for cid in ids:
+                        local.set_chip_health(inv.index_of(cid), ok)
+
+                sinks.append(local_sink)
+            self._health = HealthWatcher(self._backend, sinks=sinks)
             self._health.start()
 
     def _stop_all(self) -> None:

@@ -71,6 +71,7 @@ class TpuSharePlugin(DevicePluginServicer):
         allocate_fn: Callable[[Sequence[Sequence[str]]], list] | None,
         config: PluginConfig | None = None,
         devices_fn: Callable[..., list[FakeDevice]] | None = None,
+        preferred_fn: Callable[[list[str], int], list[str]] | None = None,
     ):
         """``allocate_fn`` receives the per-container granted fake-ID lists
         and returns ``ContainerAllocation``s (see allocator.env); raising
@@ -78,12 +79,15 @@ class TpuSharePlugin(DevicePluginServicer):
         UnexpectedAdmissionError for the pod (``allocate.go:99-105``).
 
         ``devices_fn(health=...)`` overrides the advertised device list
-        (default: the fractional-HBM fan-out).
+        (default: the fractional-HBM fan-out). ``preferred_fn(available,
+        size)`` orders GetPreferredAllocation picks (the core plugin steers
+        kubelet away from chips with fractional usage).
         """
         self._inv = inventory
         self._allocate_fn = allocate_fn
         self._cfg = config or PluginConfig()
         self._devices_fn = devices_fn or inventory.mem_fake_devices
+        self._preferred_fn = preferred_fn
         self._health: dict[str, ChipHealth] = {}
         self._cond = threading.Condition()
         self._version = 0  # bumped on every health change
@@ -167,11 +171,23 @@ class TpuSharePlugin(DevicePluginServicer):
             yield self._snapshot()
 
     def GetPreferredAllocation(self, request, context) -> pb.PreferredAllocationResponse:
-        # Fake HBM-unit devices are fungible; no preference to express.
+        # Fake HBM-unit devices are fungible (which IDs kubelet grants is
+        # irrelevant by design — Allocate only counts them), so the mem
+        # plugin takes the first N. The core plugin injects a preferred_fn
+        # that steers kubelet toward conflict-free chips.
         resp = pb.PreferredAllocationResponse()
         for creq in request.container_requests:
             cresp = resp.container_responses.add()
-            cresp.deviceIDs.extend(creq.available_deviceIDs[: creq.allocation_size])
+            available = list(creq.available_deviceIDs)
+            if self._preferred_fn is not None:
+                try:
+                    picks = self._preferred_fn(available, creq.allocation_size)
+                except Exception as e:  # noqa: BLE001 — preference only
+                    log.warning("preferred_fn failed: %s", e)
+                    picks = available[: creq.allocation_size]
+            else:
+                picks = available[: creq.allocation_size]
+            cresp.deviceIDs.extend(picks)
         return resp
 
     def Allocate(self, request, context) -> pb.AllocateResponse:

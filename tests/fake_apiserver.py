@@ -37,6 +37,10 @@ def _match_label_selector(obj: dict, selector: str) -> bool:
     for clause in selector.split(","):
         if not clause:
             continue
+        if "=" not in clause:  # existence selector: "key"
+            if clause not in labels:
+                return False
+            continue
         key, _, value = clause.partition("=")
         if labels.get(key) != value:
             return False

@@ -1,0 +1,200 @@
+"""ClusterCoreAllocator + cross-resource accounting: tpu-mem and tpu-core
+must share one physical-chip ledger (the reference's single-resource model,
+``server.go:268-289``, extended across both resources)."""
+
+import pytest
+
+from gpushare_device_plugin_tpu import const
+from gpushare_device_plugin_tpu.allocator.cluster import (
+    AllocationFailure,
+    ClusterAllocator,
+    ClusterCoreAllocator,
+    cluster_chip_state,
+    preferred_core_chips,
+)
+from gpushare_device_plugin_tpu.cluster.apiserver import ApiServerClient
+from gpushare_device_plugin_tpu.cluster.podsource import ApiServerPodSource
+from gpushare_device_plugin_tpu.device import DeviceInventory
+from gpushare_device_plugin_tpu.discovery import MockBackend
+
+from fake_apiserver import FakeApiServer
+from k8s_fixtures import assigned_running_pod, make_pod
+
+NODE = "node-a"
+
+
+@pytest.fixture
+def api():
+    srv = FakeApiServer()
+    srv.add_node(NODE)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def running_core_pod(name: str, chips: str, n: int = 1, **kw) -> dict:
+    ann = {
+        const.ENV_CORE_IDS: chips,
+        const.ENV_ASSIGNED_FLAG: "true",
+    }
+    labels = {const.LABEL_RESOURCE_KEY: const.LABEL_CORE_VALUE}
+    return make_pod(
+        name, tpu_core=n, phase="Running", annotations=ann, labels=labels,
+        node=NODE, **kw,
+    )
+
+
+def setup(api_srv, **kw):
+    client = ApiServerClient(api_srv.url)
+    src = ApiServerPodSource(client, NODE)
+    inv = DeviceInventory(MockBackend(num_chips=4, hbm_bytes=32 << 30).chips())
+    mem = ClusterAllocator(inv, client, src, NODE, **kw)
+    core = ClusterCoreAllocator(inv, client, src, NODE, **kw.get("core_kw", {}))
+    return mem, core, inv, client, src
+
+
+def granted_units(n):
+    return [[f"fake-{i}" for i in range(n)]]
+
+
+def granted_chips(inv, *indices):
+    return [[inv.id_of_index(i) for i in indices]]
+
+
+# --- mem binpack excludes core-held chips ----------------------------------
+
+
+def test_core_held_chip_forces_mem_pod_elsewhere(api):
+    """VERDICT #2 done-criterion: a Running tpu-core pod on chip 0 forces a
+    2-unit mem pod to chip 1."""
+    mem, core, inv, client, src = setup(api)
+    api.add_pod(running_core_pod("exclusive", "0"))
+    api.add_pod(make_pod("frac", 2, node=NODE))
+    res = mem.allocate(granted_units(2))
+    assert res[0].envs[const.ENV_TPU_VISIBLE_CHIPS] == "1"
+
+
+def test_core_held_noncontiguous_chips_excluded(api):
+    mem, core, inv, client, src = setup(api)
+    api.add_pod(running_core_pod("exclusive", "0,2", n=2))
+    api.add_pod(make_pod("frac", 2, node=NODE))
+    res = mem.allocate(granted_units(2))
+    assert res[0].envs[const.ENV_TPU_VISIBLE_CHIPS] == "1"
+
+
+def test_all_chips_core_held_fails_mem_admission(api):
+    mem, core, inv, client, src = setup(api)
+    api.add_pod(running_core_pod("exclusive", "0,1,2,3", n=4))
+    api.add_pod(make_pod("frac", 2, node=NODE))
+    with pytest.raises(AllocationFailure):
+        mem.allocate(granted_units(2))
+
+
+def test_extender_assumed_onto_core_held_chip_rejected(api):
+    mem, core, inv, client, src = setup(api)
+    api.add_pod(running_core_pod("exclusive", "1"))
+    api.add_pod(
+        make_pod(
+            "assumed", 2, node=NODE,
+            annotations={
+                const.ENV_MEM_IDX: "1",
+                const.ENV_ASSUME_TIME: "1700000000000000000",
+            },
+        )
+    )
+    with pytest.raises(AllocationFailure):
+        mem.allocate(granted_units(2))
+
+
+# --- core allocation validates against mem usage ---------------------------
+
+
+def test_mem_usage_blocks_core_grant(api):
+    """Vice-versa criterion: a chip with fractional usage cannot be granted
+    exclusively."""
+    mem, core, inv, client, src = setup(api)
+    api.add_pod(assigned_running_pod("frac", 2, chip_idx=0, node=NODE))
+    api.add_pod(make_pod("exclusive", tpu_core=1, node=NODE))
+    with pytest.raises(AllocationFailure, match="in use by fractional"):
+        core.allocate(granted_chips(inv, 0))
+
+
+def test_core_grant_on_free_chip_persists_hold(api):
+    mem, core, inv, client, src = setup(api)
+    api.add_pod(assigned_running_pod("frac", 2, chip_idx=0, node=NODE))
+    api.add_pod(make_pod("exclusive", tpu_core=1, node=NODE))
+    res = core.allocate(granted_chips(inv, 1))
+    assert res[0].envs[const.ENV_TPU_VISIBLE_CHIPS] == "1"
+    pod = client.get_pod("default", "exclusive")
+    ann = pod["metadata"]["annotations"]
+    assert ann[const.ENV_CORE_IDS] == "1"
+    assert ann[const.ENV_CORE_POD] == "1"
+    assert ann[const.ENV_ASSIGNED_FLAG] == "true"
+    assert pod["metadata"]["labels"][const.LABEL_RESOURCE_KEY] == const.LABEL_CORE_VALUE
+
+
+def test_core_vs_core_conflict_fails(api):
+    mem, core, inv, client, src = setup(api)
+    api.add_pod(running_core_pod("holder", "2"))
+    api.add_pod(make_pod("second", tpu_core=1, node=NODE))
+    with pytest.raises(AllocationFailure, match="already exclusively held"):
+        core.allocate(granted_chips(inv, 2))
+
+
+def test_core_multi_chip_multi_container(api):
+    mem, core, inv, client, src = setup(api)
+    api.add_pod(make_pod("big", tpu_core=2, node=NODE))
+    res = core.allocate([[inv.id_of_index(1)], [inv.id_of_index(3)]])
+    assert len(res) == 2
+    assert res[0].envs[const.ENV_TPU_VISIBLE_CHIPS] == "1"
+    assert res[1].envs[const.ENV_TPU_VISIBLE_CHIPS] == "3"
+    ann = client.get_pod("default", "big")["metadata"]["annotations"]
+    assert ann[const.ENV_CORE_IDS] == "1,3"
+
+
+def test_core_no_matching_pod_fails(api):
+    mem, core, inv, client, src = setup(api)
+    with pytest.raises(AllocationFailure, match="no pending pod"):
+        core.allocate(granted_chips(inv, 0))
+
+
+def test_core_unhealthy_chip_rejected(api):
+    client = ApiServerClient(api.url)
+    src = ApiServerPodSource(client, NODE)
+    inv = DeviceInventory(MockBackend(num_chips=4, hbm_bytes=32 << 30).chips())
+    core = ClusterCoreAllocator(
+        inv, client, src, NODE, unhealthy_chips_fn=lambda: [3]
+    )
+    api.add_pod(make_pod("exclusive", tpu_core=1, node=NODE))
+    with pytest.raises(AllocationFailure, match="unhealthy"):
+        core.allocate(granted_chips(inv, 3))
+
+
+# --- restart re-derivation -------------------------------------------------
+
+
+def test_restart_rederives_core_holds_from_apiserver(api):
+    """A fresh allocator (daemon restart) sees existing holds purely from
+    apiserver state — the 'apiserver is the database' invariant."""
+    mem, core, inv, client, src = setup(api)
+    api.add_pod(make_pod("exclusive", tpu_core=1, node=NODE))
+    core.allocate(granted_chips(inv, 0))
+    api.set_pod_phase("default", "exclusive", "Running")
+    # brand-new allocator instances, same cluster state
+    mem2, core2, inv2, client2, src2 = setup(api)
+    api.add_pod(make_pod("frac", 2, node=NODE))
+    res = mem2.allocate(granted_units(2))
+    assert res[0].envs[const.ENV_TPU_VISIBLE_CHIPS] == "1"
+
+
+# --- GetPreferredAllocation steering ---------------------------------------
+
+
+def test_preferred_core_chips_avoids_busy_chips(api):
+    mem, core, inv, client, src = setup(api)
+    api.add_pod(assigned_running_pod("frac", 2, chip_idx=0, node=NODE))
+    api.add_pod(running_core_pod("holder", "1"))
+    prefer = preferred_core_chips(inv, cluster_chip_state(src))
+    ids = [inv.id_of_index(i) for i in range(4)]
+    picks = prefer(ids, 2)
+    assert picks == [inv.id_of_index(2), inv.id_of_index(3)]

@@ -95,12 +95,16 @@ def test_manager_serves_both_resources_and_allocates(cluster):
     ann = client.get_pod("default", "trainer")["metadata"]["annotations"]
     assert ann[const.ENV_ASSIGNED_FLAG] == "true"
 
-    # whole-chip allocation honors granted chip IDs
+    # whole-chip allocation honors granted chip IDs and persists the hold
+    api.add_pod(make_pod("exclusive", tpu_core=1, node=NODE))
     resp = kubelet.allocate(
         regs[const.RESOURCE_CORE].endpoint, [[core_devs[2].ID]]
     )
     envs = resp.container_responses[0].envs
     assert envs[const.ENV_TPU_VISIBLE_CHIPS] == "2"
+    ann = client.get_pod("default", "exclusive")["metadata"]["annotations"]
+    assert ann[const.ENV_CORE_IDS] == "2"
+    assert ann[const.ENV_ASSIGNED_FLAG] == "true"
 
 
 def test_kubelet_restart_triggers_reregistration(cluster, tmp_path):
@@ -154,6 +158,123 @@ def test_health_file_drives_listandwatch(tmp_path):
             json.dump({}, f)
         devs = kubelet.wait_for_devices(const.RESOURCE_MEM, timeout=10)
         assert all(d.health == "Healthy" for d in devs)
+    finally:
+        manager.trigger_stop("test")
+        t.join(timeout=5)
+        kubelet.stop()
+
+
+def test_isolation_node_label_read_at_build(tmp_path):
+    """VERDICT #3: the ctpu.disable.isolation node label switches the mem
+    payload to CTPU_DISABLE=true with no XLA mem-fraction cap (reference:
+    podmanager.go:59-72 read at server.go:60-74)."""
+    api = FakeApiServer()
+    api.add_node(NODE, labels={const.LABEL_DISABLE_ISOLATION: "true"})
+    api.start()
+    kubelet = FakeKubelet(str(tmp_path))
+    kubelet.start()
+    client = ApiServerClient(api.url)
+    manager = TpuShareManager(
+        MockBackend(num_chips=2, hbm_bytes=8 << 30),
+        ManagerConfig(plugin_dir=str(tmp_path), node_name=NODE),
+        api_client=client,
+        pod_source=ApiServerPodSource(client, NODE),
+    )
+    t = run_manager_bg(manager)
+    try:
+        regs = {}
+        for _ in range(2):
+            reg = kubelet.wait_for_registration()
+            regs[reg.resource_name] = reg
+        api.add_pod(make_pod("capless", 2, node=NODE))
+        resp = kubelet.allocate(
+            regs[const.RESOURCE_MEM].endpoint, [["g0", "g1"]]
+        )
+        envs = resp.container_responses[0].envs
+        assert envs.get("CTPU_DISABLE") == "true"
+        assert const.ENV_XLA_PYTHON_MEM_FRACTION not in envs
+        assert const.ENV_XLA_MEM_FRACTION not in envs
+    finally:
+        manager.trigger_stop("test")
+        t.join(timeout=5)
+        kubelet.stop()
+        api.stop()
+
+
+def test_standalone_health_excludes_chip_from_binpack(tmp_path):
+    """VERDICT #4: in standalone mode the HealthWatcher feeds the
+    LocalAllocator, so --standalone --health-check avoids sick chips; a
+    core grant of a sick chip fails admission."""
+    health_file = str(tmp_path / "health.json")
+    kubelet = FakeKubelet(str(tmp_path / "plugins"))
+    kubelet.start()
+    backend = MockBackend(
+        num_chips=2, hbm_bytes=4 << 30, health_file=health_file, poll_interval_s=0.02
+    )
+    manager = TpuShareManager(
+        backend,
+        ManagerConfig(
+            plugin_dir=str(tmp_path / "plugins"),
+            standalone=True,
+            health_check=True,
+        ),
+    )
+    t = run_manager_bg(manager)
+    try:
+        regs = {}
+        for _ in range(2):
+            reg = kubelet.wait_for_registration()
+            regs[reg.resource_name] = reg
+        kubelet.begin_watch(const.RESOURCE_MEM, regs[const.RESOURCE_MEM].endpoint)
+        devs = kubelet.wait_for_devices(const.RESOURCE_MEM)
+        assert all(d.health == "Healthy" for d in devs)
+
+        chip0 = backend.chips()[0].id
+        with open(health_file, "w") as f:
+            json.dump({chip0: "Unhealthy"}, f)
+        devs = kubelet.wait_for_devices(const.RESOURCE_MEM, timeout=10)
+        assert sum(d.health == "Unhealthy" for d in devs) == 4
+
+        # standalone mem binpack must route around the sick chip 0
+        resp = kubelet.allocate(regs[const.RESOURCE_MEM].endpoint, [["g0"]])
+        assert resp.container_responses[0].envs[const.ENV_TPU_VISIBLE_CHIPS] == "1"
+
+        # core grant of the sick chip fails admission
+        import grpc
+
+        with pytest.raises(grpc.RpcError):
+            kubelet.allocate(regs[const.RESOURCE_CORE].endpoint, [[chip0]])
+        # ... while the healthy chip 1 cannot be granted either: it has
+        # fractional usage from the pod above
+        chip1 = backend.chips()[1].id
+        with pytest.raises(grpc.RpcError):
+            kubelet.allocate(regs[const.RESOURCE_CORE].endpoint, [[chip1]])
+    finally:
+        manager.trigger_stop("test")
+        t.join(timeout=5)
+        kubelet.stop()
+
+
+def test_standalone_core_hold_blocks_mem_binpack(tmp_path):
+    kubelet = FakeKubelet(str(tmp_path / "plugins"))
+    kubelet.start()
+    backend = MockBackend(num_chips=2, hbm_bytes=4 << 30)
+    manager = TpuShareManager(
+        backend,
+        ManagerConfig(plugin_dir=str(tmp_path / "plugins"), standalone=True),
+    )
+    t = run_manager_bg(manager)
+    try:
+        regs = {}
+        for _ in range(2):
+            reg = kubelet.wait_for_registration()
+            regs[reg.resource_name] = reg
+        chip0 = backend.chips()[0].id
+        resp = kubelet.allocate(regs[const.RESOURCE_CORE].endpoint, [[chip0]])
+        assert resp.container_responses[0].envs[const.ENV_TPU_VISIBLE_CHIPS] == "0"
+        # mem pod must land on chip 1 (chip 0 exclusively held)
+        resp = kubelet.allocate(regs[const.RESOURCE_MEM].endpoint, [["g0"]])
+        assert resp.container_responses[0].envs[const.ENV_TPU_VISIBLE_CHIPS] == "1"
     finally:
         manager.trigger_stop("test")
         t.join(timeout=5)

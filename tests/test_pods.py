@@ -99,9 +99,39 @@ def test_used_units_by_chip_counts_only_running_labeled():
 
 
 def test_used_chips_from_core_pods():
+    # legacy fallback: contiguous range from the mem IDX annotation
     p = make_pod(
         "core", tpu_core=2, phase="Running",
-        annotations={const.ENV_MEM_IDX: "1"},
+        annotations={const.ENV_MEM_IDX: "1", const.ENV_ASSIGNED_FLAG: "true"},
     )
     assert P.used_chips([p]) == {1, 2}
     assert P.used_chips([make_pod("none", 1, phase="Running")]) == set()
+    # primary: explicit (possibly non-contiguous) CORE_IDS annotation
+    q = make_pod(
+        "core2", tpu_core=2, phase="Running",
+        annotations={const.ENV_CORE_IDS: "0,3", const.ENV_ASSIGNED_FLAG: "true"},
+    )
+    assert P.used_chips([q]) == {0, 3}
+    # assigned-but-Pending holds count; terminal phases do not
+    pend = make_pod(
+        "pend-core", tpu_core=1, phase="Pending",
+        annotations={const.ENV_CORE_IDS: "2", const.ENV_ASSIGNED_FLAG: "true"},
+    )
+    assert P.used_chips([pend]) == {2}
+    done = make_pod(
+        "done-core", tpu_core=1, phase="Succeeded",
+        annotations={const.ENV_CORE_IDS: "2", const.ENV_ASSIGNED_FLAG: "true"},
+    )
+    assert P.used_chips([done]) == set()
+
+
+def test_used_units_counts_assigned_pending_reservations():
+    """Deviation from the reference (podmanager.go:102-115 Running-only):
+    an assigned pod still Pending (image pull) holds its reservation."""
+    from k8s_fixtures import assigned_running_pod
+
+    pend = assigned_running_pod("pend", 4, chip_idx=1)
+    pend["status"]["phase"] = "Pending"
+    done = assigned_running_pod("done", 4, chip_idx=1)
+    done["status"]["phase"] = "Succeeded"
+    assert P.used_units_by_chip([pend, done]) == {1: 4}
