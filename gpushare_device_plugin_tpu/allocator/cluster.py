@@ -30,6 +30,7 @@ from typing import Sequence
 from .. import const
 from ..cluster import pods as P
 from ..cluster.apiserver import ApiError, ApiServerClient
+from ..cluster.events import REASON_ALLOC_FAILED, emit_pod_event
 from ..cluster.podsource import PodSource
 from ..device.fanout import DeviceInventory
 from ..utils.log import get_logger
@@ -93,6 +94,7 @@ class ClusterAllocator:
         policy: str = "first-fit",
         disable_isolation: bool = False,
         unhealthy_chips_fn=None,
+        lock: threading.Lock | None = None,
     ):
         self._inv = inventory
         self._api = api
@@ -101,8 +103,12 @@ class ClusterAllocator:
         self._policy = policy
         self._disable_isolation = disable_isolation
         self._unhealthy_fn = unhealthy_chips_fn or (lambda: [])
-        # serializes the whole allocate path (reference: allocate.go:42-43)
-        self._lock = threading.Lock()
+        # Serializes the whole allocate path (reference: allocate.go:42-43).
+        # MUST be shared with the node's ClusterCoreAllocator: the two
+        # resources share one physical-chip ledger, and independent locks
+        # would let concurrent mem/core Allocates each read a snapshot
+        # before the other persists — double-booking the same chip.
+        self._lock = lock if lock is not None else threading.Lock()
 
     # ------------------------------------------------------------------
 
@@ -123,33 +129,43 @@ class ClusterAllocator:
                     f"invalid allocation request: no pending pod on {self._node} "
                     f"requesting {pod_units} {const.RESOURCE_MEM}"
                 )
-            for attempt in (0, 1):
-                idx, annotations = self._place(pod, pod_units)
-                try:
-                    self._persist(pod, annotations)
-                    break
-                except _PodGone:
-                    # The matched pod was deleted with its cache entry still
-                    # live — evict it and re-match so a live same-size pod
-                    # is not failed for a ghost's sake.
-                    log.warning(
-                        "pod %s/%s vanished during persist; re-matching",
-                        P.namespace(pod), P.name(pod),
+            try:
+                for attempt in (0, 1):
+                    idx, annotations = self._place(pod, pod_units)
+                    try:
+                        self._persist(pod, annotations)
+                        break
+                    except _PodGone:
+                        # The matched pod was deleted with its cache entry
+                        # still live — evict it and re-match so a live
+                        # same-size pod is not failed for a ghost's sake.
+                        log.warning(
+                            "pod %s/%s vanished during persist; re-matching",
+                            P.namespace(pod), P.name(pod),
+                        )
+                        self._pods.evict(pod)
+                        pod = None
+                        if attempt:
+                            raise AllocationFailure(
+                                f"no live pending pod on {self._node} "
+                                f"requesting {pod_units} {const.RESOURCE_MEM}"
+                            ) from None
+                        self._pods.refresh()
+                        pod = self._match_pending_pod(pod_units)
+                        if pod is None:
+                            raise AllocationFailure(
+                                f"invalid allocation request: no pending pod "
+                                f"on {self._node} requesting {pod_units} "
+                                f"{const.RESOURCE_MEM}"
+                            ) from None
+            except AllocationFailure as e:
+                # kubelet only logs the gRPC error; a Warning event on the
+                # pod makes `kubectl describe pod` show why admission failed
+                if pod is not None:
+                    emit_pod_event(
+                        self._api, pod, REASON_ALLOC_FAILED, str(e), host=self._node
                     )
-                    self._pods.evict(pod)
-                    if attempt:
-                        raise AllocationFailure(
-                            f"no live pending pod on {self._node} requesting "
-                            f"{pod_units} {const.RESOURCE_MEM}"
-                        ) from None
-                    self._pods.refresh()
-                    pod = self._match_pending_pod(pod_units)
-                    if pod is None:
-                        raise AllocationFailure(
-                            f"invalid allocation request: no pending pod on "
-                            f"{self._node} requesting {pod_units} "
-                            f"{const.RESOURCE_MEM}"
-                        ) from None
+                raise
         chip = self._inv.chip_by_id(self._inv.id_of_index(idx))
         total = self._chip_total(idx)
         log.info(
@@ -187,6 +203,12 @@ class ClusterAllocator:
 
         One labeled-pods snapshot serves both the usage accounting and the
         core-hold exclusion (a single LIST/cache read per placement)."""
+        if P.core_chips_of_pod(pod) > 0:
+            raise AllocationFailure(
+                f"pod {P.name(pod)} requests both {const.RESOURCE_MEM} and "
+                f"{const.RESOURCE_CORE}; dual-resource pods are unsupported "
+                "(the two allocators would race each other's assigned flag)"
+            )
         snapshot = self._pods.labeled_pods()
         if P.is_assumed(pod) and not P.is_assigned(pod):
             idx = self._assumed_chip(pod, snapshot)
@@ -267,6 +289,7 @@ class ClusterCoreAllocator:
         node_name: str,
         topology=None,
         unhealthy_chips_fn=None,
+        lock: threading.Lock | None = None,
     ):
         self._inv = inventory
         self._api = api
@@ -274,7 +297,8 @@ class ClusterCoreAllocator:
         self._node = node_name
         self._topo = topology
         self._unhealthy_fn = unhealthy_chips_fn or (lambda: [])
-        self._lock = threading.Lock()
+        # shared with the mem allocator — see ClusterAllocator.__init__
+        self._lock = lock if lock is not None else threading.Lock()
 
     def allocate(self, granted: Sequence[Sequence[str]]) -> list[ContainerAllocation]:
         total = sum(len(ids) for ids in granted)
@@ -296,32 +320,46 @@ class ClusterCoreAllocator:
                     f"invalid allocation request: no pending pod on {self._node} "
                     f"requesting {total} {const.RESOURCE_CORE}"
                 )
-            self._check_conflicts(indices)
-            annotations = {
-                const.ENV_CORE_IDS: ",".join(str(i) for i in indices),
-                const.ENV_CORE_POD: str(total),
-                const.ENV_ASSIGNED_FLAG: "true",
-                const.ENV_ASSUME_TIME: str(time.time_ns()),
-            }
-            for attempt in (0, 1):
-                try:
-                    persist_pod_assignment(
-                        self._api, self._pods, pod, annotations, const.LABEL_CORE_VALUE
+            try:
+                if P.mem_units_of_pod(pod) > 0:
+                    raise AllocationFailure(
+                        f"pod {P.name(pod)} requests both {const.RESOURCE_MEM} "
+                        f"and {const.RESOURCE_CORE}; dual-resource pods are "
+                        "unsupported"
                     )
-                    break
-                except _PodGone:
-                    log.warning(
-                        "core pod %s/%s vanished during persist; re-matching",
-                        P.namespace(pod), P.name(pod),
+                self._check_conflicts(indices)
+                annotations = {
+                    const.ENV_CORE_IDS: ",".join(str(i) for i in indices),
+                    const.ENV_CORE_POD: str(total),
+                    const.ENV_ASSIGNED_FLAG: "true",
+                    const.ENV_ASSUME_TIME: str(time.time_ns()),
+                }
+                for attempt in (0, 1):
+                    try:
+                        persist_pod_assignment(
+                            self._api, self._pods, pod, annotations,
+                            const.LABEL_CORE_VALUE,
+                        )
+                        break
+                    except _PodGone:
+                        log.warning(
+                            "core pod %s/%s vanished during persist; re-matching",
+                            P.namespace(pod), P.name(pod),
+                        )
+                        self._pods.evict(pod)
+                        self._pods.refresh()
+                        pod = None if attempt else self._match_pending_pod(total)
+                        if pod is None:
+                            raise AllocationFailure(
+                                f"no live pending pod on {self._node} requesting "
+                                f"{total} {const.RESOURCE_CORE}"
+                            ) from None
+            except AllocationFailure as e:
+                if pod is not None:
+                    emit_pod_event(
+                        self._api, pod, REASON_ALLOC_FAILED, str(e), host=self._node
                     )
-                    self._pods.evict(pod)
-                    self._pods.refresh()
-                    pod = None if attempt else self._match_pending_pod(total)
-                    if pod is None:
-                        raise AllocationFailure(
-                            f"no live pending pod on {self._node} requesting "
-                            f"{total} {const.RESOURCE_CORE}"
-                        ) from None
+                raise
         log.info(
             "allocated core pod %s/%s: chips %s",
             P.namespace(pod), P.name(pod), indices,

@@ -25,13 +25,26 @@ def _table(rows: list[list[str]]) -> str:
 def render_summary(infos: list[NodeInfo]) -> str:
     unit = infer_unit(infos)
     buf = StringIO()
-    rows = [["NAME", "IPADDRESS", f"TPU Memory ({unit})"]]
+    any_core = any(i.core_holds for i in infos)
+    header = ["NAME", "IPADDRESS", f"TPU Memory ({unit})"]
+    if any_core:
+        header.append("EXCLUSIVE CHIPS (tpu-core)")
+    rows = [header]
     for info in infos:
+        held = set(info.core_held_chips)
         chips = ", ".join(
-            f"chip{d.index}: {d.used_units}/{d.total_units}"
+            f"chip{d.index}: "
+            + ("exclusive" if d.index in held else f"{d.used_units}/{d.total_units}")
             for d in sorted(info.devices.values(), key=lambda d: d.index)
         )
-        rows.append([info.name, info.address, chips])
+        row = [info.name, info.address, chips]
+        if any_core:
+            pending_holds = sum(1 for h in info.core_holds if not h.chips)
+            cell = ",".join(str(i) for i in info.core_held_chips) or "-"
+            if pending_holds:
+                cell += f" (+{pending_holds} pending)"
+            row.append(cell)
+        rows.append(row)
     buf.write(_table(rows))
     buf.write("\n")
     total = sum(i.total_units for i in infos)
@@ -44,6 +57,12 @@ def render_summary(infos: list[NodeInfo]) -> str:
     pending = sum(i.pending_units for i in infos)
     if pending:
         buf.write(f"Pending (unattributed) TPU Memory ({unit}): {pending}\n")
+    if any_core:
+        n_held = sum(len(i.core_held_chips) for i in infos)
+        n_pods = sum(len(i.core_holds) for i in infos)
+        buf.write(
+            f"Exclusively held TPU chips (tpu-core): {n_held} across {n_pods} pod(s)\n"
+        )
     return buf.getvalue()
 
 
@@ -61,6 +80,17 @@ def render_details(infos: list[NodeInfo]) -> str:
             rows.append([pod.namespace, pod.name, str(pod.total_units), chips])
         buf.write(_table(rows))
         buf.write("\n")
+        if info.core_holds:
+            crows = [["NAMESPACE", "NAME", "EXCLUSIVE CHIPS"]]
+            for hold in sorted(info.core_holds, key=lambda h: (h.namespace, h.name)):
+                chips = ",".join(f"chip{i}" for i in hold.chips) or (
+                    f"pending ({hold.requested} chip"
+                    + ("s" if hold.requested != 1 else "")
+                    + ")"
+                )
+                crows.append([hold.namespace, hold.name, chips])
+            buf.write(_table(crows))
+            buf.write("\n")
         buf.write(
             f"Allocated : {info.used_units} ({(100.0 * info.used_units / info.total_units) if info.total_units else 0:.0f}%)\n"
         )

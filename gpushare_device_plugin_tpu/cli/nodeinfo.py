@@ -39,12 +39,24 @@ class DeviceInfo:
 
 
 @dataclasses.dataclass
+class CoreHold:
+    """One tpu-core pod's exclusive chip hold (empty chips = not yet
+    assigned: the hold is pending)."""
+
+    namespace: str
+    name: str
+    chips: list[int]
+    requested: int = 0
+
+
+@dataclasses.dataclass
 class NodeInfo:
     name: str
     address: str
     devices: dict[int, DeviceInfo]
     pods: list[PodUsage]
     pending_units: int = 0
+    core_holds: list[CoreHold] = dataclasses.field(default_factory=list)
 
     @property
     def total_units(self) -> int:
@@ -53,6 +65,10 @@ class NodeInfo:
     @property
     def used_units(self) -> int:
         return sum(d.used_units for d in self.devices.values())
+
+    @property
+    def core_held_chips(self) -> list[int]:
+        return sorted({i for h in self.core_holds for i in h.chips})
 
 
 def is_shared_tpu_node(node: dict) -> bool:
@@ -101,8 +117,11 @@ def pod_allocation(pod: dict) -> dict[int, int]:
     return {idx: total}
 
 
-def build_node_info(node: dict, pods: list[dict]) -> NodeInfo:
-    """Pods must already be filtered to this node's active share pods."""
+def build_node_info(
+    node: dict, pods: list[dict], core_pods: list[dict] | None = None
+) -> NodeInfo:
+    """Pods must already be filtered to this node's active share pods;
+    ``core_pods`` to its active whole-chip (tpu-core) pods."""
     capacity = chip_capacity_vector(node, const.RESOURCE_MEM, const.RESOURCE_COUNT)
     info = NodeInfo(
         name=node.get("metadata", {}).get("name", ""),
@@ -129,6 +148,15 @@ def build_node_info(node: dict, pods: list[dict]) -> NodeInfo:
                 info.devices[idx] = DeviceInfo(
                     index=idx, total_units=0, used_units=units
                 )
+    for pod in core_pods or []:
+        info.core_holds.append(
+            CoreHold(
+                namespace=P.namespace(pod),
+                name=P.name(pod),
+                chips=P.core_hold_chips(pod) if P.is_assigned(pod) else [],
+                requested=P.core_chips_of_pod(pod),
+            )
+        )
     return info
 
 
@@ -140,14 +168,14 @@ def build_all_node_infos(nodes: list[dict], pods: list[dict]) -> list[NodeInfo]:
         if not is_shared_tpu_node(node):
             continue
         name = node.get("metadata", {}).get("name", "")
-        node_pods = [
+        active = [
             p
             for p in pods
-            if P.node_name(p) == name
-            and P.phase(p) not in ("Succeeded", "Failed")
-            and P.mem_units_of_pod(p) > 0
+            if P.node_name(p) == name and P.phase(p) not in ("Succeeded", "Failed")
         ]
-        infos.append(build_node_info(node, node_pods))
+        node_pods = [p for p in active if P.mem_units_of_pod(p) > 0]
+        core_pods = [p for p in active if P.core_chips_of_pod(p) > 0]
+        infos.append(build_node_info(node, node_pods, core_pods))
     return infos
 
 

@@ -1,0 +1,63 @@
+"""Kubernetes Event emission for allocation/bind failures.
+
+The reference's RBAC grants ``create events`` (``device-plugin-rbac.yaml:
+8-37``) but its code never uses it — failures are glog-only and operators
+must read node logs to learn why admission failed. Surfacing them as
+Warning events on the pod makes ``kubectl describe pod`` show the cause.
+Best-effort by design: an event that cannot be posted must never turn a
+clean failure path into a crash.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..utils.log import get_logger
+
+log = get_logger("cluster.events")
+
+COMPONENT = "tpushare-device-plugin"
+REASON_ALLOC_FAILED = "TpuShareAllocationFailed"
+REASON_BIND_FAILED = "TpuShareBindFailed"
+
+
+def emit_pod_event(
+    api,
+    pod: dict,
+    reason: str,
+    message: str,
+    *,
+    component: str = COMPONENT,
+    host: str = "",
+    event_type: str = "Warning",
+) -> None:
+    meta = pod.get("metadata", {}) if pod else {}
+    ns = meta.get("namespace", "default")
+    name = meta.get("name", "")
+    now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    event = {
+        "apiVersion": "v1",
+        "kind": "Event",
+        "metadata": {
+            "generateName": f"{name}.tpushare-" if name else "tpushare-",
+            "namespace": ns,
+        },
+        "involvedObject": {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "namespace": ns,
+            "name": name,
+            "uid": meta.get("uid", ""),
+        },
+        "reason": reason,
+        "message": message,
+        "type": event_type,
+        "source": {"component": component, "host": host},
+        "firstTimestamp": now,
+        "lastTimestamp": now,
+        "count": 1,
+    }
+    try:
+        api.create_event(ns, event)
+    except Exception as e:  # noqa: BLE001 — events are best-effort
+        log.warning("event emission failed for %s/%s: %s", ns, name, e)

@@ -291,15 +291,6 @@ class PodInformer:
                 == const.LABEL_RESOURCE_VALUE
             ]
 
-    def running_core_pods(self) -> list[dict]:
-        with self._lock:
-            return [
-                p
-                for p in self._cache.values()
-                if P.labels(p).get(const.LABEL_RESOURCE_KEY)
-                == const.LABEL_CORE_VALUE
-            ]
-
     def labeled_pods(self) -> list[dict]:
         """All pods bearing the tpu/resource label (mem or core) — one
         snapshot for cross-resource accounting on the Allocate path."""

@@ -220,28 +220,32 @@ def used_units_by_chip(pods: Iterable[Pod]) -> dict[int, int]:
     return used
 
 
+def core_hold_chips(pod: Pod) -> list[int]:
+    """Chips one core pod holds. Primary source is the ``ENV_CORE_IDS``
+    annotation the core allocator persists (kubelet may grant
+    non-contiguous chips); legacy fallback is a contiguous range from the
+    mem IDX annotation. One helper so the allocator ledger and the inspect
+    CLI can never disagree about what a pod holds."""
+    n = core_chips_of_pod(pod)
+    if n <= 0:
+        return []
+    ids = core_ids_from_annotation(pod)
+    if ids:
+        return sorted(ids)
+    idx = chip_idx_from_annotation(pod)
+    if idx >= 0:
+        return list(range(idx, idx + n))
+    return []
+
+
 def used_chips(pods: Iterable[Pod]) -> set[int]:
     """Chip indices exclusively held by assigned, non-terminal tpu-core
-    pods (assigned-but-Pending holds count — see ``used_units_by_chip``).
-
-    Primary source is the ``ENV_CORE_IDS`` annotation the core allocator
-    persists (kubelet may grant non-contiguous chips); legacy fallback is a
-    contiguous range from the mem IDX annotation.
-    """
+    pods (assigned-but-Pending holds count — see ``used_units_by_chip``)."""
     out: set[int] = set()
     for pod in pods:
         if not is_active(pod):
             continue
         if not is_assigned(pod):
             continue
-        n = core_chips_of_pod(pod)
-        if n <= 0:
-            continue
-        ids = core_ids_from_annotation(pod)
-        if ids:
-            out.update(ids)
-            continue
-        idx = chip_idx_from_annotation(pod)
-        if idx >= 0:
-            out.update(range(idx, idx + n))
+        out.update(core_hold_chips(pod))
     return out

@@ -35,10 +35,6 @@ class PodSource(Protocol):
         """Running pods bearing the tpushare label (usage accounting)."""
         ...
 
-    def running_core_pods(self) -> list[dict]:
-        """Running pods bearing the tpu-core label (exclusive-hold accounting)."""
-        ...
-
     def labeled_pods(self) -> list[dict]:
         """All pods bearing the tpu/resource label (either value) — one
         snapshot for cross-resource accounting per Allocate."""
@@ -95,18 +91,6 @@ class ApiServerPodSource:
             lambda: self._c.list_pods(
                 field_selector=f"spec.nodeName={self._node}",
                 label_selector=f"{const.LABEL_RESOURCE_KEY}={const.LABEL_RESOURCE_VALUE}",
-            ),
-            attempts=APISERVER_RETRIES,
-            delay_s=APISERVER_DELAY_S,
-        )
-
-    def running_core_pods(self) -> list[dict]:
-        from .. import const
-
-        return retry(
-            lambda: self._c.list_pods(
-                field_selector=f"spec.nodeName={self._node}",
-                label_selector=f"{const.LABEL_RESOURCE_KEY}={const.LABEL_CORE_VALUE}",
             ),
             attempts=APISERVER_RETRIES,
             delay_s=APISERVER_DELAY_S,
@@ -175,19 +159,6 @@ class KubeletPodSource:
             p
             for p in pods
             if P.labels(p).get(const.LABEL_RESOURCE_KEY) == const.LABEL_RESOURCE_VALUE
-        ]
-
-    def running_core_pods(self) -> list[dict]:
-        from .. import const
-
-        try:
-            pods = self._kubelet_pods()
-        except RetryError:
-            return self._fallback.running_core_pods()
-        return [
-            p
-            for p in pods
-            if P.labels(p).get(const.LABEL_RESOURCE_KEY) == const.LABEL_CORE_VALUE
         ]
 
     def labeled_pods(self) -> list[dict]:

@@ -59,10 +59,20 @@ class NodeView:
     resource: str
     capacity: dict[int, int]  # chip index -> units
     used: dict[int, int]
+    # chips exclusively held by assigned tpu-core pods: zero free units for
+    # fractional placement (keeps the extender's decisions consistent with
+    # the device plugin's cross-resource ledger — otherwise it would assume
+    # mem pods onto held chips and Allocate would reject them forever)
+    core_held: set[int] = dataclasses.field(default_factory=set)
 
     def free(self) -> dict[int, int]:
         return {
-            i: self.capacity[i] - self.used.get(i, 0) for i in self.capacity
+            i: (
+                0
+                if i in self.core_held
+                else self.capacity[i] - self.used.get(i, 0)
+            )
+            for i in self.capacity
         }
 
 
@@ -105,11 +115,15 @@ def build_node_view(
     node: dict, pods_by_node: dict[str, list[dict]], resource: str
 ) -> NodeView:
     name = node.get("metadata", {}).get("name", "")
+    node_pods = pods_by_node.get(name, [])
     return NodeView(
         name=name,
         resource=resource,
         capacity=node_capacity(node, resource),
-        used=node_usage(pods_by_node.get(name, []), resource),
+        used=node_usage(node_pods, resource),
+        core_held=(
+            P.used_chips(node_pods) if resource == const.RESOURCE_MEM else set()
+        ),
     )
 
 
@@ -187,7 +201,13 @@ def choose_chip(
     family = RESOURCE_FAMILIES[resource]
     request = P.mem_units_of_pod(pod, resource=resource)
     view = build_node_view(node, group_pods_by_node(pods), resource)
-    idx = assign_chip(request, view.capacity, view.used, policy=policy)
+    idx = assign_chip(
+        request,
+        view.capacity,
+        view.used,
+        unhealthy=sorted(view.core_held),
+        policy=policy,
+    )
     containers = pod.get("spec", {}).get("containers", [])
     alloc_map = {
         c.get("name", f"c{i}"): {str(idx): P.mem_units_of_container(c, resource)}

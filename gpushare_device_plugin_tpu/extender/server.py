@@ -123,6 +123,16 @@ class ExtenderCore:
                 self._inflight[(ns, name)] = (node_name, annotations, time.monotonic())
             except (ApiError, AssignmentError) as e:
                 log.warning("bind %s/%s -> %s failed: %s", ns, name, node_name, e)
+                from ..cluster.events import REASON_BIND_FAILED, emit_pod_event
+
+                emit_pod_event(
+                    self._api,
+                    {"metadata": {"namespace": ns, "name": name}},
+                    REASON_BIND_FAILED,
+                    f"bind to {node_name} failed: {e}",
+                    component="tpushare-scheduler-extender",
+                    host=node_name,
+                )
                 return {"error": str(e)}
         log.info("bound %s/%s -> %s chip %d", ns, name, node_name, idx)
         return {"error": ""}

@@ -68,6 +68,9 @@ class TpuShareManager:
         # every plugin (re)build (reference: podmanager.go:59-72 read at
         # server.go:60-74)
         self._disable_isolation = config.disable_isolation
+        # one lock across the mem and core allocators: both resources share
+        # one physical-chip ledger and must serialize their decisions
+        self._alloc_lock = threading.Lock()
         self._restart = threading.Event()
         self._stop = threading.Event()
         self._park = threading.Event()
@@ -105,6 +108,7 @@ class TpuShareManager:
             policy=self._cfg.policy,
             disable_isolation=self._disable_isolation,
             unhealthy_chips_fn=unhealthy_fn,
+            lock=self._alloc_lock,
         )
         return cluster.allocate
 
@@ -122,20 +126,22 @@ class TpuShareManager:
             local = self._local
 
             def allocate(granted: Sequence[Sequence[str]]):
-                out = []
-                for ids in granted:
-                    chips = [inventory.chip_by_id(cid) for cid in ids]
-                    indices = [inventory.index_of(cid) for cid in ids]
-                    if local is not None:
-                        local.hold_chips(indices)  # raises on conflict
-                    out.append(
-                        build_core_allocation(
-                            chips=chips,
-                            process_bounds=topo.process_bounds,
-                            chips_per_process_bounds=topo.chips_per_process_bounds,
-                        )
+                # One atomic hold for the whole pod: hold_chips validates
+                # every chip before recording any, so a conflict on one
+                # container cannot leak the others' holds.
+                all_indices = [
+                    inventory.index_of(cid) for ids in granted for cid in ids
+                ]
+                if local is not None:
+                    local.hold_chips(all_indices)  # raises on conflict
+                return [
+                    build_core_allocation(
+                        chips=[inventory.chip_by_id(cid) for cid in ids],
+                        process_bounds=topo.process_bounds,
+                        chips_per_process_bounds=topo.chips_per_process_bounds,
                     )
-                return out
+                    for ids in granted
+                ]
 
             return allocate
         from ..allocator.cluster import ClusterCoreAllocator
@@ -147,6 +153,7 @@ class TpuShareManager:
             self._cfg.node_name,
             topology=topo,
             unhealthy_chips_fn=unhealthy_fn,
+            lock=self._alloc_lock,
         )
         return core.allocate
 

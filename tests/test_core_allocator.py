@@ -198,3 +198,38 @@ def test_preferred_core_chips_avoids_busy_chips(api):
     ids = [inv.id_of_index(i) for i in range(4)]
     picks = prefer(ids, 2)
     assert picks == [inv.id_of_index(2), inv.id_of_index(3)]
+
+
+# --- failure events --------------------------------------------------------
+
+
+def test_allocation_failure_emits_pod_event(api):
+    """VERDICT #8: admission failures land as Warning events on the pod."""
+    mem, core, inv, client, src = setup(api)
+    api.add_pod(running_core_pod("exclusive", "0,1,2,3", n=4))
+    api.add_pod(make_pod("frac", 2, node=NODE))
+    with pytest.raises(AllocationFailure):
+        mem.allocate(granted_units(2))
+    assert len(api.events) == 1
+    ev = api.events[0]
+    assert ev["reason"] == "TpuShareAllocationFailed"
+    assert ev["type"] == "Warning"
+    assert ev["involvedObject"]["name"] == "frac"
+    assert "no chip can fit" in ev["message"]
+
+
+def test_core_conflict_emits_pod_event(api):
+    mem, core, inv, client, src = setup(api)
+    api.add_pod(assigned_running_pod("frac", 2, chip_idx=0, node=NODE))
+    api.add_pod(make_pod("exclusive", tpu_core=1, node=NODE))
+    with pytest.raises(AllocationFailure):
+        core.allocate(granted_chips(inv, 0))
+    assert [e["involvedObject"]["name"] for e in api.events] == ["exclusive"]
+
+
+def test_no_matching_pod_failure_has_no_event(api):
+    """With no pod matched there is nothing to attribute the event to."""
+    mem, core, inv, client, src = setup(api)
+    with pytest.raises(AllocationFailure):
+        mem.allocate(granted_units(2))
+    assert api.events == []

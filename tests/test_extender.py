@@ -157,3 +157,35 @@ def test_health_endpoints(stack):
     _, url = stack
     assert requests.get(f"{url}/healthz").json()["ok"] is True
     assert requests.post(f"{url}/scheduler/filter", data="{bad json").status_code == 400
+
+
+def test_extender_excludes_core_held_chips():
+    """The extender's ledger must match the plugin's: chips exclusively
+    held by assigned tpu-core pods have zero free units for fractional
+    placement (otherwise it binds pods the plugin then rejects forever)."""
+    node = shared_node("n1", chips=2, units=8)
+    core_pod = make_pod(
+        "holder", tpu_core=1, node="n1", phase="Running",
+        annotations={
+            const.ENV_CORE_IDS: "0",
+            const.ENV_ASSIGNED_FLAG: "true",
+        },
+        labels={const.LABEL_RESOURCE_KEY: const.LABEL_CORE_VALUE},
+    )
+    pod = make_pod("frac", 8, node="")
+    fits, failed = logic.filter_nodes(pod, [node], [core_pod])
+    assert fits == ["n1"]  # chip 1 still free
+    resource, idx, ann = logic.choose_chip(pod, node, [core_pod])
+    assert idx == 1
+
+    # both chips held -> node fails filter and choose raises
+    core_pod2 = make_pod(
+        "holder2", tpu_core=1, node="n1", phase="Pending",
+        annotations={
+            const.ENV_CORE_IDS: "1",
+            const.ENV_ASSIGNED_FLAG: "true",
+        },
+        labels={const.LABEL_RESOURCE_KEY: const.LABEL_CORE_VALUE},
+    )
+    fits, failed = logic.filter_nodes(pod, [node], [core_pod, core_pod2])
+    assert fits == [] and "n1" in failed

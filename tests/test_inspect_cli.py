@@ -125,3 +125,49 @@ def test_cli_unknown_node_errors(api, monkeypatch):
     monkeypatch.setattr(inspect_cli, "_client", lambda: ApiServerClient(api.url))
     with pytest.raises(SystemExit, match="not found"):
         inspect_cli.main(["nope"])
+
+
+def test_core_holds_in_summary_and_details(api, capsys, monkeypatch):
+    """VERDICT #10: tpu-core exclusive holds are visible alongside HBM."""
+    api.add_node("n1")
+    api.nodes["n1"].update(shared_node("n1"))
+    api.add_pod(assigned_running_pod("frac", 8, chip_idx=0, node="n1"))
+    api.add_pod(
+        make_pod(
+            "exclusive", tpu_core=2, node="n1", phase="Running",
+            annotations={
+                const.ENV_CORE_IDS: "1,3",
+                const.ENV_ASSIGNED_FLAG: "true",
+            },
+            labels={const.LABEL_RESOURCE_KEY: const.LABEL_CORE_VALUE},
+        )
+    )
+    api.add_pod(
+        make_pod("waiting", tpu_core=1, node="n1", phase="Pending")
+    )
+    monkeypatch.setattr(inspect_cli, "_client", lambda: ApiServerClient(api.url))
+
+    assert inspect_cli.main([]) == 0
+    out = capsys.readouterr().out
+    assert "chip1: exclusive" in out
+    assert "chip3: exclusive" in out
+    assert "chip0: 8/32" in out
+    assert "1,3 (+1 pending)" in out
+    assert "Exclusively held TPU chips (tpu-core): 2 across 2 pod(s)" in out
+
+    assert inspect_cli.main(["-d"]) == 0
+    out = capsys.readouterr().out
+    assert "exclusive" in out and "chip1,chip3" in out
+    assert "pending (1 chip)" in out
+
+
+def test_no_core_holds_keeps_reference_layout(api, capsys, monkeypatch):
+    """Without tpu-core pods the report keeps the reference's column set."""
+    api.add_node("n1")
+    api.nodes["n1"].update(shared_node("n1"))
+    api.add_pod(assigned_running_pod("frac", 8, chip_idx=0, node="n1"))
+    monkeypatch.setattr(inspect_cli, "_client", lambda: ApiServerClient(api.url))
+    assert inspect_cli.main([]) == 0
+    out = capsys.readouterr().out
+    assert "EXCLUSIVE" not in out
+    assert "chip0: 8/32" in out
