@@ -1,7 +1,7 @@
 PY ?= python
 PROTOC ?= protoc
 
-.PHONY: proto native test bench
+.PHONY: proto native test test-fast test-slow lint bench
 
 # Regenerate protobuf message classes (gRPC bindings are hand-written in
 # gpushare_device_plugin_tpu/plugin/api/api_grpc.py; grpc_tools is not
@@ -16,6 +16,18 @@ native:
 
 test:
 	$(PY) -m pytest tests/ -x -q
+
+# plugin/cluster/CLI tier: no JAX compiles, < 60 s
+test-fast:
+	$(PY) -m pytest tests/ -x -q -m "not slow"
+
+# JAX tier: kernels, trainer, multihost (CPU mesh)
+test-slow:
+	$(PY) -m pytest tests/ -x -q -m slow
+
+lint:
+	$(PY) -m compileall -q gpushare_device_plugin_tpu tests bench.py __graft_entry__.py
+	$(PY) -m pyflakes gpushare_device_plugin_tpu tests || true
 
 bench:
 	$(PY) bench.py

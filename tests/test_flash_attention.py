@@ -5,6 +5,10 @@ on TPU is the same kernel) and checks forward and gradients against
 ``parallel.ring.full_attention``.
 """
 
+import pytest
+
+pytestmark = pytest.mark.slow
+
 import jax
 import jax.numpy as jnp
 import pytest

@@ -1,5 +1,9 @@
 """Driver contract: __graft_entry__.entry / dryrun_multichip."""
 
+import pytest
+
+pytestmark = pytest.mark.slow
+
 import sys
 from pathlib import Path
 

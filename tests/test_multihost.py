@@ -7,6 +7,10 @@ global 8-device mesh, and run the flagship FSDP train step on it —
 cross-process collectives ride gloo (the CPU stand-in for ICI/DCN).
 """
 
+import pytest
+
+pytestmark = pytest.mark.slow
+
 import os
 import subprocess
 import sys

@@ -4,6 +4,10 @@ Runs on the virtual 8-device CPU mesh (conftest.py sets
 xla_force_host_platform_device_count=8).
 """
 
+import pytest
+
+pytestmark = pytest.mark.slow
+
 import jax
 import jax.numpy as jnp
 import numpy as np

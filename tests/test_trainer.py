@@ -1,5 +1,9 @@
 """Training loop: checkpoint/resume equivalence on the virtual CPU mesh."""
 
+import pytest
+
+pytestmark = pytest.mark.slow
+
 import jax
 import jax.numpy as jnp
 import numpy as np

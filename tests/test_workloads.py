@@ -1,5 +1,9 @@
 """Workload training steps on the virtual 8-device CPU mesh."""
 
+import pytest
+
+pytestmark = pytest.mark.slow
+
 import jax
 import jax.numpy as jnp
 import numpy as np

@@ -1,5 +1,9 @@
 """ResNet-50 and BERT workloads on the virtual 8-device CPU mesh."""
 
+import pytest
+
+pytestmark = pytest.mark.slow
+
 import jax
 import jax.numpy as jnp
 import numpy as np
