@@ -77,10 +77,14 @@ class PodInformer:
     protocol (``pending_pods``/``running_share_pods``) plus the informer
     extras (``refresh``/``note_pod_update``)."""
 
-    def __init__(self, client: ApiServerClient, node_name: str):
+    def __init__(self, client: ApiServerClient, node_name: str = ""):
+        """``node_name`` scopes the cache to one node's pods (the daemon's
+        use); empty means cluster-wide (the scheduler extender's use —
+        placement accounting needs every node's pods, including assumed
+        pods that carry annotations but no label yet)."""
         self._c = client
         self._node = node_name
-        self._field_selector = f"spec.nodeName={node_name}"
+        self._field_selector = f"spec.nodeName={node_name}" if node_name else ""
         self._cache: dict[tuple[str, str], dict] = {}
         # key -> rv at eviction: blocks lagging in-flight watch events from
         # resurrecting a pod the apiserver reported gone (PATCH 404)
@@ -223,8 +227,13 @@ class PodInformer:
                 self._store_if_newer(key, pod)
         # A pod moving OFF this node arrives as MODIFIED with a different
         # nodeName (field-selector watches emit it as DELETED on a real
-        # apiserver; tolerate both shapes).
-        if etype != "DELETED" and P.node_name(pod) not in ("", self._node):
+        # apiserver; tolerate both shapes). Cluster-wide informers keep
+        # every pod.
+        if (
+            self._node
+            and etype != "DELETED"
+            and P.node_name(pod) not in ("", self._node)
+        ):
             with self._lock:
                 self._cache.pop(key, None)
 
@@ -300,6 +309,12 @@ class PodInformer:
                 for p in self._cache.values()
                 if const.LABEL_RESOURCE_KEY in P.labels(p)
             ]
+
+    def all_pods(self) -> list[dict]:
+        """Every cached pod (the extender's placement accounting reads
+        annotated-but-unlabeled assumed pods too)."""
+        with self._lock:
+            return list(self._cache.values())
 
     # --- informer extras --------------------------------------------------
 

@@ -17,6 +17,7 @@ decision via its annotations-in-apiserver plus a short local cache).
 from __future__ import annotations
 
 import argparse
+import copy
 import json
 import sys
 import threading
@@ -33,9 +34,15 @@ log = get_logger("extender")
 
 
 class ExtenderCore:
-    def __init__(self, api: ApiServerClient, policy: str = "best-fit"):
+    def __init__(self, api: ApiServerClient, policy: str = "best-fit", informer=None):
+        """``informer``: an optional cluster-wide ``PodInformer`` (no node
+        field-selector). With it, filter/prioritize/bind read the watch
+        cache instead of LISTing every pod in the cluster per webhook call
+        — at a few thousand pods that LIST costs tens of ms and real
+        apiserver load on every scheduling decision."""
         self._api = api
         self._policy = policy
+        self._informer = informer
         # RLock: bind() holds it across its whole decision and calls
         # _active_pods(), which also touches the in-flight cache
         self._lock = threading.RLock()
@@ -47,7 +54,10 @@ class ExtenderCore:
     # --- helpers ----------------------------------------------------------
 
     def _active_pods(self) -> list[dict]:
-        pods = self._api.list_pods()
+        if self._informer is not None:
+            pods = self._informer.all_pods()
+        else:
+            pods = self._api.list_pods()
         out = []
         for pod in pods:
             if pod.get("status", {}).get("phase") in ("Succeeded", "Failed"):
@@ -62,15 +72,20 @@ class ExtenderCore:
             }
             inflight = dict(self._inflight)
         by_key = {(p.get("metadata", {}).get("namespace", "default"),
-                   p.get("metadata", {}).get("name", "")): p for p in out}
+                   p.get("metadata", {}).get("name", "")): i
+                  for i, p in enumerate(out)}
         for (ns, name), (node, ann, _) in inflight.items():
-            pod = by_key.get((ns, name))
-            if pod is not None:
+            i = by_key.get((ns, name))
+            if i is not None:
+                # copy before overlay: with an informer these dicts ARE the
+                # cache entries and must not be mutated
+                pod = copy.deepcopy(out[i])
                 meta = pod.setdefault("metadata", {})
                 merged = dict(meta.get("annotations") or {})
                 merged.update(ann)
                 meta["annotations"] = merged
                 pod.setdefault("spec", {}).setdefault("nodeName", node)
+                out[i] = pod
         return out
 
     def _nodes_from_args(self, args: dict) -> list[dict]:
@@ -205,6 +220,9 @@ def main(argv=None) -> int:
     p.add_argument("--port", type=int, default=32766)
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--policy", default="best-fit", choices=["first-fit", "best-fit"])
+    p.add_argument("--pod-source", default="informer", choices=["informer", "list"],
+                   help="watch-backed cluster pod cache (default) or a full "
+                   "LIST per webhook call")
     p.add_argument("--timeout", type=float, default=10.0)
     p.add_argument("-v", "--verbosity", type=int, default=0)
     args = p.parse_args(argv)
@@ -213,13 +231,22 @@ def main(argv=None) -> int:
         api = ApiServerClient.from_env(timeout_s=args.timeout)
     except Exception as e:
         log.fatal(f"apiserver config failed: {e}")
-    server = ExtenderHTTPServer(ExtenderCore(api, policy=args.policy),
-                                host=args.host, port=args.port)
+    informer = None
+    if args.pod_source == "informer":
+        from ..cluster.informer import PodInformer
+
+        informer = PodInformer(api).start()
+    server = ExtenderHTTPServer(
+        ExtenderCore(api, policy=args.policy, informer=informer),
+        host=args.host, port=args.port,
+    )
     server.start()
     try:
         threading.Event().wait()
     except KeyboardInterrupt:
         server.stop()
+        if informer is not None:
+            informer.stop()
     return 0
 
 

@@ -189,3 +189,53 @@ def test_extender_excludes_core_held_chips():
     )
     fits, failed = logic.filter_nodes(pod, [node], [core_pod, core_pod2])
     assert fits == [] and "n1" in failed
+
+
+def test_informer_backed_extender_scale_2000_pods():
+    """VERDICT #7: with the cluster-wide informer the webhook verbs stay
+    fast at ~2,000 pods (p50 < 5 ms) instead of LISTing the world per call."""
+    import statistics
+    import time as _time
+
+    from gpushare_device_plugin_tpu.cluster.informer import PodInformer
+
+    api = FakeApiServer()
+    api.start()
+    client = ApiServerClient(api.url)
+    # 2000 active pods spread over 50 nodes, ~half tpushare-annotated
+    for i in range(2000):
+        node = f"n{i % 50}"
+        if i % 2 == 0:
+            pod = assigned_running_pod(f"p{i}", 2, chip_idx=i % 4, node=node)
+        else:
+            pod = make_pod(f"p{i}", 0, node=node, phase="Running")
+        pod["metadata"]["namespace"] = "default"
+        api.add_pod(pod)
+    nodes = [shared_node(f"n{j}", chips=4, units=32) for j in range(50)]
+
+    informer = PodInformer(client).start(sync_timeout_s=30)
+    core = ExtenderCore(client, informer=informer)
+    try:
+        assert len(informer.all_pods()) == 2000
+        pending = make_pod("newpod", 4, node="")
+        args = {"pod": pending, "nodes": {"items": nodes}}
+        lat = []
+        for _ in range(30):
+            t0 = _time.perf_counter()
+            result = core.filter(args)
+            lat.append((_time.perf_counter() - t0) * 1e3)
+        assert result["nodenames"], "filter returned no fitting nodes"
+        p50 = statistics.median(lat)
+        assert p50 < 5.0, f"filter p50 {p50:.2f}ms over budget at 2000 pods"
+
+        # bind also stays in budget (one GET + PATCH + POST, no LIST)
+        api.add_pod(pending)
+        t0 = _time.perf_counter()
+        res = core.bind({"podNamespace": "default", "podName": "newpod",
+                         "node": result["nodenames"][0]})
+        bind_ms = (_time.perf_counter() - t0) * 1e3
+        assert res["error"] == ""
+        assert bind_ms < 50.0, f"bind took {bind_ms:.1f}ms"
+    finally:
+        informer.stop()
+        api.stop()
