@@ -201,20 +201,22 @@ class ClusterAllocator:
     def _place(self, pod, pod_units: int) -> tuple[int, dict[str, str]]:
         """Decide the chip and the annotations to persist for one pod.
 
-        One labeled-pods snapshot serves both the usage accounting and the
-        core-hold exclusion (a single LIST/cache read per placement)."""
+        One ``chip_state()`` read serves both the usage accounting and the
+        core-hold exclusion — O(chips) per placement with the informer's
+        incremental index (the reference rescans every labeled pod per
+        admission, ``podmanager.go:102-115``)."""
         if P.core_chips_of_pod(pod) > 0:
             raise AllocationFailure(
                 f"pod {P.name(pod)} requests both {const.RESOURCE_MEM} and "
                 f"{const.RESOURCE_CORE}; dual-resource pods are unsupported "
                 "(the two allocators would race each other's assigned flag)"
             )
-        snapshot = self._pods.labeled_pods()
+        mem_used, core_held = self._pods.chip_state()
         if P.is_assumed(pod) and not P.is_assigned(pod):
-            idx = self._assumed_chip(pod, snapshot)
+            idx = self._assumed_chip(pod, core_held)
             annotations = {const.ENV_ASSIGNED_FLAG: "true"}
         else:
-            idx = self._binpack_chip(pod_units, snapshot)
+            idx = self._binpack_chip(pod_units, mem_used, core_held)
             annotations = {
                 const.ENV_MEM_IDX: str(idx),
                 const.ENV_MEM_POD: str(pod_units),
@@ -224,7 +226,7 @@ class ClusterAllocator:
         annotations[const.ENV_ASSUME_TIME] = str(time.time_ns())
         return idx, annotations
 
-    def _assumed_chip(self, pod, snapshot: list[dict]) -> int:
+    def _assumed_chip(self, pod, core_held: set[int]) -> int:
         """Branch A: trust the scheduler extender's placement."""
         idx = P.chip_idx_from_annotation(pod)
         if idx < 0 or idx not in self._inv.units_by_index():
@@ -232,7 +234,7 @@ class ClusterAllocator:
                 f"pod {P.name(pod)} assumed by extender but its "
                 f"{const.ENV_MEM_IDX} annotation is invalid: {idx}"
             )
-        if idx in P.used_chips(snapshot):
+        if idx in core_held:
             raise AllocationFailure(
                 f"pod {P.name(pod)} assumed onto chip {idx}, but that chip "
                 f"is exclusively held by a {const.RESOURCE_CORE} pod"
@@ -240,7 +242,9 @@ class ClusterAllocator:
         log.v(4, "extender placement for %s: chip %d", P.name(pod), idx)
         return idx
 
-    def _binpack_chip(self, pod_units: int, snapshot: list[dict]) -> int:
+    def _binpack_chip(
+        self, pod_units: int, used: dict[int, int], core_held: set[int]
+    ) -> int:
         """Branch B: first-fit over capacity minus apiserver-declared usage.
 
         Chips exclusively held by assigned tpu-core pods are excluded along
@@ -248,8 +252,6 @@ class ClusterAllocator:
         accounting (the reference's single-resource model, server.go:268-289,
         extended across both).
         """
-        used = P.used_units_by_chip(snapshot)
-        core_held = P.used_chips(snapshot)
         excluded = sorted(set(self._unhealthy_fn()) | core_held)
         try:
             return assign_chip(
@@ -321,20 +323,24 @@ class ClusterCoreAllocator:
                     f"requesting {total} {const.RESOURCE_CORE}"
                 )
             try:
-                if P.mem_units_of_pod(pod) > 0:
-                    raise AllocationFailure(
-                        f"pod {P.name(pod)} requests both {const.RESOURCE_MEM} "
-                        f"and {const.RESOURCE_CORE}; dual-resource pods are "
-                        "unsupported"
-                    )
-                self._check_conflicts(indices)
-                annotations = {
-                    const.ENV_CORE_IDS: ",".join(str(i) for i in indices),
-                    const.ENV_CORE_POD: str(total),
-                    const.ENV_ASSIGNED_FLAG: "true",
-                    const.ENV_ASSUME_TIME: str(time.time_ns()),
-                }
+                # Validation runs per attempt: a pod re-matched after
+                # _PodGone is a different pod and must clear the
+                # dual-resource guard and the chip-conflict check itself
+                # (mirrors the mem path re-running _place per attempt).
                 for attempt in (0, 1):
+                    if P.mem_units_of_pod(pod) > 0:
+                        raise AllocationFailure(
+                            f"pod {P.name(pod)} requests both "
+                            f"{const.RESOURCE_MEM} and {const.RESOURCE_CORE}; "
+                            "dual-resource pods are unsupported"
+                        )
+                    self._check_conflicts(indices)
+                    annotations = {
+                        const.ENV_CORE_IDS: ",".join(str(i) for i in indices),
+                        const.ENV_CORE_POD: str(total),
+                        const.ENV_ASSIGNED_FLAG: "true",
+                        const.ENV_ASSUME_TIME: str(time.time_ns()),
+                    }
                     try:
                         persist_pod_assignment(
                             self._api, self._pods, pod, annotations,
@@ -347,8 +353,16 @@ class ClusterCoreAllocator:
                             P.namespace(pod), P.name(pod),
                         )
                         self._pods.evict(pod)
+                        pod = None
+                        if attempt:
+                            # final attempt: no point refreshing a result
+                            # we would discard (mirrors the mem path)
+                            raise AllocationFailure(
+                                f"no live pending pod on {self._node} requesting "
+                                f"{total} {const.RESOURCE_CORE}"
+                            ) from None
                         self._pods.refresh()
-                        pod = None if attempt else self._match_pending_pod(total)
+                        pod = self._match_pending_pod(total)
                         if pod is None:
                             raise AllocationFailure(
                                 f"no live pending pod on {self._node} requesting "
@@ -387,9 +401,7 @@ class ClusterCoreAllocator:
 
     def _check_conflicts(self, indices: list[int]) -> None:
         """Every granted chip must be free of other holds and healthy."""
-        snapshot = self._pods.labeled_pods()
-        mem_used = P.used_units_by_chip(snapshot)
-        core_held = P.used_chips(snapshot)
+        mem_used, core_held = self._pods.chip_state()
         unhealthy = set(self._unhealthy_fn())
         for idx in indices:
             if idx in core_held:
@@ -407,13 +419,8 @@ class ClusterCoreAllocator:
 
 
 def cluster_chip_state(pod_source: PodSource):
-    """() -> (mem_used_by_chip, core_held_chips) from one snapshot."""
-
-    def state():
-        snapshot = pod_source.labeled_pods()
-        return P.used_units_by_chip(snapshot), P.used_chips(snapshot)
-
-    return state
+    """() -> (mem_used_by_chip, core_held_chips) from one source read."""
+    return pod_source.chip_state
 
 
 def preferred_core_chips(inventory: DeviceInventory, state_fn):

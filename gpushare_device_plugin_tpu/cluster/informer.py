@@ -37,6 +37,10 @@ log = get_logger("cluster.informer")
 RELIST_BACKOFF_S = 1.0
 REFRESH_RETRIES = 3
 REFRESH_DELAY_S = 1.0
+# Tombstone rv recorded when the evicted pod had no parseable
+# resourceVersion: blocks every store for the key until an authoritative
+# LIST shows it again (_merge_list clears sentinels on presence).
+TOMB_SENTINEL = 1 << 62
 
 
 def _is_read_timeout(e: Exception) -> bool:
@@ -82,6 +86,8 @@ class PodInformer:
         use); empty means cluster-wide (the scheduler extender's use —
         placement accounting needs every node's pods, including assumed
         pods that carry annotations but no label yet)."""
+        from .usage import NodeChipUsage
+
         self._c = client
         self._node = node_name
         self._field_selector = f"spec.nodeName={node_name}" if node_name else ""
@@ -94,6 +100,13 @@ class PodInformer:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._live_response = None  # in-flight watch, closed by stop()
+        # Incremental aggregates maintained on every cache mutation so hot
+        # paths read O(chips)/O(nodes) instead of rescanning the cache.
+        # Node-scoped only: a cluster-wide cache would merge chip 0 of
+        # every node into one bucket (consumers there register their own
+        # per-node index via add_index).
+        self._usage = NodeChipUsage() if node_name else None
+        self._indexes: list = [self._usage] if self._usage else []
 
     # --- lifecycle --------------------------------------------------------
 
@@ -140,6 +153,43 @@ class PodInformer:
             self._thread.join(timeout=5.0)
             self._thread = None
 
+    @property
+    def synced(self) -> bool:
+        """True once an authoritative LIST has seeded the cache. Consumers
+        that cannot tolerate a cold cache (the extender would place pods
+        onto chips it believes empty) must fall back to direct LISTs, or
+        call ``refresh()``, while this is False."""
+        return self._synced.is_set()
+
+    # --- incremental indexes ----------------------------------------------
+
+    def add_index(self, index) -> "PodInformer":
+        """Register an aggregate maintained on every cache mutation.
+
+        ``index`` implements ``rebuild(pods)`` (called now, to fold in the
+        current cache) and ``on_change(old, new)`` (called under the cache
+        lock on every store/delete: ``old`` is the prior cached pod or
+        None, ``new`` the replacement or None)."""
+        with self._lock:
+            self._indexes.append(index)
+            index.rebuild(list(self._cache.values()))
+        return self
+
+    def _cache_set(self, key: tuple[str, str], pod: dict) -> None:
+        """Caller must hold self._lock."""
+        old = self._cache.get(key)
+        self._cache[key] = pod
+        for ix in self._indexes:
+            ix.on_change(old, pod)
+
+    def _cache_pop(self, key: tuple[str, str]) -> dict | None:
+        """Caller must hold self._lock."""
+        old = self._cache.pop(key, None)
+        if old is not None:
+            for ix in self._indexes:
+                ix.on_change(old, None)
+        return old
+
     # --- list+watch loop --------------------------------------------------
 
     def _key(self, pod: dict) -> tuple[str, str]:
@@ -171,11 +221,24 @@ class PodInformer:
             for key in [k for k in self._cache if k not in listed]:
                 cached_rv = _rv_int(self._cache[key])
                 if list_rv is None or cached_rv is None or cached_rv <= list_rv:
-                    self._cache.pop(key)
+                    self._cache_pop(key)
             for key, tomb in list(self._tombstones.items()):
                 if key in listed:
-                    # present in an authoritative LIST -> live now
-                    self._tombstones.pop(key)
+                    # Present in a LIST that provably postdates the
+                    # eviction -> live now (a recreation). A LIST whose rv
+                    # is unknown or older may have been served before the
+                    # deletion landed; keeping the tombstone makes
+                    # _store_if_newer drop that stale copy instead of
+                    # resurrecting the ghost. Sentinel tombstones (no rv
+                    # was parseable at evict time, stored as 1<<62) can
+                    # never win an rv comparison — for them an
+                    # authoritative LIST presence is the best evidence
+                    # available and must clear the block, or the key would
+                    # be uncacheable until restart.
+                    if tomb >= TOMB_SENTINEL or (
+                        list_rv is not None and list_rv >= tomb
+                    ):
+                        self._tombstones.pop(key)
                 elif gc_tombstones and list_rv is not None and tomb <= list_rv:
                     self._tombstones.pop(key)
             for p in items:
@@ -199,7 +262,7 @@ class PodInformer:
             old_rv = _rv_int(cached)
             if old_rv is not None and new_rv is not None and new_rv <= old_rv:
                 return
-        self._cache[key] = pod
+        self._cache_set(key, pod)
 
     def _apply(self, etype: str, pod: dict) -> None:
         key = self._key(pod)
@@ -217,7 +280,7 @@ class PodInformer:
                     or ev_rv is None
                     or cached_rv <= ev_rv
                 ):
-                    self._cache.pop(key, None)
+                    self._cache_pop(key)
                 # the real deletion arrived; the tombstone has served its
                 # purpose (a later recreation must not be blocked)
                 tomb = self._tombstones.get(key)
@@ -235,7 +298,7 @@ class PodInformer:
             and P.node_name(pod) not in ("", self._node)
         ):
             with self._lock:
-                self._cache.pop(key, None)
+                self._cache_pop(key)
 
     def _run(self) -> None:
         rv = "0"
@@ -316,6 +379,25 @@ class PodInformer:
         with self._lock:
             return list(self._cache.values())
 
+    def get_pod(self, namespace: str, name: str) -> dict | None:
+        with self._lock:
+            return self._cache.get((namespace, name))
+
+    def chip_state(self) -> tuple[dict[int, int], set[int]]:
+        """O(chips) usage read for the Allocate path: -> (mem units used
+        per chip, exclusively-held chips), maintained incrementally instead
+        of rescanning every labeled pod per admission. Falls back to a
+        synchronous LIST when the cache has never synced (a cold cache
+        reads as an empty node and would double-book every chip)."""
+        if self._usage is None:
+            raise RuntimeError(
+                "chip_state() requires a node-scoped informer; a "
+                "cluster-wide cache would merge chip indices across nodes"
+            )
+        if not self._synced.is_set():
+            self.refresh()
+        return self._usage.snapshot()
+
     # --- informer extras --------------------------------------------------
 
     def refresh(self) -> None:
@@ -339,6 +421,8 @@ class PodInformer:
             delay_s=REFRESH_DELAY_S,
         )
         self._merge_list(items, rv)
+        # an authoritative LIST seeds the cache as well as _relist does
+        self._synced.set()
 
     def evict(self, pod: dict) -> None:
         """Drop a pod the apiserver reported gone (PATCH 404) so the next
@@ -347,11 +431,11 @@ class PodInformer:
         from re-inserting the ghost behind our back."""
         key = self._key(pod)
         with self._lock:
-            cached = self._cache.pop(key, None)
+            cached = self._cache_pop(key)
             rv = _rv_int(cached) if cached is not None else None
             if rv is None:
                 rv = _rv_int(pod)
-            self._tombstones[key] = rv if rv is not None else (1 << 62)
+            self._tombstones[key] = rv if rv is not None else TOMB_SENTINEL
 
     def note_pod_update(self, pod: dict) -> None:
         """Feed a freshly-PATCHed pod straight into the cache so the next

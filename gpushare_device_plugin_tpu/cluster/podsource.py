@@ -60,6 +60,17 @@ class PodSource(Protocol):
         """
         ...
 
+    def chip_state(self) -> tuple[dict[int, int], set[int]]:
+        """One consistent usage read for the Allocate path: -> (mem units
+        used per chip, exclusively-held chips). List-backed sources derive
+        it from a labeled-pods snapshot; the informer maintains it
+        incrementally (O(chips) per admission)."""
+        ...
+
+
+def _chip_state_from(labeled_pods: list[dict]) -> tuple[dict[int, int], set[int]]:
+    return P.used_units_by_chip(labeled_pods), P.used_chips(labeled_pods)
+
 
 class ApiServerPodSource:
     def __init__(self, client: ApiServerClient, node_name: str):
@@ -108,6 +119,9 @@ class ApiServerPodSource:
             attempts=APISERVER_RETRIES,
             delay_s=APISERVER_DELAY_S,
         )
+
+    def chip_state(self) -> tuple[dict[int, int], set[int]]:
+        return _chip_state_from(self.labeled_pods())
 
 
 class KubeletPodSource:
@@ -169,3 +183,6 @@ class KubeletPodSource:
         except RetryError:
             return self._fallback.labeled_pods()
         return [p for p in pods if const.LABEL_RESOURCE_KEY in P.labels(p)]
+
+    def chip_state(self) -> tuple[dict[int, int], set[int]]:
+        return _chip_state_from(self.labeled_pods())

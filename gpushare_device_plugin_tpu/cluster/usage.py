@@ -1,0 +1,110 @@
+"""Incremental chip-usage accounting over an informer cache.
+
+The reference recomputes usage by scanning every labeled pod on each
+Allocate (``getPodUsedGPUMemory``, ``podmanager.go:102-115`` — a LIST plus
+an O(pods) walk per admission). Round 2 moved the LIST into the watch cache
+but kept the O(pods) walk; this module removes the walk too: a
+``NodeChipUsage`` index subscribes to cache mutations and maintains the two
+aggregates the Allocate path reads — fractional HBM units used per chip and
+the set of exclusively-held chips — so each admission reads O(chips), not
+O(pods).
+
+Correctness contract: a pod's contribution is a pure function of its JSON
+(``_mem_contribution`` / ``_core_contribution``, built on the same
+``cluster.pods`` predicates the batch helpers use), so applying
+``on_change(old, new)`` as subtract-then-add keeps the aggregates exactly
+equal to ``P.used_units_by_chip(cache)`` / ``P.used_chips(cache)`` at every
+point. Exclusive holds are reference-counted: two pods claiming one chip is
+an anomaly the allocator rejects, but the index must not forget the
+surviving hold when one of them dies.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from .. import const
+from . import pods as P
+
+
+def _mem_contribution(pod: dict) -> tuple[int, int] | None:
+    """(chip index, units) this pod adds to fractional-HBM accounting, or
+    None — the per-pod form of ``P.used_units_by_chip``."""
+    if not P.is_active(pod):
+        return None
+    if P.labels(pod).get(const.LABEL_RESOURCE_KEY) != const.LABEL_RESOURCE_VALUE:
+        return None
+    if not P.is_assigned(pod):
+        return None
+    idx = P.chip_idx_from_annotation(pod)
+    if idx < 0:
+        return None
+    return idx, P.mem_units_of_pod(pod)
+
+
+def _core_contribution(pod: dict) -> list[int]:
+    """Chips this pod holds exclusively — the per-pod form of
+    ``P.used_chips``."""
+    if not P.is_active(pod):
+        return []
+    if not P.is_assigned(pod):
+        return []
+    return P.core_hold_chips(pod)
+
+
+class NodeChipUsage:
+    """Per-chip usage aggregates for one node's pods (the daemon's view)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._mem_used: dict[int, int] = {}
+        self._core_refs: dict[int, int] = {}
+
+    # --- informer index protocol -----------------------------------------
+
+    def rebuild(self, pods: list[dict]) -> None:
+        with self._lock:
+            self._mem_used.clear()
+            self._core_refs.clear()
+            for pod in pods:
+                self._add(pod)
+
+    def on_change(self, old: dict | None, new: dict | None) -> None:
+        with self._lock:
+            if old is not None:
+                self._remove(old)
+            if new is not None:
+                self._add(new)
+
+    # --- internals (lock held) -------------------------------------------
+
+    def _add(self, pod: dict) -> None:
+        mem = _mem_contribution(pod)
+        if mem is not None:
+            idx, units = mem
+            self._mem_used[idx] = self._mem_used.get(idx, 0) + units
+        for idx in _core_contribution(pod):
+            self._core_refs[idx] = self._core_refs.get(idx, 0) + 1
+
+    def _remove(self, pod: dict) -> None:
+        mem = _mem_contribution(pod)
+        if mem is not None:
+            idx, units = mem
+            left = self._mem_used.get(idx, 0) - units
+            if left > 0:
+                self._mem_used[idx] = left
+            else:
+                self._mem_used.pop(idx, None)
+        for idx in _core_contribution(pod):
+            left = self._core_refs.get(idx, 0) - 1
+            if left > 0:
+                self._core_refs[idx] = left
+            else:
+                self._core_refs.pop(idx, None)
+
+    # --- reads ------------------------------------------------------------
+
+    def snapshot(self) -> tuple[dict[int, int], set[int]]:
+        """-> (mem units used per chip, exclusively-held chips)."""
+        with self._lock:
+            return dict(self._mem_used), set(self._core_refs)

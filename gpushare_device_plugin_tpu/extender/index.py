@@ -1,0 +1,130 @@
+"""Incremental per-node placement accounting for the scheduler extender.
+
+Round 2's informer removed the LIST-per-webhook, but every verb still
+walked all cached pods and rebuilt each node's view from scratch — O(pods)
+pure-Python work per scheduling decision, ~13 ms at 2,000 pods. This index
+subscribes to the cluster-wide ``PodInformer``'s cache mutations
+(``PodInformer.add_index``) and maintains, per node:
+
+- fractional units used per chip, per resource family (tpu-mem, gpu-mem) —
+  counted for any active pod carrying the family's IDX annotation (assumed
+  pods included), the same per-pod rule as ``logic.node_usage``;
+- a refcount of exclusively-held chips (assigned tpu-core pods), the same
+  per-pod rule as ``pods.used_chips``.
+
+Webhook verbs then read O(nodes-under-consideration), not O(cluster pods).
+The contribution of a pod is a pure function of its JSON, so
+subtract-then-add on every mutation keeps the aggregates exactly equal to
+a full recomputation over the cache.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..cluster import pods as P
+from .logic import RESOURCE_FAMILIES
+
+
+def _contributions(pod: dict) -> tuple[list[tuple[str, int, int]], list[int]]:
+    """-> ([(resource, chip idx, units)], [exclusively-held chip idx]).
+
+    Mirrors ``logic.node_usage`` (fractional) and ``P.used_chips``
+    (exclusive) for a single pod."""
+    if not P.is_active(pod):
+        return [], []
+    ann = P.annotations(pod)
+    frac: list[tuple[str, int, int]] = []
+    for resource, family in RESOURCE_FAMILIES.items():
+        raw = ann.get(family["idx"])
+        if raw is None:
+            continue
+        try:
+            idx = int(raw)
+        except (TypeError, ValueError):
+            continue
+        if idx < 0:
+            continue
+        units = P.mem_units_of_pod(pod, resource=resource)
+        if units > 0:
+            frac.append((resource, idx, units))
+    return frac, sorted(P.used_chips([pod]))
+
+
+class ClusterUsageIndex:
+    """Implements the PodInformer index protocol (rebuild/on_change)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # node -> {"frac": {resource: {chip: units}}, "core": {chip: refs}}
+        self._nodes: dict[str, dict] = {}
+
+    # --- informer index protocol -----------------------------------------
+
+    def rebuild(self, pods: list[dict]) -> None:
+        with self._lock:
+            self._nodes.clear()
+            for pod in pods:
+                self._add(pod)
+
+    def on_change(self, old: dict | None, new: dict | None) -> None:
+        with self._lock:
+            if old is not None:
+                self._remove(old)
+            if new is not None:
+                self._add(new)
+
+    # --- internals (lock held) -------------------------------------------
+
+    def _agg(self, node: str) -> dict:
+        agg = self._nodes.get(node)
+        if agg is None:
+            agg = self._nodes[node] = {"frac": {}, "core": {}}
+        return agg
+
+    def _add(self, pod: dict) -> None:
+        frac, cores = _contributions(pod)
+        if not frac and not cores:
+            return
+        agg = self._agg(P.node_name(pod))
+        for resource, idx, units in frac:
+            used = agg["frac"].setdefault(resource, {})
+            used[idx] = used.get(idx, 0) + units
+        for idx in cores:
+            agg["core"][idx] = agg["core"].get(idx, 0) + 1
+
+    def _remove(self, pod: dict) -> None:
+        frac, cores = _contributions(pod)
+        if not frac and not cores:
+            return
+        node = P.node_name(pod)
+        agg = self._nodes.get(node)
+        if agg is None:
+            return
+        for resource, idx, units in frac:
+            used = agg["frac"].get(resource, {})
+            left = used.get(idx, 0) - units
+            if left > 0:
+                used[idx] = left
+            else:
+                used.pop(idx, None)
+        for idx in cores:
+            left = agg["core"].get(idx, 0) - 1
+            if left > 0:
+                agg["core"][idx] = left
+            else:
+                agg["core"].pop(idx, None)
+        if not agg["core"] and not any(agg["frac"].values()):
+            self._nodes.pop(node, None)
+
+    # --- reads ------------------------------------------------------------
+
+    def node_state(self, node: str, resource: str) -> tuple[dict[int, int], set[int]]:
+        """-> (units used per chip for ``resource``, exclusively-held
+        chips) on ``node``; copies, safe to mutate (the extender overlays
+        in-flight decisions on top)."""
+        with self._lock:
+            agg = self._nodes.get(node)
+            if agg is None:
+                return {}, set()
+            return dict(agg["frac"].get(resource, {})), set(agg["core"])

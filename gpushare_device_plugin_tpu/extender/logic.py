@@ -133,31 +133,55 @@ def node_fits(view: NodeView, request_units: int) -> bool:
     return any(f >= request_units for f in view.free().values())
 
 
-def filter_nodes(
-    pod: dict, nodes: list[dict], pods: list[dict]
+def evaluate_filter(
+    request_units: int, views: list[NodeView]
 ) -> tuple[list[str], dict[str, str]]:
-    """-> (fitting node names, failed node -> reason)."""
+    """Fit check over prebuilt views -> (fitting names, name -> reason)."""
+    fits, failed = [], {}
+    for view in views:
+        if not view.capacity:
+            failed[view.name] = f"node does not advertise {view.resource}"
+        elif not node_fits(view, request_units):
+            failed[view.name] = (
+                f"no single chip with {request_units} free units of "
+                f"{view.resource} (free: {view.free()})"
+            )
+        else:
+            fits.append(view.name)
+    return fits, failed
+
+
+def views_from_pods(pods: list[dict]):
+    """views_fn over a full pod list (the LIST-backed path); the extender
+    server passes its index-backed equivalent instead."""
+
+    def views(resource: str, nodes: list[dict]) -> list[NodeView]:
+        by_node = group_pods_by_node(pods)
+        return [build_node_view(n, by_node, resource) for n in nodes]
+
+    return views
+
+
+def filter_with_views(
+    pod: dict, nodes: list[dict], views_fn
+) -> tuple[list[str], dict[str, str]]:
+    """-> (fitting node names, failed node -> reason).
+
+    ``views_fn(resource, nodes) -> list[NodeView]`` supplies the accounting
+    (full-scan or incremental-index) — verb semantics live here once."""
     resource = pod_resource(pod)
     if resource is None:
         # not a share pod: everything passes (we shouldn't be called, but
         # the scheduler may still route the pod through the extender)
         return [n.get("metadata", {}).get("name", "") for n in nodes], {}
     request = P.mem_units_of_pod(pod, resource=resource)
-    by_node = group_pods_by_node(pods)
-    fits, failed = [], {}
-    for node in nodes:
-        view = build_node_view(node, by_node, resource)
-        name = view.name
-        if not view.capacity:
-            failed[name] = f"node does not advertise {resource}"
-        elif not node_fits(view, request):
-            failed[name] = (
-                f"no single chip with {request} free units of {resource} "
-                f"(free: {view.free()})"
-            )
-        else:
-            fits.append(name)
-    return fits, failed
+    return evaluate_filter(request, views_fn(resource, nodes))
+
+
+def filter_nodes(
+    pod: dict, nodes: list[dict], pods: list[dict]
+) -> tuple[list[str], dict[str, str]]:
+    return filter_with_views(pod, nodes, views_from_pods(pods))
 
 
 def score_node(view: NodeView, request_units: int) -> int:
@@ -173,18 +197,22 @@ def score_node(view: NodeView, request_units: int) -> int:
     return round(10 * (1 - (best - request_units) / cap))
 
 
-def prioritize_nodes(
-    pod: dict, nodes: list[dict], pods: list[dict]
-) -> dict[str, int]:
+def evaluate_scores(request_units: int, views: list[NodeView]) -> dict[str, int]:
+    return {v.name: score_node(v, request_units) for v in views}
+
+
+def prioritize_with_views(pod: dict, nodes: list[dict], views_fn) -> dict[str, int]:
     resource = pod_resource(pod)
     if resource is None:
         return {n.get("metadata", {}).get("name", ""): 0 for n in nodes}
     request = P.mem_units_of_pod(pod, resource=resource)
-    by_node = group_pods_by_node(pods)
-    return {
-        (v := build_node_view(n, by_node, resource)).name: score_node(v, request)
-        for n in nodes
-    }
+    return evaluate_scores(request, views_fn(resource, nodes))
+
+
+def prioritize_nodes(
+    pod: dict, nodes: list[dict], pods: list[dict]
+) -> dict[str, int]:
+    return prioritize_with_views(pod, nodes, views_from_pods(pods))
 
 
 def choose_chip(
@@ -198,9 +226,17 @@ def choose_chip(
     resource = pod_resource(pod)
     if resource is None:
         raise AssignmentError("pod requests no share resource")
+    view = build_node_view(node, group_pods_by_node(pods), resource)
+    return choose_chip_from_view(pod, view, policy=policy)
+
+
+def choose_chip_from_view(
+    pod: dict, view: NodeView, policy: str = "best-fit"
+) -> tuple[str, int, dict[str, str]]:
+    """``choose_chip`` over a prebuilt view (the index-backed path)."""
+    resource = view.resource
     family = RESOURCE_FAMILIES[resource]
     request = P.mem_units_of_pod(pod, resource=resource)
-    view = build_node_view(node, group_pods_by_node(pods), resource)
     idx = assign_chip(
         request,
         view.capacity,

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import argparse
 import copy
+import dataclasses
 import json
 import sys
 import threading
@@ -25,36 +26,120 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..allocator.binpack import AssignmentError
+from ..cluster import pods as P
 from ..cluster.apiserver import ApiError, ApiServerClient
 from ..utils.log import get_logger
 from ..utils import log as logutil
 from . import logic
+from .index import ClusterUsageIndex
 
 log = get_logger("extender")
+
+
+@dataclasses.dataclass
+class _Inflight:
+    """A bind decision the apiserver watch may not reflect yet."""
+
+    node: str
+    resource: str
+    idx: int
+    units: int
+    annotations: dict[str, str]
+    stamp: float
 
 
 class ExtenderCore:
     def __init__(self, api: ApiServerClient, policy: str = "best-fit", informer=None):
         """``informer``: an optional cluster-wide ``PodInformer`` (no node
-        field-selector). With it, filter/prioritize/bind read the watch
-        cache instead of LISTing every pod in the cluster per webhook call
-        — at a few thousand pods that LIST costs tens of ms and real
-        apiserver load on every scheduling decision."""
+        field-selector). With it, filter/prioritize/bind read incremental
+        per-node aggregates (``ClusterUsageIndex``) off the watch cache —
+        O(nodes) per webhook verb — instead of LISTing and walking every
+        pod in the cluster per scheduling decision."""
         self._api = api
         self._policy = policy
         self._informer = informer
+        self._index: ClusterUsageIndex | None = None
+        if informer is not None:
+            self._index = ClusterUsageIndex()
+            informer.add_index(self._index)
         # RLock: bind() holds it across its whole decision and calls
-        # _active_pods(), which also touches the in-flight cache
+        # _node_views(), which also touches the in-flight cache
         self._lock = threading.RLock()
-        # (ns, name) -> (node, annotations, stamp): decisions made here that
-        # the apiserver may not reflect yet when the next filter runs
-        self._inflight: dict[tuple[str, str], tuple[str, dict, float]] = {}
+        self._inflight: dict[tuple[str, str], _Inflight] = {}
         self._inflight_ttl_s = 60.0
 
     # --- helpers ----------------------------------------------------------
 
+    def _use_index(self) -> bool:
+        """The index serves reads only once the informer has synced: before
+        the first LIST lands the cache reads as an empty cluster and every
+        chip looks free — placements would over-commit. Until then fall
+        back to direct LISTs (never weaker than the reference extender)."""
+        return (
+            self._index is not None
+            and self._informer is not None
+            and self._informer.synced
+        )
+
+    def _live_inflight(self) -> dict[tuple[str, str], _Inflight]:
+        now = time.monotonic()
+        with self._lock:
+            self._inflight = {
+                k: v for k, v in self._inflight.items()
+                if now - v.stamp < self._inflight_ttl_s
+            }
+            return dict(self._inflight)
+
+    def _node_views(self, resource: str, nodes: list[dict]) -> list[logic.NodeView]:
+        """Build per-node placement views for ``resource``.
+
+        Index path: O(len(nodes)) reads of the incremental aggregates, then
+        overlay in-flight bind decisions whose annotations have not yet
+        arrived on the watch (once the pod's cached copy carries the IDX
+        annotation the index already counts it — skip to avoid double
+        counting). List path: full scan, identical semantics."""
+        if self._use_index():
+            views = []
+            by_name: dict[str, logic.NodeView] = {}
+            for node in nodes:
+                name = node.get("metadata", {}).get("name", "")
+                used, core_held = self._index.node_state(name, resource)
+                view = logic.NodeView(
+                    name=name,
+                    resource=resource,
+                    capacity=logic.node_capacity(node, resource),
+                    used=used,
+                    core_held=core_held if resource == logic.const.RESOURCE_MEM
+                    else set(),
+                )
+                views.append(view)
+                by_name[name] = view
+            family = logic.RESOURCE_FAMILIES[resource]
+            for (ns, pname), entry in self._live_inflight().items():
+                if entry.resource != resource:
+                    continue
+                view = by_name.get(entry.node)
+                if view is None:
+                    continue
+                cached = self._informer.get_pod(ns, pname)
+                if cached is None or not P.is_active(cached):
+                    continue
+                if (
+                    family["idx"] in P.annotations(cached)
+                    and P.node_name(cached) == entry.node
+                ):
+                    continue  # watch caught up; the index counts it on node
+                # Otherwise the index either misses the pod or files it
+                # under the wrong node (annotation MODIFIED can precede the
+                # bind MODIFIED, leaving nodeName empty): count it here.
+                view.used[entry.idx] = view.used.get(entry.idx, 0) + entry.units
+            return views
+        pods = self._active_pods()
+        by_node = logic.group_pods_by_node(pods)
+        return [logic.build_node_view(n, by_node, resource) for n in nodes]
+
     def _active_pods(self) -> list[dict]:
-        if self._informer is not None:
+        if self._informer is not None and self._informer.synced:
             pods = self._informer.all_pods()
         else:
             pods = self._api.list_pods()
@@ -64,17 +149,11 @@ class ExtenderCore:
                 continue
             out.append(pod)
         # overlay in-flight decisions not yet visible in the list
-        now = time.monotonic()
-        with self._lock:
-            self._inflight = {
-                k: v for k, v in self._inflight.items()
-                if now - v[2] < self._inflight_ttl_s
-            }
-            inflight = dict(self._inflight)
+        inflight = self._live_inflight()
         by_key = {(p.get("metadata", {}).get("namespace", "default"),
                    p.get("metadata", {}).get("name", "")): i
                   for i, p in enumerate(out)}
-        for (ns, name), (node, ann, _) in inflight.items():
+        for (ns, name), entry in inflight.items():
             i = by_key.get((ns, name))
             if i is not None:
                 # copy before overlay: with an informer these dicts ARE the
@@ -82,9 +161,9 @@ class ExtenderCore:
                 pod = copy.deepcopy(out[i])
                 meta = pod.setdefault("metadata", {})
                 merged = dict(meta.get("annotations") or {})
-                merged.update(ann)
+                merged.update(entry.annotations)
                 meta["annotations"] = merged
-                pod.setdefault("spec", {}).setdefault("nodeName", node)
+                pod.setdefault("spec", {}).setdefault("nodeName", entry.node)
                 out[i] = pod
         return out
 
@@ -105,7 +184,7 @@ class ExtenderCore:
     def filter(self, args: dict) -> dict:
         pod = args.get("pod") or {}
         nodes = self._nodes_from_args(args)
-        fits, failed = logic.filter_nodes(pod, nodes, self._active_pods())
+        fits, failed = logic.filter_with_views(pod, nodes, self._node_views)
         log.v(4, "filter %s: fits=%s failed=%s",
               pod.get("metadata", {}).get("name"), fits, list(failed))
         return {
@@ -119,7 +198,7 @@ class ExtenderCore:
     def prioritize(self, args: dict) -> list[dict]:
         pod = args.get("pod") or {}
         nodes = self._nodes_from_args(args)
-        scores = logic.prioritize_nodes(pod, nodes, self._active_pods())
+        scores = logic.prioritize_with_views(pod, nodes, self._node_views)
         return [{"host": host, "score": score} for host, score in scores.items()]
 
     def bind(self, args: dict) -> dict:
@@ -130,12 +209,23 @@ class ExtenderCore:
             try:
                 pod = self._api.get_pod(ns, name)
                 node = self._api.get_node(node_name)
-                _, idx, annotations = logic.choose_chip(
-                    pod, node, self._active_pods(), policy=self._policy
+                resource = logic.pod_resource(pod)
+                if resource is None:
+                    raise AssignmentError("pod requests no share resource")
+                view = self._node_views(resource, [node])[0]
+                _, idx, annotations = logic.choose_chip_from_view(
+                    pod, view, policy=self._policy
                 )
                 self._api.patch_pod(ns, name, {"metadata": {"annotations": annotations}})
                 self._api.bind_pod(ns, name, node_name)
-                self._inflight[(ns, name)] = (node_name, annotations, time.monotonic())
+                self._inflight[(ns, name)] = _Inflight(
+                    node=node_name,
+                    resource=resource,
+                    idx=idx,
+                    units=P.mem_units_of_pod(pod, resource=resource),
+                    annotations=annotations,
+                    stamp=time.monotonic(),
+                )
             except (ApiError, AssignmentError) as e:
                 log.warning("bind %s/%s -> %s failed: %s", ns, name, node_name, e)
                 from ..cluster.events import REASON_BIND_FAILED, emit_pod_event

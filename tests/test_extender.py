@@ -212,6 +212,8 @@ def test_informer_backed_extender_scale_2000_pods():
         pod["metadata"]["namespace"] = "default"
         api.add_pod(pod)
     nodes = [shared_node(f"n{j}", chips=4, units=32) for j in range(50)]
+    for n in nodes:
+        api.nodes[n["metadata"]["name"]] = n
 
     informer = PodInformer(client).start(sync_timeout_s=30)
     core = ExtenderCore(client, informer=informer)
@@ -236,6 +238,46 @@ def test_informer_backed_extender_scale_2000_pods():
         bind_ms = (_time.perf_counter() - t0) * 1e3
         assert res["error"] == ""
         assert bind_ms < 50.0, f"bind took {bind_ms:.1f}ms"
+    finally:
+        informer.stop()
+        api.stop()
+
+
+def test_index_overlay_counts_bind_before_nodename_lands():
+    """Watch-lag hazard on the index path: after bind() the annotation
+    MODIFIED can reach the cache before the bind MODIFIED sets nodeName —
+    the index then files the pod's usage under node "" and the target
+    node's view would under-count it. The in-flight overlay must keep
+    counting the decision until the cached copy carries BOTH the IDX
+    annotation and the decided nodeName."""
+    from gpushare_device_plugin_tpu.cluster.informer import PodInformer
+
+    api = FakeApiServer()
+    api.start()
+    client = ApiServerClient(api.url)
+    node = shared_node("n1", chips=1, units=8)
+    api.nodes["n1"] = node
+    informer = PodInformer(client).start(sync_timeout_s=10)
+    core = ExtenderCore(client, informer=informer)
+    try:
+        api.add_pod(make_pod("first", 8, node=""))
+        assert core.bind({"podName": "first", "podNamespace": "default",
+                          "node": "n1"})["error"] == ""
+        # simulate the half-landed watch state: annotations present,
+        # nodeName still empty (the bind MODIFIED is in flight)
+        stored = api.pods[("default", "first")]
+        half = json.loads(json.dumps(stored))
+        half["spec"]["nodeName"] = ""
+        half["metadata"]["resourceVersion"] = str(
+            int(stored["metadata"].get("resourceVersion", "1")) + 1000
+        )
+        informer.note_pod_update(half)
+        # the only chip is fully reserved by the in-flight decision
+        pod2 = make_pod("second", 8, node="")
+        fits, failed = core.filter({"pod": pod2, "nodes": {"items": [node]}})[
+            "nodenames"], core.filter({"pod": pod2, "nodes": {"items": [node]}})[
+            "failedNodes"]
+        assert fits == [] and "n1" in failed
     finally:
         informer.stop()
         api.stop()

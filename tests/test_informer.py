@@ -279,3 +279,91 @@ def test_lagging_deleted_event_does_not_evict_recreation(api):
     assert [p["metadata"]["resourceVersion"] for p in inf.pending_pods()] == [
         newer["metadata"]["resourceVersion"]
     ]
+
+
+def test_chip_state_matches_batch_computation(api):
+    """The incremental NodeChipUsage index must equal the batch helpers
+    (P.used_units_by_chip / P.used_chips) after every kind of mutation."""
+    from gpushare_device_plugin_tpu.cluster import pods as P
+
+    inf = PodInformer(ApiServerClient(api.url), NODE).start(sync_timeout_s=5)
+    try:
+        from k8s_fixtures import assigned_running_pod
+
+        api.add_pod(assigned_running_pod("m1", 4, chip_idx=0, node=NODE))
+        api.add_pod(assigned_running_pod("m2", 2, chip_idx=0, node=NODE))
+        api.add_pod(assigned_running_pod("m3", 8, chip_idx=2, node=NODE))
+        core = make_pod(
+            "holder", tpu_core=1, node=NODE, phase="Running",
+            annotations={
+                const.ENV_CORE_IDS: "3",
+                const.ENV_ASSIGNED_FLAG: "true",
+            },
+            labels={const.LABEL_RESOURCE_KEY: const.LABEL_CORE_VALUE},
+        )
+        api.add_pod(core)
+        assert wait_until(lambda: len(inf.all_pods()) == 4)
+
+        def batch():
+            pods = inf.all_pods()
+            return P.used_units_by_chip(pods), P.used_chips(pods)
+
+        assert inf.chip_state() == ({0: 6, 2: 8}, {3})
+        assert inf.chip_state() == batch()
+
+        # a pod finishing releases its units
+        api.set_pod_phase("default", "m2", "Succeeded")
+        assert wait_until(lambda: inf.chip_state()[0].get(0) == 4)
+        assert inf.chip_state() == batch()
+
+        # deletion releases the exclusive hold
+        api.delete_pod("default", "holder")
+        assert wait_until(lambda: inf.chip_state()[1] == set())
+        assert inf.chip_state() == batch()
+
+        # evict + note_pod_update keep the index in step
+        m3 = next(p for p in inf.all_pods() if p["metadata"]["name"] == "m3")
+        inf.evict(m3)
+        assert inf.chip_state()[0].get(2) is None
+    finally:
+        inf.stop()
+
+
+def test_sentinel_tombstone_cleared_by_authoritative_list(api):
+    """evict() with no parseable rv writes a sentinel tombstone; presence
+    in a later authoritative LIST must clear it (else the key would be
+    uncacheable until restart)."""
+    inf = PodInformer(ApiServerClient(api.url), NODE).start(sync_timeout_s=5)
+    inf.stop()
+    ghost = make_pod("ghost", 2, node=NODE)
+    ghost["metadata"].pop("resourceVersion", None)
+    inf.evict(ghost)
+    # lagging watch event for the ghost stays blocked
+    inf._apply("MODIFIED", ghost)
+    assert inf.pending_pods() == []
+    # a recreation arrives via LIST
+    api.add_pod(make_pod("ghost", 2, node=NODE))
+    inf.refresh()
+    assert [p["metadata"]["name"] for p in inf.pending_pods()] == ["ghost"]
+
+
+def test_stale_list_does_not_resurrect_evicted_ghost(api):
+    """A LIST served before the deletion (rv older than the tombstone)
+    must not resurrect the ghost via refresh()."""
+    inf = PodInformer(ApiServerClient(api.url), NODE).start(sync_timeout_s=5)
+    api.add_pod(make_pod("ghost", 2, node=NODE))
+    assert wait_until(lambda: len(inf.pending_pods()) == 1)
+    inf.stop()
+    # capture a LIST from before the eviction
+    stale_items, stale_rv = ApiServerClient(api.url).list_pods_with_rv(
+        field_selector=f"spec.nodeName={NODE}"
+    )
+    # the cached copy advances past the stale LIST before the eviction
+    ghost = dict(inf.pending_pods()[0])
+    ghost["metadata"] = dict(ghost["metadata"])
+    ghost["metadata"]["resourceVersion"] = str(int(stale_rv) + 10)
+    inf.note_pod_update(ghost)
+    inf.evict(ghost)
+    assert inf.pending_pods() == []
+    inf._merge_list(stale_items, stale_rv)
+    assert inf.pending_pods() == []
