@@ -1,0 +1,260 @@
+"""Compute-path benchmark on the real TPU chip: kernel speedup + train MFU.
+
+Two measurements the control-plane bench (``bench.py``) cannot make:
+
+1. **flash-vs-plain attention** — compiles ``ops/flash_attention.py`` with
+   ``interpret=False`` (real Mosaic lowering), asserts numerics on-device
+   against the plain-softmax oracle (``workloads/attention.grouped_full_attention``,
+   which reduces to ``parallel/ring.full_attention`` for MHA), and reports
+   wall-time at several (S, D) points plus one backward-pass point.
+2. **flagship train step** — >=20 timed optimizer steps of the Llama-style
+   decoder (``workloads/transformer.py`` via ``make_train_step``) with the
+   flash kernel forced on, reporting tokens/s and model-FLOPs MFU
+   (achieved matmul FLOP/s divided by the chip's peak bf16 FLOP/s).
+
+The reference publishes no compute numbers at all (its scope is container
+scheduling, ``/root/reference/README.md:1-16``); these numbers exist so the
+workload half of this framework is held to the hardware, not to the Pallas
+interpreter.
+
+Prints ONE JSON object on stdout (consumed by ``bench.py``); human-readable
+progress goes to stderr.  On a non-TPU backend it prints
+``{"skipped": true}`` and exits 0 — the compiled-kernel path is meaningless
+off-chip.
+
+MFU convention: model matmul FLOPs only (no rematerialisation recompute, no
+vector ops), causal attention counted at half the full score matrix —
+the conservative count, so the reported MFU is a lower bound.
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import sys
+import time
+
+# Peak dense bf16 FLOP/s per chip, keyed by substring of device_kind.
+# Public Cloud TPU spec-sheet numbers (same provenance as the HBM table in
+# discovery/tpuvm.py).
+_PEAK_BF16_TFLOPS = (
+    ("v6 lite", 918.0),  # Trillium / v6e
+    ("v6e", 918.0),
+    ("v5 lite", 197.0),  # v5e
+    ("v5litepod", 197.0),
+    ("v5e", 197.0),
+    ("v5p", 459.0),
+    ("v5", 459.0),  # v5p long name fallback; must come after the lite keys
+    ("v4", 275.0),
+    ("v3", 123.0),
+    ("v2", 46.0),
+)
+
+
+def _peak_tflops(device_kind: str) -> float | None:
+    kind = device_kind.lower()
+    for key, tflops in _PEAK_BF16_TFLOPS:
+        if key in kind:
+            return tflops
+    return None
+
+
+def _timeit(fn, *args, iters: int = 20, warmup: int = 2):
+    """Median + spread of per-call wall time (seconds), device-synced."""
+    import jax
+
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args))
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        times.append(time.perf_counter() - t0)
+    return statistics.median(times), times
+
+
+def bench_flash(report: dict) -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from gpushare_device_plugin_tpu.ops import flash_attention
+    from gpushare_device_plugin_tpu.workloads.attention import grouped_full_attention
+
+    # (B, H, Hkv, S, Dh): an MHA point, a GQA point, a long-context point.
+    points = [
+        (4, 16, 16, 1024, 64),
+        (2, 16, 4, 4096, 128),
+        (1, 8, 8, 8192, 64),
+    ]
+    results = []
+    for B, H, Hkv, S, Dh in points:
+        kq, kk, kv = jax.random.split(jax.random.key(0), 3)
+        q = jax.random.normal(kq, (B, S, H, Dh), jnp.bfloat16)
+        k = jax.random.normal(kk, (B, S, Hkv, Dh), jnp.bfloat16)
+        v = jax.random.normal(kv, (B, S, Hkv, Dh), jnp.bfloat16)
+
+        flash = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True, interpret=False))
+        plain = jax.jit(lambda q, k, v: grouped_full_attention(q, k, v, causal=True))
+
+        # Numerics: both paths do f32 scores/softmax and cast to bf16, so
+        # they must agree to bf16 rounding on O(1)-scale outputs.
+        o_flash = np.asarray(flash(q, k, v), np.float32)
+        o_plain = np.asarray(plain(q, k, v), np.float32)
+        err = float(np.max(np.abs(o_flash - o_plain)))
+        if err > 0.03:
+            raise AssertionError(
+                f"flash kernel numerics off oracle at S={S} Dh={Dh}: max abs err {err}"
+            )
+
+        t_flash, _ = _timeit(flash, q, k, v)
+        t_plain, _ = _timeit(plain, q, k, v)
+        # Causal-effective score+value matmul FLOPs: 2 * (QK + PV) / 2.
+        flops = 2.0 * B * H * S * S * Dh
+        res = {
+            "B": B, "H": H, "Hkv": Hkv, "S": S, "Dh": Dh,
+            "flash_ms": round(t_flash * 1e3, 3),
+            "plain_ms": round(t_plain * 1e3, 3),
+            "speedup": round(t_plain / t_flash, 2),
+            "flash_tflops": round(flops / t_flash / 1e12, 1),
+            "max_abs_err": round(err, 4),
+        }
+        results.append(res)
+        print(f"flash fwd {res}", file=sys.stderr)
+    report["flash"] = results
+
+    # Backward pass at the GQA point: full VJP through the Pallas dQ/dKV
+    # kernels vs the oracle's autodiff.
+    B, H, Hkv, S, Dh = points[1]
+    kq, kk, kv = jax.random.split(jax.random.key(1), 3)
+    q = jax.random.normal(kq, (B, S, H, Dh), jnp.bfloat16)
+    k = jax.random.normal(kk, (B, S, Hkv, Dh), jnp.bfloat16)
+    v = jax.random.normal(kv, (B, S, Hkv, Dh), jnp.bfloat16)
+    loss_flash = jax.jit(jax.grad(
+        lambda q, k, v: flash_attention(q, k, v, causal=True, interpret=False)
+        .astype(jnp.float32).sum()
+    ))
+    loss_plain = jax.jit(jax.grad(
+        lambda q, k, v: grouped_full_attention(q, k, v, causal=True)
+        .astype(jnp.float32).sum()
+    ))
+    t_flash, _ = _timeit(loss_flash, q, k, v)
+    t_plain, _ = _timeit(loss_plain, q, k, v)
+    report["flash_bwd"] = {
+        "B": B, "H": H, "Hkv": Hkv, "S": S, "Dh": Dh,
+        "flash_ms": round(t_flash * 1e3, 3),
+        "plain_ms": round(t_plain * 1e3, 3),
+        "speedup": round(t_plain / t_flash, 2),
+    }
+    print(f"flash bwd {report['flash_bwd']}", file=sys.stderr)
+
+
+def _matmul_flops_per_step(cfg, batch: int, seq: int) -> tuple[float, int]:
+    """(train-step matmul FLOPs, param count) for the decoder.
+
+    Forward matmul FLOPs = 2 * (weight size) per token for every projection,
+    plus causal-effective attention scores; backward = 2x forward.  Remat
+    recompute is deliberately NOT counted (model FLOPs, lower-bound MFU).
+    """
+    d, H, Dh, Hkv, F, L, V = (
+        cfg.d_model, cfg.n_heads, cfg.head_dim, cfg.kv_heads,
+        cfg.d_ff, cfg.n_layers, cfg.vocab,
+    )
+    tokens = batch * seq
+    per_layer_params = d * H * Dh + d * 2 * Hkv * Dh + H * Dh * d + d * 2 * F + F * d
+    n_params = V * d * 2 + L * (per_layer_params + 2 * d) + d
+    proj_fwd = 2.0 * tokens * (L * per_layer_params + V * d)  # out-proj; embed is a gather
+    attn_fwd = L * batch * (2.0 * H * seq * seq * Dh)  # (QK + PV) / 2 causal
+    return 3.0 * (proj_fwd + attn_fwd), n_params
+
+
+def bench_train(report: dict) -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from gpushare_device_plugin_tpu.workloads.transformer import (
+        TransformerConfig,
+        demo_batch,
+        init_train_state,
+        make_train_step,
+    )
+
+    # ~0.5B-param decoder: big enough that the MXU dominates, small enough
+    # that f32 params + Adam moments + activations fit one v5e chip (16 GiB).
+    cfg = TransformerConfig(
+        vocab=8192, d_model=2048, n_layers=8, n_heads=16, n_kv_heads=8,
+        d_ff=7168, max_seq=2048, rope_theta=500000.0,
+        compute_dtype=jnp.bfloat16, attention="flash",
+    )
+    batch, seq = 8, 2048
+    mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1, 1, 1), ("dp", "fsdp", "tp", "sp"))
+
+    flops_per_step, n_params = _matmul_flops_per_step(cfg, batch, seq)
+    print(
+        f"train: {n_params / 1e6:.0f}M params, {batch}x{seq} tokens/step, "
+        f"{flops_per_step / 1e12:.1f} model TFLOPs/step",
+        file=sys.stderr,
+    )
+
+    params, opt_state = init_train_state(jax.random.key(0), mesh, cfg)
+    step = make_train_step(mesh, cfg)
+    tokens = demo_batch(jax.random.key(1), batch, seq, cfg.vocab)
+
+    for _ in range(3):  # compile + warmup
+        params, opt_state, loss = step(params, opt_state, tokens)
+    loss = float(jax.block_until_ready(loss))
+    if not np.isfinite(loss):
+        raise AssertionError(f"non-finite warmup loss {loss}")
+
+    times = []
+    n_steps = 20
+    for _ in range(n_steps):
+        t0 = time.perf_counter()
+        params, opt_state, loss = step(params, opt_state, tokens)
+        jax.block_until_ready(loss)
+        times.append(time.perf_counter() - t0)
+    step_s = statistics.median(times)
+    peak = report.get("peak_bf16_tflops")
+    achieved_tflops = flops_per_step / step_s / 1e12
+    report["train"] = {
+        "params_m": round(n_params / 1e6, 1),
+        "batch": batch, "seq": seq, "steps_timed": n_steps,
+        "step_ms": round(step_s * 1e3, 1),
+        "step_ms_min": round(min(times) * 1e3, 1),
+        "step_ms_max": round(max(times) * 1e3, 1),
+        "tokens_per_s": round(batch * seq / step_s),
+        "achieved_tflops": round(achieved_tflops, 1),
+        "mfu_pct": round(100.0 * achieved_tflops / peak, 1) if peak else None,
+        "final_loss": round(float(jax.block_until_ready(loss)), 4),
+    }
+    print(f"train {report['train']}", file=sys.stderr)
+
+
+def main() -> int:
+    import jax
+
+    if jax.default_backend() != "tpu":
+        print(
+            f"backend is {jax.default_backend()!r}, not tpu - skipping compute bench",
+            file=sys.stderr,
+        )
+        print(json.dumps({"skipped": True, "backend": jax.default_backend()}))
+        return 0
+
+    dev = jax.devices()[0]
+    report: dict = {
+        "skipped": False,
+        "backend": "tpu",
+        "device_kind": dev.device_kind,
+        "peak_bf16_tflops": _peak_tflops(dev.device_kind),
+    }
+    bench_flash(report)
+    bench_train(report)
+    print(json.dumps(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -5,12 +5,24 @@ of verbs (list pods/nodes with selectors, strategic-merge patch pod, patch
 node status), all plain REST+JSON. Config resolution mirrors the reference
 (``podmanager.go:29-57``): ``$KUBECONFIG`` file if set, else the in-cluster
 serviceaccount (token + CA + ``KUBERNETES_SERVICE_HOST/PORT``).
+
+Transport: the unary verbs ride a persistent per-thread ``http.client``
+connection — the Allocate hot path's PATCH is the one unavoidable network
+round-trip (``allocate.go:136-150``), and the requests library spends
+~0.5 ms of pure client CPU per call (header/cookie plumbing) with a long
+jittery tail, roughly 4x the cost of the socket write itself. The
+streaming watch keeps requests (chunked iter_lines + a Response handle the
+informer can close from another thread to cancel a blocked read).
 """
 
 from __future__ import annotations
 
+import http.client
 import json
 import os
+import ssl
+import threading
+import urllib.parse
 from typing import Any, Mapping
 
 import requests
@@ -53,6 +65,91 @@ class ApiServerClient:
         if client_cert:
             self._session.cert = client_cert
         self._session.verify = False if insecure else (ca_file or True)
+
+        # Unary-verb transport: persistent http.client connections, one per
+        # thread (HTTPConnection is not thread-safe; the extender serves
+        # concurrent webhook verbs over one shared client).
+        u = urllib.parse.urlsplit(self.base_url)
+        self._scheme = u.scheme or "http"
+        self._host = u.hostname or "127.0.0.1"
+        self._port = u.port or (443 if self._scheme == "https" else 80)
+        # Path prefix in the server URL (proxied clusters, e.g.
+        # https://gw.example/k8s/clusters/c-abc) must prefix every verb.
+        self._base_path = u.path.rstrip("/")
+        self._headers = {"Authorization": f"Bearer {token}"} if token else {}
+        self._ssl_ctx: ssl.SSLContext | None = None
+        if self._scheme == "https":
+            if insecure:
+                ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+                ctx.check_hostname = False
+                ctx.verify_mode = ssl.CERT_NONE
+            else:
+                ctx = ssl.create_default_context(cafile=ca_file)
+            if client_cert:
+                ctx.load_cert_chain(client_cert[0], client_cert[1])
+            self._ssl_ctx = ctx
+        self._local = threading.local()
+
+    def _connection(self) -> http.client.HTTPConnection:
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            if self._scheme == "https":
+                conn = http.client.HTTPSConnection(
+                    self._host, self._port,
+                    context=self._ssl_ctx, timeout=self._timeout,
+                )
+            else:
+                conn = http.client.HTTPConnection(
+                    self._host, self._port, timeout=self._timeout
+                )
+            self._local.conn = conn
+        return conn
+
+    def _request(
+        self,
+        method: str,
+        path: str,
+        params: Mapping[str, str] | None = None,
+        body: str | None = None,
+        content_type: str | None = None,
+    ) -> tuple[int, str]:
+        """One unary round-trip on the persistent connection.
+
+        A keep-alive connection the server quietly closed surfaces as a
+        failure on the *next* use (write succeeds into a dead socket, read
+        gets EOF = ``RemoteDisconnected``) — retried once on a fresh
+        connection. Non-idempotent verbs (PATCH/POST) retry ONLY on that
+        zero-bytes-received signature or on send-phase failures: a timeout
+        mid-response could mean the server already applied the change
+        (re-sending a Binding would 409 a pod that is actually bound), so
+        it propagates.
+        """
+        if params:
+            path = path + "?" + urllib.parse.urlencode(params)
+        path = self._base_path + path
+        headers = dict(self._headers)
+        if content_type:
+            headers["Content-Type"] = content_type
+        idempotent = method == "GET"
+        for attempt in (0, 1):
+            conn = self._connection()
+            sent = False
+            try:
+                conn.request(method, path, body=body, headers=headers)
+                sent = True
+                resp = conn.getresponse()
+                return resp.status, resp.read().decode("utf-8", "replace")
+            except (http.client.HTTPException, ConnectionError, OSError) as e:
+                self._local.conn = None
+                try:
+                    conn.close()
+                except Exception:  # noqa: BLE001
+                    pass
+                retriable = idempotent or not sent or isinstance(
+                    e, http.client.RemoteDisconnected
+                )
+                if attempt or not retriable:
+                    raise
 
     # --- construction ------------------------------------------------------
 
@@ -153,23 +250,16 @@ class ApiServerClient:
     # --- raw verbs ----------------------------------------------------------
 
     def _get(self, path: str, params: Mapping[str, str] | None = None) -> dict:
-        r = self._session.get(
-            self.base_url + path, params=params or {}, timeout=self._timeout
-        )
-        if r.status_code != 200:
-            raise ApiError(r.status_code, r.text)
-        return r.json()
+        status, text = self._request("GET", path, params)
+        if status != 200:
+            raise ApiError(status, text)
+        return json.loads(text)
 
     def _patch(self, path: str, body: Any, content_type: str) -> dict:
-        r = self._session.patch(
-            self.base_url + path,
-            data=json.dumps(body),
-            headers={"Content-Type": content_type},
-            timeout=self._timeout,
-        )
-        if r.status_code not in (200, 201):
-            raise ApiError(r.status_code, r.text)
-        return r.json()
+        status, text = self._request("PATCH", path, body=json.dumps(body), content_type=content_type)
+        if status not in (200, 201):
+            raise ApiError(status, text)
+        return json.loads(text)
 
     # --- typed helpers ------------------------------------------------------
 
@@ -268,14 +358,14 @@ class ApiServerClient:
             "metadata": {"name": name, "namespace": namespace},
             "target": {"apiVersion": "v1", "kind": "Node", "name": node},
         }
-        r = self._session.post(
-            f"{self.base_url}/api/v1/namespaces/{namespace}/pods/{name}/binding",
-            data=json.dumps(body),
-            headers={"Content-Type": "application/json"},
-            timeout=self._timeout,
+        status, text = self._request(
+            "POST",
+            f"/api/v1/namespaces/{namespace}/pods/{name}/binding",
+            body=json.dumps(body),
+            content_type="application/json",
         )
-        if r.status_code not in (200, 201):
-            raise ApiError(r.status_code, r.text)
+        if status not in (200, 201):
+            raise ApiError(status, text)
 
     def list_nodes(self, label_selector: str = "") -> list[dict]:
         params = {"labelSelector": label_selector} if label_selector else {}
@@ -299,11 +389,11 @@ class ApiServerClient:
         return self._patch(f"/api/v1/nodes/{name}/status", body, MERGE_PATCH)
 
     def create_event(self, namespace: str, event: dict) -> None:
-        r = self._session.post(
-            f"{self.base_url}/api/v1/namespaces/{namespace}/events",
-            data=json.dumps(event),
-            headers={"Content-Type": "application/json"},
-            timeout=self._timeout,
+        status, _ = self._request(
+            "POST",
+            f"/api/v1/namespaces/{namespace}/events",
+            body=json.dumps(event),
+            content_type="application/json",
         )
-        if r.status_code not in (200, 201):
-            log.warning("event create failed: HTTP %s", r.status_code)
+        if status not in (200, 201):
+            log.warning("event create failed: HTTP %s", status)

@@ -83,16 +83,25 @@ class TpuVmBackend:
     # --- enumeration -------------------------------------------------------
 
     def _device_paths(self) -> list[str]:
+        return self._device_paths_numbered()[0]
+
+    def _device_paths_numbered(self) -> tuple[list[str], bool]:
+        """(sorted device paths, numbers-are-chip-indices).
+
+        ``/dev/accelN``'s N *is* the chip number (stable across a vanished
+        sibling); ``/dev/vfio/N`` is an IOMMU group number with no chip
+        meaning, so vfio paths get positional indices.
+        """
         paths = sorted(
             glob.glob(self._dev_glob),
             key=lambda p: int(re.sub(r"\D", "", p) or 0),
         )
         if paths:
-            return paths
+            return paths, True
         return sorted(
             glob.glob(self._vfio_glob),
             key=lambda p: int(re.sub(r"\D", "", p) or 0),
-        )
+        ), False
 
     def _accel_type(self) -> str:
         for key in ENV_ACCEL_TYPE:
@@ -120,18 +129,53 @@ class TpuVmBackend:
         return bool(self._device_paths())
 
     def chips(self) -> Sequence[TpuChip]:
+        """Chip list keyed by the *device number*, not the glob position.
+
+        ``/dev/accel2`` is chip 2 even when ``/dev/accel1`` has vanished
+        (driver reset mid-rescan): positional numbering would renumber the
+        surviving chips, silently remapping every pod's
+        ``TPU_VISIBLE_CHIPS`` — the same stability contract as the native
+        shim's devnum keying (``native/tpuinfo.cpp:150-153``) and the
+        reference's index-from-path parse (``nvidia.go:66``). When the shim
+        is loaded it is the authoritative enumerator (it reads the same
+        /dev but adds libtpu-derived HBM); the pure-Python glob is the
+        fallback so driverless images still park cleanly.
+        """
         hbm = self._hbm_bytes()
         gen, _ = parse_accelerator_type(self._accel_type())
         host = self._worker_id()
-        return [
-            TpuChip(
-                id=f"tpu-{gen or 'unknown'}-host{host}-chip{i}",
-                index=i,
-                device_path=path,
-                hbm_bytes=hbm,
+        if not self._env_overridden:
+            native = self._load_native()
+            if native is not None:
+                try:
+                    native.rescan()
+                    nchips = native.chips()
+                except OSError:
+                    nchips = []
+                if nchips:
+                    return [
+                        TpuChip(
+                            id=c.id or f"tpu-{gen or 'unknown'}-host{host}-chip{c.index}",
+                            index=c.index,
+                            device_path=c.device_path,
+                            hbm_bytes=c.hbm_bytes if c.hbm_bytes > 0 else hbm,
+                        )
+                        for c in nchips
+                    ]
+        out = []
+        paths, numbered = self._device_paths_numbered()
+        for pos, path in enumerate(paths):
+            m = re.search(r"(\d+)$", path) if numbered else None
+            idx = int(m.group(1)) if m else pos
+            out.append(
+                TpuChip(
+                    id=f"tpu-{gen or 'unknown'}-host{host}-chip{idx}",
+                    index=idx,
+                    device_path=path,
+                    hbm_bytes=hbm,
+                )
             )
-            for i, path in enumerate(self._device_paths())
-        ]
+        return out
 
     def _worker_id(self) -> int:
         for key in ENV_WORKER_ID:

@@ -122,13 +122,19 @@ class ExtenderCore:
                 if view is None:
                     continue
                 cached = self._informer.get_pod(ns, pname)
-                if cached is None or not P.is_active(cached):
-                    continue
-                if (
-                    family["idx"] in P.annotations(cached)
-                    and P.node_name(cached) == entry.node
-                ):
-                    continue  # watch caught up; the index counts it on node
+                # Not cached yet (reservation made before the pod's watch
+                # event, or before its PATCH even landed): the index cannot
+                # be counting it, so the overlay must — skipping here would
+                # let a concurrent bind double-book the chip. Only a pod
+                # provably finished stops counting early (TTL otherwise).
+                if cached is not None:
+                    if not P.is_active(cached):
+                        continue
+                    if (
+                        family["idx"] in P.annotations(cached)
+                        and P.node_name(cached) == entry.node
+                    ):
+                        continue  # watch caught up; the index counts it on node
                 # Otherwise the index either misses the pod or files it
                 # under the wrong node (annotation MODIFIED can precede the
                 # bind MODIFIED, leaving nodeName empty): count it here.
@@ -202,22 +208,35 @@ class ExtenderCore:
         return [{"host": host, "score": score} for host, score in scores.items()]
 
     def bind(self, args: dict) -> dict:
+        """Persist the chip decision and create the v1 Binding.
+
+        Concurrency design: the lock guards only the in-memory decision —
+        build the node view, choose the chip, and *reserve* it by inserting
+        the in-flight entry — never network I/O. The GET pod/node before it
+        and the PATCH + binding POST after it run unlocked, so binds to
+        different nodes proceed in parallel instead of serializing the
+        whole cluster's admission behind one apiserver round-trip (with the
+        index path the locked section is pure memory; the ``--pod-source
+        list`` fallback still LISTs inside ``_node_views``). The
+        reservation is visible to every concurrent decision through the
+        in-flight overlay (``_node_views``), which is exactly how mid-PATCH
+        decisions were already kept from double-booking; a failed PATCH or
+        Binding rolls the reservation back.
+        """
         ns = args.get("podNamespace", "default")
         name = args.get("podName", "")
         node_name = args.get("node", "")
-        with self._lock:
-            try:
-                pod = self._api.get_pod(ns, name)
-                node = self._api.get_node(node_name)
-                resource = logic.pod_resource(pod)
-                if resource is None:
-                    raise AssignmentError("pod requests no share resource")
+        try:
+            pod = self._api.get_pod(ns, name)
+            node = self._api.get_node(node_name)
+            resource = logic.pod_resource(pod)
+            if resource is None:
+                raise AssignmentError("pod requests no share resource")
+            with self._lock:
                 view = self._node_views(resource, [node])[0]
                 _, idx, annotations = logic.choose_chip_from_view(
                     pod, view, policy=self._policy
                 )
-                self._api.patch_pod(ns, name, {"metadata": {"annotations": annotations}})
-                self._api.bind_pod(ns, name, node_name)
                 self._inflight[(ns, name)] = _Inflight(
                     node=node_name,
                     resource=resource,
@@ -226,19 +245,26 @@ class ExtenderCore:
                     annotations=annotations,
                     stamp=time.monotonic(),
                 )
-            except (ApiError, AssignmentError) as e:
-                log.warning("bind %s/%s -> %s failed: %s", ns, name, node_name, e)
-                from ..cluster.events import REASON_BIND_FAILED, emit_pod_event
+            try:
+                self._api.patch_pod(ns, name, {"metadata": {"annotations": annotations}})
+                self._api.bind_pod(ns, name, node_name)
+            except Exception:
+                with self._lock:
+                    self._inflight.pop((ns, name), None)
+                raise
+        except (ApiError, AssignmentError) as e:
+            log.warning("bind %s/%s -> %s failed: %s", ns, name, node_name, e)
+            from ..cluster.events import REASON_BIND_FAILED, emit_pod_event
 
-                emit_pod_event(
-                    self._api,
-                    {"metadata": {"namespace": ns, "name": name}},
-                    REASON_BIND_FAILED,
-                    f"bind to {node_name} failed: {e}",
-                    component="tpushare-scheduler-extender",
-                    host=node_name,
-                )
-                return {"error": str(e)}
+            emit_pod_event(
+                self._api,
+                {"metadata": {"namespace": ns, "name": name}},
+                REASON_BIND_FAILED,
+                f"bind to {node_name} failed: {e}",
+                component="tpushare-scheduler-extender",
+                host=node_name,
+            )
+            return {"error": str(e)}
         log.info("bound %s/%s -> %s chip %d", ns, name, node_name, idx)
         return {"error": ""}
 
@@ -259,6 +285,13 @@ class ExtenderHTTPServer:
         core = self._core
 
         class Handler(BaseHTTPRequestHandler):
+            # Keep-alive matters twice over: the scheduler calls the webhook
+            # per scheduling cycle, and each handler thread caches its own
+            # persistent apiserver connection (ApiServerClient._connection
+            # is thread-local) — HTTP/1.0's connection-per-request would
+            # pay a fresh apiserver TCP/TLS handshake on every verb.
+            protocol_version = "HTTP/1.1"
+
             def log_message(self, fmt, *args):
                 log.v(6, fmt, *args)
 

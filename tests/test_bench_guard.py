@@ -1,0 +1,104 @@
+"""The bench trend guard: a >20% allocate-p50 regression must fail loudly.
+
+Round 1 -> round 3 the north-star p50 drifted +34% with nobody noticing
+(VERDICT round 3, weak #1); the guard makes that class of silent
+regression impossible — bench.py exits nonzero when the measured p50
+regresses more than ``TREND_GUARD_PCT`` against the newest committed
+``BENCH_r*.json`` record.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import bench
+
+
+def _write_record(tmp: Path, n: int, p50: float) -> None:
+    """A driver-shaped BENCH_r{n}.json: {"parsed": {...}} possibly among
+    other concatenated records."""
+    rec = {
+        "n": n,
+        "cmd": "python bench.py",
+        "rc": 0,
+        "parsed": {
+            "metric": "allocate_p50_latency",
+            "value": p50,
+            "unit": "ms",
+            "vs_baseline": round(100.0 / p50, 1),
+        },
+    }
+    (tmp / f"BENCH_r{n:02d}.json").write_text(json.dumps(rec))
+
+
+def test_no_history_passes(tmp_path):
+    assert bench.previous_p50(tmp_path) is None
+    assert bench.trend_guard(999.0, tmp_path) is None
+
+
+def test_newest_record_wins(tmp_path):
+    _write_record(tmp_path, 1, 2.0)
+    _write_record(tmp_path, 3, 3.0)
+    _write_record(tmp_path, 2, 1.0)
+    p50, fname = bench.previous_p50(tmp_path)
+    assert p50 == 3.0
+    assert fname == "BENCH_r03.json"
+
+
+def test_within_budget_passes(tmp_path):
+    _write_record(tmp_path, 1, 2.0)
+    assert bench.trend_guard(2.0, tmp_path) is None
+    assert bench.trend_guard(2.39, tmp_path) is None  # +19.5% < 20%
+
+
+def test_regression_fails(tmp_path):
+    _write_record(tmp_path, 1, 2.0)
+    msg = bench.trend_guard(2.5, tmp_path)  # +25%
+    assert msg is not None and "TREND GUARD" in msg and "BENCH_r01.json" in msg
+
+
+def test_improvement_passes(tmp_path):
+    _write_record(tmp_path, 1, 2.0)
+    assert bench.trend_guard(1.2, tmp_path) is None
+
+
+def test_malformed_history_ignored(tmp_path):
+    (tmp_path / "BENCH_r01.json").write_text("not json at all {")
+    _write_record(tmp_path, 2, 2.0)
+    p50, fname = bench.previous_p50(tmp_path)
+    assert (p50, fname) == (2.0, "BENCH_r02.json")
+
+
+def test_nested_compute_record_parses(tmp_path):
+    """The round-4+ record embeds a nested "compute" object (flash/MFU
+    results); the parser must be brace-aware, not a flat-regex scan."""
+    rec = {
+        "n": 4,
+        "parsed": {
+            "metric": "allocate_p50_latency",
+            "value": 1.75,
+            "unit": "ms",
+            "vs_baseline": 57.2,
+            "compute": {
+                "flash": [{"S": 4096, "speedup": 3.2}],
+                "train": {"mfu_pct": 41.0, "tokens_per_s": 31000},
+            },
+        },
+    }
+    (tmp_path / "BENCH_r04.json").write_text(json.dumps(rec))
+    p50, fname = bench.previous_p50(tmp_path)
+    assert (p50, fname) == (1.75, "BENCH_r04.json")
+
+
+def test_concatenated_records_take_last(tmp_path):
+    """Driver files may concatenate several {...} blocks; the last parsed
+    allocate_p50_latency block is the authoritative one."""
+    a = {"n": 1, "parsed": {"metric": "allocate_p50_latency", "value": 9.0}}
+    b = {"n": 1, "parsed": {"metric": "allocate_p50_latency", "value": 2.0}}
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(a) + json.dumps(b))
+    p50, _ = bench.previous_p50(tmp_path)
+    assert p50 == 2.0

@@ -124,6 +124,32 @@ def test_tpuvm_backend_uses_native_hbm(libpath, fake_host, monkeypatch):
     assert chips[0].hbm_bytes == 32 << 30  # sysfs via native shim
 
 
+def test_native_sparse_device_numbers(libpath, fake_host):
+    """Shim keys chips on the device number: with accel1 gone, survivors
+    keep indices {0,2,3} across a rescan (``tpuinfo.cpp`` devnum keying)."""
+    n = tpuinfo.load(libpath)
+    try:
+        (fake_host / "accel1").unlink()
+        n.rescan()
+        assert [c.index for c in n.chips()] == [0, 2, 3]
+        assert [c.id for c in n.chips()] == [
+            "tpu-v5e-chip0", "tpu-v5e-chip2", "tpu-v5e-chip3",
+        ]
+    finally:
+        n.shutdown()
+
+
+def test_tpuvm_backend_prefers_native_enumeration(libpath, fake_host):
+    """With the shim loaded, TpuVmBackend takes the shim's chip list (not
+    just its HBM): a sparse /dev keeps device-number indices end to end."""
+    (fake_host / "accel1").unlink()
+    be = TpuVmBackend(dev_glob=str(fake_host / "accel*"), native_lib=libpath)
+    chips = be.chips()
+    assert [c.index for c in chips] == [0, 2, 3]
+    assert chips[0].id == "tpu-v5e-chip0"  # shim-authored id
+    assert all(c.hbm_bytes == 32 << 30 for c in chips)  # shim sysfs HBM
+
+
 def test_tpuvm_backend_env_dict_is_hermetic(libpath, fake_host):
     """An explicit env dict must not be bypassed by the native shim's
     process-env metadata (testability contract of TpuVmBackend)."""

@@ -42,6 +42,38 @@ def test_probe_and_chips(fake_dev):
     assert "v4" in chips[0].id
 
 
+def test_sparse_device_numbers_keep_indices(tmp_path):
+    """A vanished /dev/accel1 must NOT renumber accel2 -> index 1: the
+    index is parsed from the device number (``nvidia.go:66`` semantics,
+    matching the native shim ``tpuinfo.cpp``), so surviving chips keep
+    their identity and no pod's TPU_VISIBLE_CHIPS silently remaps."""
+    for i in (0, 2, 3):
+        (tmp_path / f"accel{i}").touch()
+    be = TpuVmBackend(
+        dev_glob=str(tmp_path / "accel*"), env={"TPU_ACCELERATOR_TYPE": "v4-8"}
+    )
+    chips = be.chips()
+    assert [c.index for c in chips] == [0, 2, 3]
+    assert [c.id for c in chips] == [
+        "tpu-v4-host0-chip0", "tpu-v4-host0-chip2", "tpu-v4-host0-chip3",
+    ]
+
+
+def test_rescan_after_device_loss_is_stable(tmp_path):
+    """Indices {0,1,2,3} -> remove accel1 -> rescan sees {0,2,3} with ids
+    unchanged for the survivors (no renumber across rescans)."""
+    for i in range(4):
+        (tmp_path / f"accel{i}").touch()
+    be = TpuVmBackend(
+        dev_glob=str(tmp_path / "accel*"), env={"TPU_ACCELERATOR_TYPE": "v4-8"}
+    )
+    before = {c.index: c.id for c in be.chips()}
+    (tmp_path / "accel1").unlink()
+    after = {c.index: c.id for c in be.chips()}
+    assert sorted(after) == [0, 2, 3]
+    assert all(after[i] == before[i] for i in after)
+
+
 def test_probe_false_without_devices(tmp_path):
     be = TpuVmBackend(dev_glob=str(tmp_path / "accel*"), env={})
     assert not be.probe()
