@@ -1,7 +1,7 @@
 PY ?= python
 PROTOC ?= protoc
 
-.PHONY: proto native test test-fast test-slow lint bench
+.PHONY: proto native test test-fast test-slow test-stress lint bench e2e-kind
 
 # Regenerate protobuf message classes (gRPC bindings are hand-written in
 # gpushare_device_plugin_tpu/plugin/api/api_grpc.py; grpc_tools is not
@@ -24,6 +24,28 @@ test-fast:
 # JAX tier: kernels, trainer, multihost (CPU mesh)
 test-slow:
 	$(PY) -m pytest tests/ -x -q -m slow
+
+# Stress tier: the race-targeted tests for the threaded core
+# (informer/allocator/manager/extender), repeated with chaos mode on —
+# randomized watch jitter + abrupt stream drops in the fake apiserver,
+# seeded per iteration. The Python stand-in for the reference's
+# `go test -race` CI pass (.circleci/config.yml:17-19).
+STRESS_ITERS ?= 50
+test-stress:
+	@for i in $$(seq 1 $(STRESS_ITERS)); do \
+	  echo "stress iteration $$i/$(STRESS_ITERS)"; \
+	  TPUSHARE_TEST_CHAOS=1 TPUSHARE_TEST_CHAOS_SEED=$$i \
+	  $(PY) -m pytest tests/test_informer.py tests/test_cluster_allocator.py \
+	    tests/test_manager.py tests/test_extender.py tests/test_plugin_e2e.py \
+	    -x -q || exit 1; \
+	done
+
+# kind end-to-end: deploy the manifests with mock discovery on a local kind
+# cluster and assert the demo pod admits with TPU_VISIBLE_CHIPS injected
+# (BASELINE config 1). Requires kind + kubectl + docker; skips cleanly in
+# environments without them.
+e2e-kind:
+	bash deploy/e2e_kind.sh
 
 lint:
 	$(PY) -m compileall -q gpushare_device_plugin_tpu tests bench.py __graft_entry__.py

@@ -1,0 +1,94 @@
+#!/usr/bin/env bash
+# End-to-end smoke on a local kind cluster (BASELINE config 1):
+#
+#   kind cluster -> deploy RBAC + DaemonSet with --discovery=mock
+#   (4 chips x 32 GiB) -> node advertises aliyun.com/tpu-mem: 128 ->
+#   demo job requesting 2 GiB admits with TPU_VISIBLE_CHIPS injected ->
+#   the inspect CLI reports 2/128 GiB used.
+#
+# The reference's only end-to-end was running demo/binpack-1 by hand on a
+# live cluster (SURVEY.md section 4); this scripts that, against kind, with
+# mock discovery standing in for TPU hardware.
+#
+# Requires kind + kubectl + docker; exits 0 with SKIP when absent (CI
+# environments without nested containers, e.g. the build image, skip this).
+set -euo pipefail
+
+CLUSTER=${TPUSHARE_E2E_CLUSTER:-tpushare-e2e}
+IMG=${TPUSHARE_E2E_IMAGE:-tpushare-device-plugin:latest}
+ROOT=$(cd "$(dirname "$0")/.." && pwd)
+KCTL="kubectl --context kind-${CLUSTER}"
+
+for bin in kind kubectl docker; do
+  if ! command -v "$bin" >/dev/null 2>&1; then
+    echo "SKIP: $bin not available — kind e2e needs kind+kubectl+docker"
+    exit 0
+  fi
+done
+
+cleanup() { kind delete cluster --name "$CLUSTER" >/dev/null 2>&1 || true; }
+trap cleanup EXIT
+
+echo "=== build image"
+docker build -t "$IMG" "$ROOT"
+
+echo "=== create kind cluster $CLUSTER"
+kind delete cluster --name "$CLUSTER" >/dev/null 2>&1 || true
+kind create cluster --name "$CLUSTER" --wait 120s
+kind load docker-image "$IMG" --name "$CLUSTER"
+
+NODE=$($KCTL get nodes -o jsonpath='{.items[0].metadata.name}')
+$KCTL label node "$NODE" tpushare=true --overwrite
+
+echo "=== deploy plugin (mock discovery)"
+$KCTL apply -f "$ROOT/deploy/device-plugin-rbac.yaml"
+# Same DaemonSet the docs ship, with mock discovery standing in for
+# /dev/accel* (kind nodes have no TPUs).
+sed 's/- --health-check/- --health-check\n            - --discovery=mock/' \
+  "$ROOT/deploy/device-plugin-ds.yaml" | $KCTL apply -f -
+$KCTL -n kube-system rollout status ds/tpushare-device-plugin --timeout=180s
+
+echo "=== wait for node capacity aliyun.com/tpu-mem=128"
+for i in $(seq 1 60); do
+  CAP=$($KCTL get node "$NODE" -o jsonpath='{.status.allocatable.aliyun\.com/tpu-mem}' || true)
+  [ "$CAP" = "128" ] && break
+  sleep 2
+done
+[ "$CAP" = "128" ] || { echo "FAIL: node advertises tpu-mem='$CAP', want 128"; exit 1; }
+echo "node advertises 128 tpu-mem units"
+
+echo "=== run a 2 GiB workload pod"
+$KCTL apply -f - <<EOF
+apiVersion: v1
+kind: Pod
+metadata:
+  name: tpushare-e2e-smoke
+  labels:
+    app: tpushare-e2e-smoke
+spec:
+  restartPolicy: Never
+  containers:
+    - name: smoke
+      image: $IMG
+      command: ["sh", "-c", "test -n \"\$TPU_VISIBLE_CHIPS\" && echo TPU_VISIBLE_CHIPS=\$TPU_VISIBLE_CHIPS && sleep 300"]
+      resources:
+        limits:
+          aliyun.com/tpu-mem: 2
+EOF
+$KCTL wait pod/tpushare-e2e-smoke --for=condition=Ready --timeout=180s
+
+CHIPS=$($KCTL exec tpushare-e2e-smoke -- printenv TPU_VISIBLE_CHIPS)
+[ -n "$CHIPS" ] || { echo "FAIL: TPU_VISIBLE_CHIPS not injected"; exit 1; }
+echo "pod admitted with TPU_VISIBLE_CHIPS=$CHIPS"
+
+echo "=== inspect CLI reports utilization"
+# The plugin image carries the inspect CLI; run it in the DaemonSet pod,
+# which has an in-cluster serviceaccount.
+DS_POD=$($KCTL -n kube-system get pod -l app=tpushare-device-plugin \
+  -o jsonpath='{.items[0].metadata.name}')
+REPORT=$($KCTL -n kube-system exec "$DS_POD" -- kubectl-inspect-tpushare)
+echo "$REPORT"
+echo "$REPORT" | grep -q "2/128" || {
+  echo "FAIL: inspect CLI does not show 2/128 units used"; exit 1; }
+
+echo "PASS: kind e2e — admission, env injection, and utilization all good"

@@ -19,6 +19,50 @@ log = get_logger("cluster.events")
 COMPONENT = "tpushare-device-plugin"
 REASON_ALLOC_FAILED = "TpuShareAllocationFailed"
 REASON_BIND_FAILED = "TpuShareBindFailed"
+REASON_CHIP_UNHEALTHY = "TpuChipUnhealthy"
+REASON_CHIP_RECOVERED = "TpuChipRecovered"
+REASON_CHIP_APP_FAULT = "TpuChipAppLevelFault"
+REASON_CHIP_TRANSIENT = "TpuChipTransientBlip"
+
+
+def emit_node_event(
+    api,
+    node_name: str,
+    reason: str,
+    message: str,
+    *,
+    component: str = COMPONENT,
+    event_type: str = "Warning",
+) -> None:
+    """Warning/Normal event on the Node object so ``kubectl describe node``
+    shows chip health transitions with their classified reason (the
+    reference's XID events were glog-only)."""
+    now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    event = {
+        "apiVersion": "v1",
+        "kind": "Event",
+        "metadata": {
+            "generateName": f"{node_name}.tpushare-",
+            "namespace": "default",
+        },
+        "involvedObject": {
+            "apiVersion": "v1",
+            "kind": "Node",
+            "name": node_name,
+            "uid": node_name,
+        },
+        "reason": reason,
+        "message": message,
+        "type": event_type,
+        "source": {"component": component, "host": node_name},
+        "firstTimestamp": now,
+        "lastTimestamp": now,
+        "count": 1,
+    }
+    try:
+        api.create_event("default", event)
+    except Exception as e:  # noqa: BLE001 — events are best-effort
+        log.warning("node event emission failed for %s: %s", node_name, e)
 
 
 def emit_pod_event(

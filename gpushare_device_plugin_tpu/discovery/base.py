@@ -53,11 +53,23 @@ class HealthEvent:
 
     Analog of an NVML XID critical event (``nvidia.go:121-152``): events
     without a device attribution mark every chip unhealthy.
+
+    ``severity`` classifies the fault the way the reference classifies
+    XIDs (``nvidia.go:133-137`` skips application-level XIDs 31/43/45):
+
+    - ``"hard"`` — infrastructure fault; flips schedulability (the
+      allocator excludes the chip, ListAndWatch marks it Unhealthy).
+    - ``"transient"`` — infrastructure blip that self-healed inside the
+      grace window (driver reset); informational only, never flips health.
+    - ``"app"`` — application-level fault (e.g. correctable-error counter
+      ticked); surfaced as a log line and a Kubernetes event but NEVER
+      changes chip health — a user bug must not de-advertise hardware.
     """
 
     chip_id: str | None
     health: ChipHealth
     reason: str = ""
+    severity: str = "hard"
 
 
 class DiscoveryBackend(Protocol):

@@ -37,6 +37,21 @@ CHIPS_PER_HOST = {"v2": 4, "v3": 4, "v4": 4, "v5e": 8, "v5litepod": 8, "v5p": 4,
 ENV_ACCEL_TYPE = ("TPU_ACCELERATOR_TYPE", "ACCELERATOR_TYPE")
 ENV_WORKER_ID = ("TPU_WORKER_ID", "WORKER_ID")
 ENV_HBM_OVERRIDE = "TPUSHARE_HBM_GIB"
+ENV_SYSFS_ROOT = "TPUINFO_SYSFS_ROOT"
+
+# Health classification knobs (see watch_health):
+# A device file must stay missing for MORE than this many consecutive polls
+# before the chip goes Unhealthy — a shorter blip (driver reset, host
+# maintenance tick) never surfaces, so the allocator never excludes the chip.
+DEVICE_GONE_GRACE_POLLS = 1
+# After a hard error-counter hit, this many quiet polls heal the chip.
+COUNTER_QUIET_POLLS = 6
+# sysfs error counters (best-effort: present on some driver versions under
+# /sys/class/accel/accel<N>/device/). Uncorrectable errors are
+# infrastructure faults -> hard; correctable errors are the app-level
+# analog of XID 31/43/45 (``nvidia.go:133-137``) -> never de-advertise.
+HARD_COUNTER_FILES = ("uncorrectable_errors",)
+APP_COUNTER_FILES = ("correctable_errors",)
 
 
 def parse_accelerator_type(accel: str) -> tuple[str, int]:
@@ -54,6 +69,9 @@ class TpuVmBackend:
         vfio_glob: str = "/dev/vfio/[0-9]*",
         env: dict | None = None,
         native_lib: str | None = None,
+        sysfs_root: str | None = None,
+        poll_s: float = 5.0,
+        grace_polls: int = DEVICE_GONE_GRACE_POLLS,
     ):
         self._dev_glob = dev_glob
         self._vfio_glob = vfio_glob
@@ -65,6 +83,9 @@ class TpuVmBackend:
         self._native = None
         self._native_lib = native_lib
         self._native_tried = False
+        self._sysfs_root = sysfs_root or self._env.get(ENV_SYSFS_ROOT) or "/sys"
+        self._poll_s = poll_s
+        self._grace_polls = grace_polls
 
     # --- native shim (optional) -------------------------------------------
 
@@ -205,16 +226,45 @@ class TpuVmBackend:
 
     # --- health ------------------------------------------------------------
 
-    def watch_health(self, stop: Callable[[], bool]) -> Iterator[HealthEvent]:
-        """Device-file liveness poll (5 s, matching ``nvidia.go:128``).
+    def _read_counters(self, device_path: str) -> dict[str, int]:
+        """Best-effort sysfs error counters for one chip ({} when absent)."""
+        name = os.path.basename(device_path)
+        base = os.path.join(self._sysfs_root, "class", "accel", name, "device")
+        out: dict[str, int] = {}
+        for fname in HARD_COUNTER_FILES + APP_COUNTER_FILES:
+            try:
+                with open(os.path.join(base, fname)) as f:
+                    out[fname] = int(f.read().strip())
+            except (OSError, ValueError):
+                continue
+        return out
 
-        A chip whose device file disappears (driver reset, host maintenance
-        event) is marked unhealthy; it recovers when the file returns — the
-        recovery path the reference never implemented (FIXME ``server.go:184``).
-        The native shim, when present, adds a libtpu liveness check for the
-        whole host.
+    def watch_health(self, stop: Callable[[], bool]) -> Iterator[HealthEvent]:
+        """Per-chip classified health poll (default 5 s, ``nvidia.go:128``).
+
+        Three signals, classified per chip (the reference's XID watcher
+        granularity, ``nvidia.go:102-154``, vs round-3's whole-host flag):
+
+        - **device file presence** with a grace window: a file missing for
+          <= ``grace_polls`` consecutive polls is a transient blip (driver
+          reset) and surfaces nothing — the allocator never excludes the
+          chip; longer outages go Unhealthy with reason
+          ``device-file-gone``, and recover the moment the file returns
+          (the recovery path the reference never implemented, FIXME
+          ``server.go:184``).
+        - **sysfs error counters** (when the driver exposes them): an
+          uncorrectable-error delta is a hard fault (immediate Unhealthy,
+          healed after ``COUNTER_QUIET_POLLS`` quiet polls); a
+          correctable-error delta is the app-level analog of XID 31/43/45
+          (``nvidia.go:133-137``) — an ``"app"``-severity event that never
+          flips schedulability.
+        - **libtpu runtime liveness** via the native shim, whole-host, hard
+          (a dead runtime takes every chip with it).
         """
-        state: dict[str, bool] = {}
+        state: dict[str, bool] = {}  # cid -> currently advertised healthy
+        miss: dict[str, int] = {}  # cid -> consecutive missing polls
+        quiet: dict[str, int] = {}  # cid -> polls since last hard counter hit
+        counters: dict[str, dict[str, int]] = {}
         seen: dict[str, str] = {}  # chip id -> device path, sticky
         native_ok = True
         while not stop():
@@ -238,16 +288,87 @@ class TpuVmBackend:
             for chip in self.chips():
                 seen.setdefault(chip.id, chip.device_path)
             for cid, path in seen.items():
-                ok = os.path.exists(path)
-                if ok != state.get(cid, True):
-                    yield HealthEvent(
-                        chip_id=cid,
-                        health=ChipHealth.HEALTHY if ok else ChipHealth.UNHEALTHY,
-                        reason="device-file",
+                healthy = state.get(cid, True)
+                if os.path.exists(path):
+                    blip = miss.pop(cid, 0)
+                    if not healthy and quiet.get(cid) is None:
+                        # gone past grace, now back: recover immediately
+                        state[cid] = True
+                        yield HealthEvent(
+                            chip_id=cid, health=ChipHealth.HEALTHY,
+                            reason="device-file-restored",
+                        )
+                        continue
+                    if blip and healthy:
+                        # infrastructure blip inside the grace window:
+                        # informational, schedulability untouched
+                        yield HealthEvent(
+                            chip_id=cid, health=ChipHealth.HEALTHY,
+                            reason=f"device-file-blip({blip} polls)",
+                            severity="transient",
+                        )
+                else:
+                    miss[cid] = miss.get(cid, 0) + 1
+                    if healthy and miss[cid] > self._grace_polls:
+                        state[cid] = False
+                        quiet.pop(cid, None)  # cause: device, not counters
+                        yield HealthEvent(
+                            chip_id=cid, health=ChipHealth.UNHEALTHY,
+                            reason=f"device-file-gone({miss[cid]} polls)",
+                        )
+                    continue  # no counters to read while the file is gone
+
+                cur = self._read_counters(path)
+                last = counters.get(cid)
+                if cur:
+                    counters[cid] = cur
+                if not cur or last is None:
+                    # No counters (driver doesn't expose them, or they
+                    # vanished across a reset) or first observation: no
+                    # deltas to classify — but a counter-unhealthy chip
+                    # still makes quiet progress, else vanished counter
+                    # files would pin it Unhealthy forever.
+                    hard_delta = app_delta = 0
+                else:
+                    hard_delta = sum(
+                        cur.get(f, 0) - last.get(f, 0)
+                        for f in HARD_COUNTER_FILES
+                        if cur.get(f, 0) > last.get(f, 0)
                     )
-                state[cid] = ok
-            # stop-aware wait: 5 s poll period, 0.1 s stop latency
-            for _ in range(50):
+                    app_delta = sum(
+                        cur.get(f, 0) - last.get(f, 0)
+                        for f in APP_COUNTER_FILES
+                        if cur.get(f, 0) > last.get(f, 0)
+                    )
+                if app_delta:
+                    yield HealthEvent(
+                        chip_id=cid, health=ChipHealth.HEALTHY,
+                        reason=f"correctable-errors+{app_delta}",
+                        severity="app",
+                    )
+                if hard_delta:
+                    quiet[cid] = 0
+                    if state.get(cid, True):
+                        state[cid] = False
+                        yield HealthEvent(
+                            chip_id=cid, health=ChipHealth.UNHEALTHY,
+                            reason=f"uncorrectable-errors+{hard_delta}",
+                        )
+                elif quiet.get(cid) is not None:
+                    quiet[cid] += 1
+                    if quiet[cid] >= COUNTER_QUIET_POLLS:
+                        quiet.pop(cid)
+                        if not state.get(cid, True):
+                            state[cid] = True
+                            yield HealthEvent(
+                                chip_id=cid, health=ChipHealth.HEALTHY,
+                                reason=f"error-counter-quiet({COUNTER_QUIET_POLLS} polls)",
+                            )
+            # stop-aware wait (0.1 s stop latency)
+            waited = 0.0
+            while waited < self._poll_s:
                 if stop():
                     return
-                time.sleep(0.1)
+                step = min(0.1, self._poll_s - waited)
+                time.sleep(step)
+                waited += step

@@ -24,10 +24,15 @@ class HealthWatcher:
         self,
         backend: DiscoveryBackend,
         sinks: Iterable[Callable[[str | None, ChipHealth], None]],
+        on_event: Callable[[HealthEvent], None] | None = None,
     ):
-        """``sinks``: callables like ``plugin.set_chip_health`` invoked per event."""
+        """``sinks``: callables like ``plugin.set_chip_health`` invoked per
+        hard event. ``on_event`` (optional) receives EVERY event including
+        ``"app"``-severity ones — the hook the lifecycle uses to surface
+        transitions as Kubernetes node events (``kubectl describe node``)."""
         self._backend = backend
         self._sinks = list(sinks)
+        self._on_event = on_event
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._unhealthy_ids: set[str] = set()
@@ -39,9 +44,19 @@ class HealthWatcher:
 
     def _handle(self, event: HealthEvent) -> None:
         log.info(
-            "health: chip=%s -> %s (%s)",
+            "health: chip=%s -> %s (%s, %s)",
             event.chip_id or "ALL", event.health.value, event.reason,
+            event.severity,
         )
+        if self._on_event is not None:
+            try:
+                self._on_event(event)
+            except Exception as e:  # noqa: BLE001 — events are best-effort
+                log.warning("health on_event hook failed: %s", e)
+        if event.severity != "hard":
+            # "app" (reference skips XIDs 31/43/45, nvidia.go:133-137) and
+            # "transient" (self-healed blip): visible, never de-advertise.
+            return
         with self._lock:
             if event.chip_id is None:
                 if event.health == ChipHealth.UNHEALTHY:

@@ -245,7 +245,56 @@ class TpuShareManager:
                         local.set_chip_health(inv.index_of(cid), ok)
 
                 sinks.append(local_sink)
-            self._health = HealthWatcher(self._backend, sinks=sinks)
+            on_event = None
+            if self._api is not None and self._cfg.node_name:
+                api, node_name = self._api, self._cfg.node_name
+                # Rate limit per (chip, reason-class): a continuously
+                # ticking correctable-error counter must not write a fresh
+                # Event into etcd every 5 s poll. Hard transitions are rare
+                # (state-edge-triggered in the backend) and pass through.
+                last_emit: dict[tuple, float] = {}
+                min_interval_s = 300.0
+
+                def on_event(event):  # noqa: F811 — the cluster-mode hook
+                    import threading as _threading
+                    import time as _time
+
+                    from ..cluster.events import (
+                        REASON_CHIP_APP_FAULT,
+                        REASON_CHIP_RECOVERED,
+                        REASON_CHIP_TRANSIENT,
+                        REASON_CHIP_UNHEALTHY,
+                        emit_node_event,
+                    )
+                    from ..discovery.base import ChipHealth
+
+                    if event.severity == "app":
+                        reason, etype = REASON_CHIP_APP_FAULT, "Warning"
+                    elif event.severity == "transient":
+                        reason, etype = REASON_CHIP_TRANSIENT, "Normal"
+                    elif event.health == ChipHealth.UNHEALTHY:
+                        reason, etype = REASON_CHIP_UNHEALTHY, "Warning"
+                    else:
+                        reason, etype = REASON_CHIP_RECOVERED, "Normal"
+                    if event.severity != "hard":
+                        key = (event.chip_id, reason)
+                        now = _time.monotonic()
+                        if now - last_emit.get(key, -min_interval_s) < min_interval_s:
+                            return
+                        last_emit[key] = now
+                    # Fire-and-forget: an unreachable apiserver must not
+                    # stall hard-health propagation behind connect timeouts.
+                    _threading.Thread(
+                        target=emit_node_event,
+                        args=(api, node_name, reason,
+                              f"chip {event.chip_id or 'ALL'}: {event.reason}"),
+                        kwargs={"event_type": etype},
+                        daemon=True,
+                    ).start()
+
+            self._health = HealthWatcher(
+                self._backend, sinks=sinks, on_event=on_event
+            )
             self._health.start()
 
     def _stop_all(self) -> None:

@@ -10,7 +10,10 @@ from __future__ import annotations
 
 import copy
 import json
+import os
+import random
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
@@ -56,6 +59,15 @@ class FakeApiServer:
         self.patch_log: list[tuple[str, dict]] = []
         # fail the next N pod patches with a 409 conflict (retry testing)
         self.conflicts_to_inject = 0
+        # Chaos mode (the stress tier's stand-in for `go test -race`):
+        # randomized watch-delivery jitter and abrupt mid-stream connection
+        # drops, shaking out thread schedules the happy path never hits. A
+        # real apiserver may close a watch at any moment; chaos makes
+        # "any moment" happen constantly. Seeded for reproducibility.
+        self.chaos = os.environ.get("TPUSHARE_TEST_CHAOS") == "1"
+        self._chaos_rng = random.Random(
+            int(os.environ.get("TPUSHARE_TEST_CHAOS_SEED", "0") or 0)
+        )
         self._server: ThreadingHTTPServer | None = None
         self._lock = threading.Lock()
         # --- watch machinery: a monotonically increasing resourceVersion
@@ -214,6 +226,20 @@ class FakeApiServer:
                             emit = transition(etype, obj)
                             if emit is None:
                                 continue
+                            if store.chaos:
+                                with store._lock:
+                                    r = store._chaos_rng.random()
+                                    jitter = store._chaos_rng.random()
+                                if r < 0.05:
+                                    # Abrupt drop: the client must notice and
+                                    # re-watch. close_connection is required —
+                                    # a bare return on an HTTP/1.1 keep-alive
+                                    # socket leaves it open and the client
+                                    # blocks until its read timeout.
+                                    self.close_connection = True
+                                    return
+                                if r < 0.55:
+                                    time.sleep(jitter * 0.003)
                             line = (
                                 json.dumps({"type": emit[0], "object": emit[1]}) + "\n"
                             ).encode()

@@ -22,3 +22,32 @@ def test_entry_compiles_and_runs():
 
 def test_dryrun_multichip_8():
     graft.dryrun_multichip(8)
+
+
+def test_dryrun_multichip_16_flagship_topology():
+    """The v4-32 topology the flagship demo manifest promises
+    (demo/flagship/llama3-8b-v4-32.yaml: 16 chips, fsdp=16) must execute,
+    plus a mixed dp2/fsdp2/tp2/sp2 shape. The suite's own process is
+    pinned to 8 virtual devices (conftest), so this runs in a fresh
+    16-device subprocess."""
+    import os
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=16"
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-c",
+            "from __graft_entry__ import dryrun_multichip; dryrun_multichip(16)",
+        ],
+        cwd=str(Path(__file__).resolve().parent.parent),
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=900,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "fsdp=16" in proc.stdout
+    assert "dp=2 fsdp=2 tp=2 sp=2" in proc.stdout
