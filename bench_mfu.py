@@ -232,6 +232,41 @@ def bench_train(report: dict) -> None:
     print(f"train {report['train']}", file=sys.stderr)
 
 
+def bench_decode(report: dict) -> None:
+    """Cached single-token decode throughput (serving-side metric)."""
+    import jax
+    import jax.numpy as jnp
+
+    from gpushare_device_plugin_tpu.workloads import generate as G
+    from gpushare_device_plugin_tpu.workloads.transformer import (
+        TransformerConfig,
+        init_params,
+    )
+
+    cfg = TransformerConfig(
+        vocab=8192, d_model=2048, n_layers=8, n_heads=16, n_kv_heads=8,
+        d_ff=7168, max_seq=2048, rope_theta=500000.0,
+        compute_dtype=jnp.bfloat16, attention="flash",
+    )
+    params = jax.jit(lambda k: init_params(k, cfg))(jax.random.key(0))
+    results = []
+    for batch in (1, 8):
+        cache = G.init_cache(cfg, batch, 2048)
+        tok = jnp.zeros((batch,), jnp.int32)
+        # params as an argument, not a closure: closed-over arrays embed as
+        # compile-time constants (0.5B params would bloat the executable).
+        step = jax.jit(lambda p, t, c: G.decode_step(p, t, c, cfg))
+        logits, cache = step(params, tok, cache)  # compile + first write
+        t, times = _timeit(lambda: step(params, tok, cache)[0], iters=30, warmup=3)
+        results.append({
+            "batch": batch,
+            "step_ms": round(t * 1e3, 2),
+            "tokens_per_s": round(batch / t),
+        })
+        print(f"decode {results[-1]}", file=sys.stderr)
+    report["decode"] = results
+
+
 def main() -> int:
     import jax
 
@@ -252,6 +287,7 @@ def main() -> int:
     }
     bench_flash(report)
     bench_train(report)
+    bench_decode(report)
     print(json.dumps(report))
     return 0
 

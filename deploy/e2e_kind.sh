@@ -43,8 +43,9 @@ $KCTL label node "$NODE" tpushare=true --overwrite
 echo "=== deploy plugin (mock discovery)"
 $KCTL apply -f "$ROOT/deploy/device-plugin-rbac.yaml"
 # Same DaemonSet the docs ship, with mock discovery standing in for
-# /dev/accel* (kind nodes have no TPUs).
-sed 's/- --health-check/- --health-check\n            - --discovery=mock/' \
+# /dev/accel* (kind nodes have no TPUs). awk, not sed: BSD sed renders
+# a '\n' replacement as a literal 'n', silently mangling the flag list.
+awk '{print} /- --health-check/ {print "            - --discovery=mock"}' \
   "$ROOT/deploy/device-plugin-ds.yaml" | $KCTL apply -f -
 $KCTL -n kube-system rollout status ds/tpushare-device-plugin --timeout=180s
 

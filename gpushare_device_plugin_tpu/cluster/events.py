@@ -25,6 +25,44 @@ REASON_CHIP_APP_FAULT = "TpuChipAppLevelFault"
 REASON_CHIP_TRANSIENT = "TpuChipTransientBlip"
 
 
+def _post_event(
+    api,
+    namespace: str,
+    involved: dict,
+    reason: str,
+    message: str,
+    component: str,
+    host: str,
+    event_type: str,
+) -> None:
+    """Shared best-effort Event POST (one schema for pod + node events)."""
+    name = involved.get("name", "")
+    now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    event = {
+        "apiVersion": "v1",
+        "kind": "Event",
+        "metadata": {
+            "generateName": f"{name}.tpushare-" if name else "tpushare-",
+            "namespace": namespace,
+        },
+        "involvedObject": involved,
+        "reason": reason,
+        "message": message,
+        "type": event_type,
+        "source": {"component": component, "host": host},
+        "firstTimestamp": now,
+        "lastTimestamp": now,
+        "count": 1,
+    }
+    try:
+        api.create_event(namespace, event)
+    except Exception as e:  # noqa: BLE001 — events are best-effort
+        log.warning(
+            "event emission failed for %s %s: %s",
+            involved.get("kind", "?"), name, e,
+        )
+
+
 def emit_node_event(
     api,
     node_name: str,
@@ -37,32 +75,11 @@ def emit_node_event(
     """Warning/Normal event on the Node object so ``kubectl describe node``
     shows chip health transitions with their classified reason (the
     reference's XID events were glog-only)."""
-    now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
-    event = {
-        "apiVersion": "v1",
-        "kind": "Event",
-        "metadata": {
-            "generateName": f"{node_name}.tpushare-",
-            "namespace": "default",
-        },
-        "involvedObject": {
-            "apiVersion": "v1",
-            "kind": "Node",
-            "name": node_name,
-            "uid": node_name,
-        },
-        "reason": reason,
-        "message": message,
-        "type": event_type,
-        "source": {"component": component, "host": node_name},
-        "firstTimestamp": now,
-        "lastTimestamp": now,
-        "count": 1,
-    }
-    try:
-        api.create_event("default", event)
-    except Exception as e:  # noqa: BLE001 — events are best-effort
-        log.warning("node event emission failed for %s: %s", node_name, e)
+    _post_event(
+        api, "default",
+        {"apiVersion": "v1", "kind": "Node", "name": node_name, "uid": node_name},
+        reason, message, component, node_name, event_type,
+    )
 
 
 def emit_pod_event(
@@ -77,31 +94,14 @@ def emit_pod_event(
 ) -> None:
     meta = pod.get("metadata", {}) if pod else {}
     ns = meta.get("namespace", "default")
-    name = meta.get("name", "")
-    now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
-    event = {
-        "apiVersion": "v1",
-        "kind": "Event",
-        "metadata": {
-            "generateName": f"{name}.tpushare-" if name else "tpushare-",
-            "namespace": ns,
-        },
-        "involvedObject": {
+    _post_event(
+        api, ns,
+        {
             "apiVersion": "v1",
             "kind": "Pod",
             "namespace": ns,
-            "name": name,
+            "name": meta.get("name", ""),
             "uid": meta.get("uid", ""),
         },
-        "reason": reason,
-        "message": message,
-        "type": event_type,
-        "source": {"component": component, "host": host},
-        "firstTimestamp": now,
-        "lastTimestamp": now,
-        "count": 1,
-    }
-    try:
-        api.create_event(ns, event)
-    except Exception as e:  # noqa: BLE001 — events are best-effort
-        log.warning("event emission failed for %s/%s: %s", ns, name, e)
+        reason, message, component, host, event_type,
+    )

@@ -255,18 +255,17 @@ class TpuShareManager:
                 last_emit: dict[tuple, float] = {}
                 min_interval_s = 300.0
 
-                def on_event(event):  # noqa: F811 — the cluster-mode hook
-                    import threading as _threading
-                    import time as _time
+                from ..cluster.events import (
+                    REASON_CHIP_APP_FAULT,
+                    REASON_CHIP_RECOVERED,
+                    REASON_CHIP_TRANSIENT,
+                    REASON_CHIP_UNHEALTHY,
+                    emit_node_event,
+                )
+                from ..discovery.base import ChipHealth
 
-                    from ..cluster.events import (
-                        REASON_CHIP_APP_FAULT,
-                        REASON_CHIP_RECOVERED,
-                        REASON_CHIP_TRANSIENT,
-                        REASON_CHIP_UNHEALTHY,
-                        emit_node_event,
-                    )
-                    from ..discovery.base import ChipHealth
+                def on_event(event):  # noqa: F811 — the cluster-mode hook
+                    import time as _time
 
                     if event.severity == "app":
                         reason, etype = REASON_CHIP_APP_FAULT, "Warning"
@@ -284,7 +283,7 @@ class TpuShareManager:
                         last_emit[key] = now
                     # Fire-and-forget: an unreachable apiserver must not
                     # stall hard-health propagation behind connect timeouts.
-                    _threading.Thread(
+                    threading.Thread(
                         target=emit_node_event,
                         args=(api, node_name, reason,
                               f"chip {event.chip_id or 'ALL'}: {event.reason}"),

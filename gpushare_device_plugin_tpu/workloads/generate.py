@@ -1,0 +1,204 @@
+"""Autoregressive generation with a KV cache for the decoder flagship.
+
+The training half (``transformer.py``) covers the reference-parity story;
+this is the inference half a framework user expects: prefill + cached
+decode, compiled end to end.
+
+TPU-first design:
+
+- **Static shapes everywhere.** The cache is a fixed ``[L, B, Smax, Hkv,
+  Dh]`` buffer; the decode loop is a ``lax.scan`` of static trip count
+  (``max_new``), so XLA compiles ONE program — no per-token retracing, no
+  dynamic shapes blocking MXU tiling. Early stop on EOS is a post-hoc mask
+  (XLA-friendly), not a data-dependent loop break.
+- **Prefill is the training forward** (flash attention when on TPU) plus
+  cache writes; decode attention is a single-query masked attention over
+  the cache — a [B,H,1,S] einsum the MXU handles without a custom kernel.
+- **GQA-native end to end**: the cache stores ``Hkv`` heads (1/g the HBM
+  of full-head caching, the whole point of GQA at serving time); the query
+  group dimension rides inside the einsums.
+
+Single-host scope: generation targets one chip (or auto-SPMD under jit on
+a mesh via sharded params); the sp-ring path is a training concern.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from .attention import flash_or_plain
+from .transformer import TransformerConfig, _mlp_block, _project_qkv, _rms_norm
+
+KVCache = dict[str, jax.Array]  # {"k","v"}: [L, B, Smax, Hkv, Dh]; "len": []
+
+
+def init_cache(cfg: TransformerConfig, batch: int, max_len: int) -> KVCache:
+    shape = (cfg.n_layers, batch, max_len, cfg.kv_heads, cfg.head_dim)
+    return {
+        "k": jnp.zeros(shape, cfg.compute_dtype),
+        "v": jnp.zeros(shape, cfg.compute_dtype),
+        "len": jnp.zeros((), jnp.int32),
+    }
+
+
+def _decode_attention(q, k_cache, v_cache, cur_len):
+    """Single-position attention over the cache.
+
+    q: [B, 1, H, Dh]; k_cache/v_cache: [B, Smax, Hkv, Dh]; positions
+    ``>= cur_len`` (the unwritten tail) are masked out. f32 softmax like
+    every other attention path in the repo.
+    """
+    B, _, H, Dh = q.shape
+    Smax = k_cache.shape[1]
+    Hkv = k_cache.shape[2]
+    g = H // Hkv
+    qg = q[:, 0].reshape(B, Hkv, g, Dh)
+    s = jnp.einsum("bkgd,bskd->bkgs", qg, k_cache).astype(jnp.float32)
+    s = s / jnp.sqrt(jnp.float32(Dh))
+    mask = jnp.arange(Smax) < cur_len  # [Smax]
+    s = jnp.where(mask[None, None, None, :], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bkgs,bskd->bkgd", p.astype(v_cache.dtype), v_cache)
+    return out.reshape(B, 1, H, Dh)
+
+
+def prefill(
+    params: Any, tokens: jax.Array, cache: KVCache, cfg: TransformerConfig
+) -> tuple[jax.Array, KVCache]:
+    """Run the prompt through the model, filling the cache.
+
+    tokens: [B, Tp] -> (last-position logits [B, vocab], cache with
+    ``len=Tp``). Prompt self-attention is the training attention path
+    (flash on TPU); the cache is written, not read — prefill always starts
+    a fresh sequence.
+    """
+    dt = cfg.compute_dtype
+    B, Tp = tokens.shape
+    positions = jnp.arange(Tp)
+    x = params["embed"].astype(dt)[tokens]
+
+    def layer(x, xs):
+        lp, _ = xs
+        h = _rms_norm(x, lp["ln1"])
+        q, k, v = _project_qkv(h, lp, cfg, positions)
+        attn = flash_or_plain(
+            q, k, v, attention=cfg.attention, causal=True, mesh=None
+        )
+        x = x + jnp.einsum("bthn,hnd->btd", attn, lp["wo"].astype(dt))
+        return _mlp_block(x, lp, cfg), (k, v)
+
+    x, (ks, vs) = jax.lax.scan(
+        layer, x, (params["layers"], jnp.arange(cfg.n_layers))
+    )
+    # ks/vs: [L, B, Tp, Hkv, Dh] -> cache[:, :, :Tp]
+    cache = {
+        "k": jax.lax.dynamic_update_slice(
+            cache["k"], ks.astype(cache["k"].dtype), (0, 0, 0, 0, 0)
+        ),
+        "v": jax.lax.dynamic_update_slice(
+            cache["v"], vs.astype(cache["v"].dtype), (0, 0, 0, 0, 0)
+        ),
+        "len": jnp.int32(Tp),
+    }
+    x = _rms_norm(x[:, -1:], params["final_norm"])
+    logits = jnp.einsum("btd,dv->btv", x, params["out"].astype(dt))
+    return logits[:, 0].astype(jnp.float32), cache
+
+
+def decode_step(
+    params: Any, token: jax.Array, cache: KVCache, cfg: TransformerConfig
+) -> tuple[jax.Array, KVCache]:
+    """One cached decode step. token: [B] -> (logits [B, vocab], cache+1)."""
+    dt = cfg.compute_dtype
+    pos = cache["len"]
+    positions = pos[None]  # [1]
+    x = params["embed"].astype(dt)[token][:, None]  # [B, 1, d]
+
+    def layer(x, xs):
+        lp, k_cache, v_cache = xs
+        h = _rms_norm(x, lp["ln1"])
+        q, k, v = _project_qkv(h, lp, cfg, positions)
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k.astype(k_cache.dtype), (0, pos, 0, 0)
+        )
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v.astype(v_cache.dtype), (0, pos, 0, 0)
+        )
+        attn = _decode_attention(q, k_cache, v_cache, pos + 1)
+        x = x + jnp.einsum("bthn,hnd->btd", attn, lp["wo"].astype(dt))
+        return _mlp_block(x, lp, cfg), (k_cache, v_cache)
+
+    x, (ks, vs) = jax.lax.scan(layer, x, (params["layers"], cache["k"], cache["v"]))
+    cache = {"k": ks, "v": vs, "len": pos + 1}
+    x = _rms_norm(x, params["final_norm"])
+    logits = jnp.einsum("btd,dv->btv", x, params["out"].astype(dt))
+    return logits[:, 0].astype(jnp.float32), cache
+
+
+def generate(
+    params: Any,
+    prompt: jax.Array,
+    cfg: TransformerConfig,
+    *,
+    max_new: int,
+    temperature: float = 0.0,
+    rng: jax.Array | None = None,
+    eos_id: int | None = None,
+) -> jax.Array:
+    """Generate ``max_new`` tokens after ``prompt`` ([B, Tp] int32).
+
+    Returns [B, Tp + max_new]. ``temperature=0`` is greedy argmax;
+    otherwise softmax sampling at the given temperature (``rng``
+    required). With ``eos_id``, positions after the first EOS are
+    overwritten with EOS (post-hoc mask — the compiled loop always runs
+    ``max_new`` steps; see module docstring).
+
+    Wrap in ``jax.jit`` with ``static_argnames=()`` via
+    :func:`make_generate` for repeated use.
+    """
+    if temperature > 0.0 and rng is None:
+        raise ValueError("temperature sampling needs rng")
+    B, Tp = prompt.shape
+    cache = init_cache(cfg, B, Tp + max_new)
+    logits, cache = prefill(params, prompt, cache, cfg)
+    rng = rng if rng is not None else jax.random.key(0)
+
+    def pick(logits, key):
+        if temperature > 0.0:
+            return jax.random.categorical(key, logits / temperature, axis=-1)
+        return jnp.argmax(logits, axis=-1)
+
+    rng, k0 = jax.random.split(rng)
+    first = pick(logits, k0).astype(jnp.int32)  # [B]
+
+    def step(carry, _):
+        token, cache, key = carry
+        key, sub = jax.random.split(key)
+        logits, cache = decode_step(params, token, cache, cfg)
+        nxt = pick(logits, sub).astype(jnp.int32)
+        return (nxt, cache, key), token
+
+    (_last, cache, _), toks = jax.lax.scan(
+        step, (first, cache, rng), None, length=max_new
+    )
+    out = jnp.concatenate([prompt, toks.T], axis=1)  # [B, Tp + max_new]
+    if eos_id is not None:
+        gen = out[:, Tp:]
+        seen = jnp.cumsum((gen == eos_id).astype(jnp.int32), axis=1)
+        # positions strictly after the first EOS become EOS
+        gen = jnp.where(seen - (gen == eos_id) > 0, eos_id, gen)
+        out = jnp.concatenate([out[:, :Tp], gen], axis=1)
+    return out
+
+
+def make_generate(cfg: TransformerConfig, *, max_new: int, temperature: float = 0.0):
+    """Jitted (params, prompt, rng) -> tokens closure (one compile per
+    prompt shape)."""
+    fn = functools.partial(
+        generate, cfg=cfg, max_new=max_new, temperature=temperature
+    )
+    return jax.jit(lambda params, prompt, rng: fn(params, prompt, rng=rng))

@@ -163,15 +163,39 @@ def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
 
 
+def _project_qkv(h, lp, cfg: TransformerConfig, positions):
+    """ln1-normalized hidden -> RoPE'd (q [B,T,H,Dh], k, v [B,T,Hkv,Dh]).
+
+    Shared by the training forward and the cached decode path
+    (``generate.py``) so the layer math exists exactly once — cached
+    decode's contract is token-exactness with this forward.
+    """
+    dt = cfg.compute_dtype
+    q = jnp.einsum("btd,dhn->bthn", h, lp["wq"].astype(dt))
+    kv = jnp.einsum("btd,dchn->btchn", h, lp["wkv"].astype(dt))
+    k, v = kv[:, :, 0], kv[:, :, 1]
+    return (
+        _rope(q, positions, cfg.rope_theta),
+        _rope(k, positions, cfg.rope_theta),
+        v,
+    )
+
+
+def _mlp_block(x, lp, cfg: TransformerConfig):
+    """Residual SwiGLU MLP (ln2 -> gate/up -> silu -> down). Shared with
+    ``generate.py`` (same single-source rationale as ``_project_qkv``)."""
+    dt = cfg.compute_dtype
+    h = _rms_norm(x, lp["ln2"])
+    gate_up = jnp.einsum("btd,dcf->btcf", h, lp["wi"].astype(dt))
+    ff = jax.nn.silu(gate_up[:, :, 0]) * gate_up[:, :, 1]
+    return x + jnp.einsum("btf,fd->btd", ff, lp["wdown"].astype(dt))
+
+
 def _layer(x, lp, cfg: TransformerConfig, positions, mesh: Mesh | None):
     """One decoder block. x: [B, T, d] global arrays (auto-SPMD)."""
     dt = cfg.compute_dtype
     h = _rms_norm(x, lp["ln1"])
-    q = jnp.einsum("btd,dhn->bthn", h, lp["wq"].astype(dt))  # [B,T,H,Dh]
-    kv = jnp.einsum("btd,dchn->btchn", h, lp["wkv"].astype(dt))
-    k, v = kv[:, :, 0], kv[:, :, 1]  # [B,T,Hkv,Dh]
-    q = _rope(q, positions, cfg.rope_theta)
-    k = _rope(k, positions, cfg.rope_theta)
+    q, k, v = _project_qkv(h, lp, cfg, positions)
     if cfg.seq_parallel:
         if mesh is None:
             raise ValueError("seq_parallel=True requires a mesh")
@@ -194,11 +218,7 @@ def _layer(x, lp, cfg: TransformerConfig, positions, mesh: Mesh | None):
             q, k, v, attention=cfg.attention, causal=True, mesh=mesh
         )
     x = x + jnp.einsum("bthn,hnd->btd", attn, lp["wo"].astype(dt))
-    h = _rms_norm(x, lp["ln2"])
-    gate_up = jnp.einsum("btd,dcf->btcf", h, lp["wi"].astype(dt))
-    ff = jax.nn.silu(gate_up[:, :, 0]) * gate_up[:, :, 1]
-    x = x + jnp.einsum("btf,fd->btd", ff, lp["wdown"].astype(dt))
-    return x
+    return _mlp_block(x, lp, cfg)
 
 
 def forward(
