@@ -24,21 +24,21 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 
 def _online_update(o, m, l, s, v):
-    """One online-softmax accumulation step.
+    """One online-softmax accumulation step (GQA-grouped shapes).
 
-    o: [B,H,Tq,D] weighted-value accumulator, m: [B,H,Tq] running max,
-    l: [B,H,Tq] running denominator, s: [B,H,Tq,Tk] scores (may be -inf),
-    v: [B,Tk,H,D].
+    o: [B,Hkv,g,Tq,D] weighted-value accumulator, m: [B,Hkv,g,Tq] running
+    max, l: [B,Hkv,g,Tq] running denominator, s: [B,Hkv,g,Tq,Tk] scores
+    (may be -inf), v: [B,Tk,Hkv,D]. MHA is the g=1 case.
     """
     s_max = jnp.max(s, axis=-1)
     m_new = jnp.maximum(m, s_max)
     # Rows fully masked so far have m_new == -inf; substitute 0 so the exps
     # below produce exact zeros instead of NaN ((-inf) - (-inf)).
     m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
-    p = jnp.exp(s - m_safe[..., None])  # [B,H,Tq,Tk]
+    p = jnp.exp(s - m_safe[..., None])  # [B,Hkv,g,Tq,Tk]
     alpha = jnp.exp(m - m_safe)  # m_safe is finite, so m=-inf -> alpha=0
     l_new = alpha * l + jnp.sum(p, axis=-1)
-    o_new = alpha[..., None] * o + jnp.einsum("bhqk,bkhd->bhqd", p, v)
+    o_new = alpha[..., None] * o + jnp.einsum("bhgqk,bkhd->bhgqd", p, v)
     return o_new, m_new, l_new
 
 
@@ -53,35 +53,44 @@ def ring_attention_block(
 ) -> jax.Array:
     """Per-shard ring attention body — call *inside* ``shard_map``.
 
-    q: [B, Tq, H, D] local query block; k, v: [B, Tk, H, D] local K/V block.
-    Returns [B, Tq, H, D]. Global sequence order is block-major: device i of
-    the ``axis_name`` ring holds positions [i*T, (i+1)*T).
+    q: [B, Tq, H, D] local query block; k, v: [B, Tk, Hkv, D] local K/V
+    block. **GQA-native**: ``Hkv`` may divide ``H`` (KV head i serves
+    query heads [i*g, (i+1)*g)) — the ring then circulates only the
+    grouped K/V, 1/g the ICI bytes per hop of a full-head ring.
+    Returns [B, Tq, H, D]. Global sequence order is block-major: device i
+    of the ``axis_name`` ring holds positions [i*T, (i+1)*T).
     """
     B, Tq, H, D = q.shape
-    Tk = k.shape[1]
+    Tk, Hkv = k.shape[1], k.shape[2]
+    if H % Hkv:
+        raise ValueError(f"q heads {H} not a multiple of kv heads {Hkv}")
+    g = H // Hkv
     n = jax.lax.psum(1, axis_name)
     idx = jax.lax.axis_index(axis_name)
     sc = scale if scale is not None else 1.0 / math.sqrt(D)
     q_pos = idx * Tq + jnp.arange(Tq)  # global query positions
+    qg = q.reshape(B, Tq, Hkv, g, D)
 
     # send-to-next permutation: after step i each device holds block (idx-i)%n
     perm = [(j, (j + 1) % n) for j in range(n)]
 
     # Derive the zero accumulators from q so they inherit q's shard-varying
     # axes (shard_map's VMA check requires loop-carry types to be stable).
-    zero = jnp.transpose(q, (0, 2, 1, 3)).astype(jnp.float32) * 0.0  # [B,H,Tq,D]
+    zero = (
+        jnp.transpose(qg, (0, 2, 3, 1, 4)).astype(jnp.float32) * 0.0
+    )  # [B,Hkv,g,Tq,D]
     o = zero
-    m = zero[..., 0] - jnp.inf  # [B,H,Tq] all -inf
+    m = zero[..., 0] - jnp.inf  # [B,Hkv,g,Tq] all -inf
     l = zero[..., 0]
 
     def body(i, carry):
         o, m, l, k, v = carry
         src = (idx - i) % n  # which global block this k/v is
-        s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * sc
+        s = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k).astype(jnp.float32) * sc
         if causal:
             k_pos = src * Tk + jnp.arange(Tk)
             mask = q_pos[:, None] >= k_pos[None, :]  # [Tq, Tk]
-            s = jnp.where(mask[None, None], s, -jnp.inf)
+            s = jnp.where(mask[None, None, None], s, -jnp.inf)
         o, m, l = _online_update(o, m, l, s, v)
         k = jax.lax.ppermute(k, axis_name, perm)
         v = jax.lax.ppermute(v, axis_name, perm)
@@ -89,8 +98,8 @@ def ring_attention_block(
 
     o, m, l, _, _ = jax.lax.fori_loop(0, n, body, (o, m, l, k, v))
     l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows (shouldn't occur causally)
-    out = (o / l[..., None]).astype(q.dtype)  # [B,H,Tq,D]
-    return jnp.transpose(out, (0, 2, 1, 3))  # [B,Tq,H,D]
+    out = (o / l[..., None]).astype(q.dtype)  # [B,Hkv,g,Tq,D]
+    return jnp.transpose(out, (0, 3, 1, 2, 4)).reshape(B, Tq, H, D)
 
 
 def ring_attention(

@@ -80,6 +80,23 @@ def use_flash(
     return True
 
 
+def ulysses_inner_attn(attention: str):
+    """Per-shard attention for the Ulysses a2a layout: full sequence,
+    1/n of the (possibly grouped) heads — the flash kernel's home turf.
+    Signature matches ``parallel.ulysses``'s ``attn_fn`` contract."""
+
+    def fn(q, k, v, *, causal, scale):
+        if scale is not None:
+            raise ValueError(
+                "ulysses_inner_attn uses the 1/sqrt(Dh) default scale"
+            )
+        if use_flash(attention, q, None, kv_heads=k.shape[2]):
+            return flash_attention(q, k, v, causal=causal)
+        return grouped_full_attention(q, k, v, causal=causal)
+
+    return fn
+
+
 def flash_or_plain(
     q: jax.Array,
     k: jax.Array,

@@ -34,7 +34,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..parallel.mesh import batch_sharding, commit_to_mesh, prune_unshardable
 from ..parallel.ring import ring_attention
-from .attention import flash_or_plain
+from ..parallel.ulysses import ulysses_attention
+from .attention import flash_or_plain, ulysses_inner_attn
 
 Params = dict[str, Any]
 
@@ -54,6 +55,11 @@ class TransformerConfig:
     compute_dtype: Any = jnp.bfloat16
     remat: bool = True
     seq_parallel: bool = False
+    # Context-parallel scheme when seq_parallel: "ring" (K/V ppermute ring,
+    # online softmax, overlappable hops) or "ulysses" (two all_to_all swaps
+    # to a full-sequence/1-in-n-heads layout, so the flash kernel runs
+    # per shard). Both exact; see parallel/ulysses.py for the trade.
+    context_parallel: str = "ring"
     # "auto": the Pallas flash kernel (ops/flash_attention.py) on TPU, plain
     # attention elsewhere (the kernel's CPU fallback is the Pallas
     # interpreter — correct but far too slow for training loops).
@@ -199,20 +205,29 @@ def _layer(x, lp, cfg: TransformerConfig, positions, mesh: Mesh | None):
     if cfg.seq_parallel:
         if mesh is None:
             raise ValueError("seq_parallel=True requires a mesh")
-        groups = cfg.n_heads // cfg.kv_heads
-        if groups > 1:
-            # The ring circulates K/V blocks with the full head count; a
-            # grouped-native ring (circulating Hkv heads, 1/groups the ICI
-            # bytes) is future work. The plain/flash dispatch below keeps
-            # K/V grouped.
+        tp = mesh.shape.get("tp", 1)
+        if cfg.kv_heads % tp:
+            # Both schemes shard KV heads over tp; when Hkv doesn't
+            # divide tp, fall back to full heads (correct, g-times the
+            # collective bytes).
+            groups = cfg.n_heads // cfg.kv_heads
             k = jnp.repeat(k, groups, axis=2)
             v = jnp.repeat(v, groups, axis=2)
-        # Only attention needs manual collectives (the K/V ring over sp);
-        # everything around it stays auto-sharded SPMD.
-        attn = ring_attention(
-            q, k, v, mesh, axis_name="sp", causal=True,
-            batch_axes=("dp", "fsdp"), head_axes="tp",
-        )
+        # Only attention needs manual collectives; everything around it
+        # stays auto-sharded SPMD. Ring circulates the grouped K/V (1/g
+        # the ICI bytes per hop); Ulysses swaps to a full-sequence layout
+        # so the flash kernel runs per shard (parallel/ulysses.py).
+        if cfg.context_parallel == "ulysses":
+            attn = ulysses_attention(
+                q, k, v, mesh, axis_name="sp", causal=True,
+                batch_axes=("dp", "fsdp"), head_axes="tp",
+                attn_fn=ulysses_inner_attn(cfg.attention),
+            )
+        else:
+            attn = ring_attention(
+                q, k, v, mesh, axis_name="sp", causal=True,
+                batch_axes=("dp", "fsdp"), head_axes="tp",
+            )
     else:
         attn = flash_or_plain(
             q, k, v, attention=cfg.attention, causal=True, mesh=mesh

@@ -1,0 +1,146 @@
+"""Ulysses all-to-all sequence parallelism vs the exact-attention oracle.
+
+Ring and Ulysses are drop-in interchangeable context-parallel schemes
+(same sharding contract); both must be exact, so every test here compares
+against the single-device attention and, end-to-end, against the dense
+transformer loss.
+"""
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from gpushare_device_plugin_tpu.parallel.ring import full_attention
+from gpushare_device_plugin_tpu.parallel.ulysses import ulysses_attention
+from gpushare_device_plugin_tpu.workloads.attention import grouped_full_attention
+
+
+def sp_mesh():
+    return Mesh(np.array(jax.devices()).reshape(8), ("sp",))
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ulysses_matches_full(causal):
+    mesh = sp_mesh()
+    B, S, H, D = 2, 32, 8, 8
+    kq, kk, kv = jax.random.split(jax.random.key(0), 3)
+    q = jax.random.normal(kq, (B, S, H, D), dtype=jnp.float32)
+    k = jax.random.normal(kk, (B, S, H, D), dtype=jnp.float32)
+    v = jax.random.normal(kv, (B, S, H, D), dtype=jnp.float32)
+    expected = full_attention(q, k, v, causal=causal)
+    got = ulysses_attention(q, k, v, mesh, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=2e-5)
+
+
+def test_ulysses_gqa_grouped():
+    """Hkv % sp == 0: grouped K/V scatter natively (1/g the a2a bytes)."""
+    mesh = sp_mesh()
+    B, S, H, Hkv, D = 2, 32, 16, 8, 8
+    kq, kk, kv = jax.random.split(jax.random.key(1), 3)
+    q = jax.random.normal(kq, (B, S, H, D), dtype=jnp.float32)
+    k = jax.random.normal(kk, (B, S, Hkv, D), dtype=jnp.float32)
+    v = jax.random.normal(kv, (B, S, Hkv, D), dtype=jnp.float32)
+    expected = grouped_full_attention(q, k, v, causal=True)
+    got = ulysses_attention(q, k, v, mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=2e-5)
+
+
+def test_ulysses_gqa_few_kv_heads_falls_back():
+    """Hkv < sp: repeats K/V to full heads inside the block (still exact)."""
+    mesh = sp_mesh()
+    B, S, H, Hkv, D = 2, 16, 8, 2, 8
+    kq, kk, kv = jax.random.split(jax.random.key(2), 3)
+    q = jax.random.normal(kq, (B, S, H, D), dtype=jnp.float32)
+    k = jax.random.normal(kk, (B, S, Hkv, D), dtype=jnp.float32)
+    v = jax.random.normal(kv, (B, S, Hkv, D), dtype=jnp.float32)
+    expected = grouped_full_attention(q, k, v, causal=True)
+    got = ulysses_attention(q, k, v, mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=2e-5)
+
+
+def test_ulysses_with_tp():
+    """Composes with tensor parallelism: tp shards heads first, the a2a
+    scatters each tp shard's heads over sp."""
+    mesh = Mesh(np.array(jax.devices()).reshape(2, 2, 2), ("dp", "tp", "sp"))
+    B, S, H, D = 2, 16, 8, 8
+    kq, kk, kv = jax.random.split(jax.random.key(3), 3)
+    q = jax.random.normal(kq, (B, S, H, D))
+    k = jax.random.normal(kk, (B, S, H, D))
+    v = jax.random.normal(kv, (B, S, H, D))
+    expected = full_attention(q, k, v, causal=True)
+    got = ulysses_attention(
+        q, k, v, mesh, causal=True, batch_axes=("dp",), head_axes="tp"
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=2e-5)
+
+
+def test_ulysses_with_flash_kernel_inner():
+    """The module's reason to exist: the Pallas kernel runs per shard on
+    the full-sequence layout between the two all_to_all swaps. Forced
+    through the interpreter here (no TPU), which still builds the real
+    pallas_call inside the shard_map — this is the path that trips the
+    VMA check if the wrapper doesn't disable it."""
+    from gpushare_device_plugin_tpu.ops import flash_attention
+
+    mesh = sp_mesh()
+    B, S, H, D = 1, 64, 8, 8
+    kq, kk, kv = jax.random.split(jax.random.key(5), 3)
+    q = jax.random.normal(kq, (B, S, H, D), dtype=jnp.float32)
+    k = jax.random.normal(kk, (B, S, H, D), dtype=jnp.float32)
+    v = jax.random.normal(kv, (B, S, H, D), dtype=jnp.float32)
+
+    def flash_inner(q, k, v, *, causal, scale):
+        return flash_attention(q, k, v, causal=causal, scale=scale, interpret=True)
+
+    expected = full_attention(q, k, v, causal=True)
+    got = ulysses_attention(q, k, v, mesh, causal=True, attn_fn=flash_inner)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=2e-5)
+
+
+def test_ulysses_bad_head_ratio_raises():
+    mesh = sp_mesh()
+    q = jnp.zeros((1, 16, 8, 4))
+    kv = jnp.zeros((1, 16, 3, 4))  # 8 % 3 != 0
+    with pytest.raises(ValueError, match="not a multiple"):
+        ulysses_attention(q, kv, kv, mesh)
+
+
+def test_ulysses_grad():
+    mesh = sp_mesh()
+    B, S, H, D = 1, 16, 8, 4
+    q = jax.random.normal(jax.random.key(4), (B, S, H, D))
+
+    def loss(q):
+        return jnp.sum(ulysses_attention(q, q, q, mesh) ** 2)
+
+    g = jax.jit(jax.grad(loss))(q)
+    assert g.shape == q.shape and bool(jnp.isfinite(g).all())
+
+
+def test_transformer_ulysses_loss_matches_dense():
+    """End to end: the Ulysses-parallel transformer loss equals the dense
+    (no-mesh) loss — same bar the ring path is held to."""
+    from gpushare_device_plugin_tpu.workloads.transformer import (
+        TransformerConfig,
+        demo_batch,
+        init_params,
+        loss_fn,
+    )
+
+    mesh = Mesh(np.array(jax.devices()).reshape(1, 1, 1, 8), ("dp", "fsdp", "tp", "sp"))
+    base = dict(
+        vocab=64, d_model=32, n_layers=2, n_heads=8, n_kv_heads=8, d_ff=64,
+        max_seq=64, compute_dtype=jnp.float32, remat=False,
+    )
+    cfg_u = TransformerConfig(**base, seq_parallel=True, context_parallel="ulysses")
+    cfg_d = TransformerConfig(**base)
+    params = init_params(jax.random.key(0), cfg_u)
+    tokens = demo_batch(jax.random.key(1), 2, 32, cfg_u.vocab)
+    dense = loss_fn(params, tokens, cfg_d)
+    ulysses = loss_fn(params, tokens, cfg_u, mesh)
+    np.testing.assert_allclose(float(ulysses), float(dense), atol=1e-5)
