@@ -67,6 +67,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--plugin-dir", default=const.DEVICE_PLUGIN_PATH)
     p.add_argument("--node-name", default=os.environ.get("NODE_NAME", ""))
     p.add_argument("--coredump-dir", default="/etc/kubernetes")
+    p.add_argument("--metrics-port", type=int, default=0,
+                   help="serve Prometheus /metrics on this port (0 = off; "
+                   "the reference had no metrics at all)")
     p.add_argument("-v", "--verbosity", type=int, default=0)
     return p
 
@@ -126,6 +129,13 @@ def main(argv=None) -> int:
         else:
             pod_source = apisrc
 
+    metrics_server = None
+    if args.metrics_port:
+        from ..utils.metrics import MetricsServer
+
+        metrics_server = MetricsServer(port=args.metrics_port).start()
+        log.info("metrics on :%d/metrics", metrics_server.port)
+
     try:
         manager = TpuShareManager(
             backend, cfg, api_client=api_client, pod_source=pod_source
@@ -137,6 +147,8 @@ def main(argv=None) -> int:
         )
         manager.run()
     finally:
+        if metrics_server is not None:
+            metrics_server.stop()
         # The informer owns a watch thread + open HTTP stream; shut it down
         # with the manager instead of abandoning it to process teardown.
         stop = getattr(pod_source, "stop", None)

@@ -309,22 +309,42 @@ class ExtenderHTTPServer:
                 return self._send(404, {"error": "not found"})
 
             def do_POST(self):
+                from ..utils.metrics import REGISTRY
+
                 n = int(self.headers.get("Content-Length", "0"))
                 try:
                     body = json.loads(self.rfile.read(n) or b"{}")
                 except json.JSONDecodeError:
                     return self._send(400, {"error": "bad json"})
+                verbs = {
+                    "/scheduler/filter": core.filter,
+                    "/scheduler/prioritize": core.prioritize,
+                    "/scheduler/bind": core.bind,
+                }
+                fn = verbs.get(self.path)
+                if fn is None:
+                    return self._send(404, {"error": f"unknown path {self.path}"})
+                verb = self.path.rsplit("/", 1)[-1]
+                t0 = time.perf_counter()
                 try:
-                    if self.path == "/scheduler/filter":
-                        return self._send(200, core.filter(body))
-                    if self.path == "/scheduler/prioritize":
-                        return self._send(200, core.prioritize(body))
-                    if self.path == "/scheduler/bind":
-                        return self._send(200, core.bind(body))
+                    result = fn(body)
                 except Exception as e:  # keep the webhook alive
                     log.error("extender verb %s failed: %s", self.path, e)
+                    REGISTRY.counter_inc(
+                        "tpushare_extender_verb_total",
+                        "Webhook verbs by outcome", verb=verb, outcome="error",
+                    )
                     return self._send(200, {"error": str(e)})
-                return self._send(404, {"error": f"unknown path {self.path}"})
+                REGISTRY.observe(
+                    "tpushare_extender_verb_seconds",
+                    time.perf_counter() - t0,
+                    "Webhook verb latency", verb=verb,
+                )
+                REGISTRY.counter_inc(
+                    "tpushare_extender_verb_total",
+                    "Webhook verbs by outcome", verb=verb, outcome="ok",
+                )
+                return self._send(200, result)
 
         self._server = ThreadingHTTPServer((self._host, self._port), Handler)
         t = threading.Thread(target=self._server.serve_forever, daemon=True)
@@ -347,9 +367,17 @@ def main(argv=None) -> int:
                    help="watch-backed cluster pod cache (default) or a full "
                    "LIST per webhook call")
     p.add_argument("--timeout", type=float, default=10.0)
+    p.add_argument("--metrics-port", type=int, default=0,
+                   help="serve Prometheus /metrics on this port (0 = off)")
     p.add_argument("-v", "--verbosity", type=int, default=0)
     args = p.parse_args(argv)
     logutil.setup(args.verbosity)
+    metrics_server = None
+    if args.metrics_port:
+        from ..utils.metrics import MetricsServer
+
+        metrics_server = MetricsServer(port=args.metrics_port).start()
+        log.info("metrics on :%d/metrics", metrics_server.port)
     try:
         api = ApiServerClient.from_env(timeout_s=args.timeout)
     except Exception as e:
@@ -370,6 +398,8 @@ def main(argv=None) -> int:
         server.stop()
         if informer is not None:
             informer.stop()
+        if metrics_server is not None:
+            metrics_server.stop()
     return 0
 
 

@@ -48,6 +48,13 @@ class HealthWatcher:
             event.chip_id or "ALL", event.health.value, event.reason,
             event.severity,
         )
+        from ..utils.metrics import REGISTRY
+
+        REGISTRY.counter_inc(
+            "tpushare_health_events_total",
+            "Classified health transitions",
+            severity=event.severity, health=event.health.value,
+        )
         if self._on_event is not None:
             try:
                 self._on_event(event)
@@ -69,6 +76,11 @@ class HealthWatcher:
                 self._unhealthy_ids.add(event.chip_id)
             else:
                 self._unhealthy_ids.discard(event.chip_id)
+        REGISTRY.gauge_set(
+            "tpushare_unhealthy_chips",
+            len(self._unhealthy_ids),
+            "Chips currently excluded from placement",
+        )
         for sink in self._sinks:
             try:
                 sink(event.chip_id, event.health)

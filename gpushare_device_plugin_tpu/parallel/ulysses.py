@@ -50,7 +50,10 @@ def _grouped_plain(q, k, v, *, causal, scale):
         mask = jnp.tril(jnp.ones((S, S), dtype=bool))
         s = jnp.where(mask[None, None, None], s, -jnp.inf)
     p = jax.nn.softmax(s, axis=-1)
-    out = jnp.einsum("bkgqs,bskd->bqkgd", p.astype(v.dtype), v)
+    # f32 accumulation over the S-long key axis (bf16 accumulation would
+    # drift at long sequences), matching grouped_full_attention and the
+    # ring's f32 online accumulator; cast once at the end.
+    out = jnp.einsum("bkgqs,bskd->bqkgd", p, v).astype(q.dtype)
     return out.reshape(B, S, H, D)
 
 

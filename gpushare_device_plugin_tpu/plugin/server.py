@@ -192,15 +192,34 @@ class TpuSharePlugin(DevicePluginServicer):
 
     def Allocate(self, request, context) -> pb.AllocateResponse:
         """Count granted fake IDs per container and delegate placement."""
+        from ..utils.metrics import REGISTRY
+
         granted = [list(creq.devicesIDs) for creq in request.container_requests]
         log.v(4, "Allocate: granted id counts %s", [len(g) for g in granted])
         if self._allocate_fn is None:
             context.abort(grpc.StatusCode.FAILED_PRECONDITION, "allocator not bound")
+        t0 = time.perf_counter()
         try:
             allocations = self._allocate_fn(granted)
         except Exception as e:  # business errors -> admission failure
             log.warning("Allocate failed: %s", e)
+            REGISTRY.counter_inc(
+                "tpushare_allocate_total",
+                "Allocate RPCs by outcome",
+                resource=self._cfg.resource_name, outcome="error",
+            )
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        REGISTRY.observe(
+            "tpushare_allocate_seconds",
+            time.perf_counter() - t0,
+            "Allocate placement latency",
+            resource=self._cfg.resource_name,
+        )
+        REGISTRY.counter_inc(
+            "tpushare_allocate_total",
+            "Allocate RPCs by outcome",
+            resource=self._cfg.resource_name, outcome="ok",
+        )
         resp = pb.AllocateResponse()
         for alloc in allocations:
             cresp = resp.container_responses.add()

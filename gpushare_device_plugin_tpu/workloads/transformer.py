@@ -217,6 +217,11 @@ def _layer(x, lp, cfg: TransformerConfig, positions, mesh: Mesh | None):
         # stays auto-sharded SPMD. Ring circulates the grouped K/V (1/g
         # the ICI bytes per hop); Ulysses swaps to a full-sequence layout
         # so the flash kernel runs per shard (parallel/ulysses.py).
+        if cfg.context_parallel not in ("ring", "ulysses"):
+            raise ValueError(
+                f"unknown context_parallel={cfg.context_parallel!r}: "
+                "expected ring|ulysses"
+            )
         if cfg.context_parallel == "ulysses":
             attn = ulysses_attention(
                 q, k, v, mesh, axis_name="sp", causal=True,

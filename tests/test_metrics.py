@@ -1,0 +1,119 @@
+"""Prometheus metrics: registry semantics + endpoint + hot-path wiring.
+
+The reference shipped zero metrics (SURVEY.md section 5); the contract
+here is a correct text-exposition format over the paths operators care
+about: Allocate latency/outcomes, extender verb latency, health
+transitions.
+"""
+
+import requests
+
+from gpushare_device_plugin_tpu.utils.metrics import (
+    MetricsRegistry,
+    MetricsServer,
+)
+
+
+def test_counter_and_gauge_render():
+    r = MetricsRegistry()
+    r.counter_inc("x_total", "things", outcome="ok")
+    r.counter_inc("x_total", outcome="ok")
+    r.counter_inc("x_total", outcome="err")
+    r.gauge_set("y", 3.5, "level")
+    text = r.render()
+    assert '# TYPE x_total counter' in text
+    assert 'x_total{outcome="ok"} 2' in text
+    assert 'x_total{outcome="err"} 1' in text
+    assert '# TYPE y gauge' in text and "y 3.5" in text
+
+
+def test_histogram_buckets_cumulative():
+    r = MetricsRegistry()
+    for s in (0.0004, 0.003, 0.3):
+        r.observe("lat_seconds", s, "latency", buckets=(0.001, 0.01, 1.0))
+    text = r.render()
+    assert 'lat_seconds_bucket{le="0.001"} 1' in text
+    assert 'lat_seconds_bucket{le="0.01"} 2' in text
+    assert 'lat_seconds_bucket{le="1"} 3' in text
+    assert 'lat_seconds_bucket{le="+Inf"} 3' in text
+    assert "lat_seconds_count 3" in text
+
+
+def test_metrics_server_endpoint():
+    r = MetricsRegistry()
+    r.counter_inc("served_total", "hits")
+    srv = MetricsServer(registry=r, host="127.0.0.1", port=0).start()
+    try:
+        url = f"http://127.0.0.1:{srv.port}"
+        resp = requests.get(f"{url}/metrics")
+        assert resp.status_code == 200
+        assert "served_total 1" in resp.text
+        assert "text/plain" in resp.headers["Content-Type"]
+        assert requests.get(f"{url}/healthz").text == "ok\n"
+        assert requests.get(f"{url}/nope").status_code == 404
+    finally:
+        srv.stop()
+
+
+def test_allocate_path_is_instrumented(tmp_path):
+    """A real gRPC Allocate through the plugin server lands in the default
+    registry (histogram + ok counter)."""
+    from gpushare_device_plugin_tpu import const
+    from gpushare_device_plugin_tpu.allocator.env import ContainerAllocation
+    from gpushare_device_plugin_tpu.device import DeviceInventory
+    from gpushare_device_plugin_tpu.discovery import MockBackend
+    from gpushare_device_plugin_tpu.plugin import PluginConfig, TpuSharePlugin
+    from gpushare_device_plugin_tpu.utils.metrics import REGISTRY
+
+    from fake_kubelet import FakeKubelet
+
+    kubelet = FakeKubelet(str(tmp_path))
+    kubelet.start()
+    inv = DeviceInventory(MockBackend(num_chips=2, hbm_bytes=8 << 30).chips())
+    plugin = TpuSharePlugin(
+        inv,
+        allocate_fn=lambda granted: [
+            ContainerAllocation(envs={const.ENV_TPU_VISIBLE_CHIPS: "0"})
+            for _ in granted
+        ],
+        config=PluginConfig(plugin_dir=str(tmp_path)),
+    )
+    plugin.serve()
+    try:
+        reg = kubelet.wait_for_registration()
+        kubelet.allocate(reg.endpoint, [["g0", "g1"]])
+        text = REGISTRY.render()
+        assert 'tpushare_allocate_total{outcome="ok",resource="aliyun.com/tpu-mem"} ' in text
+        assert "tpushare_allocate_seconds_count" in text
+    finally:
+        plugin.stop()
+        kubelet.stop()
+
+
+def test_extender_verbs_instrumented():
+    from gpushare_device_plugin_tpu.cluster.apiserver import ApiServerClient
+    from gpushare_device_plugin_tpu.extender.server import (
+        ExtenderCore,
+        ExtenderHTTPServer,
+    )
+    from gpushare_device_plugin_tpu.utils.metrics import REGISTRY
+
+    from fake_apiserver import FakeApiServer
+
+    api = FakeApiServer()
+    api.start()
+    http = ExtenderHTTPServer(
+        ExtenderCore(ApiServerClient(api.url)), host="127.0.0.1", port=0
+    )
+    http.start()
+    try:
+        requests.post(
+            f"http://127.0.0.1:{http.port}/scheduler/filter",
+            json={"pod": {}, "nodenames": []},
+        )
+        text = REGISTRY.render()
+        assert 'tpushare_extender_verb_total{outcome="ok",verb="filter"}' in text
+        assert "tpushare_extender_verb_seconds_count" in text
+    finally:
+        http.stop()
+        api.stop()
