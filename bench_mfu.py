@@ -73,7 +73,29 @@ def _timeit(fn, *args, iters: int = 20, warmup: int = 2):
     return statistics.median(times), times
 
 
-def bench_flash(report: dict) -> None:
+def _bench_cfg(smoke: bool):
+    """One model config for BOTH the train and decode sections (a drifted
+    copy would make their cross-section comparison meaningless).
+    Full mode: ~0.5B params — big enough that the MXU dominates, small
+    enough that f32 params + Adam moments + activations fit one v5e chip
+    (16 GiB)."""
+    import jax.numpy as jnp
+
+    from gpushare_device_plugin_tpu.workloads.transformer import TransformerConfig
+
+    if smoke:
+        return TransformerConfig(
+            vocab=128, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+            d_ff=128, max_seq=128, compute_dtype=jnp.float32,
+        )
+    return TransformerConfig(
+        vocab=8192, d_model=2048, n_layers=8, n_heads=16, n_kv_heads=8,
+        d_ff=7168, max_seq=2048, rope_theta=500000.0,
+        compute_dtype=jnp.bfloat16, attention="flash",
+    )
+
+
+def bench_flash(report: dict, smoke: bool = False) -> None:
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -87,6 +109,10 @@ def bench_flash(report: dict) -> None:
         (2, 16, 4, 4096, 128),
         (1, 8, 8, 8192, 64),
     ]
+    iters = 20
+    if smoke:  # CPU path-check: tiny shapes, interpreter kernel
+        points = [(1, 4, 2, 256, 32)]
+        iters = 2
     results = []
     for B, H, Hkv, S, Dh in points:
         kq, kk, kv = jax.random.split(jax.random.key(0), 3)
@@ -94,7 +120,8 @@ def bench_flash(report: dict) -> None:
         k = jax.random.normal(kk, (B, S, Hkv, Dh), jnp.bfloat16)
         v = jax.random.normal(kv, (B, S, Hkv, Dh), jnp.bfloat16)
 
-        flash = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True, interpret=False))
+        interpret = None if not smoke else True
+        flash = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True, interpret=interpret))
         plain = jax.jit(lambda q, k, v: grouped_full_attention(q, k, v, causal=True))
 
         # Numerics: both paths do f32 scores/softmax and cast to bf16, so
@@ -107,8 +134,8 @@ def bench_flash(report: dict) -> None:
                 f"flash kernel numerics off oracle at S={S} Dh={Dh}: max abs err {err}"
             )
 
-        t_flash, _ = _timeit(flash, q, k, v)
-        t_plain, _ = _timeit(plain, q, k, v)
+        t_flash, _ = _timeit(flash, q, k, v, iters=iters)
+        t_plain, _ = _timeit(plain, q, k, v, iters=iters)
         # Causal-effective score+value matmul FLOPs: 2 * (QK + PV) / 2.
         flops = 2.0 * B * H * S * S * Dh
         res = {
@@ -125,21 +152,22 @@ def bench_flash(report: dict) -> None:
 
     # Backward pass at the GQA point: full VJP through the Pallas dQ/dKV
     # kernels vs the oracle's autodiff.
-    B, H, Hkv, S, Dh = points[1]
+    B, H, Hkv, S, Dh = points[1] if not smoke else points[0]
     kq, kk, kv = jax.random.split(jax.random.key(1), 3)
     q = jax.random.normal(kq, (B, S, H, Dh), jnp.bfloat16)
     k = jax.random.normal(kk, (B, S, Hkv, Dh), jnp.bfloat16)
     v = jax.random.normal(kv, (B, S, Hkv, Dh), jnp.bfloat16)
+    interpret = None if not smoke else True
     loss_flash = jax.jit(jax.grad(
-        lambda q, k, v: flash_attention(q, k, v, causal=True, interpret=False)
+        lambda q, k, v: flash_attention(q, k, v, causal=True, interpret=interpret)
         .astype(jnp.float32).sum()
     ))
     loss_plain = jax.jit(jax.grad(
         lambda q, k, v: grouped_full_attention(q, k, v, causal=True)
         .astype(jnp.float32).sum()
     ))
-    t_flash, _ = _timeit(loss_flash, q, k, v)
-    t_plain, _ = _timeit(loss_plain, q, k, v)
+    t_flash, _ = _timeit(loss_flash, q, k, v, iters=iters)
+    t_plain, _ = _timeit(loss_plain, q, k, v, iters=iters)
     report["flash_bwd"] = {
         "B": B, "H": H, "Hkv": Hkv, "S": S, "Dh": Dh,
         "flash_ms": round(t_flash * 1e3, 3),
@@ -168,7 +196,7 @@ def _matmul_flops_per_step(cfg, batch: int, seq: int) -> tuple[float, int]:
     return 3.0 * (proj_fwd + attn_fwd), n_params
 
 
-def bench_train(report: dict) -> None:
+def bench_train(report: dict, smoke: bool = False) -> None:
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -183,12 +211,8 @@ def bench_train(report: dict) -> None:
 
     # ~0.5B-param decoder: big enough that the MXU dominates, small enough
     # that f32 params + Adam moments + activations fit one v5e chip (16 GiB).
-    cfg = TransformerConfig(
-        vocab=8192, d_model=2048, n_layers=8, n_heads=16, n_kv_heads=8,
-        d_ff=7168, max_seq=2048, rope_theta=500000.0,
-        compute_dtype=jnp.bfloat16, attention="flash",
-    )
-    batch, seq = 8, 2048
+    cfg = _bench_cfg(smoke)
+    batch, seq = (2, 64) if smoke else (8, 2048)
     mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1, 1, 1), ("dp", "fsdp", "tp", "sp"))
 
     flops_per_step, n_params = _matmul_flops_per_step(cfg, batch, seq)
@@ -209,7 +233,7 @@ def bench_train(report: dict) -> None:
         raise AssertionError(f"non-finite warmup loss {loss}")
 
     times = []
-    n_steps = 20
+    n_steps = 20 if not smoke else 3
     for _ in range(n_steps):
         t0 = time.perf_counter()
         params, opt_state, loss = step(params, opt_state, tokens)
@@ -232,7 +256,7 @@ def bench_train(report: dict) -> None:
     print(f"train {report['train']}", file=sys.stderr)
 
 
-def bench_decode(report: dict) -> None:
+def bench_decode(report: dict, smoke: bool = False) -> None:
     """Cached single-token decode throughput (serving-side metric)."""
     import jax
     import jax.numpy as jnp
@@ -243,21 +267,18 @@ def bench_decode(report: dict) -> None:
         init_params,
     )
 
-    cfg = TransformerConfig(
-        vocab=8192, d_model=2048, n_layers=8, n_heads=16, n_kv_heads=8,
-        d_ff=7168, max_seq=2048, rope_theta=500000.0,
-        compute_dtype=jnp.bfloat16, attention="flash",
-    )
+    cfg = _bench_cfg(smoke)
+    cache_len = 2048 if not smoke else 128
     params = jax.jit(lambda k: init_params(k, cfg))(jax.random.key(0))
     results = []
-    for batch in (1, 8):
-        cache = G.init_cache(cfg, batch, 2048)
+    for batch in (1, 8) if not smoke else (1,):
+        cache = G.init_cache(cfg, batch, cache_len)
         tok = jnp.zeros((batch,), jnp.int32)
         # params as an argument, not a closure: closed-over arrays embed as
         # compile-time constants (0.5B params would bloat the executable).
         step = jax.jit(lambda p, t, c: G.decode_step(p, t, c, cfg))
         logits, cache = step(params, tok, cache)  # compile + first write
-        t, times = _timeit(lambda: step(params, tok, cache)[0], iters=30, warmup=3)
+        t, times = _timeit(lambda: step(params, tok, cache)[0], iters=30 if not smoke else 3, warmup=3 if not smoke else 1)
         results.append({
             "batch": batch,
             "step_ms": round(t * 1e3, 2),
@@ -267,10 +288,26 @@ def bench_decode(report: dict) -> None:
     report["decode"] = results
 
 
-def main() -> int:
+def main(argv: list[str] | None = None) -> int:
+    args = argv if argv is not None else sys.argv[1:]
+    # --smoke: CPU path-check with tiny shapes + the interpreter kernel, so
+    # a Python-level bug cannot survive to the one-shot real-TPU run. The
+    # numbers it prints are meaningless; the exercised code paths are real.
+    smoke = "--smoke" in args
+    if smoke:
+        import os
+
+        # Force, don't default: an inherited JAX_PLATFORMS (axon/tpu) would
+        # defeat the CPU path-check (and hang when the tunnel is down).
+        os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
 
-    if jax.default_backend() != "tpu":
+    if smoke:
+        try:
+            jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+        except Exception:  # noqa: BLE001 — backend already initialized
+            pass
+    elif jax.default_backend() != "tpu":
         print(
             f"backend is {jax.default_backend()!r}, not tpu - skipping compute bench",
             file=sys.stderr,
@@ -281,13 +318,14 @@ def main() -> int:
     dev = jax.devices()[0]
     report: dict = {
         "skipped": False,
-        "backend": "tpu",
+        "smoke": smoke,
+        "backend": jax.default_backend(),
         "device_kind": dev.device_kind,
         "peak_bf16_tflops": _peak_tflops(dev.device_kind),
     }
-    bench_flash(report)
-    bench_train(report)
-    bench_decode(report)
+    bench_flash(report, smoke=smoke)
+    bench_train(report, smoke=smoke)
+    bench_decode(report, smoke=smoke)
     print(json.dumps(report))
     return 0
 

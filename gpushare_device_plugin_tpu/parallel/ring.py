@@ -132,6 +132,54 @@ def ring_attention(
     )(q, k, v)
 
 
+def grouped_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    scale: float | None = None,
+    mask: jax.Array | None = None,
+) -> jax.Array:
+    """Single-device grouped (GQA) attention — THE shared plain-math path.
+
+    q: [B, S, H, D]; k, v: [B, S, Hkv, D] with H a multiple of Hkv (MHA is
+    g=1). ``mask`` ([B, Tq, Tk] boolean, True = attend) composes with the
+    causal mask; rows left fully masked produce zeros (never NaN). f32
+    scores/softmax/accumulation, one cast at the end.
+
+    Every consumer that needs plain grouped attention delegates here
+    (``workloads.attention.grouped_full_attention``, the Ulysses inner
+    fallback, padded-prefill in ``workloads.generate``) so the numerics
+    exist exactly once; :func:`full_attention` stays an independent MHA
+    oracle for tests.
+    """
+    B, S, H, D = q.shape
+    Hkv = k.shape[2]
+    if H % Hkv:
+        raise ValueError(f"q heads {H} not a multiple of kv heads {Hkv}")
+    g = H // Hkv
+    sc = scale if scale is not None else 1.0 / math.sqrt(D)
+    qg = q.reshape(B, S, Hkv, g, D)
+    s = jnp.einsum("bqkgd,bskd->bkgqs", qg, k).astype(jnp.float32) * sc
+    m = None
+    if causal:
+        m = jnp.tril(jnp.ones((S, S), dtype=bool))[None]  # [1, Tq, Tk]
+    if mask is not None:
+        m = mask if m is None else (m & mask)
+    if m is not None:
+        s = jnp.where(m[:, None, None], s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        # fully-masked rows: all--inf softmax is NaN; zero them so NaN
+        # never leaks into downstream residuals/caches
+        dead = ~m.any(-1)  # [B|1, Tq]
+        p = jnp.where(dead[:, None, None, :, None], 0.0, p)
+    else:
+        p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bkgqs,bskd->bqkgd", p, v).astype(q.dtype)
+    return out.reshape(B, S, H, D)
+
+
 def full_attention(
     q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool = True,
     scale: float | None = None,

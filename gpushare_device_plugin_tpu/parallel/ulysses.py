@@ -25,36 +25,13 @@ full heads before the swap.
 from __future__ import annotations
 
 import functools
-import math
 from typing import Callable
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from .ring import full_attention
-
-
-def _grouped_plain(q, k, v, *, causal, scale):
-    """Oracle-grade grouped attention without importing workloads (the
-    package layering is parallel <- workloads, not the reverse)."""
-    B, S, H, D = q.shape
-    Hkv = k.shape[2]
-    if H == Hkv:
-        return full_attention(q, k, v, causal=causal, scale=scale)
-    g = H // Hkv
-    sc = scale if scale is not None else 1.0 / math.sqrt(D)
-    qg = q.reshape(B, S, Hkv, g, D)
-    s = jnp.einsum("bqkgd,bskd->bkgqs", qg, k).astype(jnp.float32) * sc
-    if causal:
-        mask = jnp.tril(jnp.ones((S, S), dtype=bool))
-        s = jnp.where(mask[None, None, None], s, -jnp.inf)
-    p = jax.nn.softmax(s, axis=-1)
-    # f32 accumulation over the S-long key axis (bf16 accumulation would
-    # drift at long sequences), matching grouped_full_attention and the
-    # ring's f32 online accumulator; cast once at the end.
-    out = jnp.einsum("bkgqs,bskd->bqkgd", p, v).astype(q.dtype)
-    return out.reshape(B, S, H, D)
+from .ring import grouped_attention
 
 
 def ulysses_attention_block(
@@ -95,7 +72,7 @@ def ulysses_attention_block(
     q = seq_to_heads(q)
     k = seq_to_heads(k)
     v = seq_to_heads(v)
-    fn = attn_fn if attn_fn is not None else _grouped_plain
+    fn = attn_fn if attn_fn is not None else grouped_attention
     out = fn(q, k, v, causal=causal, scale=scale)
     # [B, S, H/n, D] -> [B, S/n, H, D]
     return jax.lax.all_to_all(

@@ -10,14 +10,13 @@ the shard_map wrapper that runs the kernel per-shard over the
 from __future__ import annotations
 
 import functools
-import math
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops import flash_attention
-from ..parallel.ring import full_attention
+from ..parallel.ring import grouped_attention
 
 
 def grouped_full_attention(
@@ -27,22 +26,11 @@ def grouped_full_attention(
 
     q: [B, S, H, Dh]; k, v: [B, S, Hkv, Dh] with H a multiple of Hkv. The
     group dim rides inside the einsums as a broadcast axis, so full-head
-    K/V is never materialized in HBM. Numerics mirror
-    ``parallel.ring.full_attention`` (f32 scores/softmax).
+    K/V is never materialized in HBM. Delegates to the shared
+    ``parallel.ring.grouped_attention`` math (f32 scores/softmax/
+    accumulation — one implementation repo-wide).
     """
-    B, S, H, Dh = q.shape
-    Hkv = k.shape[2]
-    if H == Hkv:
-        return full_attention(q, k, v, causal=causal)
-    qg = q.reshape(B, S, Hkv, H // Hkv, Dh)
-    sc = 1.0 / math.sqrt(Dh)
-    s = jnp.einsum("bqkgd,bskd->bkgqs", qg, k).astype(jnp.float32) * sc
-    if causal:
-        mask = jnp.tril(jnp.ones((S, S), dtype=bool))
-        s = jnp.where(mask[None, None, None], s, -jnp.inf)
-    p = jax.nn.softmax(s, axis=-1)
-    out = jnp.einsum("bkgqs,bskd->bqkgd", p, v).astype(q.dtype)
-    return out.reshape(B, S, H, Dh)
+    return grouped_attention(q, k, v, causal=causal)
 
 
 def use_flash(

@@ -30,6 +30,7 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
+from ..parallel.ring import grouped_attention
 from .attention import flash_or_plain
 from .transformer import TransformerConfig, _mlp_block, _project_qkv, _rms_norm
 
@@ -45,12 +46,13 @@ def init_cache(cfg: TransformerConfig, batch: int, max_len: int) -> KVCache:
     }
 
 
-def _decode_attention(q, k_cache, v_cache, cur_len):
+def _decode_attention(q, k_cache, v_cache, cur_len, start=None):
     """Single-position attention over the cache.
 
     q: [B, 1, H, Dh]; k_cache/v_cache: [B, Smax, Hkv, Dh]; positions
-    ``>= cur_len`` (the unwritten tail) are masked out. f32 softmax like
-    every other attention path in the repo.
+    ``>= cur_len`` (the unwritten tail) are masked out, as are positions
+    ``< start[b]`` (per-row left padding). f32 softmax like every other
+    attention path in the repo.
     """
     B, _, H, Dh = q.shape
     Smax = k_cache.shape[1]
@@ -59,15 +61,39 @@ def _decode_attention(q, k_cache, v_cache, cur_len):
     qg = q[:, 0].reshape(B, Hkv, g, Dh)
     s = jnp.einsum("bkgd,bskd->bkgs", qg, k_cache).astype(jnp.float32)
     s = s / jnp.sqrt(jnp.float32(Dh))
-    mask = jnp.arange(Smax) < cur_len  # [Smax]
-    s = jnp.where(mask[None, None, None, :], s, -jnp.inf)
+    idx = jnp.arange(Smax)
+    mask = jnp.broadcast_to(idx < cur_len, (B, Smax))
+    if start is not None:
+        mask = mask & (idx[None, :] >= start[:, None])
+    s = jnp.where(mask[:, None, None, :], s, -jnp.inf)
     p = jax.nn.softmax(s, axis=-1)
-    out = jnp.einsum("bkgs,bskd->bkgd", p.astype(v_cache.dtype), v_cache)
+    # f32 accumulation over the key axis; cast once at the end.
+    out = jnp.einsum("bkgs,bskd->bkgd", p, v_cache).astype(q.dtype)
     return out.reshape(B, 1, H, Dh)
 
 
+def _padded_prefill_attention(q, k, v, pad):
+    """Prompt self-attention with per-row left padding.
+
+    q: [B, T, H, Dh]; k, v: [B, T, Hkv, Dh]; pad: [B] leading pad counts.
+    Causal mask plus exclusion of each row's pad keys, delegated to the
+    shared grouped-attention math. Plain path by design (the flash kernel
+    has no per-row mask input); prefill happens once per sequence, decode
+    dominates serving cost.
+    """
+    T = q.shape[1]
+    live = jnp.arange(T)[None, :] >= pad[:, None]  # [B, Tk]
+    return grouped_attention(
+        q, k, v, causal=True, mask=jnp.broadcast_to(live[:, None, :], (q.shape[0], T, T))
+    )
+
+
 def prefill(
-    params: Any, tokens: jax.Array, cache: KVCache, cfg: TransformerConfig
+    params: Any,
+    tokens: jax.Array,
+    cache: KVCache,
+    cfg: TransformerConfig,
+    pad: jax.Array | None = None,
 ) -> tuple[jax.Array, KVCache]:
     """Run the prompt through the model, filling the cache.
 
@@ -75,19 +101,29 @@ def prefill(
     ``len=Tp``). Prompt self-attention is the training attention path
     (flash on TPU); the cache is written, not read — prefill always starts
     a fresh sequence.
+
+    ``pad`` ([B] leading pad counts) switches to LEFT-padded variable-
+    length mode: RoPE positions are offset per row, pad keys are masked,
+    and the last position holds every row's final real token.
     """
     dt = cfg.compute_dtype
     B, Tp = tokens.shape
-    positions = jnp.arange(Tp)
+    if pad is None:
+        positions = jnp.arange(Tp)
+    else:
+        positions = jnp.clip(jnp.arange(Tp)[None, :] - pad[:, None], 0)
     x = params["embed"].astype(dt)[tokens]
 
     def layer(x, xs):
         lp, _ = xs
         h = _rms_norm(x, lp["ln1"])
         q, k, v = _project_qkv(h, lp, cfg, positions)
-        attn = flash_or_plain(
-            q, k, v, attention=cfg.attention, causal=True, mesh=None
-        )
+        if pad is None:
+            attn = flash_or_plain(
+                q, k, v, attention=cfg.attention, causal=True, mesh=None
+            )
+        else:
+            attn = _padded_prefill_attention(q, k, v, pad)
         x = x + jnp.einsum("bthn,hnd->btd", attn, lp["wo"].astype(dt))
         return _mlp_block(x, lp, cfg), (k, v)
 
@@ -110,12 +146,23 @@ def prefill(
 
 
 def decode_step(
-    params: Any, token: jax.Array, cache: KVCache, cfg: TransformerConfig
+    params: Any,
+    token: jax.Array,
+    cache: KVCache,
+    cfg: TransformerConfig,
+    start: jax.Array | None = None,
 ) -> tuple[jax.Array, KVCache]:
-    """One cached decode step. token: [B] -> (logits [B, vocab], cache+1)."""
+    """One cached decode step. token: [B] -> (logits [B, vocab], cache+1).
+
+    ``start`` ([B] leading pad counts from a left-padded prefill) offsets
+    each row's RoPE position and masks its pad slots out of attention.
+    """
     dt = cfg.compute_dtype
     pos = cache["len"]
-    positions = pos[None]  # [1]
+    if start is None:
+        positions = pos[None]  # [1]
+    else:
+        positions = (pos - start)[:, None]  # [B, 1]
     x = params["embed"].astype(dt)[token][:, None]  # [B, 1, d]
 
     def layer(x, xs):
@@ -128,7 +175,7 @@ def decode_step(
         v_cache = jax.lax.dynamic_update_slice(
             v_cache, v.astype(v_cache.dtype), (0, pos, 0, 0)
         )
-        attn = _decode_attention(q, k_cache, v_cache, pos + 1)
+        attn = _decode_attention(q, k_cache, v_cache, pos + 1, start=start)
         x = x + jnp.einsum("bthn,hnd->btd", attn, lp["wo"].astype(dt))
         return _mlp_block(x, lp, cfg), (k_cache, v_cache)
 
@@ -148,23 +195,36 @@ def generate(
     temperature: float = 0.0,
     rng: jax.Array | None = None,
     eos_id: int | None = None,
+    prompt_lens: jax.Array | None = None,
 ) -> jax.Array:
     """Generate ``max_new`` tokens after ``prompt`` ([B, Tp] int32).
 
-    Returns [B, Tp + max_new]. ``temperature=0`` is greedy argmax;
-    otherwise softmax sampling at the given temperature (``rng``
-    required). With ``eos_id``, positions after the first EOS are
-    overwritten with EOS (post-hoc mask — the compiled loop always runs
-    ``max_new`` steps; see module docstring).
+    Returns [B, Tp + max_new]; with ``prompt_lens`` (variable-length
+    batch), returns ONLY the generated block [B, max_new] — row i's
+    tokens logically continue from position ``prompt_lens[i]``, so a
+    concatenated layout would be ragged. ``prompt`` is right-padded as
+    given; it is re-packed LEFT-padded internally so every row's decode
+    writes the same cache slot (static shapes, no per-row scatter).
 
-    Wrap in ``jax.jit`` with ``static_argnames=()`` via
-    :func:`make_generate` for repeated use.
+    ``temperature=0`` is greedy argmax; otherwise softmax sampling at the
+    given temperature (``rng`` required). With ``eos_id``, positions
+    after the first EOS are overwritten with EOS (post-hoc mask — the
+    compiled loop always runs ``max_new`` steps; see module docstring).
+
+    Wrap in ``jax.jit`` via :func:`make_generate` for repeated use.
     """
     if temperature > 0.0 and rng is None:
         raise ValueError("temperature sampling needs rng")
     B, Tp = prompt.shape
     cache = init_cache(cfg, B, Tp + max_new)
-    logits, cache = prefill(params, prompt, cache, cfg)
+    pad = None
+    if prompt_lens is not None:
+        pad = (Tp - prompt_lens).astype(jnp.int32)
+        # right-padded -> left-padded: roll each row by its pad count
+        prompt_packed = jax.vmap(jnp.roll)(prompt, pad)
+        logits, cache = prefill(params, prompt_packed, cache, cfg, pad=pad)
+    else:
+        logits, cache = prefill(params, prompt, cache, cfg)
     rng = rng if rng is not None else jax.random.key(0)
 
     def pick(logits, key):
@@ -178,27 +238,45 @@ def generate(
     def step(carry, _):
         token, cache, key = carry
         key, sub = jax.random.split(key)
-        logits, cache = decode_step(params, token, cache, cfg)
+        logits, cache = decode_step(params, token, cache, cfg, start=pad)
         nxt = pick(logits, sub).astype(jnp.int32)
         return (nxt, cache, key), token
 
     (_last, cache, _), toks = jax.lax.scan(
         step, (first, cache, rng), None, length=max_new
     )
-    out = jnp.concatenate([prompt, toks.T], axis=1)  # [B, Tp + max_new]
+    gen = toks.T  # [B, max_new]
     if eos_id is not None:
-        gen = out[:, Tp:]
         seen = jnp.cumsum((gen == eos_id).astype(jnp.int32), axis=1)
         # positions strictly after the first EOS become EOS
         gen = jnp.where(seen - (gen == eos_id) > 0, eos_id, gen)
-        out = jnp.concatenate([out[:, :Tp], gen], axis=1)
-    return out
+    if prompt_lens is not None:
+        return gen
+    return jnp.concatenate([prompt, gen], axis=1)  # [B, Tp + max_new]
 
 
-def make_generate(cfg: TransformerConfig, *, max_new: int, temperature: float = 0.0):
-    """Jitted (params, prompt, rng) -> tokens closure (one compile per
-    prompt shape)."""
+def make_generate(
+    cfg: TransformerConfig,
+    *,
+    max_new: int,
+    temperature: float = 0.0,
+    eos_id: int | None = None,
+    padded: bool = False,
+):
+    """Jitted generate closure (one compile per prompt shape).
+
+    ``padded=False``: (params, prompt, rng) -> [B, Tp+max_new].
+    ``padded=True``: (params, prompt, prompt_lens, rng) -> [B, max_new]
+    (the variable-length serving path).
+    """
     fn = functools.partial(
-        generate, cfg=cfg, max_new=max_new, temperature=temperature
+        generate, cfg=cfg, max_new=max_new, temperature=temperature,
+        eos_id=eos_id,
     )
+    if padded:
+        return jax.jit(
+            lambda params, prompt, prompt_lens, rng: fn(
+                params, prompt, rng=rng, prompt_lens=prompt_lens
+            )
+        )
     return jax.jit(lambda params, prompt, rng: fn(params, prompt, rng=rng))

@@ -158,13 +158,16 @@ def _rms_norm(x, weight, eps=1e-6):
 
 
 def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
-    """x: [B, T, H, Dh]; positions: [T] global token positions."""
+    """x: [B, T, H, Dh]; positions: [T] shared or [B, T] per-row token
+    positions (padded generation offsets positions per row)."""
     Dh = x.shape[-1]
     half = Dh // 2
     freqs = 1.0 / (theta ** (jnp.arange(half, dtype=jnp.float32) / half))
-    angles = positions[:, None].astype(jnp.float32) * freqs[None, :]  # [T, half]
-    cos = jnp.cos(angles)[None, :, None, :].astype(x.dtype)
-    sin = jnp.sin(angles)[None, :, None, :].astype(x.dtype)
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [*, T, half]
+    if angles.ndim == 2:  # shared positions -> add the batch dim
+        angles = angles[None]
+    cos = jnp.cos(angles)[:, :, None, :].astype(x.dtype)  # [B|1, T, 1, half]
+    sin = jnp.sin(angles)[:, :, None, :].astype(x.dtype)
     x1, x2 = x[..., :half], x[..., half:]
     return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
 

@@ -1,0 +1,35 @@
+"""bench_mfu.py --smoke: the compute bench's code paths must run on CPU.
+
+The real bench runs once per round on scarce TPU time; a Python-level bug
+there loses the round's compute numbers. Smoke mode exercises every stage
+(flash fwd numerics + timing, flash bwd, train-step MFU accounting, cached
+decode) with tiny shapes and the interpreter kernel.
+"""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+
+def test_bench_mfu_smoke_runs_clean():
+    repo = Path(__file__).resolve().parent.parent
+    proc = subprocess.run(
+        [sys.executable, str(repo / "bench_mfu.py"), "--smoke"],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        capture_output=True, text=True, timeout=900, cwd=str(repo),
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    report = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert report["smoke"] is True
+    assert report["flash"], "flash section missing"
+    assert report["flash"][0]["max_abs_err"] < 0.03
+    assert report["flash_bwd"]["flash_ms"] > 0
+    assert report["train"]["steps_timed"] >= 3
+    assert report["train"]["tokens_per_s"] > 0
+    assert report["decode"][0]["tokens_per_s"] > 0

@@ -104,6 +104,42 @@ def test_eos_masking(setup):
             assert (row[int(hits[0]):] == 3).all()
 
 
+def test_padded_batch_matches_per_row_generation(setup):
+    """Variable-length prompts: a padded batch must generate exactly the
+    tokens each row generates alone (greedy)."""
+    cfg, params, _ = setup
+    max_new = 5
+    rows = [
+        demo_batch(jax.random.key(10), 1, 3, cfg.vocab),
+        demo_batch(jax.random.key(11), 1, 7, cfg.vocab),
+    ]
+    Tp = 7
+    lens = jnp.array([3, 7], jnp.int32)
+    padded = jnp.zeros((2, Tp), jnp.int32)
+    for i, row in enumerate(rows):
+        padded = padded.at[i, : row.shape[1]].set(row[0])
+
+    got = G.generate(params, padded, cfg, max_new=max_new, prompt_lens=lens)
+    assert got.shape == (2, max_new)
+    for i, row in enumerate(rows):
+        alone = G.generate(params, row, cfg, max_new=max_new)
+        assert (got[i] == alone[0, row.shape[1]:]).all(), (
+            f"row {i}: padded {got[i].tolist()} vs "
+            f"alone {alone[0, row.shape[1]:].tolist()}"
+        )
+
+
+def test_padded_full_length_row_matches_unpadded(setup):
+    """A prompt_lens row equal to Tp must behave exactly like the
+    unpadded path."""
+    cfg, params, prompt = setup
+    Tp = prompt.shape[1]
+    lens = jnp.full((prompt.shape[0],), Tp, jnp.int32)
+    got = G.generate(params, prompt, cfg, max_new=4, prompt_lens=lens)
+    ref = G.generate(params, prompt, cfg, max_new=4)
+    assert (got == ref[:, Tp:]).all()
+
+
 def test_gqa_cache_shape(setup):
     """The cache stores grouped KV heads (1/g the HBM of full heads)."""
     cfg, params, prompt = setup
