@@ -209,8 +209,6 @@ def bench_train(report: dict, smoke: bool = False) -> None:
         make_train_step,
     )
 
-    # ~0.5B-param decoder: big enough that the MXU dominates, small enough
-    # that f32 params + Adam moments + activations fit one v5e chip (16 GiB).
     cfg = _bench_cfg(smoke)
     batch, seq = (2, 64) if smoke else (8, 2048)
     mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1, 1, 1), ("dp", "fsdp", "tp", "sp"))

@@ -135,6 +135,32 @@ def test_llama3_8b_preset():
     assert cfg.vocab == 128256 and cfg.d_ff == 14336
 
 
+def test_llama3_8b_param_count_and_shardings():
+    """The preset really is ~8B params, and every major tensor carries an
+    fsdp/tp sharding on the mesh (abstract — eval_shape, no memory)."""
+    import jax
+
+    from gpushare_device_plugin_tpu.workloads.transformer import (
+        init_params,
+        llama3_8b,
+        param_shardings,
+    )
+
+    cfg = llama3_8b()
+    abstract = jax.eval_shape(lambda: init_params(jax.random.key(0), cfg))
+    n = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(abstract))
+    assert 7.9e9 < n < 8.2e9, f"param count {n / 1e9:.2f}B"
+
+    mesh = make_mesh(MeshSpec(dp=1, fsdp=2, tp=2, sp=2))
+    sh = param_shardings(mesh, cfg)
+    for name in ("embed", "out"):
+        spec = sh[name].spec
+        assert any(ax in ("fsdp", "tp") for ax in spec if ax), (name, spec)
+    for name in ("wq", "wkv", "wo", "wi", "wdown"):
+        spec = sh["layers"][name].spec
+        assert any(ax in ("fsdp", "tp") for ax in spec if ax), (name, spec)
+
+
 def test_mnist_learns():
     loss = mnist.train(steps=40, batch=128)
     assert loss < 0.5
