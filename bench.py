@@ -211,24 +211,41 @@ def trend_guard(p50: float, repo: Path) -> str | None:
 
 
 def run_compute_bench(repo: Path) -> dict:
-    """bench_mfu.py in a subprocess; {} on any failure (never fatal here)."""
+    """bench_mfu.py in a subprocess; {} on any failure (never fatal here).
+
+    bench_mfu re-prints its cumulative report after every section, so even
+    a timeout (dead TPU tunnel mid-compile) salvages the sections that
+    finished — the last parseable dict line wins.
+    """
+    stdout, stderr, note = "", "", None
     try:
         proc = subprocess.run(
             [sys.executable, str(repo / "bench_mfu.py")],
             capture_output=True, text=True, timeout=1800,
         )
-    except (subprocess.TimeoutExpired, OSError) as e:
+        stdout, stderr = proc.stdout, proc.stderr
+        note = None if proc.returncode == 0 else f"rc={proc.returncode}"
+    except subprocess.TimeoutExpired as e:
+        # kill-at-timeout can truncate multi-byte sequences: never raise
+        def _txt(v):
+            return v.decode(errors="replace") if isinstance(v, bytes) else (v or "")
+
+        stdout, stderr = _txt(e.stdout), _txt(e.stderr)
+        note = "timeout"
+    except OSError as e:
         print(f"compute bench failed to run: {e}", file=sys.stderr)
         return {"error": str(e)}
-    sys.stderr.write(proc.stderr)
-    for line in reversed(proc.stdout.strip().splitlines()):
+    sys.stderr.write(stderr)
+    for line in reversed(stdout.strip().splitlines()):
         try:
             obj = json.loads(line)
         except json.JSONDecodeError:
             continue
         if isinstance(obj, dict):
+            if note:
+                obj["partial"] = note
             return obj
-    return {"error": f"no JSON output (rc={proc.returncode})"}
+    return {"error": f"no JSON output ({note or 'empty'})"}
 
 
 def main() -> int:

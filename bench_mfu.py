@@ -17,10 +17,12 @@ scheduling, ``/root/reference/README.md:1-16``); these numbers exist so the
 workload half of this framework is held to the hardware, not to the Pallas
 interpreter.
 
-Prints ONE JSON object on stdout (consumed by ``bench.py``); human-readable
-progress goes to stderr.  On a non-TPU backend it prints
-``{"skipped": true}`` and exits 0 — the compiled-kernel path is meaningless
-off-chip.
+Prints the cumulative report JSON to stdout once up front and again after
+every section (last line wins — ``bench.py`` parses the last valid dict
+line, so a mid-section hang loses only the unfinished sections);
+human-readable progress goes to stderr. On a non-TPU backend it prints
+``{"skipped": true}`` and exits 0 — the compiled-kernel path is
+meaningless off-chip.
 
 MFU convention: model matmul FLOPs only (no rematerialisation recompute, no
 vector ops), causal attention counted at half the full score matrix —
@@ -320,11 +322,26 @@ def main(argv: list[str] | None = None) -> int:
         "backend": jax.default_backend(),
         "device_kind": dev.device_kind,
         "peak_bf16_tflops": _peak_tflops(dev.device_kind),
+        "sections": [],
     }
-    bench_flash(report, smoke=smoke)
-    bench_train(report, smoke=smoke)
-    bench_decode(report, smoke=smoke)
-    print(json.dumps(report))
+    # Section order = risk order, and the cumulative report is re-printed
+    # after every section: a hang mid-section (the remote-TPU tunnel has
+    # died mid-Pallas-compile before) still leaves the completed sections'
+    # numbers on stdout — bench.py takes the last parseable line, and
+    # salvages partial output on subprocess timeout. decode goes FIRST
+    # because it is the only section that never compiles the Pallas kernel
+    # (cached decode is plain einsum attention; train's forward and the
+    # flash section both lower Mosaic), so at least one number survives a
+    # kernel-compile hang.
+    print(json.dumps(report), flush=True)
+    for name, fn in (
+        ("decode", bench_decode),
+        ("train", bench_train),
+        ("flash", bench_flash),
+    ):
+        fn(report, smoke=smoke)
+        report["sections"].append(name)
+        print(json.dumps(report), flush=True)
     return 0
 
 
