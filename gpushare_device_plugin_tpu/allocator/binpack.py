@@ -10,6 +10,10 @@ Additions over the reference:
 - ``policy="best-fit"``: picks the feasible chip with the least free space,
   which strictly improves worst-case fragmentation for mixed request sizes
   (the north-star metric is binpack utilization %).
+- ``policy="spread"``: picks the feasible chip with the MOST free space —
+  the anti-affinity choice for latency-sensitive fleets, minimizing HBM
+  bandwidth contention between co-resident pods at the cost of packing
+  density (ties break to the lowest index, so it stays deterministic).
 - unhealthy chips are excluded (reference TODO at ``server.go:267``).
 """
 
@@ -61,12 +65,18 @@ def assign_chip(
         for idx in sorted(avail):
             if avail[idx] >= request_units:
                 return idx
-    elif policy == "best-fit":
-        # least free space among feasible chips; ties -> lowest index
+    elif policy in ("best-fit", "spread"):
+        # best-fit: least free space among feasible chips (densest packing);
+        # spread: most free space (least contention). Ties -> lowest index.
+        prefer_less = policy == "best-fit"
         best = None
         for idx in sorted(avail):
             if avail[idx] >= request_units:
-                if best is None or avail[idx] < avail[best]:
+                if best is None or (
+                    avail[idx] < avail[best]
+                    if prefer_less
+                    else avail[idx] > avail[best]
+                ):
                     best = idx
         if best is not None:
             return best

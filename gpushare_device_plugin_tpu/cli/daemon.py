@@ -56,7 +56,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--discovery", default="auto",
                    choices=["auto", "mock", "jax", "tpuvm"])
     p.add_argument("--policy", default="first-fit",
-                   choices=["first-fit", "best-fit"])
+                   choices=["first-fit", "best-fit", "spread"])
     p.add_argument("--standalone", action="store_true",
                    help="no apiserver: in-process accounting (dev/bench)")
     p.add_argument("--no-core-resource", action="store_true",

@@ -184,35 +184,46 @@ def filter_nodes(
     return filter_with_views(pod, nodes, views_from_pods(pods))
 
 
-def score_node(view: NodeView, request_units: int) -> int:
-    """Binpack score 0-10: prefer the node whose tightest feasible chip
-    leaves the least slack (consolidates fragments, keeps big chips whole)."""
+def score_node(view: NodeView, request_units: int, policy: str = "best-fit") -> int:
+    """Node score 0-10, consistent with the chip-level policy.
+
+    Packing policies (first-fit/best-fit) prefer the node whose tightest
+    feasible chip leaves the least slack (consolidates fragments, keeps
+    big chips whole); ``spread`` inverts — prefer the node whose emptiest
+    feasible chip has the MOST headroom, so pods fan out across nodes the
+    same way they fan out across chips."""
     feasible = [f for f in view.free().values() if f >= request_units]
     if not feasible:
         return 0
-    best = min(feasible)
     cap = max(view.capacity.values(), default=0)
     if cap <= 0:
         return 0
+    if policy == "spread":
+        return round(10 * (max(feasible) - request_units) / cap)
+    best = min(feasible)
     return round(10 * (1 - (best - request_units) / cap))
 
 
-def evaluate_scores(request_units: int, views: list[NodeView]) -> dict[str, int]:
-    return {v.name: score_node(v, request_units) for v in views}
+def evaluate_scores(
+    request_units: int, views: list[NodeView], policy: str = "best-fit"
+) -> dict[str, int]:
+    return {v.name: score_node(v, request_units, policy) for v in views}
 
 
-def prioritize_with_views(pod: dict, nodes: list[dict], views_fn) -> dict[str, int]:
+def prioritize_with_views(
+    pod: dict, nodes: list[dict], views_fn, policy: str = "best-fit"
+) -> dict[str, int]:
     resource = pod_resource(pod)
     if resource is None:
         return {n.get("metadata", {}).get("name", ""): 0 for n in nodes}
     request = P.mem_units_of_pod(pod, resource=resource)
-    return evaluate_scores(request, views_fn(resource, nodes))
+    return evaluate_scores(request, views_fn(resource, nodes), policy)
 
 
 def prioritize_nodes(
-    pod: dict, nodes: list[dict], pods: list[dict]
+    pod: dict, nodes: list[dict], pods: list[dict], policy: str = "best-fit"
 ) -> dict[str, int]:
-    return prioritize_with_views(pod, nodes, views_from_pods(pods))
+    return prioritize_with_views(pod, nodes, views_from_pods(pods), policy)
 
 
 def choose_chip(

@@ -204,7 +204,9 @@ class ExtenderCore:
     def prioritize(self, args: dict) -> list[dict]:
         pod = args.get("pod") or {}
         nodes = self._nodes_from_args(args)
-        scores = logic.prioritize_with_views(pod, nodes, self._node_views)
+        scores = logic.prioritize_with_views(
+            pod, nodes, self._node_views, policy=self._policy
+        )
         return [{"host": host, "score": score} for host, score in scores.items()]
 
     def bind(self, args: dict) -> dict:
@@ -362,7 +364,7 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="tpushare-scheduler-extender")
     p.add_argument("--port", type=int, default=32766)
     p.add_argument("--host", default="0.0.0.0")
-    p.add_argument("--policy", default="best-fit", choices=["first-fit", "best-fit"])
+    p.add_argument("--policy", default="best-fit", choices=["first-fit", "best-fit", "spread"])
     p.add_argument("--pod-source", default="informer", choices=["informer", "list"],
                    help="watch-backed cluster pod cache (default) or a full "
                    "LIST per webhook call")

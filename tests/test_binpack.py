@@ -89,3 +89,21 @@ def test_best_fit_reduces_fragmentation_vs_first_fit():
 def test_unknown_policy():
     with pytest.raises(ValueError):
         assign_chip(1, CAP4x32, {}, policy="worst-fit")
+
+
+def test_spread_prefers_emptiest_chip():
+    # best-fit packs onto the tight chip; spread anti-affines to the
+    # emptiest one (minimizing HBM-bandwidth contention between pods)
+    used = {0: 8, 1: 30, 2: 28}
+    assert assign_chip(2, CAP4x32, used, policy="spread") == 3  # untouched
+    assert assign_chip(2, CAP4x32, used, policy="best-fit") == 1
+
+
+def test_spread_tie_lowest_index():
+    assert assign_chip(4, {0: 8, 1: 8}, {}, policy="spread") == 0
+
+
+def test_spread_still_respects_feasibility():
+    # the emptiest chip is unhealthy -> next-emptiest healthy chip wins
+    used = {0: 16, 1: 4}
+    assert assign_chip(8, {0: 32, 1: 32}, used, unhealthy=[1], policy="spread") == 0

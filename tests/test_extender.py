@@ -64,6 +64,18 @@ def test_prioritize_prefers_tight_fit():
     assert scores["tight"] > scores["loose"]
 
 
+def test_prioritize_spread_prefers_empty_node():
+    """policy=spread must invert node scoring too — otherwise the
+    scheduler consolidates pods onto one node and only spreads chips
+    within it, defeating the bandwidth-isolation intent."""
+    nodes = [shared_node("tight", chips=1, units=8), shared_node("loose", chips=1, units=8)]
+    pods = [assigned_running_pod("r", 4, chip_idx=0, node="tight")]
+    scores = logic.prioritize_nodes(
+        make_pod("new", 4, node=""), nodes, pods, policy="spread"
+    )
+    assert scores["loose"] > scores["tight"]
+
+
 def test_choose_chip_annotations():
     node = shared_node("n", chips=2, units=8)
     pods = [assigned_running_pod("r", 7, chip_idx=0, node="n")]
