@@ -41,6 +41,9 @@ def main(argv=None) -> int:
     )
     p.add_argument("node", nargs="?", default="", help="restrict to one node")
     p.add_argument("-d", "--details", action="store_true", help="per-pod rows")
+    p.add_argument("-o", "--output", default="table", choices=["table", "json"],
+                   help="output format (json: machine-readable, for "
+                   "dashboards/automation)")
     args = p.parse_args(argv)
 
     try:
@@ -52,12 +55,75 @@ def main(argv=None) -> int:
         print(f"error: cannot reach the cluster: {e}", file=sys.stderr)
         return 1
     infos = build_all_node_infos(nodes, pods)
+    if args.output == "json":
+        sys.stdout.write(render_json(infos))
+        return 0
     if not infos:
         print("no shared-TPU nodes found (allocatable aliyun.com/tpu-mem is 0 everywhere)")
         return 0
     out = render_details(infos) if args.details else render_summary(infos)
     sys.stdout.write(out)
     return 0
+
+
+def render_json(infos: list) -> str:
+    """Machine-readable report: the same numbers the tables show,
+    including the north-star cluster utilization line."""
+    import json
+
+    from .nodeinfo import infer_unit
+
+    total = sum(n.total_units for n in infos)
+    used = sum(n.used_units for n in infos)
+
+    def node_doc(n):
+        held = set(n.core_held_chips)
+        return {
+            "name": n.name,
+            "address": n.address,
+            "total_units": n.total_units,
+            "used_units": n.used_units,
+            "pending_units": n.pending_units,
+            "chips": [
+                {
+                    "index": d.index,
+                    "total_units": d.total_units,
+                    "used_units": d.used_units,
+                    "core_held": d.index in held,
+                }
+                for d in sorted(n.devices.values(), key=lambda d: d.index)
+            ],
+            "pods": [
+                {
+                    "namespace": p.namespace,
+                    "name": p.name,
+                    "units_by_chip": {str(k): v for k, v in p.units_by_chip.items()},
+                }
+                for p in n.pods
+            ],
+            "core_holds": [
+                {
+                    "namespace": h.namespace,
+                    "name": h.name,
+                    "chips": h.chips,
+                    "requested": h.requested,
+                }
+                for h in n.core_holds
+            ],
+        }
+
+    doc = {
+        # same MiB/GiB heuristic the table headers use; without it a
+        # consumer cannot compare unit counts across clusters
+        "unit": infer_unit(infos),
+        "nodes": [node_doc(n) for n in infos],
+        "cluster": {
+            "total_units": total,
+            "used_units": used,
+            "utilization_pct": round(100.0 * used / total, 1) if total else 0.0,
+        },
+    }
+    return json.dumps(doc, indent=2) + "\n"
 
 
 if __name__ == "__main__":

@@ -211,8 +211,11 @@ def loss_fn(
 # --- training ---------------------------------------------------------------
 
 
-def make_optimizer(lr: float = 1e-4) -> optax.GradientTransformation:
-    return optax.adamw(lr, weight_decay=0.01)
+def make_optimizer(lr: float = 1e-4, **kw) -> optax.GradientTransformation:
+    """AdamW + clip (+ warmup-cosine with total_steps=...); see optim.py."""
+    from .optim import make_optimizer as _mk
+
+    return _mk(lr, **kw)
 
 
 def make_train_step(mesh: Mesh, cfg: BertConfig, optimizer=None):

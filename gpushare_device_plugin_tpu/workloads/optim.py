@@ -1,0 +1,53 @@
+"""Shared optimizer construction for the demo workloads.
+
+One place for the training hygiene every real run wants — global-norm
+gradient clipping and a warmup-cosine learning-rate schedule — so the
+per-model ``make_optimizer`` helpers stay one-liners and cannot drift.
+Pure optax composition; everything jit-traces into the train step.
+"""
+
+from __future__ import annotations
+
+import optax
+
+
+def make_optimizer(
+    lr: float = 3e-4,
+    *,
+    weight_decay: float = 0.01,
+    clip_norm: float | None = None,
+    warmup_steps: int = 0,
+    total_steps: int | None = None,
+    min_lr_ratio: float = 0.1,
+    b1: float = 0.9,
+    b2: float = 0.999,
+) -> optax.GradientTransformation:
+    """AdamW with opt-in global-norm clipping and warmup-cosine decay.
+
+    Defaults produce EXACTLY ``optax.adamw(lr, weight_decay=...)`` — same
+    hyperparameters AND the same opt-state pytree (no wrapping chain) —
+    because the opt-state structure is a checkpoint compatibility
+    contract: orbax restore of a run saved before this module existed
+    must keep working (``trainer.py``'s resume-after-eviction promise).
+
+    - ``clip_norm=1.0`` is the standard LLM clipping setting (opt-in; it
+      nests the opt state one chain level deeper).
+    - With ``total_steps``, the LR warms up linearly over ``warmup_steps``
+      then follows a cosine decay to ``lr * min_lr_ratio``; without it the
+      LR is constant. The schedule lives inside adamw's state counter, so
+      it does not change the pytree structure.
+    """
+    if total_steps is not None:
+        schedule = optax.warmup_cosine_decay_schedule(
+            init_value=0.0,
+            peak_value=lr,
+            warmup_steps=max(1, warmup_steps),
+            decay_steps=total_steps,
+            end_value=lr * min_lr_ratio,
+        )
+    else:
+        schedule = lr
+    adamw = optax.adamw(schedule, b1=b1, b2=b2, weight_decay=weight_decay)
+    if clip_norm is None:
+        return adamw
+    return optax.chain(optax.clip_by_global_norm(clip_norm), adamw)

@@ -279,8 +279,11 @@ def loss_fn(
 
 # --- training ---------------------------------------------------------------
 
-def make_optimizer(lr: float = 3e-4) -> optax.GradientTransformation:
-    return optax.adamw(lr, weight_decay=0.01)
+def make_optimizer(lr: float = 3e-4, **kw) -> optax.GradientTransformation:
+    """AdamW + clip (+ warmup-cosine with total_steps=...); see optim.py."""
+    from .optim import make_optimizer as _mk
+
+    return _mk(lr, **kw)
 
 
 def make_train_step(mesh: Mesh, cfg: TransformerConfig, optimizer=None):

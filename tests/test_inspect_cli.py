@@ -112,6 +112,40 @@ def test_cli_details_and_node_filter(api, capsys, monkeypatch):
     assert "node-b" not in out
 
 
+def test_cli_json_output(api, capsys, monkeypatch):
+    import json
+
+    api.nodes["node-a"] = shared_node("node-a")
+    api.add_pod(assigned_running_pod("r1", 16, chip_idx=0, node="node-a"))
+    monkeypatch.setattr(inspect_cli, "_client", lambda: ApiServerClient(api.url))
+
+    rc = inspect_cli.main(["-o", "json"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    doc = json.loads(out)
+    assert doc["cluster"] == {
+        "total_units": 128, "used_units": 16, "utilization_pct": 12.5,
+    }
+    node = doc["nodes"][0]
+    assert node["name"] == "node-a"
+    chip0 = node["chips"][0]
+    assert (chip0["index"], chip0["used_units"], chip0["total_units"]) == (0, 16, 32)
+    assert node["pods"][0]["name"] == "r1"
+    assert node["pods"][0]["units_by_chip"] == {"0": 16}
+
+
+def test_cli_json_empty_cluster(api, capsys, monkeypatch):
+    import json
+
+    api.add_node("plain")  # no shared nodes at all
+    monkeypatch.setattr(inspect_cli, "_client", lambda: ApiServerClient(api.url))
+    rc = inspect_cli.main(["-o", "json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert doc["nodes"] == []
+    assert doc["cluster"]["utilization_pct"] == 0.0
+
+
 def test_cli_no_shared_nodes(api, capsys, monkeypatch):
     api.add_node("plain")
     monkeypatch.setattr(inspect_cli, "_client", lambda: ApiServerClient(api.url))
