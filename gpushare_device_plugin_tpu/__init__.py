@@ -12,8 +12,10 @@ share one accelerator by memory slice):
 - ``manager``    — daemon lifecycle: socket watch, signals, health, restart
 - ``extender``   — scheduler-extender half: cluster-level binpack placement
 - ``cli``        — daemon entrypoint, kubectl-inspect-tpushare, podgetter
-- ``parallel``   — pod-side JAX runtime: Mesh from injected env, shardings
-- ``models``     — demo JAX workloads (MNIST, ResNet, BERT, LLaMA-style)
+- ``parallel``   — pod-side JAX runtime: Mesh from injected env, shardings,
+  ring + Ulysses sequence parallelism
+- ``workloads``  — demo JAX workloads (MNIST, ResNet, BERT, Llama-style
+  decoder) with training loop, checkpointing, and KV-cache generation
 - ``ops``        — Pallas TPU kernels used by the demo workloads
 """
 

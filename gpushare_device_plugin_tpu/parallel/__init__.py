@@ -24,3 +24,4 @@ from .podenv import (  # noqa: F401
 )
 from .mesh import MeshSpec, make_mesh, batch_sharding  # noqa: F401
 from .ring import ring_attention  # noqa: F401
+from .ulysses import ulysses_attention  # noqa: F401

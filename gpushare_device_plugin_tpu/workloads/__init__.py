@@ -11,6 +11,8 @@ code.
 """
 
 from .bert import BertConfig  # noqa: F401
+from .generate import generate, make_generate  # noqa: F401
+from .optim import make_optimizer  # noqa: F401
 from .resnet import ResNetConfig  # noqa: F401
 from .trainer import TrainLoopConfig, run_train_loop  # noqa: F401
-from .transformer import TransformerConfig  # noqa: F401
+from .transformer import TransformerConfig, llama3_8b  # noqa: F401

@@ -34,9 +34,16 @@ def make_optimizer(
       nests the opt state one chain level deeper).
     - With ``total_steps``, the LR warms up linearly over ``warmup_steps``
       then follows a cosine decay to ``lr * min_lr_ratio``; without it the
-      LR is constant. The schedule lives inside adamw's state counter, so
-      it does not change the pytree structure.
+      LR is constant. NOTE: a schedule also changes the opt-state pytree
+      (optax swaps ``scale()`` for ``scale_by_schedule()``, which carries
+      a step counter) — like clipping, turning it on/off across a restart
+      is a checkpoint-structure change.
     """
+    if warmup_steps and total_steps is None:
+        raise ValueError(
+            "warmup_steps requires total_steps (otherwise the LR would "
+            "silently stay constant at full peak)"
+        )
     if total_steps is not None:
         schedule = optax.warmup_cosine_decay_schedule(
             init_value=0.0,
