@@ -32,6 +32,7 @@ import jax.numpy as jnp
 
 from ..parallel.ring import grouped_attention
 from .attention import flash_or_plain
+from .quant import embed_lookup, matmul_weight
 from .transformer import TransformerConfig, _mlp_block, _project_qkv, _rms_norm
 
 KVCache = dict[str, jax.Array]  # {"k","v"}: [L, B, Smax, Hkv, Dh]; "len": []
@@ -112,7 +113,7 @@ def prefill(
         positions = jnp.arange(Tp)
     else:
         positions = jnp.clip(jnp.arange(Tp)[None, :] - pad[:, None], 0)
-    x = params["embed"].astype(dt)[tokens]
+    x = embed_lookup(params["embed"], tokens, dt)
 
     def layer(x, xs):
         lp, _ = xs
@@ -124,7 +125,7 @@ def prefill(
             )
         else:
             attn = _padded_prefill_attention(q, k, v, pad)
-        x = x + jnp.einsum("bthn,hnd->btd", attn, lp["wo"].astype(dt))
+        x = x + jnp.einsum("bthn,hnd->btd", attn, matmul_weight(lp["wo"], dt))
         return _mlp_block(x, lp, cfg), (k, v)
 
     x, (ks, vs) = jax.lax.scan(
@@ -141,7 +142,7 @@ def prefill(
         "len": jnp.int32(Tp),
     }
     x = _rms_norm(x[:, -1:], params["final_norm"])
-    logits = jnp.einsum("btd,dv->btv", x, params["out"].astype(dt))
+    logits = jnp.einsum("btd,dv->btv", x, matmul_weight(params["out"], dt))
     return logits[:, 0].astype(jnp.float32), cache
 
 
@@ -163,7 +164,7 @@ def decode_step(
         positions = pos[None]  # [1]
     else:
         positions = (pos - start)[:, None]  # [B, 1]
-    x = params["embed"].astype(dt)[token][:, None]  # [B, 1, d]
+    x = embed_lookup(params["embed"], token, dt)[:, None]  # [B, 1, d]
 
     def layer(x, xs):
         lp, k_cache, v_cache = xs
@@ -176,13 +177,13 @@ def decode_step(
             v_cache, v.astype(v_cache.dtype), (0, pos, 0, 0)
         )
         attn = _decode_attention(q, k_cache, v_cache, pos + 1, start=start)
-        x = x + jnp.einsum("bthn,hnd->btd", attn, lp["wo"].astype(dt))
+        x = x + jnp.einsum("bthn,hnd->btd", attn, matmul_weight(lp["wo"], dt))
         return _mlp_block(x, lp, cfg), (k_cache, v_cache)
 
     x, (ks, vs) = jax.lax.scan(layer, x, (params["layers"], cache["k"], cache["v"]))
     cache = {"k": ks, "v": vs, "len": pos + 1}
     x = _rms_norm(x, params["final_norm"])
-    logits = jnp.einsum("btd,dv->btv", x, params["out"].astype(dt))
+    logits = jnp.einsum("btd,dv->btv", x, matmul_weight(params["out"], dt))
     return logits[:, 0].astype(jnp.float32), cache
 
 

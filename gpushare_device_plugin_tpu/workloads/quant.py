@@ -1,0 +1,123 @@
+"""Weight-only int8 quantization for serving the decoder.
+
+The point, in this framework's terms: pods are binpacked onto *fractional
+HBM slices* (the plugin's whole reason to exist), and weight-only int8
+cuts the decoder's parameter HBM by ~4x — a model that needed a 16 GiB
+slice serves from 4-and-change, or a 2 GiB slice hosts 4x the parameters.
+On TPU the dequantize (int8 -> bf16 multiply by a per-channel scale)
+fuses into the consuming matmul's operand read under XLA, so the storage
+saving does not cost a materialized full-precision copy per step.
+
+Scheme: symmetric per-output-channel int8 (`q8 = round(w / scale)`,
+`scale = max|w| / 127` reduced over the matmul *contraction* axes, kept
+as broadcastable keepdims). Norm gains stay f32 (tiny, precision-
+critical); activations stay in ``cfg.compute_dtype`` — this is weight-only
+quantization, the standard serving recipe.
+
+Integration: :func:`quantize_decoder` maps a trained param tree to a
+quantized one; ``generate.prefill``/``decode_step`` accept either tree —
+quantized layer weights are dequantized per layer *inside* the scan body,
+so only one layer's full-precision weights exist at a time.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+Params = dict[str, Any]
+
+# Contraction axes per stacked layer weight (axis 0 is the scan's L dim):
+# reducing max|w| over them yields one scale per output channel.
+_LAYER_AXES = {
+    "wq": (1,),      # [L, d, H, Dh] contracts d
+    "wkv": (1,),     # [L, d, 2, Hkv, Dh] contracts d
+    "wo": (1, 2),    # [L, H, Dh, d] contracts (H, Dh)
+    "wi": (1,),      # [L, d, 2, F] contracts d
+    "wdown": (1,),   # [L, F, d] contracts F
+}
+_KEEP_FP = ("ln1", "ln2")
+
+
+def quantize(w: jax.Array, axes: tuple[int, ...]) -> Params:
+    """Symmetric int8 with per-channel scale over ``axes`` (keepdims)."""
+    amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=axes, keepdims=True)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q8 = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    return {"q8": q8, "scale": scale.astype(jnp.float32)}
+
+
+def is_qtensor(x: Any) -> bool:
+    return isinstance(x, dict) and set(x) == {"q8", "scale"}
+
+
+def dequantize(qt: Params, dtype=jnp.float32) -> jax.Array:
+    return (qt["q8"].astype(jnp.float32) * qt["scale"]).astype(dtype)
+
+
+def quantize_decoder(params: Params) -> Params:
+    """Quantize a trained decoder tree (``transformer.init_params`` layout).
+
+    Layer matmul weights and the embed/out projections go int8; norm gains
+    stay f32. The result is a drop-in ``params`` argument for
+    ``generate.generate``/``prefill``/``decode_step``.
+    """
+    layers = {}
+    for name, w in params["layers"].items():
+        if name in _KEEP_FP:
+            layers[name] = w
+        else:
+            layers[name] = quantize(w, _LAYER_AXES[name])
+    return {
+        # embed is a gather: per-ROW scale so a token's row dequantizes
+        # from its own scale ([V, d] reduced over d)
+        "embed": quantize(params["embed"], (1,)),
+        "layers": layers,
+        "final_norm": params["final_norm"],
+        # out projection [d, V] contracts d
+        "out": quantize(params["out"], (0,)),
+    }
+
+
+def dequantize_tree(tree: Any, dtype=jnp.float32) -> Any:
+    """Recursively replace qtensors with full-precision arrays."""
+    if is_qtensor(tree):
+        return dequantize(tree, dtype)
+    if isinstance(tree, dict):
+        return {k: dequantize_tree(v, dtype) for k, v in tree.items()}
+    return tree
+
+
+def param_bytes(tree: Any) -> int:
+    """Total bytes of array leaves (quantized trees count q8 + scales)."""
+    return sum(
+        x.size * x.dtype.itemsize
+        for x in jax.tree.leaves(tree)
+        if hasattr(x, "dtype")
+    )
+
+
+def embed_lookup(embed: Any, tokens: jax.Array, dtype) -> jax.Array:
+    """Embedding gather for fp or quantized tables.
+
+    Quantized: gather int8 rows + their scales, THEN dequantize — the full
+    table is never materialized in fp.
+    """
+    if is_qtensor(embed):
+        rows = embed["q8"][tokens].astype(jnp.float32)
+        scales = embed["scale"][tokens]
+        return (rows * scales).astype(dtype)
+    return embed.astype(dtype)[tokens]
+
+
+def matmul_weight(w: Any, dtype) -> jax.Array:
+    """Materialize a (possibly quantized) matmul operand in compute dtype.
+
+    Under jit the dequantize fuses into the consuming matmul; HBM holds
+    only the int8 copy.
+    """
+    if is_qtensor(w):
+        return dequantize(w, dtype)
+    return w.astype(dtype)

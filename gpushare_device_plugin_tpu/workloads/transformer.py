@@ -36,6 +36,7 @@ from ..parallel.mesh import batch_sharding, commit_to_mesh, prune_unshardable
 from ..parallel.ring import ring_attention
 from ..parallel.ulysses import ulysses_attention
 from .attention import flash_or_plain, ulysses_inner_attn
+from .quant import embed_lookup, matmul_weight
 
 Params = dict[str, Any]
 
@@ -180,8 +181,8 @@ def _project_qkv(h, lp, cfg: TransformerConfig, positions):
     decode's contract is token-exactness with this forward.
     """
     dt = cfg.compute_dtype
-    q = jnp.einsum("btd,dhn->bthn", h, lp["wq"].astype(dt))
-    kv = jnp.einsum("btd,dchn->btchn", h, lp["wkv"].astype(dt))
+    q = jnp.einsum("btd,dhn->bthn", h, matmul_weight(lp["wq"], dt))
+    kv = jnp.einsum("btd,dchn->btchn", h, matmul_weight(lp["wkv"], dt))
     k, v = kv[:, :, 0], kv[:, :, 1]
     return (
         _rope(q, positions, cfg.rope_theta),
@@ -195,9 +196,9 @@ def _mlp_block(x, lp, cfg: TransformerConfig):
     ``generate.py`` (same single-source rationale as ``_project_qkv``)."""
     dt = cfg.compute_dtype
     h = _rms_norm(x, lp["ln2"])
-    gate_up = jnp.einsum("btd,dcf->btcf", h, lp["wi"].astype(dt))
+    gate_up = jnp.einsum("btd,dcf->btcf", h, matmul_weight(lp["wi"], dt))
     ff = jax.nn.silu(gate_up[:, :, 0]) * gate_up[:, :, 1]
-    return x + jnp.einsum("btf,fd->btd", ff, lp["wdown"].astype(dt))
+    return x + jnp.einsum("btf,fd->btd", ff, matmul_weight(lp["wdown"], dt))
 
 
 def _layer(x, lp, cfg: TransformerConfig, positions, mesh: Mesh | None):
@@ -240,7 +241,7 @@ def _layer(x, lp, cfg: TransformerConfig, positions, mesh: Mesh | None):
         attn = flash_or_plain(
             q, k, v, attention=cfg.attention, causal=True, mesh=mesh
         )
-    x = x + jnp.einsum("bthn,hnd->btd", attn, lp["wo"].astype(dt))
+    x = x + jnp.einsum("bthn,hnd->btd", attn, matmul_weight(lp["wo"], dt))
     return _mlp_block(x, lp, cfg)
 
 
@@ -254,13 +255,15 @@ def forward(
     dt = cfg.compute_dtype
     B, S = tokens.shape
     positions = jnp.arange(S)
-    x = params["embed"].astype(dt)[tokens]
+    x = embed_lookup(params["embed"], tokens, dt)
     layer_fn = functools.partial(_layer, cfg=cfg, positions=positions, mesh=mesh)
     if cfg.remat:
         layer_fn = jax.checkpoint(layer_fn)
     x = jax.lax.scan(lambda c, lp: (layer_fn(c, lp), None), x, params["layers"])[0]
     x = _rms_norm(x, params["final_norm"])
-    return jnp.einsum("btd,dv->btv", x, params["out"].astype(dt)).astype(jnp.float32)
+    return jnp.einsum(
+        "btd,dv->btv", x, matmul_weight(params["out"], dt)
+    ).astype(jnp.float32)
 
 
 def loss_fn(
