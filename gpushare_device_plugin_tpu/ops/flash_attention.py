@@ -39,6 +39,12 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = float("-inf")
 LANES = 128
+# Grid dims: (rows, outer blocks) are independent, the innermost dim carries
+# the running accumulator — telling Mosaic so unlocks cross-iteration
+# scheduling on the parallel dims. (CompilerParams is the post-0.7 name of
+# TPUCompilerParams; accept either so the jax>=0.6 floor keeps importing.)
+_SEMANTICS = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+_SEMANTICS = _SEMANTICS(dimension_semantics=("parallel", "parallel", "arbitrary"))
 # Per-row stats (lse, delta) travel HBM as [BH, S, STAT_LANES] float32:
 # Mosaic requires the last block dim to be 128-divisible or equal to the
 # array dim, and the sublane dim 8-divisible — so a flat [BH, S] layout is
@@ -79,9 +85,12 @@ def _fwd_kernel(
 
     @pl.when(ki <= last_ki)
     def _step():
-        q = q_ref[0].astype(jnp.float32)  # [bq, D]
-        k = k_ref[0].astype(jnp.float32)  # [bk, D]
-        v = v_ref[0].astype(jnp.float32)  # [bk, D]
+        # Dots run on the inputs' native dtype: bf16 x bf16 -> f32 on the
+        # MXU accumulates in f32 anyway, so upcasting first would only cost
+        # ~4x MXU throughput for zero precision gain.
+        q = q_ref[0]  # [bq, D]
+        k = k_ref[0]  # [bk, D]
+        v = v_ref[0]  # [bk, D]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # [bq, bk]
@@ -91,11 +100,14 @@ def _fwd_kernel(
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         # Fully-masked rows keep m=-inf; shift by 0 there so exp() gives 0.
         m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
-        p = jnp.exp(s - m_safe)  # [bq, bk]
+        p = jnp.exp(s - m_safe)  # [bq, bk] f32
         alpha = jnp.exp(m_prev - m_safe)  # [bq, 1], 0 where m_prev=-inf
         l_new = alpha * l_scr[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+        # p in [0, 1] cast to the V dtype (bf16 keeps ~3 significant
+        # digits; the f32 accumulator absorbs the summation error).
         acc_scr[:] = alpha * acc_scr[:] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
@@ -150,6 +162,7 @@ def _fwd(q, k, v, *, scale, causal, block_q, block_k, heads, kv_heads, interpret
             pltpu.VMEM((block_q, LANES), jnp.float32),  # running denom
             pltpu.VMEM((block_q, D), jnp.float32),  # output accumulator
         ],
+        compiler_params=_SEMANTICS,
         interpret=interpret,
     )(q, k, v)
     return o, lse  # o: [BH, S, Dh]; lse: [BH, S, STAT_LANES] (lane-broadcast)
@@ -177,10 +190,11 @@ def _dq_kernel(
 
     @pl.when(ki <= last_ki)
     def _step():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        # Native-dtype dots (see _fwd_kernel): bf16 MXU rate, f32 accumulate.
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0][:, :1]  # [bq, 1]
         delta = delta_ref[0][:, :1]
         s = jax.lax.dot_general(
@@ -192,7 +206,7 @@ def _dq_kernel(
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = p * (dp - delta) * scale
+        ds = (p * (dp - delta) * scale).astype(k.dtype)
         dq_scr[:] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -224,10 +238,11 @@ def _dkv_kernel(
 
     @pl.when(qi >= first_qi)
     def _step():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        # Native-dtype dots (see _fwd_kernel): bf16 MXU rate, f32 accumulate.
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0][:, :1]  # [bq, 1]
         delta = delta_ref[0][:, :1]
         s = jax.lax.dot_general(
@@ -235,15 +250,16 @@ def _dkv_kernel(
         ) * scale
         if causal:
             s = _causal_mask(s, qi, ki, block_q, block_k)
-        p = jnp.exp(s - lse)  # [bq, bk]
+        p = jnp.exp(s - lse)  # [bq, bk] f32
         # dv += p^T @ do
         dv_scr[:] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = p * (dp - delta) * scale  # [bq, bk]
+        ds = (p * (dp - delta) * scale).astype(q.dtype)  # [bq, bk]
         # dk += ds^T @ q
         dk_scr[:] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
@@ -286,6 +302,7 @@ def _bwd(
         out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        compiler_params=_SEMANTICS,
         interpret=interpret,
     )(q, k, v, do, lse, delta)
 
@@ -323,6 +340,7 @@ def _bwd(
             pltpu.VMEM((block_k, D), jnp.float32),
             pltpu.VMEM((block_k, D), jnp.float32),
         ],
+        compiler_params=_SEMANTICS,
         interpret=interpret,
     )(q, k, v, do, lse, delta)
     return dq, dk, dv
@@ -370,8 +388,8 @@ def flash_attention(
     *,
     causal: bool = True,
     scale: float | None = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int | None = None,
+    block_k: int | None = None,
     interpret: bool | None = None,
 ) -> jax.Array:
     """Flash attention over ``[B, S, H, D]`` arrays (layout of
@@ -393,8 +411,27 @@ def flash_attention(
     Hkv = k.shape[2]
     if H % Hkv:
         raise ValueError(f"q heads {H} not a multiple of kv heads {Hkv}")
-    block_q = min(block_q, S)
-    block_k = min(block_k, S)
+    # Defaults (512, 1024) won the on-chip sweep at S in [1k, 8k]. The auto
+    # path shrinks them to a power-of-two divisor of S, floored at 128 (the
+    # MXU dimension — an 8-row block would be a pathological kernel), then
+    # falls back to a single whole-sequence block when S is short enough
+    # for VMEM; anything else raises. Explicit block sizes are clamped to S
+    # but otherwise honored strictly: a non-dividing choice raises rather
+    # than silently running a different configuration than the caller tuned.
+    def _fit(requested, default):
+        if requested is not None:
+            return min(requested, S)
+        b = min(default, S)
+        while b > 128 and S % b:
+            b //= 2
+        # Whole-sequence fallback: both blocks may land here, making the
+        # f32 score tile S x S — 1024 keeps that worst case at 4 MB VMEM.
+        if S % b and S <= 1024:
+            b = S
+        return b
+
+    block_q = _fit(block_q, 512)
+    block_k = _fit(block_k, 1024)
     if S % block_q or S % block_k:
         raise ValueError(
             f"sequence length {S} not divisible by blocks ({block_q}, {block_k})"

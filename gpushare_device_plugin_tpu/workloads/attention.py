@@ -56,8 +56,11 @@ def use_flash(
     if jax.default_backend() != "tpu":
         return False
     B, S, H = q.shape[0], q.shape[1], q.shape[2]
-    # Kernel blocks shrink to min(128, S); Mosaic needs the sublane (block)
-    # dim 8-divisible, so S must be a multiple of 128 or itself 8-aligned.
+    # Coupled to flash_attention's default-block auto-shrink (512/1024
+    # halved to a pow2 divisor of S, floored at 128, whole-S fallback when
+    # S <= 1024): a multiple of 128 always lands on a legal block, and a
+    # short 8-aligned S runs as one whole-sequence block (Mosaic needs the
+    # sublane dim 8-divisible or equal to the array dim).
     if (S % 128 if S > 128 else S % 8):
         return False
     if mesh is not None:
