@@ -83,20 +83,44 @@ def ring_attention_block(
     m = zero[..., 0] - jnp.inf  # [B,Hkv,g,Tq] all -inf
     l = zero[..., 0]
 
+    def accumulate(o, m, l, k, v, src):
+        """Score + online-softmax update against K/V block ``src``."""
+
+        def visible(o, m, l):
+            s = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k).astype(jnp.float32) * sc
+            if causal:
+                k_pos = src * Tk + jnp.arange(Tk)
+                mask = q_pos[:, None] >= k_pos[None, :]  # [Tq, Tk]
+                s = jnp.where(mask[None, None, None], s, -jnp.inf)
+            return _online_update(o, m, l, s, v)
+
+        if not causal:
+            return visible(o, m, l)
+        # Causal hop skip: block ``src`` is entirely in this shard's future
+        # when its first key position exceeds the last query position — the
+        # score einsum would be fully masked, pure wasted MXU work. With
+        # block-major sequence order that is ~half of all (device, hop)
+        # pairs at sp > 1, so the skip halves the ring's causal FLOPs.
+        fully_masked = src * Tk > idx * Tq + (Tq - 1)
+        return jax.lax.cond(
+            fully_masked, lambda o, m, l: (o, m, l), visible, o, m, l
+        )
+
     def body(i, carry):
         o, m, l, k, v = carry
         src = (idx - i) % n  # which global block this k/v is
-        s = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k).astype(jnp.float32) * sc
-        if causal:
-            k_pos = src * Tk + jnp.arange(Tk)
-            mask = q_pos[:, None] >= k_pos[None, :]  # [Tq, Tk]
-            s = jnp.where(mask[None, None, None], s, -jnp.inf)
-        o, m, l = _online_update(o, m, l, s, v)
+        o, m, l = accumulate(o, m, l, k, v, src)
         k = jax.lax.ppermute(k, axis_name, perm)
         v = jax.lax.ppermute(v, axis_name, perm)
         return o, m, l, k, v
 
-    o, m, l, _, _ = jax.lax.fori_loop(0, n, body, (o, m, l, k, v))
+    # n-1 hops rotate K/V; the final block is consumed in place (a ppermute
+    # pair after the last accumulation would move data nobody reads — dead
+    # ICI work). n is static (axis sizes are), so the n=1 ring traces no
+    # loop and no collective at all.
+    if n > 1:
+        o, m, l, k, v = jax.lax.fori_loop(0, n - 1, body, (o, m, l, k, v))
+    o, m, l = accumulate(o, m, l, k, v, (idx - (n - 1)) % n)
     l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows (shouldn't occur causally)
     out = (o / l[..., None]).astype(q.dtype)  # [B,Hkv,g,Tq,D]
     return jnp.transpose(out, (0, 3, 1, 2, 4)).reshape(B, Tq, H, D)

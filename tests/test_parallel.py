@@ -197,6 +197,46 @@ def test_ring_attention_gqa_grad():
     assert float(jnp.abs(gkv).sum()) > 0
 
 
+def test_ring_attention_causal_skips_masked_hops():
+    """The causal ring must guard each hop's score/update behind a
+    conditional on block visibility (fully-future K/V blocks are skipped —
+    ~half the MXU work at sp > 1), while the non-causal ring has no such
+    branch. Oracle equality for both is covered above; here we pin the
+    structure so a refactor cannot silently reintroduce the wasted work."""
+    devs = np.array(jax.devices()).reshape(8)
+    mesh = Mesh(devs, ("sp",))
+    B, S, H, D = 1, 32, 2, 8
+    q = jax.random.normal(jax.random.key(0), (B, S, H, D))
+
+    causal_jaxpr = str(jax.make_jaxpr(
+        lambda q: ring_attention(q, q, q, mesh, causal=True)
+    )(q))
+    plain_jaxpr = str(jax.make_jaxpr(
+        lambda q: ring_attention(q, q, q, mesh, causal=False)
+    )(q))
+    assert "cond" in causal_jaxpr
+    assert "cond" not in plain_jaxpr
+
+
+def test_ring_attention_single_device_axis():
+    """n=1 ring (sp axis of size 1): the rotate loop has zero trips and the
+    one block is consumed in place — no ppermute at all in the graph."""
+    devs = np.array(jax.devices()[:1]).reshape(1)
+    mesh = Mesh(devs, ("sp",))
+    B, S, H, D = 2, 16, 4, 8
+    kq, kk, kv = jax.random.split(jax.random.key(7), 3)
+    q = jax.random.normal(kq, (B, S, H, D))
+    k = jax.random.normal(kk, (B, S, H, D))
+    v = jax.random.normal(kv, (B, S, H, D))
+    expected = full_attention(q, k, v, causal=True)
+    got = ring_attention(q, k, v, mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=2e-5)
+    jaxpr = str(jax.make_jaxpr(
+        lambda q: ring_attention(q, q, q, mesh, causal=True)
+    )(q))
+    assert "ppermute" not in jaxpr
+
+
 def test_ring_attention_jit_grad():
     """Ring attention must be differentiable under jit (training path)."""
     devs = np.array(jax.devices()).reshape(8)
