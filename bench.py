@@ -170,8 +170,9 @@ def _iter_json_objects(text: str):
         i = end
 
 
-def previous_p50(repo: Path) -> tuple[float, str] | None:
-    """(p50_ms, filename) from the newest committed BENCH_r*.json, if any."""
+def previous_metric(repo: Path, field: str) -> tuple[float, str] | None:
+    """(value, filename) of ``field`` from the newest committed
+    ``BENCH_r*.json`` that carries it, if any."""
     newest: tuple[int, float, str] | None = None
     for f in repo.glob("BENCH_r*.json"):
         m = re.match(r"BENCH_r(\d+)\.json", f.name)
@@ -179,11 +180,11 @@ def previous_p50(repo: Path) -> tuple[float, str] | None:
             continue
         try:
             vals = [
-                float(parsed["value"])
+                float(parsed[field])
                 for obj in _iter_json_objects(f.read_text())
                 if isinstance(parsed := (obj.get("parsed") if isinstance(obj, dict) else None), dict)
                 and parsed.get("metric") == "allocate_p50_latency"
-                and isinstance(parsed.get("value"), (int, float))
+                and isinstance(parsed.get(field), (int, float))
             ]
             if not vals:
                 continue
@@ -193,6 +194,11 @@ def previous_p50(repo: Path) -> tuple[float, str] | None:
         if newest is None or n > newest[0]:
             newest = (n, vals[-1], f.name)
     return (newest[1], newest[2]) if newest else None
+
+
+def previous_p50(repo: Path) -> tuple[float, str] | None:
+    """(p50_ms, filename) from the newest committed BENCH_r*.json, if any."""
+    return previous_metric(repo, "value")
 
 
 def trend_guard(p50: float, repo: Path) -> str | None:
@@ -206,6 +212,23 @@ def trend_guard(p50: float, repo: Path) -> str | None:
         return (
             f"TREND GUARD: p50 {p50:.3f}ms regressed >{TREND_GUARD_PCT:.0f}% "
             f"vs {fname} ({prev_p50:.3f}ms)"
+        )
+    return None
+
+
+def utilization_guard(util_pct: float, repo: Path) -> str | None:
+    """Failure message when peak binpack utilization dropped below the
+    newest committed record's (no tolerance: the fill schedule packs the
+    host exactly, so any drop means pods the allocator used to place now
+    fail); None when >= previous or no history."""
+    prev = previous_metric(repo, "binpack_utilization_pct")
+    if prev is None:
+        return None
+    prev_util, fname = prev
+    if util_pct < prev_util:
+        return (
+            f"UTILIZATION GUARD: peak binpack utilization {util_pct:.1f}% "
+            f"dropped below {fname} ({prev_util:.1f}%)"
         )
     return None
 
@@ -296,15 +319,25 @@ def main() -> int:
         "p50_spread_ms": [round(min(trial_p50s), 3), round(max(trial_p50s), 3)],
         "p99_ms": round(p99, 3),
         "throughput_pods_s": round(statistics.median(throughputs), 1),
+        # North star #2 (BASELINE.md, reference analog display.go:231-241):
+        # peak TPU-HBM binpack utilization across trials — the fill rounds
+        # pack the host completely, so anything under 100 is an allocator
+        # regression.
+        "binpack_utilization_pct": round(max(utils), 1),
         "trials": TRIALS,
         "compute": compute,
     }
     print(json.dumps(record))
 
     if "--no-trend-guard" not in args:
-        msg = trend_guard(p50, repo)
-        if msg is not None:
-            print(msg, file=sys.stderr)
+        msgs = [
+            trend_guard(p50, repo),
+            utilization_guard(record["binpack_utilization_pct"], repo),
+        ]
+        failed = [m for m in msgs if m is not None]
+        if failed:
+            for m in failed:
+                print(m, file=sys.stderr)
             return 1
     return 0
 

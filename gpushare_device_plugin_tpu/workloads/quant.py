@@ -81,6 +81,24 @@ def quantize_decoder(params: Params) -> Params:
     }
 
 
+def cast_decoder(params: Params, dtype=jnp.bfloat16) -> Params:
+    """Serving-precision copy of a trained decoder tree: matmul weights and
+    embeddings cast to ``dtype`` (bf16 halves parameter HBM vs the f32
+    master copy), norm gains kept f32 — the bf16 counterpart of
+    :func:`quantize_decoder`, and the honest baseline to compare it
+    against (a serving stack never streams f32 masters)."""
+    layers = {
+        name: (w if name in _KEEP_FP else w.astype(dtype))
+        for name, w in params["layers"].items()
+    }
+    return {
+        "embed": params["embed"].astype(dtype),
+        "layers": layers,
+        "final_norm": params["final_norm"],
+        "out": params["out"].astype(dtype),
+    }
+
+
 def dequantize_tree(tree: Any, dtype=jnp.float32) -> Any:
     """Recursively replace qtensors with full-precision arrays."""
     if is_qtensor(tree):

@@ -18,7 +18,7 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 import bench
 
 
-def _write_record(tmp: Path, n: int, p50: float) -> None:
+def _write_record(tmp: Path, n: int, p50: float, util: float | None = None) -> None:
     """A driver-shaped BENCH_r{n}.json: {"parsed": {...}} possibly among
     other concatenated records."""
     rec = {
@@ -32,6 +32,8 @@ def _write_record(tmp: Path, n: int, p50: float) -> None:
             "vs_baseline": round(100.0 / p50, 1),
         },
     }
+    if util is not None:
+        rec["parsed"]["binpack_utilization_pct"] = util
     (tmp / f"BENCH_r{n:02d}.json").write_text(json.dumps(rec))
 
 
@@ -92,6 +94,27 @@ def test_nested_compute_record_parses(tmp_path):
     (tmp_path / "BENCH_r04.json").write_text(json.dumps(rec))
     p50, fname = bench.previous_p50(tmp_path)
     assert (p50, fname) == (1.75, "BENCH_r04.json")
+
+
+def test_utilization_guard_no_history_passes(tmp_path):
+    _write_record(tmp_path, 1, 2.0)  # record without the utilization field
+    assert bench.utilization_guard(100.0, tmp_path) is None
+    assert bench.utilization_guard(12.0, tmp_path) is None
+
+
+def test_utilization_guard_drop_fails(tmp_path):
+    _write_record(tmp_path, 1, 2.0, util=100.0)
+    msg = bench.utilization_guard(99.9, tmp_path)
+    assert msg is not None and "UTILIZATION GUARD" in msg
+    assert bench.utilization_guard(100.0, tmp_path) is None
+
+
+def test_utilization_guard_newest_record_wins(tmp_path):
+    _write_record(tmp_path, 1, 2.0, util=100.0)
+    _write_record(tmp_path, 2, 2.0, util=75.0)
+    # newest says 75 — holding 80 passes even though round 1 had 100
+    assert bench.utilization_guard(80.0, tmp_path) is None
+    assert bench.utilization_guard(74.0, tmp_path) is not None
 
 
 def test_concatenated_records_take_last(tmp_path):

@@ -105,3 +105,23 @@ def test_quantized_tree_jits(setup):
     gen = G.make_generate(cfg, max_new=3)
     out = gen(qparams, prompt, jax.random.key(0))
     assert out.shape == (2, prompt.shape[1] + 3)
+
+
+def test_cast_decoder_serving_copy(setup):
+    """bf16 serving cast: matmul weights/embeddings halve, norm gains stay
+    f32, and the cast tree drops into the same generate entry points."""
+    cfg, params, _, prompt = setup
+    bf16 = Q.cast_decoder(params)
+    assert bf16["layers"]["wq"].dtype == jnp.bfloat16
+    assert bf16["embed"].dtype == jnp.bfloat16
+    assert bf16["layers"]["ln1"].dtype == jnp.float32
+    assert bf16["final_norm"].dtype == jnp.float32
+    # ~2x smaller than the f32 masters (norm gains are negligible)
+    ratio = Q.param_bytes(params) / Q.param_bytes(bf16)
+    assert 1.9 < ratio < 2.1
+    out = G.generate(bf16, prompt, cfg, max_new=3)
+    assert out.shape == (2, prompt.shape[1] + 3)
+    # greedy first token tracks the f32 model
+    fp_out = G.generate(params, prompt, cfg, max_new=3)
+    Tp = prompt.shape[1]
+    assert (out[:, Tp] == fp_out[:, Tp]).all()

@@ -50,10 +50,12 @@ def test_ulysses_gqa_grouped():
     np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=2e-5)
 
 
-def test_ulysses_gqa_few_kv_heads_falls_back():
-    """Hkv < sp: repeats K/V to full heads inside the block (still exact)."""
-    mesh = sp_mesh()
-    B, S, H, Hkv, D = 2, 16, 8, 2, 8
+@pytest.mark.parametrize("n,Hkv,H", [(8, 2, 8), (4, 2, 8), (8, 4, 16), (8, 6, 24)])
+def test_ulysses_gqa_gcd_scatter_exact(n, Hkv, H):
+    """Hkv % sp != 0: the gcd scatter + in-group broadcast must stay exact
+    (Hkv | n, and the general gcd < min(Hkv, n) case with n=8, Hkv=6)."""
+    mesh = Mesh(np.array(jax.devices()[:n]).reshape(n), ("sp",))
+    B, S, D = 2, 2 * n, 8
     kq, kk, kv = jax.random.split(jax.random.key(2), 3)
     q = jax.random.normal(kq, (B, S, H, D), dtype=jnp.float32)
     k = jax.random.normal(kk, (B, S, Hkv, D), dtype=jnp.float32)
@@ -61,6 +63,41 @@ def test_ulysses_gqa_few_kv_heads_falls_back():
     expected = grouped_full_attention(q, k, v, causal=True)
     got = ulysses_attention(q, k, v, mesh, causal=True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=2e-5)
+
+
+def test_ulysses_gqa_never_repeats_to_full_heads(monkeypatch):
+    """Hkv=2, sp=4, H=8: the wire layout must be the gcd block-replication
+    (2x the grouped bytes), NOT a repeat to the full H query heads (4x).
+    Pinned by recording every jnp.repeat the block traces."""
+    import gpushare_device_plugin_tpu.parallel.ulysses as U
+
+    calls = []
+    real_repeat = jnp.repeat
+
+    class RecordingJnp:
+        def __getattr__(self, name):
+            if name == "repeat":
+                def repeat(x, r, axis=None, **kw):
+                    out = real_repeat(x, r, axis=axis, **kw)
+                    calls.append(out.shape)
+                    return out
+                return repeat
+            return getattr(jnp, name)
+
+    monkeypatch.setattr(U, "jnp", RecordingJnp())
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(4), ("sp",))
+    B, S, H, Hkv, D = 2, 16, 8, 2, 8
+    kq, kk, kv = jax.random.split(jax.random.key(6), 3)
+    q = jax.random.normal(kq, (B, S, H, D), dtype=jnp.float32)
+    k = jax.random.normal(kk, (B, S, Hkv, D), dtype=jnp.float32)
+    v = jax.random.normal(kv, (B, S, Hkv, D), dtype=jnp.float32)
+    expected = grouped_full_attention(q, k, v, causal=True)
+    got = ulysses_attention(q, k, v, mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=2e-5)
+    # Two repeats (k and v), each to n*hb = 4 head slots pre-a2a — never H=8.
+    assert calls, "gcd scatter path did not run"
+    for shape in calls:
+        assert shape[2] == 4, f"repeat produced {shape[2]} head blocks, want n*hb=4"
 
 
 def test_ulysses_with_tp():
