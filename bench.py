@@ -329,16 +329,18 @@ def main() -> int:
     }
     print(json.dumps(record))
 
+    # Each guard has its own opt-out: bypassing an accepted latency
+    # regression must not also waive the utilization bar (and vice versa).
+    msgs = []
     if "--no-trend-guard" not in args:
-        msgs = [
-            trend_guard(p50, repo),
-            utilization_guard(record["binpack_utilization_pct"], repo),
-        ]
-        failed = [m for m in msgs if m is not None]
-        if failed:
-            for m in failed:
-                print(m, file=sys.stderr)
-            return 1
+        msgs.append(trend_guard(p50, repo))
+    if "--no-util-guard" not in args:
+        msgs.append(utilization_guard(record["binpack_utilization_pct"], repo))
+    failed = [m for m in msgs if m is not None]
+    if failed:
+        for m in failed:
+            print(m, file=sys.stderr)
+        return 1
     return 0
 
 

@@ -60,15 +60,26 @@ def _causal_mask(s, qi, ki, block_q, block_k):
     return jnp.where(q_pos >= k_pos, s, NEG_INF)
 
 
+def _pad_mask(s, ki, block_k, start):
+    """Mask keys before this row's first real (non-pad) position."""
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    return jnp.where(k_pos >= start, s, NEG_INF)
+
+
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
 
 
 def _fwd_kernel(
-    q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-    *, scale, causal, block_q, block_k, num_kv,
+    q_ref, k_ref, v_ref, *rest,
+    scale, causal, block_q, block_k, num_kv, has_start,
 ):
+    if has_start:
+        start_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        start_ref = None
+        o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -82,8 +93,13 @@ def _fwd_kernel(
     last_ki = (
         jax.lax.div(qi * block_q + block_q - 1, block_k) if causal else num_kv - 1
     )
+    live = ki <= last_ki
+    if has_start:
+        # Left padding: KV blocks entirely before this row's first real
+        # position contribute nothing — skip their MXU work too.
+        live = live & (ki * block_k + block_k - 1 >= start_ref[0, 0, 0])
 
-    @pl.when(ki <= last_ki)
+    @pl.when(live)
     def _step():
         # Dots run on the inputs' native dtype: bf16 x bf16 -> f32 on the
         # MXU accumulates in f32 anyway, so upcasting first would only cost
@@ -96,6 +112,8 @@ def _fwd_kernel(
         ) * scale  # [bq, bk]
         if causal:
             s = _causal_mask(s, qi, ki, block_q, block_k)
+        if has_start:
+            s = _pad_mask(s, ki, block_k, start_ref[0, 0, 0])
         m_prev = m_scr[:, :1]  # [bq, 1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         # Fully-masked rows keep m=-inf; shift by 0 there so exp() gives 0.
@@ -129,7 +147,9 @@ def _kv_row(b, heads, kv_heads):
     return (b // heads) * kv_heads + (b % heads) // groups
 
 
-def _fwd(q, k, v, *, scale, causal, block_q, block_k, heads, kv_heads, interpret):
+def _fwd(
+    q, k, v, start, *, scale, causal, block_q, block_k, heads, kv_heads, interpret
+):
     BH, S, D = q.shape
     num_q = S // block_q
     num_kv = S // block_k
@@ -137,18 +157,24 @@ def _fwd(q, k, v, *, scale, causal, block_q, block_k, heads, kv_heads, interpret
         _fwd_kernel,
         scale=scale, causal=causal,
         block_q=block_q, block_k=block_k, num_kv=num_kv,
+        has_start=start is not None,
     )
     # GQA-native: K/V stay [B*kv_heads, S, D] in HBM; each query head's
     # grid row streams its group's KV blocks directly (no repeated copy).
     kv_map = lambda b, i, j: (_kv_row(b, heads, kv_heads), j, 0)  # noqa: E731
+    in_specs = [
+        pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, D), kv_map),
+        pl.BlockSpec((1, block_k, D), kv_map),
+    ]
+    operands = [q, k, v]
+    if start is not None:
+        in_specs.append(pl.BlockSpec((1, 1, STAT_LANES), lambda b, i, j: (b, 0, 0)))
+        operands.append(start)
     o, lse = pl.pallas_call(
         kernel,
         grid=(BH, num_q, num_kv),
-        in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, D), kv_map),
-            pl.BlockSpec((1, block_k, D), kv_map),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, STAT_LANES), lambda b, i, j: (b, i, 0)),
@@ -164,7 +190,7 @@ def _fwd(q, k, v, *, scale, causal, block_q, block_k, heads, kv_heads, interpret
         ],
         compiler_params=_SEMANTICS,
         interpret=interpret,
-    )(q, k, v)
+    )(*operands)
     return o, lse  # o: [BH, S, Dh]; lse: [BH, S, STAT_LANES] (lane-broadcast)
 
 
@@ -174,9 +200,14 @@ def _fwd(q, k, v, *, scale, causal, block_q, block_k, heads, kv_heads, interpret
 
 
 def _dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
-    *, scale, causal, block_q, block_k, num_kv,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+    scale, causal, block_q, block_k, num_kv, has_start,
 ):
+    if has_start:
+        start_ref, dq_ref, dq_scr = rest
+    else:
+        start_ref = None
+        dq_ref, dq_scr = rest
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -187,8 +218,11 @@ def _dq_kernel(
     last_ki = (
         jax.lax.div(qi * block_q + block_q - 1, block_k) if causal else num_kv - 1
     )
+    live = ki <= last_ki
+    if has_start:
+        live = live & (ki * block_k + block_k - 1 >= start_ref[0, 0, 0])
 
-    @pl.when(ki <= last_ki)
+    @pl.when(live)
     def _step():
         # Native-dtype dots (see _fwd_kernel): bf16 MXU rate, f32 accumulate.
         q = q_ref[0]
@@ -202,6 +236,11 @@ def _dq_kernel(
         ) * scale
         if causal:
             s = _causal_mask(s, qi, ki, block_q, block_k)
+        if has_start:
+            s = _pad_mask(s, ki, block_k, start_ref[0, 0, 0])
+            # Rows fully inside the pad have lse=-inf; shift by 0 there so
+            # exp(-inf - 0) gives the 0 the mask means (not -inf+inf=NaN).
+            lse = jnp.where(jnp.isneginf(lse), 0.0, lse)
         p = jnp.exp(s - lse)  # [bq, bk]; exp(-inf)=0 handles the mask
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -217,10 +256,14 @@ def _dq_kernel(
 
 
 def _dkv_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    dk_scr, dv_scr,
-    *, scale, causal, block_q, block_k, num_q,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+    scale, causal, block_q, block_k, num_q, has_start,
 ):
+    if has_start:
+        start_ref, dk_ref, dv_ref, dk_scr, dv_scr = rest
+    else:
+        start_ref = None
+        dk_ref, dv_ref, dk_scr, dv_scr = rest
     ki = pl.program_id(1)
     # Innermost dim fuses (group member, q block): dK/dV of one KV head sum
     # contributions from every query head in its group, so the whole group
@@ -235,8 +278,13 @@ def _dkv_kernel(
 
     # First Q block that sees this KV block under causality.
     first_qi = jax.lax.div(ki * block_k, block_q) if causal else 0
+    live = qi >= first_qi
+    if has_start:
+        # KV blocks wholly inside the pad produce zero dK/dV: skip their
+        # MXU work (scratch init at gq==0 is unconditional, so safe).
+        live = live & (ki * block_k + block_k - 1 >= start_ref[0, 0, 0])
 
-    @pl.when(qi >= first_qi)
+    @pl.when(live)
     def _step():
         # Native-dtype dots (see _fwd_kernel): bf16 MXU rate, f32 accumulate.
         q = q_ref[0]
@@ -250,6 +298,9 @@ def _dkv_kernel(
         ) * scale
         if causal:
             s = _causal_mask(s, qi, ki, block_q, block_k)
+        if has_start:
+            s = _pad_mask(s, ki, block_k, start_ref[0, 0, 0])
+            lse = jnp.where(jnp.isneginf(lse), 0.0, lse)  # see _dq_kernel
         p = jnp.exp(s - lse)  # [bq, bk] f32
         # dv += p^T @ do
         dv_scr[:] += jax.lax.dot_general(
@@ -272,8 +323,8 @@ def _dkv_kernel(
 
 
 def _bwd(
-    q, k, v, o, lse, do, *, scale, causal, block_q, block_k, heads, kv_heads,
-    interpret,
+    q, k, v, o, lse, do, start, *, scale, causal, block_q, block_k, heads,
+    kv_heads, interpret,
 ):
     BH, S, D = q.shape
     BKV = k.shape[0]
@@ -285,26 +336,34 @@ def _bwd(
     delta = jnp.broadcast_to(delta_row[..., None], (BH, S, STAT_LANES))
 
     kv_map = lambda b, i, j: (_kv_row(b, heads, kv_heads), j, 0)  # noqa: E731
+    dq_in_specs = [
+        pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, D), kv_map),
+        pl.BlockSpec((1, block_k, D), kv_map),
+        pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_q, STAT_LANES), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_q, STAT_LANES), lambda b, i, j: (b, i, 0)),
+    ]
+    dq_operands = [q, k, v, do, lse, delta]
+    if start is not None:
+        dq_in_specs.append(
+            pl.BlockSpec((1, 1, STAT_LANES), lambda b, i, j: (b, 0, 0))
+        )
+        dq_operands.append(start)
     dq = pl.pallas_call(
         functools.partial(
             _dq_kernel, scale=scale, causal=causal,
             block_q=block_q, block_k=block_k, num_kv=num_kv,
+            has_start=start is not None,
         ),
         grid=(BH, num_q, num_kv),
-        in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, D), kv_map),
-            pl.BlockSpec((1, block_k, D), kv_map),
-            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q, STAT_LANES), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q, STAT_LANES), lambda b, i, j: (b, i, 0)),
-        ],
+        in_specs=dq_in_specs,
         out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
         compiler_params=_SEMANTICS,
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(*dq_operands)
 
     # dK/dV grid runs over KV heads; the innermost dim is (group member,
     # q block) so one KV head's accumulator sums its whole query group.
@@ -314,20 +373,33 @@ def _bwd(
         row = (b // kv_heads) * heads + (b % kv_heads) * groups + gq // num_q
         return (row, gq % num_q, 0)
 
+    dkv_in_specs = [
+        pl.BlockSpec((1, block_q, D), q_map),
+        pl.BlockSpec((1, block_k, D), lambda b, j, gq: (b, j, 0)),
+        pl.BlockSpec((1, block_k, D), lambda b, j, gq: (b, j, 0)),
+        pl.BlockSpec((1, block_q, D), q_map),
+        pl.BlockSpec((1, block_q, STAT_LANES), q_map),
+        pl.BlockSpec((1, block_q, STAT_LANES), q_map),
+    ]
+    dkv_operands = [q, k, v, do, lse, delta]
+    if start is not None:
+        # start is per batch row (constant over heads): any q-side row of
+        # this KV row's batch reads the same value.
+        dkv_in_specs.append(
+            pl.BlockSpec(
+                (1, 1, STAT_LANES),
+                lambda b, j, gq: ((b // kv_heads) * heads, 0, 0),
+            )
+        )
+        dkv_operands.append(start)
     dk, dv = pl.pallas_call(
         functools.partial(
             _dkv_kernel, scale=scale, causal=causal,
             block_q=block_q, block_k=block_k, num_q=num_q,
+            has_start=start is not None,
         ),
         grid=(BKV, num_kv, groups * num_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, D), q_map),
-            pl.BlockSpec((1, block_k, D), lambda b, j, gq: (b, j, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, j, gq: (b, j, 0)),
-            pl.BlockSpec((1, block_q, D), q_map),
-            pl.BlockSpec((1, block_q, STAT_LANES), q_map),
-            pl.BlockSpec((1, block_q, STAT_LANES), q_map),
-        ],
+        in_specs=dkv_in_specs,
         out_specs=[
             pl.BlockSpec((1, block_k, D), lambda b, j, gq: (b, j, 0)),
             pl.BlockSpec((1, block_k, D), lambda b, j, gq: (b, j, 0)),
@@ -342,7 +414,7 @@ def _bwd(
         ],
         compiler_params=_SEMANTICS,
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(*dkv_operands)
     return dq, dk, dv
 
 
@@ -351,31 +423,39 @@ def _bwd(
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
-def _flash(q, k, v, scale, causal, block_q, block_k, heads, kv_heads, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10))
+def _flash(q, k, v, start, scale, causal, block_q, block_k, heads, kv_heads, interpret):
     o, _ = _fwd(
-        q, k, v, scale=scale, causal=causal, block_q=block_q, block_k=block_k,
-        heads=heads, kv_heads=kv_heads, interpret=interpret,
+        q, k, v, start, scale=scale, causal=causal, block_q=block_q,
+        block_k=block_k, heads=heads, kv_heads=kv_heads, interpret=interpret,
     )
     return o
 
 
-def _flash_fwd(q, k, v, scale, causal, block_q, block_k, heads, kv_heads, interpret):
+def _flash_fwd(
+    q, k, v, start, scale, causal, block_q, block_k, heads, kv_heads, interpret
+):
     o, lse = _fwd(
-        q, k, v, scale=scale, causal=causal, block_q=block_q, block_k=block_k,
-        heads=heads, kv_heads=kv_heads, interpret=interpret,
+        q, k, v, start, scale=scale, causal=causal, block_q=block_q,
+        block_k=block_k, heads=heads, kv_heads=kv_heads, interpret=interpret,
     )
-    return o, (q, k, v, o, lse)
+    return o, (q, k, v, o, lse, start)
 
 
 def _flash_bwd(scale, causal, block_q, block_k, heads, kv_heads, interpret, res, do):
-    q, k, v, o, lse = res
+    import numpy as np
+
+    q, k, v, o, lse, start = res
     dq, dk, dv = _bwd(
-        q, k, v, o, lse, do, scale=scale, causal=causal,
+        q, k, v, o, lse, do, start, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k, heads=heads, kv_heads=kv_heads,
         interpret=interpret,
     )
-    return dq, dk, dv
+    # start is integer data (pad counts): its cotangent type is float0.
+    dstart = (
+        None if start is None else np.zeros(start.shape, jax.dtypes.float0)
+    )
+    return dq, dk, dv, dstart
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -390,6 +470,7 @@ def flash_attention(
     scale: float | None = None,
     block_q: int | None = None,
     block_k: int | None = None,
+    start: jax.Array | None = None,
     interpret: bool | None = None,
 ) -> jax.Array:
     """Flash attention over ``[B, S, H, D]`` arrays (layout of
@@ -401,6 +482,13 @@ def flash_attention(
     no repeated copies in HBM, 1/g the KV bandwidth — and dK/dV accumulate
     each query group inside the kernel before a single writeback.
 
+    ``start`` ([B] int32 leading-pad counts) masks each batch row's keys at
+    positions ``< start[b]`` — LEFT-padded variable-length batches (the
+    serving prefill layout, ``workloads.generate``) stay on the kernel
+    instead of falling back to materialized-score attention. Rows whose
+    queries sit entirely in the pad region produce zeros, never NaN, and
+    KV blocks wholly inside the pad are skipped like causal future blocks.
+
     ``interpret=None`` autodetects: compiled Mosaic on TPU, Pallas
     interpreter elsewhere (CPU tests, the virtual-device mesh harness).
     Sequence length must be divisible by the (auto-shrunk) block sizes.
@@ -411,13 +499,17 @@ def flash_attention(
     Hkv = k.shape[2]
     if H % Hkv:
         raise ValueError(f"q heads {H} not a multiple of kv heads {Hkv}")
-    # Defaults (512, 1024) won the on-chip sweep at S in [1k, 8k]. The auto
-    # path shrinks them to a power-of-two divisor of S, floored at 128 (the
-    # MXU dimension — an 8-row block would be a pathological kernel), then
-    # falls back to a single whole-sequence block when S is short enough
-    # for VMEM; anything else raises. Explicit block sizes are clamped to S
-    # but otherwise honored strictly: a non-dividing choice raises rather
-    # than silently running a different configuration than the caller tuned.
+    # Defaults (512, 1024) won the on-chip sweep at S in [1k, 8k] for
+    # Dh <= 128; larger head dims halve both (the f32 score/prob tiles
+    # plus double-buffered KV blocks scale with Dh and would crowd the
+    # ~16 MB VMEM budget). The auto path shrinks the default to a
+    # power-of-two divisor of S, floored at 128 (the MXU dimension — an
+    # 8-row block would be a pathological kernel), then falls back to a
+    # single whole-sequence block when S is short enough for VMEM;
+    # anything else raises. Explicit block sizes are clamped to S but
+    # otherwise honored strictly: a non-dividing choice raises rather
+    # than silently running a different configuration than the caller
+    # tuned.
     def _fit(requested, default):
         if requested is not None:
             return min(requested, S)
@@ -430,8 +522,8 @@ def flash_attention(
             b = S
         return b
 
-    block_q = _fit(block_q, 512)
-    block_k = _fit(block_k, 1024)
+    block_q = _fit(block_q, 512 if D <= 128 else 256)
+    block_k = _fit(block_k, 1024 if D <= 128 else 512)
     if S % block_q or S % block_k:
         raise ValueError(
             f"sequence length {S} not divisible by blocks ({block_q}, {block_k})"
@@ -442,7 +534,19 @@ def flash_attention(
         h = x.shape[2]
         return x.transpose(0, 2, 1, 3).reshape(B * h, S, x.shape[-1])
 
+    start_bh = None
+    if start is not None:
+        if start.shape != (B,):
+            raise ValueError(f"start must be [{B}] (one pad count per row)")
+        # One row per folded (batch, head) pair, lane-broadcast to the
+        # minimum legal f32/int32 tile (see STAT_LANES).
+        start_bh = jnp.broadcast_to(
+            jnp.repeat(start.astype(jnp.int32), H)[:, None, None],
+            (B * H, 1, STAT_LANES),
+        )
+
     o = _flash(
-        fold(q), fold(k), fold(v), sc, causal, block_q, block_k, H, Hkv, interpret
+        fold(q), fold(k), fold(v), start_bh, sc, causal, block_q, block_k,
+        H, Hkv, interpret,
     )
     return o.reshape(B, H, S, D).transpose(0, 2, 1, 3)

@@ -30,8 +30,9 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
+from ..ops import flash_attention
 from ..parallel.ring import grouped_attention
-from .attention import flash_or_plain
+from .attention import flash_or_plain, use_flash
 from .quant import embed_lookup, matmul_weight
 from .transformer import TransformerConfig, _mlp_block, _project_qkv, _rms_norm
 
@@ -73,15 +74,19 @@ def _decode_attention(q, k_cache, v_cache, cur_len, start=None):
     return out.reshape(B, 1, H, Dh)
 
 
-def _padded_prefill_attention(q, k, v, pad):
+def _padded_prefill_attention(q, k, v, pad, attention: str = "auto"):
     """Prompt self-attention with per-row left padding.
 
     q: [B, T, H, Dh]; k, v: [B, T, Hkv, Dh]; pad: [B] leading pad counts.
-    Causal mask plus exclusion of each row's pad keys, delegated to the
-    shared grouped-attention math. Plain path by design (the flash kernel
-    has no per-row mask input); prefill happens once per sequence, decode
-    dominates serving cost.
+    On TPU this stays on the flash kernel via its ``start`` input (pad
+    keys masked in-kernel, O(T·Dh) HBM) — a serving-realistic 4-8k prompt
+    through materialized-score attention would be exactly the quadratic
+    HBM traffic the kernel exists to avoid. Off-TPU (or misfit shapes)
+    it delegates to the shared grouped-attention math with an explicit
+    key mask.
     """
+    if use_flash(attention, q, None, kv_heads=k.shape[2]):
+        return flash_attention(q, k, v, causal=True, start=pad)
     T = q.shape[1]
     live = jnp.arange(T)[None, :] >= pad[:, None]  # [B, Tk]
     return grouped_attention(
@@ -124,7 +129,7 @@ def prefill(
                 q, k, v, attention=cfg.attention, causal=True, mesh=None
             )
         else:
-            attn = _padded_prefill_attention(q, k, v, pad)
+            attn = _padded_prefill_attention(q, k, v, pad, cfg.attention)
         x = x + jnp.einsum("bthn,hnd->btd", attn, matmul_weight(lp["wo"], dt))
         return _mlp_block(x, lp, cfg), (k, v)
 

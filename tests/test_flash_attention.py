@@ -141,3 +141,156 @@ def test_gqa_rejects_non_multiple_heads():
     q, k, v = make_gqa_qkv(jax.random.key(5), B=1, S=64, H=4, Hkv=3, D=32)
     with pytest.raises(ValueError, match="multiple"):
         flash_attention(q, k, v, interpret=True)
+
+
+# --- default (auto) block selection ----------------------------------------
+
+def test_default_blocks_shrink_loop():
+    """S=256 with no explicit blocks: the 512/1024 defaults must auto-shrink
+    to legal divisors and stay exact (the shrink loop was previously only
+    covered via explicit symmetric blocks)."""
+    q, k, v = make_qkv(jax.random.key(10), B=1, S=256, H=2, D=64)
+    out = flash_attention(q, k, v, causal=True, interpret=True)
+    ref = full_attention(q, k, v, causal=True)
+    assert jnp.allclose(out, ref, atol=2e-5)
+
+
+def test_default_blocks_whole_seq_fallback():
+    """S=520 (8-aligned, not 128-aligned): defaults fall back to one
+    whole-sequence block; also covers use_flash's relaxed short-S gate."""
+    from gpushare_device_plugin_tpu.workloads.attention import use_flash
+
+    q, k, v = make_qkv(jax.random.key(11), B=1, S=520, H=2, D=32)
+    out = flash_attention(q, k, v, causal=True, interpret=True)
+    ref = full_attention(q, k, v, causal=True)
+    assert jnp.allclose(out, ref, atol=2e-5)
+    assert use_flash("flash", q, None)
+
+
+def test_asymmetric_blocks_causal_grad():
+    """block_k > block_q with causal masking through the backward kernels
+    (the production default shape 512/1024 is asymmetric exactly like
+    this; gradients previously only ran symmetric blocks)."""
+    q, k, v = make_qkv(jax.random.key(12), B=1, S=256, H=2, D=32)
+
+    def loss_flash(q, k, v):
+        o = flash_attention(
+            q, k, v, causal=True, block_q=64, block_k=128, interpret=True
+        )
+        return jnp.sum(jnp.sin(o))
+
+    def loss_ref(q, k, v):
+        return jnp.sum(jnp.sin(full_attention(q, k, v, causal=True)))
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        assert jnp.allclose(a, b, atol=5e-5), float(jnp.abs(a - b).max())
+
+
+def test_bf16_gradients():
+    """bf16 inputs through the backward kernels (ds/p cast paths): grads
+    must come back bf16 and track the f32 oracle to bf16 tolerance."""
+    q, k, v = make_qkv(jax.random.key(13), B=1, S=128, H=2, D=32, dtype=jnp.bfloat16)
+
+    def loss_flash(q, k, v):
+        o = flash_attention(q, k, v, causal=True, block_q=64, block_k=64, interpret=True)
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(full_attention(q, k, v, causal=True).astype(jnp.float32) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        assert a.dtype == jnp.bfloat16
+        assert jnp.allclose(
+            a.astype(jnp.float32), b.astype(jnp.float32), atol=5e-2
+        ), float(jnp.abs(a.astype(jnp.float32) - b.astype(jnp.float32)).max())
+
+
+# --- per-row start (left-pad) masking --------------------------------------
+
+def pad_oracle(q, k, v, pad, causal=True):
+    from gpushare_device_plugin_tpu.parallel.ring import grouped_attention
+
+    B, T = q.shape[0], q.shape[1]
+    live = jnp.arange(T)[None, :] >= pad[:, None]
+    return grouped_attention(
+        q, k, v, causal=causal, mask=jnp.broadcast_to(live[:, None, :], (B, T, T))
+    )
+
+
+def test_start_mask_forward():
+    """Per-row left padding via the kernel's start input, including a row
+    with zero pad, a mid-block pad, and a pad spanning whole KV blocks."""
+    q, k, v = make_qkv(jax.random.key(14), B=3, S=256, H=2, D=32)
+    pad = jnp.array([0, 7, 200], jnp.int32)
+    out = flash_attention(
+        q, k, v, causal=True, block_q=64, block_k=64, start=pad, interpret=True
+    )
+    ref = pad_oracle(q, k, v, pad)
+    assert jnp.allclose(out, ref, atol=2e-5), float(jnp.abs(out - ref).max())
+
+
+def test_start_mask_gqa_forward():
+    q, k, v = make_gqa_qkv(jax.random.key(15), B=2, S=128, H=4, Hkv=2, D=32)
+    pad = jnp.array([5, 64], jnp.int32)
+    out = flash_attention(
+        q, k, v, causal=True, block_q=64, block_k=64, start=pad, interpret=True
+    )
+    ref = pad_oracle(q, k, v, pad)
+    assert jnp.allclose(out, ref, atol=2e-5), float(jnp.abs(out - ref).max())
+
+
+def test_start_mask_gradients():
+    """Gradients through the pad mask: pad rows contribute exact zeros
+    (never NaN — fully-masked rows make lse=-inf in the residuals)."""
+    q, k, v = make_qkv(jax.random.key(16), B=2, S=128, H=2, D=32)
+    pad = jnp.array([0, 96], jnp.int32)
+
+    def loss_flash(q, k, v):
+        o = flash_attention(
+            q, k, v, causal=True, block_q=64, block_k=64, start=pad, interpret=True
+        )
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(pad_oracle(q, k, v, pad).astype(jnp.float32) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        assert bool(jnp.isfinite(a).all())
+        assert jnp.allclose(a, b, atol=5e-5), float(jnp.abs(a - b).max())
+
+
+def test_start_mask_under_jit():
+    q, k, v = make_qkv(jax.random.key(17), B=2, S=128, H=2, D=32)
+    pad = jnp.array([3, 50], jnp.int32)
+    f = jax.jit(
+        lambda q, k, v, pad: flash_attention(
+            q, k, v, causal=True, start=pad, interpret=True
+        )
+    )
+    out = f(q, k, v, pad)
+    ref = pad_oracle(q, k, v, pad)
+    assert jnp.allclose(out, ref, atol=2e-5)
+
+
+def test_start_mask_bad_shape_raises():
+    q, k, v = make_qkv(jax.random.key(18), B=2, S=128, H=2, D=32)
+    with pytest.raises(ValueError, match="start"):
+        flash_attention(
+            q, k, v, causal=True, start=jnp.zeros((3,), jnp.int32), interpret=True
+        )
+
+
+def test_large_head_dim_default_blocks():
+    """Dh > 128 halves the default blocks (VMEM budget: f32 score/prob
+    tiles and double-buffered KV blocks scale with Dh); numerics stay
+    exact through the shrunk configuration."""
+    q, k, v = make_qkv(jax.random.key(19), B=1, S=512, H=2, D=256)
+    out = flash_attention(q, k, v, causal=True, interpret=True)
+    ref = full_attention(q, k, v, causal=True)
+    assert jnp.allclose(out, ref, atol=2e-5), float(jnp.abs(out - ref).max())

@@ -146,3 +146,28 @@ def test_gqa_cache_shape(setup):
     cache = G.init_cache(cfg, 2, 16)
     assert cache["k"].shape == (cfg.n_layers, 2, 16, cfg.kv_heads, cfg.head_dim)
     assert cfg.kv_heads < cfg.n_heads
+
+
+def test_padded_prefill_flash_path_matches_plain(setup):
+    """attention="flash" routes padded prefill through the Pallas kernel's
+    start input (interpret mode here); logits and cache must match the
+    plain masked-attention path exactly — the quadratic fallback remains
+    only for non-TPU/misfit shapes."""
+    cfg, params, _ = setup
+    cfg_flash = _cfg(attention="flash")
+    Tp = 16  # 8-aligned: whole-seq kernel block
+    prompt = demo_batch(jax.random.key(5), 2, Tp, cfg.vocab)
+    pad = jnp.array([0, 6], jnp.int32)
+    cache_a = G.init_cache(cfg, 2, Tp + 4)
+    cache_b = G.init_cache(cfg_flash, 2, Tp + 4)
+    lo_plain, ca = G.prefill(params, prompt, cache_a, cfg, pad=pad)
+    lo_flash, cb = G.prefill(params, prompt, cache_b, cfg_flash, pad=pad)
+    assert jnp.allclose(lo_plain, lo_flash, atol=2e-5), float(
+        jnp.abs(lo_plain - lo_flash).max()
+    )
+    assert jnp.allclose(ca["k"], cb["k"], atol=2e-5)
+    # and the full padded generate stays on rails through the kernel path
+    lens = jnp.array([Tp, Tp - 6], jnp.int32)
+    out_plain = G.generate(params, prompt, cfg, max_new=3, prompt_lens=lens)
+    out_flash = G.generate(params, prompt, cfg_flash, max_new=3, prompt_lens=lens)
+    assert (out_plain == out_flash).all()
