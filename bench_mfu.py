@@ -277,6 +277,8 @@ def _matmul_flops_per_step(cfg, batch: int, seq: int) -> tuple[float, int]:
 
 
 def bench_train(report: dict, smoke: bool = False) -> None:
+    import dataclasses
+
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -289,24 +291,45 @@ def bench_train(report: dict, smoke: bool = False) -> None:
         make_train_step,
     )
 
-    cfg = _bench_cfg(smoke)
+    base_cfg = _bench_cfg(smoke)
     batch, seq = (2, 64) if smoke else (8, 2048)
     mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1, 1, 1), ("dp", "fsdp", "tp", "sp"))
 
-    flops_per_step, n_params = _matmul_flops_per_step(cfg, batch, seq)
+    flops_per_step, n_params = _matmul_flops_per_step(base_cfg, batch, seq)
     print(
         f"train: {n_params / 1e6:.0f}M params, {batch}x{seq} tokens/step, "
         f"{flops_per_step / 1e12:.1f} model TFLOPs/step",
         file=sys.stderr,
     )
 
-    params, opt_state = init_train_state(jax.random.key(0), mesh, cfg)
-    step = make_train_step(mesh, cfg)
-    tokens = demo_batch(jax.random.key(1), batch, seq, cfg.vocab)
-
-    for _ in range(3):  # compile + warmup
-        params, opt_state, loss = step(params, opt_state, tokens)
-    loss = float(loss)  # host fetch: forces the warmup chain for real
+    # Remat ladder: "dots" saves matmul outputs so the backward does no
+    # re-forward matmuls (~4/3 fewer FLOPs than "full" remat — the single
+    # biggest MFU lever at this size); fall back to "full" only if the
+    # saved activations blow HBM.
+    last_oom = None
+    for policy in ("dots", "full"):
+        cfg = dataclasses.replace(base_cfg, remat_policy=policy)
+        try:
+            params, opt_state = init_train_state(jax.random.key(0), mesh, cfg)
+            step = make_train_step(mesh, cfg)
+            tokens = demo_batch(jax.random.key(1), batch, seq, cfg.vocab)
+            for _ in range(3):  # compile + warmup
+                params, opt_state, loss = step(params, opt_state, tokens)
+            loss = float(loss)  # host fetch: forces the warmup chain for real
+            break
+        except Exception as e:  # noqa: BLE001 — OOM class varies by runtime
+            msg = str(e)
+            if policy == "dots" and (
+                "RESOURCE_EXHAUSTED" in msg or "out of memory" in msg.lower()
+            ):
+                print(
+                    f"train: remat_policy=dots OOM'd, retrying full ({msg[:120]})",
+                    file=sys.stderr,
+                )
+                last_oom = msg
+                params = opt_state = None
+                continue
+            raise
     if not np.isfinite(loss):
         raise AssertionError(f"non-finite warmup loss {loss}")
 
@@ -338,6 +361,10 @@ def bench_train(report: dict, smoke: bool = False) -> None:
         )
     report["train"] = {
         "params_m": round(n_params / 1e6, 1),
+        "remat_policy": cfg.remat_policy,
+        # Distinguishes "dots never attempted" from "dots OOM'd" in the
+        # committed record.
+        **({"remat_fallback_reason": last_oom[:200]} if last_oom else {}),
         "batch": batch, "seq": seq, "steps_timed": n_steps,
         "step_ms": round(step_s * 1e3, 1),
         "step_ms_min": round(min(times) * 1e3, 1),
@@ -496,6 +523,48 @@ def bench_serve(report: dict, smoke: bool = False) -> None:
     report["serve"] = serve
 
 
+def bench_sweep(report: dict, smoke: bool = False) -> None:
+    """Flash block-size sweep (opt-in via --sweep): honest-timed wall per
+    (block_q, block_k) at the bench shapes, to re-tune the defaults that
+    r03 chose with broken timing. Not part of the default bench run."""
+    import jax
+    import jax.numpy as jnp
+
+    from gpushare_device_plugin_tpu.ops import flash_attention
+
+    points = [(4, 16, 16, 2048, 128), (2, 16, 4, 4096, 128), (1, 8, 8, 8192, 64)]
+    combos = [(256, 256), (256, 512), (512, 512), (512, 1024), (1024, 1024)]
+    iters = 20
+    if smoke:
+        points = [(1, 4, 2, 256, 32)]
+        combos = [(128, 128), (128, 256)]
+        iters = 2
+    interpret = None if not smoke else True
+    rows = []
+    for B, H, Hkv, S, Dh in points:
+        kq, kk, kv = jax.random.split(jax.random.key(0), 3)
+        q = jax.random.normal(kq, (B, S, H, Dh), jnp.bfloat16)
+        k = jax.random.normal(kk, (B, S, Hkv, Dh), jnp.bfloat16)
+        v = jax.random.normal(kv, (B, S, Hkv, Dh), jnp.bfloat16)
+        for bq, bk in combos:
+            if S % bq or S % bk:
+                continue
+            fn = jax.jit(
+                lambda q, k, v, bq=bq, bk=bk: flash_attention(
+                    q, k, v, causal=True, block_q=bq, block_k=bk,
+                    interpret=interpret,
+                )
+            )
+            _, t, _ = _timeit(fn, q, k, v, iters=iters, synced=False)
+            row = {
+                "B": B, "H": H, "Hkv": Hkv, "S": S, "Dh": Dh,
+                "block_q": bq, "block_k": bk, "ms": round(t * 1e3, 3),
+            }
+            rows.append(row)
+            print(f"sweep {row}", file=sys.stderr)
+    report["sweep"] = rows
+
+
 def main(argv: list[str] | None = None) -> int:
     args = argv if argv is not None else sys.argv[1:]
     # --smoke: CPU path-check with tiny shapes + the interpreter kernel, so
@@ -542,12 +611,15 @@ def main(argv: list[str] | None = None) -> int:
     # flash section both lower Mosaic), so at least one number survives a
     # kernel-compile hang.
     print(json.dumps(report), flush=True)
-    for name, fn in (
+    sections = [
         ("decode", bench_decode),
         ("train", bench_train),
         ("flash", bench_flash),
         ("serve", bench_serve),
-    ):
+    ]
+    if "--sweep" in args:
+        sections.append(("sweep", bench_sweep))
+    for name, fn in sections:
         fn(report, smoke=smoke)
         report["sections"].append(name)
         print(json.dumps(report), flush=True)

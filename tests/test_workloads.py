@@ -190,3 +190,52 @@ def test_flash_attention_loss_matches_plain():
     mesh = make_mesh(MeshSpec(dp=2, fsdp=2, tp=2))
     sharded = loss_fn(shard_params(params, mesh, cfg_flash), tokens, cfg_flash, mesh)
     np.testing.assert_allclose(float(sharded), float(plain), rtol=1e-5)
+
+
+def test_remat_policy_dots_matches_full():
+    """remat_policy="dots" changes what the backward saves, never the
+    math: loss and grads must equal full remat (and no-remat) exactly."""
+    import dataclasses
+
+    params = init_params(jax.random.key(0), TINY)
+    tokens = demo_batch(jax.random.key(1), 2, 16, TINY.vocab)
+    cfgs = {
+        "full": dataclasses.replace(TINY, remat=True, remat_policy="full"),
+        "dots": dataclasses.replace(TINY, remat=True, remat_policy="dots"),
+        "none": dataclasses.replace(TINY, remat=False),
+    }
+    losses = {}
+    grads = {}
+    for name, cfg in cfgs.items():
+        l, g = jax.value_and_grad(loss_fn)(params, tokens, cfg)
+        losses[name] = float(l)
+        grads[name] = g
+    assert losses["dots"] == pytest.approx(losses["full"], abs=1e-6)
+    assert losses["none"] == pytest.approx(losses["full"], abs=1e-6)
+    for a, b in zip(jax.tree.leaves(grads["dots"]), jax.tree.leaves(grads["full"])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_remat_policy_dots_trains_on_mesh():
+    import dataclasses
+
+    cfg = dataclasses.replace(TINY, remat_policy="dots")
+    mesh = make_mesh(MeshSpec(dp=2, fsdp=2, tp=2))
+    params, opt_state = init_train_state(jax.random.key(0), mesh, cfg)
+    step = make_train_step(mesh, cfg)
+    tokens = demo_batch(jax.random.key(1), 4, 16, cfg.vocab)
+    first = None
+    for _ in range(4):
+        params, opt_state, loss = step(params, opt_state, tokens)
+        first = float(loss) if first is None else first
+    assert float(loss) < first
+
+
+def test_remat_policy_unknown_raises():
+    import dataclasses
+
+    cfg = dataclasses.replace(TINY, remat_policy="bogus")
+    params = init_params(jax.random.key(0), cfg)
+    tokens = demo_batch(jax.random.key(1), 1, 8, cfg.vocab)
+    with pytest.raises(ValueError, match="remat_policy"):
+        forward(params, tokens, cfg)
