@@ -490,16 +490,33 @@ def bench_serve(report: dict, smoke: bool = False) -> None:
             "quantization numerics out of tolerance"
         )
 
+    # KV-cache HBM at the serving shape (batch = max(batches)): the slice
+    # a fractional-HBM pod reserves for context. eval_shape: byte
+    # accounting must not allocate (and hold) real caches in the HBM the
+    # timed runs below are characterizing.
+    bmax = max(batches)
+    for label, kv in (("bf16", None), ("int8", "int8")):
+        c = jax.eval_shape(
+            lambda kv=kv: G.init_cache(cfg, bmax, Tp + max_new, kv_dtype=kv)
+        )
+        serve[f"kv_cache_bytes_{label}"] = int(
+            sum(
+                v.size * v.dtype.itemsize
+                for k_, v in c.items() if k_ != "len"
+            )
+        )
+
     rows = []
     for batch in batches:
         prompt = jax.random.randint(jax.random.key(8), (batch, Tp), 0, cfg.vocab)
         rng = jax.random.key(9)
         row = {"batch": batch}
-        for label, p, pbytes in (
-            ("bf16", params, serve["param_bytes_bf16"]),
-            ("int8", qparams, serve["param_bytes_int8"]),
+        for label, p, pbytes, kv in (
+            ("bf16", params, serve["param_bytes_bf16"], None),
+            ("int8", qparams, serve["param_bytes_int8"], None),
+            ("int8_kv8", qparams, serve["param_bytes_int8"], "int8"),
         ):
-            gen = G.make_generate(cfg, max_new=max_new)
+            gen = G.make_generate(cfg, max_new=max_new, kv_dtype=kv)
             out = gen(p, prompt, rng)  # compile
             assert out.shape == (batch, Tp + max_new)
             _, t, _ = _timeit(lambda: gen(p, prompt, rng), iters=iters, warmup=1, synced=False)

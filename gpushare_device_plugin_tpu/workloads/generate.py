@@ -33,19 +33,49 @@ import jax.numpy as jnp
 from ..ops import flash_attention
 from ..parallel.ring import grouped_attention
 from .attention import flash_or_plain, use_flash
-from .quant import embed_lookup, matmul_weight
+from .quant import (
+    dequantize_kv,
+    embed_lookup,
+    matmul_weight,
+    quantize_kv,
+)
 from .transformer import TransformerConfig, _mlp_block, _project_qkv, _rms_norm
 
-KVCache = dict[str, jax.Array]  # {"k","v"}: [L, B, Smax, Hkv, Dh]; "len": []
+# {"k","v"}: [L, B, Smax, Hkv, Dh]; "len": []. int8 caches additionally
+# carry {"k_scale","v_scale"}: [L, B, Smax, Hkv] f32 (see init_cache).
+KVCache = dict[str, jax.Array]
 
 
-def init_cache(cfg: TransformerConfig, batch: int, max_len: int) -> KVCache:
+def init_cache(
+    cfg: TransformerConfig, batch: int, max_len: int,
+    kv_dtype: str | None = None,
+) -> KVCache:
+    """Fresh cache. ``kv_dtype="int8"`` stores K/V as symmetric int8 with
+    per-(token, head) scales (``quant.quantize_kv``) — half the cache HBM
+    of bf16, which is both the decode bandwidth floor at long context and
+    the slice a fractional-HBM pod must reserve for it. Dequantization
+    fuses into the attention einsums; entries are quantized once, at
+    write time."""
     shape = (cfg.n_layers, batch, max_len, cfg.kv_heads, cfg.head_dim)
+    if kv_dtype not in (None, "int8"):
+        raise ValueError(f"unknown kv_dtype={kv_dtype!r}: expected None|'int8'")
+    if kv_dtype == "int8":
+        return {
+            "k": jnp.zeros(shape, jnp.int8),
+            "v": jnp.zeros(shape, jnp.int8),
+            "k_scale": jnp.ones(shape[:-1], jnp.float32),
+            "v_scale": jnp.ones(shape[:-1], jnp.float32),
+            "len": jnp.zeros((), jnp.int32),
+        }
     return {
         "k": jnp.zeros(shape, cfg.compute_dtype),
         "v": jnp.zeros(shape, cfg.compute_dtype),
         "len": jnp.zeros((), jnp.int32),
     }
+
+
+def _cache_is_q8(cache: KVCache) -> bool:
+    return "k_scale" in cache
 
 
 def _decode_attention(q, k_cache, v_cache, cur_len, start=None):
@@ -137,15 +167,30 @@ def prefill(
         layer, x, (params["layers"], jnp.arange(cfg.n_layers))
     )
     # ks/vs: [L, B, Tp, Hkv, Dh] -> cache[:, :, :Tp]
-    cache = {
-        "k": jax.lax.dynamic_update_slice(
-            cache["k"], ks.astype(cache["k"].dtype), (0, 0, 0, 0, 0)
-        ),
-        "v": jax.lax.dynamic_update_slice(
-            cache["v"], vs.astype(cache["v"].dtype), (0, 0, 0, 0, 0)
-        ),
-        "len": jnp.int32(Tp),
-    }
+    if _cache_is_q8(cache):
+        kq8, kscale = quantize_kv(ks)
+        vq8, vscale = quantize_kv(vs)
+        cache = {
+            "k": jax.lax.dynamic_update_slice(cache["k"], kq8, (0, 0, 0, 0, 0)),
+            "v": jax.lax.dynamic_update_slice(cache["v"], vq8, (0, 0, 0, 0, 0)),
+            "k_scale": jax.lax.dynamic_update_slice(
+                cache["k_scale"], kscale, (0, 0, 0, 0)
+            ),
+            "v_scale": jax.lax.dynamic_update_slice(
+                cache["v_scale"], vscale, (0, 0, 0, 0)
+            ),
+            "len": jnp.int32(Tp),
+        }
+    else:
+        cache = {
+            "k": jax.lax.dynamic_update_slice(
+                cache["k"], ks.astype(cache["k"].dtype), (0, 0, 0, 0, 0)
+            ),
+            "v": jax.lax.dynamic_update_slice(
+                cache["v"], vs.astype(cache["v"].dtype), (0, 0, 0, 0, 0)
+            ),
+            "len": jnp.int32(Tp),
+        }
     x = _rms_norm(x[:, -1:], params["final_norm"])
     logits = jnp.einsum("btd,dv->btv", x, matmul_weight(params["out"], dt))
     return logits[:, 0].astype(jnp.float32), cache
@@ -171,22 +216,53 @@ def decode_step(
         positions = (pos - start)[:, None]  # [B, 1]
     x = embed_lookup(params["embed"], token, dt)[:, None]  # [B, 1, d]
 
+    q8 = _cache_is_q8(cache)
+
     def layer(x, xs):
-        lp, k_cache, v_cache = xs
+        if q8:
+            lp, k_cache, v_cache, k_scale, v_scale = xs
+        else:
+            lp, k_cache, v_cache = xs
         h = _rms_norm(x, lp["ln1"])
         q, k, v = _project_qkv(h, lp, cfg, positions)
-        k_cache = jax.lax.dynamic_update_slice(
-            k_cache, k.astype(k_cache.dtype), (0, pos, 0, 0)
-        )
-        v_cache = jax.lax.dynamic_update_slice(
-            v_cache, v.astype(v_cache.dtype), (0, pos, 0, 0)
-        )
-        attn = _decode_attention(q, k_cache, v_cache, pos + 1, start=start)
+        if q8:
+            kq8, ks_new = quantize_kv(k)
+            vq8, vs_new = quantize_kv(v)
+            k_cache = jax.lax.dynamic_update_slice(k_cache, kq8, (0, pos, 0, 0))
+            v_cache = jax.lax.dynamic_update_slice(v_cache, vq8, (0, pos, 0, 0))
+            k_scale = jax.lax.dynamic_update_slice(k_scale, ks_new, (0, pos, 0))
+            v_scale = jax.lax.dynamic_update_slice(v_scale, vs_new, (0, pos, 0))
+            # Dequant fuses into the attention einsums; HBM holds int8.
+            k_mat = dequantize_kv(k_cache, k_scale, q.dtype)
+            v_mat = dequantize_kv(v_cache, v_scale, q.dtype)
+            carry = (k_cache, v_cache, k_scale, v_scale)
+        else:
+            k_cache = jax.lax.dynamic_update_slice(
+                k_cache, k.astype(k_cache.dtype), (0, pos, 0, 0)
+            )
+            v_cache = jax.lax.dynamic_update_slice(
+                v_cache, v.astype(v_cache.dtype), (0, pos, 0, 0)
+            )
+            k_mat, v_mat = k_cache, v_cache
+            carry = (k_cache, v_cache)
+        attn = _decode_attention(q, k_mat, v_mat, pos + 1, start=start)
         x = x + jnp.einsum("bthn,hnd->btd", attn, matmul_weight(lp["wo"], dt))
-        return _mlp_block(x, lp, cfg), (k_cache, v_cache)
+        return _mlp_block(x, lp, cfg), carry
 
-    x, (ks, vs) = jax.lax.scan(layer, x, (params["layers"], cache["k"], cache["v"]))
-    cache = {"k": ks, "v": vs, "len": pos + 1}
+    if q8:
+        xs = (
+            params["layers"], cache["k"], cache["v"],
+            cache["k_scale"], cache["v_scale"],
+        )
+        x, (ks, vs, kss, vss) = jax.lax.scan(layer, x, xs)
+        cache = {
+            "k": ks, "v": vs, "k_scale": kss, "v_scale": vss, "len": pos + 1,
+        }
+    else:
+        x, (ks, vs) = jax.lax.scan(
+            layer, x, (params["layers"], cache["k"], cache["v"])
+        )
+        cache = {"k": ks, "v": vs, "len": pos + 1}
     x = _rms_norm(x, params["final_norm"])
     logits = jnp.einsum("btd,dv->btv", x, matmul_weight(params["out"], dt))
     return logits[:, 0].astype(jnp.float32), cache
@@ -202,6 +278,7 @@ def generate(
     rng: jax.Array | None = None,
     eos_id: int | None = None,
     prompt_lens: jax.Array | None = None,
+    kv_dtype: str | None = None,
 ) -> jax.Array:
     """Generate ``max_new`` tokens after ``prompt`` ([B, Tp] int32).
 
@@ -222,7 +299,7 @@ def generate(
     if temperature > 0.0 and rng is None:
         raise ValueError("temperature sampling needs rng")
     B, Tp = prompt.shape
-    cache = init_cache(cfg, B, Tp + max_new)
+    cache = init_cache(cfg, B, Tp + max_new, kv_dtype=kv_dtype)
     pad = None
     if prompt_lens is not None:
         pad = (Tp - prompt_lens).astype(jnp.int32)
@@ -268,16 +345,18 @@ def make_generate(
     temperature: float = 0.0,
     eos_id: int | None = None,
     padded: bool = False,
+    kv_dtype: str | None = None,
 ):
     """Jitted generate closure (one compile per prompt shape).
 
     ``padded=False``: (params, prompt, rng) -> [B, Tp+max_new].
     ``padded=True``: (params, prompt, prompt_lens, rng) -> [B, max_new]
-    (the variable-length serving path).
+    (the variable-length serving path). ``kv_dtype="int8"`` serves from a
+    half-size quantized KV cache (see :func:`init_cache`).
     """
     fn = functools.partial(
         generate, cfg=cfg, max_new=max_new, temperature=temperature,
-        eos_id=eos_id,
+        eos_id=eos_id, kv_dtype=kv_dtype,
     )
     if padded:
         return jax.jit(

@@ -18,6 +18,12 @@ Integration: :func:`quantize_decoder` maps a trained param tree to a
 quantized one; ``generate.prefill``/``decode_step`` accept either tree —
 quantized layer weights are dequantized per layer *inside* the scan body,
 so only one layer's full-precision weights exist at a time.
+
+The same recipe extends to the KV cache (:func:`quantize_kv` /
+:func:`dequantize_kv`, ``generate.init_cache(kv_dtype="int8")``):
+per-(token, head) symmetric int8 halves the cache stream that floors
+long-context decode latency, and composes with weight-only int8 for the
+fully quantized serving stack.
 """
 
 from __future__ import annotations
@@ -115,6 +121,30 @@ def param_bytes(tree: Any) -> int:
         for x in jax.tree.leaves(tree)
         if hasattr(x, "dtype")
     )
+
+
+def quantize_kv(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Symmetric int8 over the head_dim axis (last): per-(…, token, head)
+    scale. x: [..., Dh] -> (q8 [..., Dh] int8, scale [...] f32).
+
+    The KV-cache analog of the weight scheme: decode reads the cache once
+    per step, so int8 halves the dominant long-context HBM stream (and
+    the cache slice a fractional-HBM pod must reserve). Per-token-head
+    scales keep the error at int8 resolution regardless of outliers in
+    other positions.
+    """
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q8 = jnp.clip(
+        jnp.round(x.astype(jnp.float32) / scale[..., None]), -127, 127
+    ).astype(jnp.int8)
+    return q8, scale.astype(jnp.float32)
+
+
+def dequantize_kv(q8: jax.Array, scale: jax.Array, dtype) -> jax.Array:
+    """Inverse of :func:`quantize_kv`; under jit the multiply fuses into
+    the consuming attention einsum, so HBM holds only int8 + scales."""
+    return (q8.astype(jnp.float32) * scale[..., None]).astype(dtype)
 
 
 def embed_lookup(embed: Any, tokens: jax.Array, dtype) -> jax.Array:

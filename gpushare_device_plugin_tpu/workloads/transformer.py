@@ -61,10 +61,11 @@ class TransformerConfig:
     #   whole forward (cheapest HBM, ~4/3 the model FLOPs — an MFU
     #   measured against 3x-forward is capped at 75%).
     # - "dots": save an explicit allowlist of named projection outputs
-    #   (post-RoPE q/k/v, the attention output, the MLP gate/up — see the
-    #   checkpoint_name calls below); the backward recomputes only cheap
-    #   elementwise ops (norms, RoPE's linear rotation, silu), so compute
-    #   stays ~3x forward at O(saved projections) activation HBM. The
+    #   (post-RoPE q/k/v, the attention output, the wo projection, the
+    #   MLP gate/up — see the checkpoint_name calls below); the backward
+    #   recomputes only cheap elementwise ops (norms, RoPE's linear
+    #   rotation, silu, residual adds), so compute stays ~3x forward at
+    #   O(saved projections) activation HBM. The
     #   allowlist deliberately excludes attention scores, so plain
     #   attention never checkpoints an [S, S] matrix under this policy.
     #   The right choice whenever the activations fit — fractional-HBM
@@ -263,10 +264,15 @@ def _layer(x, lp, cfg: TransformerConfig, positions, mesh: Mesh | None):
             q, k, v, attention=cfg.attention, causal=True, mesh=mesh
         )
     # Named so the "dots" remat policy can save it: the flash kernel is a
-    # custom call, not a dot_general, so dots_saveable alone would re-run
+    # custom call, not a dot_general, so a dots-based policy would re-run
     # it during the backward recompute.
     attn = checkpoint_name(attn, "attn_out")
-    x = x + jnp.einsum("bthn,hnd->btd", attn, matmul_weight(lp["wo"], dt))
+    # wo_out saved too: the MLP VJP needs the post-residual activation,
+    # which is then an elementwise add of saved values instead of a
+    # re-run of this projection.
+    x = x + checkpoint_name(
+        jnp.einsum("bthn,hnd->btd", attn, matmul_weight(lp["wo"], dt)), "wo_out"
+    )
     return _mlp_block(x, lp, cfg)
 
 
@@ -285,7 +291,7 @@ def forward(
     if cfg.remat:
         if cfg.remat_policy == "dots":
             policy = jax.checkpoint_policies.save_only_these_names(
-                "qkv_out", "attn_out", "mlp_gate_up"
+                "qkv_out", "attn_out", "wo_out", "mlp_gate_up"
             )
             layer_fn = jax.checkpoint(layer_fn, policy=policy)
         elif cfg.remat_policy == "full":
