@@ -127,18 +127,14 @@ def quantize_kv(x: jax.Array) -> tuple[jax.Array, jax.Array]:
     """Symmetric int8 over the head_dim axis (last): per-(…, token, head)
     scale. x: [..., Dh] -> (q8 [..., Dh] int8, scale [...] f32).
 
-    The KV-cache analog of the weight scheme: decode reads the cache once
-    per step, so int8 halves the dominant long-context HBM stream (and
-    the cache slice a fractional-HBM pod must reserve). Per-token-head
-    scales keep the error at int8 resolution regardless of outliers in
-    other positions.
+    The KV-cache analog of the weight scheme — same recipe as
+    :func:`quantize` (one implementation of the scale/clip math), tuple
+    layout instead of a qtensor dict because the cache stores q8 and
+    scales as separate scan-carried arrays. Per-token-head scales keep
+    the error at int8 resolution regardless of outliers elsewhere.
     """
-    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
-    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
-    q8 = jnp.clip(
-        jnp.round(x.astype(jnp.float32) / scale[..., None]), -127, 127
-    ).astype(jnp.int8)
-    return q8, scale.astype(jnp.float32)
+    qt = quantize(x, (-1,))
+    return qt["q8"], qt["scale"][..., 0]
 
 
 def dequantize_kv(q8: jax.Array, scale: jax.Array, dtype) -> jax.Array:
