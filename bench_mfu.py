@@ -154,6 +154,24 @@ def _bench_cfg(smoke: bool):
     )
 
 
+def _bench_shapes(smoke: bool) -> tuple[int, int]:
+    """(batch, seq) shared by the train and ablate sections — the ablation
+    exists to decompose bench_train's step time, so a drifted copy would
+    make the differencing meaningless."""
+    return (2, 64) if smoke else (8, 2048)
+
+
+def _one_chip_mesh():
+    """The 1-device (dp, fsdp, tp, sp) mesh the single-chip sections use."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    return Mesh(
+        np.array(jax.devices()[:1]).reshape(1, 1, 1, 1), ("dp", "fsdp", "tp", "sp")
+    )
+
+
 def bench_flash(report: dict, smoke: bool = False) -> None:
     import jax
     import jax.numpy as jnp
@@ -284,7 +302,6 @@ def bench_train(report: dict, smoke: bool = False) -> None:
     import jax
     import jax.numpy as jnp
     import numpy as np
-    from jax.sharding import Mesh
 
     from gpushare_device_plugin_tpu.workloads.transformer import (
         TransformerConfig,
@@ -294,8 +311,8 @@ def bench_train(report: dict, smoke: bool = False) -> None:
     )
 
     base_cfg = _bench_cfg(smoke)
-    batch, seq = (2, 64) if smoke else (8, 2048)
-    mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1, 1, 1), ("dp", "fsdp", "tp", "sp"))
+    batch, seq = _bench_shapes(smoke)
+    mesh = _one_chip_mesh()
 
     flops_per_step, n_params = _matmul_flops_per_step(base_cfg, batch, seq)
     print(
@@ -554,8 +571,6 @@ def bench_ablate(report: dict, smoke: bool = False) -> None:
     import dataclasses
 
     import jax
-    import numpy as np
-    from jax.sharding import Mesh
 
     from gpushare_device_plugin_tpu.workloads.transformer import (
         demo_batch,
@@ -565,14 +580,15 @@ def bench_ablate(report: dict, smoke: bool = False) -> None:
     )
 
     base = _bench_cfg(smoke)
-    batch, seq = (2, 64) if smoke else (8, 2048)
+    batch, seq = _bench_shapes(smoke)
     iters = 3 if smoke else 10
-    mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1, 1, 1), ("dp", "fsdp", "tp", "sp"))
+    mesh = _one_chip_mesh()
     tokens = demo_batch(jax.random.key(1), batch, seq, base.vocab)
     rows = []
     variants = [("full", None), ("dots", None)] if smoke else [
         ("full", "flash"), ("dots", "flash"), ("dots", "plain"), ("full", "plain"),
     ]
+    params = opt_state = None
     for policy, attn in variants:
         cfg = dataclasses.replace(
             base, remat_policy=policy,
@@ -580,6 +596,10 @@ def bench_ablate(report: dict, smoke: bool = False) -> None:
         )
         row = {"remat_policy": policy, "attention": cfg.attention}
         try:
+            # Drop the previous variant's ~6 GB train state BEFORE the next
+            # init — two resident copies OOM the 16 GiB chip the model is
+            # sized for (see _bench_cfg).
+            params = opt_state = None
             params, opt_state = init_train_state(jax.random.key(0), mesh, cfg)
             fwd = jax.jit(lambda p, t: loss_fn(p, t, cfg, mesh))
             # returns (loss, grads): grads stay live (no DCE of the
