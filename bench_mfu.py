@@ -588,7 +588,6 @@ def bench_ablate(report: dict, smoke: bool = False) -> None:
     variants = [("full", None), ("dots", None)] if smoke else [
         ("full", "flash"), ("dots", "flash"), ("dots", "plain"), ("full", "plain"),
     ]
-    params = opt_state = None
     for policy, attn in variants:
         cfg = dataclasses.replace(
             base, remat_policy=policy,

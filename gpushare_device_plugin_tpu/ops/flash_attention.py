@@ -147,6 +147,25 @@ def _kv_row(b, heads, kv_heads):
     return (b // heads) * kv_heads + (b % heads) // groups
 
 
+def _causal_kv_map(causal, block_q, block_k, heads, kv_heads):
+    """KV-side BlockSpec index map for the (BH, num_q, num_kv) grids.
+
+    Causal: the fetched KV index is clamped at the Q block's last visible
+    block — the same ``(i*block_q + block_q - 1) // block_k`` boundary the
+    kernels' ``last_ki`` live condition uses, so no live step ever sees a
+    clamped (wrong) block, and Mosaic elides the copies of the skipped
+    future blocks (consecutive identical indices). The pad-mask skip can't
+    be clamped: ``start`` is runtime data and index maps see only grid
+    indices.
+    """
+    def kv_map(b, i, j):
+        if causal:
+            j = jnp.minimum(j, (i * block_q + block_q - 1) // block_k)
+        return (_kv_row(b, heads, kv_heads), j, 0)
+
+    return kv_map
+
+
 def _fwd(
     q, k, v, start, *, scale, causal, block_q, block_k, heads, kv_heads, interpret
 ):
@@ -160,8 +179,9 @@ def _fwd(
         has_start=start is not None,
     )
     # GQA-native: K/V stay [B*kv_heads, S, D] in HBM; each query head's
-    # grid row streams its group's KV blocks directly (no repeated copy).
-    kv_map = lambda b, i, j: (_kv_row(b, heads, kv_heads), j, 0)  # noqa: E731
+    # grid row streams its group's KV blocks directly (no repeated copy),
+    # with causal fetch-elision clamping (see _causal_kv_map).
+    kv_map = _causal_kv_map(causal, block_q, block_k, heads, kv_heads)
     in_specs = [
         pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
         pl.BlockSpec((1, block_k, D), kv_map),
@@ -335,7 +355,7 @@ def _bwd(
     delta_row = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     delta = jnp.broadcast_to(delta_row[..., None], (BH, S, STAT_LANES))
 
-    kv_map = lambda b, i, j: (_kv_row(b, heads, kv_heads), j, 0)  # noqa: E731
+    kv_map = _causal_kv_map(causal, block_q, block_k, heads, kv_heads)
     dq_in_specs = [
         pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
         pl.BlockSpec((1, block_k, D), kv_map),
@@ -369,9 +389,15 @@ def _bwd(
     # q block) so one KV head's accumulator sums its whole query group.
     # Q-side rows for grid cell b (a KV-head row) and inner index gq:
     #   q_row = (b // kv_heads) * heads + (b % kv_heads) * groups + gq // num_q
+    # Causal: Q blocks before this KV block's first visible one are
+    # clamped up to it, so their (skipped) fetches are elided like the
+    # forward's future-KV blocks.
     def q_map(b, j, gq):
         row = (b // kv_heads) * heads + (b % kv_heads) * groups + gq // num_q
-        return (row, gq % num_q, 0)
+        qi = gq % num_q
+        if causal:
+            qi = jnp.maximum(qi, (j * block_k) // block_q)
+        return (row, qi, 0)
 
     dkv_in_specs = [
         pl.BlockSpec((1, block_q, D), q_map),
