@@ -11,9 +11,10 @@ code.
 """
 
 from .bert import BertConfig  # noqa: F401
-# NOTE: only make_generate is re-exported by name — re-exporting the
-# `generate` function would shadow the `workloads.generate` submodule.
-from .generate import make_generate  # noqa: F401
+# NOTE: only make_generate/sample_logits are re-exported by name —
+# re-exporting the `generate` function would shadow the
+# `workloads.generate` submodule.
+from .generate import make_generate, sample_logits  # noqa: F401
 from .optim import make_optimizer  # noqa: F401
 from .resnet import ResNetConfig  # noqa: F401
 from .trainer import TrainLoopConfig, run_train_loop  # noqa: F401

@@ -268,6 +268,49 @@ def decode_step(
     return logits[:, 0].astype(jnp.float32), cache
 
 
+def sample_logits(
+    logits: jax.Array,
+    key: jax.Array,
+    *,
+    temperature: float = 0.0,
+    top_k: int | None = None,
+    top_p: float | None = None,
+) -> jax.Array:
+    """Sample next tokens from ``[B, vocab]`` logits (compiled-friendly).
+
+    ``temperature=0`` is greedy argmax (top_k/top_p ignored). Otherwise
+    softmax sampling at the given temperature, optionally restricted to
+    the ``top_k`` highest logits and/or the smallest set of tokens whose
+    probability mass reaches ``top_p`` (nucleus). Both filters are static
+    masks over sorted logits — no dynamic shapes, one compiled program.
+    """
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / temperature
+    if top_k is not None:
+        if top_k < 1:
+            raise ValueError(f"top_k must be >= 1, got {top_k}")
+        # Clamp to the vocab (sampler-config portability: top_k=50 on a
+        # small-vocab model means "no truncation", not a trace error).
+        kth = jax.lax.top_k(logits, min(top_k, logits.shape[-1]))[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p is not None:
+        if not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]  # descending
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # Keep tokens while the mass BEFORE them is < top_p (the first
+        # token is always kept); find the smallest kept logit.
+        keep = (cum - probs) < top_p  # [B, vocab] over sorted order
+        # smallest kept logit per row = min over kept sorted logits
+        floor = jnp.min(
+            jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True
+        )
+        logits = jnp.where(logits < floor, -jnp.inf, logits)
+    return jax.random.categorical(key, logits, axis=-1)
+
+
 def generate(
     params: Any,
     prompt: jax.Array,
@@ -275,6 +318,8 @@ def generate(
     *,
     max_new: int,
     temperature: float = 0.0,
+    top_k: int | None = None,
+    top_p: float | None = None,
     rng: jax.Array | None = None,
     eos_id: int | None = None,
     prompt_lens: jax.Array | None = None,
@@ -290,9 +335,11 @@ def generate(
     writes the same cache slot (static shapes, no per-row scatter).
 
     ``temperature=0`` is greedy argmax; otherwise softmax sampling at the
-    given temperature (``rng`` required). With ``eos_id``, positions
-    after the first EOS are overwritten with EOS (post-hoc mask — the
-    compiled loop always runs ``max_new`` steps; see module docstring).
+    given temperature (``rng`` required), optionally truncated by
+    ``top_k`` and/or nucleus ``top_p`` (:func:`sample_logits`). With
+    ``eos_id``, positions after the first EOS are overwritten with EOS
+    (post-hoc mask — the compiled loop always runs ``max_new`` steps;
+    see module docstring).
 
     Wrap in ``jax.jit`` via :func:`make_generate` for repeated use.
     """
@@ -311,9 +358,9 @@ def generate(
     rng = rng if rng is not None else jax.random.key(0)
 
     def pick(logits, key):
-        if temperature > 0.0:
-            return jax.random.categorical(key, logits / temperature, axis=-1)
-        return jnp.argmax(logits, axis=-1)
+        return sample_logits(
+            logits, key, temperature=temperature, top_k=top_k, top_p=top_p
+        )
 
     rng, k0 = jax.random.split(rng)
     first = pick(logits, k0).astype(jnp.int32)  # [B]
@@ -343,6 +390,8 @@ def make_generate(
     *,
     max_new: int,
     temperature: float = 0.0,
+    top_k: int | None = None,
+    top_p: float | None = None,
     eos_id: int | None = None,
     padded: bool = False,
     kv_dtype: str | None = None,
@@ -352,11 +401,12 @@ def make_generate(
     ``padded=False``: (params, prompt, rng) -> [B, Tp+max_new].
     ``padded=True``: (params, prompt, prompt_lens, rng) -> [B, max_new]
     (the variable-length serving path). ``kv_dtype="int8"`` serves from a
-    half-size quantized KV cache (see :func:`init_cache`).
+    half-size quantized KV cache (see :func:`init_cache`); sampling
+    controls per :func:`sample_logits`.
     """
     fn = functools.partial(
         generate, cfg=cfg, max_new=max_new, temperature=temperature,
-        eos_id=eos_id, kv_dtype=kv_dtype,
+        top_k=top_k, top_p=top_p, eos_id=eos_id, kv_dtype=kv_dtype,
     )
     if padded:
         return jax.jit(

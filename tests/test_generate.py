@@ -171,3 +171,66 @@ def test_padded_prefill_flash_path_matches_plain(setup):
     out_plain = G.generate(params, prompt, cfg, max_new=3, prompt_lens=lens)
     out_flash = G.generate(params, prompt, cfg_flash, max_new=3, prompt_lens=lens)
     assert (out_plain == out_flash).all()
+
+
+# --- sampling controls ------------------------------------------------------
+
+def test_sample_logits_top_k_one_is_greedy():
+    logits = jax.random.normal(jax.random.key(0), (4, 32))
+    greedy = G.sample_logits(logits, jax.random.key(1), temperature=0.0)
+    k1 = G.sample_logits(
+        logits, jax.random.key(1), temperature=0.7, top_k=1
+    )
+    assert (k1 == greedy).all()
+
+
+def test_sample_logits_top_k_restricts_support():
+    logits = jnp.arange(16.0)[None, :] * 2.0  # strictly increasing
+    keys = jax.random.split(jax.random.key(2), 64)
+    picks = jnp.stack([
+        G.sample_logits(logits, k, temperature=1.0, top_k=3)[0] for k in keys
+    ])
+    assert set(picks.tolist()) <= {13, 14, 15}
+
+
+def test_sample_logits_top_p_keeps_nucleus():
+    # one dominant token (p ~ 0.97): top_p=0.5 must always pick it
+    logits = jnp.zeros((1, 8)).at[0, 3].set(5.0)
+    keys = jax.random.split(jax.random.key(3), 32)
+    picks = jnp.stack([
+        G.sample_logits(logits, k, temperature=1.0, top_p=0.5)[0] for k in keys
+    ])
+    assert (picks == 3).all()
+
+
+def test_sample_logits_top_p_one_is_plain_sampling():
+    logits = jax.random.normal(jax.random.key(4), (2, 16))
+    a = G.sample_logits(logits, jax.random.key(5), temperature=1.0, top_p=1.0)
+    b = G.sample_logits(logits, jax.random.key(5), temperature=1.0)
+    assert (a == b).all()
+
+
+def test_sample_logits_validation():
+    logits = jnp.zeros((1, 4))
+    with pytest.raises(ValueError, match="top_k"):
+        G.sample_logits(logits, jax.random.key(0), temperature=1.0, top_k=0)
+    with pytest.raises(ValueError, match="top_p"):
+        G.sample_logits(logits, jax.random.key(0), temperature=1.0, top_p=0.0)
+
+
+def test_generate_with_top_k_top_p_under_jit(setup):
+    cfg, params, prompt = setup
+    gen = G.make_generate(cfg, max_new=3, temperature=0.8, top_k=5, top_p=0.9)
+    out = gen(params, prompt, jax.random.key(6))
+    assert out.shape == (2, prompt.shape[1] + 3)
+    assert ((out >= 0) & (out < cfg.vocab)).all()
+    # seeded: same rng -> same tokens
+    out2 = gen(params, prompt, jax.random.key(6))
+    assert (out == out2).all()
+
+
+def test_sample_logits_top_k_clamps_to_vocab():
+    logits = jax.random.normal(jax.random.key(7), (2, 8))
+    a = G.sample_logits(logits, jax.random.key(8), temperature=1.0, top_k=50)
+    b = G.sample_logits(logits, jax.random.key(8), temperature=1.0)
+    assert (a == b).all()  # k >= vocab means no truncation
