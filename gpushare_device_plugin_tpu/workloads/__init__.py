@@ -11,10 +11,14 @@ code.
 """
 
 from .bert import BertConfig  # noqa: F401
-# NOTE: only make_generate/sample_logits are re-exported by name —
-# re-exporting the `generate` function would shadow the
-# `workloads.generate` submodule.
-from .generate import make_generate, sample_logits  # noqa: F401
+# NOTE: only make_generate/make_speculative_generate/sample_logits are
+# re-exported by name — re-exporting the `generate` function would shadow
+# the `workloads.generate` submodule.
+from .generate import (  # noqa: F401
+    make_generate,
+    make_speculative_generate,
+    sample_logits,
+)
 from .optim import make_optimizer  # noqa: F401
 from .resnet import ResNetConfig  # noqa: F401
 from .trainer import TrainLoopConfig, run_train_loop  # noqa: F401

@@ -17,6 +17,11 @@ TPU-first design:
 - **GQA-native end to end**: the cache stores ``Hkv`` heads (1/g the HBM
   of full-head caching, the whole point of GQA at serving time); the query
   group dimension rides inside the einsums.
+- **Speculative decoding**: :func:`decode_block` verifies a k-token draft
+  in one cached forward; :func:`speculative_generate` wraps the
+  draft/verify/accept loop in a ``lax.while_loop`` with static shapes
+  (cache ``len`` rewinds past rejected entries; stale positions stay
+  masked), emitting exactly the target model's greedy tokens.
 
 Single-host scope: generation targets one chip (or auto-SPMD under jit on
 a mesh via sharded params); the sp-ring path is a training concern.
@@ -76,32 +81,6 @@ def init_cache(
 
 def _cache_is_q8(cache: KVCache) -> bool:
     return "k_scale" in cache
-
-
-def _decode_attention(q, k_cache, v_cache, cur_len, start=None):
-    """Single-position attention over the cache.
-
-    q: [B, 1, H, Dh]; k_cache/v_cache: [B, Smax, Hkv, Dh]; positions
-    ``>= cur_len`` (the unwritten tail) are masked out, as are positions
-    ``< start[b]`` (per-row left padding). f32 softmax like every other
-    attention path in the repo.
-    """
-    B, _, H, Dh = q.shape
-    Smax = k_cache.shape[1]
-    Hkv = k_cache.shape[2]
-    g = H // Hkv
-    qg = q[:, 0].reshape(B, Hkv, g, Dh)
-    s = jnp.einsum("bkgd,bskd->bkgs", qg, k_cache).astype(jnp.float32)
-    s = s / jnp.sqrt(jnp.float32(Dh))
-    idx = jnp.arange(Smax)
-    mask = jnp.broadcast_to(idx < cur_len, (B, Smax))
-    if start is not None:
-        mask = mask & (idx[None, :] >= start[:, None])
-    s = jnp.where(mask[:, None, None, :], s, -jnp.inf)
-    p = jax.nn.softmax(s, axis=-1)
-    # f32 accumulation over the key axis; cast once at the end.
-    out = jnp.einsum("bkgs,bskd->bkgd", p, v_cache).astype(q.dtype)
-    return out.reshape(B, 1, H, Dh)
 
 
 def _padded_prefill_attention(q, k, v, pad, attention: str = "auto"):
@@ -207,65 +186,20 @@ def decode_step(
 
     ``start`` ([B] leading pad counts from a left-padded prefill) offsets
     each row's RoPE position and masks its pad slots out of attention.
+    The T=1 case of :func:`decode_block` (single implementation of the
+    cache-write/attention recipe).
     """
-    dt = cfg.compute_dtype
-    pos = cache["len"]
-    if start is None:
-        positions = pos[None]  # [1]
-    else:
-        positions = (pos - start)[:, None]  # [B, 1]
-    x = embed_lookup(params["embed"], token, dt)[:, None]  # [B, 1, d]
+    logits, cache = decode_block(params, token[:, None], cache, cfg, start=start)
+    return logits[:, 0], cache
 
-    q8 = _cache_is_q8(cache)
 
-    def layer(x, xs):
-        if q8:
-            lp, k_cache, v_cache, k_scale, v_scale = xs
-        else:
-            lp, k_cache, v_cache = xs
-        h = _rms_norm(x, lp["ln1"])
-        q, k, v = _project_qkv(h, lp, cfg, positions)
-        if q8:
-            kq8, ks_new = quantize_kv(k)
-            vq8, vs_new = quantize_kv(v)
-            k_cache = jax.lax.dynamic_update_slice(k_cache, kq8, (0, pos, 0, 0))
-            v_cache = jax.lax.dynamic_update_slice(v_cache, vq8, (0, pos, 0, 0))
-            k_scale = jax.lax.dynamic_update_slice(k_scale, ks_new, (0, pos, 0))
-            v_scale = jax.lax.dynamic_update_slice(v_scale, vs_new, (0, pos, 0))
-            # Dequant fuses into the attention einsums; HBM holds int8.
-            k_mat = dequantize_kv(k_cache, k_scale, q.dtype)
-            v_mat = dequantize_kv(v_cache, v_scale, q.dtype)
-            carry = (k_cache, v_cache, k_scale, v_scale)
-        else:
-            k_cache = jax.lax.dynamic_update_slice(
-                k_cache, k.astype(k_cache.dtype), (0, pos, 0, 0)
-            )
-            v_cache = jax.lax.dynamic_update_slice(
-                v_cache, v.astype(v_cache.dtype), (0, pos, 0, 0)
-            )
-            k_mat, v_mat = k_cache, v_cache
-            carry = (k_cache, v_cache)
-        attn = _decode_attention(q, k_mat, v_mat, pos + 1, start=start)
-        x = x + jnp.einsum("bthn,hnd->btd", attn, matmul_weight(lp["wo"], dt))
-        return _mlp_block(x, lp, cfg), carry
-
-    if q8:
-        xs = (
-            params["layers"], cache["k"], cache["v"],
-            cache["k_scale"], cache["v_scale"],
-        )
-        x, (ks, vs, kss, vss) = jax.lax.scan(layer, x, xs)
-        cache = {
-            "k": ks, "v": vs, "k_scale": kss, "v_scale": vss, "len": pos + 1,
-        }
-    else:
-        x, (ks, vs) = jax.lax.scan(
-            layer, x, (params["layers"], cache["k"], cache["v"])
-        )
-        cache = {"k": ks, "v": vs, "len": pos + 1}
-    x = _rms_norm(x, params["final_norm"])
-    logits = jnp.einsum("btd,dv->btv", x, matmul_weight(params["out"], dt))
-    return logits[:, 0].astype(jnp.float32), cache
+def _mask_after_eos(gen: jax.Array, eos_id: int) -> jax.Array:
+    """Overwrite positions strictly after each row's first EOS with EOS —
+    the post-hoc equivalent of stopping (compiled loops always run their
+    full static length; see module docstring). Shared by :func:`generate`
+    and :func:`speculative_generate` so their outputs stay comparable."""
+    seen = jnp.cumsum((gen == eos_id).astype(jnp.int32), axis=1)
+    return jnp.where(seen - (gen == eos_id) > 0, eos_id, gen)
 
 
 def sample_logits(
@@ -284,19 +218,21 @@ def sample_logits(
     probability mass reaches ``top_p`` (nucleus). Both filters are static
     masks over sorted logits — no dynamic shapes, one compiled program.
     """
+    # Validate before the greedy early-return: a bad sampler config must
+    # fail at build time, not only once temperature is later enabled.
+    if top_k is not None and top_k < 1:
+        raise ValueError(f"top_k must be >= 1, got {top_k}")
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     if temperature <= 0.0:
         return jnp.argmax(logits, axis=-1)
     logits = logits / temperature
     if top_k is not None:
-        if top_k < 1:
-            raise ValueError(f"top_k must be >= 1, got {top_k}")
         # Clamp to the vocab (sampler-config portability: top_k=50 on a
         # small-vocab model means "no truncation", not a trace error).
         kth = jax.lax.top_k(logits, min(top_k, logits.shape[-1]))[0][..., -1:]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
     if top_p is not None:
-        if not 0.0 < top_p <= 1.0:
-            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
         sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]  # descending
         probs = jax.nn.softmax(sorted_logits, axis=-1)
         cum = jnp.cumsum(probs, axis=-1)
@@ -309,6 +245,99 @@ def sample_logits(
         )
         logits = jnp.where(logits < floor, -jnp.inf, logits)
     return jax.random.categorical(key, logits, axis=-1)
+
+
+def decode_block(
+    params: Any,
+    tokens: jax.Array,
+    cache: KVCache,
+    cfg: TransformerConfig,
+    start: jax.Array | None = None,
+) -> tuple[jax.Array, KVCache]:
+    """Cached decode of a T-token block: tokens [B, T] -> (logits
+    [B, T, vocab] f32, cache advanced by T).
+
+    Block position t attends to everything already in the cache plus
+    block positions <= t; :func:`decode_step` is the T=1 case. One
+    forward verifies a whole speculative draft — the target-model half
+    of :func:`speculative_generate` — and the logits at every block
+    position match what T sequential decode_step calls would produce
+    (pinned by tests). ``start`` ([B] leading pad counts) offsets RoPE
+    positions per row and masks pad slots, as in :func:`prefill`.
+    """
+    dt = cfg.compute_dtype
+    B, T = tokens.shape
+    pos0 = cache["len"]
+    positions = pos0 + jnp.arange(T)[None, :]  # [1, T] global positions
+    if start is not None:
+        positions = positions - start[:, None]  # [B, T] rope offsets
+    positions = jnp.broadcast_to(positions, (B, T))
+    x = embed_lookup(params["embed"], tokens, dt)  # [B, T, d]
+    q8 = _cache_is_q8(cache)
+    Smax = cache["k"].shape[2]
+    idx = jnp.arange(Smax)
+    # [B|1, T, Smax] visibility: cache prefix + block-causal, minus pads.
+    vis = idx[None, None, :] < (pos0 + jnp.arange(T) + 1)[None, :, None]
+    if start is not None:
+        vis = vis & (idx[None, None, :] >= start[:, None, None])
+
+    def layer(x, xs):
+        if q8:
+            lp, k_cache, v_cache, k_scale, v_scale = xs
+        else:
+            lp, k_cache, v_cache = xs
+        h = _rms_norm(x, lp["ln1"])
+        q, k, v = _project_qkv(h, lp, cfg, positions)
+        if q8:
+            kq8, ks_new = quantize_kv(k)
+            vq8, vs_new = quantize_kv(v)
+            k_cache = jax.lax.dynamic_update_slice(k_cache, kq8, (0, pos0, 0, 0))
+            v_cache = jax.lax.dynamic_update_slice(v_cache, vq8, (0, pos0, 0, 0))
+            k_scale = jax.lax.dynamic_update_slice(k_scale, ks_new, (0, pos0, 0))
+            v_scale = jax.lax.dynamic_update_slice(v_scale, vs_new, (0, pos0, 0))
+            k_mat = dequantize_kv(k_cache, k_scale, q.dtype)
+            v_mat = dequantize_kv(v_cache, v_scale, q.dtype)
+            carry = (k_cache, v_cache, k_scale, v_scale)
+        else:
+            k_cache = jax.lax.dynamic_update_slice(
+                k_cache, k.astype(k_cache.dtype), (0, pos0, 0, 0)
+            )
+            v_cache = jax.lax.dynamic_update_slice(
+                v_cache, v.astype(v_cache.dtype), (0, pos0, 0, 0)
+            )
+            k_mat, v_mat = k_cache, v_cache
+            carry = (k_cache, v_cache)
+        # Block-causal attention over the cache (vis computed above),
+        # grouped einsums, f32 softmax like every attention path here.
+        Hkv = k_mat.shape[2]
+        g = q.shape[2] // Hkv
+        qg = q.reshape(B, T, Hkv, g, -1)
+        s = jnp.einsum("btkgd,bskd->bkgts", qg, k_mat).astype(jnp.float32)
+        s = s / jnp.sqrt(jnp.float32(q.shape[-1]))
+        s = jnp.where(vis[:, None, None], s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        attn = jnp.einsum("bkgts,bskd->btkgd", p, v_mat).astype(q.dtype)
+        attn = attn.reshape(B, T, -1, q.shape[-1])
+        x = x + jnp.einsum("bthn,hnd->btd", attn, matmul_weight(lp["wo"], dt))
+        return _mlp_block(x, lp, cfg), carry
+
+    if q8:
+        xs = (
+            params["layers"], cache["k"], cache["v"],
+            cache["k_scale"], cache["v_scale"],
+        )
+        x, (ks, vs, kss, vss) = jax.lax.scan(layer, x, xs)
+        cache = {
+            "k": ks, "v": vs, "k_scale": kss, "v_scale": vss, "len": pos0 + T,
+        }
+    else:
+        x, (ks, vs) = jax.lax.scan(
+            layer, x, (params["layers"], cache["k"], cache["v"])
+        )
+        cache = {"k": ks, "v": vs, "len": pos0 + T}
+    x = _rms_norm(x, params["final_norm"])
+    logits = jnp.einsum("btd,dv->btv", x, matmul_weight(params["out"], dt))
+    return logits.astype(jnp.float32), cache
 
 
 def generate(
@@ -377,12 +406,152 @@ def generate(
     )
     gen = toks.T  # [B, max_new]
     if eos_id is not None:
-        seen = jnp.cumsum((gen == eos_id).astype(jnp.int32), axis=1)
-        # positions strictly after the first EOS become EOS
-        gen = jnp.where(seen - (gen == eos_id) > 0, eos_id, gen)
+        gen = _mask_after_eos(gen, eos_id)
     if prompt_lens is not None:
         return gen
     return jnp.concatenate([prompt, gen], axis=1)  # [B, Tp + max_new]
+
+
+def speculative_generate(
+    target_params: Any,
+    draft_params: Any,
+    prompt: jax.Array,
+    target_cfg: TransformerConfig,
+    draft_cfg: TransformerConfig,
+    *,
+    max_new: int,
+    k: int = 4,
+    eos_id: int | None = None,
+    return_stats: bool = False,
+):
+    """Greedy speculative decoding: the draft model proposes ``k`` tokens
+    per round, the target verifies them in ONE :func:`decode_block`
+    forward, and the longest matching prefix plus the target's correction
+    token are emitted. Output is the target model's greedy continuation —
+    exact by construction (pinned by tests at f32; in bf16 a near-tied
+    argmax can in principle round differently between the block and
+    per-step einsum shapes, in which case the output is still a valid
+    greedy continuation of the target at that tolerance). The draft only
+    changes how many target forwards it takes: ~``max_new/(accepted+1)``
+    instead of ``max_new``. At small batch the decode wall is the
+    target's weight stream (see docs/serving.md), so acceptance ~= speedup.
+
+    Single-sequence scope (``B == 1``): rows accepting different prefix
+    lengths would need per-row cache lengths; the latency-bound serving
+    case this targets is batch 1. Cache ``len`` rewinds past rejected
+    draft entries each round — stale cache positions are masked by
+    construction. Both configs must share a vocab.
+
+    Returns ``[1, Tp + max_new]`` like greedy :func:`generate`; with
+    ``return_stats=True`` returns ``(tokens, {"rounds", "drafted",
+    "accepted"})`` — acceptance telemetry, and the observable that pins
+    the draft-cache bookkeeping (a perfect draft must finish in
+    ``ceil((max_new-1)/(k+1))`` rounds; a stale/unwritten cache slot
+    would show up as extra rounds, invisible in the tokens).
+    """
+    if target_cfg.vocab != draft_cfg.vocab:
+        raise ValueError(
+            f"target/draft vocab mismatch: {target_cfg.vocab} vs {draft_cfg.vocab}"
+        )
+    if k < 1:
+        raise ValueError(f"k must be >= 1, got {k}")
+    B, Tp = prompt.shape
+    if B != 1:
+        raise ValueError(f"speculative_generate is single-sequence; got B={B}")
+
+    width = max_new + k + 1  # out buffer: last round may overhang by <= k
+    t_cache = init_cache(target_cfg, B, Tp + width)
+    d_cache = init_cache(draft_cfg, B, Tp + width)
+    t_logits, t_cache = prefill(target_params, prompt, t_cache, target_cfg)
+    _, d_cache = prefill(draft_params, prompt, d_cache, draft_cfg)
+    first = jnp.argmax(t_logits, -1).astype(jnp.int32)  # [1]
+    out = jnp.zeros((B, width), jnp.int32)
+    out = out.at[:, 0].set(first)
+
+    def cond(carry):
+        _, n, *_ = carry
+        return n < max_new
+
+    def body(carry):
+        out, n, last, t_cache, d_cache, stats = carry
+
+        # Draft proposes k greedy tokens from `last`. The scan runs k+1
+        # steps: the extra step consumes drafts[k-1] so its KV is written
+        # — on full acceptance the rewind marks that slot valid, and an
+        # unwritten (zero) entry there would silently poison every later
+        # draft prediction (acceptance collapses while output stays
+        # correct). Its proposal is discarded.
+        def d_step(cs, _):
+            c, tok = cs
+            logits, c2 = decode_step(draft_params, tok, c, draft_cfg)
+            nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+            return (c2, nxt), nxt
+
+        (d_cache, _), proposals = jax.lax.scan(
+            d_step, (d_cache, last), None, length=k + 1
+        )
+        drafts = proposals[:k].T  # [k, 1] -> [1, k]
+
+        # Target verifies the whole draft in one block forward.
+        block = jnp.concatenate([last[:, None], drafts], axis=1)  # [1, k+1]
+        logits, t_cache = decode_block(target_params, block, t_cache, target_cfg)
+        greedy = jnp.argmax(logits, -1).astype(jnp.int32)  # [1, k+1]
+
+        # Longest matching prefix a, then emit drafts[:a] + greedy[a].
+        match = (drafts == greedy[:, :k]).astype(jnp.int32)
+        a = jnp.sum(jnp.cumprod(match, axis=1), axis=1)[0]  # scalar
+        d_pad = jnp.concatenate([drafts, jnp.zeros((1, 1), jnp.int32)], axis=1)
+        correction = jnp.take_along_axis(greedy, a[None, None], axis=1)  # [1,1]
+        emit = jnp.where(jnp.arange(k + 1)[None] < a, d_pad, correction)
+        out = jax.lax.dynamic_update_slice(out, emit, (0, n))
+
+        emitted = a + 1
+        n2 = n + emitted
+        # Rewind cache lens past rejected entries: the valid prefix is the
+        # emitted sequence up to (not including) the new `last` token.
+        t_cache = {**t_cache, "len": jnp.int32(Tp) + n2 - 1}
+        d_cache = {**d_cache, "len": jnp.int32(Tp) + n2 - 1}
+        last = correction[:, 0]
+        stats = {
+            "rounds": stats["rounds"] + 1,
+            "drafted": stats["drafted"] + k,
+            "accepted": stats["accepted"] + a,
+        }
+        return out, n2, last, t_cache, d_cache, stats
+
+    zero_stats = {
+        "rounds": jnp.int32(0), "drafted": jnp.int32(0), "accepted": jnp.int32(0),
+    }
+    out, n, last, _, _, stats = jax.lax.while_loop(
+        cond, body, (out, jnp.int32(1), first, t_cache, d_cache, zero_stats)
+    )
+    gen = out[:, :max_new]
+    if eos_id is not None:
+        gen = _mask_after_eos(gen, eos_id)
+    tokens = jnp.concatenate([prompt, gen], axis=1)
+    if return_stats:
+        return tokens, stats
+    return tokens
+
+
+def make_speculative_generate(
+    target_cfg: TransformerConfig,
+    draft_cfg: TransformerConfig,
+    *,
+    max_new: int,
+    k: int = 4,
+    eos_id: int | None = None,
+    return_stats: bool = False,
+):
+    """Jitted closure: (target_params, draft_params, prompt) ->
+    [1, Tp + max_new] (or (tokens, stats) with ``return_stats``)."""
+    fn = functools.partial(
+        speculative_generate, max_new=max_new, k=k, eos_id=eos_id,
+        return_stats=return_stats,
+    )
+    return jax.jit(
+        lambda tp, dp, prompt: fn(tp, dp, prompt, target_cfg, draft_cfg)
+    )
 
 
 def make_generate(

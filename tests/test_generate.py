@@ -234,3 +234,116 @@ def test_sample_logits_top_k_clamps_to_vocab():
     a = G.sample_logits(logits, jax.random.key(8), temperature=1.0, top_k=50)
     b = G.sample_logits(logits, jax.random.key(8), temperature=1.0)
     assert (a == b).all()  # k >= vocab means no truncation
+
+
+# --- decode_block + speculative decoding ------------------------------------
+
+@pytest.mark.parametrize("kv", [None, "int8"])
+def test_decode_block_matches_sequential_steps(setup, kv):
+    """decode_block(T) must equal T sequential decode_step calls exactly
+    (logits and cache contents) — it is the verification forward of
+    speculative decoding, so any drift would break exactness."""
+    cfg, params, prompt = setup
+    toks = jnp.array([[7, 11, 3], [2, 9, 30]], jnp.int32)
+    cache_a = G.init_cache(cfg, 2, 16, kv_dtype=kv)
+    _, cache_a = G.prefill(params, prompt, cache_a, cfg)
+    seq_logits = []
+    for t in range(3):
+        l, cache_a = G.decode_step(params, toks[:, t], cache_a, cfg)
+        seq_logits.append(l)
+    seq_logits = jnp.stack(seq_logits, 1)
+    cache_b = G.init_cache(cfg, 2, 16, kv_dtype=kv)
+    _, cache_b = G.prefill(params, prompt, cache_b, cfg)
+    blk_logits, cache_b = G.decode_block(params, toks, cache_b, cfg)
+    assert jnp.allclose(blk_logits, seq_logits, atol=1e-5)
+    assert int(cache_b["len"]) == int(cache_a["len"])
+    assert jnp.allclose(
+        cache_a["k"][:, :, :8].astype(jnp.float32),
+        cache_b["k"][:, :, :8].astype(jnp.float32), atol=1e-5,
+    )
+
+
+@pytest.fixture(scope="module")
+def spec_setup():
+    base = dict(vocab=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+                d_ff=64, max_seq=96, compute_dtype=jnp.float32)
+    t_cfg = TransformerConfig(**base)
+    d_cfg = TransformerConfig(
+        **{**base, "d_model": 16, "n_heads": 2, "n_kv_heads": 1, "d_ff": 32}
+    )
+    t_params = init_params(jax.random.key(0), t_cfg)
+    d_params = init_params(jax.random.key(9), d_cfg)
+    prompt = demo_batch(jax.random.key(1), 1, 6, t_cfg.vocab)
+    return t_cfg, d_cfg, t_params, d_params, prompt
+
+
+@pytest.mark.parametrize("k", [1, 3, 4])
+def test_speculative_matches_target_greedy_weak_draft(spec_setup, k):
+    """Exactness bar: whatever the draft proposes, output == the target's
+    greedy continuation, token for token."""
+    t_cfg, d_cfg, t_params, d_params, prompt = spec_setup
+    ref = G.generate(t_params, prompt, t_cfg, max_new=12)
+    spec = G.speculative_generate(
+        t_params, d_params, prompt, t_cfg, d_cfg, max_new=12, k=k
+    )
+    assert (spec == ref).all()
+
+
+def test_speculative_perfect_draft_and_jit(spec_setup):
+    t_cfg, d_cfg, t_params, d_params, prompt = spec_setup
+    ref = G.generate(t_params, prompt, t_cfg, max_new=10)
+    # draft == target: every proposal accepted, still exact
+    spec = G.speculative_generate(
+        t_params, t_params, prompt, t_cfg, t_cfg, max_new=10, k=4
+    )
+    assert (spec == ref).all()
+    gen = G.make_speculative_generate(t_cfg, d_cfg, max_new=10, k=3)
+    assert (gen(t_params, d_params, prompt) == ref).all()
+
+
+@pytest.mark.parametrize("max_new,k", [(10, 4), (13, 3), (9, 1)])
+def test_speculative_perfect_draft_round_bound(spec_setup, max_new, k):
+    """A perfect draft (draft == target) must accept every proposal and
+    finish in ceil((max_new-1)/(k+1)) rounds — the observable that pins
+    the draft-cache bookkeeping: an unwritten/stale KV slot after a
+    full-acceptance rewind degrades later proposals and shows up here as
+    extra rounds while the emitted tokens stay correct."""
+    t_cfg, _, t_params, _, prompt = spec_setup
+    _, stats = G.speculative_generate(
+        t_params, t_params, prompt, t_cfg, t_cfg, max_new=max_new, k=k,
+        return_stats=True,
+    )
+    rounds = int(stats["rounds"])
+    assert rounds == -(-(max_new - 1) // (k + 1)), stats
+    assert int(stats["accepted"]) == int(stats["drafted"]) or (
+        # the final round may be cut short by the max_new cap
+        int(stats["drafted"]) - int(stats["accepted"]) <= k
+    )
+
+
+def test_speculative_eos_masking(spec_setup):
+    t_cfg, d_cfg, t_params, d_params, prompt = spec_setup
+    ref = G.generate(t_params, prompt, t_cfg, max_new=10, eos_id=2)
+    spec = G.speculative_generate(
+        t_params, d_params, prompt, t_cfg, d_cfg, max_new=10, k=3, eos_id=2
+    )
+    assert (spec == ref).all()
+
+
+def test_speculative_validation(spec_setup):
+    t_cfg, d_cfg, t_params, d_params, prompt = spec_setup
+    with pytest.raises(ValueError, match="single-sequence"):
+        G.speculative_generate(
+            t_params, d_params, jnp.ones((2, 4), jnp.int32), t_cfg, d_cfg,
+            max_new=4,
+        )
+    with pytest.raises(ValueError, match="k must be"):
+        G.speculative_generate(
+            t_params, d_params, prompt, t_cfg, d_cfg, max_new=4, k=0
+        )
+    bad = TransformerConfig(vocab=32, d_model=16, n_layers=1, n_heads=2,
+                            d_ff=32, max_seq=32)
+    with pytest.raises(ValueError, match="vocab"):
+        G.speculative_generate(
+            t_params, d_params, prompt, t_cfg, bad, max_new=4
+        )
