@@ -8,6 +8,6 @@ pair that streams K/V through VMEM instead of materializing the [S, S]
 score matrix in HBM.
 """
 
-from .flash_attention import flash_attention
+from .flash_attention import flash_attention, flash_attention_lse
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "flash_attention_lse"]

@@ -344,7 +344,7 @@ def _dkv_kernel(
 
 def _bwd(
     q, k, v, o, lse, do, start, *, scale, causal, block_q, block_k, heads,
-    kv_heads, interpret,
+    kv_heads, interpret, dlse=None,
 ):
     BH, S, D = q.shape
     BKV = k.shape[0]
@@ -352,7 +352,12 @@ def _bwd(
     num_q = S // block_q
     num_kv = S // block_k
     # delta_i = rowsum(dO * O): tiny elementwise reduce, XLA fuses it.
+    # With an lse cotangent (the (o, lse) pair entry), ds gains dlse * p:
+    # ds = p*(dp - delta) + dlse*p = p*(dp - (delta - dlse)) — the whole
+    # lse backward folds into this one subtraction.
     delta_row = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    if dlse is not None:
+        delta_row = delta_row - dlse.astype(jnp.float32)
     delta = jnp.broadcast_to(delta_row[..., None], (BH, S, STAT_LANES))
 
     kv_map = _causal_kv_map(causal, block_q, block_k, heads, kv_heads)
@@ -487,6 +492,92 @@ def _flash_bwd(scale, causal, block_q, block_k, heads, kv_heads, interpret, res,
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _flash_pair(q, k, v, scale, causal, block_q, block_k, heads, kv_heads, interpret):
+    o, lse = _fwd(
+        q, k, v, None, scale=scale, causal=causal, block_q=block_q,
+        block_k=block_k, heads=heads, kv_heads=kv_heads, interpret=interpret,
+    )
+    return o, lse[..., 0]  # lse: [BH, S] (drop the lane broadcast)
+
+
+def _flash_pair_fwd(
+    q, k, v, scale, causal, block_q, block_k, heads, kv_heads, interpret
+):
+    o, lse = _fwd(
+        q, k, v, None, scale=scale, causal=causal, block_q=block_q,
+        block_k=block_k, heads=heads, kv_heads=kv_heads, interpret=interpret,
+    )
+    return (o, lse[..., 0]), (q, k, v, o, lse)
+
+
+def _flash_pair_bwd(
+    scale, causal, block_q, block_k, heads, kv_heads, interpret, res, cts
+):
+    do, dlse = cts
+    q, k, v, o, lse = res
+    dq, dk, dv = _bwd(
+        q, k, v, o, lse, do, None, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, heads=heads, kv_heads=kv_heads,
+        interpret=interpret, dlse=dlse,
+    )
+    return dq, dk, dv
+
+
+_flash_pair.defvjp(_flash_pair_fwd, _flash_pair_bwd)
+
+
+def _entry_prologue(q, k, block_q, block_k, scale, interpret):
+    """Shared public-entry prologue (flash_attention AND
+    flash_attention_lse — one copy so block tuning can never drift
+    between them): interpret autodetect, GQA validation, default-block
+    auto-fit, divisibility check, scale default, head-fold.
+
+    Defaults (512, 1024) won the on-chip sweep at S in [1k, 8k] for
+    Dh <= 128; larger head dims halve both (the f32 score/prob tiles
+    plus double-buffered KV blocks scale with Dh and would crowd the
+    ~16 MB VMEM budget). The auto path shrinks the default to a
+    power-of-two divisor of S, floored at 128 (the MXU dimension — an
+    8-row block would be a pathological kernel), then falls back to a
+    single whole-sequence block when S is short enough for VMEM;
+    anything else raises. Explicit block sizes are clamped to S but
+    otherwise honored strictly: a non-dividing choice raises rather than
+    silently running a different configuration than the caller tuned.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    B, S, H, D = q.shape
+    Hkv = k.shape[2]
+    if H % Hkv:
+        raise ValueError(f"q heads {H} not a multiple of kv heads {Hkv}")
+
+    def _fit(requested, default):
+        if requested is not None:
+            return min(requested, S)
+        b = min(default, S)
+        while b > 128 and S % b:
+            b //= 2
+        # Whole-sequence fallback: both blocks may land here, making the
+        # f32 score tile S x S — 1024 keeps that worst case at 4 MB VMEM.
+        if S % b and S <= 1024:
+            b = S
+        return b
+
+    block_q = _fit(block_q, 512 if D <= 128 else 256)
+    block_k = _fit(block_k, 1024 if D <= 128 else 512)
+    if S % block_q or S % block_k:
+        raise ValueError(
+            f"sequence length {S} not divisible by blocks ({block_q}, {block_k})"
+        )
+    sc = scale if scale is not None else 1.0 / math.sqrt(D)
+
+    def fold(x):  # [B, S, h, D] -> [B*h, S, D]
+        h = x.shape[2]
+        return x.transpose(0, 2, 1, 3).reshape(B * h, S, x.shape[-1])
+
+    return block_q, block_k, sc, interpret, fold, (B, S, H, D, Hkv)
+
+
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
@@ -517,49 +608,12 @@ def flash_attention(
 
     ``interpret=None`` autodetects: compiled Mosaic on TPU, Pallas
     interpreter elsewhere (CPU tests, the virtual-device mesh harness).
-    Sequence length must be divisible by the (auto-shrunk) block sizes.
+    Sequence length must be divisible by the (auto-shrunk) block sizes
+    (see ``_entry_prologue`` for the block policy).
     """
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
-    B, S, H, D = q.shape
-    Hkv = k.shape[2]
-    if H % Hkv:
-        raise ValueError(f"q heads {H} not a multiple of kv heads {Hkv}")
-    # Defaults (512, 1024) won the on-chip sweep at S in [1k, 8k] for
-    # Dh <= 128; larger head dims halve both (the f32 score/prob tiles
-    # plus double-buffered KV blocks scale with Dh and would crowd the
-    # ~16 MB VMEM budget). The auto path shrinks the default to a
-    # power-of-two divisor of S, floored at 128 (the MXU dimension — an
-    # 8-row block would be a pathological kernel), then falls back to a
-    # single whole-sequence block when S is short enough for VMEM;
-    # anything else raises. Explicit block sizes are clamped to S but
-    # otherwise honored strictly: a non-dividing choice raises rather
-    # than silently running a different configuration than the caller
-    # tuned.
-    def _fit(requested, default):
-        if requested is not None:
-            return min(requested, S)
-        b = min(default, S)
-        while b > 128 and S % b:
-            b //= 2
-        # Whole-sequence fallback: both blocks may land here, making the
-        # f32 score tile S x S — 1024 keeps that worst case at 4 MB VMEM.
-        if S % b and S <= 1024:
-            b = S
-        return b
-
-    block_q = _fit(block_q, 512 if D <= 128 else 256)
-    block_k = _fit(block_k, 1024 if D <= 128 else 512)
-    if S % block_q or S % block_k:
-        raise ValueError(
-            f"sequence length {S} not divisible by blocks ({block_q}, {block_k})"
-        )
-    sc = scale if scale is not None else 1.0 / math.sqrt(D)
-
-    def fold(x):  # [B, S, h, D] -> [B*h, S, D]
-        h = x.shape[2]
-        return x.transpose(0, 2, 1, 3).reshape(B * h, S, x.shape[-1])
-
+    block_q, block_k, sc, interpret, fold, (B, S, H, D, Hkv) = _entry_prologue(
+        q, k, block_q, block_k, scale, interpret
+    )
     start_bh = None
     if start is not None:
         if start.shape != (B,):
@@ -576,3 +630,38 @@ def flash_attention(
         H, Hkv, interpret,
     )
     return o.reshape(B, H, S, D).transpose(0, 2, 1, 3)
+
+
+def flash_attention_lse(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    scale: float | None = None,
+    block_q: int | None = None,
+    block_k: int | None = None,
+    interpret: bool | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """:func:`flash_attention` that also returns the per-row logsumexp.
+
+    Returns ``(o [B, S, H, D], lse [B, S, H] f32)``. The lse is what an
+    online-softmax consumer needs to MERGE partial attention results —
+    the ring (``parallel/ring.py``) runs this kernel per hop and combines
+    the per-hop (o, lse) pairs exactly, so sequence-parallel long context
+    gets kernel-grade attention instead of materialized score blocks.
+    Fully differentiable: the lse cotangent folds into the backward's
+    delta term (see ``_bwd``). Same block auto-fit and GQA contract as
+    :func:`flash_attention` (shared ``_entry_prologue``); no pad-mask
+    variant (the ring masks by hop).
+    """
+    block_q, block_k, sc, interpret, fold, (B, S, H, D, Hkv) = _entry_prologue(
+        q, k, block_q, block_k, scale, interpret
+    )
+    o, lse = _flash_pair(
+        fold(q), fold(k), fold(v), sc, causal, block_q, block_k, H, Hkv,
+        interpret,
+    )
+    o = o.reshape(B, H, S, D).transpose(0, 2, 1, 3)
+    lse = lse.reshape(B, H, S).transpose(0, 2, 1)  # [B, S, H]
+    return o, lse

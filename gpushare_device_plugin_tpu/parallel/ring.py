@@ -42,6 +42,24 @@ def _online_update(o, m, l, s, v):
     return o_new, m_new, l_new
 
 
+def _ring_uses_kernel(Tq: int, Tk: int, hop_attention: str) -> bool:
+    """THE flash-hop gate — the single predicate both the per-shard block
+    and the ``ring_attention`` wrapper (its check_vma decision) consult,
+    so they can never diverge. Local blocks fit the Pallas kernel when
+    they mirror its auto-fit: 128-multiples, or one whole-sequence block
+    when 8-aligned and <= 1024."""
+    if hop_attention not in ("auto", "plain", "flash"):
+        raise ValueError(
+            f"unknown hop_attention={hop_attention!r}: expected auto|plain|flash"
+        )
+    if hop_attention == "flash":
+        return True
+    if hop_attention == "plain":
+        return False
+    fits = Tq == Tk and (Tq % 128 == 0 or (Tq <= 1024 and Tq % 8 == 0))
+    return jax.default_backend() == "tpu" and fits
+
+
 def ring_attention_block(
     q: jax.Array,
     k: jax.Array,
@@ -50,6 +68,7 @@ def ring_attention_block(
     axis_name: str = "sp",
     causal: bool = True,
     scale: float | None = None,
+    hop_attention: str = "auto",
 ) -> jax.Array:
     """Per-shard ring attention body — call *inside* ``shard_map``.
 
@@ -59,6 +78,14 @@ def ring_attention_block(
     grouped K/V, 1/g the ICI bytes per hop of a full-head ring.
     Returns [B, Tq, H, D]. Global sequence order is block-major: device i
     of the ``axis_name`` ring holds positions [i*T, (i+1)*T).
+
+    ``hop_attention`` selects the per-hop math: "plain" is the einsum
+    online-softmax; "flash" runs the Pallas kernel per hop
+    (:func:`..ops.flash_attention_lse` — fully-visible hops non-causal,
+    the diagonal hop causal, future hops skipped) and merges the per-hop
+    (o, lse) pairs exactly, so the sp-ring gets kernel-grade attention
+    instead of materialized [Tq, Tk] score blocks; "auto" picks flash on
+    TPU when the local block shape fits the kernel's tiling.
     """
     B, Tq, H, D = q.shape
     Tk, Hkv = k.shape[1], k.shape[2]
@@ -68,11 +95,20 @@ def ring_attention_block(
     n = jax.lax.psum(1, axis_name)
     idx = jax.lax.axis_index(axis_name)
     sc = scale if scale is not None else 1.0 / math.sqrt(D)
-    q_pos = idx * Tq + jnp.arange(Tq)  # global query positions
-    qg = q.reshape(B, Tq, Hkv, g, D)
+
+    use_kernel = _ring_uses_kernel(Tq, Tk, hop_attention)
 
     # send-to-next permutation: after step i each device holds block (idx-i)%n
     perm = [(j, (j + 1) % n) for j in range(n)]
+
+    if use_kernel:
+        return _ring_flash_hops(
+            q, k, v, idx=idx, n=n, perm=perm, axis_name=axis_name,
+            causal=causal, sc=sc,
+        )
+
+    q_pos = idx * Tq + jnp.arange(Tq)  # global query positions
+    qg = q.reshape(B, Tq, Hkv, g, D)
 
     # Derive the zero accumulators from q so they inherit q's shard-varying
     # axes (shard_map's VMA check requires loop-carry types to be stable).
@@ -126,6 +162,76 @@ def ring_attention_block(
     return jnp.transpose(out, (0, 3, 1, 2, 4)).reshape(B, Tq, H, D)
 
 
+def _ring_flash_hops(q, k, v, *, idx, n, perm, axis_name, causal, sc):
+    """Flash-kernel ring body: per-hop (o, lse) from the Pallas kernel,
+    merged exactly across hops.
+
+    Hop classification under block-major order (Tq == Tk): ``src < idx``
+    is fully visible (non-causal kernel), ``src == idx`` is the diagonal
+    (causal kernel — local causality equals global there), ``src > idx``
+    is fully future (skipped: zero contribution at lse=-inf). The merge
+    is the associative online-softmax combine, so the result is exact.
+    """
+    from ..ops import flash_attention_lse
+
+    B, Tq, H, D = q.shape
+
+    def flash(kk, vv, hop_causal):
+        o, lse = flash_attention_lse(q, kk, vv, causal=hop_causal, scale=sc)
+        return o.astype(jnp.float32), lse
+
+    def hop_result(kk, vv, src):
+        if not causal:
+            return flash(kk, vv, False)
+        empty = (
+            (q * 0.0).astype(jnp.float32),
+            q[..., 0].astype(jnp.float32) * 0.0 - jnp.inf,
+        )
+        return jax.lax.cond(
+            src > idx,
+            lambda: empty,
+            lambda: jax.lax.cond(
+                src == idx,
+                lambda: flash(kk, vv, True),
+                lambda: flash(kk, vv, False),
+            ),
+        )
+
+    def merge(o_a, lse_a, o_b, lse_b):
+        m = jnp.maximum(lse_a, lse_b)
+        m_safe = jnp.where(jnp.isneginf(m), 0.0, m)
+        wa = jnp.exp(lse_a - m_safe)
+        wb = jnp.exp(lse_b - m_safe)
+        denom = wa + wb
+        denom_safe = jnp.where(denom == 0.0, 1.0, denom)
+        o = (wa[..., None] * o_a + wb[..., None] * o_b) / denom_safe[..., None]
+        lse = m_safe + jnp.log(denom_safe)
+        lse = jnp.where(denom == 0.0, -jnp.inf, lse)
+        return o, lse
+
+    # Accumulators derive from q (shard-varying-axes stability, as in the
+    # plain path).
+    o_acc = (q * 0.0).astype(jnp.float32)
+    lse_acc = q[..., 0].astype(jnp.float32) * 0.0 - jnp.inf  # [B, Tq, H]
+
+    def body(i, carry):
+        o_acc, lse_acc, k, v = carry
+        src = (idx - i) % n
+        o_i, lse_i = hop_result(k, v, src)
+        o_acc, lse_acc = merge(o_acc, lse_acc, o_i, lse_i)
+        k = jax.lax.ppermute(k, axis_name, perm)
+        v = jax.lax.ppermute(v, axis_name, perm)
+        return o_acc, lse_acc, k, v
+
+    if n > 1:
+        o_acc, lse_acc, k, v = jax.lax.fori_loop(
+            0, n - 1, body, (o_acc, lse_acc, k, v)
+        )
+    o_i, lse_i = hop_result(k, v, (idx - (n - 1)) % n)
+    o_acc, _ = merge(o_acc, lse_acc, o_i, lse_i)
+    return o_acc.astype(q.dtype)
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -137,6 +243,7 @@ def ring_attention(
     scale: float | None = None,
     batch_axes: tuple[str, ...] | None = None,
     head_axes: str | tuple[str, ...] | None = None,
+    hop_attention: str = "auto",
 ) -> jax.Array:
     """Sequence-parallel attention over ``mesh``'s ``axis_name`` ring.
 
@@ -144,15 +251,25 @@ def ring_attention(
     sharded over ``axis_name``, the batch dim over ``batch_axes`` and the
     heads dim over ``head_axes`` (tensor parallelism composes with the ring:
     each (tp, sp) pair works on its own head/sequence tile).
-    Wraps :func:`ring_attention_block` in ``shard_map``.
+    Wraps :func:`ring_attention_block` in ``shard_map``;
+    ``hop_attention`` per the block (flash-kernel hops on TPU by default
+    when the local blocks fit the kernel tiling).
     """
     bspec = batch_axes if batch_axes else None
     spec = P(bspec, axis_name, head_axes, None)
     fn = functools.partial(
-        ring_attention_block, axis_name=axis_name, causal=causal, scale=scale
+        ring_attention_block, axis_name=axis_name, causal=causal, scale=scale,
+        hop_attention=hop_attention,
     )
+    # Same gate the block consults: pallas_call outputs carry no
+    # varying-mesh-axes metadata, so the VMA check must be off exactly
+    # when the flash hops engage (the specs above are the full truth).
+    # q and k share `spec`, so local Tq == Tk == S // n here.
+    n = mesh.shape.get(axis_name, 1)
+    Tq = q.shape[1] // n
     return jax.shard_map(
-        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=not _ring_uses_kernel(Tq, Tq, hop_attention),
     )(q, k, v)
 
 

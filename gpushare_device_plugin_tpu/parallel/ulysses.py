@@ -4,17 +4,17 @@ The second context-parallel strategy next to the ring (``ring.py``), with
 the opposite trade:
 
 - **Ring**: K/V circulate over ``sp`` (n-1 ppermute hops, each 1/n of the
-  K/V bytes); attention math is an online-softmax accumulation, so the
-  Pallas flash kernel cannot be used per-hop.
+  K/V bytes); per-hop attention is the flash kernel when the local block
+  fits (``ring.py`` merges per-hop (o, lse) pairs), else plain einsum.
 - **Ulysses**: TWO ``all_to_all`` collectives swap the sharding from
   sequence to heads and back; between them every device holds the FULL
-  sequence for H/n heads, so the inner attention is any off-the-shelf
-  implementation — including the flash kernel — over S-long sequences.
+  sequence for H/n heads, so the inner attention runs once, whole-S,
+  through any implementation — including the flash kernel.
 
 Which wins is shape-dependent: Ulysses moves O(S·H·D/n) bytes twice per
-layer but gets kernel-grade attention; the ring overlaps its hops with
-compute but does plain-math attention. Both are exact. On TPU both map to
-ICI collectives XLA schedules asynchronously.
+layer and runs one whole-sequence kernel; the ring overlaps its hop
+transfers with compute and runs a kernel per hop. Both are exact. On TPU
+both map to ICI collectives XLA schedules asynchronously.
 
 Constraint: the ``sp`` axis size must divide the head count (heads are
 scattered over it). GQA: grouped K/V with ``Hkv % n == 0`` scatters

@@ -72,10 +72,11 @@ class TransformerConfig:
     #   pods keep "full".
     remat_policy: str = "full"
     seq_parallel: bool = False
-    # Context-parallel scheme when seq_parallel: "ring" (K/V ppermute ring,
-    # online softmax, overlappable hops) or "ulysses" (two all_to_all swaps
-    # to a full-sequence/1-in-n-heads layout, so the flash kernel runs
-    # per shard). Both exact; see parallel/ulysses.py for the trade.
+    # Context-parallel scheme when seq_parallel: "ring" (K/V ppermute ring
+    # with overlappable hops; flash-kernel hops on TPU when local blocks
+    # fit) or "ulysses" (two all_to_all swaps to a full-sequence layout,
+    # one whole-S kernel per shard). Both exact; parallel/ulysses.py has
+    # the trade.
     context_parallel: str = "ring"
     # "auto": the Pallas flash kernel (ops/flash_attention.py) on TPU, plain
     # attention elsewhere (the kernel's CPU fallback is the Pallas
