@@ -181,6 +181,10 @@ def _ring_flash_hops(q, k, v, *, idx, n, perm, axis_name, causal, sc):
         return o.astype(jnp.float32), lse
 
     def hop_result(kk, vv, src):
+        """Hops 1..n-1 only (src != idx there): fully-future blocks are
+        empty, the rest run the non-causal kernel. The diagonal (src ==
+        idx, exactly hop 0) is peeled below so the loop body lowers one
+        kernel and a two-way cond instead of three branches."""
         if not causal:
             return flash(kk, vv, False)
         empty = (
@@ -188,13 +192,7 @@ def _ring_flash_hops(q, k, v, *, idx, n, perm, axis_name, causal, sc):
             q[..., 0].astype(jnp.float32) * 0.0 - jnp.inf,
         )
         return jax.lax.cond(
-            src > idx,
-            lambda: empty,
-            lambda: jax.lax.cond(
-                src == idx,
-                lambda: flash(kk, vv, True),
-                lambda: flash(kk, vv, False),
-            ),
+            src > idx, lambda: empty, lambda: flash(kk, vv, False)
         )
 
     def merge(o_a, lse_a, o_b, lse_b):
@@ -209,10 +207,13 @@ def _ring_flash_hops(q, k, v, *, idx, n, perm, axis_name, causal, sc):
         lse = jnp.where(denom == 0.0, -jnp.inf, lse)
         return o, lse
 
-    # Accumulators derive from q (shard-varying-axes stability, as in the
-    # plain path).
-    o_acc = (q * 0.0).astype(jnp.float32)
-    lse_acc = q[..., 0].astype(jnp.float32) * 0.0 - jnp.inf  # [B, Tq, H]
+    # Peeled hop 0: every device starts holding its own block (src ==
+    # idx) — the diagonal, the only hop where local causality applies.
+    o_acc, lse_acc = flash(k, v, causal)
+    if n == 1:
+        return o_acc.astype(q.dtype)
+    k = jax.lax.ppermute(k, axis_name, perm)
+    v = jax.lax.ppermute(v, axis_name, perm)
 
     def body(i, carry):
         o_acc, lse_acc, k, v = carry
@@ -223,9 +224,9 @@ def _ring_flash_hops(q, k, v, *, idx, n, perm, axis_name, causal, sc):
         v = jax.lax.ppermute(v, axis_name, perm)
         return o_acc, lse_acc, k, v
 
-    if n > 1:
+    if n > 2:
         o_acc, lse_acc, k, v = jax.lax.fori_loop(
-            0, n - 1, body, (o_acc, lse_acc, k, v)
+            1, n - 1, body, (o_acc, lse_acc, k, v)
         )
     o_i, lse_i = hop_result(k, v, (idx - (n - 1)) % n)
     o_acc, _ = merge(o_acc, lse_acc, o_i, lse_i)

@@ -259,6 +259,9 @@ def _layer(x, lp, cfg: TransformerConfig, positions, mesh: Mesh | None):
             attn = ring_attention(
                 q, k, v, mesh, axis_name="sp", causal=True,
                 batch_axes=("dp", "fsdp"), head_axes="tp",
+                # cfg.attention's force semantics extend to the hops:
+                # "plain" must really rule out the Mosaic kernel.
+                hop_attention=cfg.attention,
             )
     else:
         attn = flash_or_plain(
@@ -331,18 +334,60 @@ def make_optimizer(lr: float = 3e-4, **kw) -> optax.GradientTransformation:
     return _mk(lr, **kw)
 
 
-def make_train_step(mesh: Mesh, cfg: TransformerConfig, optimizer=None):
+def make_train_step(
+    mesh: Mesh, cfg: TransformerConfig, optimizer=None, accum_steps: int = 1
+):
     """Jitted sharded train step: (params, opt_state, tokens) -> (params, opt_state, loss).
 
     Data shards [('dp','fsdp'), 'sp'] — batch over data axes, sequence over
     the ring axis. Params/opt-state keep their NamedShardings (donated).
+
+    ``accum_steps > 1`` splits the batch into that many microbatches and
+    accumulates gradients over a ``lax.scan`` before the single optimizer
+    update — activation memory drops to one microbatch's worth while the
+    update equals the full-batch step up to f32 summation-order rounding
+    (mean-of-means over equal microbatches; pinned by tests). The fractional-HBM knob on the
+    training side: a pod on a small ``tpu-mem`` slice raises
+    ``accum_steps`` instead of shrinking its effective batch.
     """
     opt = optimizer or make_optimizer()
     psh = param_shardings(mesh, cfg)
     data_sh = batch_sharding(mesh, seq_parallel=cfg.seq_parallel)
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+
+    def grads_of(params, tokens):
+        return jax.value_and_grad(loss_fn)(params, tokens, cfg, mesh)
 
     def step(params, opt_state, tokens):
-        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg, mesh)
+        if accum_steps == 1:
+            loss, grads = grads_of(params, tokens)
+        else:
+            B = tokens.shape[0]
+            if B % accum_steps:
+                raise ValueError(
+                    f"batch {B} not divisible by accum_steps={accum_steps}"
+                )
+            # Strided split: microbatch i takes every accum_steps-th row,
+            # so each microbatch stays evenly spread over the ('dp',
+            # 'fsdp') batch shards — a contiguous split would put a whole
+            # microbatch on a fraction of the devices and force a
+            # reshard (or idle devices) every accumulation step.
+            micros = tokens.reshape(B // accum_steps, accum_steps, -1).swapaxes(0, 1)
+
+            def accum(carry, micro):
+                loss_sum, grads = carry
+                l, g = grads_of(params, micro)
+                return (loss_sum + l, jax.tree.map(jnp.add, grads, g)), None
+
+            zeros = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), params
+            )
+            (loss_sum, grads), _ = jax.lax.scan(
+                accum, (jnp.float32(0.0), zeros), micros
+            )
+            loss = loss_sum / accum_steps
+            grads = jax.tree.map(lambda g: g / accum_steps, grads)
         updates, opt_state = opt.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss

@@ -239,3 +239,58 @@ def test_remat_policy_unknown_raises():
     tokens = demo_batch(jax.random.key(1), 1, 8, cfg.vocab)
     with pytest.raises(ValueError, match="remat_policy"):
         forward(params, tokens, cfg)
+
+
+def test_grad_accumulation_matches_full_batch():
+    """accum_steps microbatching must produce the full-batch step's
+    update (equal microbatches: mean-of-means == mean) to f32
+    summation-order rounding, at one microbatch's activation memory."""
+    import dataclasses
+
+    cfg = dataclasses.replace(TINY, remat=False)
+    mesh = make_mesh(MeshSpec(dp=1, fsdp=1, tp=1), devices=jax.devices()[:1])
+    tokens = demo_batch(jax.random.key(1), 4, 16, cfg.vocab)
+
+    outs = {}
+    for accum in (1, 2, 4):
+        params, opt_state = init_train_state(jax.random.key(0), mesh, cfg)
+        step = make_train_step(mesh, cfg, accum_steps=accum)
+        params, opt_state, loss = step(params, opt_state, tokens)
+        outs[accum] = (params, float(loss))
+    _, l1 = outs[1]
+    for accum in (2, 4):
+        p, l = outs[accum]
+        assert l == pytest.approx(l1, abs=1e-6)
+        # post-optimizer params: f32 reduction-order rounding only (a
+        # wrong mean would be O(1) off, not O(1e-4))
+        for a, b in zip(jax.tree.leaves(p), jax.tree.leaves(outs[1][0])):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=5e-4
+            )
+
+
+def test_grad_accumulation_validation():
+    mesh = make_mesh(MeshSpec(dp=1, fsdp=1, tp=1), devices=jax.devices()[:1])
+    with pytest.raises(ValueError, match="accum_steps"):
+        make_train_step(mesh, TINY, accum_steps=0)
+    step = make_train_step(mesh, TINY, accum_steps=3)
+    params, opt_state = init_train_state(jax.random.key(0), mesh, TINY)
+    tokens = demo_batch(jax.random.key(1), 4, 16, TINY.vocab)  # 4 % 3 != 0
+    with pytest.raises(ValueError, match="not divisible"):
+        step(params, opt_state, tokens)
+
+
+def test_grad_accumulation_on_mesh_with_remat():
+    """Microbatching composes with fsdp/tp sharding and dots remat."""
+    import dataclasses
+
+    cfg = dataclasses.replace(TINY, remat_policy="dots")
+    mesh = make_mesh(MeshSpec(dp=2, fsdp=2, tp=2))
+    params, opt_state = init_train_state(jax.random.key(0), mesh, cfg)
+    step = make_train_step(mesh, cfg, accum_steps=2)
+    tokens = demo_batch(jax.random.key(1), 4, 16, cfg.vocab)
+    first = None
+    for _ in range(4):
+        params, opt_state, loss = step(params, opt_state, tokens)
+        first = float(loss) if first is None else first
+    assert float(loss) < first
