@@ -1,0 +1,124 @@
+"""LoRA adapters: zero-init identity, adapter-only training, serving.
+
+The contract chain: fresh adapters change nothing (B=0); training moves
+only the adapters (base frozen, optimizer state adapter-sized); the
+merged tree drops into every existing entry point including generation
+and int8 quantization.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow
+
+from gpushare_device_plugin_tpu.parallel import MeshSpec, make_mesh
+from gpushare_device_plugin_tpu.workloads import generate as G
+from gpushare_device_plugin_tpu.workloads import lora as La
+from gpushare_device_plugin_tpu.workloads.quant import quantize_decoder
+from gpushare_device_plugin_tpu.workloads.transformer import (
+    TransformerConfig,
+    demo_batch,
+    init_params,
+    loss_fn,
+)
+
+
+def _cfg():
+    return TransformerConfig(
+        vocab=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2, d_ff=64,
+        max_seq=64, compute_dtype=jnp.float32,
+    )
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = _cfg()
+    lcfg = La.LoraConfig(rank=4, targets=("wq", "wo", "wkv", "wi", "wdown"))
+    params = init_params(jax.random.key(0), cfg)
+    lora = La.init_lora(jax.random.key(1), cfg, lcfg)
+    tokens = demo_batch(jax.random.key(2), 2, 16, cfg.vocab)
+    return cfg, lcfg, params, lora, tokens
+
+
+def test_lora_shapes_and_size(setup):
+    cfg, lcfg, params, lora, _ = setup
+    assert set(lora) == {"wq", "wo", "wkv", "wi", "wdown"}
+    L, r = cfg.n_layers, lcfg.rank
+    assert lora["wq"]["a"].shape == (L, cfg.d_model, r)
+    assert lora["wq"]["b"].shape == (L, r, cfg.n_heads, cfg.head_dim)
+    assert lora["wo"]["a"].shape == (L, cfg.n_heads, cfg.head_dim, r)
+    assert lora["wo"]["b"].shape == (L, r, cfg.d_model)
+    assert lora["wkv"]["b"].shape == (L, r, 2, cfg.kv_heads, cfg.head_dim)
+    assert lora["wi"]["b"].shape == (L, r, 2, cfg.d_ff)
+    assert lora["wdown"]["a"].shape == (L, cfg.d_ff, r)
+    # adapters are a fraction of the base even at this toy scale (d=32,
+    # all five targets); at real widths the ratio is ~r/d per target
+    base = sum(x.size for x in jax.tree.leaves(params))
+    assert La.lora_param_count(lora) < base / 2
+
+
+def test_zero_init_merge_is_identity(setup):
+    cfg, lcfg, params, lora, tokens = setup
+    merged = La.merge_lora(params, lora, lcfg)
+    for name in ("wq", "wo", "wkv", "wi", "wdown"):
+        np.testing.assert_array_equal(
+            np.asarray(merged["layers"][name]),
+            np.asarray(params["layers"][name]),
+        )
+    # untargeted weights are the same object, not copies
+    assert merged["layers"]["ln1"] is params["layers"]["ln1"]
+    assert merged["embed"] is params["embed"]
+    assert float(La.lora_loss_fn(lora, params, tokens, cfg, lcfg)) == (
+        pytest.approx(float(loss_fn(params, tokens, cfg)), abs=1e-6)
+    )
+
+
+def test_lora_training_moves_only_adapters(setup):
+    cfg, lcfg, params, lora, tokens = setup
+    # the step donates its adapter/opt-state buffers — copy so the
+    # module-scoped fixture survives for later tests
+    lora = jax.tree.map(jnp.array, lora)
+    mesh = make_mesh(MeshSpec(dp=1, fsdp=1, tp=1), devices=jax.devices()[:1])
+    step, init_opt = La.make_lora_train_step(mesh, cfg, lcfg)
+    opt_state = init_opt(lora)
+    base_before = jax.tree.map(lambda x: np.asarray(x).copy(), params)
+    first = None
+    for _ in range(8):
+        lora, opt_state, loss = step(params, lora, opt_state, tokens)
+        first = float(loss) if first is None else first
+    assert float(loss) < first  # adapters learn
+    # the frozen base is bit-identical
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(base_before)):
+        np.testing.assert_array_equal(np.asarray(a), b)
+    # and B actually moved off zero
+    assert float(jnp.abs(lora["wq"]["b"]).sum()) > 0
+
+
+def test_merged_tree_serves_and_quantizes(setup):
+    cfg, lcfg, params, lora, _ = setup
+    # pretend-trained adapters: perturb B so the delta is nonzero
+    lora = jax.tree.map(lambda x: x + 0.01, lora)
+    merged = La.merge_lora(params, lora, lcfg)
+    prompt = jnp.ones((1, 6), jnp.int32)
+    out = G.generate(merged, prompt, cfg, max_new=3)
+    assert out.shape == (1, 9)
+    # LoRA + int8 compose: quantize the merged tree and serve from it
+    q = quantize_decoder(merged)
+    out_q = G.generate(q, prompt, cfg, max_new=3)
+    assert out_q.shape == (1, 9)
+
+
+def test_lora_validation(setup):
+    cfg, lcfg, *_ = setup
+    with pytest.raises(ValueError, match="rank"):
+        La.init_lora(jax.random.key(0), cfg, La.LoraConfig(rank=0))
+    with pytest.raises(ValueError, match="target"):
+        La.init_lora(
+            jax.random.key(0), cfg, La.LoraConfig(targets=("embed",))
+        )
+    with pytest.raises(ValueError, match="duplicate"):
+        La.init_lora(
+            jax.random.key(0), cfg, La.LoraConfig(targets=("wq", "wq"))
+        )
