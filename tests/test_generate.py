@@ -346,3 +346,24 @@ def test_speculative_validation(spec_setup):
         G.speculative_generate(
             t_params, d_params, prompt, t_cfg, bad, max_new=4
         )
+
+
+def test_prefix_cache_reuse_branches_continuations(setup):
+    """Prefix caching falls out of the functional cache design: caches
+    are immutable pytrees, so the post-prefill cache is a reusable
+    snapshot — decode from it twice (different first tokens) and each
+    branch must equal an independent full run over the concatenated
+    sequence. No copy, no invalidation — the serving pattern for shared
+    system prompts."""
+    cfg, params, prompt = setup
+    cache0 = G.init_cache(cfg, prompt.shape[0], 16)
+    logits0, snap = G.prefill(params, prompt, cache0, cfg)
+
+    for branch_tok in (3, 7):
+        tok = jnp.full((prompt.shape[0],), branch_tok, jnp.int32)
+        logits, _ = G.decode_step(params, tok, snap, cfg)
+        grown = jnp.concatenate([prompt, tok[:, None]], axis=1)
+        ref = forward(params, grown, cfg)[:, -1]
+        assert jnp.allclose(logits, ref, atol=1e-4), branch_tok
+    # the snapshot itself is untouched by either branch
+    assert int(snap["len"]) == prompt.shape[1]
