@@ -527,6 +527,20 @@ def _flash_pair_bwd(
 _flash_pair.defvjp(_flash_pair_fwd, _flash_pair_bwd)
 
 
+def fits_kernel(S: int, D: int | None = None) -> bool:
+    """True when the auto-fit in ``_entry_prologue`` lands on a legal
+    block configuration for sequence length ``S`` — THE predicate every
+    trace-time gate consults (``workloads.attention.use_flash``, the
+    ring's flash-hop gate), exported from here so a block-policy change
+    can never silently diverge from its gates. ``D`` is accepted for
+    future head-dim-dependent policies; the current fit is D-independent
+    (large D only halves the starting defaults, which the shrink loop
+    covers anyway).
+    """
+    del D
+    return S % 128 == 0 or (S <= 1024 and S % 8 == 0)
+
+
 def _entry_prologue(q, k, block_q, block_k, scale, interpret):
     """Shared public-entry prologue (flash_attention AND
     flash_attention_lse — one copy so block tuning can never drift

@@ -45,9 +45,10 @@ def _online_update(o, m, l, s, v):
 def _ring_uses_kernel(Tq: int, Tk: int, hop_attention: str) -> bool:
     """THE flash-hop gate — the single predicate both the per-shard block
     and the ``ring_attention`` wrapper (its check_vma decision) consult,
-    so they can never diverge. Local blocks fit the Pallas kernel when
-    they mirror its auto-fit: 128-multiples, or one whole-sequence block
-    when 8-aligned and <= 1024."""
+    so they can never diverge; block fit defers to the kernel module's
+    own ``fits_kernel`` (one copy repo-wide)."""
+    from ..ops.flash_attention import fits_kernel
+
     if hop_attention not in ("auto", "plain", "flash"):
         raise ValueError(
             f"unknown hop_attention={hop_attention!r}: expected auto|plain|flash"
@@ -56,8 +57,9 @@ def _ring_uses_kernel(Tq: int, Tk: int, hop_attention: str) -> bool:
         return True
     if hop_attention == "plain":
         return False
-    fits = Tq == Tk and (Tq % 128 == 0 or (Tq <= 1024 and Tq % 8 == 0))
-    return jax.default_backend() == "tpu" and fits
+    return (
+        jax.default_backend() == "tpu" and Tq == Tk and fits_kernel(Tq)
+    )
 
 
 def ring_attention_block(

@@ -16,6 +16,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops import flash_attention
+from ..ops.flash_attention import fits_kernel
 from ..parallel.ring import grouped_attention
 
 
@@ -56,12 +57,10 @@ def use_flash(
     if jax.default_backend() != "tpu":
         return False
     B, S, H = q.shape[0], q.shape[1], q.shape[2]
-    # Coupled to flash_attention's default-block auto-shrink (defaults
-    # halved to a pow2 divisor of S, floored at 128, whole-S fallback when
-    # S <= 1024): a multiple of 128 always lands on a legal block, and any
-    # 8-aligned S up to 1024 runs as one whole-sequence block (Mosaic
-    # needs the sublane dim 8-divisible or equal to the array dim).
-    if not (S % 128 == 0 or (S <= 1024 and S % 8 == 0)):
+    # The kernel module's own fit predicate (one copy repo-wide): a
+    # multiple of 128 always lands on a legal block, and any 8-aligned S
+    # up to 1024 runs as one whole-sequence block.
+    if not fits_kernel(S, q.shape[-1]):
         return False
     if mesh is not None:
         data = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)

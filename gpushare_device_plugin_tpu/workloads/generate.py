@@ -307,17 +307,13 @@ def decode_block(
             )
             k_mat, v_mat = k_cache, v_cache
             carry = (k_cache, v_cache)
-        # Block-causal attention over the cache (vis computed above),
-        # grouped einsums, f32 softmax like every attention path here.
-        Hkv = k_mat.shape[2]
-        g = q.shape[2] // Hkv
-        qg = q.reshape(B, T, Hkv, g, -1)
-        s = jnp.einsum("btkgd,bskd->bkgts", qg, k_mat).astype(jnp.float32)
-        s = s / jnp.sqrt(jnp.float32(q.shape[-1]))
-        s = jnp.where(vis[:, None, None], s, -jnp.inf)
-        p = jax.nn.softmax(s, axis=-1)
-        attn = jnp.einsum("bkgts,bskd->btkgd", p, v_mat).astype(q.dtype)
-        attn = attn.reshape(B, T, -1, q.shape[-1])
+        # Block-causal attention over the cache: the shared grouped-
+        # attention math (rectangular q/k, explicit mask, dead-row zero
+        # guard — one implementation repo-wide) with `vis` as the mask.
+        attn = grouped_attention(
+            q, k_mat, v_mat, causal=False,
+            mask=jnp.broadcast_to(vis, (B, T, Smax)),
+        )
         x = x + jnp.einsum("bthn,hnd->btd", attn, matmul_weight(lp["wo"], dt))
         return _mlp_block(x, lp, cfg), carry
 
