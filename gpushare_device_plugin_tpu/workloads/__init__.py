@@ -19,6 +19,7 @@ from .generate import (  # noqa: F401
     make_speculative_generate,
     sample_logits,
 )
+from .convert import from_hf_llama, to_hf_llama  # noqa: F401
 from .lora import LoraConfig, init_lora, make_lora_train_step, merge_lora  # noqa: F401
 from .optim import make_optimizer  # noqa: F401
 from .resnet import ResNetConfig  # noqa: F401

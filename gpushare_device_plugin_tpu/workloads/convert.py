@@ -1,0 +1,131 @@
+"""Checkpoint conversion: HF-Llama-style state dicts <-> this repo's tree.
+
+A user arriving from the standard ecosystem has per-layer weights named
+``model.layers.{i}.self_attn.q_proj.weight`` etc. (each a 2-D
+``[out_features, in_features]`` matrix, torch convention); this repo's
+decoder stores stacked-over-layers einsum-shaped arrays
+(``transformer.init_params``: ``wq [L, d, H, Dh]``, ``wkv [L, d, 2, Hkv,
+Dh]``, ...). The mapping is pure reshapes/transposes — no numerics —
+and is verified by a round-trip test against the exact inverse.
+
+Scope: the Llama decoder family (what ``TransformerConfig`` models —
+RMSNorm, RoPE, SwiGLU, GQA, untied lm_head). Inputs are plain
+name->array mappings (numpy or jax arrays); torch tensors should be
+converted with ``.numpy()`` first — this module never imports torch.
+
+Note on RoPE conventions: this repo rotates (x[:half], x[half:]) pairs —
+the same "rotate_half" layout HF's modeling code uses — so projection
+weights map 1:1 with no permutation.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import jax.numpy as jnp
+import numpy as np
+
+from .transformer import TransformerConfig
+
+Params = dict[str, Any]
+
+
+def _hf_names(i: int) -> dict[str, str]:
+    p = f"model.layers.{i}."
+    return {
+        "wq": p + "self_attn.q_proj.weight",
+        "wk": p + "self_attn.k_proj.weight",
+        "wv": p + "self_attn.v_proj.weight",
+        "wo": p + "self_attn.o_proj.weight",
+        "wgate": p + "mlp.gate_proj.weight",
+        "wup": p + "mlp.up_proj.weight",
+        "wdown": p + "mlp.down_proj.weight",
+        "ln1": p + "input_layernorm.weight",
+        "ln2": p + "post_attention_layernorm.weight",
+    }
+
+
+def from_hf_llama(
+    state: Mapping[str, Any], cfg: TransformerConfig
+) -> Params:
+    """HF-Llama name->array mapping -> ``init_params``-shaped tree (f32).
+
+    Expects the standard keys (``model.embed_tokens.weight``,
+    ``model.layers.{i}.*``, ``model.norm.weight``, ``lm_head.weight``)
+    with torch ``[out, in]`` matrix convention. Raises KeyError with the
+    missing name if the state dict doesn't match ``cfg``'s layer count.
+    """
+    d, H, Dh, Hkv, F, L = (
+        cfg.d_model, cfg.n_heads, cfg.head_dim, cfg.kv_heads,
+        cfg.d_ff, cfg.n_layers,
+    )
+
+    def arr(name):
+        return jnp.asarray(np.asarray(state[name]), jnp.float32)
+
+    layers: dict[str, list] = {k: [] for k in (
+        "wq", "wkv", "wo", "wi", "wdown", "ln1", "ln2"
+    )}
+    for i in range(L):
+        n = _hf_names(i)
+        # q_proj [H*Dh, d] -> [d, H, Dh]
+        layers["wq"].append(arr(n["wq"]).reshape(H, Dh, d).transpose(2, 0, 1))
+        # k/v [Hkv*Dh, d] -> stacked [d, 2, Hkv, Dh]
+        wk = arr(n["wk"]).reshape(Hkv, Dh, d).transpose(2, 0, 1)
+        wv = arr(n["wv"]).reshape(Hkv, Dh, d).transpose(2, 0, 1)
+        layers["wkv"].append(jnp.stack([wk, wv], axis=1))
+        # o_proj [d, H*Dh] -> [H, Dh, d]
+        layers["wo"].append(arr(n["wo"]).reshape(d, H, Dh).transpose(1, 2, 0))
+        # gate/up [F, d] -> stacked [d, 2, F]
+        wg = arr(n["wgate"]).T  # [d, F]
+        wu = arr(n["wup"]).T
+        layers["wi"].append(jnp.stack([wg, wu], axis=1))
+        # down [d, F] -> [F, d]
+        layers["wdown"].append(arr(n["wdown"]).T)
+        layers["ln1"].append(arr(n["ln1"]))
+        layers["ln2"].append(arr(n["ln2"]))
+
+    return {
+        "embed": arr("model.embed_tokens.weight"),  # [V, d]
+        "layers": {k: jnp.stack(v) for k, v in layers.items()},
+        "final_norm": arr("model.norm.weight"),
+        "out": arr("lm_head.weight").T,  # [V, d] -> [d, V]
+    }
+
+
+def to_hf_llama(params: Params, cfg: TransformerConfig) -> dict[str, np.ndarray]:
+    """Exact inverse of :func:`from_hf_llama` (numpy outputs) — exporting
+    a trained/merged tree back to the standard layout, and the round-trip
+    oracle for the import test."""
+    d, H, Dh, Hkv, F, L = (
+        cfg.d_model, cfg.n_heads, cfg.head_dim, cfg.kv_heads,
+        cfg.d_ff, cfg.n_layers,
+    )
+    lp = params["layers"]
+    out: dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": np.asarray(params["embed"], np.float32),
+        "model.norm.weight": np.asarray(params["final_norm"], np.float32),
+        "lm_head.weight": np.asarray(params["out"], np.float32).T,
+    }
+    for i in range(L):
+        n = _hf_names(i)
+        out[n["wq"]] = np.asarray(
+            jnp.transpose(lp["wq"][i], (1, 2, 0)).reshape(H * Dh, d), np.float32
+        )
+        out[n["wk"]] = np.asarray(
+            jnp.transpose(lp["wkv"][i, :, 0], (1, 2, 0)).reshape(Hkv * Dh, d),
+            np.float32,
+        )
+        out[n["wv"]] = np.asarray(
+            jnp.transpose(lp["wkv"][i, :, 1], (1, 2, 0)).reshape(Hkv * Dh, d),
+            np.float32,
+        )
+        out[n["wo"]] = np.asarray(
+            jnp.transpose(lp["wo"][i], (2, 0, 1)).reshape(d, H * Dh), np.float32
+        )
+        out[n["wgate"]] = np.asarray(lp["wi"][i, :, 0], np.float32).T
+        out[n["wup"]] = np.asarray(lp["wi"][i, :, 1], np.float32).T
+        out[n["wdown"]] = np.asarray(lp["wdown"][i], np.float32).T
+        out[n["ln1"]] = np.asarray(lp["ln1"][i], np.float32)
+        out[n["ln2"]] = np.asarray(lp["ln2"][i], np.float32)
+    return out

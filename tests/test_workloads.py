@@ -301,10 +301,6 @@ def test_seq_parallel_flash_hops_loss_matches_dense():
     flash-kernel hops (forced through the interpreter here) and the loss
     must still equal the dense no-mesh forward — the end-to-end proof of
     the cfg.attention -> hop_attention threading."""
-    import dataclasses
-
-    from gpushare_device_plugin_tpu.workloads.transformer import loss_fn
-
     mesh = make_mesh(MeshSpec(dp=1, fsdp=1, tp=1, sp=8))
     base = dict(
         vocab=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=4, d_ff=64,
