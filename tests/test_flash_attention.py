@@ -294,3 +294,57 @@ def test_large_head_dim_default_blocks():
     out = flash_attention(q, k, v, causal=True, interpret=True)
     ref = full_attention(q, k, v, causal=True)
     assert jnp.allclose(out, ref, atol=2e-5), float(jnp.abs(out - ref).max())
+
+
+# --- (o, lse) pair entry ----------------------------------------------------
+
+def lse_oracle(q, k, v, causal):
+    B, S, H, D = q.shape
+    Hkv = k.shape[2]
+    g = H // Hkv
+    qg = q.reshape(B, S, Hkv, g, D)
+    s = jnp.einsum("bqkgd,bskd->bkgqs", qg, k).astype(jnp.float32)
+    s = s / jnp.sqrt(jnp.float32(D))
+    if causal:
+        m = jnp.tril(jnp.ones((S, S), bool))
+        s = jnp.where(m[None, None, None], s, -jnp.inf)
+    lse = jax.scipy.special.logsumexp(s, axis=-1)  # [B, Hkv, g, S]
+    return lse.transpose(0, 3, 1, 2).reshape(B, S, H)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_lse_pair_matches_oracle(causal):
+    from gpushare_device_plugin_tpu.ops import flash_attention_lse
+
+    q, k, v = make_gqa_qkv(jax.random.key(20), B=2, S=128, H=4, Hkv=2, D=32)
+    o, lse = flash_attention_lse(q, k, v, causal=causal, interpret=True)
+    ref_o = gqa_oracle(q, k, v, causal=causal)
+    assert jnp.allclose(o, ref_o, atol=2e-5), float(jnp.abs(o - ref_o).max())
+    ref_lse = lse_oracle(q, k, v, causal)
+    assert lse.shape == (2, 128, 4) and lse.dtype == jnp.float32
+    assert jnp.allclose(lse, ref_lse, atol=2e-5), float(
+        jnp.abs(lse - ref_lse).max()
+    )
+
+
+def test_lse_pair_gradients_include_dlse():
+    """A loss that consumes BOTH outputs exercises the dlse fold in the
+    backward (ds = p*(dp - (delta - dlse))) — the path the flash-hop
+    ring's cross-hop merge differentiates through."""
+    from gpushare_device_plugin_tpu.ops import flash_attention_lse
+
+    q, k, v = make_gqa_qkv(jax.random.key(21), B=1, S=128, H=4, Hkv=2, D=32)
+
+    def loss_flash(q, k, v):
+        o, lse = flash_attention_lse(q, k, v, causal=True, interpret=True)
+        return jnp.sum(o.astype(jnp.float32) ** 2) + jnp.sum(jnp.sin(lse))
+
+    def loss_ref(q, k, v):
+        o = gqa_oracle(q, k, v, causal=True)
+        lse = lse_oracle(q, k, v, True)
+        return jnp.sum(o.astype(jnp.float32) ** 2) + jnp.sum(jnp.sin(lse))
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        assert jnp.allclose(a, b, atol=1e-4), float(jnp.abs(a - b).max())
