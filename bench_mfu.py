@@ -533,6 +533,7 @@ def bench_serve(report: dict, smoke: bool = False) -> None:
         for label, p, pbytes, kv in (
             ("bf16", params, serve["param_bytes_bf16"], None),
             ("int8", qparams, serve["param_bytes_int8"], None),
+            ("bf16_kv8", params, serve["param_bytes_bf16"], "int8"),
             ("int8_kv8", qparams, serve["param_bytes_int8"], "int8"),
         ):
             gen = G.make_generate(cfg, max_new=max_new, kv_dtype=kv)
