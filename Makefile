@@ -53,3 +53,9 @@ lint:
 
 bench:
 	$(PY) bench.py
+
+# Full on-chip compute capture: decode/train/flash/serve plus the step-
+# time ablation and the flash block-size sweep (real TPU required; off
+# chip the watchdog emits an explicit skip record). See docs/perf.md.
+bench-chip:
+	$(PY) bench_mfu.py --ablate --sweep
