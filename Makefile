@@ -1,7 +1,7 @@
 PY ?= python
 PROTOC ?= protoc
 
-.PHONY: proto native test test-fast test-slow test-stress lint bench e2e-kind
+.PHONY: proto native test test-fast test-slow test-stress chaos lint bench e2e-kind
 
 # Regenerate protobuf message classes (gRPC bindings are hand-written in
 # gpushare_device_plugin_tpu/plugin/api/api_grpc.py; grpc_tools is not
@@ -39,6 +39,13 @@ test-stress:
 	    tests/test_manager.py tests/test_extender.py tests/test_plugin_e2e.py \
 	    -x -q || exit 1; \
 	done
+
+# Fault-injection / degraded-mode suite (docs/robustness.md): apiserver
+# blackouts, 5xx storms, watch churn, kubelet restart storms, supervised
+# health-watcher crashes — replayed through the real manager loop. Also
+# part of tier-1 ('not slow'); this target runs it alone.
+chaos:
+	$(PY) -m pytest tests/ -x -q -m chaos
 
 # kind end-to-end: deploy the manifests with mock discovery on a local kind
 # cluster and assert the demo pod admits with TPU_VISIBLE_CHIPS injected

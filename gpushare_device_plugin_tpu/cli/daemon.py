@@ -70,6 +70,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--metrics-port", type=int, default=0,
                    help="serve Prometheus /metrics on this port (0 = off; "
                    "the reference had no metrics at all)")
+    # degraded-mode knobs (docs/robustness.md)
+    p.add_argument("--breaker-threshold", type=int, default=5,
+                   help="consecutive apiserver failures before the circuit "
+                   "opens and calls fail fast")
+    p.add_argument("--breaker-reset-s", type=float, default=5.0,
+                   help="seconds the circuit stays open before a half-open "
+                   "probe")
     p.add_argument("-v", "--verbosity", type=int, default=0)
     return p
 
@@ -88,6 +95,12 @@ def build_kubelet_token(args) -> str:
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     logutil.setup(args.verbosity)
+
+    # e2e fault injection (TPUSHARE_FAULTS="apiserver.request=error:5,...")
+    from ..utils.faults import FAULTS
+
+    if FAULTS.install_from_env():
+        log.warning("fault injection ACTIVE at points: %s", FAULTS.active())
 
     backend = from_name(args.discovery)
     cfg = ManagerConfig(
@@ -111,6 +124,12 @@ def main(argv=None) -> int:
             api_client = ApiServerClient.from_env(timeout_s=args.timeout)
         except Exception as e:  # bad/garbled kubeconfig, missing SA, etc.
             log.fatal(f"apiserver config failed: {e} (use --standalone for no-cluster mode)")
+        from ..utils.circuit import CircuitBreaker
+
+        api_client.breaker = CircuitBreaker(
+            failure_threshold=args.breaker_threshold,
+            reset_timeout_s=args.breaker_reset_s,
+        )
         apisrc = ApiServerPodSource(api_client, args.node_name)
         if args.query_kubelet:
             cert = None

@@ -27,6 +27,8 @@ from typing import Any, Mapping
 
 import requests
 
+from ..utils.circuit import CircuitBreaker
+from ..utils.faults import FAULTS
 from ..utils.log import get_logger
 
 log = get_logger("cluster.apiserver")
@@ -52,9 +54,14 @@ class ApiServerClient:
         client_cert: tuple[str, str] | None = None,
         insecure: bool = False,
         timeout_s: float = 10.0,
+        breaker: CircuitBreaker | None = None,
     ):
         self.base_url = base_url.rstrip("/")
         self._timeout = timeout_s
+        # One breaker across every verb AND the watch: they share the
+        # endpoint, so evidence of an outage from any of them should stop
+        # all of them from stacking connect timeouts (see utils.circuit).
+        self.breaker = breaker if breaker is not None else CircuitBreaker()
         self._session = requests.Session()
         # Cluster-internal endpoints only: skip the per-request environment
         # scan for proxies/netrc (~0.3 ms per call on the Allocate path;
@@ -112,6 +119,38 @@ class ApiServerClient:
         params: Mapping[str, str] | None = None,
         body: str | None = None,
         content_type: str | None = None,
+        timeout_s: float | None = None,
+    ) -> tuple[int, str]:
+        """One unary round-trip, gated by the circuit breaker.
+
+        Transport failures and 5xx responses count against the breaker
+        (both mean "the control plane is not serving us"); 2xx/4xx close
+        it — a 404 or 409 is the apiserver working as intended.
+        ``timeout_s`` overrides the client timeout for this call only
+        (callers under an admission deadline can't afford the default).
+        """
+        self.breaker.before()  # raises CircuitOpenError while open
+        try:
+            status, text = self._do_request(
+                method, path, params, body, content_type, timeout_s
+            )
+        except Exception:
+            self.breaker.record_failure()
+            raise
+        if status >= 500:
+            self.breaker.record_failure()
+        else:
+            self.breaker.record_success()
+        return status, text
+
+    def _do_request(
+        self,
+        method: str,
+        path: str,
+        params: Mapping[str, str] | None = None,
+        body: str | None = None,
+        content_type: str | None = None,
+        timeout_s: float | None = None,
     ) -> tuple[int, str]:
         """One unary round-trip on the persistent connection.
 
@@ -124,6 +163,7 @@ class ApiServerClient:
         (re-sending a Binding would 409 a pod that is actually bound), so
         it propagates.
         """
+        FAULTS.fire("apiserver.request")
         if params:
             path = path + "?" + urllib.parse.urlencode(params)
         path = self._base_path + path
@@ -133,6 +173,14 @@ class ApiServerClient:
         idempotent = method == "GET"
         for attempt in (0, 1):
             conn = self._connection()
+            if timeout_s is not None:
+                # Per-call override on the shared per-thread connection:
+                # conn.timeout governs the (re)connect, settimeout the
+                # reads on a live socket. Restored in the finally so later
+                # callers on this thread get the client default back.
+                conn.timeout = timeout_s
+                if conn.sock is not None:
+                    conn.sock.settimeout(timeout_s)
             sent = False
             try:
                 conn.request(method, path, body=body, headers=headers)
@@ -150,6 +198,14 @@ class ApiServerClient:
                 )
                 if attempt or not retriable:
                     raise
+            finally:
+                if timeout_s is not None:
+                    conn.timeout = self._timeout
+                    try:
+                        if conn.sock is not None:
+                            conn.sock.settimeout(self._timeout)
+                    except Exception:  # noqa: BLE001 — socket already dead
+                        pass
 
     # --- construction ------------------------------------------------------
 
@@ -249,8 +305,13 @@ class ApiServerClient:
 
     # --- raw verbs ----------------------------------------------------------
 
-    def _get(self, path: str, params: Mapping[str, str] | None = None) -> dict:
-        status, text = self._request("GET", path, params)
+    def _get(
+        self,
+        path: str,
+        params: Mapping[str, str] | None = None,
+        timeout_s: float | None = None,
+    ) -> dict:
+        status, text = self._request("GET", path, params, timeout_s=timeout_s)
         if status != 200:
             raise ApiError(status, text)
         return json.loads(text)
@@ -286,15 +347,17 @@ class ApiServerClient:
         self,
         field_selector: str = "",
         label_selector: str = "",
+        timeout_s: float | None = None,
     ) -> tuple[list[dict], str]:
         """LIST returning (items, collection resourceVersion) — the seed for
-        a subsequent watch."""
+        a subsequent watch. ``timeout_s`` bounds this one call (the
+        informer's Allocate-path refresh runs under a deadline)."""
         params = {}
         if field_selector:
             params["fieldSelector"] = field_selector
         if label_selector:
             params["labelSelector"] = label_selector
-        body = self._get("/api/v1/pods", params)
+        body = self._get("/api/v1/pods", params, timeout_s=timeout_s)
         return body.get("items", []), body.get("metadata", {}).get(
             "resourceVersion", "0"
         )
@@ -318,18 +381,32 @@ class ApiServerClient:
             params["fieldSelector"] = field_selector
         if label_selector:
             params["labelSelector"] = label_selector
-        r = self._session.get(
-            self.base_url + "/api/v1/pods",
-            params=params,
-            stream=True,
-            # (connect, read) — the read timeout bounds a silent watch; the
-            # informer treats it like a server hangup and re-watches.
-            timeout=(self._timeout, max(self._timeout, 30.0)),
-        )
+        # Stream *establishment* rides the breaker (it dials the same
+        # endpoint as the unary verbs); mid-stream failures don't — a
+        # server closing an hours-old watch is routine, not an outage.
+        self.breaker.before()
+        try:
+            FAULTS.fire("apiserver.watch")
+            r = self._session.get(
+                self.base_url + "/api/v1/pods",
+                params=params,
+                stream=True,
+                # (connect, read) — the read timeout bounds a silent watch;
+                # the informer treats it like a server hangup and re-watches.
+                timeout=(self._timeout, max(self._timeout, 30.0)),
+            )
+        except Exception:
+            self.breaker.record_failure()
+            raise
         if r.status_code != 200:
             body = r.text
             r.close()
+            if r.status_code >= 500:
+                self.breaker.record_failure()
+            else:
+                self.breaker.record_success()
             raise ApiError(r.status_code, body)
+        self.breaker.record_success()
         if on_response is not None:
             on_response(r)
         try:

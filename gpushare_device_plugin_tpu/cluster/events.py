@@ -10,6 +10,8 @@ clean failure path into a crash.
 
 from __future__ import annotations
 
+import queue
+import threading
 import time
 
 from ..utils.log import get_logger
@@ -34,8 +36,10 @@ def _post_event(
     component: str,
     host: str,
     event_type: str,
-) -> None:
-    """Shared best-effort Event POST (one schema for pod + node events)."""
+) -> bool:
+    """Shared best-effort Event POST (one schema for pod + node events).
+    Returns False when the post failed (callers that count drops care;
+    fire-and-forget callers ignore it)."""
     name = involved.get("name", "")
     now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
     event = {
@@ -61,6 +65,8 @@ def _post_event(
             "event emission failed for %s %s: %s",
             involved.get("kind", "?"), name, e,
         )
+        return False
+    return True
 
 
 def emit_node_event(
@@ -71,11 +77,11 @@ def emit_node_event(
     *,
     component: str = COMPONENT,
     event_type: str = "Warning",
-) -> None:
+) -> bool:
     """Warning/Normal event on the Node object so ``kubectl describe node``
     shows chip health transitions with their classified reason (the
     reference's XID events were glog-only)."""
-    _post_event(
+    return _post_event(
         api, "default",
         {"apiVersion": "v1", "kind": "Node", "name": node_name, "uid": node_name},
         reason, message, component, node_name, event_type,
@@ -105,3 +111,66 @@ def emit_pod_event(
         },
         reason, message, component, host, event_type,
     )
+
+
+class NodeEventEmitter:
+    """One worker + one bounded queue for node health events.
+
+    Replaces the thread-per-event emission (a 5 s health poll against an
+    unreachable apiserver used to spawn a fresh daemon thread per event,
+    each parked on a connect timeout — unbounded thread growth for the
+    whole outage). The queue bounds memory; a full queue drops the oldest
+    behavior by refusing the newest and counting it — during an outage the
+    event's value decays fast anyway, and the health state itself lives in
+    ListAndWatch/allocator, not in Events.
+    """
+
+    def __init__(self, api, node_name: str, maxsize: int = 64):
+        self._api = api
+        self._node = node_name
+        self._q: "queue.Queue[tuple[str, str, str] | None]" = queue.Queue(maxsize)
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "NodeEventEmitter":
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="node-events"
+        )
+        self._thread.start()
+        return self
+
+    def _count_drop(self, why: str) -> None:
+        from ..utils.metrics import REGISTRY
+
+        REGISTRY.counter_inc(
+            "tpushare_node_events_dropped_total",
+            "Node events dropped (full queue or failed send)",
+            reason=why,
+        )
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            reason, message, event_type = item
+            if not emit_node_event(
+                self._api, self._node, reason, message, event_type=event_type
+            ):
+                self._count_drop("send_failed")
+
+    def emit(self, reason: str, message: str, event_type: str = "Warning") -> None:
+        """Non-blocking enqueue; never stalls the health watcher."""
+        try:
+            self._q.put_nowait((reason, message, event_type))
+        except queue.Full:
+            self._count_drop("queue_full")
+
+    def stop(self) -> None:
+        if self._thread is None:
+            return
+        try:
+            self._q.put_nowait(None)
+        except queue.Full:
+            pass  # worker is behind; it is a daemon thread, let it go
+        self._thread.join(timeout=2.0)
+        self._thread = None

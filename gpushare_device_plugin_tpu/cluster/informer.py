@@ -24,19 +24,37 @@ Consistency notes:
 from __future__ import annotations
 
 import threading
+import time
 
 import requests
 
 from .. import const
 from ..utils.log import get_logger
+from ..utils.metrics import REGISTRY
+from ..utils.retry import Backoff
 from . import pods as P
 from .apiserver import ApiError, ApiServerClient
 
 log = get_logger("cluster.informer")
 
-RELIST_BACKOFF_S = 1.0
+# Relist failures back off with full jitter (a fixed 1 s loop turns an
+# apiserver outage into a fleet-synchronized relist storm at recovery).
+RELIST_BACKOFF_BASE_S = 0.5
+RELIST_BACKOFF_MAX_S = 5.0
 REFRESH_RETRIES = 3
-REFRESH_DELAY_S = 1.0
+REFRESH_DELAY_S = 0.25
+# refresh() runs inside the Allocate admission path: a per-attempt HTTP
+# timeout plus an overall deadline bound its total cost, so a dead
+# apiserver yields a fast admission error instead of a kubelet worker
+# stalled on the client's default connect timeout.
+REFRESH_ATTEMPT_TIMEOUT_S = 1.0
+REFRESH_DEADLINE_S = 3.0
+
+STALENESS_GAUGE = "tpushare_informer_staleness_seconds"
+STALENESS_HELP = (
+    "Seconds since the cache last heard from the apiserver (LIST or "
+    "watch event); rises during an outage while reads serve last-good data"
+)
 # Tombstone rv recorded when the evicted pod had no parseable
 # resourceVersion: blocks every store for the key until an authoritative
 # LIST shows it again (_merge_list clears sentinels on presence).
@@ -107,6 +125,10 @@ class PodInformer:
         # per-node index via add_index).
         self._usage = NodeChipUsage() if node_name else None
         self._indexes: list = [self._usage] if self._usage else []
+        # monotonic timestamp of the last successful apiserver contact;
+        # drives the staleness gauge while the cache serves degraded reads
+        self._last_sync = time.monotonic()
+        self._scope = node_name or "cluster"
 
     # --- lifecycle --------------------------------------------------------
 
@@ -152,6 +174,23 @@ class PodInformer:
         if self._thread is not None:
             self._thread.join(timeout=5.0)
             self._thread = None
+
+    def staleness_s(self) -> float:
+        """Age of the newest apiserver data in the cache. Near zero while
+        the watch is live; rises monotonically through an outage."""
+        return time.monotonic() - self._last_sync
+
+    def _mark_synced(self) -> None:
+        self._last_sync = time.monotonic()
+        REGISTRY.gauge_set(
+            STALENESS_GAUGE, 0.0, STALENESS_HELP, scope=self._scope
+        )
+
+    def _mark_stale(self) -> None:
+        REGISTRY.gauge_set(
+            STALENESS_GAUGE, self.staleness_s(), STALENESS_HELP,
+            scope=self._scope,
+        )
 
     @property
     def synced(self) -> bool:
@@ -203,6 +242,7 @@ class PodInformer:
         # window on the Allocate path).
         self._merge_list(items, rv, gc_tombstones=True)
         self._synced.set()
+        self._mark_synced()
         log.v(4, "informer listed %d pods at rv=%s", len(items), rv)
         return rv
 
@@ -303,11 +343,13 @@ class PodInformer:
     def _run(self) -> None:
         rv = "0"
         need_list = True
+        backoff = Backoff(base_s=RELIST_BACKOFF_BASE_S, max_s=RELIST_BACKOFF_MAX_S)
         while not self._stop.is_set():
             try:
                 if need_list:
                     rv = self._relist()
                     need_list = False
+                    backoff.reset()
                 events = self._c.watch_pods(
                     resource_version=rv,
                     field_selector=self._field_selector,
@@ -316,6 +358,8 @@ class PodInformer:
                 for etype, obj in events:
                     if self._stop.is_set():
                         return
+                    backoff.reset()
+                    self._mark_synced()
                     if etype == "ERROR":
                         # In-stream failure (a real apiserver reports an
                         # expired rv as HTTP 200 + one ERROR/Status event,
@@ -335,16 +379,21 @@ class PodInformer:
                 else:
                     log.warning("watch failed (%s); relisting", e)
                 need_list = True
-                self._stop.wait(RELIST_BACKOFF_S)
+                self._mark_stale()
+                self._stop.wait(backoff.next())
             except Exception as e:  # noqa: BLE001 — timeouts, resets, closes
                 if _is_read_timeout(e):
                     # Routine idle-watch read timeout: the cache is still
                     # good — re-watch from the last rv, no LIST, no backoff.
                     log.v(4, "idle watch timed out; re-watching from rv=%s", rv)
                 else:
+                    # Covers CircuitOpenError too: while the breaker is
+                    # open each pass fails instantly, so the jittered
+                    # backoff is what keeps this from being a hot loop.
                     log.v(4, "watch interrupted (%s); relisting", e)
                     need_list = True
-                    self._stop.wait(RELIST_BACKOFF_S)
+                    self._mark_stale()
+                    self._stop.wait(backoff.next())
             finally:
                 self._live_response = None
 
@@ -416,13 +465,20 @@ class PodInformer:
         from ..utils.retry import retry
 
         items, rv = retry(
-            lambda: self._c.list_pods_with_rv(field_selector=self._field_selector),
+            lambda: self._c.list_pods_with_rv(
+                field_selector=self._field_selector,
+                timeout_s=REFRESH_ATTEMPT_TIMEOUT_S,
+            ),
             attempts=REFRESH_RETRIES,
             delay_s=REFRESH_DELAY_S,
+            backoff=2.0,
+            jitter=True,
+            deadline_s=REFRESH_DEADLINE_S,
         )
         self._merge_list(items, rv)
         # an authoritative LIST seeds the cache as well as _relist does
         self._synced.set()
+        self._mark_synced()
 
     def evict(self, pod: dict) -> None:
         """Drop a pod the apiserver reported gone (PATCH 404) so the next

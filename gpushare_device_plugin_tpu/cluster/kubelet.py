@@ -13,6 +13,7 @@ from __future__ import annotations
 import urllib3
 import requests
 
+from ..utils.faults import FAULTS
 from ..utils.log import get_logger
 
 log = get_logger("cluster.kubelet")
@@ -41,6 +42,7 @@ class KubeletClient:
 
     def get_node_running_pods(self) -> list[dict]:
         """The kubelet's local ``v1.PodList`` (``client.go:119-134``)."""
+        FAULTS.fire("kubelet.pods")
         r = self._session.get(f"{self.base_url}/pods/", timeout=self._timeout)
         r.raise_for_status()
         return r.json().get("items", [])

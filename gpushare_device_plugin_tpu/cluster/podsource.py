@@ -20,10 +20,28 @@ from .kubelet import KubeletClient
 
 log = get_logger("cluster.podsource")
 
+# Attempt counts keep the reference's budgets (``podmanager.go:143-147,
+# 164-176``); the fixed delays became exponential backoff with full jitter
+# plus a per-call deadline — these reads sit on the Allocate admission
+# path, so a dead control plane must produce an error while kubelet still
+# cares, and a recovering one must not be hit by synchronized retries.
 KUBELET_RETRIES = 8
-KUBELET_DELAY_S = 0.1
+KUBELET_DELAY_S = 0.05
+KUBELET_DEADLINE_S = 2.0
 APISERVER_RETRIES = 3
-APISERVER_DELAY_S = 1.0
+APISERVER_DELAY_S = 0.25
+APISERVER_DEADLINE_S = 5.0
+_BACKOFF = dict(backoff=2.0, jitter=True)
+
+
+def _apiserver_retry(fn):
+    return retry(
+        fn,
+        attempts=APISERVER_RETRIES,
+        delay_s=APISERVER_DELAY_S,
+        deadline_s=APISERVER_DEADLINE_S,
+        **_BACKOFF,
+    )
 
 
 class PodSource(Protocol):
@@ -87,37 +105,31 @@ class ApiServerPodSource:
         pass  # nothing cached
 
     def pending_pods(self) -> list[dict]:
-        return retry(
+        return _apiserver_retry(
             lambda: self._c.list_pods(
                 field_selector=f"spec.nodeName={self._node},status.phase=Pending"
-            ),
-            attempts=APISERVER_RETRIES,
-            delay_s=APISERVER_DELAY_S,
+            )
         )
 
     def running_share_pods(self) -> list[dict]:
         from .. import const
 
-        return retry(
+        return _apiserver_retry(
             lambda: self._c.list_pods(
                 field_selector=f"spec.nodeName={self._node}",
                 label_selector=f"{const.LABEL_RESOURCE_KEY}={const.LABEL_RESOURCE_VALUE}",
-            ),
-            attempts=APISERVER_RETRIES,
-            delay_s=APISERVER_DELAY_S,
+            )
         )
 
     def labeled_pods(self) -> list[dict]:
         from .. import const
 
         # existence selector: one LIST covers both resource values
-        return retry(
+        return _apiserver_retry(
             lambda: self._c.list_pods(
                 field_selector=f"spec.nodeName={self._node}",
                 label_selector=const.LABEL_RESOURCE_KEY,
-            ),
-            attempts=APISERVER_RETRIES,
-            delay_s=APISERVER_DELAY_S,
+            )
         )
 
     def chip_state(self) -> tuple[dict[int, int], set[int]]:
@@ -151,6 +163,8 @@ class KubeletPodSource:
             self._kubelet.get_node_running_pods,
             attempts=KUBELET_RETRIES,
             delay_s=KUBELET_DELAY_S,
+            deadline_s=KUBELET_DEADLINE_S,
+            **_BACKOFF,
         )
 
     def pending_pods(self) -> list[dict]:

@@ -79,8 +79,13 @@ class MockBackend:
         The control file holds a JSON object mapping chip id (or "*") to
         "Healthy"/"Unhealthy"; transitions are emitted as events.
         """
+        from ..utils.faults import FAULTS
+
         last: dict[str, str] = {}
         while not stop():
+            # chaos hook: lets tests kill the stream mid-flight (the
+            # supervised HealthWatcher must revive it)
+            FAULTS.fire("discovery.watch_health")
             if self._health_file and os.path.exists(self._health_file):
                 try:
                     with open(self._health_file) as f:

@@ -14,7 +14,9 @@ import threading
 from typing import Callable, Iterable
 
 from ..discovery.base import ChipHealth, DiscoveryBackend, HealthEvent
+from ..utils.faults import FAULTS
 from ..utils.log import get_logger
+from ..utils.retry import Backoff
 
 log = get_logger("manager.health")
 
@@ -37,6 +39,16 @@ class HealthWatcher:
         self._thread: threading.Thread | None = None
         self._unhealthy_ids: set[str] = set()
         self._lock = threading.Lock()
+        self._restarts = 0
+
+    @property
+    def restarts(self) -> int:
+        """How many times the supervisor revived a dead watch loop."""
+        return self._restarts
+
+    @property
+    def alive(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
 
     def unhealthy_ids(self) -> set[str]:
         with self._lock:
@@ -88,14 +100,43 @@ class HealthWatcher:
                 log.warning("health sink failed: %s", e)
 
     def start(self) -> None:
+        """Run the watch loop under a supervisor: a backend that raises (a
+        wedged driver poll, a flaky metadata server) gets restarted with
+        jittered backoff instead of silently ending health monitoring for
+        the life of the daemon — the chips would otherwise stay advertised
+        Healthy forever on a node whose watcher died at hour one."""
+
         def run():
-            try:
-                for event in self._backend.watch_health(self._stop.is_set):
+            from ..utils.metrics import REGISTRY
+
+            backoff = Backoff(base_s=0.1, max_s=5.0)
+            while not self._stop.is_set():
+                try:
+                    FAULTS.fire("discovery.watch_health")
+                    for event in self._backend.watch_health(self._stop.is_set):
+                        if self._stop.is_set():
+                            return
+                        backoff.reset()
+                        self._handle(event)
                     if self._stop.is_set():
                         return
-                    self._handle(event)
-            except Exception as e:
-                log.error("health watcher died: %s", e)
+                    # Generator exhausted without stop: the backend gave up
+                    # on its own — treat it exactly like a crash.
+                    raise RuntimeError("watch_health stream ended early")
+                except Exception as e:  # noqa: BLE001 — supervised
+                    if self._stop.is_set():
+                        return
+                    self._restarts += 1
+                    REGISTRY.counter_inc(
+                        "tpushare_health_watcher_restarts_total",
+                        "Health watch loop crashes revived by the supervisor",
+                    )
+                    delay = backoff.next()
+                    log.error(
+                        "health watcher died (%s); restart #%d in %.2fs",
+                        e, self._restarts, delay,
+                    )
+                    self._stop.wait(delay)
 
         self._thread = threading.Thread(target=run, daemon=True, name="health-watch")
         self._thread.start()

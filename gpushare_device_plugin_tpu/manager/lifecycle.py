@@ -25,6 +25,7 @@ from ..allocator.local import LocalAllocator
 from ..device.fanout import DeviceInventory
 from ..discovery.base import DiscoveryBackend
 from ..plugin.server import PluginConfig, TpuSharePlugin
+from ..utils.faults import FAULTS
 from ..utils.log import get_logger
 from ..utils.stacktrace import coredump
 from .health import HealthWatcher
@@ -63,6 +64,7 @@ class TpuShareManager:
         self._pod_source = pod_source
         self._plugins: list[TpuSharePlugin] = []
         self._health: HealthWatcher | None = None
+        self._events = None  # NodeEventEmitter in cluster mode w/ health
         self._local: LocalAllocator | None = None  # standalone accounting
         # effective isolation toggle: config flag OR node label, re-read at
         # every plugin (re)build (reference: podmanager.go:59-72 read at
@@ -78,6 +80,7 @@ class TpuShareManager:
     # ------------------------------------------------------------------
 
     def _build_inventory(self) -> DeviceInventory | None:
+        FAULTS.fire("discovery.probe")
         if not self._backend.probe():
             return None
         chips = self._backend.chips()
@@ -247,7 +250,6 @@ class TpuShareManager:
                 sinks.append(local_sink)
             on_event = None
             if self._api is not None and self._cfg.node_name:
-                api, node_name = self._api, self._cfg.node_name
                 # Rate limit per (chip, reason-class): a continuously
                 # ticking correctable-error counter must not write a fresh
                 # Event into etcd every 5 s poll. Hard transitions are rare
@@ -260,9 +262,18 @@ class TpuShareManager:
                     REASON_CHIP_RECOVERED,
                     REASON_CHIP_TRANSIENT,
                     REASON_CHIP_UNHEALTHY,
-                    emit_node_event,
+                    NodeEventEmitter,
                 )
                 from ..discovery.base import ChipHealth
+
+                # One worker + bounded queue instead of a daemon thread per
+                # event: an unreachable apiserver must neither stall
+                # hard-health propagation nor grow a thread per poll tick
+                # for the whole outage. Overflow drops are counted.
+                self._events = NodeEventEmitter(
+                    self._api, self._cfg.node_name
+                ).start()
+                emitter = self._events
 
                 def on_event(event):  # noqa: F811 — the cluster-mode hook
                     import time as _time
@@ -281,15 +292,11 @@ class TpuShareManager:
                         if now - last_emit.get(key, -min_interval_s) < min_interval_s:
                             return
                         last_emit[key] = now
-                    # Fire-and-forget: an unreachable apiserver must not
-                    # stall hard-health propagation behind connect timeouts.
-                    threading.Thread(
-                        target=emit_node_event,
-                        args=(api, node_name, reason,
-                              f"chip {event.chip_id or 'ALL'}: {event.reason}"),
-                        kwargs={"event_type": etype},
-                        daemon=True,
-                    ).start()
+                    emitter.emit(
+                        reason,
+                        f"chip {event.chip_id or 'ALL'}: {event.reason}",
+                        event_type=etype,
+                    )
 
             self._health = HealthWatcher(
                 self._backend, sinks=sinks, on_event=on_event
@@ -300,6 +307,9 @@ class TpuShareManager:
         if self._health is not None:
             self._health.stop()
             self._health = None
+        if self._events is not None:
+            self._events.stop()
+            self._events = None
         for plugin in self._plugins:
             try:
                 plugin.stop()

@@ -192,8 +192,13 @@ class TpuSharePlugin(DevicePluginServicer):
 
     def Allocate(self, request, context) -> pb.AllocateResponse:
         """Count granted fake IDs per container and delegate placement."""
+        from ..utils.faults import FAULTS
         from ..utils.metrics import REGISTRY
 
+        try:
+            FAULTS.fire("plugin.allocate")
+        except Exception as e:  # injected kubelet-facing failure
+            context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
         granted = [list(creq.devicesIDs) for creq in request.container_requests]
         log.v(4, "Allocate: granted id counts %s", [len(g) for g in granted])
         if self._allocate_fn is None:

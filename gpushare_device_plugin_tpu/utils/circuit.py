@@ -1,0 +1,159 @@
+"""Circuit breaker for the apiserver client.
+
+During a control-plane outage every call pays the full connect timeout
+(10 s default). On the Allocate path that stalls kubelet's admission
+worker; stacked across the informer relist loop, the event emitter, and
+the node patch it turns one outage into a daemon-wide pile-up of blocked
+threads. The breaker converts that into fail-fast: after
+``failure_threshold`` consecutive transport failures the circuit opens and
+callers get ``CircuitOpenError`` immediately (kubelet retries admission;
+the informer serves its last-good cache) until a half-open probe after
+``reset_timeout_s`` succeeds and closes it again.
+
+Classic three-state machine:
+
+    CLOSED --(N consecutive failures)--> OPEN
+    OPEN   --(reset_timeout elapsed)---> HALF_OPEN (one probe admitted)
+    HALF_OPEN --success--> CLOSED | --failure--> OPEN
+
+State is exported as ``tpushare_circuit_state`` (0 closed / 1 half-open /
+2 open) plus transition and fast-fail counters, so the degraded mode is
+visible on the scrape the moment it engages.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable
+
+from .log import get_logger
+from .metrics import REGISTRY
+
+log = get_logger("utils.circuit")
+
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half-open"
+
+_STATE_VALUE = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}
+
+
+class CircuitOpenError(RuntimeError):
+    """Fail-fast rejection while the circuit is open. Deliberately NOT an
+    ``ApiError``: it must not be mistaken for a server-issued status (a
+    404-driven evict, a 409 conflict retry) — callers see it as what it
+    is, a client-side refusal to dial a known-down endpoint."""
+
+    def __init__(self, name: str, retry_after_s: float):
+        super().__init__(
+            f"circuit '{name}' open: apiserver unreachable, "
+            f"failing fast (next probe in {retry_after_s:.1f}s)"
+        )
+        self.retry_after_s = retry_after_s
+
+
+class CircuitBreaker:
+    def __init__(
+        self,
+        name: str = "apiserver",
+        failure_threshold: int = 5,
+        reset_timeout_s: float = 5.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if failure_threshold < 1:
+            raise ValueError("failure_threshold must be >= 1")
+        self.name = name
+        self._threshold = failure_threshold
+        self._reset_timeout = reset_timeout_s
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._failures = 0  # consecutive
+        self._opened_at = 0.0
+        self._probe_in_flight = False
+        self._export()
+
+    # ------------------------------------------------------------------
+
+    def _export(self) -> None:
+        REGISTRY.gauge_set(
+            "tpushare_circuit_state",
+            _STATE_VALUE[self._state],
+            "Breaker state: 0 closed, 1 half-open, 2 open",
+            breaker=self.name,
+        )
+
+    def _transition(self, state: str) -> None:
+        """Caller must hold self._lock."""
+        if state == self._state:
+            return
+        log.warning("circuit '%s': %s -> %s", self.name, self._state, state)
+        self._state = state
+        REGISTRY.counter_inc(
+            "tpushare_circuit_transitions_total",
+            "Breaker state transitions",
+            breaker=self.name, to=state,
+        )
+        self._export()
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            # surface OPEN->HALF_OPEN eligibility without requiring a call
+            if (
+                self._state == OPEN
+                and self._clock() - self._opened_at >= self._reset_timeout
+            ):
+                return HALF_OPEN
+            return self._state
+
+    # ------------------------------------------------------------------
+
+    def before(self) -> None:
+        """Gate one call. Raises ``CircuitOpenError`` when open; admits a
+        single probe when the reset window has elapsed."""
+        with self._lock:
+            if self._state == CLOSED:
+                return
+            elapsed = self._clock() - self._opened_at
+            if self._state == OPEN and elapsed >= self._reset_timeout:
+                self._transition(HALF_OPEN)
+                self._probe_in_flight = False
+            if self._state == HALF_OPEN and not self._probe_in_flight:
+                self._probe_in_flight = True  # this caller is the probe
+                return
+            REGISTRY.counter_inc(
+                "tpushare_circuit_fastfail_total",
+                "Calls rejected while the circuit was open",
+                breaker=self.name,
+            )
+            raise CircuitOpenError(
+                self.name, max(0.0, self._reset_timeout - elapsed)
+            )
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._failures = 0
+            self._probe_in_flight = False
+            self._transition(CLOSED)
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._failures += 1
+            self._probe_in_flight = False
+            if self._state == HALF_OPEN or self._failures >= self._threshold:
+                self._opened_at = self._clock()
+                self._transition(OPEN)
+
+    def call(self, fn: Callable):
+        """Convenience guard: ``before()`` + outcome accounting around one
+        callable (exception = failure, return = success)."""
+        self.before()
+        try:
+            result = fn()
+        except Exception:
+            self.record_failure()
+            raise
+        self.record_success()
+        return result

@@ -51,7 +51,7 @@ def _match_label_selector(obj: dict, selector: str) -> bool:
 
 
 class FakeApiServer:
-    def __init__(self):
+    def __init__(self, chaos: bool | None = None):
         self.pods: dict[tuple[str, str], dict] = {}  # (ns, name) -> pod
         self.nodes: dict[str, dict] = {}
         self.events: list[dict] = []
@@ -59,12 +59,22 @@ class FakeApiServer:
         self.patch_log: list[tuple[str, dict]] = []
         # fail the next N pod patches with a 409 conflict (retry testing)
         self.conflicts_to_inject = 0
+        # --- chaos-suite fault controls (tests/test_chaos.py) ---
+        # outage: every request (watch included) gets a 503 and in-flight
+        # watch streams are severed — a full control-plane blackout.
+        self.outage = False
+        # fail the next N requests of any verb with a 503 (5xx storm)
+        self.fail_requests = 0
+        # per-request added latency (a congested apiserver)
+        self.latency_s = 0.0
         # Chaos mode (the stress tier's stand-in for `go test -race`):
         # randomized watch-delivery jitter and abrupt mid-stream connection
         # drops, shaking out thread schedules the happy path never hits. A
         # real apiserver may close a watch at any moment; chaos makes
         # "any moment" happen constantly. Seeded for reproducibility.
-        self.chaos = os.environ.get("TPUSHARE_TEST_CHAOS") == "1"
+        if chaos is None:
+            chaos = os.environ.get("TPUSHARE_TEST_CHAOS") == "1"
+        self.chaos = chaos
         self._chaos_rng = random.Random(
             int(os.environ.get("TPUSHARE_TEST_CHAOS_SEED", "0") or 0)
         )
@@ -105,6 +115,18 @@ class FakeApiServer:
             pod = self.pods.pop((ns, name), None)
             if pod is not None:
                 self._record_event("DELETED", pod)
+
+    def set_outage(self, on: bool) -> None:
+        """Blackout toggle: while on, every request 503s and live watch
+        streams are torn down (their handlers notice via the flag)."""
+        with self._cond:
+            self.outage = on
+            self._cond.notify_all()  # wake idle watch handlers to sever
+
+    def fail_next(self, n: int) -> None:
+        """The next ``n`` requests (any verb) answer 503 — a 5xx storm."""
+        with self._lock:
+            self.fail_requests = n
 
     def add_node(self, name: str, labels: dict | None = None, capacity: dict | None = None, allocatable: dict | None = None) -> None:
         self.nodes[name] = {
@@ -155,6 +177,23 @@ class FakeApiServer:
             def _read_body(self) -> dict:
                 n = int(self.headers.get("Content-Length", "0"))
                 return json.loads(self.rfile.read(n) or b"{}")
+
+            def _maybe_fault(self) -> bool:
+                """Chaos-suite faults: added latency, then 503 on outage or
+                while the 5xx-storm budget lasts. True = request consumed."""
+                with store._lock:
+                    delay = store.latency_s
+                    fault = store.outage
+                    if not fault and store.fail_requests > 0:
+                        store.fail_requests -= 1
+                        fault = True
+                if delay:
+                    time.sleep(delay)
+                if fault:
+                    self._send(503, {"message": "the server is currently "
+                                     "unable to handle the request"})
+                    return True
+                return False
 
             def _stream_watch(self, q):
                 """k8s watch: chunked stream of {"type","object"} JSON lines."""
@@ -215,6 +254,10 @@ class FakeApiServer:
                 try:
                     while True:
                         with store._cond:
+                            if store.outage:
+                                # blackout severs live streams mid-flight
+                                self.close_connection = True
+                                return
                             batch = store._watch_log[pos:]
                             pos = len(store._watch_log)
                             if not batch:
@@ -249,6 +292,8 @@ class FakeApiServer:
                     pass  # client hung up — normal watch termination
 
             def do_GET(self):
+                if self._maybe_fault():
+                    return
                 u = urlparse(self.path)
                 q = {k: v[0] for k, v in parse_qs(u.query).items()}
                 parts = [p for p in u.path.split("/") if p]
@@ -299,6 +344,8 @@ class FakeApiServer:
                 return self._send(404, {"message": f"unhandled GET {u.path}"})
 
             def do_PATCH(self):
+                if self._maybe_fault():
+                    return
                 u = urlparse(self.path)
                 parts = [p for p in u.path.split("/") if p]
                 body = self._read_body()
@@ -344,6 +391,8 @@ class FakeApiServer:
                 return self._send(404, {"message": f"unhandled PATCH {u.path}"})
 
             def do_POST(self):
+                if self._maybe_fault():
+                    return
                 u = urlparse(self.path)
                 parts = [p for p in u.path.split("/") if p]
                 body = self._read_body()

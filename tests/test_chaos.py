@@ -1,0 +1,477 @@
+"""Chaos suite: control-plane faults replayed through the real manager loop.
+
+Drives the production assembly — TpuShareManager + PodInformer +
+CircuitBreaker + supervised HealthWatcher — through apiserver blackouts,
+5xx storms, watch churn, kubelet restart storms, and injected discovery
+faults, and asserts the degraded-mode contract from docs/robustness.md:
+
+- Allocate() during an outage fails fast with a clear gRPC error (kubelet
+  retries admission) instead of stalling on connect timeouts;
+- the informer keeps serving last-good pods while the staleness gauge
+  rises;
+- everything recovers on its own once the faults clear: circuit closes,
+  cache resyncs, health watcher alive.
+
+Runs inside tier-1 (not slow); `make chaos` runs it alone.
+"""
+
+import os
+import queue
+import threading
+import time
+
+import grpc
+import pytest
+
+from gpushare_device_plugin_tpu import const
+from gpushare_device_plugin_tpu.cluster import pods as P
+from gpushare_device_plugin_tpu.cluster.apiserver import ApiServerClient
+from gpushare_device_plugin_tpu.cluster.events import NodeEventEmitter
+from gpushare_device_plugin_tpu.cluster.informer import (
+    STALENESS_GAUGE,
+    PodInformer,
+)
+from gpushare_device_plugin_tpu.discovery import MockBackend
+from gpushare_device_plugin_tpu.manager import ManagerConfig, TpuShareManager
+from gpushare_device_plugin_tpu.utils.circuit import (
+    CLOSED,
+    OPEN,
+    CircuitBreaker,
+    CircuitOpenError,
+)
+from gpushare_device_plugin_tpu.utils.faults import FAULTS, FaultError
+from gpushare_device_plugin_tpu.utils.metrics import REGISTRY
+
+from fake_apiserver import FakeApiServer
+from fake_kubelet import FakeKubelet
+from k8s_fixtures import make_pod
+
+pytestmark = pytest.mark.chaos
+
+NODE = "node-chaos"
+
+
+def wait_until(cond, timeout=10.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return cond()
+
+
+def counter(name, **labels):
+    return REGISTRY._counters.get((name, tuple(sorted(labels.items()))), 0.0)
+
+
+def gauge(name, **labels):
+    return REGISTRY._gauges.get((name, tuple(sorted(labels.items()))))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    FAULTS.clear()
+    yield
+    FAULTS.clear()
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    """The production assembly with chaos-friendly knobs: a fast-tripping
+    breaker and the informer pod source (the daemon's default)."""
+    api = FakeApiServer()
+    api.add_node(NODE)
+    api.start()
+    kubelet = FakeKubelet(str(tmp_path))
+    kubelet.start()
+    client = ApiServerClient(
+        api.url,
+        timeout_s=2.0,
+        breaker=CircuitBreaker("apiserver", failure_threshold=3, reset_timeout_s=0.3),
+    )
+    informer = PodInformer(client, NODE).start(sync_timeout_s=5)
+    manager = TpuShareManager(
+        MockBackend(num_chips=4, hbm_bytes=32 << 30),
+        ManagerConfig(plugin_dir=str(tmp_path), node_name=NODE, health_check=True),
+        api_client=client,
+        pod_source=informer,
+    )
+    t = threading.Thread(target=manager.run, daemon=True)
+    t.start()
+    regs = {}
+    for _ in range(2):
+        reg = kubelet.wait_for_registration()
+        regs[reg.resource_name] = reg
+    yield api, kubelet, manager, client, informer, regs
+    api.set_outage(False)  # never leave a blackout behind for teardown
+    manager.trigger_stop("test")
+    t.join(timeout=5)
+    informer.stop()
+    kubelet.stop()
+    api.stop()
+
+
+# ---------------------------------------------------------------------------
+# acceptance: outage -> degraded mode -> recovery, end to end
+# ---------------------------------------------------------------------------
+
+
+def test_apiserver_outage_fails_fast_serves_cache_then_recovers(cluster):
+    api, kubelet, manager, client, informer, regs = cluster
+    mem = regs[const.RESOURCE_MEM]
+
+    # healthy path first: one pod allocated through the real flow
+    api.add_pod(make_pod("p1", 4, node=NODE))
+    assert wait_until(lambda: len(informer.pending_pods()) == 1)
+    resp = kubelet.allocate(mem.endpoint, [[f"g{i}" for i in range(4)]])
+    assert resp.container_responses[0].envs[const.ENV_TPU_VISIBLE_CHIPS] == "0"
+
+    # blackout: the informer's own relist/watch failures trip the breaker
+    api.set_outage(True)
+    assert wait_until(lambda: client.breaker.state == OPEN, timeout=10)
+
+    # degraded reads: the cache still serves the last-good pod set
+    assert len(informer.running_share_pods()) == 1
+    assert wait_until(
+        lambda: (gauge(STALENESS_GAUGE, scope=NODE) or 0) > 0, timeout=10
+    )
+    stale_1 = gauge(STALENESS_GAUGE, scope=NODE)
+
+    # Allocate fails fast inside its deadline with a clear error — kubelet
+    # would retry admission; it must NOT stall out its 5 s RPC budget
+    t0 = time.monotonic()
+    with pytest.raises(grpc.RpcError) as ei:
+        kubelet.allocate(mem.endpoint, [["g0", "g1"]])
+    elapsed = time.monotonic() - t0
+    assert elapsed < 4.0, f"Allocate stalled {elapsed:.1f}s during outage"
+    assert ei.value.code() != grpc.StatusCode.DEADLINE_EXCEEDED
+    # fast-fails were breaker rejections, visible on the metric
+    assert counter("tpushare_circuit_fastfail_total", breaker="apiserver") > 0
+
+    # staleness keeps rising while the outage lasts
+    assert wait_until(
+        lambda: gauge(STALENESS_GAUGE, scope=NODE) > stale_1, timeout=15
+    )
+
+    # recovery: faults clear -> circuit closes, cache resyncs, health alive
+    api.set_outage(False)
+    api.add_pod(make_pod("p2", 2, node=NODE))
+    assert wait_until(lambda: client.breaker.state == CLOSED, timeout=15)
+    assert wait_until(
+        lambda: any(P.name(p) == "p2" for p in informer.pending_pods()),
+        timeout=15,
+    )
+    assert wait_until(
+        lambda: gauge(STALENESS_GAUGE, scope=NODE) == 0.0, timeout=15
+    )
+    resp = kubelet.allocate(mem.endpoint, [["g0", "g1"]])
+    assert resp.container_responses[0].envs[const.ENV_TPU_VISIBLE_CHIPS] != ""
+    ann = client.get_pod("default", "p2")["metadata"]["annotations"]
+    assert ann[const.ENV_ASSIGNED_FLAG] == "true"
+    assert manager._health is not None and manager._health.alive
+
+
+def test_5xx_storm_mid_allocate_then_kubelet_retry_succeeds(cluster):
+    """The PATCH persisting the placement dies in a 5xx storm: admission
+    must fail cleanly (no partial state) and the kubelet's retry after the
+    storm must succeed against the intact cache."""
+    api, kubelet, manager, client, informer, regs = cluster
+    mem = regs[const.RESOURCE_MEM]
+    api.add_pod(make_pod("victim", 2, node=NODE))
+    assert wait_until(lambda: len(informer.pending_pods()) == 1)
+
+    api.fail_next(4)  # PATCH + event POST + slack: all 503
+    t0 = time.monotonic()
+    with pytest.raises(grpc.RpcError) as ei:
+        kubelet.allocate(mem.endpoint, [["g0", "g1"]])
+    assert time.monotonic() - t0 < 4.0
+    assert "patch failed" in (ei.value.details() or "")
+
+    # no partial state was persisted: the pod is still an unassigned
+    # candidate, and the retry (kubelet's behavior on admission error)
+    # lands it normally once the storm passes
+    api.fail_next(0)
+    assert wait_until(lambda: client.breaker.state != OPEN, timeout=10)
+    resp = kubelet.allocate(mem.endpoint, [["g0", "g1"]])
+    assert resp.container_responses[0].envs[const.ENV_TPU_VISIBLE_CHIPS] != ""
+    ann = api.pods[("default", "victim")]["metadata"]["annotations"]
+    assert ann[const.ENV_ASSIGNED_FLAG] == "true"
+
+
+def test_watch_churn_cache_converges(tmp_path):
+    """Chaos-mode watch delivery (random jitter + abrupt stream drops)
+    while pods come and go: the cache must converge to the server state."""
+    api = FakeApiServer(chaos=True)
+    api.add_node(NODE)
+    api.start()
+    client = ApiServerClient(
+        api.url,
+        breaker=CircuitBreaker("churn", failure_threshold=10, reset_timeout_s=0.2),
+    )
+    inf = PodInformer(client, NODE).start(sync_timeout_s=5)
+    try:
+        for i in range(30):
+            api.add_pod(make_pod(f"p{i}", 1, node=NODE))
+        for i in range(0, 30, 2):
+            api.delete_pod("default", f"p{i}")
+        survivors = {f"p{i}" for i in range(1, 30, 2)}
+        assert wait_until(
+            lambda: {P.name(p) for p in inf.pending_pods()} == survivors,
+            timeout=20,
+        )
+    finally:
+        inf.stop()
+        api.stop()
+
+
+# ---------------------------------------------------------------------------
+# kubelet restart storm (satellite: re-registration loop coverage)
+# ---------------------------------------------------------------------------
+
+
+def test_kubelet_restart_storm_reregisters_exactly_once_each(cluster, tmp_path):
+    """Each socket recreation triggers exactly one rebuild (one
+    registration per resource), leaves no leaked plugin sockets, and the
+    allocator's usage view is rebuilt from the pod source."""
+    api, kubelet, manager, client, informer, regs = cluster
+    plugin_dir = kubelet.plugin_dir
+
+    # seed usage the rebuilt allocator must re-derive: 4 units on chip 0
+    api.add_pod(make_pod("existing", 4, node=NODE))
+    assert wait_until(lambda: len(informer.pending_pods()) == 1)
+    kubelet.allocate(regs[const.RESOURCE_MEM].endpoint, [[f"g{i}" for i in range(4)]])
+
+    current = kubelet
+    for round_n in range(3):
+        current.stop()
+        current = FakeKubelet(plugin_dir)
+        current.start()
+        names = sorted(
+            current.wait_for_registration(timeout=15).resource_name
+            for _ in range(2)
+        )
+        assert names == sorted([const.RESOURCE_CORE, const.RESOURCE_MEM]), (
+            f"restart {round_n}: bad re-registration set {names}"
+        )
+    # exactly one rebuild per recreation: no extra registrations trail in
+    with pytest.raises(queue.Empty):
+        current.registrations.get(timeout=1.0)
+
+    # no leaked sockets: kubelet.sock + one socket per resource
+    socks = {f for f in os.listdir(plugin_dir) if f.endswith(".sock")}
+    assert socks == {
+        "kubelet.sock", const.MEM_SOCKET_NAME, const.CORE_SOCKET_NAME,
+    }, f"leaked sockets: {socks}"
+
+    # allocator state rebuilt from the pod source: a 30-unit pod cannot
+    # share chip 0 (4/32 used by the pre-storm pod) and must land on 1
+    api.add_pod(make_pod("post-storm", 30, node=NODE))
+    assert wait_until(
+        lambda: any(P.name(p) == "post-storm" for p in informer.pending_pods())
+    )
+    resp = current.allocate(
+        regs[const.RESOURCE_MEM].endpoint, [[f"h{i}" for i in range(30)]]
+    )
+    assert resp.container_responses[0].envs[const.ENV_TPU_VISIBLE_CHIPS] == "1"
+    current.stop()
+
+
+# ---------------------------------------------------------------------------
+# supervised health watcher (satellite: watcher restart + counter)
+# ---------------------------------------------------------------------------
+
+
+def test_health_watcher_survives_backend_crashes(tmp_path):
+    import json
+
+    restarts_before = counter("tpushare_health_watcher_restarts_total")
+    health_file = str(tmp_path / "health.json")
+    kubelet = FakeKubelet(str(tmp_path / "plugins"))
+    kubelet.start()
+    backend = MockBackend(
+        num_chips=2, hbm_bytes=4 << 30, health_file=health_file,
+        poll_interval_s=0.02,
+    )
+    manager = TpuShareManager(
+        backend,
+        ManagerConfig(
+            plugin_dir=str(tmp_path / "plugins"),
+            standalone=True,
+            health_check=True,
+            serve_core_resource=False,
+        ),
+    )
+    t = threading.Thread(target=manager.run, daemon=True)
+    t.start()
+    try:
+        reg = kubelet.wait_for_registration()
+        kubelet.begin_watch(reg.resource_name, reg.endpoint)
+        kubelet.wait_for_devices(const.RESOURCE_MEM)
+
+        # kill the health stream twice; the supervisor must revive it
+        assert wait_until(lambda: manager._health is not None, timeout=5)
+        FAULTS.inject("discovery.watch_health", mode="error", times=2)
+        assert wait_until(lambda: manager._health.restarts >= 2, timeout=10)
+        assert counter("tpushare_health_watcher_restarts_total") >= restarts_before + 2
+        assert wait_until(lambda: manager._health.alive, timeout=5)
+
+        # and transitions still flow end-to-end after the revival
+        chip0 = backend.chips()[0].id
+        with open(health_file, "w") as f:
+            json.dump({chip0: "Unhealthy"}, f)
+        devs = kubelet.wait_for_devices(const.RESOURCE_MEM, timeout=10)
+        assert sum(d.health == "Unhealthy" for d in devs) == 4
+    finally:
+        manager.trigger_stop("test")
+        t.join(timeout=5)
+        kubelet.stop()
+
+
+# ---------------------------------------------------------------------------
+# bounded node-event emitter (satellite: no thread-per-event, counted drops)
+# ---------------------------------------------------------------------------
+
+
+def test_event_emitter_bounded_queue_counts_drops():
+    class WedgedApi:
+        """create_event blocks like a connect to a blackholed endpoint,
+        then fails — the worst case for the old thread-per-event design."""
+
+        def __init__(self):
+            self.release = threading.Event()
+
+        def create_event(self, ns, event):
+            self.release.wait(5)
+            raise ConnectionError("apiserver unreachable")
+
+    dropped_before = counter(
+        "tpushare_node_events_dropped_total", reason="queue_full"
+    )
+    api = WedgedApi()
+    emitter = NodeEventEmitter(api, NODE, maxsize=4).start()
+    threads_before = threading.active_count()
+    for i in range(50):
+        emitter.emit("TpuChipUnhealthy", f"event {i}")
+    # one worker, not one thread per event
+    assert threading.active_count() <= threads_before
+    # queue bounded at 4: the overflow was dropped and counted
+    dropped = counter(
+        "tpushare_node_events_dropped_total", reason="queue_full"
+    ) - dropped_before
+    assert dropped >= 40
+    assert emitter._q.qsize() <= 4
+    api.release.set()
+    # failed sends are drops too (counted under their own reason)
+    assert wait_until(
+        lambda: counter("tpushare_node_events_dropped_total", reason="send_failed") > 0,
+        timeout=5,
+    )
+    emitter.stop()
+
+
+# ---------------------------------------------------------------------------
+# fault-injection layer itself
+# ---------------------------------------------------------------------------
+
+
+def test_fault_modes_error_latency_flap():
+    FAULTS.inject("apiserver.request", "error", times=2)
+    with pytest.raises(FaultError):
+        FAULTS.fire("apiserver.request")
+    with pytest.raises(FaultError):
+        FAULTS.fire("apiserver.request")
+    FAULTS.fire("apiserver.request")  # budget spent: passes through
+    assert FAULTS.fired("apiserver.request") == 2
+    FAULTS.clear()
+
+    FAULTS.inject("kubelet.pods", "latency", latency_s=0.05, times=1)
+    t0 = time.monotonic()
+    FAULTS.fire("kubelet.pods")
+    assert time.monotonic() - t0 >= 0.05
+    FAULTS.fire("kubelet.pods")  # no second sleep
+    FAULTS.clear()
+
+    FAULTS.inject("plugin.allocate", "flap", fail_n=2, pass_n=1)
+    outcomes = []
+    for _ in range(6):
+        try:
+            FAULTS.fire("plugin.allocate")
+            outcomes.append("ok")
+        except FaultError:
+            outcomes.append("err")
+    assert outcomes == ["err", "err", "ok", "err", "err", "ok"]
+
+
+def test_fault_env_spec_parsing():
+    reg_spec = (
+        "apiserver.request=error:3, kubelet.pods=latency:0.2,"
+        "plugin.allocate=flap:2/3, bogus==,discovery.probe=error"
+    )
+    n = FAULTS.install_from_env(reg_spec)
+    assert n >= 4
+    assert "apiserver.request" in FAULTS.active()
+    assert "discovery.probe" in FAULTS.active()
+    FAULTS.clear()
+    assert FAULTS.active() == []
+
+
+def test_injected_faults_reach_the_apiserver_client():
+    """The apiserver.request point makes the real client fail without any
+    fake-server cooperation — and failures count against the breaker."""
+    api = FakeApiServer()
+    api.add_node(NODE)
+    api.start()
+    try:
+        client = ApiServerClient(
+            api.url,
+            breaker=CircuitBreaker("inj", failure_threshold=2, reset_timeout_s=30),
+        )
+        with FAULTS.injected("apiserver.request", "error", times=2):
+            with pytest.raises(ConnectionError):
+                client.get_node(NODE)
+            with pytest.raises(ConnectionError):
+                client.get_node(NODE)
+            # two injected failures tripped the breaker: fail fast now
+            with pytest.raises(CircuitOpenError):
+                client.get_node(NODE)
+    finally:
+        api.stop()
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker unit behavior
+# ---------------------------------------------------------------------------
+
+
+def test_breaker_open_halfopen_close_cycle():
+    now = [0.0]
+    b = CircuitBreaker("t", failure_threshold=3, reset_timeout_s=10, clock=lambda: now[0])
+    for _ in range(2):
+        b.record_failure()
+    assert b.state == CLOSED  # below threshold
+    b.record_failure()
+    assert b.state == OPEN
+    with pytest.raises(CircuitOpenError):
+        b.before()
+    now[0] = 10.5  # reset window elapsed: one probe admitted
+    b.before()
+    with pytest.raises(CircuitOpenError):
+        b.before()  # second caller while the probe is in flight
+    b.record_success()
+    assert b.state == CLOSED
+    b.before()  # closed again: flows freely
+
+
+def test_breaker_halfopen_probe_failure_reopens():
+    now = [0.0]
+    b = CircuitBreaker("t2", failure_threshold=1, reset_timeout_s=5, clock=lambda: now[0])
+    b.record_failure()
+    assert b.state == OPEN
+    now[0] = 5.1
+    b.before()  # the probe
+    b.record_failure()  # probe failed
+    with pytest.raises(CircuitOpenError):
+        b.before()  # immediately open again, full reset window
+    now[0] = 10.0
+    with pytest.raises(CircuitOpenError):
+        b.before()  # 4.9s into the new window: still open

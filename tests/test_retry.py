@@ -1,6 +1,8 @@
+import random
+
 import pytest
 
-from gpushare_device_plugin_tpu.utils.retry import RetryError, retry
+from gpushare_device_plugin_tpu.utils.retry import Backoff, RetryError, retry
 
 
 def test_retry_succeeds_after_failures():
@@ -41,3 +43,91 @@ def test_retry_non_retryable_stops_immediately():
             sleep=lambda s: None,
         )
     assert len(calls) == 1
+
+
+def test_retry_exponential_backoff_caps_at_max():
+    sleeps = []
+
+    def fn():
+        raise ValueError("down")
+
+    with pytest.raises(RetryError):
+        retry(
+            fn,
+            attempts=6,
+            delay_s=0.1,
+            backoff=2.0,
+            max_delay_s=0.4,
+            sleep=sleeps.append,
+        )
+    assert sleeps == [0.1, 0.2, 0.4, 0.4, 0.4]
+
+
+def test_retry_full_jitter_sleeps_within_window():
+    sleeps = []
+
+    def fn():
+        raise ValueError("down")
+
+    with pytest.raises(RetryError):
+        retry(
+            fn,
+            attempts=5,
+            delay_s=1.0,
+            backoff=2.0,
+            jitter=True,
+            sleep=sleeps.append,
+            rng=random.Random(42),
+        )
+    caps = [1.0, 2.0, 4.0, 8.0]
+    assert len(sleeps) == 4
+    for got, cap in zip(sleeps, caps):
+        assert 0.0 <= got <= cap
+
+
+def test_retry_deadline_stops_before_overrunning():
+    """A dead dependency must yield an error while the caller still cares:
+    the deadline cuts the budget even with attempts remaining."""
+    now = [0.0]
+
+    def clock():
+        return now[0]
+
+    def sleep(s):
+        now[0] += s
+
+    def fn():
+        now[0] += 0.5  # each attempt costs wall clock too
+        raise ValueError("down")
+
+    with pytest.raises(RetryError) as ei:
+        retry(
+            fn,
+            attempts=100,
+            delay_s=0.5,
+            deadline_s=2.0,
+            sleep=sleep,
+            clock=clock,
+        )
+    assert ei.value.deadline_exceeded
+    assert ei.value.attempts < 100
+    assert now[0] <= 2.5  # never slept past the budget
+
+
+def test_backoff_grows_jittered_and_resets():
+    b = Backoff(base_s=0.1, max_s=1.0, rng=random.Random(7))
+    first = [b.next() for _ in range(6)]
+    # each draw is full-jitter within a doubling cap that tops out at max
+    caps = [0.1, 0.2, 0.4, 0.8, 1.0, 1.0]
+    for got, cap in zip(first, caps):
+        assert 0.0 <= got <= cap
+    b.reset()
+    assert b.next() <= 0.1
+
+
+def test_backoff_never_overflows_on_long_outages():
+    """An outage lasting thousands of cycles must not walk the exponent
+    into float overflow and kill the loop the backoff paces."""
+    b = Backoff(base_s=0.5, max_s=5.0)
+    for _ in range(3000):
+        assert 0.0 <= b.next() <= 5.0
