@@ -1,7 +1,7 @@
 PY ?= python
 PROTOC ?= protoc
 
-.PHONY: proto native test test-fast test-slow test-stress chaos lint bench e2e-kind
+.PHONY: proto native test test-fast test-slow test-stress chaos lint bench bench-smoke e2e-kind
 
 # Regenerate protobuf message classes (gRPC bindings are hand-written in
 # gpushare_device_plugin_tpu/plugin/api/api_grpc.py; grpc_tools is not
@@ -60,6 +60,12 @@ lint:
 
 bench:
 	$(PY) bench.py
+
+# Quick pass over every bench section (serial, concurrent storm, extender
+# scoring) with tiny sizes and all guards off — the bit-rot insurance that
+# tier-1 runs via tests/test_bench_smoke.py. See docs/perf.md.
+bench-smoke:
+	$(PY) bench.py --smoke
 
 # Full on-chip compute capture: decode/train/flash/serve plus the step-
 # time ablation and the flash block-size sweep (real TPU required; off

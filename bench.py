@@ -1,48 +1,58 @@
-"""North-star benchmark: pod Allocate() p50 latency through the full stack,
-plus the compute-path numbers (flash-attention speedup, train-step MFU) when
-a real TPU chip is attached.
+"""North-star benchmark: pod Allocate() latency + throughput through the
+full stack, plus the compute-path numbers (flash-attention speedup,
+train-step MFU) when a real TPU chip is attached.
 
-Control-plane half: drives the complete admission path on one simulated
-4-chip x 32 GiB host (BASELINE.md config 1/3 shape): in-process fake kubelet
-grants fake-device IDs over **real gRPC on a unix socket** to the real
-plugin server, whose ClusterAllocator lists pending pods from an in-process
-apiserver over **real HTTP**, matches the pod, first-fit binpacks the chip,
-and persists annotations with a strategic-merge PATCH — the reference's hot
-path (``allocate.go:27-134``) end to end, nothing mocked below the wire.
-Three independent trials; the reported p50 is the median of per-trial
-medians and the spread across trials is printed so a regression can be told
-from machine noise.
+Control-plane half, three sections:
+
+- **Serial** (the historical headline): drives the complete admission path
+  on one simulated 4-chip x 32 GiB host (BASELINE.md config 1/3 shape):
+  in-process fake kubelet grants fake-device IDs over **real gRPC on a
+  unix socket** to the real plugin server, whose ClusterAllocator matches
+  the pod off the informer cache, first-fit binpacks the chip, and
+  persists annotations with a strategic-merge PATCH — the reference's hot
+  path (``allocate.go:27-134``) end to end, nothing mocked below the wire.
+  Three independent trials; the reported p50/p99 are the medians of
+  per-trial quantiles.
+- **Concurrent** (``--workers N``, default 8): N parallel fake-kubelet
+  admission workers storm the same real gRPC socket with same-size pods.
+  The lock-sharded allocator overlaps their apiserver PATCHes; the section
+  verifies zero double-assignments / no chip over-commit after every storm
+  and reports aggregate pods/s plus the speedup over this run's serial
+  throughput.
+- **Extender**: a multi-node scoring benchmark — cluster-wide informer
+  over hundreds of placed pods, batched filter+prioritize over the node
+  list, p50 per scheduling decision (index + NodeView cache hot).
 
 Compute half: delegates to ``bench_mfu.py`` in a subprocess (so this script
-stays importable without jax) and folds its JSON into the ``compute`` key —
-flash-vs-plain kernel wall-times compiled on the chip and the flagship
-decoder's tokens/s + model-FLOPs MFU. Skipped cleanly off-TPU.
+stays importable without jax) and folds its JSON into the ``compute`` key.
+Skipped cleanly off-TPU.
 
 Prints ONE JSON line:
     {"metric": "allocate_p50_latency", "value": <ms>, "unit": "ms",
-     "vs_baseline": <x>, ...}
+     "vs_baseline": <x>, "concurrent": {...}, "extender": {...}, ...}
 
-The reference publishes no benchmark numbers at all (README.md:1-16;
-BASELINE.json "published": {}). The only latency anchor in its code is the
-allocate-path kubelet-poll retry tick of 100 ms (``podmanager.go:26,143-147``)
-— the granularity its own Allocate() tolerates — so ``vs_baseline`` is
-reported as 100 ms / p50 (higher is better, >1 means finer than the
-reference's own retry tick).
+``vs_baseline`` is 100 ms / p50 (the reference's own allocate-path retry
+tick, its only latency anchor; higher is better).
 
-Trend guard: exits nonzero (after printing the JSON line) when the measured
-p50 regresses >20% against the newest committed ``BENCH_r*.json``, so a
-latency regression can never land silently again (the round-1 -> round-3
-drift went unnoticed for two rounds). ``--no-trend-guard`` disables it.
+Trend guards: exits nonzero (after printing the JSON line) when the
+measured p50 regresses >20% — or the p99 >25% (tail regressions must not
+land silently either) — against the newest committed ``BENCH_r*.json``.
+``--no-trend-guard`` disables both. ``--smoke`` runs a 3-pod quick pass
+with all guards and the compute bench off (CI bit-rot insurance, see
+``make bench-smoke``).
 """
 
 from __future__ import annotations
 
+import argparse
 import json
+import os
 import re
 import statistics
 import subprocess
 import sys
 import tempfile
+import threading
 import time
 from pathlib import Path
 
@@ -57,9 +67,15 @@ TRIALS = 3
 # four repetitions pack the host 128/128 (first-fit lands them chip by chip).
 POD_SIZES = [16, 8, 4, 2, 2] * CHIPS
 TREND_GUARD_PCT = 20.0
+P99_GUARD_PCT = 25.0
+DEFAULT_WORKERS = 8
+CONCURRENT_ROUNDS = 4  # first round is warmup, like the serial trial
+CONCURRENT_POD_UNITS = 2
 
 
-def run_allocate_trial() -> tuple[list[float], float, float]:
+def run_allocate_trial(
+    rounds: int = ROUNDS, pod_sizes: list[int] | None = None
+) -> tuple[list[float], float, float]:
     """One full fill/drain cycle; returns (latencies_ms, wall_s, peak_util%)."""
     from gpushare_device_plugin_tpu import const
     from gpushare_device_plugin_tpu.allocator.cluster import ClusterAllocator
@@ -73,6 +89,7 @@ def run_allocate_trial() -> tuple[list[float], float, float]:
     from fake_kubelet import FakeKubelet
     from k8s_fixtures import make_pod
 
+    pod_sizes = pod_sizes if pod_sizes is not None else POD_SIZES
     tmp = tempfile.mkdtemp(prefix="tpushare-bench-")
     api = FakeApiServer()
     api.add_node(NODE)
@@ -98,11 +115,11 @@ def run_allocate_trial() -> tuple[list[float], float, float]:
     peak_used = 0
     pod_seq = 0
     fill_wall = 0.0
-    for rnd in range(ROUNDS):
+    for rnd in range(rounds):
         t_fill0 = time.perf_counter()
         running: list[str] = []
         used = 0
-        for size in POD_SIZES:
+        for size in pod_sizes:
             name = f"bench-{pod_seq}"
             pod_seq += 1
             api.add_pod(make_pod(name, size, node=NODE))
@@ -150,6 +167,251 @@ def run_allocate_trial() -> tuple[list[float], float, float]:
     informer.stop()
     api.stop()
     return latencies, fill_wall, 100.0 * peak_used / total_units
+
+
+def run_concurrent_trial(
+    workers: int,
+    rounds: int = CONCURRENT_ROUNDS,
+    pod_units: int = CONCURRENT_POD_UNITS,
+    pods_per_round: int | None = None,
+) -> dict:
+    """Concurrent-admission storm: ``workers`` threads drive Allocate()
+    through the real gRPC socket against a shared pool of same-size
+    pending pods (the hardest case for the match semantics — every worker
+    competes for the same candidates). Per round the host is packed
+    exactly full, then every assignment is audited: each pod annotated
+    exactly once, no chip over its capacity. Returns aggregate pods/s over
+    the timed rounds (round 0 is warmup) plus the audit tallies."""
+    from gpushare_device_plugin_tpu import const
+    from gpushare_device_plugin_tpu.allocator.cluster import ClusterAllocator
+    from gpushare_device_plugin_tpu.cluster.apiserver import ApiServerClient
+    from gpushare_device_plugin_tpu.cluster.informer import PodInformer
+    from gpushare_device_plugin_tpu.device import DeviceInventory
+    from gpushare_device_plugin_tpu.discovery import MockBackend
+    from gpushare_device_plugin_tpu.plugin import PluginConfig, TpuSharePlugin
+
+    from fake_apiserver import FakeApiServer
+    from fake_kubelet import FakeKubelet
+
+    tmp = tempfile.mkdtemp(prefix="tpushare-cbench-")
+    api = FakeApiServer()
+    api.add_node(NODE)
+    api.start()
+    kubelet = FakeKubelet(tmp)
+    kubelet.start()
+
+    client = ApiServerClient(api.url)
+    inv = DeviceInventory(MockBackend(num_chips=CHIPS, hbm_bytes=HBM_GIB << 30).chips())
+    informer = PodInformer(client, NODE).start()
+    allocator = ClusterAllocator(inv, client, informer, NODE)
+    plugin = TpuSharePlugin(
+        inv,
+        allocate_fn=allocator.allocate,
+        config=PluginConfig(plugin_dir=tmp, grpc_workers=max(8, workers + 4)),
+    )
+    plugin.serve()
+    reg = kubelet.wait_for_registration()
+    assert reg.resource_name == const.RESOURCE_MEM
+    kubelet.stub_for(reg.endpoint)  # pre-dial before the threads race it
+
+    units_by_index = inv.units_by_index()
+    total_units = sum(units_by_index.values())
+    if pods_per_round is None:
+        pods_per_round = total_units // pod_units  # exact pack
+
+    def wait_until(pred, timeout=10.0):
+        deadline = time.perf_counter() + timeout
+        while time.perf_counter() < deadline:
+            if pred():
+                return True
+            time.sleep(0.001)
+        return False
+
+    try:
+        timed_pods, timed_wall, latencies = _concurrent_rounds(
+            api, kubelet, reg, informer, client, units_by_index,
+            workers, rounds, pod_units, pods_per_round, wait_until,
+        )
+    finally:
+        plugin.stop()
+        kubelet.stop()
+        informer.stop()
+        api.stop()
+    return {
+        "workers": workers,
+        # Thread concurrency buys wall-clock only where admission waits
+        # (apiserver RTT) rather than computes; the speedup is therefore
+        # core-count-bound on CPU-starved hosts. Recorded so a reader can
+        # interpret speedup_vs_serial against the machine that produced it.
+        "cpus": os.cpu_count(),
+        "pods_per_round": pods_per_round,
+        "pod_units": pod_units,
+        "rounds_timed": rounds - 1,
+        "throughput_pods_s": round(timed_pods / timed_wall, 1) if timed_wall else 0.0,
+        "p50_ms": round(statistics.median(latencies), 3) if latencies else None,
+        "p99_ms": (
+            round(statistics.quantiles(latencies, n=100)[98], 3)
+            if len(latencies) >= 100
+            else None
+        ),
+        "double_assignments": 0,  # audited per round; any nonzero raises
+    }
+
+
+def _concurrent_rounds(
+    api, kubelet, reg, informer, client, units_by_index,
+    workers, rounds, pod_units, pods_per_round, wait_until,
+) -> tuple[int, float, list[float]]:
+    from gpushare_device_plugin_tpu import const
+
+    from k8s_fixtures import make_pod
+
+    timed_pods = 0
+    timed_wall = 0.0
+    latencies: list[float] = []
+    errors: list[str] = []
+    pod_seq = 0
+    for rnd in range(rounds):
+        names = []
+        for _ in range(pods_per_round):
+            name = f"cbench-{pod_seq}"
+            pod_seq += 1
+            api.add_pod(make_pod(name, pod_units, node=NODE))
+            names.append(name)
+        # the storm measures admission, not watch propagation: wait until
+        # every pending pod is matchable from the cache before firing
+        assert wait_until(
+            lambda: len(informer.pending_pods()) >= pods_per_round
+        ), "informer never saw the round's pending pods"
+
+        jobs = list(range(pods_per_round))
+        jobs_lock = threading.Lock()
+        round_lat: list[list[float]] = [[] for _ in range(workers)]
+        barrier = threading.Barrier(workers + 1)
+
+        def worker(wi: int):
+            barrier.wait()
+            while True:
+                with jobs_lock:
+                    if not jobs:
+                        return
+                    jobs.pop()
+                t0 = time.perf_counter()
+                try:
+                    kubelet.allocate(
+                        reg.endpoint, [[f"g{i}" for i in range(pod_units)]]
+                    )
+                except Exception as e:  # noqa: BLE001 — audited below
+                    errors.append(str(e))
+                round_lat[wi].append((time.perf_counter() - t0) * 1e3)
+
+        threads = [
+            threading.Thread(target=worker, args=(wi,), daemon=True)
+            for wi in range(workers)
+        ]
+        for t in threads:
+            t.start()
+        barrier.wait()
+        t0 = time.perf_counter()
+        for t in threads:
+            t.join(timeout=60.0)
+        wall = time.perf_counter() - t0
+        hung = [t.name for t in threads if t.is_alive()]
+        if hung:
+            # a bogus 60s wall would inflate throughput and the audit
+            # below would race the still-running workers — fail loudly
+            raise AssertionError(f"storm workers hung past 60s: {hung}")
+        if errors:
+            raise AssertionError(f"concurrent Allocate errors: {errors[:3]}")
+
+        # audit the round: every pod assigned exactly once, no chip over
+        # capacity — the storm must not trade throughput for correctness
+        used_by_chip: dict[int, int] = {}
+        for name in names:
+            pod = client.get_pod("default", name)
+            ann = pod["metadata"].get("annotations", {})
+            assert ann.get(const.ENV_ASSIGNED_FLAG) == "true", f"{name} unassigned"
+            idx = int(ann[const.ENV_MEM_IDX])
+            used_by_chip[idx] = used_by_chip.get(idx, 0) + pod_units
+        over = {
+            i: u for i, u in used_by_chip.items() if u > units_by_index.get(i, 0)
+        }
+        assert not over, f"chip over-commit after storm: {over}"
+
+        if rnd > 0:
+            timed_pods += pods_per_round
+            timed_wall += wall
+            for lats in round_lat:
+                latencies.extend(lats)
+
+        for name in names:
+            api.delete_pod("default", name)
+        assert wait_until(
+            lambda: all(informer.get_pod("default", n) is None for n in names)
+        ), "informer never drained the round's deleted pods"
+
+    return timed_pods, timed_wall, latencies
+
+
+def run_extender_bench(
+    n_nodes: int = 32, pods_per_node: int = 30, iters: int = 30
+) -> dict:
+    """Multi-node scheduler-extender scoring benchmark: a cluster-wide
+    informer holds ``n_nodes * pods_per_node`` placed pods; one scheduling
+    decision = batched filter+prioritize over all nodes. Reports the p50
+    per decision with the incremental index + NodeView cache hot, and the
+    legacy two-verb cost for comparison."""
+    from gpushare_device_plugin_tpu.cluster.apiserver import ApiServerClient
+    from gpushare_device_plugin_tpu.cluster.informer import PodInformer
+    from gpushare_device_plugin_tpu.extender.server import ExtenderCore
+
+    from fake_apiserver import FakeApiServer
+    from k8s_fixtures import assigned_running_pod, make_pod
+
+    api = FakeApiServer()
+    api.start()
+    nodes = []
+    for j in range(n_nodes):
+        name = f"xb{j}"
+        cap = {"aliyun.com/tpu-mem": str(CHIPS * HBM_GIB), "aliyun.com/tpu-count": str(CHIPS)}
+        node = {
+            "metadata": {"name": name, "labels": {}, "resourceVersion": "1"},
+            "status": {"capacity": dict(cap), "allocatable": dict(cap)},
+        }
+        api.nodes[name] = node
+        nodes.append(node)
+    for i in range(n_nodes * pods_per_node):
+        api.add_pod(
+            assigned_running_pod(
+                f"xp{i}", 2, chip_idx=i % CHIPS, node=f"xb{i % n_nodes}"
+            )
+        )
+    client = ApiServerClient(api.url)
+    informer = PodInformer(client).start(sync_timeout_s=30)
+    core = ExtenderCore(client, informer=informer)
+    pending = make_pod("xbench-pod", 4, node="")
+    args = {"pod": pending, "nodes": {"items": nodes}}
+    try:
+        assert core.batch(args)["nodenames"], "extender bench: nothing fits"
+        batch_lat, pair_lat = [], []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            core.batch(args)
+            batch_lat.append((time.perf_counter() - t0) * 1e3)
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            core.filter(args)
+            core.prioritize(args)
+            pair_lat.append((time.perf_counter() - t0) * 1e3)
+    finally:
+        informer.stop()
+        api.stop()
+    return {
+        "nodes": n_nodes,
+        "pods": n_nodes * pods_per_node,
+        "batch_p50_ms": round(statistics.median(batch_lat), 3),
+        "filter_prioritize_p50_ms": round(statistics.median(pair_lat), 3),
+    }
 
 
 def _iter_json_objects(text: str):
@@ -216,6 +478,24 @@ def trend_guard(p50: float, repo: Path) -> str | None:
     return None
 
 
+def p99_guard(p99: float, repo: Path) -> str | None:
+    """Failure message when ``p99`` regressed >P99_GUARD_PCT vs the newest
+    committed record carrying a p99; None when within budget (or no
+    history). The p50 guard alone let tail-latency regressions land
+    silently — a hot path can keep its median while growing a lock-wait
+    tail, which is exactly the failure mode a concurrency rework risks."""
+    prev = previous_metric(repo, "p99_ms")
+    if prev is None:
+        return None
+    prev_p99, fname = prev
+    if p99 > prev_p99 * (1 + P99_GUARD_PCT / 100.0):
+        return (
+            f"TREND GUARD: p99 {p99:.3f}ms regressed >{P99_GUARD_PCT:.0f}% "
+            f"vs {fname} ({prev_p99:.3f}ms)"
+        )
+    return None
+
+
 def utilization_guard(util_pct: float, repo: Path) -> str | None:
     """Failure message when peak binpack utilization dropped below the
     newest committed record's (no tolerance: the fill schedule packs the
@@ -271,22 +551,50 @@ def run_compute_bench(repo: Path) -> dict:
     return {"error": f"no JSON output ({note or 'empty'})"}
 
 
-def main() -> int:
-    args = sys.argv[1:]
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(prog="bench.py")
+    p.add_argument("--workers", type=int, default=DEFAULT_WORKERS,
+                   help="concurrent-admission worker count (0 = skip the "
+                   "concurrent section)")
+    p.add_argument("--smoke", action="store_true",
+                   help="3-pod quick run: 1 trial, tiny rounds, guards and "
+                   "compute bench off — exercises every section end to end "
+                   "so the script itself cannot bit-rot (make bench-smoke)")
+    p.add_argument("--no-mfu", action="store_true")
+    p.add_argument("--no-trend-guard", action="store_true")
+    p.add_argument("--no-util-guard", action="store_true")
+    p.add_argument("--no-extender", action="store_true",
+                   help="skip the multi-node extender scoring section")
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
     repo = Path(__file__).resolve().parent
+    if args.smoke:
+        args.no_mfu = True
+        args.no_trend_guard = True
+        args.no_util_guard = True
+    trials = 1 if args.smoke else TRIALS
+    rounds = 2 if args.smoke else ROUNDS
+    pod_sizes = [16, 8, 4] if args.smoke else POD_SIZES  # smoke: 3 pods/round
 
     trial_p50s: list[float] = []
     trial_p99s: list[float] = []
     throughputs: list[float] = []
     utils: list[float] = []
-    for i in range(TRIALS):
-        latencies, wall, util = run_allocate_trial()
+    for i in range(trials):
+        latencies, wall, util = run_allocate_trial(rounds=rounds, pod_sizes=pod_sizes)
         trial_p50s.append(statistics.median(latencies))
-        trial_p99s.append(statistics.quantiles(latencies, n=100)[98])
+        trial_p99s.append(
+            statistics.quantiles(latencies, n=100)[98]
+            if len(latencies) >= 100
+            else max(latencies)
+        )
         throughputs.append(len(latencies) / wall)
         utils.append(util)
         print(
-            f"trial {i + 1}/{TRIALS}: pods={len(latencies)} "
+            f"trial {i + 1}/{trials}: pods={len(latencies)} "
             f"p50={trial_p50s[-1]:.3f}ms p99={trial_p99s[-1]:.3f}ms "
             f"throughput={throughputs[-1]:.1f} pods/s",
             file=sys.stderr,
@@ -294,15 +602,50 @@ def main() -> int:
 
     p50 = statistics.median(trial_p50s)
     p99 = statistics.median(trial_p99s)
+    serial_pods_s = statistics.median(throughputs)
     print(
         f"allocate: p50={p50:.3f}ms (spread {min(trial_p50s):.3f}-{max(trial_p50s):.3f}) "
         f"p99={p99:.3f}ms (spread {min(trial_p99s):.3f}-{max(trial_p99s):.3f}) "
-        f"throughput={statistics.median(throughputs):.1f} pods/s "
+        f"throughput={serial_pods_s:.1f} pods/s "
         f"peak_binpack_utilization={max(utils):.1f}%",
         file=sys.stderr,
     )
 
-    compute = {} if "--no-mfu" in args else run_compute_bench(repo)
+    concurrent = {}
+    if args.workers > 0:
+        concurrent = run_concurrent_trial(
+            args.workers,
+            rounds=2 if args.smoke else CONCURRENT_ROUNDS,
+            pod_units=16 if args.smoke else CONCURRENT_POD_UNITS,
+        )
+        if serial_pods_s > 0 and concurrent.get("throughput_pods_s"):
+            concurrent["speedup_vs_serial"] = round(
+                concurrent["throughput_pods_s"] / serial_pods_s, 2
+            )
+        print(
+            f"concurrent (workers={args.workers}): "
+            f"throughput={concurrent['throughput_pods_s']:.1f} pods/s "
+            f"(x{concurrent.get('speedup_vs_serial', 0)} vs serial) "
+            f"p50={concurrent['p50_ms']}ms "
+            f"double_assignments={concurrent['double_assignments']}",
+            file=sys.stderr,
+        )
+
+    extender = {}
+    if not args.no_extender:
+        extender = run_extender_bench(
+            n_nodes=4 if args.smoke else 32,
+            pods_per_node=5 if args.smoke else 30,
+            iters=5 if args.smoke else 30,
+        )
+        print(
+            f"extender ({extender['nodes']} nodes, {extender['pods']} pods): "
+            f"batch_p50={extender['batch_p50_ms']}ms "
+            f"filter+prioritize_p50={extender['filter_prioritize_p50_ms']}ms",
+            file=sys.stderr,
+        )
+
+    compute = {} if args.no_mfu else run_compute_bench(repo)
     if compute.get("train"):
         t = compute["train"]
         print(
@@ -318,13 +661,15 @@ def main() -> int:
         "vs_baseline": round(100.0 / p50, 1),
         "p50_spread_ms": [round(min(trial_p50s), 3), round(max(trial_p50s), 3)],
         "p99_ms": round(p99, 3),
-        "throughput_pods_s": round(statistics.median(throughputs), 1),
+        "throughput_pods_s": round(serial_pods_s, 1),
         # North star #2 (BASELINE.md, reference analog display.go:231-241):
         # peak TPU-HBM binpack utilization across trials — the fill rounds
         # pack the host completely, so anything under 100 is an allocator
         # regression.
         "binpack_utilization_pct": round(max(utils), 1),
-        "trials": TRIALS,
+        "trials": trials,
+        "concurrent": concurrent,
+        "extender": extender,
         "compute": compute,
     }
     print(json.dumps(record))
@@ -332,9 +677,10 @@ def main() -> int:
     # Each guard has its own opt-out: bypassing an accepted latency
     # regression must not also waive the utilization bar (and vice versa).
     msgs = []
-    if "--no-trend-guard" not in args:
+    if not args.no_trend_guard:
         msgs.append(trend_guard(p50, repo))
-    if "--no-util-guard" not in args:
+        msgs.append(p99_guard(p99, repo))
+    if not args.no_util_guard:
         msgs.append(utilization_guard(record["binpack_utilization_pct"], repo))
     failed = [m for m in msgs if m is not None]
     if failed:

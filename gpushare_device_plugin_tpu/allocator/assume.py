@@ -1,0 +1,148 @@
+"""In-flight reservation ledger for the node's allocators (the assume-cache).
+
+The reference serializes the *entire* Allocate flow — match, placement,
+and the apiserver PATCH — behind one mutex (``allocate.go:42-43``), so N
+concurrent kubelet admission workers pay N sequential apiserver
+round-trips. This ledger is what lets the lock be sharded away: the only
+state that truly needs cross-worker atomicity is "which pods are mid-
+admission and what did we promise them", and that is pure memory.
+
+Design (mirrors the scheduler extender's bind reservation, which solved
+the same problem one layer up):
+
+- **claim**: a pod matched by one worker is claimed by key, so a
+  concurrent same-size Allocate matches the *next* oldest candidate
+  instead of racing for the same pod. Claims are what keep the documented
+  oldest-first same-size match semantics intact under concurrency.
+- **reserve**: the chip decision is recorded (mem units on a chip index /
+  exclusively-held chip set) *before* the PATCH goes out. Every other
+  worker's placement overlays these reservations on top of the pod
+  source's usage snapshot, so two in-flight placements cannot double-book
+  a chip even though neither is visible in the apiserver yet.
+- **transaction**: snapshot-overlay-decide-reserve must be one atomic
+  step against other reservations; ``transaction()`` scopes it. The lock
+  is an RLock held only for in-memory work — network I/O (PATCH, LIST)
+  never runs under it on the warm informer path (the one cold exception:
+  a never-synced cache refreshes inside ``chip_state()``) — and the wait
+  for it is exported as a histogram so contention regressions are
+  observable.
+- **release**: after the PATCH persists (and ``note_pod_update`` has fed
+  the result back into the pod source), the reservation is redundant —
+  the source itself now counts the pod — and is dropped. The overlay
+  skips reservations the source already counts (``visible_fn``), so the
+  persist→release window cannot double-count either.
+
+Failure semantics: any error path releases the claim and reservations, so
+a failed admission never leaks phantom usage.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+from ..utils.metrics import timed_acquire
+
+PodKey = tuple[str, str]  # (namespace, name)
+
+LOCK_WAIT_METRIC = "tpushare_allocator_lock_wait_seconds"
+LOCK_WAIT_HELP = (
+    "Time Allocate workers spend waiting for allocator locks "
+    "(match stripes and the reservation ledger); mass above ~1ms means "
+    "I/O crept back under a lock"
+)
+
+
+class AssumeCache:
+    """Shared between the node's mem and core allocators: the two
+    resources share one physical-chip ledger, and reservations from one
+    must exclude chips from the other (the same reason they used to share
+    one mutex)."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._claimed: set[PodKey] = set()
+        self._mem: dict[PodKey, tuple[int, int]] = {}  # key -> (chip, units)
+        self._core: dict[PodKey, tuple[int, ...]] = {}  # key -> chip indices
+        # Legacy full-serialization lock for list-backed pod sources: they
+        # expose no get_pod, so a worker cannot re-verify a candidate
+        # against live state at claim time — without that check the
+        # sharded flow could re-match a pod whose PATCH landed after the
+        # matcher's LIST snapshot. Those sources keep the reference's
+        # one-admission-at-a-time semantics; the informer (the default)
+        # takes the sharded path. Shared mem/core like everything here.
+        self.serial_lock = threading.RLock()
+
+    # --- claims -----------------------------------------------------------
+
+    def claim(self, key: PodKey) -> bool:
+        """Mark ``key`` as mid-admission; False if already claimed."""
+        with self._lock:
+            if key in self._claimed:
+                return False
+            self._claimed.add(key)
+            return True
+
+    def is_claimed(self, key: PodKey) -> bool:
+        with self._lock:
+            return key in self._claimed
+
+    def release(self, key: PodKey) -> None:
+        """Drop the claim and any reservations for ``key`` (success — the
+        pod source counts the pod now — or failure — nothing was placed)."""
+        with self._lock:
+            self._claimed.discard(key)
+            self._mem.pop(key, None)
+            self._core.pop(key, None)
+
+    # --- reservations (call within transaction()) -------------------------
+
+    @contextlib.contextmanager
+    def transaction(self):
+        """Scope one atomic snapshot-overlay-decide-reserve step. In-memory
+        work only; the wait is recorded in the lock-wait histogram."""
+        with timed_acquire(
+            self._lock, LOCK_WAIT_METRIC, LOCK_WAIT_HELP, lock="ledger"
+        ):
+            yield self
+
+    def reserve_mem(self, key: PodKey, chip_idx: int, units: int) -> None:
+        with self._lock:
+            self._mem[key] = (chip_idx, units)
+
+    def reserve_core(self, key: PodKey, chip_indices: list[int]) -> None:
+        with self._lock:
+            self._core[key] = tuple(chip_indices)
+
+    def overlaid_state(
+        self, state_fn, visible_fn=None
+    ) -> tuple[dict[int, int], set[int]]:
+        """One usage snapshot with in-flight reservations folded in:
+        ``state_fn() -> (mem_used, core_held)`` caller-owned copies.
+
+        ``visible_fn(key) -> bool`` reports whether the pod source already
+        counts the pod (its PATCHed copy landed in the cache) — those
+        reservations are skipped to avoid double-counting in the window
+        between ``note_pod_update`` and ``release``. Ordering is the
+        correctness core: visibility is decided BEFORE ``state_fn`` reads
+        the snapshot. Visibility only ever flips invisible -> visible (a
+        deleted pod stops being visible, but then holds nothing), so a
+        reservation judged visible is provably in any snapshot read
+        afterwards — every in-flight pod is counted at least once, never
+        zero times. The reverse order would let a pod land in the cache
+        between an older snapshot and the visibility check and be counted
+        nowhere. Without ``visible_fn`` every reservation counts, which is
+        conservative (can only over-count, never double-book).
+        """
+        with self._lock:
+            mem = list(self._mem.items())
+            core = list(self._core.items())
+        if visible_fn is not None:
+            mem = [(k, v) for k, v in mem if not visible_fn(k)]
+            core = [(k, v) for k, v in core if not visible_fn(k)]
+        mem_used, core_held = state_fn()
+        for _key, (idx, units) in mem:
+            mem_used[idx] = mem_used.get(idx, 0) + units
+        for _key, indices in core:
+            core_held.update(indices)
+        return mem_used, core_held

@@ -19,10 +19,30 @@ SURVEY.md section 3.2) with its failure semantics:
    strategic-merge patch, retried once on optimistic-lock conflicts
    (``allocate.go:136-150``). The apiserver is the only database; restart
    re-derives everything.
+
+Concurrency design (replaces the reference's single mutex,
+``allocate.go:42-43``): the flow is sharded so concurrent kubelet
+admission workers for different pods proceed in parallel —
+
+- *match* is serialized per request size only (striped locks): two
+  same-size pods admitted concurrently keep the documented oldest-first
+  semantics because the first worker *claims* its match in the shared
+  ``AssumeCache`` and the second matches the next oldest candidate;
+- *placement* is one atomic in-memory transaction against the ledger:
+  usage snapshot + in-flight reservation overlay + chip decision +
+  reservation, so two in-flight placements cannot double-book a chip;
+- *persist* (the apiserver PATCH — the dominant wall-clock cost) runs
+  under no lock at all; the reservation covers the pod until its PATCHed
+  copy is visible in the pod source.
+
+The same-size-pod match hazard documented above (point 2) is unchanged:
+two same-size pods can still swap allocations — each still gets *a*
+valid placement, never the same one.
 """
 
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
 from typing import Sequence
@@ -32,12 +52,74 @@ from ..cluster import pods as P
 from ..cluster.apiserver import ApiError, ApiServerClient
 from ..cluster.events import REASON_ALLOC_FAILED, emit_pod_event
 from ..cluster.podsource import PodSource
+from ..cluster.usage import pod_counts_toward_usage
 from ..device.fanout import DeviceInventory
 from ..utils.log import get_logger
+from ..utils.metrics import timed_acquire
+from .assume import LOCK_WAIT_HELP, LOCK_WAIT_METRIC, AssumeCache, PodKey
 from .binpack import assign_chip
 from .env import ContainerAllocation, build_core_allocation, build_mem_allocation
 
 log = get_logger("allocator.cluster")
+
+# Match stripes: same-size matches must serialize (they compete for the
+# same oldest candidate); different sizes never do. 8 stripes is plenty —
+# the stripe is held only for the in-memory match, not the PATCH.
+NUM_MATCH_STRIPES = 8
+
+
+def _pod_key(pod) -> PodKey:
+    return P.namespace(pod), P.name(pod)
+
+
+def _counted_by_source(pod_source, key: PodKey) -> bool:
+    """True when the pod source's own accounting already covers the
+    reserved pod (its PATCHed copy landed in the cache) — the reservation
+    overlay skips it to avoid double-counting. List-backed sources expose
+    no ``get_pod``; their reservations count until released, which is
+    conservative (over-counts briefly, never double-books)."""
+    get_pod = getattr(pod_source, "get_pod", None)
+    if get_pod is None:
+        return False
+    pod = get_pod(*key)
+    return pod is not None and pod_counts_toward_usage(pod)
+
+
+def _live_candidate(pod_source, pod, node: str, units: int, resource: str):
+    """Re-evaluate a matched candidate against the source's *current*
+    state. The match snapshot can predate a concurrent worker's
+    note_pod_update: its claim is released only after the PATCHed copy is
+    in the cache, so a candidate that is (a) unclaimed and (b) still a
+    candidate in the live copy is genuinely unowned. Returns the live pod
+    (the copy to place/persist) or None. Sources without ``get_pod`` run
+    fully serialized (``_serial_guard``) and skip this check."""
+    get_pod = getattr(pod_source, "get_pod", None)
+    if get_pod is None:
+        return pod
+    live = get_pod(P.namespace(pod), P.name(pod))
+    if live is None:
+        return None
+    if P.phase(live) != "Pending" or P.node_name(live) != node:
+        return None
+    if P.mem_units_of_pod(live, resource=resource) != units:
+        return None
+    if P.is_assumed(live) and P.is_assigned(live):
+        return None
+    return live
+
+
+def _serial_guard(pod_source, assume: AssumeCache):
+    """The sharded flow is safe only when a matcher can re-verify a stale
+    candidate against live state (``get_pod``). List-backed sources can't
+    offer that — a LIST snapshot taken before a concurrent PATCH would
+    happily re-match the just-assigned pod — so they keep the reference's
+    one-admission-at-a-time lock; the informer path returns a no-op
+    guard and admissions overlap."""
+    if getattr(pod_source, "get_pod", None) is None:
+        return timed_acquire(
+            assume.serial_lock, LOCK_WAIT_METRIC, LOCK_WAIT_HELP, lock="serial"
+        )
+    return contextlib.nullcontext()
 
 
 class AllocationFailure(RuntimeError):
@@ -94,7 +176,7 @@ class ClusterAllocator:
         policy: str = "first-fit",
         disable_isolation: bool = False,
         unhealthy_chips_fn=None,
-        lock: threading.Lock | None = None,
+        assume: AssumeCache | None = None,
     ):
         self._inv = inventory
         self._api = api
@@ -103,12 +185,13 @@ class ClusterAllocator:
         self._policy = policy
         self._disable_isolation = disable_isolation
         self._unhealthy_fn = unhealthy_chips_fn or (lambda: [])
-        # Serializes the whole allocate path (reference: allocate.go:42-43).
+        # The in-flight claim/reservation ledger (see allocator.assume).
         # MUST be shared with the node's ClusterCoreAllocator: the two
-        # resources share one physical-chip ledger, and independent locks
-        # would let concurrent mem/core Allocates each read a snapshot
-        # before the other persists — double-booking the same chip.
-        self._lock = lock if lock is not None else threading.Lock()
+        # resources share one physical-chip ledger, and independent
+        # ledgers would let concurrent mem/core Allocates each read a
+        # snapshot before the other persists — double-booking the chip.
+        self._assume = assume if assume is not None else AssumeCache()
+        self._match_locks = [threading.Lock() for _ in range(NUM_MATCH_STRIPES)]
 
     # ------------------------------------------------------------------
 
@@ -116,56 +199,8 @@ class ClusterAllocator:
         pod_units = sum(len(ids) for ids in granted)
         container_units = [len(ids) for ids in granted]
         log.v(4, "Allocate: pod_units=%d per-container=%s", pod_units, container_units)
-        with self._lock:
-            pod = self._match_pending_pod(pod_units)
-            if pod is None:
-                # Cached sources may lag the scheduler's bind by a watch
-                # event; one synchronous refresh closes the window before
-                # we fail the admission.
-                self._pods.refresh()
-                pod = self._match_pending_pod(pod_units)
-            if pod is None:
-                raise AllocationFailure(
-                    f"invalid allocation request: no pending pod on {self._node} "
-                    f"requesting {pod_units} {const.RESOURCE_MEM}"
-                )
-            try:
-                for attempt in (0, 1):
-                    idx, annotations = self._place(pod, pod_units)
-                    try:
-                        self._persist(pod, annotations)
-                        break
-                    except _PodGone:
-                        # The matched pod was deleted with its cache entry
-                        # still live — evict it and re-match so a live
-                        # same-size pod is not failed for a ghost's sake.
-                        log.warning(
-                            "pod %s/%s vanished during persist; re-matching",
-                            P.namespace(pod), P.name(pod),
-                        )
-                        self._pods.evict(pod)
-                        pod = None
-                        if attempt:
-                            raise AllocationFailure(
-                                f"no live pending pod on {self._node} "
-                                f"requesting {pod_units} {const.RESOURCE_MEM}"
-                            ) from None
-                        self._pods.refresh()
-                        pod = self._match_pending_pod(pod_units)
-                        if pod is None:
-                            raise AllocationFailure(
-                                f"invalid allocation request: no pending pod "
-                                f"on {self._node} requesting {pod_units} "
-                                f"{const.RESOURCE_MEM}"
-                            ) from None
-            except AllocationFailure as e:
-                # kubelet only logs the gRPC error; a Warning event on the
-                # pod makes `kubectl describe pod` show why admission failed
-                if pod is not None:
-                    emit_pod_event(
-                        self._api, pod, REASON_ALLOC_FAILED, str(e), host=self._node
-                    )
-                raise
+        with _serial_guard(self._pods, self._assume):
+            idx, pod = self._admit(pod_units)
         chip = self._inv.chip_by_id(self._inv.id_of_index(idx))
         total = self._chip_total(idx)
         log.info(
@@ -183,19 +218,111 @@ class ClusterAllocator:
             for n in container_units
         ]
 
+    def _admit(self, pod_units: int):
+        """Match, place, persist; -> (chip index, the matched pod)."""
+        pod = self._claim_pod(pod_units)
+        try:
+            try:
+                for attempt in (0, 1):
+                    idx, annotations = self._place(pod, pod_units)
+                    try:
+                        self._persist(pod, annotations)
+                        break
+                    except _PodGone:
+                        # The matched pod was deleted with its cache entry
+                        # still live — evict it and re-match so a live
+                        # same-size pod is not failed for a ghost's sake.
+                        log.warning(
+                            "pod %s/%s vanished during persist; re-matching",
+                            P.namespace(pod), P.name(pod),
+                        )
+                        self._pods.evict(pod)
+                        self._assume.release(_pod_key(pod))
+                        pod = None
+                        if attempt:
+                            raise AllocationFailure(
+                                f"no live pending pod on {self._node} "
+                                f"requesting {pod_units} {const.RESOURCE_MEM}"
+                            ) from None
+                        pod = self._claim_pod(pod_units, refresh_first=True)
+            except AllocationFailure as e:
+                # kubelet only logs the gRPC error; a Warning event on the
+                # pod makes `kubectl describe pod` show why admission failed
+                if pod is not None:
+                    emit_pod_event(
+                        self._api, pod, REASON_ALLOC_FAILED, str(e), host=self._node
+                    )
+                raise
+        finally:
+            # Success: the PATCHed copy is in the pod source (counted by
+            # its own accounting, and ``note_pod_update`` landed before
+            # this release — matchers re-verify candidates against the
+            # live copy, so the released claim cannot re-open a re-match).
+            # Failure: nothing was placed. Either way the claim must not
+            # outlive this admission.
+            if pod is not None:
+                self._assume.release(_pod_key(pod))
+        return idx, pod
+
     # ------------------------------------------------------------------
 
     def _chip_total(self, idx: int) -> int:
         return self._inv.units_of(self._inv.id_of_index(idx))
 
+    def _claim_pod(self, pod_units: int, refresh_first: bool = False):
+        """Match + claim the oldest unclaimed same-size pending pod, under
+        this size's stripe lock (two same-size admissions serialize their
+        match; different sizes proceed in parallel). Raises when nothing
+        matches even after a refresh."""
+        stripe = self._match_locks[pod_units % NUM_MATCH_STRIPES]
+        with timed_acquire(
+            stripe, LOCK_WAIT_METRIC, LOCK_WAIT_HELP, lock="match"
+        ):
+            refreshed = refresh_first
+            if refresh_first:
+                self._pods.refresh()
+            while True:
+                pod = self._match_pending_pod(pod_units)
+                if pod is None and not refreshed:
+                    # Cached sources may lag the scheduler's bind by a
+                    # watch event; one synchronous refresh closes the
+                    # window before we fail the admission.
+                    refreshed = True
+                    self._pods.refresh()
+                    pod = self._match_pending_pod(pod_units)
+                if pod is None:
+                    raise AllocationFailure(
+                        f"invalid allocation request: no pending pod on "
+                        f"{self._node} requesting {pod_units} {const.RESOURCE_MEM}"
+                    )
+                # The stripe serializes same-size matches within this
+                # allocator, but another instance sharing the ledger (the
+                # core allocator on a dual-labeled ghost, or a rebuilt
+                # plugin's allocator) can win the claim between our match
+                # and here — losing means rescan, never proceed unowned.
+                if self._assume.claim(_pod_key(pod)):
+                    return pod
+
     def _match_pending_pod(self, pod_units: int):
         """Oldest pending share pod whose total limits equal the request
-        (``allocate.go:51-61``)."""
-        candidates = P.candidate_pods(self._pods.pending_pods(), self._node)
+        (``allocate.go:51-61``), skipping pods another worker has claimed
+        mid-admission. Candidates that pass the claim check are re-verified
+        against the live cache copy (see ``_live_candidate``) — the
+        snapshot may predate a concurrent worker's just-persisted
+        assignment."""
+        candidates = P.candidate_pods(
+            self._pods.pending_share_pods(const.RESOURCE_MEM), self._node
+        )
         log.v(4, "candidates: %s", [P.name(p) for p in candidates])
         for pod in candidates:
-            if P.mem_units_of_pod(pod) == pod_units:
-                return pod
+            if P.mem_units_of_pod(pod) == pod_units and not self._assume.is_claimed(
+                _pod_key(pod)
+            ):
+                live = _live_candidate(
+                    self._pods, pod, self._node, pod_units, const.RESOURCE_MEM
+                )
+                if live is not None:
+                    return live
         return None
 
     def _place(self, pod, pod_units: int) -> tuple[int, dict[str, str]]:
@@ -204,25 +331,33 @@ class ClusterAllocator:
         One ``chip_state()`` read serves both the usage accounting and the
         core-hold exclusion — O(chips) per placement with the informer's
         incremental index (the reference rescans every labeled pod per
-        admission, ``podmanager.go:102-115``)."""
+        admission, ``podmanager.go:102-115``). Snapshot, overlay of
+        in-flight reservations, decision, and this pod's own reservation
+        are one ledger transaction, so the chip is protected the moment it
+        is chosen — before the PATCH leaves the building."""
         if P.core_chips_of_pod(pod) > 0:
             raise AllocationFailure(
                 f"pod {P.name(pod)} requests both {const.RESOURCE_MEM} and "
                 f"{const.RESOURCE_CORE}; dual-resource pods are unsupported "
                 "(the two allocators would race each other's assigned flag)"
             )
-        mem_used, core_held = self._pods.chip_state()
-        if P.is_assumed(pod) and not P.is_assigned(pod):
-            idx = self._assumed_chip(pod, core_held)
-            annotations = {const.ENV_ASSIGNED_FLAG: "true"}
-        else:
-            idx = self._binpack_chip(pod_units, mem_used, core_held)
-            annotations = {
-                const.ENV_MEM_IDX: str(idx),
-                const.ENV_MEM_POD: str(pod_units),
-                const.ENV_MEM_DEV: str(self._chip_total(idx)),
-                const.ENV_ASSIGNED_FLAG: "true",
-            }
+        with self._assume.transaction():
+            mem_used, core_held = self._assume.overlaid_state(
+                self._pods.chip_state,
+                visible_fn=lambda key: _counted_by_source(self._pods, key),
+            )
+            if P.is_assumed(pod) and not P.is_assigned(pod):
+                idx = self._assumed_chip(pod, core_held)
+                annotations = {const.ENV_ASSIGNED_FLAG: "true"}
+            else:
+                idx = self._binpack_chip(pod_units, mem_used, core_held)
+                annotations = {
+                    const.ENV_MEM_IDX: str(idx),
+                    const.ENV_MEM_POD: str(pod_units),
+                    const.ENV_MEM_DEV: str(self._chip_total(idx)),
+                    const.ENV_ASSIGNED_FLAG: "true",
+                }
+            self._assume.reserve_mem(_pod_key(pod), idx, pod_units)
         annotations[const.ENV_ASSUME_TIME] = str(time.time_ns())
         return idx, annotations
 
@@ -291,7 +426,7 @@ class ClusterCoreAllocator:
         node_name: str,
         topology=None,
         unhealthy_chips_fn=None,
-        lock: threading.Lock | None = None,
+        assume: AssumeCache | None = None,
     ):
         self._inv = inventory
         self._api = api
@@ -300,7 +435,8 @@ class ClusterCoreAllocator:
         self._topo = topology
         self._unhealthy_fn = unhealthy_chips_fn or (lambda: [])
         # shared with the mem allocator — see ClusterAllocator.__init__
-        self._lock = lock if lock is not None else threading.Lock()
+        self._assume = assume if assume is not None else AssumeCache()
+        self._match_locks = [threading.Lock() for _ in range(NUM_MATCH_STRIPES)]
 
     def allocate(self, granted: Sequence[Sequence[str]]) -> list[ContainerAllocation]:
         total = sum(len(ids) for ids in granted)
@@ -312,16 +448,28 @@ class ClusterCoreAllocator:
             raise AllocationFailure(f"granted unknown chip id: {e}") from e
         indices = sorted(i for ids in per_container for i in ids)
         log.v(4, "core Allocate: chips %s", indices)
-        with self._lock:
-            pod = self._match_pending_pod(total)
-            if pod is None:
-                self._pods.refresh()
-                pod = self._match_pending_pod(total)
-            if pod is None:
-                raise AllocationFailure(
-                    f"invalid allocation request: no pending pod on {self._node} "
-                    f"requesting {total} {const.RESOURCE_CORE}"
-                )
+        with _serial_guard(self._pods, self._assume):
+            pod = self._admit(total, indices)
+        log.info(
+            "allocated core pod %s/%s: chips %s",
+            P.namespace(pod), P.name(pod), indices,
+        )
+        chips_by_id = {c.id: c for c in self._inv.chips()}
+        return [
+            build_core_allocation(
+                chips=[chips_by_id[self._inv.id_of_index(i)] for i in ids],
+                process_bounds=getattr(self._topo, "process_bounds", ""),
+                chips_per_process_bounds=getattr(
+                    self._topo, "chips_per_process_bounds", ""
+                ),
+            )
+            for ids in per_container
+        ]
+
+    def _admit(self, total: int, indices: list[int]):
+        """Match, validate+reserve, persist; -> the matched pod."""
+        pod = self._claim_pod(total)
+        try:
             try:
                 # Validation runs per attempt: a pod re-matched after
                 # _PodGone is a different pod and must clear the
@@ -334,7 +482,7 @@ class ClusterCoreAllocator:
                             f"{const.RESOURCE_MEM} and {const.RESOURCE_CORE}; "
                             "dual-resource pods are unsupported"
                         )
-                    self._check_conflicts(indices)
+                    self._check_and_reserve(pod, indices)
                     annotations = {
                         const.ENV_CORE_IDS: ",".join(str(i) for i in indices),
                         const.ENV_CORE_POD: str(total),
@@ -353,6 +501,7 @@ class ClusterCoreAllocator:
                             P.namespace(pod), P.name(pod),
                         )
                         self._pods.evict(pod)
+                        self._assume.release(_pod_key(pod))
                         pod = None
                         if attempt:
                             # final attempt: no point refreshing a result
@@ -361,66 +510,102 @@ class ClusterCoreAllocator:
                                 f"no live pending pod on {self._node} requesting "
                                 f"{total} {const.RESOURCE_CORE}"
                             ) from None
-                        self._pods.refresh()
-                        pod = self._match_pending_pod(total)
-                        if pod is None:
-                            raise AllocationFailure(
-                                f"no live pending pod on {self._node} requesting "
-                                f"{total} {const.RESOURCE_CORE}"
-                            ) from None
+                        pod = self._claim_pod(total, refresh_first=True)
             except AllocationFailure as e:
                 if pod is not None:
                     emit_pod_event(
                         self._api, pod, REASON_ALLOC_FAILED, str(e), host=self._node
                     )
                 raise
-        log.info(
-            "allocated core pod %s/%s: chips %s",
-            P.namespace(pod), P.name(pod), indices,
-        )
-        chips_by_id = {c.id: c for c in self._inv.chips()}
-        return [
-            build_core_allocation(
-                chips=[chips_by_id[self._inv.id_of_index(i)] for i in ids],
-                process_bounds=getattr(self._topo, "process_bounds", ""),
-                chips_per_process_bounds=getattr(
-                    self._topo, "chips_per_process_bounds", ""
-                ),
-            )
-            for ids in per_container
-        ]
+        finally:
+            if pod is not None:
+                self._assume.release(_pod_key(pod))
+        return pod
+
+    def _claim_pod(self, total: int, refresh_first: bool = False):
+        """Match + claim under the size stripe (see ClusterAllocator)."""
+        stripe = self._match_locks[total % NUM_MATCH_STRIPES]
+        with timed_acquire(
+            stripe, LOCK_WAIT_METRIC, LOCK_WAIT_HELP, lock="match"
+        ):
+            refreshed = refresh_first
+            if refresh_first:
+                self._pods.refresh()
+            while True:
+                pod = self._match_pending_pod(total)
+                if pod is None and not refreshed:
+                    refreshed = True
+                    self._pods.refresh()
+                    pod = self._match_pending_pod(total)
+                if pod is None:
+                    raise AllocationFailure(
+                        f"invalid allocation request: no pending pod on "
+                        f"{self._node} requesting {total} {const.RESOURCE_CORE}"
+                    )
+                # lost claim race to another instance -> rescan, see
+                # ClusterAllocator._claim_pod
+                if self._assume.claim(_pod_key(pod)):
+                    return pod
 
     def _match_pending_pod(self, total: int):
         candidates = P.candidate_pods(
-            self._pods.pending_pods(), self._node, resource=const.RESOURCE_CORE
+            self._pods.pending_share_pods(const.RESOURCE_CORE),
+            self._node,
+            resource=const.RESOURCE_CORE,
         )
         for pod in candidates:
-            if P.core_chips_of_pod(pod) == total:
-                return pod
+            if P.core_chips_of_pod(pod) == total and not self._assume.is_claimed(
+                _pod_key(pod)
+            ):
+                live = _live_candidate(
+                    self._pods, pod, self._node, total, const.RESOURCE_CORE
+                )
+                if live is not None:
+                    return live
         return None
 
-    def _check_conflicts(self, indices: list[int]) -> None:
-        """Every granted chip must be free of other holds and healthy."""
-        mem_used, core_held = self._pods.chip_state()
-        unhealthy = set(self._unhealthy_fn())
-        for idx in indices:
-            if idx in core_held:
-                raise AllocationFailure(
-                    f"chip {idx} is already exclusively held by another "
-                    f"{const.RESOURCE_CORE} pod"
-                )
-            if mem_used.get(idx, 0) > 0:
-                raise AllocationFailure(
-                    f"chip {idx} has {mem_used[idx]} {const.RESOURCE_MEM} units "
-                    "in use by fractional pods; cannot grant exclusively"
-                )
-            if idx in unhealthy:
-                raise AllocationFailure(f"chip {idx} is unhealthy")
+    def _check_and_reserve(self, pod, indices: list[int]) -> None:
+        """Every granted chip must be free of other holds (in-flight
+        reservations included) and healthy; passing chips are reserved in
+        the same ledger transaction so a concurrent mem binpack excludes
+        them before this pod's PATCH lands."""
+        with self._assume.transaction():
+            mem_used, core_held = self._assume.overlaid_state(
+                self._pods.chip_state,
+                visible_fn=lambda key: _counted_by_source(self._pods, key),
+            )
+            unhealthy = set(self._unhealthy_fn())
+            for idx in indices:
+                if idx in core_held:
+                    raise AllocationFailure(
+                        f"chip {idx} is already exclusively held by another "
+                        f"{const.RESOURCE_CORE} pod"
+                    )
+                if mem_used.get(idx, 0) > 0:
+                    raise AllocationFailure(
+                        f"chip {idx} has {mem_used[idx]} {const.RESOURCE_MEM} units "
+                        "in use by fractional pods; cannot grant exclusively"
+                    )
+                if idx in unhealthy:
+                    raise AllocationFailure(f"chip {idx} is unhealthy")
+            self._assume.reserve_core(_pod_key(pod), indices)
 
 
-def cluster_chip_state(pod_source: PodSource):
-    """() -> (mem_used_by_chip, core_held_chips) from one source read."""
-    return pod_source.chip_state
+def cluster_chip_state(pod_source: PodSource, assume: AssumeCache | None = None):
+    """() -> (mem_used_by_chip, core_held_chips) from one source read,
+    with in-flight reservations folded in when the allocators' shared
+    ledger is supplied (GetPreferredAllocation should steer kubelet away
+    from chips a concurrent Allocate is mid-claiming, too)."""
+    if assume is None:
+        return pod_source.chip_state
+
+    def state():
+        return assume.overlaid_state(
+            pod_source.chip_state,
+            visible_fn=lambda key: _counted_by_source(pod_source, key),
+        )
+
+    return state
 
 
 def preferred_core_chips(inventory: DeviceInventory, state_fn):

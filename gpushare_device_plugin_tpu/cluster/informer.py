@@ -59,6 +59,20 @@ STALENESS_HELP = (
 # resourceVersion: blocks every store for the key until an authoritative
 # LIST shows it again (_merge_list clears sentinels on presence).
 TOMB_SENTINEL = 1 << 62
+# Tombstones normally die on relist GC, but a long watch-stable period
+# never relists — the map must also be bounded by size and age so a 404
+# storm (mass pod deletion mid-allocate) cannot grow it forever. Age
+# chosen >> any realistic watch-event lag; by then the lagging event the
+# tombstone guards against has either arrived or never will.
+TOMBSTONE_MAX = 1024
+TOMBSTONE_MAX_AGE_S = 600.0
+TOMBSTONE_SWEEP_EVERY_S = 60.0
+
+INDEX_REBUILDS = "tpushare_informer_index_rebuilds_total"
+INDEX_REBUILDS_HELP = (
+    "Full index rebuilds (registration + post-relist revalidation); "
+    "everything else is incremental on_change maintenance"
+)
 
 
 def _is_read_timeout(e: Exception) -> bool:
@@ -104,15 +118,18 @@ class PodInformer:
         use); empty means cluster-wide (the scheduler extender's use —
         placement accounting needs every node's pods, including assumed
         pods that carry annotations but no label yet)."""
+        from .indexes import LabeledPodIndex, PendingPodIndex
         from .usage import NodeChipUsage
 
         self._c = client
         self._node = node_name
         self._field_selector = f"spec.nodeName={node_name}" if node_name else ""
         self._cache: dict[tuple[str, str], dict] = {}
-        # key -> rv at eviction: blocks lagging in-flight watch events from
-        # resurrecting a pod the apiserver reported gone (PATCH 404)
-        self._tombstones: dict[tuple[str, str], int] = {}
+        # key -> (rv at eviction, monotonic stamp): blocks lagging in-flight
+        # watch events from resurrecting a pod the apiserver reported gone
+        # (PATCH 404); the stamp drives the age/size sweep
+        self._tombstones: dict[tuple[str, str], tuple[int, float]] = {}
+        self._last_tomb_sweep = time.monotonic()
         self._lock = threading.Lock()
         self._synced = threading.Event()
         self._stop = threading.Event()
@@ -120,11 +137,18 @@ class PodInformer:
         self._live_response = None  # in-flight watch, closed by stop()
         # Incremental aggregates maintained on every cache mutation so hot
         # paths read O(chips)/O(nodes) instead of rescanning the cache.
-        # Node-scoped only: a cluster-wide cache would merge chip 0 of
-        # every node into one bucket (consumers there register their own
-        # per-node index via add_index).
+        # NodeChipUsage is node-scoped only: a cluster-wide cache would
+        # merge chip 0 of every node into one bucket (consumers there
+        # register their own per-node index via add_index). The pod-set
+        # indexes (pending-by-resource, labeled-by-value) apply to both
+        # scopes and make pending_pods()/labeled_pods() O(answer) instead
+        # of O(cache).
         self._usage = NodeChipUsage() if node_name else None
-        self._indexes: list = [self._usage] if self._usage else []
+        self._pending = PendingPodIndex()
+        self._labeled = LabeledPodIndex()
+        self._indexes: list = [self._pending, self._labeled]
+        if self._usage:
+            self._indexes.append(self._usage)
         # monotonic timestamp of the last successful apiserver contact;
         # drives the staleness gauge while the cache serves degraded reads
         self._last_sync = time.monotonic()
@@ -212,7 +236,28 @@ class PodInformer:
         with self._lock:
             self._indexes.append(index)
             index.rebuild(list(self._cache.values()))
+        REGISTRY.counter_inc(
+            INDEX_REBUILDS, INDEX_REBUILDS_HELP,
+            scope=self._scope, reason="register",
+        )
         return self
+
+    def revalidate_indexes(self) -> None:
+        """Escape hatch: rebuild every index from the cache in one atomic
+        pass. Called after each relist — incremental maintenance is exact
+        by construction, but a relist is the moment the cache itself was
+        just re-anchored to an authoritative LIST, so re-deriving the
+        aggregates there turns any would-be drift bug from a permanent
+        corruption into a one-relist-cycle blip (and the rebuild counter
+        makes the frequency observable)."""
+        with self._lock:
+            pods = list(self._cache.values())
+            for ix in self._indexes:
+                ix.rebuild(pods)
+        REGISTRY.counter_inc(
+            INDEX_REBUILDS, INDEX_REBUILDS_HELP,
+            scope=self._scope, reason="revalidate",
+        )
 
     def _cache_set(self, key: tuple[str, str], pod: dict) -> None:
         """Caller must hold self._lock."""
@@ -241,6 +286,7 @@ class PodInformer:
         # note_pod_update/evict state (that would re-open the re-match
         # window on the Allocate path).
         self._merge_list(items, rv, gc_tombstones=True)
+        self.revalidate_indexes()
         self._synced.set()
         self._mark_synced()
         log.v(4, "informer listed %d pods at rv=%s", len(items), rv)
@@ -262,7 +308,7 @@ class PodInformer:
                 cached_rv = _rv_int(self._cache[key])
                 if list_rv is None or cached_rv is None or cached_rv <= list_rv:
                     self._cache_pop(key)
-            for key, tomb in list(self._tombstones.items()):
+            for key, (tomb, _stamp) in list(self._tombstones.items()):
                 if key in listed:
                     # Present in a LIST that provably postdates the
                     # eviction -> live now (a recreation). A LIST whose rv
@@ -290,11 +336,11 @@ class PodInformer:
         event must not revert a pod fed in by note_pod_update()/refresh()
         (that would re-open the re-match window those hooks close)."""
         new_rv = _rv_int(pod)
-        tomb = self._tombstones.get(key)
-        if tomb is not None:
+        entry = self._tombstones.get(key)
+        if entry is not None:
             # A lagging pre-deletion event must not resurrect an evicted
             # ghost; anything provably newer is a legitimate recreation.
-            if new_rv is None or new_rv <= tomb:
+            if new_rv is None or new_rv <= entry[0]:
                 return
             self._tombstones.pop(key, None)
         cached = self._cache.get(key)
@@ -323,11 +369,17 @@ class PodInformer:
                     self._cache_pop(key)
                 # the real deletion arrived; the tombstone has served its
                 # purpose (a later recreation must not be blocked)
-                tomb = self._tombstones.get(key)
-                if tomb is not None and (ev_rv is None or ev_rv >= tomb):
+                entry = self._tombstones.get(key)
+                if entry is not None and (ev_rv is None or ev_rv >= entry[0]):
                     self._tombstones.pop(key)
             elif etype in ("ADDED", "MODIFIED"):
                 self._store_if_newer(key, pod)
+            now = time.monotonic()
+            if (
+                self._tombstones
+                and now - self._last_tomb_sweep > TOMBSTONE_SWEEP_EVERY_S
+            ):
+                self._sweep_tombstones(now)
         # A pod moving OFF this node arrives as MODIFIED with a different
         # nodeName (field-selector watches emit it as DELETED on a real
         # apiserver; tolerate both shapes). Cluster-wide informers keep
@@ -400,27 +452,22 @@ class PodInformer:
     # --- PodSource protocol ----------------------------------------------
 
     def pending_pods(self) -> list[dict]:
-        with self._lock:
-            return [p for p in self._cache.values() if P.phase(p) == "Pending"]
+        return self._pending.pods()
+
+    def pending_share_pods(self, resource: str) -> list[dict]:
+        """Pending pods requesting ``resource`` — the allocator's match
+        universe, O(bucket) instead of O(cache) (the full-scan filter it
+        replaces lives on in ``P.candidate_pods`` as the screen over this
+        pre-filtered set)."""
+        return self._pending.pods(resource)
 
     def running_share_pods(self) -> list[dict]:
-        with self._lock:
-            return [
-                p
-                for p in self._cache.values()
-                if P.labels(p).get(const.LABEL_RESOURCE_KEY)
-                == const.LABEL_RESOURCE_VALUE
-            ]
+        return self._labeled.pods(const.LABEL_RESOURCE_VALUE)
 
     def labeled_pods(self) -> list[dict]:
         """All pods bearing the tpu/resource label (mem or core) — one
         snapshot for cross-resource accounting on the Allocate path."""
-        with self._lock:
-            return [
-                p
-                for p in self._cache.values()
-                if const.LABEL_RESOURCE_KEY in P.labels(p)
-            ]
+        return self._labeled.pods()
 
     def all_pods(self) -> list[dict]:
         """Every cached pod (the extender's placement accounting reads
@@ -491,7 +538,27 @@ class PodInformer:
             rv = _rv_int(cached) if cached is not None else None
             if rv is None:
                 rv = _rv_int(pod)
-            self._tombstones[key] = rv if rv is not None else TOMB_SENTINEL
+            now = time.monotonic()
+            self._tombstones[key] = (
+                rv if rv is not None else TOMB_SENTINEL, now
+            )
+            if len(self._tombstones) > TOMBSTONE_MAX:
+                self._sweep_tombstones(now)
+
+    def _sweep_tombstones(self, now: float) -> None:
+        """Caller must hold self._lock. Age out expired tombstones; if the
+        map still exceeds the size cap, drop oldest-first (a dropped
+        tombstone only re-opens the brief lagging-event window the next
+        relist would have closed anyway — an acceptable trade against an
+        unbounded map)."""
+        self._last_tomb_sweep = now
+        for key, (_rv, stamp) in list(self._tombstones.items()):
+            if now - stamp > TOMBSTONE_MAX_AGE_S:
+                self._tombstones.pop(key)
+        if len(self._tombstones) > TOMBSTONE_MAX:
+            by_age = sorted(self._tombstones.items(), key=lambda kv: kv[1][1])
+            for key, _entry in by_age[: len(self._tombstones) - TOMBSTONE_MAX]:
+                self._tombstones.pop(key)
 
     def note_pod_update(self, pod: dict) -> None:
         """Feed a freshly-PATCHed pod straight into the cache so the next

@@ -49,6 +49,12 @@ class PodSource(Protocol):
         """Pods on this node that may be awaiting allocation."""
         ...
 
+    def pending_share_pods(self, resource: str) -> list[dict]:
+        """Pending pods requesting ``resource`` — the allocator's match
+        universe. List-backed sources filter a fresh pending LIST; the
+        informer serves its pending-by-resource index (O(bucket))."""
+        ...
+
     def running_share_pods(self) -> list[dict]:
         """Running pods bearing the tpushare label (usage accounting)."""
         ...
@@ -110,6 +116,13 @@ class ApiServerPodSource:
                 field_selector=f"spec.nodeName={self._node},status.phase=Pending"
             )
         )
+
+    def pending_share_pods(self, resource: str) -> list[dict]:
+        return [
+            p
+            for p in self.pending_pods()
+            if P.mem_units_of_pod(p, resource=resource) > 0
+        ]
 
     def running_share_pods(self) -> list[dict]:
         from .. import const
@@ -175,6 +188,13 @@ class KubeletPodSource:
             return self._fallback.pending_pods()
         # kubelet reports all local pods; keep the pending ones
         return [p for p in pods if P.phase(p) == "Pending"]
+
+    def pending_share_pods(self, resource: str) -> list[dict]:
+        return [
+            p
+            for p in self.pending_pods()
+            if P.mem_units_of_pod(p, resource=resource) > 0
+        ]
 
     def running_share_pods(self) -> list[dict]:
         from .. import const

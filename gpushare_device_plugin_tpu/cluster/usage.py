@@ -52,6 +52,14 @@ def _core_contribution(pod: dict) -> list[int]:
     return P.core_hold_chips(pod)
 
 
+def pod_counts_toward_usage(pod: dict) -> bool:
+    """True when this pod's JSON contributes to either aggregate — i.e. a
+    cache holding this copy already accounts for it. The allocator's
+    reservation overlay uses this to stop counting an in-flight pod the
+    moment its PATCHed copy lands in the pod source."""
+    return _mem_contribution(pod) is not None or bool(_core_contribution(pod))
+
+
 class NodeChipUsage:
     """Per-chip usage aggregates for one node's pods (the daemon's view)."""
 

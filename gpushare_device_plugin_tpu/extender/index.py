@@ -58,12 +58,19 @@ class ClusterUsageIndex:
         self._lock = threading.Lock()
         # node -> {"frac": {resource: {chip: units}}, "core": {chip: refs}}
         self._nodes: dict[str, dict] = {}
+        # change detection for the extender's NodeView cache: a per-node
+        # counter bumped on every usage-affecting mutation, plus a global
+        # epoch bumped on rebuild (which resets the per-node counters)
+        self._gen: dict[str, int] = {}
+        self._epoch = 0
 
     # --- informer index protocol -----------------------------------------
 
     def rebuild(self, pods: list[dict]) -> None:
         with self._lock:
             self._nodes.clear()
+            self._gen.clear()
+            self._epoch += 1
             for pod in pods:
                 self._add(pod)
 
@@ -86,7 +93,9 @@ class ClusterUsageIndex:
         frac, cores = _contributions(pod)
         if not frac and not cores:
             return
-        agg = self._agg(P.node_name(pod))
+        node = P.node_name(pod)
+        self._gen[node] = self._gen.get(node, 0) + 1
+        agg = self._agg(node)
         for resource, idx, units in frac:
             used = agg["frac"].setdefault(resource, {})
             used[idx] = used.get(idx, 0) + units
@@ -98,6 +107,7 @@ class ClusterUsageIndex:
         if not frac and not cores:
             return
         node = P.node_name(pod)
+        self._gen[node] = self._gen.get(node, 0) + 1
         agg = self._nodes.get(node)
         if agg is None:
             return
@@ -118,6 +128,13 @@ class ClusterUsageIndex:
             self._nodes.pop(node, None)
 
     # --- reads ------------------------------------------------------------
+
+    def generation(self, node: str) -> tuple[int, int]:
+        """Opaque change token for ``node``'s aggregates: equal tokens
+        guarantee ``node_state(node, *)`` is unchanged. The extender's
+        NodeView cache keys on it instead of re-reading per verb."""
+        with self._lock:
+            return (self._epoch, self._gen.get(node, 0))
 
     def node_state(self, node: str, resource: str) -> tuple[dict[int, int], set[int]]:
         """-> (units used per chip for ``resource``, exclusively-held

@@ -184,6 +184,18 @@ def filter_nodes(
     return filter_with_views(pod, nodes, views_from_pods(pods))
 
 
+def _score_free(
+    free_values, cap: int, request_units: int, policy: str
+) -> int:
+    feasible = [f for f in free_values if f >= request_units]
+    if not feasible or cap <= 0:
+        return 0
+    if policy == "spread":
+        return round(10 * (max(feasible) - request_units) / cap)
+    best = min(feasible)
+    return round(10 * (1 - (best - request_units) / cap))
+
+
 def score_node(view: NodeView, request_units: int, policy: str = "best-fit") -> int:
     """Node score 0-10, consistent with the chip-level policy.
 
@@ -192,16 +204,43 @@ def score_node(view: NodeView, request_units: int, policy: str = "best-fit") -> 
     big chips whole); ``spread`` inverts — prefer the node whose emptiest
     feasible chip has the MOST headroom, so pods fan out across nodes the
     same way they fan out across chips."""
-    feasible = [f for f in view.free().values() if f >= request_units]
-    if not feasible:
-        return 0
-    cap = max(view.capacity.values(), default=0)
-    if cap <= 0:
-        return 0
-    if policy == "spread":
-        return round(10 * (max(feasible) - request_units) / cap)
-    best = min(feasible)
-    return round(10 * (1 - (best - request_units) / cap))
+    return _score_free(
+        view.free().values(),
+        max(view.capacity.values(), default=0),
+        request_units,
+        policy,
+    )
+
+
+def evaluate_filter_and_scores(
+    request_units: int, views: list[NodeView], policy: str = "best-fit"
+) -> tuple[list[str], dict[str, str], dict[str, int]]:
+    """One pass over prebuilt views -> (fits, failed reasons, scores for
+    the fitting nodes). The batched filter+prioritize: each view's free
+    vector is computed once and serves both the fit check and the score,
+    where the two-verb protocol recomputes it per verb."""
+    fits: list[str] = []
+    failed: dict[str, str] = {}
+    scores: dict[str, int] = {}
+    for view in views:
+        if not view.capacity:
+            failed[view.name] = f"node does not advertise {view.resource}"
+            continue
+        free = view.free()
+        if not any(f >= request_units for f in free.values()):
+            failed[view.name] = (
+                f"no single chip with {request_units} free units of "
+                f"{view.resource} (free: {free})"
+            )
+            continue
+        fits.append(view.name)
+        scores[view.name] = _score_free(
+            free.values(),
+            max(view.capacity.values(), default=0),
+            request_units,
+            policy,
+        )
+    return fits, failed, scores
 
 
 def evaluate_scores(

@@ -67,6 +67,17 @@ class ExtenderCore:
         self._lock = threading.RLock()
         self._inflight: dict[tuple[str, str], _Inflight] = {}
         self._inflight_ttl_s = 60.0
+        # Incremental NodeView cache, keyed (node, resource) with a
+        # (node resourceVersion, usage-index generation) change token: a
+        # filter round over N unchanged nodes re-parses zero capacity
+        # vectors and re-copies zero usage maps — pod add/remove deltas
+        # land in the index, which bumps the generation and invalidates
+        # exactly the touched node.
+        self._view_cache: dict[
+            tuple[str, str],
+            tuple[str, tuple, dict[int, int], dict[int, int], set[int]],
+        ] = {}
+        self._view_cache_max = 8192
 
     # --- helpers ----------------------------------------------------------
 
@@ -90,6 +101,51 @@ class ExtenderCore:
             }
             return dict(self._inflight)
 
+    def _view_for(self, node: dict, resource: str) -> logic.NodeView:
+        """One node's placement view off the incremental index, memoized.
+
+        Cache hit requires BOTH halves unchanged: the node object's
+        resourceVersion (capacity side) and the usage index's per-node
+        generation (pod side). Nodes without a resourceVersion (some
+        callers pass bare name-only dicts) are never cached — correctness
+        over speed. The returned view carries fresh copies of the mutable
+        maps because the in-flight overlay writes into ``used``."""
+        from ..utils.metrics import REGISTRY
+
+        name = node.get("metadata", {}).get("name", "")
+        rv = node.get("metadata", {}).get("resourceVersion")
+        gen = self._index.generation(name)
+        key = (name, resource)
+        outcome = "rebuild"
+        with self._lock:
+            entry = self._view_cache.get(key)
+            if entry is not None and rv is not None and entry[0] == rv and entry[1] == gen:
+                _rv, _gen, capacity, used, core_held = entry
+                outcome = "hit"
+        if outcome == "rebuild":
+            capacity = logic.node_capacity(node, resource)
+            used, core_held = self._index.node_state(name, resource)
+            if rv is not None:
+                with self._lock:
+                    if len(self._view_cache) >= self._view_cache_max:
+                        self._view_cache.clear()  # crude, but bounds memory
+                    self._view_cache[key] = (rv, gen, capacity, used, core_held)
+        REGISTRY.counter_inc(
+            "tpushare_extender_view_total",
+            "NodeView constructions by outcome (hit = served from the "
+            "incremental cache; rebuild = capacity re-parsed / usage re-read)",
+            outcome=outcome,
+        )
+        return logic.NodeView(
+            name=name,
+            resource=resource,
+            capacity=capacity,
+            used=dict(used),
+            core_held=(
+                set(core_held) if resource == logic.const.RESOURCE_MEM else set()
+            ),
+        )
+
     def _node_views(self, resource: str, nodes: list[dict]) -> list[logic.NodeView]:
         """Build per-node placement views for ``resource``.
 
@@ -102,18 +158,9 @@ class ExtenderCore:
             views = []
             by_name: dict[str, logic.NodeView] = {}
             for node in nodes:
-                name = node.get("metadata", {}).get("name", "")
-                used, core_held = self._index.node_state(name, resource)
-                view = logic.NodeView(
-                    name=name,
-                    resource=resource,
-                    capacity=logic.node_capacity(node, resource),
-                    used=used,
-                    core_held=core_held if resource == logic.const.RESOURCE_MEM
-                    else set(),
-                )
+                view = self._view_for(node, resource)
                 views.append(view)
-                by_name[name] = view
+                by_name[view.name] = view
             family = logic.RESOURCE_FAMILIES[resource]
             for (ns, pname), entry in self._live_inflight().items():
                 if entry.resource != resource:
@@ -193,9 +240,10 @@ class ExtenderCore:
         fits, failed = logic.filter_with_views(pod, nodes, self._node_views)
         log.v(4, "filter %s: fits=%s failed=%s",
               pod.get("metadata", {}).get("name"), fits, list(failed))
+        fit_set = set(fits)
         return {
             "nodes": {"items": [n for n in nodes
-                                if n.get("metadata", {}).get("name") in fits]},
+                                if n.get("metadata", {}).get("name") in fit_set]},
             "nodenames": fits,
             "failedNodes": failed,
             "error": "",
@@ -208,6 +256,42 @@ class ExtenderCore:
             pod, nodes, self._node_views, policy=self._policy
         )
         return [{"host": host, "score": score} for host, score in scores.items()]
+
+    def batch(self, args: dict) -> dict:
+        """Batched filter + prioritize in one verb: one view build and one
+        free-vector computation per node serve both answers (the two-verb
+        protocol builds views twice per scheduling cycle). Same args as
+        filter; the response adds ``hostPriorityList`` for the fitting
+        nodes. Not part of the upstream extender protocol — callers are
+        our own tooling (bench, tests) and schedulers taught the route."""
+        pod = args.get("pod") or {}
+        nodes = self._nodes_from_args(args)
+        resource = logic.pod_resource(pod)
+        if resource is None:
+            names = [n.get("metadata", {}).get("name", "") for n in nodes]
+            return {
+                "nodes": {"items": nodes},
+                "nodenames": names,
+                "failedNodes": {},
+                "hostPriorityList": [{"host": n, "score": 0} for n in names],
+                "error": "",
+            }
+        request = P.mem_units_of_pod(pod, resource=resource)
+        views = self._node_views(resource, nodes)
+        fits, failed, scores = logic.evaluate_filter_and_scores(
+            request, views, policy=self._policy
+        )
+        fit_set = set(fits)
+        return {
+            "nodes": {"items": [n for n in nodes
+                                if n.get("metadata", {}).get("name") in fit_set]},
+            "nodenames": fits,
+            "failedNodes": failed,
+            "hostPriorityList": [
+                {"host": name, "score": scores[name]} for name in fits
+            ],
+            "error": "",
+        }
 
     def bind(self, args: dict) -> dict:
         """Persist the chip decision and create the v1 Binding.
@@ -321,6 +405,7 @@ class ExtenderHTTPServer:
                 verbs = {
                     "/scheduler/filter": core.filter,
                     "/scheduler/prioritize": core.prioritize,
+                    "/scheduler/batch": core.batch,
                     "/scheduler/bind": core.bind,
                 }
                 fn = verbs.get(self.path)

@@ -70,9 +70,12 @@ class TpuShareManager:
         # every plugin (re)build (reference: podmanager.go:59-72 read at
         # server.go:60-74)
         self._disable_isolation = config.disable_isolation
-        # one lock across the mem and core allocators: both resources share
-        # one physical-chip ledger and must serialize their decisions
-        self._alloc_lock = threading.Lock()
+        # one reservation ledger across the mem and core allocators: both
+        # resources share one physical-chip ledger, so their in-flight
+        # claims/reservations must be mutually visible (allocator.assume)
+        from ..allocator.assume import AssumeCache
+
+        self._alloc_assume = AssumeCache()
         self._restart = threading.Event()
         self._stop = threading.Event()
         self._park = threading.Event()
@@ -111,7 +114,7 @@ class TpuShareManager:
             policy=self._cfg.policy,
             disable_isolation=self._disable_isolation,
             unhealthy_chips_fn=unhealthy_fn,
-            lock=self._alloc_lock,
+            assume=self._alloc_assume,
         )
         return cluster.allocate
 
@@ -156,7 +159,7 @@ class TpuShareManager:
             self._cfg.node_name,
             topology=topo,
             unhealthy_chips_fn=unhealthy_fn,
-            lock=self._alloc_lock,
+            assume=self._alloc_assume,
         )
         return core.allocate
 
@@ -182,7 +185,9 @@ class TpuShareManager:
             from ..allocator.cluster import cluster_chip_state, preferred_core_chips
 
             if not (self._cfg.standalone or self._api is None):
-                state_fn = cluster_chip_state(self._pod_source)
+                state_fn = cluster_chip_state(
+                    self._pod_source, assume=self._alloc_assume
+                )
             else:
                 local = self._local
 

@@ -167,7 +167,9 @@ class FakeApiServer:
                 pass
 
             def _send(self, code: int, body: dict):
-                data = json.dumps(body).encode()
+                self._send_bytes(code, json.dumps(body).encode())
+
+            def _send_bytes(self, code: int, data: bytes):
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(data)))
@@ -303,12 +305,17 @@ class FakeApiServer:
                     and q.get("watch") in ("true", "1")
                 ):
                     return self._stream_watch(q)
+                # Serialize under the store lock (the objects are live and
+                # mutable), but write the socket outside it — concurrent
+                # reads must not serialize behind each other's sends.
+                payload = None
                 with store._lock:
                     # kubelet-style /pods/
                     if u.path.rstrip("/") == "/pods":
                         items = list(store.pods.values())
-                        return self._send(200, {"kind": "PodList", "items": items})
-                    if parts[:2] == ["api", "v1"]:
+                        payload = (200, json.dumps(
+                            {"kind": "PodList", "items": items}).encode())
+                    elif parts[:2] == ["api", "v1"]:
                         rest = parts[2:]
                         if rest == ["pods"]:
                             items = [
@@ -317,78 +324,94 @@ class FakeApiServer:
                                 if _match_field_selector(p, q.get("fieldSelector", ""))
                                 and _match_label_selector(p, q.get("labelSelector", ""))
                             ]
-                            return self._send(
-                                200,
+                            payload = (200, json.dumps(
                                 {
                                     "items": items,
                                     "metadata": {"resourceVersion": str(store._rv)},
-                                },
-                            )
-                        if rest == ["nodes"]:
+                                }).encode())
+                        elif rest == ["nodes"]:
                             items = [
                                 n
                                 for n in store.nodes.values()
                                 if _match_label_selector(n, q.get("labelSelector", ""))
                             ]
-                            return self._send(200, {"items": items})
-                        if len(rest) == 2 and rest[0] == "nodes":
+                            payload = (200, json.dumps({"items": items}).encode())
+                        elif len(rest) == 2 and rest[0] == "nodes":
                             node = store.nodes.get(rest[1])
-                            if node is None:
-                                return self._send(404, {"message": "not found"})
-                            return self._send(200, node)
-                        if len(rest) == 4 and rest[0] == "namespaces" and rest[2] == "pods":
+                            payload = (
+                                (404, b'{"message": "not found"}')
+                                if node is None
+                                else (200, json.dumps(node).encode())
+                            )
+                        elif len(rest) == 4 and rest[0] == "namespaces" and rest[2] == "pods":
                             pod = store.pods.get((rest[1], rest[3]))
-                            if pod is None:
-                                return self._send(404, {"message": "not found"})
-                            return self._send(200, pod)
-                return self._send(404, {"message": f"unhandled GET {u.path}"})
+                            payload = (
+                                (404, b'{"message": "not found"}')
+                                if pod is None
+                                else (200, json.dumps(pod).encode())
+                            )
+                if payload is None:
+                    payload = (404, json.dumps(
+                        {"message": f"unhandled GET {u.path}"}).encode())
+                return self._send_bytes(*payload)
 
             def do_PATCH(self):
+                # The store lock scopes the state mutation only; the HTTP
+                # response write happens outside it. Holding it across
+                # _send serialized every concurrent PATCH behind each
+                # other's socket writes — invisible single-threaded, a
+                # bottleneck for the concurrent-admission benchmark.
                 if self._maybe_fault():
                     return
                 u = urlparse(self.path)
                 parts = [p for p in u.path.split("/") if p]
                 body = self._read_body()
+                response = None
                 with store._lock:
                     store.patch_log.append((u.path, body))
                     rest = parts[2:] if parts[:2] == ["api", "v1"] else []
                     if len(rest) == 4 and rest[0] == "namespaces" and rest[2] == "pods":
                         if store.conflicts_to_inject > 0:
                             store.conflicts_to_inject -= 1
-                            return self._send(
+                            response = (
                                 409,
                                 {"message": "Operation cannot be fulfilled: "
                                  "the object has been modified; please apply your "
                                  "changes to the latest version and try again"},
                             )
-                        pod = store.pods.get((rest[1], rest[3]))
-                        if pod is None:
-                            return self._send(404, {"message": "not found"})
-                        meta_patch = body.get("metadata", {})
-                        meta = pod.setdefault("metadata", {})
-                        for key in ("annotations", "labels"):
-                            if key in meta_patch:
-                                merged = dict(meta.get(key) or {})
-                                for k, v in (meta_patch[key] or {}).items():
-                                    if v is None:
-                                        merged.pop(k, None)
-                                    else:
-                                        merged[k] = v
-                                meta[key] = merged
-                        store._record_event("MODIFIED", pod)
-                        return self._send(200, pod)
-                    if len(rest) == 3 and rest[0] == "nodes" and rest[2] == "status":
+                        else:
+                            pod = store.pods.get((rest[1], rest[3]))
+                            if pod is None:
+                                response = (404, {"message": "not found"})
+                            else:
+                                meta_patch = body.get("metadata", {})
+                                meta = pod.setdefault("metadata", {})
+                                for key in ("annotations", "labels"):
+                                    if key in meta_patch:
+                                        merged = dict(meta.get(key) or {})
+                                        for k, v in (meta_patch[key] or {}).items():
+                                            if v is None:
+                                                merged.pop(k, None)
+                                            else:
+                                                merged[k] = v
+                                        meta[key] = merged
+                                store._record_event("MODIFIED", pod)
+                                response = (200, copy.deepcopy(pod))
+                    elif len(rest) == 3 and rest[0] == "nodes" and rest[2] == "status":
                         node = store.nodes.get(rest[1])
                         if node is None:
-                            return self._send(404, {"message": "not found"})
-                        st = node.setdefault("status", {})
-                        for key in ("capacity", "allocatable"):
-                            if key in body.get("status", {}):
-                                merged = dict(st.get(key) or {})
-                                merged.update(body["status"][key])
-                                st[key] = merged
-                        return self._send(200, node)
-                return self._send(404, {"message": f"unhandled PATCH {u.path}"})
+                            response = (404, {"message": "not found"})
+                        else:
+                            st = node.setdefault("status", {})
+                            for key in ("capacity", "allocatable"):
+                                if key in body.get("status", {}):
+                                    merged = dict(st.get(key) or {})
+                                    merged.update(body["status"][key])
+                                    st[key] = merged
+                            response = (200, copy.deepcopy(node))
+                if response is None:
+                    response = (404, {"message": f"unhandled PATCH {u.path}"})
+                return self._send(*response)
 
             def do_POST(self):
                 if self._maybe_fault():

@@ -1,0 +1,249 @@
+"""Concurrency stress: parallel Allocate() storms through the real gRPC
+socket against the lock-sharded allocator (ISSUE 2 tentpole).
+
+The hardest case by construction: every pending pod is the SAME size, so
+all workers compete for the same oldest candidate — the claim/reservation
+ledger (allocator.assume) is the only thing standing between them and a
+double assignment. After each storm the suite asserts the three
+invariants the sharding must preserve:
+
+1. no double assignment — every pod annotated exactly once, all pods
+   assigned, no chip over its capacity;
+2. no lost annotation — each PATCH's annotations all present on the pod;
+3. index/cache coherence — the informer's incremental chip_state equals
+   the full-scan recompute over its own cache after the dust settles.
+"""
+
+from __future__ import annotations
+
+import tempfile
+import threading
+import time
+
+import pytest
+
+from gpushare_device_plugin_tpu import const
+from gpushare_device_plugin_tpu.allocator.assume import AssumeCache
+from gpushare_device_plugin_tpu.allocator.cluster import (
+    ClusterAllocator,
+    ClusterCoreAllocator,
+)
+from gpushare_device_plugin_tpu.cluster import pods as P
+from gpushare_device_plugin_tpu.cluster.apiserver import ApiServerClient
+from gpushare_device_plugin_tpu.cluster.informer import PodInformer
+from gpushare_device_plugin_tpu.device import DeviceInventory
+from gpushare_device_plugin_tpu.discovery import MockBackend
+from gpushare_device_plugin_tpu.plugin import PluginConfig, TpuSharePlugin
+
+from fake_apiserver import FakeApiServer
+from fake_kubelet import FakeKubelet
+from k8s_fixtures import make_pod
+
+NODE = "stress-node"
+CHIPS = 4
+UNITS_PER_CHIP = 32
+WORKERS = 16
+POD_UNITS = 2  # 16 same-size pods -> 32 units, fits the 128-unit host
+
+
+def wait_until(pred, timeout=10.0, every=0.002):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(every)
+    return False
+
+
+@pytest.fixture()
+def stack():
+    tmp = tempfile.mkdtemp(prefix="tpushare-stress-")
+    api = FakeApiServer()
+    api.add_node(NODE)
+    api.start()
+    kubelet = FakeKubelet(tmp)
+    kubelet.start()
+    client = ApiServerClient(api.url)
+    inv = DeviceInventory(
+        MockBackend(num_chips=CHIPS, hbm_bytes=UNITS_PER_CHIP << 30).chips()
+    )
+    informer = PodInformer(client, NODE).start(sync_timeout_s=5)
+    allocator = ClusterAllocator(inv, client, informer, NODE)
+    plugin = TpuSharePlugin(
+        inv,
+        allocate_fn=allocator.allocate,
+        config=PluginConfig(plugin_dir=tmp, grpc_workers=WORKERS + 4),
+    )
+    plugin.serve()
+    reg = kubelet.wait_for_registration()
+    assert reg.resource_name == const.RESOURCE_MEM
+    kubelet.stub_for(reg.endpoint)  # pre-dial before worker threads race it
+    yield api, client, informer, kubelet, reg, inv
+    plugin.stop()
+    kubelet.stop()
+    informer.stop()
+    api.stop()
+
+
+def _storm(kubelet, endpoint, n_calls: int, pod_units: int, workers: int):
+    """Fire ``n_calls`` Allocate RPCs from ``workers`` parallel threads;
+    returns the list of exceptions (empty = all admitted)."""
+    jobs = list(range(n_calls))
+    jobs_lock = threading.Lock()
+    errors: list[Exception] = []
+    barrier = threading.Barrier(workers)
+
+    def worker():
+        barrier.wait()
+        while True:
+            with jobs_lock:
+                if not jobs:
+                    return
+                jobs.pop()
+            try:
+                kubelet.allocate(endpoint, [[f"g{i}" for i in range(pod_units)]])
+            except Exception as e:  # noqa: BLE001 — asserted by caller
+                with jobs_lock:
+                    errors.append(e)
+
+    threads = [threading.Thread(target=worker, daemon=True) for _ in range(workers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60.0)
+    assert not any(t.is_alive() for t in threads), "storm workers hung"
+    return errors
+
+
+def test_sixteen_parallel_allocates_no_double_assignment(stack):
+    api, client, informer, kubelet, reg, inv = stack
+    names = [f"storm-{i}" for i in range(WORKERS)]
+    for name in names:
+        api.add_pod(make_pod(name, POD_UNITS, node=NODE))
+    assert wait_until(lambda: len(informer.pending_pods()) == WORKERS)
+
+    errors = _storm(kubelet, reg.endpoint, WORKERS, POD_UNITS, WORKERS)
+    assert errors == []
+
+    # 1. no double assignment / no lost annotation: every pod carries the
+    # full annotation set exactly once, and chips stay within capacity
+    used_by_chip: dict[int, int] = {}
+    for name in names:
+        pod = client.get_pod("default", name)
+        ann = pod["metadata"]["annotations"]
+        assert ann.get(const.ENV_ASSIGNED_FLAG) == "true", f"{name} unassigned"
+        assert ann.get(const.ENV_MEM_POD) == str(POD_UNITS), f"{name} lost annotation"
+        assert const.ENV_ASSUME_TIME in ann, f"{name} lost assume-time"
+        idx = int(ann[const.ENV_MEM_IDX])
+        used_by_chip[idx] = used_by_chip.get(idx, 0) + POD_UNITS
+        assert (
+            pod["metadata"]["labels"][const.LABEL_RESOURCE_KEY]
+            == const.LABEL_RESOURCE_VALUE
+        )
+    capacity = inv.units_by_index()
+    for idx, used in used_by_chip.items():
+        assert used <= capacity[idx], f"chip {idx} over-committed: {used_by_chip}"
+    assert sum(used_by_chip.values()) == WORKERS * POD_UNITS
+
+    # 2. index/cache coherence after the storm: the incremental chip_state
+    # must equal the full-scan recompute over the same cache, and no
+    # claims/reservations may leak past the admissions
+    assert wait_until(
+        lambda: sum(informer.chip_state()[0].values()) == WORKERS * POD_UNITS
+    )
+    pods = informer.all_pods()
+    assert informer.chip_state() == (P.used_units_by_chip(pods), P.used_chips(pods))
+
+
+def test_storm_with_fewer_pods_than_requests_fails_extras_cleanly(stack):
+    """More concurrent Allocates than pending pods: the extras must fail
+    with the no-pending-pod admission error, never hang, and never steal
+    or corrupt the winners' assignments."""
+    api, client, informer, kubelet, reg, inv = stack
+    n_pods, n_calls = 10, WORKERS
+    for i in range(n_pods):
+        api.add_pod(make_pod(f"few-{i}", POD_UNITS, node=NODE))
+    assert wait_until(lambda: len(informer.pending_pods()) == n_pods)
+
+    errors = _storm(kubelet, reg.endpoint, n_calls, POD_UNITS, WORKERS)
+    assert len(errors) == n_calls - n_pods
+    assert all("no pending pod" in str(e) for e in errors)
+    assigned = [
+        p
+        for i in range(n_pods)
+        if (p := client.get_pod("default", f"few-{i}")) is not None
+        and P.is_assigned(p)
+    ]
+    assert len(assigned) == n_pods
+
+
+def test_concurrent_mem_and_core_never_share_a_chip(stack):
+    """Cross-resource race: mem binpack and core validation run through
+    the shared AssumeCache, so an in-flight core grant must exclude its
+    chips from a concurrent mem placement and vice versa."""
+    api, client, informer, kubelet, reg, inv = stack
+    # share one ledger across both allocators, like the manager does
+    assume = AssumeCache()
+    mem_alloc = ClusterAllocator(inv, client, informer, NODE, assume=assume)
+    core_alloc = ClusterCoreAllocator(inv, client, informer, NODE, assume=assume)
+
+    api.add_pod(make_pod("mem-pod", 4, node=NODE))
+    core_pod = make_pod("core-pod", 0, node=NODE, tpu_core=2)
+    api.add_pod(core_pod)
+    assert wait_until(lambda: len(informer.pending_pods()) == 2)
+
+    results: dict[str, object] = {}
+
+    def run_mem():
+        try:
+            results["mem"] = mem_alloc.allocate([["a", "b", "c", "d"]])
+        except Exception as e:  # noqa: BLE001
+            results["mem"] = e
+
+    def run_core():
+        try:
+            ids = [inv.id_of_index(0), inv.id_of_index(1)]
+            results["core"] = core_alloc.allocate([ids])
+        except Exception as e:  # noqa: BLE001
+            results["core"] = e
+
+    ts = [threading.Thread(target=run_mem), threading.Thread(target=run_core)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+
+    mem_res, core_res = results.get("mem"), results.get("core")
+    # At least one side must win; if both won, they must not share a chip.
+    mem_ok = not isinstance(mem_res, Exception)
+    core_ok = not isinstance(core_res, Exception)
+    assert mem_ok or core_ok, (mem_res, core_res)
+    if mem_ok and core_ok:
+        mem_ann = client.get_pod("default", "mem-pod")["metadata"]["annotations"]
+        mem_chip = int(mem_ann[const.ENV_MEM_IDX])
+        core_ann = client.get_pod("default", "core-pod")["metadata"]["annotations"]
+        core_chips = {int(x) for x in core_ann[const.ENV_CORE_IDS].split(",")}
+        assert mem_chip not in core_chips, (
+            f"mem pod and core pod share chip {mem_chip}"
+        )
+
+
+def test_repeated_storms_leave_no_leaked_claims(stack):
+    """Claims and reservations must not survive their admissions: after
+    several fill/drain storms the same pods' names can be reused and the
+    host packs to exactly full every time."""
+    api, client, informer, kubelet, reg, inv = stack
+    pods_per_storm = (CHIPS * UNITS_PER_CHIP) // 16  # 8 pods of 16 units
+    for rnd in range(3):
+        names = [f"cycle-{rnd}-{i}" for i in range(pods_per_storm)]
+        for name in names:
+            api.add_pod(make_pod(name, 16, node=NODE))
+        assert wait_until(lambda: len(informer.pending_pods()) == pods_per_storm)
+        errors = _storm(kubelet, reg.endpoint, pods_per_storm, 16, 8)
+        assert errors == [], f"round {rnd}: {errors[:3]}"
+        for name in names:
+            api.delete_pod("default", name)
+        assert wait_until(
+            lambda: all(informer.get_pod("default", n) is None for n in names)
+        )
+        assert wait_until(lambda: sum(informer.chip_state()[0].values()) == 0)

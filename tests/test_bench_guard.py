@@ -18,7 +18,10 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 import bench
 
 
-def _write_record(tmp: Path, n: int, p50: float, util: float | None = None) -> None:
+def _write_record(
+    tmp: Path, n: int, p50: float, util: float | None = None,
+    p99: float | None = None,
+) -> None:
     """A driver-shaped BENCH_r{n}.json: {"parsed": {...}} possibly among
     other concatenated records."""
     rec = {
@@ -34,6 +37,8 @@ def _write_record(tmp: Path, n: int, p50: float, util: float | None = None) -> N
     }
     if util is not None:
         rec["parsed"]["binpack_utilization_pct"] = util
+    if p99 is not None:
+        rec["parsed"]["p99_ms"] = p99
     (tmp / f"BENCH_r{n:02d}.json").write_text(json.dumps(rec))
 
 
@@ -115,6 +120,30 @@ def test_utilization_guard_newest_record_wins(tmp_path):
     # newest says 75 — holding 80 passes even though round 1 had 100
     assert bench.utilization_guard(80.0, tmp_path) is None
     assert bench.utilization_guard(74.0, tmp_path) is not None
+
+
+def test_p99_guard_no_history_passes(tmp_path):
+    _write_record(tmp_path, 1, 2.0)  # record without a p99 field
+    assert bench.p99_guard(999.0, tmp_path) is None
+
+
+def test_p99_guard_within_budget_passes(tmp_path):
+    _write_record(tmp_path, 1, 2.0, p99=10.0)
+    assert bench.p99_guard(10.0, tmp_path) is None
+    assert bench.p99_guard(12.4, tmp_path) is None  # +24% < 25%
+
+
+def test_p99_guard_regression_fails(tmp_path):
+    """ISSUE 2 satellite: the p50-only guard let tail regressions land
+    silently; a >25% p99 regression must now fail the run."""
+    _write_record(tmp_path, 1, 2.0, p99=10.0)
+    msg = bench.p99_guard(12.6, tmp_path)  # +26%
+    assert msg is not None and "p99" in msg and "BENCH_r01.json" in msg
+
+
+def test_p99_guard_improvement_passes(tmp_path):
+    _write_record(tmp_path, 1, 2.0, p99=10.0)
+    assert bench.p99_guard(4.0, tmp_path) is None
 
 
 def test_concatenated_records_take_last(tmp_path):

@@ -1,0 +1,49 @@
+"""Tier-1 smoke run of the control-plane benchmark (ISSUE 2 satellite).
+
+``bench.py`` is the only consumer of several cross-layer seams (fake
+kubelet -> real gRPC -> sharded allocator -> informer; the concurrent
+storm; the extender batch verb) that ordinary unit tests drive one at a
+time. Running the whole script in smoke mode per tier-1 pass means the
+benchmark itself can never bit-rot into a round-end surprise — exactly
+the failure mode ``make bench-smoke`` exists to catch early.
+
+Subprocess on purpose: the benchmark must work as shipped (argv handling,
+sys.path bootstrap, the JSON contract the driver parses), not merely as
+importable functions.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def test_bench_smoke_runs_and_emits_record():
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--smoke"],
+        capture_output=True,
+        text=True,
+        timeout=300,
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, (
+        f"bench.py --smoke failed rc={proc.returncode}\n"
+        f"stderr tail: {proc.stderr[-2000:]}"
+    )
+    # the last stdout line is the driver-facing JSON record
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
+    record = json.loads(lines[-1])
+    assert record["metric"] == "allocate_p50_latency"
+    assert record["value"] > 0
+    assert record["p99_ms"] >= record["value"]
+    # the new sections ride along even in smoke mode
+    assert record["concurrent"]["double_assignments"] == 0
+    assert record["concurrent"]["throughput_pods_s"] > 0
+    assert record["extender"]["batch_p50_ms"] > 0
+    # smoke implies guards-off: a record with a huge p50 still exits 0,
+    # which is what makes this safe to run against any committed history
+    assert record["compute"] == {}

@@ -347,6 +347,46 @@ def test_sentinel_tombstone_cleared_by_authoritative_list(api):
     assert [p["metadata"]["name"] for p in inf.pending_pods()] == ["ghost"]
 
 
+def test_tombstone_map_bounded_by_size(api):
+    """A 404 storm (mass deletion mid-allocate) must not grow the
+    tombstone map without bound between relists: evict() sweeps it down
+    to TOMBSTONE_MAX, dropping oldest-first."""
+    from gpushare_device_plugin_tpu.cluster import informer as I
+
+    inf = PodInformer(ApiServerClient(api.url), NODE).start(sync_timeout_s=5)
+    inf.stop()  # no watch: nothing else touches the tombstones
+    for i in range(I.TOMBSTONE_MAX + 50):
+        ghost = make_pod(f"ghost-{i}", 2, node=NODE)
+        ghost["metadata"]["resourceVersion"] = str(i + 1)
+        inf.evict(ghost)
+    assert len(inf._tombstones) <= I.TOMBSTONE_MAX
+    # oldest were dropped, newest survive
+    assert ("default", f"ghost-{I.TOMBSTONE_MAX + 49}") in inf._tombstones
+    assert ("default", "ghost-0") not in inf._tombstones
+
+
+def test_tombstones_age_out_without_relist(api):
+    """A long watch-stable period never relists (the usual tombstone GC);
+    the periodic age sweep in the event path must reclaim them anyway."""
+    from gpushare_device_plugin_tpu.cluster import informer as I
+
+    inf = PodInformer(ApiServerClient(api.url), NODE).start(sync_timeout_s=5)
+    inf.stop()
+    ghost = make_pod("old-ghost", 2, node=NODE)
+    inf.evict(ghost)
+    assert len(inf._tombstones) == 1
+    # backdate the tombstone past the age cap and make the next event
+    # eligible to sweep
+    with inf._lock:
+        inf._tombstones = {
+            k: (rv, stamp - I.TOMBSTONE_MAX_AGE_S - 1.0)
+            for k, (rv, stamp) in inf._tombstones.items()
+        }
+        inf._last_tomb_sweep -= I.TOMBSTONE_SWEEP_EVERY_S + 1.0
+    inf._apply("ADDED", make_pod("unrelated", 2, node=NODE))
+    assert inf._tombstones == {}
+
+
 def test_stale_list_does_not_resurrect_evicted_ghost(api):
     """A LIST served before the deletion (rv older than the tombstone)
     must not resurrect the ghost via refresh()."""
