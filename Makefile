@@ -1,7 +1,7 @@
 PY ?= python
 PROTOC ?= protoc
 
-.PHONY: proto native test test-fast test-slow test-stress chaos lint bench bench-smoke e2e-kind
+.PHONY: proto native test test-fast test-slow test-stress chaos chaos-restart lint bench bench-smoke e2e-kind
 
 # Regenerate protobuf message classes (gRPC bindings are hand-written in
 # gpushare_device_plugin_tpu/plugin/api/api_grpc.py; grpc_tools is not
@@ -46,6 +46,14 @@ test-stress:
 # part of tier-1 ('not slow'); this target runs it alone.
 chaos:
 	$(PY) -m pytest tests/ -x -q -m chaos
+
+# Crash-safe state suite (docs/robustness.md): kill-at-every-journal-step
+# restart recovery, WAL/checkpoint unit tests, drift-reconciler repairs,
+# fencing, graceful drain, plugin-socket-vanish re-registration. All of it
+# runs inside tier-1 ('not slow'); this target runs it alone.
+chaos-restart:
+	$(PY) -m pytest tests/test_restart_recovery.py tests/test_checkpoint.py \
+	  tests/test_reconciler.py -x -q
 
 # kind end-to-end: deploy the manifests with mock discovery on a local kind
 # cluster and assert the demo pod admits with TPU_VISIBLE_CHIPS injected

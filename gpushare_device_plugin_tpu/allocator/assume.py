@@ -40,8 +40,9 @@ from __future__ import annotations
 
 import contextlib
 import threading
+import time
 
-from ..utils.metrics import timed_acquire
+from ..utils.metrics import REGISTRY, timed_acquire
 
 PodKey = tuple[str, str]  # (namespace, name)
 
@@ -52,18 +53,43 @@ LOCK_WAIT_HELP = (
     "I/O crept back under a lock"
 )
 
+EXPIRED_METRIC = "tpushare_assume_expired_total"
+EXPIRED_HELP = (
+    "Claims/reservations released by TTL expiry — an owner (a hung PATCH, "
+    "a crashed worker) held them past the deadline; capacity was unstranded"
+)
+
+# An admission that has not finished inside this window is dead or wedged
+# far beyond every retry deadline on the persist path (PATCH retries top
+# out in single-digit seconds); releasing then can free capacity the owner
+# still thinks it holds only if that owner later persists *without*
+# re-checking — and both allocators re-place from a fresh transaction on
+# every attempt, so expiry is safe and strictly better than stranding.
+DEFAULT_TTL_S = 300.0
+
 
 class AssumeCache:
     """Shared between the node's mem and core allocators: the two
     resources share one physical-chip ledger, and reservations from one
     must exclude chips from the other (the same reason they used to share
-    one mutex)."""
+    one mutex).
 
-    def __init__(self):
+    Every claim/reservation carries a monotonic stamp and a TTL
+    (``ttl_s``): an entry whose owner died mid-admission — crashed worker
+    thread, PATCH hung past all deadlines — is released by
+    ``expire_stale`` (run lazily on every overlay read and by the drift
+    reconciler) instead of stranding capacity forever. Entries are
+    re-stamped on re-reservation, so a live retry loop never expires.
+    """
+
+    def __init__(self, ttl_s: float = DEFAULT_TTL_S, clock=time.monotonic):
         self._lock = threading.RLock()
-        self._claimed: set[PodKey] = set()
+        self._ttl = ttl_s
+        self._clock = clock
+        self._claimed: dict[PodKey, float] = {}  # key -> stamp
         self._mem: dict[PodKey, tuple[int, int]] = {}  # key -> (chip, units)
         self._core: dict[PodKey, tuple[int, ...]] = {}  # key -> chip indices
+        self._stamps: dict[PodKey, float] = {}  # reservation stamps
         # Legacy full-serialization lock for list-backed pod sources: they
         # expose no get_pod, so a worker cannot re-verify a candidate
         # against live state at claim time — without that check the
@@ -76,24 +102,74 @@ class AssumeCache:
     # --- claims -----------------------------------------------------------
 
     def claim(self, key: PodKey) -> bool:
-        """Mark ``key`` as mid-admission; False if already claimed."""
+        """Mark ``key`` as mid-admission; False if already claimed (by a
+        live owner — an expired claim is reaped and re-claimable)."""
         with self._lock:
-            if key in self._claimed:
-                return False
-            self._claimed.add(key)
+            now = self._clock()
+            stamp = self._claimed.get(key)
+            if stamp is not None:
+                if now - stamp <= self._ttl:
+                    return False
+                self._release_expired(key, "claim")
+            self._claimed[key] = now
             return True
 
     def is_claimed(self, key: PodKey) -> bool:
         with self._lock:
-            return key in self._claimed
+            stamp = self._claimed.get(key)
+            return stamp is not None and self._clock() - stamp <= self._ttl
 
     def release(self, key: PodKey) -> None:
         """Drop the claim and any reservations for ``key`` (success — the
         pod source counts the pod now — or failure — nothing was placed)."""
         with self._lock:
-            self._claimed.discard(key)
+            self._claimed.pop(key, None)
             self._mem.pop(key, None)
             self._core.pop(key, None)
+            self._stamps.pop(key, None)
+
+    def release_if_unclaimed(self, key: PodKey) -> bool:
+        """Atomic check-and-release for the reconciler: a claimed key is a
+        live admission mid-flow and must keep its reservation — releasing
+        on a stale pre-network-round-trip claim check would strip a live
+        worker's protection. True when released."""
+        with self._lock:
+            if self.is_claimed(key):
+                return False
+            self.release(key)
+            return True
+
+    def _release_expired(self, key: PodKey, kind: str) -> None:
+        """Caller must hold self._lock."""
+        self._claimed.pop(key, None)
+        self._mem.pop(key, None)
+        self._core.pop(key, None)
+        self._stamps.pop(key, None)
+        REGISTRY.counter_inc(EXPIRED_METRIC, EXPIRED_HELP, kind=kind)
+
+    def expire_stale(self, now: float | None = None) -> list[PodKey]:
+        """Release every claim/reservation older than the TTL; -> released
+        keys. O(in-flight entries) — a handful at worst."""
+        released: list[PodKey] = []
+        with self._lock:
+            if now is None:
+                now = self._clock()
+            for key, stamp in list(self._claimed.items()):
+                if now - stamp > self._ttl:
+                    self._release_expired(key, "claim")
+                    released.append(key)
+            for key, stamp in list(self._stamps.items()):
+                if now - stamp > self._ttl:
+                    kind = "mem" if key in self._mem else "core"
+                    self._release_expired(key, kind)
+                    released.append(key)
+        return released
+
+    def snapshot(self) -> tuple[dict[PodKey, float], dict, dict]:
+        """Introspection for the drift reconciler: (claims with stamps,
+        mem reservations, core reservations) — copies."""
+        with self._lock:
+            return dict(self._claimed), dict(self._mem), dict(self._core)
 
     # --- reservations (call within transaction()) -------------------------
 
@@ -109,10 +185,12 @@ class AssumeCache:
     def reserve_mem(self, key: PodKey, chip_idx: int, units: int) -> None:
         with self._lock:
             self._mem[key] = (chip_idx, units)
+            self._stamps[key] = self._clock()
 
     def reserve_core(self, key: PodKey, chip_indices: list[int]) -> None:
         with self._lock:
             self._core[key] = tuple(chip_indices)
+            self._stamps[key] = self._clock()
 
     def overlaid_state(
         self, state_fn, visible_fn=None
@@ -135,6 +213,7 @@ class AssumeCache:
         conservative (can only over-count, never double-book).
         """
         with self._lock:
+            self.expire_stale()  # lazy TTL reaping on every overlay read
             mem = list(self._mem.items())
             core = list(self._core.items())
         if visible_fn is not None:

@@ -1,0 +1,461 @@
+"""Write-ahead allocation journal: crash-safe record of in-flight PATCHes.
+
+The apiserver is the database (SURVEY.md section 5) — but the Allocate
+flow has a window where the truth is *in flight*: the chip decision is
+made and the annotation PATCH is on the wire, yet nothing durable on this
+node records it. A daemon killed inside that window restarts with a cold
+ledger; until the informer resyncs, a concurrent admission can binpack
+onto state that silently omits the just-persisted pod. This journal
+closes the window with classic WAL discipline:
+
+1. **begin** — appended and fsync'd *before* the PATCH leaves the node:
+   pod key + the exact decision (chip index / chip ids, units, the
+   annotations about to be written).
+2. **commit** — appended after the PATCH response was processed and the
+   pod source counts the pod.
+3. **abort** — appended when the admission fails before persisting
+   anything (binpack conflict, pod deleted, PATCH refused).
+
+A restarted daemon replays every begun-but-unresolved entry as a ledger
+reservation (``replay_checkpoint``) — conservative: the chip is protected
+whether or not the PATCH landed — and the drift reconciler
+(``cluster/reconciler.py``) then resolves each entry against the
+apiserver: annotation present -> the PATCH won, retro-commit; absent ->
+nothing persisted, retro-abort. Either way the reservation is released
+and capacity converges to exactly what annotations say.
+
+File format: JSON lines (``{"op": "header"|"begin"|"commit"|"abort", ...}``),
+append-only between compactions. A torn final line (crash mid-append) is
+detected and ignored on load. Compaction rewrites the file to a header
+plus the live ``begin`` records via atomic rename.
+
+Fencing: the header carries a **generation**, bumped on every open. In
+cluster mode the daemon stamps its generation into a node annotation
+(``acquire_fence``); a stale duplicate daemon — two instances racing
+during a botched DaemonSet rollout — observes a higher generation on the
+node (``verify_fence``, run by the reconciler each pass) and refuses
+further journal begins, which the allocator maps to admission failure.
+The newest daemon always wins; the loser can only read.
+
+Fault points ``checkpoint.begin|commit|abort`` fire immediately *after*
+each record is durable, giving the restart-recovery suite its
+``crash_after:<site>`` boundaries (see utils/faults.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+from ..utils.faults import FAULTS
+from ..utils.log import get_logger
+from ..utils.metrics import REGISTRY
+from .assume import AssumeCache, PodKey
+
+log = get_logger("allocator.checkpoint")
+
+JOURNAL_APPENDS = "tpushare_checkpoint_appends_total"
+JOURNAL_APPENDS_HELP = "Checkpoint journal records appended, by op"
+JOURNAL_ERRORS = "tpushare_checkpoint_errors_total"
+JOURNAL_ERRORS_HELP = (
+    "Checkpoint journal I/O failures (the daemon degrades to unjournaled "
+    "operation rather than refusing admissions on a sick disk)"
+)
+FENCE_GAUGE = "tpushare_checkpoint_fenced"
+FENCE_GAUGE_HELP = (
+    "1 when this daemon observed a newer generation on the node and "
+    "refuses journal writes (a stale duplicate instance)"
+)
+
+# Resolved (committed/aborted) records tolerated in the file before the
+# journal is rewritten down to header + live begins.
+COMPACT_EVERY = 512
+
+
+class StaleDaemonError(RuntimeError):
+    """This daemon's fencing generation was superseded on the node: a newer
+    instance owns allocation now. Writes must be refused — two writers
+    journaling against one node double-book chips."""
+
+
+class AllocationCheckpoint:
+    def __init__(self, path: str):
+        self._path = path
+        self._lock = threading.RLock()
+        self._entries: dict[PodKey, dict] = {}  # begun, unresolved
+        self._generation = 0
+        # Incarnation token: the fencing tie-breaker. Two daemons racing a
+        # rollout can GET-then-PATCH the same generation onto the node
+        # (the PATCH carries no resourceVersion precondition); with equal
+        # generations neither would fence on the number alone. The token
+        # makes the node annotation name one exact incarnation — last
+        # writer wins, the other observes a foreign token and fences.
+        self._token = os.urandom(6).hex()
+        self._fenced = False
+        self._resolved_since_compact = 0
+        self._seq = 0  # monotonically stamps each begin (see begin())
+        self._f = None
+        self._lockf = None
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        self._acquire_file_lock()
+        self._load()
+        # every open is a new incarnation: bump the generation and compact
+        # so the header on disk names it before any new begin rides on it
+        self._generation += 1
+        self._compact()
+        REGISTRY.gauge_set(FENCE_GAUGE, 0.0, FENCE_GAUGE_HELP)
+
+    def _acquire_file_lock(self) -> None:
+        """Best-effort flock on a sidecar: two live processes appending and
+        compacting one WAL would corrupt it. Advisory only — the fencing
+        token is the correctness mechanism for allocation writes; this
+        just makes the shared-file mistake loud instead of silent."""
+        try:
+            import fcntl
+
+            self._lockf = open(self._path + ".lock", "wb")
+            fcntl.flock(self._lockf.fileno(), fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            log.error(
+                "checkpoint %s is locked by another live process — two "
+                "daemon instances sharing one WAL file will corrupt it; "
+                "continuing, relying on the fencing token", self._path,
+            )
+            if self._lockf is not None:
+                try:
+                    self._lockf.close()
+                except OSError:
+                    pass
+                self._lockf = None
+        except ImportError:
+            self._lockf = None
+
+    # --- introspection ----------------------------------------------------
+
+    @property
+    def path(self) -> str:
+        return self._path
+
+    @property
+    def generation(self) -> int:
+        with self._lock:
+            return self._generation
+
+    @property
+    def fenced(self) -> bool:
+        with self._lock:
+            return self._fenced
+
+    def pending(self) -> dict[PodKey, dict]:
+        """Begun-but-unresolved entries (the replay set)."""
+        with self._lock:
+            return {k: dict(v) for k, v in self._entries.items()}
+
+    # --- load / persist ---------------------------------------------------
+
+    def _load(self) -> None:
+        if not os.path.exists(self._path):
+            return
+        try:
+            with open(self._path, "rb") as f:
+                raw = f.read()
+        except OSError as e:
+            log.warning("checkpoint read failed (%s); starting empty", e)
+            return
+        lines = raw.split(b"\n")
+        for i, line in enumerate(lines):
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                # a torn final line is the expected crash artifact; a torn
+                # middle line means external corruption — skip either way,
+                # the WAL invariant (begin precedes PATCH) still holds for
+                # every record that did land intact
+                log.warning(
+                    "checkpoint: dropping unparseable line %d%s",
+                    i + 1,
+                    " (torn tail)" if i == len(lines) - 1 else "",
+                )
+                continue
+            op = rec.get("op")
+            if op == "header":
+                try:
+                    self._generation = max(
+                        self._generation, int(rec.get("generation", 0))
+                    )
+                except (TypeError, ValueError):
+                    pass
+            elif op == "begin":
+                key = rec.get("key") or []
+                if len(key) == 2:
+                    data = dict(rec.get("data") or {})
+                    try:
+                        self._seq = max(self._seq, int(data.get("_seq", 0)))
+                    except (TypeError, ValueError):
+                        data.pop("_seq", None)
+                    self._entries[(str(key[0]), str(key[1]))] = data
+            elif op in ("commit", "abort"):
+                key = rec.get("key") or []
+                if len(key) == 2:
+                    self._entries.pop((str(key[0]), str(key[1])), None)
+
+    def _open_append(self):
+        if self._f is None:
+            self._f = open(self._path, "ab")
+        return self._f
+
+    def _append(self, rec: dict) -> None:
+        """Caller must hold self._lock. Durable before return."""
+        f = self._open_append()
+        f.write(json.dumps(rec, separators=(",", ":")).encode() + b"\n")
+        f.flush()
+        os.fsync(f.fileno())
+
+    def _compact(self) -> None:
+        """Caller must hold self._lock (or be the constructor). Rewrite the
+        journal to header + live begins via atomic rename, so a crash
+        mid-compaction leaves the old file intact."""
+        tmp = self._path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(
+                json.dumps(
+                    {"op": "header", "generation": self._generation},
+                    separators=(",", ":"),
+                ).encode()
+                + b"\n"
+            )
+            for key, data in self._entries.items():
+                f.write(
+                    json.dumps(
+                        {"op": "begin", "key": list(key), "data": data},
+                        separators=(",", ":"),
+                    ).encode()
+                    + b"\n"
+                )
+            f.flush()
+            os.fsync(f.fileno())
+        if self._f is not None:
+            try:
+                self._f.close()
+            except OSError:
+                pass
+            self._f = None
+        os.replace(tmp, self._path)
+        parent = os.path.dirname(self._path) or "."
+        try:
+            dirfd = os.open(parent, os.O_RDONLY)
+            try:
+                os.fsync(dirfd)
+            finally:
+                os.close(dirfd)
+        except OSError:
+            pass  # platform without dir fsync — rename is still atomic
+        self._resolved_since_compact = 0
+
+    # --- journal ops ------------------------------------------------------
+
+    def begin(self, key: PodKey, data: dict) -> None:
+        """Journal an in-flight decision; MUST precede the PATCH. Raises
+        ``StaleDaemonError`` when fenced; I/O failures degrade to
+        unjournaled operation (logged + counted) — a full disk must not
+        take pod admission down with it.
+
+        Each begin gets a monotonic ``_seq`` stamp (persisted with the
+        record): ``commit``/``abort`` with ``seq`` only resolve the exact
+        incarnation of the entry the caller saw, so the reconciler racing
+        a fresh same-key admission cannot pop the new entry."""
+        with self._lock:
+            if self._fenced:
+                raise StaleDaemonError(
+                    f"generation {self._generation} superseded on the node"
+                )
+            self._seq += 1
+            data = dict(data)
+            data["_seq"] = self._seq
+            try:
+                self._append({"op": "begin", "key": list(key), "data": data})
+                self._entries[key] = data
+            except OSError as e:
+                log.warning("checkpoint begin append failed: %s", e)
+                REGISTRY.counter_inc(
+                    JOURNAL_ERRORS, JOURNAL_ERRORS_HELP, op="begin"
+                )
+                return
+        REGISTRY.counter_inc(JOURNAL_APPENDS, JOURNAL_APPENDS_HELP, op="begin")
+        FAULTS.fire("checkpoint.begin")
+
+    def commit(self, key: PodKey, seq: int | None = None) -> bool:
+        resolved = self._resolve("commit", key, seq)
+        FAULTS.fire("checkpoint.commit")
+        return resolved
+
+    def abort(self, key: PodKey, seq: int | None = None) -> bool:
+        resolved = self._resolve("abort", key, seq)
+        FAULTS.fire("checkpoint.abort")
+        return resolved
+
+    def _resolve(self, op: str, key: PodKey, seq: int | None = None) -> bool:
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                return False  # unjournaled admission (degraded mode)
+            if seq is not None and entry.get("_seq") != seq:
+                return False  # a newer begin owns this key now
+            try:
+                self._append({"op": op, "key": list(key)})
+            except OSError as e:
+                log.warning("checkpoint %s append failed: %s", op, e)
+                REGISTRY.counter_inc(JOURNAL_ERRORS, JOURNAL_ERRORS_HELP, op=op)
+                return False
+            self._entries.pop(key, None)
+            self._resolved_since_compact += 1
+            if self._resolved_since_compact >= COMPACT_EVERY:
+                try:
+                    self._compact()
+                except OSError as e:
+                    log.warning("checkpoint compaction failed: %s", e)
+        REGISTRY.counter_inc(JOURNAL_APPENDS, JOURNAL_APPENDS_HELP, op=op)
+        return True
+
+    def flush(self) -> None:
+        with self._lock:
+            if self._f is not None:
+                try:
+                    self._f.flush()
+                    os.fsync(self._f.fileno())
+                except OSError:
+                    pass
+
+    def close(self) -> None:
+        with self._lock:
+            self.flush()
+            if self._f is not None:
+                try:
+                    self._f.close()
+                except OSError:
+                    pass
+                self._f = None
+            if self._lockf is not None:
+                try:
+                    self._lockf.close()  # releases the flock
+                except OSError:
+                    pass
+                self._lockf = None
+
+    # --- fencing ----------------------------------------------------------
+
+    def acquire_fence(self, api, node_name: str) -> int:
+        """Claim allocation ownership of the node: ensure our generation
+        exceeds whatever the node annotation records, then stamp
+        ``<generation>:<incarnation token>``. Called at every (re)build;
+        any instance that acquires later gets a generation at least as
+        high and — PATCH order being the tie-breaker via the token — the
+        last writer owns the node and everyone else fences."""
+        from .. import const
+
+        node = api.get_node(node_name)
+        node_gen, _tok = _node_fence(node)
+        with self._lock:
+            if node_gen >= self._generation:
+                self._generation = node_gen + 1
+                self._compact()  # the header must name the new generation
+            gen = self._generation
+            self._fenced = False
+        api.patch_node(
+            node_name,
+            {"metadata": {"annotations": {
+                const.ANN_FENCE_GENERATION: f"{gen}:{self._token}"
+            }}},
+        )
+        REGISTRY.gauge_set(FENCE_GAUGE, 0.0, FENCE_GAUGE_HELP)
+        log.info(
+            "fence acquired: node %s generation=%d token=%s",
+            node_name, gen, self._token,
+        )
+        return gen
+
+    def verify_fence(self, api, node_name: str) -> bool:
+        """True while this daemon still owns the node. Fences on a newer
+        generation OR an equal generation under a foreign token (two
+        instances raced the non-CAS acquire to the same number; the last
+        PATCH writer owns it) — run by the reconciler every pass."""
+        node = api.get_node(node_name)
+        node_gen, node_tok = _node_fence(node)
+        with self._lock:
+            superseded = node_gen > self._generation or (
+                node_gen == self._generation
+                and node_tok not in ("", self._token)
+            )
+            if superseded:
+                if not self._fenced:
+                    log.error(
+                        "FENCED: node fence %d:%s vs ours %d:%s — another "
+                        "daemon instance owns allocation; refusing writes",
+                        node_gen, node_tok, self._generation, self._token,
+                    )
+                self._fenced = True
+            ok = not self._fenced
+        REGISTRY.gauge_set(
+            FENCE_GAUGE, 0.0 if ok else 1.0, FENCE_GAUGE_HELP
+        )
+        return ok
+
+
+def _node_fence(node: dict) -> tuple[int, str]:
+    """Parse the ``<generation>[:<token>]`` node annotation."""
+    from .. import const
+
+    ann = node.get("metadata", {}).get("annotations") or {}
+    raw = str(ann.get(const.ANN_FENCE_GENERATION, "0"))
+    gen_s, _, token = raw.partition(":")
+    try:
+        return int(gen_s), token
+    except (TypeError, ValueError):
+        return 0, token
+
+
+def replay_checkpoint(ckpt: AllocationCheckpoint, assume: AssumeCache) -> int:
+    """Re-install every unresolved journal entry as a ledger reservation.
+
+    Conservative by design: whether the crashed PATCH landed or not, the
+    chip is protected until the reconciler resolves the entry against the
+    apiserver. An admission placed during the replay-to-reconcile window
+    sees the reservation through the usual overlay and routes around it —
+    it can under-pack briefly, never double-book. No claims are taken:
+    the crashed admission's kubelet RPC died with the old process, and a
+    retried Allocate for the same pod must be free to re-match it.
+    """
+    n = 0
+    for key, data in ckpt.pending().items():
+        kind = data.get("kind")
+        if kind == "mem":
+            try:
+                assume.reserve_mem(key, int(data["idx"]), int(data["units"]))
+            except (KeyError, TypeError, ValueError):
+                log.warning("checkpoint replay: malformed mem entry for %s", key)
+                continue
+        elif kind == "core":
+            ids = data.get("ids") or []
+            try:
+                assume.reserve_core(key, [int(i) for i in ids])
+            except (TypeError, ValueError):
+                log.warning("checkpoint replay: malformed core entry for %s", key)
+                continue
+        else:
+            log.warning("checkpoint replay: unknown entry kind %r for %s", kind, key)
+            continue
+        n += 1
+        log.info("replayed in-flight %s reservation for %s/%s", kind, *key)
+    if n:
+        REGISTRY.counter_inc(
+            "tpushare_checkpoint_replayed_total",
+            "In-flight journal entries re-installed as ledger reservations "
+            "at daemon (re)start",
+            value=float(n),
+        )
+    return n

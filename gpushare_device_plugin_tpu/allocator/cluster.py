@@ -54,9 +54,11 @@ from ..cluster.events import REASON_ALLOC_FAILED, emit_pod_event
 from ..cluster.podsource import PodSource
 from ..cluster.usage import pod_counts_toward_usage
 from ..device.fanout import DeviceInventory
+from ..utils.faults import FAULTS
 from ..utils.log import get_logger
 from ..utils.metrics import timed_acquire
 from .assume import LOCK_WAIT_HELP, LOCK_WAIT_METRIC, AssumeCache, PodKey
+from .checkpoint import StaleDaemonError
 from .binpack import assign_chip
 from .env import ContainerAllocation, build_core_allocation, build_mem_allocation
 
@@ -106,6 +108,27 @@ def _live_candidate(pod_source, pod, node: str, units: int, resource: str):
     if P.is_assumed(live) and P.is_assigned(live):
         return None
     return live
+
+
+def _journal_begin(ckpt, key: PodKey, data: dict) -> None:
+    """WAL begin before the PATCH. Fencing refusal is a hard admission
+    failure (two writers double-book); journal I/O trouble is handled
+    inside the checkpoint (degrade to unjournaled, never block admission).
+    """
+    if ckpt is None:
+        return
+    try:
+        ckpt.begin(key, data)
+    except StaleDaemonError as e:
+        raise AllocationFailure(
+            f"stale daemon instance refuses to allocate: {e}"
+        ) from e
+
+
+def _journal_resolve(ckpt, key: PodKey, op: str) -> None:
+    if ckpt is None:
+        return
+    (ckpt.commit if op == "commit" else ckpt.abort)(key)
 
 
 def _serial_guard(pod_source, assume: AssumeCache):
@@ -177,6 +200,7 @@ class ClusterAllocator:
         disable_isolation: bool = False,
         unhealthy_chips_fn=None,
         assume: AssumeCache | None = None,
+        checkpoint=None,
     ):
         self._inv = inventory
         self._api = api
@@ -185,6 +209,10 @@ class ClusterAllocator:
         self._policy = policy
         self._disable_isolation = disable_isolation
         self._unhealthy_fn = unhealthy_chips_fn or (lambda: [])
+        # Write-ahead journal (allocator.checkpoint): the decision is made
+        # durable before the PATCH leaves the node, so a daemon killed
+        # mid-persist replays the reservation instead of double-assigning.
+        self._ckpt = checkpoint
         # The in-flight claim/reservation ledger (see allocator.assume).
         # MUST be shared with the node's ClusterCoreAllocator: the two
         # resources share one physical-chip ledger, and independent
@@ -219,19 +247,38 @@ class ClusterAllocator:
         ]
 
     def _admit(self, pod_units: int):
-        """Match, place, persist; -> (chip index, the matched pod)."""
+        """Match, place, journal, persist; -> (chip index, the matched pod).
+
+        WAL ordering per attempt: the chip decision is journaled durable
+        (``begin``) before the PATCH goes out, ``commit`` lands only after
+        the PATCHed copy is back in the pod source, and every failure path
+        that provably persisted nothing journals ``abort``. A crash at any
+        instruction leaves either no entry (nothing happened), or an
+        unresolved entry the restarted daemon replays as a reservation and
+        the reconciler resolves against the apiserver.
+        """
         pod = self._claim_pod(pod_units)
         try:
             try:
                 for attempt in (0, 1):
                     idx, annotations = self._place(pod, pod_units)
+                    key = _pod_key(pod)
+                    _journal_begin(self._ckpt, key, {
+                        "kind": "mem",
+                        "idx": idx,
+                        "units": pod_units,
+                        "annotations": annotations,
+                    })
                     try:
                         self._persist(pod, annotations)
+                        FAULTS.fire("allocator.post_persist")
+                        _journal_resolve(self._ckpt, key, "commit")
                         break
                     except _PodGone:
                         # The matched pod was deleted with its cache entry
                         # still live — evict it and re-match so a live
                         # same-size pod is not failed for a ghost's sake.
+                        _journal_resolve(self._ckpt, key, "abort")
                         log.warning(
                             "pod %s/%s vanished during persist; re-matching",
                             P.namespace(pod), P.name(pod),
@@ -245,6 +292,10 @@ class ClusterAllocator:
                                 f"requesting {pod_units} {const.RESOURCE_MEM}"
                             ) from None
                         pod = self._claim_pod(pod_units, refresh_first=True)
+                    except AllocationFailure:
+                        # the PATCH conclusively failed — nothing persisted
+                        _journal_resolve(self._ckpt, key, "abort")
+                        raise
             except AllocationFailure as e:
                 # kubelet only logs the gRPC error; a Warning event on the
                 # pod makes `kubectl describe pod` show why admission failed
@@ -427,6 +478,7 @@ class ClusterCoreAllocator:
         topology=None,
         unhealthy_chips_fn=None,
         assume: AssumeCache | None = None,
+        checkpoint=None,
     ):
         self._inv = inventory
         self._api = api
@@ -434,6 +486,8 @@ class ClusterCoreAllocator:
         self._node = node_name
         self._topo = topology
         self._unhealthy_fn = unhealthy_chips_fn or (lambda: [])
+        # shared WAL with the mem allocator — see ClusterAllocator.__init__
+        self._ckpt = checkpoint
         # shared with the mem allocator — see ClusterAllocator.__init__
         self._assume = assume if assume is not None else AssumeCache()
         self._match_locks = [threading.Lock() for _ in range(NUM_MATCH_STRIPES)]
@@ -489,13 +543,26 @@ class ClusterCoreAllocator:
                         const.ENV_ASSIGNED_FLAG: "true",
                         const.ENV_ASSUME_TIME: str(time.time_ns()),
                     }
+                    key = _pod_key(pod)
+                    _journal_begin(self._ckpt, key, {
+                        "kind": "core",
+                        "ids": list(indices),
+                        "units": total,
+                        "annotations": annotations,
+                    })
                     try:
                         persist_pod_assignment(
                             self._api, self._pods, pod, annotations,
                             const.LABEL_CORE_VALUE,
                         )
+                        FAULTS.fire("allocator.post_persist")
+                        _journal_resolve(self._ckpt, key, "commit")
                         break
+                    except AllocationFailure:
+                        _journal_resolve(self._ckpt, key, "abort")
+                        raise
                     except _PodGone:
+                        _journal_resolve(self._ckpt, key, "abort")
                         log.warning(
                             "core pod %s/%s vanished during persist; re-matching",
                             P.namespace(pod), P.name(pod),

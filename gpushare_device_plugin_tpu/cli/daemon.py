@@ -5,6 +5,14 @@ construction with serviceaccount-token fallback, memory-unit validation,
 then hand-off to the lifecycle manager. TPU additions: ``--discovery``
 backend selection, ``--policy`` binpack choice, ``--standalone`` mode
 (no apiserver), and ``--no-core-resource``.
+
+Graceful shutdown: SIGTERM/SIGINT (installed via
+``manager.install_signal_handlers``) triggers a drain — in-flight
+Allocate calls finish their apiserver PATCH and journal commit, new ones
+are refused, the allocation checkpoint is flushed and closed, and the
+plugin gRPC sockets are unlinked — instead of dying mid-write. A hard
+kill at any instruction is survivable too (``--checkpoint-path`` WAL +
+restart replay); the drain just makes the common case not need it.
 """
 
 from __future__ import annotations
@@ -77,6 +85,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--breaker-reset-s", type=float, default=5.0,
                    help="seconds the circuit stays open before a half-open "
                    "probe")
+    # crash-safe state (docs/robustness.md, allocator/checkpoint.py)
+    p.add_argument("--checkpoint-path", default="",
+                   help="write-ahead allocation journal file; default is "
+                   "<plugin-dir>/tpushare-allocations.ckpt in cluster mode "
+                   "(the device-plugin dir is already a host path, so the "
+                   "journal survives container restarts); 'none' disables")
+    p.add_argument("--reconcile-interval", type=float, default=30.0,
+                   help="seconds between drift-reconciler passes "
+                   "(annotations vs ledger vs checkpoint); 0 disables")
+    p.add_argument("--drain-timeout", type=float, default=5.0,
+                   help="graceful-shutdown budget for in-flight Allocate "
+                   "calls before the gRPC sockets close")
     p.add_argument("-v", "--verbosity", type=int, default=0)
     return p
 
@@ -103,6 +123,15 @@ def main(argv=None) -> int:
         log.warning("fault injection ACTIVE at points: %s", FAULTS.active())
 
     backend = from_name(args.discovery)
+    # WAL default: on in cluster mode, under the plugin dir (a hostPath in
+    # every real deployment, so the journal outlives the container).
+    checkpoint_path = args.checkpoint_path
+    if checkpoint_path == "none":
+        checkpoint_path = ""
+    elif not checkpoint_path and not args.standalone:
+        checkpoint_path = os.path.join(
+            args.plugin_dir, "tpushare-allocations.ckpt"
+        )
     cfg = ManagerConfig(
         plugin_dir=args.plugin_dir,
         node_name=args.node_name,
@@ -113,6 +142,9 @@ def main(argv=None) -> int:
         serve_core_resource=not args.no_core_resource,
         disable_isolation=args.disable_isolation,
         coredump_dir=args.coredump_dir,
+        checkpoint_path=checkpoint_path,
+        reconcile_interval_s=args.reconcile_interval,
+        drain_timeout_s=args.drain_timeout,
     )
 
     api_client = None

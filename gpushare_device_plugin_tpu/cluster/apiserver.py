@@ -451,6 +451,11 @@ class ApiServerClient:
     def get_node(self, name: str) -> dict:
         return self._get(f"/api/v1/nodes/{name}")
 
+    def patch_node(self, name: str, patch: dict) -> dict:
+        """Strategic-merge patch on node metadata (fencing-generation
+        annotation, allocator/checkpoint.py)."""
+        return self._patch(f"/api/v1/nodes/{name}", patch, STRATEGIC_MERGE)
+
     def patch_node_status(self, name: str, capacity: Mapping[str, str]) -> dict:
         """Merge extended resources into node Status.Capacity/Allocatable.
 

@@ -1,0 +1,340 @@
+"""Periodic drift reconciler: annotations vs. ledger vs. checkpoint vs. kubelet.
+
+Hot state now lives in four places — apiserver pod annotations (the
+database), the AssumeCache reservation ledger (in-flight protection), the
+allocation checkpoint (crash-surviving WAL), and kubelet's own notion of
+which device IDs it granted. They are kept coherent by construction on the
+happy path; this reconciler is the backstop for every path that isn't:
+pods deleted mid-allocation, PATCHes that landed after their daemon died,
+reservations whose owner hung, duplicate daemons racing a rollout.
+
+One pass (``reconcile_once``):
+
+1. **fence check** — verify this daemon's generation still owns the node
+   annotation; a superseded instance latches fenced (allocation writes
+   refuse) and skips repairs (the newer instance owns them).
+2. **TTL expiry** — reap ledger entries older than the AssumeCache TTL
+   (a crashed or hung PATCH can never permanently strand capacity).
+3. **checkpoint resolution** — every replayed journal entry is resolved
+   against the apiserver: pod assigned -> retro-commit (the crashed PATCH
+   won); pod gone or unassigned -> retro-abort (nothing persisted).
+   Either way its ledger reservation is released. Entries whose pod key
+   is currently *claimed* belong to a live admission and are skipped.
+4. **ledger orphans** — unclaimed reservations whose pod is authoritatively
+   gone (deleted mid-allocation) or already counted by annotations
+   (redundant) are released.
+5. **annotation audit** — assigned pods with garbled chip annotations and
+   per-chip overcommit (annotations promising more than inventory) are
+   counted as drift; they are observable, not auto-mutated — annotations
+   are the database, and a reconciler that "fixes" the database on a
+   hunch is how real outages start.
+6. **kubelet diff** — when a grants feed is available (tests; the
+   podresources API in production), pods assigned in annotations but
+   unknown to kubelet — and vice versa — are counted as drift.
+
+Everything emits ``tpushare_reconcile_drift_total{kind=...}`` /
+``tpushare_reconcile_repairs_total{kind=...}`` so an operator can alert on
+a node that keeps needing repair.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..allocator.assume import AssumeCache, PodKey
+from ..utils.log import get_logger
+from ..utils.metrics import REGISTRY
+from . import pods as P
+
+log = get_logger("cluster.reconciler")
+
+DRIFT_METRIC = "tpushare_reconcile_drift_total"
+DRIFT_HELP = (
+    "State divergences observed between annotations, the reservation "
+    "ledger, the checkpoint, and kubelet grants, by kind"
+)
+REPAIR_METRIC = "tpushare_reconcile_repairs_total"
+REPAIR_HELP = "Divergences repaired (released/resolved), by kind"
+RUNS_METRIC = "tpushare_reconcile_runs_total"
+RUNS_HELP = "Reconcile passes by outcome"
+DURATION_METRIC = "tpushare_reconcile_seconds"
+DURATION_HELP = "Wall time of one reconcile pass"
+
+DEFAULT_INTERVAL_S = 30.0
+
+
+class DriftReconciler:
+    def __init__(
+        self,
+        api,
+        pod_source,
+        assume: AssumeCache,
+        checkpoint=None,
+        node_name: str = "",
+        inventory=None,
+        kubelet_grants_fn=None,
+        interval_s: float = DEFAULT_INTERVAL_S,
+        on_fenced=None,
+    ):
+        """``kubelet_grants_fn() -> dict[PodKey, list[str]]`` supplies
+        kubelet's granted device IDs per pod when a feed exists (the fake
+        kubelet in tests; the podresources socket in production); None
+        skips that diff. ``on_fenced()`` fires once when this instance
+        discovers it was superseded."""
+        self._api = api
+        self._pods = pod_source
+        self._assume = assume
+        self._ckpt = checkpoint
+        self._node = node_name
+        self._inv = inventory
+        self._grants_fn = kubelet_grants_fn
+        self._interval = interval_s
+        self._on_fenced = on_fenced
+        self._fenced_notified = False
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # --- lifecycle --------------------------------------------------------
+
+    def start(self) -> "DriftReconciler":
+        self._thread = threading.Thread(
+            target=self._run, name="drift-reconciler", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def _run(self) -> None:
+        # first pass immediately: the post-restart replay set should be
+        # resolved as soon as the control plane answers, not interval_s later
+        while True:
+            try:
+                self.reconcile_once()
+            except Exception as e:  # noqa: BLE001 — never kill the loop
+                log.warning("reconcile pass failed: %s", e)
+                REGISTRY.counter_inc(RUNS_METRIC, RUNS_HELP, outcome="error")
+            if self._stop.wait(self._interval):
+                return
+
+    # --- the pass ---------------------------------------------------------
+
+    def reconcile_once(self) -> dict[str, int]:
+        """One full pass; -> counts by drift kind (for tests/logging)."""
+        t0 = time.perf_counter()
+        counts: dict[str, int] = {}
+
+        def drift(kind: str, repaired: bool = False, n: int = 1) -> None:
+            counts[kind] = counts.get(kind, 0) + n
+            REGISTRY.counter_inc(DRIFT_METRIC, DRIFT_HELP, value=n, kind=kind)
+            if repaired:
+                REGISTRY.counter_inc(REPAIR_METRIC, REPAIR_HELP, value=n, kind=kind)
+
+        # 1. fencing: a superseded daemon must stop writing AND stop
+        # repairing — the newer instance owns both.
+        if not self._check_fence(drift):
+            REGISTRY.counter_inc(RUNS_METRIC, RUNS_HELP, outcome="fenced")
+            return counts
+
+        # 2. TTL expiry (the ledger's own lazy reaping, forced eagerly here
+        # so a quiet node still unstrands within one reconcile interval)
+        for key in self._assume.expire_stale():
+            log.warning("reconcile: expired stale ledger entry for %s/%s", *key)
+            drift("expired_reservation", repaired=True)
+
+        # one refresh so "absent from the source" below means absent from
+        # the apiserver now, not absent from a stale cache
+        authoritative = True
+        try:
+            self._pods.refresh()
+        except Exception as e:  # noqa: BLE001 — outage: observe, don't repair
+            log.v(4, "reconcile: refresh failed (%s); repairs deferred", e)
+            authoritative = False
+
+        # 3. checkpoint resolution
+        if self._ckpt is not None:
+            self._resolve_checkpoint(drift)
+
+        # 4. ledger orphans
+        if authoritative:
+            self._release_orphan_reservations(drift)
+
+        # 5. annotation audit (observability only)
+        self._audit_annotations(drift)
+
+        # 6. kubelet grants diff
+        if self._grants_fn is not None:
+            self._diff_kubelet_grants(drift)
+
+        REGISTRY.counter_inc(RUNS_METRIC, RUNS_HELP, outcome="ok")
+        REGISTRY.observe(
+            DURATION_METRIC, time.perf_counter() - t0, DURATION_HELP
+        )
+        if counts:
+            log.info("reconcile pass repaired/observed drift: %s", counts)
+        return counts
+
+    # --- steps ------------------------------------------------------------
+
+    def _check_fence(self, drift) -> bool:
+        if self._ckpt is None or self._api is None or not self._node:
+            return True
+        try:
+            ok = self._ckpt.verify_fence(self._api, self._node)
+        except Exception as e:  # noqa: BLE001 — can't read the node: assume ok
+            log.v(4, "reconcile: fence verify failed (%s); assuming owned", e)
+            return True
+        if not ok:
+            drift("fenced")
+            if not self._fenced_notified:
+                self._fenced_notified = True
+                if self._on_fenced is not None:
+                    try:
+                        self._on_fenced()
+                    except Exception:  # noqa: BLE001
+                        pass
+        return ok
+
+    def _fetch_pod(self, key: PodKey) -> tuple[dict | None, bool]:
+        """-> (pod or None, authoritative). The apiserver GET is the truth;
+        a cached source read is good enough only for presence, never for
+        a deletion verdict."""
+        if self._api is not None:
+            from .apiserver import ApiError
+
+            try:
+                return self._api.get_pod(*key), True
+            except ApiError as e:
+                if e.status == 404:
+                    return None, True
+                return None, False
+            except Exception:  # noqa: BLE001 — outage
+                return None, False
+        get_pod = getattr(self._pods, "get_pod", None)
+        if get_pod is not None:
+            return get_pod(*key), False
+        return None, False
+
+    def _resolve_checkpoint(self, drift) -> None:
+        for key, data in self._ckpt.pending().items():
+            if self._assume.is_claimed(key):
+                continue  # a live admission owns this entry
+            pod, authoritative = self._fetch_pod(key)
+            if not authoritative:
+                continue  # resolve next pass, reservation stays protective
+            # The claim check above predates the slow GET: a kubelet retry
+            # may have claimed the key and journaled a NEW begin since.
+            # Resolution is therefore conditional on both the entry's seq
+            # (only the incarnation we inspected resolves) and the claim
+            # state at release time (a live worker keeps its reservation).
+            seq = data.get("_seq")
+            if pod is not None and P.is_assigned(pod):
+                # the crashed PATCH won: the annotation is the record now
+                if self._ckpt.commit(key, seq=seq):
+                    self._assume.release_if_unclaimed(key)
+                    log.info(
+                        "reconcile: journal entry for %s/%s committed "
+                        "(PATCH had landed before the crash)", *key
+                    )
+                    drift("replayed_commit", repaired=True)
+            else:
+                # pod gone, or still pending unassigned: nothing persisted
+                if self._ckpt.abort(key, seq=seq):
+                    self._assume.release_if_unclaimed(key)
+                    log.info(
+                        "reconcile: journal entry for %s/%s aborted "
+                        "(no assignment persisted)", *key
+                    )
+                    drift("replayed_abort", repaired=True)
+
+    def _release_orphan_reservations(self, drift) -> None:
+        claims, mem, core = self._assume.snapshot()
+        for key in list(mem) + list(core):
+            if key in claims:
+                continue  # live admission mid-PATCH: not drift
+            if self._ckpt is not None and key in self._ckpt.pending():
+                continue  # checkpoint resolution owns this one
+            pod, authoritative = self._fetch_pod(key)
+            if not authoritative:
+                continue
+            # release_if_unclaimed: the claim state is re-checked under
+            # the ledger lock — a worker that claimed during the GET
+            # keeps its reservation (see _resolve_checkpoint).
+            if pod is None:
+                if self._assume.release_if_unclaimed(key):
+                    log.warning(
+                        "reconcile: released reservation for deleted pod "
+                        "%s/%s", *key,
+                    )
+                    drift("orphan_reservation", repaired=True)
+            elif P.is_assigned(pod):
+                # annotations count the pod; the reservation is redundant
+                if self._assume.release_if_unclaimed(key):
+                    drift("redundant_reservation", repaired=True)
+
+    def _audit_annotations(self, drift) -> None:
+        try:
+            labeled = self._pods.labeled_pods()
+        except Exception:  # noqa: BLE001
+            return
+        units_by_index = (
+            self._inv.units_by_index() if self._inv is not None else None
+        )
+        used: dict[int, int] = {}
+        for pod in labeled:
+            if not P.is_active(pod) or not P.is_assigned(pod):
+                continue
+            if P.core_chips_of_pod(pod) > 0:
+                if not P.core_hold_chips(pod):
+                    drift("garbled_annotation")
+                continue
+            idx = P.chip_idx_from_annotation(pod)
+            if idx < 0:
+                drift("garbled_annotation")
+                continue
+            if units_by_index is not None and idx not in units_by_index:
+                drift("unknown_chip")
+                continue
+            used[idx] = used.get(idx, 0) + P.mem_units_of_pod(pod)
+        if units_by_index is not None:
+            for idx, n in used.items():
+                if n > units_by_index.get(idx, 0):
+                    log.error(
+                        "reconcile: chip %d overcommitted by annotations "
+                        "(%d > %d units)", idx, n, units_by_index.get(idx, 0),
+                    )
+                    drift("overcommit")
+
+    def _diff_kubelet_grants(self, drift) -> None:
+        try:
+            grants = self._grants_fn() or {}
+        except Exception as e:  # noqa: BLE001
+            log.v(4, "reconcile: kubelet grants read failed: %s", e)
+            return
+        try:
+            labeled = self._pods.labeled_pods()
+        except Exception:  # noqa: BLE001
+            return
+        assigned = {
+            (P.namespace(p), P.name(p))
+            for p in labeled
+            if P.is_active(p) and P.is_assigned(p)
+        }
+        grant_keys = {tuple(k) for k in grants}
+        for key in sorted(assigned - grant_keys):
+            log.v(
+                4, "reconcile: pod %s/%s assigned in annotations but "
+                "unknown to kubelet", *key,
+            )
+            drift("kubelet_unknown")
+        for key in sorted(grant_keys - assigned):
+            log.v(
+                4, "reconcile: kubelet granted devices to %s/%s which has "
+                "no assignment annotation", *key,
+            )
+            drift("kubelet_orphan")

@@ -78,6 +78,15 @@ LABEL_DISABLE_ISOLATION = "ctpu.disable.isolation"
 # time; the inspect CLI prefers it for per-chip attribution.
 ANN_EXTENDER_ALLOCATION = "scheduler.framework.tpushare.allocation"
 
+# --- Crash-safe state (allocator/checkpoint.py) ----------------------------
+# Node annotation carrying the fencing state, formatted
+# "<generation>:<incarnation token>": the newest daemon instance stamps
+# its checkpoint generation + a random per-open token here at (re)build.
+# An instance observing a higher generation — or its own generation under
+# a foreign token (two instances raced the acquire to the same number;
+# the last PATCH writer owns it) — is stale and refuses allocation writes.
+ANN_FENCE_GENERATION = "tpushare.aliyun.com/fence-generation"
+
 # Optimistic-lock conflict marker in apiserver patch errors
 # (reference: const.go:15).
 OPTIMISTIC_LOCK_ERROR_MSG = "the object has been modified; please apply your changes to the latest version and try again"

@@ -49,15 +49,32 @@ class _Inflight:
 
 
 class ExtenderCore:
-    def __init__(self, api: ApiServerClient, policy: str = "best-fit", informer=None):
+    def __init__(
+        self,
+        api: ApiServerClient,
+        policy: str = "best-fit",
+        informer=None,
+        checkpoint=None,
+    ):
         """``informer``: an optional cluster-wide ``PodInformer`` (no node
         field-selector). With it, filter/prioritize/bind read incremental
         per-node aggregates (``ClusterUsageIndex``) off the watch cache —
         O(nodes) per webhook verb — instead of LISTing and walking every
-        pod in the cluster per scheduling decision."""
+        pod in the cluster per scheduling decision.
+
+        ``checkpoint``: an optional ``AllocationCheckpoint`` journaling
+        each bind decision before its PATCH (same WAL as the device
+        plugin's allocator). On construction the unresolved entries seed
+        the in-flight overlay — serve-from-checkpoint warmup — so a
+        restarted extender keeps honoring decisions whose PATCH/Binding
+        may have landed but are not yet visible on the watch, instead of
+        double-booking those chips during its cold-start window. Entries
+        age out of the overlay on the normal in-flight TTL, by which time
+        the watch has either confirmed them or they never happened."""
         self._api = api
         self._policy = policy
         self._informer = informer
+        self._ckpt = checkpoint
         self._index: ClusterUsageIndex | None = None
         if informer is not None:
             self._index = ClusterUsageIndex()
@@ -78,6 +95,42 @@ class ExtenderCore:
             tuple[str, tuple, dict[int, int], dict[int, int], set[int]],
         ] = {}
         self._view_cache_max = 8192
+        if checkpoint is not None:
+            self._warmup_from_checkpoint()
+
+    def _warmup_from_checkpoint(self) -> None:
+        now = time.monotonic()
+        wall = time.time()
+        seeded = 0
+        for key, data in self._ckpt.pending().items():
+            # Entries older than the in-flight TTL are stale survivors of
+            # an earlier crash cycle: by now the watch has either shown
+            # their bind or it never landed — resolve them at load instead
+            # of replaying phantom capacity on every restart forever.
+            ts = data.get("ts")
+            if isinstance(ts, (int, float)) and wall - ts > self._inflight_ttl_s:
+                self._ckpt.abort(key, seq=data.get("_seq"))
+                continue
+            try:
+                entry = _Inflight(
+                    node=str(data["node"]),
+                    resource=str(data["resource"]),
+                    idx=int(data["idx"]),
+                    units=int(data["units"]),
+                    annotations=dict(data.get("annotations") or {}),
+                    stamp=now,
+                )
+            except (KeyError, TypeError, ValueError):
+                log.warning("checkpoint warmup: malformed bind entry for %s", key)
+                self._ckpt.abort(key, seq=data.get("_seq"))
+                continue
+            self._inflight[(key[0], key[1])] = entry
+            seeded += 1
+        if seeded:
+            log.info(
+                "serve-from-checkpoint warmup: %d in-flight bind "
+                "decision(s) restored", seeded,
+            )
 
     # --- helpers ----------------------------------------------------------
 
@@ -95,11 +148,21 @@ class ExtenderCore:
     def _live_inflight(self) -> dict[tuple[str, str], _Inflight]:
         now = time.monotonic()
         with self._lock:
-            self._inflight = {
-                k: v for k, v in self._inflight.items()
-                if now - v.stamp < self._inflight_ttl_s
-            }
-            return dict(self._inflight)
+            expired = [
+                k for k, v in self._inflight.items()
+                if now - v.stamp >= self._inflight_ttl_s
+            ]
+            for k in expired:
+                self._inflight.pop(k)
+            live = dict(self._inflight)
+        # An overlay entry aging out means the watch has caught up (or the
+        # bind never landed) — the journal entry has served its purpose
+        # and must not be replayed at the next restart. Unjournaled keys
+        # (including already-committed ones) are a no-op inside abort().
+        if self._ckpt is not None:
+            for k in expired:
+                self._ckpt.abort(k)
+        return live
 
     def _view_for(self, node: dict, resource: str) -> logic.NodeView:
         """One node's placement view off the incremental index, memoized.
@@ -323,21 +386,38 @@ class ExtenderCore:
                 _, idx, annotations = logic.choose_chip_from_view(
                     pod, view, policy=self._policy
                 )
+                units = P.mem_units_of_pod(pod, resource=resource)
                 self._inflight[(ns, name)] = _Inflight(
                     node=node_name,
                     resource=resource,
                     idx=idx,
-                    units=P.mem_units_of_pod(pod, resource=resource),
+                    units=units,
                     annotations=annotations,
                     stamp=time.monotonic(),
                 )
+            # WAL begin before the PATCH/Binding: a crash inside the next
+            # block leaves an unresolved entry the restarted extender's
+            # warmup serves from (and a journal-less crash would forget).
+            if self._ckpt is not None:
+                self._ckpt.begin((ns, name), {
+                    "node": node_name,
+                    "resource": resource,
+                    "idx": idx,
+                    "units": units,
+                    "annotations": annotations,
+                    "ts": time.time(),  # warmup ages stale entries out by this
+                })
             try:
                 self._api.patch_pod(ns, name, {"metadata": {"annotations": annotations}})
                 self._api.bind_pod(ns, name, node_name)
             except Exception:
                 with self._lock:
                     self._inflight.pop((ns, name), None)
+                if self._ckpt is not None:
+                    self._ckpt.abort((ns, name))
                 raise
+            if self._ckpt is not None:
+                self._ckpt.commit((ns, name))
         except (ApiError, AssignmentError) as e:
             log.warning("bind %s/%s -> %s failed: %s", ns, name, node_name, e)
             from ..cluster.events import REASON_BIND_FAILED, emit_pod_event
@@ -453,6 +533,11 @@ def main(argv=None) -> int:
     p.add_argument("--pod-source", default="informer", choices=["informer", "list"],
                    help="watch-backed cluster pod cache (default) or a full "
                    "LIST per webhook call")
+    p.add_argument("--checkpoint-path", default="",
+                   help="bind-decision WAL file; a restarted extender "
+                   "warms its in-flight overlay from it instead of "
+                   "double-booking chips whose bind is not yet on the "
+                   "watch (empty disables)")
     p.add_argument("--timeout", type=float, default=10.0)
     p.add_argument("--metrics-port", type=int, default=0,
                    help="serve Prometheus /metrics on this port (0 = off)")
@@ -474,8 +559,18 @@ def main(argv=None) -> int:
         from ..cluster.informer import PodInformer
 
         informer = PodInformer(api).start()
+    checkpoint = None
+    if args.checkpoint_path:
+        from ..allocator.checkpoint import AllocationCheckpoint
+
+        try:
+            checkpoint = AllocationCheckpoint(args.checkpoint_path)
+        except OSError as e:
+            log.warning("bind checkpoint unavailable (%s); running without", e)
     server = ExtenderHTTPServer(
-        ExtenderCore(api, policy=args.policy, informer=informer),
+        ExtenderCore(
+            api, policy=args.policy, informer=informer, checkpoint=checkpoint
+        ),
         host=args.host, port=args.port,
     )
     server.start()

@@ -29,7 +29,7 @@ from ..utils.faults import FAULTS
 from ..utils.log import get_logger
 from ..utils.stacktrace import coredump
 from .health import HealthWatcher
-from .watchers import SocketWatcher
+from .watchers import PluginDirWatcher
 
 log = get_logger("manager")
 
@@ -48,6 +48,14 @@ class ManagerConfig:
     serve_core_resource: bool = True
     disable_isolation: bool = False
     coredump_dir: str = "/etc/kubernetes"
+    # Write-ahead allocation journal (allocator/checkpoint.py). Empty
+    # disables it; cluster mode should point it at a path that survives
+    # container restarts (the device-plugin dir is the natural hostPath).
+    checkpoint_path: str = ""
+    # Drift-reconciler cadence (cluster/reconciler.py); <= 0 disables.
+    reconcile_interval_s: float = 30.0
+    # How long graceful shutdown waits for in-flight Allocate calls.
+    drain_timeout_s: float = 5.0
 
 
 class TpuShareManager:
@@ -76,6 +84,21 @@ class TpuShareManager:
         from ..allocator.assume import AssumeCache
 
         self._alloc_assume = AssumeCache()
+        # Crash-safe state layer (cluster mode): the WAL checkpoint the
+        # allocators journal through, and the drift reconciler that keeps
+        # annotations / ledger / checkpoint / kubelet coherent.
+        self._ckpt = None
+        if config.checkpoint_path and api_client is not None and not config.standalone:
+            from ..allocator.checkpoint import AllocationCheckpoint
+
+            try:
+                self._ckpt = AllocationCheckpoint(config.checkpoint_path)
+            except OSError as e:
+                log.warning(
+                    "allocation checkpoint unavailable (%s); running "
+                    "unjournaled — restart recovery degraded", e,
+                )
+        self._reconciler = None
         self._restart = threading.Event()
         self._stop = threading.Event()
         self._park = threading.Event()
@@ -115,6 +138,7 @@ class TpuShareManager:
             disable_isolation=self._disable_isolation,
             unhealthy_chips_fn=unhealthy_fn,
             assume=self._alloc_assume,
+            checkpoint=self._ckpt,
         )
         return cluster.allocate
 
@@ -160,6 +184,7 @@ class TpuShareManager:
             topology=topo,
             unhealthy_chips_fn=unhealthy_fn,
             assume=self._alloc_assume,
+            checkpoint=self._ckpt,
         )
         return core.allocate
 
@@ -236,6 +261,27 @@ class TpuShareManager:
             )
             if self._disable_isolation:
                 log.info("HBM isolation disabled (config flag or node label)")
+        # Crash recovery BEFORE the plugins serve: claim the fencing
+        # generation (a stale duplicate instance observes it and refuses)
+        # and replay unresolved journal entries into the ledger, so the
+        # first Allocate after a restart already sees every in-flight
+        # reservation the previous incarnation died holding.
+        if self._ckpt is not None and self._api is not None:
+            if self._cfg.node_name:
+                try:
+                    self._ckpt.acquire_fence(self._api, self._cfg.node_name)
+                except Exception as e:
+                    log.warning(
+                        "fence acquire failed (%s); continuing unfenced", e
+                    )
+            from ..allocator.checkpoint import replay_checkpoint
+
+            n = replay_checkpoint(self._ckpt, self._alloc_assume)
+            if n:
+                log.info(
+                    "device-state replay: %d in-flight allocation(s) "
+                    "restored from checkpoint", n,
+                )
         self._plugins = self._build_plugins(inventory)
         for plugin in self._plugins:
             plugin.serve()
@@ -307,20 +353,68 @@ class TpuShareManager:
                 self._backend, sinks=sinks, on_event=on_event
             )
             self._health.start()
+        # The drift reconciler runs for the lifetime of this build; its
+        # first pass resolves whatever the replay above re-reserved.
+        if (
+            self._api is not None
+            and self._pod_source is not None
+            and not self._cfg.standalone
+            and self._cfg.reconcile_interval_s > 0
+        ):
+            from ..cluster.reconciler import DriftReconciler
+
+            self._reconciler = DriftReconciler(
+                api=self._api,
+                pod_source=self._pod_source,
+                assume=self._alloc_assume,
+                checkpoint=self._ckpt,
+                node_name=self._cfg.node_name,
+                inventory=inventory,
+                interval_s=self._cfg.reconcile_interval_s,
+            ).start()
 
     def _stop_all(self) -> None:
+        if self._reconciler is not None:
+            self._reconciler.stop()
+            self._reconciler = None
         if self._health is not None:
             self._health.stop()
             self._health = None
         if self._events is not None:
             self._events.stop()
             self._events = None
+        # Graceful drain first: refuse new Allocate RPCs on EVERY plugin
+        # at once (quiesce), then wait for in-flight ones to finish their
+        # PATCH + journal commit against one shared deadline — the
+        # checkpoint covers a hard cut, but a clean flush beats replaying
+        # one, and the total drain must fit one grace budget, not N.
+        import time as _time
+
+        for plugin in self._plugins:
+            try:
+                plugin.quiesce()
+            except Exception as e:
+                log.warning("plugin quiesce failed: %s", e)
+        deadline = _time.monotonic() + self._cfg.drain_timeout_s
+        for plugin in self._plugins:
+            try:
+                remaining = max(0.0, deadline - _time.monotonic())
+                if not plugin.drain(remaining):
+                    log.warning(
+                        "plugin %s did not drain within %.1fs; stopping "
+                        "anyway (checkpoint covers the cut)",
+                        plugin.resource_name, self._cfg.drain_timeout_s,
+                    )
+            except Exception as e:
+                log.warning("plugin drain failed: %s", e)
         for plugin in self._plugins:
             try:
                 plugin.stop()
             except Exception as e:
                 log.warning("plugin stop failed: %s", e)
         self._plugins = []
+        if self._ckpt is not None:
+            self._ckpt.flush()
 
     # ------------------------------------------------------------------
 
@@ -356,13 +450,21 @@ class TpuShareManager:
             log.info("no TPU chips found on this node; parking")
             self._park.wait()
             return
-        watcher = SocketWatcher(
-            path=f"{self._cfg.plugin_dir.rstrip('/')}/kubelet.sock"
+        # Restart detection across the whole device-plugin dir: kubelet.sock
+        # recreation (kubelet restart) or our own plugin sockets vanishing
+        # (kubelet cleanup that silently unregisters us). Suspended around
+        # our own rebuilds so self-inflicted socket churn never loops.
+        watcher = PluginDirWatcher(
+            kubelet_sock_path=f"{self._cfg.plugin_dir.rstrip('/')}/kubelet.sock",
+            plugin_sockets_fn=lambda: [p.socket_path for p in self._plugins],
         )
-        watcher.start(on_recreate=lambda: self.trigger_restart("kubelet restart"))
+        watcher.start(
+            on_recreate=lambda reason: self.trigger_restart(reason)
+        )
         try:
             while not self._stop.is_set():
                 self._restart.clear()
+                watcher.suspend()
                 try:
                     self._serve_all()
                 except Exception as e:
@@ -371,8 +473,14 @@ class TpuShareManager:
                     if self._stop.wait(5.0):
                         break
                     continue
+                watcher.resume()
                 self._restart.wait()
+                watcher.suspend()
                 self._stop_all()
         finally:
             watcher.stop()
             self._stop_all()
+            if self._ckpt is not None:
+                # graceful shutdown: the journal is flushed and closed so
+                # the next incarnation loads a clean file
+                self._ckpt.close()

@@ -92,7 +92,16 @@ class TpuSharePlugin(DevicePluginServicer):
         self._cond = threading.Condition()
         self._version = 0  # bumped on every health change
         self._stopping = False
+        self._inflight_allocates = 0  # guarded by _cond; drain() waits on it
         self._server: grpc.Server | None = None
+
+    @property
+    def resource_name(self) -> str:
+        return self._cfg.resource_name
+
+    @property
+    def socket_path(self) -> str:
+        return self._cfg.socket_path
 
     # ------------------------------------------------------------------
     # health ingestion (fed by the manager's health watcher thread)
@@ -192,6 +201,24 @@ class TpuSharePlugin(DevicePluginServicer):
 
     def Allocate(self, request, context) -> pb.AllocateResponse:
         """Count granted fake IDs per container and delegate placement."""
+        # In-flight accounting for graceful shutdown: a SIGTERM'd daemon
+        # drains admissions that already started (their PATCH may be on
+        # the wire — dying mid-write is the checkpoint's job to survive,
+        # but not dying at all is better) and refuses new ones.
+        with self._cond:
+            if self._stopping:
+                context.abort(
+                    grpc.StatusCode.UNAVAILABLE, "plugin is shutting down"
+                )
+            self._inflight_allocates += 1
+        try:
+            return self._allocate_inner(request, context)
+        finally:
+            with self._cond:
+                self._inflight_allocates -= 1
+                self._cond.notify_all()
+
+    def _allocate_inner(self, request, context) -> pb.AllocateResponse:
         from ..utils.faults import FAULTS
         from ..utils.metrics import REGISTRY
 
@@ -292,6 +319,27 @@ class TpuSharePlugin(DevicePluginServicer):
     def serve(self) -> None:
         self.start()
         self.register()
+
+    def quiesce(self) -> None:
+        """Refuse new Allocate calls from now on, without waiting. The
+        manager quiesces every plugin before draining any, so later
+        plugins cannot keep admitting work while earlier ones drain."""
+        with self._cond:
+            self._stopping = True
+            self._cond.notify_all()
+
+    def drain(self, timeout_s: float = 5.0) -> bool:
+        """Refuse new Allocate calls and wait for in-flight ones to finish
+        (their apiserver PATCH completes and the journal entry resolves).
+        True when the plugin drained inside the timeout; False means the
+        caller proceeds to stop anyway — the checkpoint replay covers
+        whatever was cut mid-write."""
+        with self._cond:
+            self._stopping = True
+            self._cond.notify_all()
+            return self._cond.wait_for(
+                lambda: self._inflight_allocates == 0, timeout_s
+            )
 
     def stop(self, grace: float = 1.0) -> None:
         with self._cond:

@@ -17,7 +17,18 @@ Registered points (see docs/robustness.md for the failure-mode matrix):
 ``discovery.watch_health``  health-event stream (supervised loop entry +
                         every mock-backend poll)
 ``plugin.allocate``     Allocate RPC entry (kubelet-facing)
+``checkpoint.begin``    after the WAL begin record is durably on disk
+``checkpoint.commit``   after the WAL commit record is durably on disk
+``checkpoint.abort``    after the WAL abort record is durably on disk
+``allocator.post_persist``  after the pod PATCH landed, before the WAL
+                        commit record (the mid-window crash site)
 ==========================================================================
+
+The ``checkpoint.*`` / ``allocator.post_persist`` points sit immediately
+*after* each journal step takes durable effect, so arming them with the
+``crash`` mode is the ``crash_after:<site>`` primitive the restart-recovery
+suite drives: the process "dies" with the file/apiserver state exactly as
+a SIGKILL at that instruction would leave it.
 
 Modes:
 
@@ -26,6 +37,12 @@ Modes:
 - ``latency``: sleep ``latency_s`` before letting the call proceed.
 - ``flap``:    cyclically fail ``fail_n`` calls then pass ``pass_n`` —
                models a control plane that is intermittently reachable.
+- ``crash``:   raise ``SimulatedCrash`` — a ``BaseException``, so no
+               business-level ``except Exception`` handler (allocator
+               rollback, journal abort) can observe it, exactly like a
+               process kill. Cleanup that would not survive a real crash
+               must not run; in-memory ``finally`` blocks still do, which
+               is fine — a restarted daemon has fresh memory anyway.
 
 ``times`` bounds how many *firings* a fault affects (then it disarms
 itself); ``None`` means until cleared.
@@ -60,6 +77,10 @@ POINTS = (
     "discovery.probe",
     "discovery.watch_health",
     "plugin.allocate",
+    "checkpoint.begin",
+    "checkpoint.commit",
+    "checkpoint.abort",
+    "allocator.post_persist",
 )
 
 
@@ -71,6 +92,18 @@ class FaultError(ConnectionError):
 
     def __init__(self, point: str):
         super().__init__(f"injected fault at {point}")
+        self.point = point
+
+
+class SimulatedCrash(BaseException):
+    """Process death, simulated. Deliberately NOT an ``Exception``: every
+    business-level handler on the Allocate path (journal abort, claim
+    rollback, gRPC error mapping) catches ``Exception`` and would otherwise
+    run cleanup a SIGKILL never runs — which is precisely what restart
+    recovery must be tested *without*. Only the test harness catches it."""
+
+    def __init__(self, point: str):
+        super().__init__(f"simulated crash at {point}")
         self.point = point
 
 
@@ -86,12 +119,17 @@ class _Fault:
         fail_n: int,
         pass_n: int,
     ):
-        if mode not in ("error", "latency", "flap"):
+        if mode not in ("error", "latency", "flap", "crash"):
             raise ValueError(f"unknown fault mode: {mode}")
         self.point = point
         self.mode = mode
         self.times = times
-        self.error = error or (lambda: FaultError(point))
+        if error is not None:
+            self.error = error
+        elif mode == "crash":
+            self.error = lambda: SimulatedCrash(point)
+        else:
+            self.error = lambda: FaultError(point)
         self.latency_s = latency_s
         self.fail_n = max(1, fail_n)
         self.pass_n = max(1, pass_n)

@@ -397,6 +397,23 @@ class FakeApiServer:
                                         meta[key] = merged
                                 store._record_event("MODIFIED", pod)
                                 response = (200, copy.deepcopy(pod))
+                    elif len(rest) == 2 and rest[0] == "nodes":
+                        node = store.nodes.get(rest[1])
+                        if node is None:
+                            response = (404, {"message": "not found"})
+                        else:
+                            meta_patch = body.get("metadata", {})
+                            meta = node.setdefault("metadata", {})
+                            for key in ("annotations", "labels"):
+                                if key in meta_patch:
+                                    merged = dict(meta.get(key) or {})
+                                    for k, v in (meta_patch[key] or {}).items():
+                                        if v is None:
+                                            merged.pop(k, None)
+                                        else:
+                                            merged[k] = v
+                                    meta[key] = merged
+                            response = (200, copy.deepcopy(node))
                     elif len(rest) == 3 and rest[0] == "nodes" and rest[2] == "status":
                         node = store.nodes.get(rest[1])
                         if node is None:

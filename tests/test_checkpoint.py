@@ -1,0 +1,234 @@
+"""Unit tests for the write-ahead allocation journal (allocator/checkpoint.py):
+durability, torn-tail tolerance, compaction, generation bump, replay, and
+the node-annotation fencing token."""
+
+import json
+import os
+
+import pytest
+
+from gpushare_device_plugin_tpu import const
+from gpushare_device_plugin_tpu.allocator.assume import AssumeCache
+from gpushare_device_plugin_tpu.allocator.checkpoint import (
+    AllocationCheckpoint,
+    StaleDaemonError,
+    replay_checkpoint,
+)
+from gpushare_device_plugin_tpu.cluster.apiserver import ApiServerClient
+from gpushare_device_plugin_tpu.utils.faults import FAULTS, SimulatedCrash
+
+from fake_apiserver import FakeApiServer
+
+NODE = "node-ckpt"
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    FAULTS.clear()
+    yield
+    FAULTS.clear()
+
+
+def test_begin_commit_abort_roundtrip(tmp_path):
+    ckpt = AllocationCheckpoint(str(tmp_path / "a.ckpt"))
+    ckpt.begin(("default", "p1"), {"kind": "mem", "idx": 0, "units": 4})
+    ckpt.begin(("default", "p2"), {"kind": "core", "ids": [1, 2], "units": 2})
+    assert set(ckpt.pending()) == {("default", "p1"), ("default", "p2")}
+    ckpt.commit(("default", "p1"))
+    ckpt.abort(("default", "p2"))
+    assert ckpt.pending() == {}
+
+
+def test_unresolved_entries_survive_reopen(tmp_path):
+    path = str(tmp_path / "a.ckpt")
+    ckpt = AllocationCheckpoint(path)
+    ckpt.begin(("default", "live"), {"kind": "mem", "idx": 1, "units": 2})
+    ckpt.begin(("default", "done"), {"kind": "mem", "idx": 2, "units": 2})
+    ckpt.commit(("default", "done"))
+    # no close(): simulate a crash — the appends were fsync'd as they went
+    reopened = AllocationCheckpoint(path)
+    assert set(reopened.pending()) == {("default", "live")}
+    assert reopened.pending()[("default", "live")]["idx"] == 1
+
+
+def test_generation_bumps_every_open(tmp_path):
+    path = str(tmp_path / "a.ckpt")
+    g1 = AllocationCheckpoint(path).generation
+    g2 = AllocationCheckpoint(path).generation
+    g3 = AllocationCheckpoint(path).generation
+    assert g1 < g2 < g3
+
+
+def test_torn_tail_line_tolerated(tmp_path):
+    path = str(tmp_path / "a.ckpt")
+    ckpt = AllocationCheckpoint(path)
+    ckpt.begin(("default", "ok"), {"kind": "mem", "idx": 0, "units": 1})
+    ckpt.close()
+    with open(path, "ab") as f:  # the crash artifact: a half-written record
+        f.write(b'{"op":"begin","key":["default","to')
+    reopened = AllocationCheckpoint(path)
+    assert set(reopened.pending()) == {("default", "ok")}
+    # and the reopen compacted the torn garbage away
+    with open(path) as f:
+        for line in f:
+            json.loads(line)  # every surviving line parses
+
+
+def test_compaction_bounds_file_and_keeps_pending(tmp_path):
+    from gpushare_device_plugin_tpu.allocator import checkpoint as ckpt_mod
+
+    path = str(tmp_path / "a.ckpt")
+    ckpt = AllocationCheckpoint(path)
+    ckpt.begin(("default", "keeper"), {"kind": "mem", "idx": 3, "units": 1})
+    for i in range(ckpt_mod.COMPACT_EVERY + 5):
+        ckpt.begin(("default", f"p{i}"), {"kind": "mem", "idx": 0, "units": 1})
+        ckpt.commit(("default", f"p{i}"))
+    with open(path) as f:
+        lines = [ln for ln in f if ln.strip()]
+    # compacted: header + live begins only, nowhere near 2*COMPACT_EVERY
+    assert len(lines) < ckpt_mod.COMPACT_EVERY
+    assert set(ckpt.pending()) == {("default", "keeper")}
+    reopened = AllocationCheckpoint(path)
+    assert set(reopened.pending()) == {("default", "keeper")}
+
+
+def test_replay_installs_reservations(tmp_path):
+    ckpt = AllocationCheckpoint(str(tmp_path / "a.ckpt"))
+    ckpt.begin(("default", "m"), {"kind": "mem", "idx": 1, "units": 4})
+    ckpt.begin(("default", "c"), {"kind": "core", "ids": [2, 3], "units": 2})
+    ckpt.begin(("default", "junk"), {"kind": "wat"})
+    assume = AssumeCache()
+    assert replay_checkpoint(ckpt, assume) == 2
+    mem_used, core_held = assume.overlaid_state(lambda: ({}, set()))
+    assert mem_used == {1: 4}
+    assert core_held == {2, 3}
+    # replay takes reservations, never claims: a kubelet retry for the
+    # same pod must be free to re-match it
+    assert not assume.is_claimed(("default", "m"))
+
+
+def test_crash_fault_fires_after_durable_write(tmp_path):
+    path = str(tmp_path / "a.ckpt")
+    ckpt = AllocationCheckpoint(path)
+    FAULTS.inject("checkpoint.begin", mode="crash", times=1)
+    with pytest.raises(SimulatedCrash):
+        ckpt.begin(("default", "p"), {"kind": "mem", "idx": 0, "units": 2})
+    # crash_after semantics: the record IS on disk despite the "death"
+    survivor = AllocationCheckpoint(path)
+    assert set(survivor.pending()) == {("default", "p")}
+
+
+# --- fencing ---------------------------------------------------------------
+
+
+@pytest.fixture
+def api():
+    srv = FakeApiServer()
+    srv.add_node(NODE)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def test_fencing_newer_instance_fences_older(tmp_path, api):
+    client = ApiServerClient(api.url)
+    old = AllocationCheckpoint(str(tmp_path / "old.ckpt"))
+    old.acquire_fence(client, NODE)
+    assert old.verify_fence(client, NODE)  # sole owner
+
+    new = AllocationCheckpoint(str(tmp_path / "new.ckpt"))
+    gen_new = new.acquire_fence(client, NODE)
+    assert gen_new > old.generation
+    ann = api.nodes[NODE]["metadata"]["annotations"]
+    assert ann[const.ANN_FENCE_GENERATION].startswith(f"{gen_new}:")
+
+    # the old instance discovers it was superseded and refuses writes
+    assert not old.verify_fence(client, NODE)
+    assert old.fenced
+    with pytest.raises(StaleDaemonError):
+        old.begin(("default", "p"), {"kind": "mem", "idx": 0, "units": 1})
+    # the new instance keeps writing
+    assert new.verify_fence(client, NODE)
+    new.begin(("default", "p"), {"kind": "mem", "idx": 0, "units": 1})
+
+
+def test_fencing_equal_generation_foreign_token_fences(tmp_path, api):
+    """The non-CAS acquire race: two instances stamp the SAME generation;
+    the incarnation token breaks the tie — whoever PATCHed last owns the
+    node, the other observes a foreign token at its own generation and
+    fences instead of co-writing forever."""
+    client = ApiServerClient(api.url)
+    mine = AllocationCheckpoint(str(tmp_path / "mine.ckpt"))
+    mine.acquire_fence(client, NODE)
+    assert mine.verify_fence(client, NODE)
+    # the racing twin's PATCH lands last: same generation, its token
+    client.patch_node(NODE, {"metadata": {"annotations": {
+        const.ANN_FENCE_GENERATION: f"{mine.generation}:deadbeefcafe"
+    }}})
+    assert not mine.verify_fence(client, NODE)
+    with pytest.raises(StaleDaemonError):
+        mine.begin(("default", "p"), {"kind": "mem", "idx": 0, "units": 1})
+
+
+def test_resolve_seq_guard_protects_newer_begin(tmp_path):
+    """commit/abort with a seq only resolve the exact begin incarnation the
+    caller inspected — a reconciler racing a fresh same-key admission
+    cannot pop the new entry."""
+    ckpt = AllocationCheckpoint(str(tmp_path / "a.ckpt"))
+    key = ("default", "p")
+    ckpt.begin(key, {"kind": "mem", "idx": 0, "units": 2})
+    seq1 = ckpt.pending()[key]["_seq"]
+    assert ckpt.abort(key, seq=seq1)  # matching seq resolves
+    # a retried admission journals a NEW begin for the same key
+    ckpt.begin(key, {"kind": "mem", "idx": 1, "units": 2})
+    assert not ckpt.abort(key, seq=seq1)  # stale seq: refused
+    assert key in ckpt.pending()
+    assert ckpt.pending()[key]["idx"] == 1
+    assert ckpt.commit(key)  # unconditioned resolve still works
+
+
+def test_fencing_reacquire_unfences(tmp_path, api):
+    """A daemon that re-acquires (its own rebuild) goes back to writing —
+    only being *superseded* is terminal until the next acquire wins."""
+    client = ApiServerClient(api.url)
+    a = AllocationCheckpoint(str(tmp_path / "a.ckpt"))
+    a.acquire_fence(client, NODE)
+    b = AllocationCheckpoint(str(tmp_path / "b.ckpt"))
+    b.acquire_fence(client, NODE)
+    assert not a.verify_fence(client, NODE)
+    ga = a.acquire_fence(client, NODE)  # a rebuilds: takes ownership back
+    assert ga > b.generation
+    assert a.verify_fence(client, NODE)
+    assert not b.verify_fence(client, NODE)
+
+
+def test_fenced_allocator_refuses_admission(tmp_path, api):
+    """End to end: a stale daemon's ClusterAllocator fails admission with
+    a clear error instead of double-booking behind the new instance."""
+    from gpushare_device_plugin_tpu.allocator.cluster import (
+        AllocationFailure,
+        ClusterAllocator,
+    )
+    from gpushare_device_plugin_tpu.cluster.podsource import ApiServerPodSource
+    from gpushare_device_plugin_tpu.device import DeviceInventory
+    from gpushare_device_plugin_tpu.discovery import MockBackend
+
+    from k8s_fixtures import make_pod
+
+    client = ApiServerClient(api.url)
+    stale = AllocationCheckpoint(str(tmp_path / "stale.ckpt"))
+    stale.acquire_fence(client, NODE)
+    newer = AllocationCheckpoint(str(tmp_path / "newer.ckpt"))
+    newer.acquire_fence(client, NODE)
+    assert not stale.verify_fence(client, NODE)
+
+    api.add_pod(make_pod("victim", 2, node=NODE))
+    inv = DeviceInventory(MockBackend(num_chips=2, hbm_bytes=8 << 30).chips())
+    alloc = ClusterAllocator(
+        inv, client, ApiServerPodSource(client, NODE), NODE, checkpoint=stale
+    )
+    with pytest.raises(AllocationFailure, match="stale daemon"):
+        alloc.allocate([["g0", "g1"]])
+    # nothing was persisted by the fenced instance
+    ann = api.pods[("default", "victim")]["metadata"].get("annotations", {})
+    assert const.ENV_ASSIGNED_FLAG not in ann

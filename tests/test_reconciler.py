@@ -1,0 +1,243 @@
+"""Drift-reconciler unit tests (cluster/reconciler.py): orphan/redundant
+reservation repair, checkpoint resolution, TTL expiry, kubelet-grant
+diffing, fencing detection — with the repair metrics asserted."""
+
+import pytest
+
+from gpushare_device_plugin_tpu import const
+from gpushare_device_plugin_tpu.allocator.assume import AssumeCache
+from gpushare_device_plugin_tpu.allocator.checkpoint import AllocationCheckpoint
+from gpushare_device_plugin_tpu.cluster.apiserver import ApiServerClient
+from gpushare_device_plugin_tpu.cluster.podsource import ApiServerPodSource
+from gpushare_device_plugin_tpu.cluster.reconciler import (
+    DRIFT_METRIC,
+    REPAIR_METRIC,
+    DriftReconciler,
+)
+from gpushare_device_plugin_tpu.device import DeviceInventory
+from gpushare_device_plugin_tpu.discovery import MockBackend
+from gpushare_device_plugin_tpu.utils.metrics import REGISTRY
+
+from fake_apiserver import FakeApiServer
+from k8s_fixtures import assigned_running_pod, make_pod
+
+NODE = "node-rec"
+
+
+def counter(name, **labels):
+    return REGISTRY._counters.get((name, tuple(sorted(labels.items()))), 0.0)
+
+
+@pytest.fixture
+def api():
+    srv = FakeApiServer()
+    srv.add_node(NODE)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def make_reconciler(api_srv, assume, ckpt=None, **kw):
+    client = ApiServerClient(api_srv.url)
+    source = ApiServerPodSource(client, NODE)
+    return (
+        DriftReconciler(
+            api=client, pod_source=source, assume=assume, checkpoint=ckpt,
+            node_name=NODE, **kw,
+        ),
+        client,
+    )
+
+
+def test_orphan_reservation_released(api):
+    """A reservation whose pod was deleted mid-allocation (and whose owner
+    died before releasing) must not strand the chip."""
+    assume = AssumeCache()
+    assume.reserve_mem(("default", "ghost"), 0, 4)
+    rec, _ = make_reconciler(api, assume)
+    before = counter(REPAIR_METRIC, kind="orphan_reservation")
+    counts = rec.reconcile_once()
+    assert counts.get("orphan_reservation") == 1
+    assert counter(REPAIR_METRIC, kind="orphan_reservation") == before + 1
+    _claims, mem, core = assume.snapshot()
+    assert mem == {} and core == {}
+
+
+def test_redundant_reservation_released(api):
+    """A reservation whose pod is already assigned in annotations is
+    redundant (the source counts the pod) and gets dropped."""
+    api.add_pod(assigned_running_pod("done", 4, chip_idx=1, node=NODE))
+    assume = AssumeCache()
+    assume.reserve_mem(("default", "done"), 1, 4)
+    rec, _ = make_reconciler(api, assume)
+    counts = rec.reconcile_once()
+    assert counts.get("redundant_reservation") == 1
+    assert assume.snapshot()[1] == {}
+
+
+def test_claimed_reservation_is_not_touched(api):
+    """A claimed key is a live admission mid-PATCH — never drift."""
+    assume = AssumeCache()
+    key = ("default", "inflight")
+    assert assume.claim(key)
+    assume.reserve_mem(key, 0, 2)
+    rec, _ = make_reconciler(api, assume)
+    counts = rec.reconcile_once()
+    assert "orphan_reservation" not in counts
+    assert assume.snapshot()[1] == {key: (0, 2)}
+
+
+def test_release_if_unclaimed_is_atomic_guard():
+    """The reconciler's release primitive: a claim taken between its slow
+    apiserver GET and the release must win — the live worker keeps its
+    reservation (the pre-check/TOCTOU fix)."""
+    assume = AssumeCache()
+    key = ("default", "raced")
+    assume.reserve_mem(key, 0, 4)  # replay reservation, unclaimed
+    assert assume.claim(key)  # ...but a kubelet retry claims it mid-GET
+    assert not assume.release_if_unclaimed(key)
+    assert assume.snapshot()[1] == {key: (0, 4)}
+    assume.release(key)
+    assume.reserve_mem(key, 0, 4)
+    assert assume.release_if_unclaimed(key)  # truly unclaimed: released
+    assert assume.snapshot()[1] == {}
+
+
+def test_checkpoint_entry_committed_when_patch_landed(api, tmp_path):
+    """Crash after the PATCH but before the WAL commit: the reconciler
+    discovers the annotation and retro-commits the entry."""
+    api.add_pod(assigned_running_pod("won", 4, chip_idx=2, node=NODE))
+    ckpt = AllocationCheckpoint(str(tmp_path / "a.ckpt"))
+    ckpt.begin(("default", "won"), {"kind": "mem", "idx": 2, "units": 4})
+    assume = AssumeCache()
+    assume.reserve_mem(("default", "won"), 2, 4)  # the replay did this
+    rec, _ = make_reconciler(api, assume, ckpt=ckpt)
+    counts = rec.reconcile_once()
+    assert counts.get("replayed_commit") == 1
+    assert ckpt.pending() == {}
+    assert assume.snapshot()[1] == {}
+
+
+def test_checkpoint_entry_aborted_when_nothing_persisted(api, tmp_path):
+    """Crash after the WAL begin but before the PATCH: the pod is still
+    pending unassigned, so the entry retro-aborts and the reservation is
+    released — the kubelet retry re-places from scratch."""
+    api.add_pod(make_pod("lost", 4, node=NODE))
+    ckpt = AllocationCheckpoint(str(tmp_path / "a.ckpt"))
+    ckpt.begin(("default", "lost"), {"kind": "mem", "idx": 0, "units": 4})
+    assume = AssumeCache()
+    assume.reserve_mem(("default", "lost"), 0, 4)
+    rec, _ = make_reconciler(api, assume, ckpt=ckpt)
+    counts = rec.reconcile_once()
+    assert counts.get("replayed_abort") == 1
+    assert ckpt.pending() == {}
+    assert assume.snapshot()[1] == {}
+
+
+def test_ttl_expiry_unstrands_capacity(api):
+    """Satellite: a reservation whose owner hung forever is reaped by TTL
+    (both via the reconciler and lazily on the overlay read)."""
+    now = [0.0]
+    assume = AssumeCache(ttl_s=10.0, clock=lambda: now[0])
+    key = ("default", "hung")
+    assert assume.claim(key)
+    assume.reserve_mem(key, 0, 8)
+    now[0] = 5.0
+    mem_used, _ = assume.overlaid_state(lambda: ({}, set()))
+    assert mem_used == {0: 8}  # young: still protective
+    now[0] = 11.0
+    before = counter("tpushare_assume_expired_total", kind="claim")
+    rec, _ = make_reconciler(api, assume)
+    counts = rec.reconcile_once()
+    assert counts.get("expired_reservation", 0) >= 1
+    assert counter("tpushare_assume_expired_total", kind="claim") >= before + 1
+    mem_used, _ = assume.overlaid_state(lambda: ({}, set()))
+    assert mem_used == {}
+    # the key is claimable again — the pod can be re-admitted
+    assert assume.claim(key)
+
+
+def test_ttl_lazy_expiry_without_reconciler():
+    now = [0.0]
+    assume = AssumeCache(ttl_s=10.0, clock=lambda: now[0])
+    assume.reserve_core(("default", "hung"), [0, 1])
+    now[0] = 20.0
+    _, core_held = assume.overlaid_state(lambda: ({}, set()))
+    assert core_held == set()
+
+
+def test_kubelet_grants_diff(api):
+    """Assigned-in-annotations vs granted-by-kubelet divergence is counted
+    in both directions."""
+    api.add_pod(assigned_running_pod("known", 2, chip_idx=0, node=NODE))
+    api.add_pod(assigned_running_pod("unknown", 2, chip_idx=1, node=NODE))
+    grants = {
+        ("default", "known"): ["g0", "g1"],
+        ("default", "rogue"): ["g7"],  # kubelet granted, no annotation
+    }
+    assume = AssumeCache()
+    rec, _ = make_reconciler(api, assume, kubelet_grants_fn=lambda: grants)
+    before_u = counter(DRIFT_METRIC, kind="kubelet_unknown")
+    before_o = counter(DRIFT_METRIC, kind="kubelet_orphan")
+    counts = rec.reconcile_once()
+    assert counts.get("kubelet_unknown") == 1  # "unknown" pod
+    assert counts.get("kubelet_orphan") == 1  # "rogue" grant
+    assert counter(DRIFT_METRIC, kind="kubelet_unknown") == before_u + 1
+    assert counter(DRIFT_METRIC, kind="kubelet_orphan") == before_o + 1
+
+
+def test_annotation_audit_flags_garbled_and_overcommit(api):
+    api.add_pod(
+        make_pod(
+            "garbled", 2, node=NODE, phase="Running",
+            labels={const.LABEL_RESOURCE_KEY: const.LABEL_RESOURCE_VALUE},
+            annotations={const.ENV_ASSIGNED_FLAG: "true",
+                         const.ENV_MEM_IDX: "banana"},
+        )
+    )
+    api.add_pod(assigned_running_pod("whale", 50, chip_idx=0, node=NODE))
+    inv = DeviceInventory(MockBackend(num_chips=2, hbm_bytes=8 << 30).chips())
+    assume = AssumeCache()
+    rec, _ = make_reconciler(api, assume, inventory=inv)
+    counts = rec.reconcile_once()
+    assert counts.get("garbled_annotation") == 1
+    assert counts.get("overcommit") == 1  # 50 units on an 8-unit chip
+
+
+def test_fenced_instance_skips_repairs(api, tmp_path):
+    """A superseded daemon observes the fence and leaves repair to the new
+    owner — two reconcilers repairing one node would fight."""
+    client = ApiServerClient(api.url)
+    stale = AllocationCheckpoint(str(tmp_path / "stale.ckpt"))
+    stale.acquire_fence(client, NODE)
+    newer = AllocationCheckpoint(str(tmp_path / "newer.ckpt"))
+    newer.acquire_fence(client, NODE)
+
+    fenced_events = []
+    assume = AssumeCache()
+    assume.reserve_mem(("default", "ghost"), 0, 4)  # would-be repair
+    rec, _ = make_reconciler(
+        api, assume, ckpt=stale, on_fenced=lambda: fenced_events.append(1)
+    )
+    counts = rec.reconcile_once()
+    assert counts.get("fenced") == 1
+    assert fenced_events == [1]
+    assert stale.fenced
+    # no repair ran: the reservation is untouched
+    assert assume.snapshot()[1] == {("default", "ghost"): (0, 4)}
+
+
+def test_background_loop_runs_and_stops(api):
+    assume = AssumeCache()
+    assume.reserve_mem(("default", "ghost"), 0, 4)
+    rec, _ = make_reconciler(api, assume, interval_s=0.05)
+    rec.start()
+    try:
+        import time
+
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and assume.snapshot()[1]:
+            time.sleep(0.02)
+        assert assume.snapshot()[1] == {}
+    finally:
+        rec.stop()

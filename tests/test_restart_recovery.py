@@ -1,0 +1,532 @@
+"""Restart-recovery acceptance suite: kill the daemon at every journal
+step, restart it from the persisted artifacts only, and prove the state
+layer converges — zero double assignments, zero stranded reservations,
+ledger == annotations == kubelet grants after replay + one reconcile pass.
+
+A "crash" is a ``SimulatedCrash`` (BaseException) injected at a
+``crash_after`` fault point (utils/faults.py): every business-level
+handler is blind to it, so the file and apiserver are left exactly as a
+SIGKILL at that instruction would leave them. The "restart" constructs a
+second daemon's state — fresh AssumeCache, the checkpoint reloaded from
+the same path, ``replay_checkpoint``, one ``DriftReconciler`` pass — and
+then drives the kubelet-retry admissions to completion.
+
+Also covers the manager-level pieces: checkpoint replay through
+``TpuShareManager``, plugin-socket-vanish re-registration (the
+PluginDirWatcher), graceful drain on shutdown, and the extender's
+serve-from-checkpoint warmup.
+"""
+
+import os
+import threading
+import time
+
+import pytest
+
+from gpushare_device_plugin_tpu import const
+from gpushare_device_plugin_tpu.allocator.assume import AssumeCache
+from gpushare_device_plugin_tpu.allocator.checkpoint import (
+    AllocationCheckpoint,
+    replay_checkpoint,
+)
+from gpushare_device_plugin_tpu.allocator.cluster import (
+    AllocationFailure,
+    ClusterAllocator,
+    ClusterCoreAllocator,
+)
+from gpushare_device_plugin_tpu.cluster import pods as P
+from gpushare_device_plugin_tpu.cluster.apiserver import ApiServerClient
+from gpushare_device_plugin_tpu.cluster.podsource import ApiServerPodSource
+from gpushare_device_plugin_tpu.cluster.reconciler import DriftReconciler
+from gpushare_device_plugin_tpu.device import DeviceInventory
+from gpushare_device_plugin_tpu.discovery import MockBackend
+from gpushare_device_plugin_tpu.utils.faults import FAULTS, SimulatedCrash
+
+from fake_apiserver import FakeApiServer
+from k8s_fixtures import make_pod
+
+NODE = "node-crash"
+
+# Every boundary the WAL defines, in flow order. None = the control run.
+CRASH_SITES = [
+    None,
+    "checkpoint.begin",  # begin durable, PATCH never left the node
+    "allocator.post_persist",  # PATCH landed, commit record never written
+    "checkpoint.commit",  # fully committed, claim release never ran
+]
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    FAULTS.clear()
+    yield
+    FAULTS.clear()
+
+
+@pytest.fixture
+def api():
+    srv = FakeApiServer()
+    srv.add_node(NODE)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def granted(n, prefix="fake"):
+    return [[f"{prefix}-{i}" for i in range(n)]]
+
+
+def assigned_pods(api):
+    """{key: (chip idx, units)} straight from apiserver annotations."""
+    out = {}
+    for key, pod in api.pods.items():
+        if not P.is_active(pod) or not P.is_assigned(pod):
+            continue
+        out[key] = (P.chip_idx_from_annotation(pod), P.mem_units_of_pod(pod))
+    return out
+
+
+def audit_no_overcommit(api, inv):
+    used = {}
+    for _key, (idx, units) in assigned_pods(api).items():
+        assert idx >= 0, "assigned pod with garbled chip index"
+        used[idx] = used.get(idx, 0) + units
+    for idx, n in used.items():
+        cap = inv.units_by_index()[idx]
+        assert n <= cap, f"chip {idx} double-booked: {n} > {cap} units"
+
+
+@pytest.mark.parametrize("site", CRASH_SITES)
+def test_kill_at_every_journal_step_mem(site, api, tmp_path):
+    """The acceptance criterion: after replay + one reconcile pass the
+    ledger equals the annotations equals the kubelet grants, with zero
+    double assignments and zero stranded reservations — for a crash at
+    each journal boundary."""
+    path = str(tmp_path / "wal.ckpt")
+    client = ApiServerClient(api.url)
+    source = ApiServerPodSource(client, NODE)
+    # 2 chips x 8 units; 6-unit pods so a double-booked chip is provable
+    # (6 + 6 > 8) rather than coincidentally legal.
+    inv = DeviceInventory(MockBackend(num_chips=2, hbm_bytes=8 << 30).chips())
+    api.add_pod(make_pod("victim", 6, node=NODE, created="2026-01-01T00:00:00Z"))
+    api.add_pod(make_pod("bystander", 6, node=NODE, created="2026-01-02T00:00:00Z"))
+
+    # kubelet's view: a grant exists iff an Allocate response arrived
+    grants: dict[tuple, list[str]] = {}
+
+    def allocate_and_record(alloc, units):
+        before = set(assigned_pods(api))
+        alloc.allocate(granted(units))
+        newly = set(assigned_pods(api)) - before
+        assert len(newly) == 1
+        grants[newly.pop()] = granted(units)[0]
+
+    # --- incarnation 1: dies (or not) mid-admission -----------------------
+    ckpt1 = AllocationCheckpoint(path)
+    alloc1 = ClusterAllocator(
+        inv, client, source, NODE, assume=AssumeCache(), checkpoint=ckpt1
+    )
+    if site is None:
+        allocate_and_record(alloc1, 6)
+    else:
+        with FAULTS.injected(site, "crash", times=1):
+            with pytest.raises(SimulatedCrash):
+                alloc1.allocate(granted(6))
+        # the response never reached kubelet: no grant recorded
+
+    # --- incarnation 2: restart from the persisted artifacts only ---------
+    ckpt2 = AllocationCheckpoint(path)
+    assume2 = AssumeCache()
+    replay_checkpoint(ckpt2, assume2)
+    reconciler = DriftReconciler(
+        api=client,
+        pod_source=source,
+        assume=assume2,
+        checkpoint=ckpt2,
+        node_name=NODE,
+        inventory=inv,
+        kubelet_grants_fn=lambda: dict(grants),
+    )
+    drift = reconciler.reconcile_once()
+    alloc2 = ClusterAllocator(
+        inv, client, source, NODE, assume=assume2, checkpoint=ckpt2
+    )
+
+    # zero stranded reservations, nothing left unresolved in the journal
+    claims, mem, core = assume2.snapshot()
+    assert claims == {} and mem == {} and core == {}
+    assert ckpt2.pending() == {}
+
+    victim_assigned = ("default", "victim") in assigned_pods(api)
+    if site in ("allocator.post_persist", "checkpoint.commit"):
+        assert victim_assigned, "PATCH landed before the crash"
+        if site == "allocator.post_persist":
+            # the mid-window entry was resolved by discovery, not rollback
+            assert drift.get("replayed_commit") == 1
+    elif site == "checkpoint.begin":
+        assert not victim_assigned, "begin is durable but the PATCH never left"
+        assert drift.get("replayed_abort") == 1
+
+    if victim_assigned and ("default", "victim") not in grants:
+        # annotations say assigned but kubelet never completed the grant —
+        # the reconciler must surface exactly that divergence...
+        assert reconciler.reconcile_once().get("kubelet_unknown") == 1
+        # ...and the real-world resolution is the failed admission's pod
+        # being recreated by its controller:
+        api.delete_pod("default", "victim")
+        api.add_pod(
+            make_pod("victim-r", 6, node=NODE, created="2026-01-03T00:00:00Z")
+        )
+
+    # kubelet retries every admission that never completed
+    for _ in range(2):
+        pending = [
+            p
+            for p in source.pending_share_pods(const.RESOURCE_MEM)
+            if not P.is_assigned(p)
+        ]
+        if not pending:
+            break
+        allocate_and_record(alloc2, 6)
+
+    # --- the convergence criterion ----------------------------------------
+    final = assigned_pods(api)
+    assert len(final) == 2  # every pod assigned exactly once
+    audit_no_overcommit(api, inv)
+    assert set(final) == set(grants), "annotations and kubelet grants diverge"
+    claims, mem, core = assume2.snapshot()
+    assert claims == {} and mem == {} and core == {}  # ledger drained
+    assert ckpt2.pending() == {}
+    assert reconciler.reconcile_once() == {}  # steady state: no drift left
+
+
+@pytest.mark.parametrize("site", ["checkpoint.begin", "allocator.post_persist"])
+def test_kill_and_restart_core_resource(site, api, tmp_path):
+    """Same discipline for whole-chip (tpu-core) admissions: the replayed
+    core reservation must keep the crashed grant's chips out of the mem
+    binpack until the reconciler resolves it, and retry must converge."""
+    path = str(tmp_path / "wal.ckpt")
+    client = ApiServerClient(api.url)
+    source = ApiServerPodSource(client, NODE)
+    inv = DeviceInventory(MockBackend(num_chips=2, hbm_bytes=8 << 30).chips())
+    chip_ids = [c.id for c in inv.chips()]
+    api.add_pod(make_pod("exclusive", tpu_core=1, node=NODE))
+
+    ckpt1 = AllocationCheckpoint(path)
+    core1 = ClusterCoreAllocator(
+        inv, client, source, NODE, assume=AssumeCache(), checkpoint=ckpt1
+    )
+    with FAULTS.injected(site, "crash", times=1):
+        with pytest.raises(SimulatedCrash):
+            core1.allocate([[chip_ids[0]]])
+
+    ckpt2 = AllocationCheckpoint(path)
+    assume2 = AssumeCache()
+    assert replay_checkpoint(ckpt2, assume2) == 1
+    # pre-reconcile: the in-flight core hold shadows chip 0 for mem binpack
+    _, core_held = assume2.overlaid_state(source.chip_state)
+    assert core_held == {0}
+
+    DriftReconciler(
+        api=client, pod_source=source, assume=assume2, checkpoint=ckpt2,
+        node_name=NODE,
+    ).reconcile_once()
+    assert ckpt2.pending() == {}
+    assert assume2.snapshot()[2] == {}
+
+    exclusive_assigned = P.is_assigned(api.pods[("default", "exclusive")])
+    if site == "allocator.post_persist":
+        # the crashed PATCH landed: the hold is in annotations now
+        assert exclusive_assigned
+        assert source.chip_state()[1] == {0}
+    else:
+        assert not exclusive_assigned
+        core2 = ClusterCoreAllocator(
+            inv, client, source, NODE, assume=assume2, checkpoint=ckpt2
+        )
+        core2.allocate([[chip_ids[0]]])  # the kubelet retry
+        assert source.chip_state()[1] == {0}
+
+
+def test_replayed_reservation_blocks_double_booking_before_reconcile(api, tmp_path):
+    """The window the WAL exists for: the crashed PATCH landed but the
+    restarted daemon's pod source has not caught up. The replayed
+    reservation must keep a concurrent admission off the chip capacity the
+    invisible pod holds."""
+    path = str(tmp_path / "wal.ckpt")
+    client = ApiServerClient(api.url)
+    inv = DeviceInventory(MockBackend(num_chips=2, hbm_bytes=8 << 30).chips())
+
+    ckpt1 = AllocationCheckpoint(path)
+    ckpt1.begin(("default", "invisible"), {"kind": "mem", "idx": 0, "units": 6})
+    ckpt1.close()  # crashed mid-window
+
+    class StaleSource(ApiServerPodSource):
+        """A pod source that (like a cold informer) does not yet see the
+        crashed pod's PATCH."""
+
+        def chip_state(self):
+            return {}, set()
+
+    source = StaleSource(client, NODE)
+    ckpt2 = AllocationCheckpoint(path)
+    assume2 = AssumeCache()
+    replay_checkpoint(ckpt2, assume2)
+
+    api.add_pod(make_pod("newcomer", 6, node=NODE))
+    alloc2 = ClusterAllocator(
+        inv, client, source, NODE, assume=assume2, checkpoint=ckpt2
+    )
+    res = alloc2.allocate(granted(6))
+    # chip 0 carries the replayed 6-unit reservation: 6+6 > 8 forces chip 1
+    assert res[0].envs[const.ENV_TPU_VISIBLE_CHIPS] == "1"
+
+
+# --- manager-level recovery -------------------------------------------------
+
+
+def run_manager_bg(manager):
+    t = threading.Thread(target=manager.run, daemon=True)
+    t.start()
+    return t
+
+
+def wait_until(cond, timeout=10.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return cond()
+
+
+def test_manager_replays_and_reconciler_resolves(api, tmp_path):
+    """Full assembly: a checkpoint left behind by a dead incarnation is
+    replayed at manager start and resolved by the manager's own
+    reconciler; the fencing generation lands on the node annotation."""
+    from gpushare_device_plugin_tpu.manager import ManagerConfig, TpuShareManager
+
+    from fake_kubelet import FakeKubelet
+
+    ckpt_path = str(tmp_path / "wal.ckpt")
+    stale = AllocationCheckpoint(ckpt_path)
+    stale.begin(("default", "orphan"), {"kind": "mem", "idx": 0, "units": 4})
+    stale.close()  # the previous daemon died here
+
+    kubelet = FakeKubelet(str(tmp_path / "plugins"))
+    kubelet.start()
+    client = ApiServerClient(api.url)
+    manager = TpuShareManager(
+        MockBackend(num_chips=4, hbm_bytes=32 << 30),
+        ManagerConfig(
+            plugin_dir=str(tmp_path / "plugins"),
+            node_name=NODE,
+            checkpoint_path=ckpt_path,
+            reconcile_interval_s=0.1,
+        ),
+        api_client=client,
+        pod_source=ApiServerPodSource(client, NODE),
+    )
+    t = run_manager_bg(manager)
+    try:
+        for _ in range(2):
+            kubelet.wait_for_registration()
+        # fencing generation stamped on the node, newer than the dead one's
+        ann = api.nodes[NODE]["metadata"].get("annotations", {})
+        node_gen = int(ann[const.ANN_FENCE_GENERATION].partition(":")[0])
+        assert node_gen > stale.generation
+        # the orphan entry (pod never existed -> nothing persisted) is
+        # resolved by the reconciler's first passes
+        assert wait_until(lambda: manager._ckpt.pending() == {}, timeout=10)
+        claims, mem, core = manager._alloc_assume.snapshot()
+        assert mem == {} and core == {}
+    finally:
+        manager.trigger_stop("test")
+        t.join(timeout=5)
+        kubelet.stop()
+
+
+def test_plugin_socket_vanish_triggers_reregistration(api, tmp_path):
+    """Tentpole: socket-dir watching. kubelet wiping a plugin socket
+    without touching kubelet.sock silently unregisters the plugin; the
+    PluginDirWatcher must notice and rebuild + re-register."""
+    from gpushare_device_plugin_tpu.manager import ManagerConfig, TpuShareManager
+
+    from fake_kubelet import FakeKubelet
+
+    plugin_dir = str(tmp_path / "plugins")
+    kubelet = FakeKubelet(plugin_dir)
+    kubelet.start()
+    client = ApiServerClient(api.url)
+    manager = TpuShareManager(
+        MockBackend(num_chips=2, hbm_bytes=8 << 30),
+        ManagerConfig(plugin_dir=plugin_dir, node_name=NODE),
+        api_client=client,
+        pod_source=ApiServerPodSource(client, NODE),
+    )
+    t = run_manager_bg(manager)
+    try:
+        first = {kubelet.wait_for_registration().resource_name for _ in range(2)}
+        assert first == {const.RESOURCE_MEM, const.RESOURCE_CORE}
+        # kubelet cleanup deletes our socket; kubelet.sock keeps its inode
+        os.unlink(os.path.join(plugin_dir, const.MEM_SOCKET_NAME))
+        second = {
+            kubelet.wait_for_registration(timeout=15).resource_name
+            for _ in range(2)
+        }
+        assert second == {const.RESOURCE_MEM, const.RESOURCE_CORE}
+        assert os.path.exists(os.path.join(plugin_dir, const.MEM_SOCKET_NAME))
+    finally:
+        manager.trigger_stop("test")
+        t.join(timeout=5)
+        kubelet.stop()
+
+
+def test_graceful_drain_finishes_inflight_allocate(tmp_path):
+    """Satellite: shutdown drains in-flight Allocate calls — the slow
+    admission completes (its PATCH/journal included) while new admissions
+    are refused, then the socket closes."""
+    import grpc
+
+    from gpushare_device_plugin_tpu.device.fanout import DeviceInventory as Inv
+    from gpushare_device_plugin_tpu.plugin.server import PluginConfig, TpuSharePlugin
+
+    from fake_kubelet import FakeKubelet
+
+    plugin_dir = str(tmp_path / "plugins")
+    kubelet = FakeKubelet(plugin_dir)
+    kubelet.start()
+    inv = Inv(MockBackend(num_chips=1, hbm_bytes=4 << 30).chips())
+
+    entered = threading.Event()
+    release = threading.Event()
+    finished = []
+
+    def slow_allocate(granted_ids):
+        entered.set()
+        release.wait(5)
+        finished.append(len(granted_ids))
+        from gpushare_device_plugin_tpu.allocator.env import build_mem_allocation
+
+        chip = inv.chips()[0]
+        return [
+            build_mem_allocation(
+                chip=chip, chip_total_units=4, pod_units=1, container_units=1
+            )
+        ]
+
+    plugin = TpuSharePlugin(
+        inv,
+        allocate_fn=slow_allocate,
+        config=PluginConfig(plugin_dir=plugin_dir),
+    )
+    plugin.serve()
+    try:
+        result = {}
+
+        def call():
+            try:
+                result["resp"] = kubelet.allocate(
+                    plugin._cfg.socket_name, [["g0"]]
+                )
+            except Exception as e:  # noqa: BLE001
+                result["err"] = e
+
+        caller = threading.Thread(target=call, daemon=True)
+        caller.start()
+        assert entered.wait(5)
+
+        # drain in a thread: it must block on the in-flight call
+        drained = []
+        drainer = threading.Thread(
+            target=lambda: drained.append(plugin.drain(timeout_s=5)), daemon=True
+        )
+        drainer.start()
+        time.sleep(0.2)
+        assert not drained  # still waiting on the slow admission
+
+        # a NEW admission during drain is refused, not queued
+        with pytest.raises(grpc.RpcError) as ei:
+            kubelet.allocate(plugin._cfg.socket_name, [["g1"]])
+        assert ei.value.code() == grpc.StatusCode.UNAVAILABLE
+
+        release.set()
+        caller.join(timeout=5)
+        drainer.join(timeout=5)
+        assert drained == [True]
+        assert "resp" in result, f"in-flight Allocate failed: {result.get('err')}"
+        assert finished == [1]
+    finally:
+        plugin.stop()
+        kubelet.stop()
+
+
+def test_extender_warmup_ages_out_stale_entries(api, tmp_path):
+    """A WAL entry surviving from an old crash cycle (older than the
+    in-flight TTL) is resolved at load, not replayed as phantom capacity
+    on every restart forever."""
+    from gpushare_device_plugin_tpu.extender.server import ExtenderCore
+
+    client = ApiServerClient(api.url)
+    ckpt_path = str(tmp_path / "bind.ckpt")
+    dead = AllocationCheckpoint(ckpt_path)
+    dead.begin(("default", "ancient"), {
+        "node": "n", "resource": const.RESOURCE_MEM, "idx": 0, "units": 4,
+        "ts": time.time() - 3600,  # an hour old: far past the 60 s TTL
+    })
+    dead.close()
+
+    warmed_ckpt = AllocationCheckpoint(ckpt_path)
+    core = ExtenderCore(client, checkpoint=warmed_ckpt)
+    assert core._live_inflight() == {}  # not seeded
+    assert warmed_ckpt.pending() == {}  # and resolved on disk
+    # a third incarnation no longer sees it at all
+    assert AllocationCheckpoint(ckpt_path).pending() == {}
+
+
+def test_extender_warmup_serves_from_checkpoint(api, tmp_path):
+    """Tentpole: a restarted extender seeds its in-flight overlay from the
+    bind WAL, so a chip whose bind PATCH is not yet visible on the watch
+    is not double-booked during the cold-start window."""
+    from gpushare_device_plugin_tpu.cluster.informer import PodInformer
+    from gpushare_device_plugin_tpu.extender.server import ExtenderCore
+
+    api.add_node(
+        "ext-node",
+        capacity={const.RESOURCE_COUNT: "1", const.RESOURCE_MEM: "8"},
+    )
+    client = ApiServerClient(api.url)
+
+    # the dead extender journaled a bind of 6 units onto chip 0 and died
+    # with that PATCH not yet visible anywhere (not even on the watch)
+    ckpt_path = str(tmp_path / "bind.ckpt")
+    dead = AllocationCheckpoint(ckpt_path)
+    dead.begin(("default", "bound-pod"), {
+        "node": "ext-node", "resource": const.RESOURCE_MEM, "idx": 0,
+        "units": 6,
+        "annotations": {const.ENV_MEM_IDX: "0", const.ENV_ASSUME_TIME: "1"},
+    })
+    dead.close()
+
+    informer = PodInformer(client).start(sync_timeout_s=5)
+    try:
+        warmed = ExtenderCore(
+            client, informer=informer,
+            checkpoint=AllocationCheckpoint(ckpt_path),
+        )
+        amnesiac = ExtenderCore(client, informer=informer)  # no WAL: forgot
+
+        next_pod = make_pod("next-pod", 6, node="")
+        args = {
+            "pod": next_pod,
+            "nodes": {"items": [client.get_node("ext-node")]},
+        }
+        # the amnesiac extender would bind a second 6-unit pod onto the
+        # 8-unit chip the invisible decision already half-filled...
+        assert amnesiac.filter(args)["nodenames"] == ["ext-node"]
+        # ...the warmed one knows 6 of 8 units are spoken for: 6+6 > 8
+        result = warmed.filter(args)
+        assert result["nodenames"] == []
+        assert "ext-node" in result["failedNodes"]
+    finally:
+        informer.stop()
