@@ -1,7 +1,7 @@
 PY ?= python
 PROTOC ?= protoc
 
-.PHONY: proto native test test-fast test-slow test-stress chaos chaos-restart lint bench bench-smoke e2e-kind
+.PHONY: proto native test test-fast test-slow test-stress chaos chaos-restart lint bench bench-smoke bench-wal e2e-kind
 
 # Regenerate protobuf message classes (gRPC bindings are hand-written in
 # gpushare_device_plugin_tpu/plugin/api/api_grpc.py; grpc_tools is not
@@ -53,7 +53,7 @@ chaos:
 # runs inside tier-1 ('not slow'); this target runs it alone.
 chaos-restart:
 	$(PY) -m pytest tests/test_restart_recovery.py tests/test_checkpoint.py \
-	  tests/test_reconciler.py -x -q
+	  tests/test_reconciler.py tests/test_wal_groupcommit.py -x -q
 
 # kind end-to-end: deploy the manifests with mock discovery on a local kind
 # cluster and assert the demo pod admits with TPU_VISIBLE_CHIPS injected
@@ -74,6 +74,13 @@ bench:
 # tier-1 runs via tests/test_bench_smoke.py. See docs/perf.md.
 bench-smoke:
 	$(PY) bench.py --smoke
+
+# Group-commit WAL A/B: the 16-way admission storm with the journal in
+# per-record-fsync ('always') then group-commit ('batch') mode. Reports
+# throughput, fsyncs-per-admission, batch-size mean, and the PATCH-
+# coalescing ratio for both. See docs/perf.md.
+bench-wal:
+	$(PY) bench.py --wal-bench --workers 16
 
 # Full on-chip compute capture: decode/train/flash/serve plus the step-
 # time ablation and the flash block-size sweep (real TPU required; off

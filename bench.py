@@ -18,7 +18,15 @@ Control-plane half, three sections:
   The lock-sharded allocator overlaps their apiserver PATCHes; the section
   verifies zero double-assignments / no chip over-commit after every storm
   and reports aggregate pods/s plus the speedup over this run's serial
-  throughput.
+  throughput. The storm runs with the crash-safe WAL **on** (group-commit
+  ``batch`` mode by default; ``--wal-fsync`` picks ``always``/``off``) and
+  the coalesced PATCH pipeline wired in, and reports
+  ``wal_fsyncs_per_admission``, the fsync p99, and the PATCH-coalescing
+  ratio. The serial section stays WAL-free — its p50 is the long-lived
+  trend-guard series and must compare like-for-like with the committed
+  history. ``--wal-bench`` runs ONLY the storm, once per WAL mode
+  (``always`` then ``batch``), and emits a comparison record
+  (``make bench-wal``).
 - **Extender**: a multi-node scoring benchmark — cluster-wide informer
   over hundreds of placed pods, batched filter+prioritize over the node
   list, p50 per scheduling decision (index + NodeView cache hot).
@@ -169,11 +177,35 @@ def run_allocate_trial(
     return latencies, fill_wall, 100.0 * peak_used / total_units
 
 
+def _wal_metrics_snapshot(wal_mode: str) -> dict:
+    """Cumulative WAL/PATCH instrumentation counters from the process-wide
+    registry; the storm reports deltas across its run."""
+    from gpushare_device_plugin_tpu.allocator import checkpoint as ckpt_mod
+    from gpushare_device_plugin_tpu.cluster import apiserver as api_mod
+    from gpushare_device_plugin_tpu.utils.metrics import REGISTRY
+
+    fsyncs, _ = REGISTRY.histogram_stats(ckpt_mod.FSYNC_SECONDS, mode=wal_mode)
+    _batches, records = REGISTRY.histogram_stats(
+        ckpt_mod.BATCH_RECORDS, mode=wal_mode
+    )
+    patch_batches, patches = REGISTRY.histogram_stats(
+        api_mod.PATCH_BATCH_RECORDS, kind="pod"
+    )
+    return {
+        "fsyncs": fsyncs,
+        "wal_records": records,
+        "patch_batches": patch_batches,
+        "patches": patches,
+    }
+
+
 def run_concurrent_trial(
     workers: int,
     rounds: int = CONCURRENT_ROUNDS,
     pod_units: int = CONCURRENT_POD_UNITS,
     pods_per_round: int | None = None,
+    wal_mode: str = "batch",
+    wal_window_s: float = 0.002,
 ) -> dict:
     """Concurrent-admission storm: ``workers`` threads drive Allocate()
     through the real gRPC socket against a shared pool of same-size
@@ -203,7 +235,28 @@ def run_concurrent_trial(
     client = ApiServerClient(api.url)
     inv = DeviceInventory(MockBackend(num_chips=CHIPS, hbm_bytes=HBM_GIB << 30).chips())
     informer = PodInformer(client, NODE).start()
-    allocator = ClusterAllocator(inv, client, informer, NODE)
+    # The storm runs the full crash-safe + coalesced write stack — the WAL
+    # (group-commit or always-fsync per wal_mode) plus the pipelined PATCH
+    # dispatcher — i.e. the configuration a production daemon ships with.
+    # The serial section stays WAL-free for trend-guard parity.
+    ckpt = None
+    if wal_mode != "off":
+        from gpushare_device_plugin_tpu.allocator.checkpoint import (
+            AllocationCheckpoint,
+        )
+
+        ckpt = AllocationCheckpoint(
+            os.path.join(tmp, "wal.ckpt"), fsync=wal_mode,
+            batch_window_s=wal_window_s,
+        )
+    from gpushare_device_plugin_tpu.cluster.apiserver import PodPatchPipeline
+
+    pipeline = PodPatchPipeline(client)
+    metrics_before = _wal_metrics_snapshot(wal_mode)
+    allocator = ClusterAllocator(
+        inv, client, informer, NODE,
+        checkpoint=ckpt, patcher=pipeline.patch_pod,
+    )
     plugin = TpuSharePlugin(
         inv,
         allocate_fn=allocator.allocate,
@@ -236,9 +289,46 @@ def run_concurrent_trial(
         plugin.stop()
         kubelet.stop()
         informer.stop()
+        pipeline.stop()
+        if ckpt is not None:
+            ckpt.close()
         api.stop()
+
+    # WAL + PATCH-coalescing instrumentation over the whole storm (warmup
+    # round included — the counters span every admission of this trial)
+    after = _wal_metrics_snapshot(wal_mode)
+    delta = {k: after[k] - metrics_before[k] for k in after}
+    admissions = pods_per_round * rounds
+    wal_stats: dict = {"wal_mode": wal_mode}
+    if wal_mode != "off":
+        wal_stats["wal_window_ms"] = round(wal_window_s * 1e3, 1)
+    if wal_mode != "off" and admissions:
+        from gpushare_device_plugin_tpu.allocator import checkpoint as ckpt_mod
+        from gpushare_device_plugin_tpu.utils.metrics import REGISTRY
+
+        p99_s = REGISTRY.histogram_quantile(
+            ckpt_mod.FSYNC_SECONDS, 0.99, mode=wal_mode
+        )
+        wal_stats.update({
+            "wal_fsyncs_per_admission": round(delta["fsyncs"] / admissions, 3),
+            "wal_fsync_p99_ms": (
+                round(p99_s * 1e3, 3) if p99_s is not None else None
+            ),
+            "wal_batch_mean": (
+                round(delta["wal_records"] / delta["fsyncs"], 2)
+                if delta["fsyncs"] else None
+            ),
+        })
+    patch_coalesce_ratio = (
+        round(1.0 - delta["patch_batches"] / delta["patches"], 3)
+        if delta["patches"] else None
+    )
     return {
         "workers": workers,
+        **wal_stats,
+        # fraction of pod PATCHes that shared a dispatch batch with at
+        # least one other (1 - batches/patches; 0 = fully sequential)
+        "patch_coalesce_ratio": patch_coalesce_ratio,
         # Thread concurrency buys wall-clock only where admission waits
         # (apiserver RTT) rather than computes; the speedup is therefore
         # core-count-bound on CPU-starved hosts. Recorded so a reader can
@@ -513,6 +603,42 @@ def utilization_guard(util_pct: float, repo: Path) -> str | None:
     return None
 
 
+def wal_fsync_guard(fsyncs_per_admission: float | None, repo: Path) -> str | None:
+    """Failure message when ``wal_fsyncs_per_admission`` regressed (grew)
+    >P99_GUARD_PCT vs the newest committed record carrying it — group
+    commit's amortization must not silently erode back toward
+    one-fsync-per-record; None when within budget or no history."""
+    if fsyncs_per_admission is None:
+        return None
+    prev = previous_metric(repo, "wal_fsyncs_per_admission")
+    if prev is None:
+        return None
+    prev_val, fname = prev
+    if fsyncs_per_admission > prev_val * (1 + P99_GUARD_PCT / 100.0):
+        return (
+            f"TREND GUARD: wal_fsyncs_per_admission {fsyncs_per_admission:.3f} "
+            f"regressed >{P99_GUARD_PCT:.0f}% vs {fname} ({prev_val:.3f})"
+        )
+    return None
+
+
+def wal_fsync_p99_guard(p99_ms: float | None, repo: Path) -> str | None:
+    """Same budget for the fsync latency tail: a batch that grows cheap in
+    count but expensive per sync is still a regression."""
+    if p99_ms is None:
+        return None
+    prev = previous_metric(repo, "wal_fsync_p99_ms")
+    if prev is None:
+        return None
+    prev_val, fname = prev
+    if p99_ms > prev_val * (1 + P99_GUARD_PCT / 100.0):
+        return (
+            f"TREND GUARD: wal_fsync_p99 {p99_ms:.3f}ms regressed "
+            f">{P99_GUARD_PCT:.0f}% vs {fname} ({prev_val:.3f}ms)"
+        )
+    return None
+
+
 def run_compute_bench(repo: Path) -> dict:
     """bench_mfu.py in a subprocess; {} on any failure (never fatal here).
 
@@ -565,12 +691,66 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--no-util-guard", action="store_true")
     p.add_argument("--no-extender", action="store_true",
                    help="skip the multi-node extender scoring section")
+    p.add_argument("--wal-fsync", default="batch",
+                   choices=["batch", "always", "off"],
+                   help="WAL mode for the concurrent storm: group-commit "
+                   "batch (default), per-record always, or off (no "
+                   "journal; the coalesced PATCH pipeline stays on in "
+                   "every mode — 'off' isolates the WAL's cost, not "
+                   "this round's whole write stack)")
+    p.add_argument("--wal-bench", action="store_true",
+                   help="run ONLY the concurrent storm, once per WAL mode "
+                   "(always then batch), and emit a comparison record "
+                   "(make bench-wal)")
+    p.add_argument("--wal-window-ms", type=float, default=8.0,
+                   help="group-commit gather window for the storm's WAL "
+                   "(the --wal-batch-window-ms daemon tunable). The storm "
+                   "default is wider than the daemon's 2 ms: a throughput "
+                   "storm trades per-record latency for amortization, and "
+                   "the window is invisible in wall clock because the "
+                   "waits overlap across workers")
     return p.parse_args(argv)
+
+
+def run_wal_bench(
+    workers: int, rounds: int = CONCURRENT_ROUNDS,
+    wal_window_s: float = 0.002,
+) -> int:
+    """A/B the group-commit WAL under an admission storm: same storm, WAL
+    in ``always`` then ``batch`` mode. Emits one JSON line; nonzero only
+    if a storm audit fails (those raise)."""
+    record = {
+        "metric": "wal_groupcommit", "workers": workers,
+        "wal_window_ms": wal_window_s * 1e3,
+    }
+    for mode in ("always", "batch"):
+        trial = run_concurrent_trial(
+            workers, rounds=rounds, wal_mode=mode, wal_window_s=wal_window_s
+        )
+        record[mode] = trial
+        print(
+            f"wal={mode}: throughput={trial['throughput_pods_s']:.1f} pods/s "
+            f"p50={trial['p50_ms']}ms "
+            f"fsyncs/admission={trial.get('wal_fsyncs_per_admission')} "
+            f"batch_mean={trial.get('wal_batch_mean')} "
+            f"patch_coalesce_ratio={trial.get('patch_coalesce_ratio')}",
+            file=sys.stderr,
+        )
+    always_tput = record["always"].get("throughput_pods_s") or 0
+    batch_tput = record["batch"].get("throughput_pods_s") or 0
+    if always_tput:
+        record["batch_speedup_vs_always"] = round(batch_tput / always_tput, 2)
+    print(json.dumps(record))
+    return 0
 
 
 def main(argv=None) -> int:
     args = parse_args(argv)
     repo = Path(__file__).resolve().parent
+    if args.wal_bench:
+        return run_wal_bench(
+            max(1, args.workers), wal_window_s=args.wal_window_ms / 1000.0
+        )
     if args.smoke:
         args.no_mfu = True
         args.no_trend_guard = True
@@ -617,16 +797,20 @@ def main(argv=None) -> int:
             args.workers,
             rounds=2 if args.smoke else CONCURRENT_ROUNDS,
             pod_units=16 if args.smoke else CONCURRENT_POD_UNITS,
+            wal_mode=args.wal_fsync,
+            wal_window_s=args.wal_window_ms / 1000.0,
         )
         if serial_pods_s > 0 and concurrent.get("throughput_pods_s"):
             concurrent["speedup_vs_serial"] = round(
                 concurrent["throughput_pods_s"] / serial_pods_s, 2
             )
         print(
-            f"concurrent (workers={args.workers}): "
+            f"concurrent (workers={args.workers}, wal={args.wal_fsync}): "
             f"throughput={concurrent['throughput_pods_s']:.1f} pods/s "
             f"(x{concurrent.get('speedup_vs_serial', 0)} vs serial) "
             f"p50={concurrent['p50_ms']}ms "
+            f"fsyncs/admission={concurrent.get('wal_fsyncs_per_admission')} "
+            f"patch_coalesce_ratio={concurrent.get('patch_coalesce_ratio')} "
             f"double_assignments={concurrent['double_assignments']}",
             file=sys.stderr,
         )
@@ -668,6 +852,11 @@ def main(argv=None) -> int:
         # regression.
         "binpack_utilization_pct": round(max(utils), 1),
         "trials": trials,
+        # WAL group-commit numbers, hoisted top-level so previous_metric /
+        # the trend guards can read them like every other headline field.
+        "wal_fsyncs_per_admission": concurrent.get("wal_fsyncs_per_admission"),
+        "wal_fsync_p99_ms": concurrent.get("wal_fsync_p99_ms"),
+        "patch_coalesce_ratio": concurrent.get("patch_coalesce_ratio"),
         "concurrent": concurrent,
         "extender": extender,
         "compute": compute,
@@ -680,6 +869,8 @@ def main(argv=None) -> int:
     if not args.no_trend_guard:
         msgs.append(trend_guard(p50, repo))
         msgs.append(p99_guard(p99, repo))
+        msgs.append(wal_fsync_guard(record["wal_fsyncs_per_admission"], repo))
+        msgs.append(wal_fsync_p99_guard(record["wal_fsync_p99_ms"], repo))
     if not args.no_util_guard:
         msgs.append(utilization_guard(record["binpack_utilization_pct"], repo))
     failed = [m for m in msgs if m is not None]

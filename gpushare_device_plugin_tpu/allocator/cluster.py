@@ -161,10 +161,17 @@ def persist_pod_assignment(
     pod,
     annotations: dict[str, str],
     label_value: str,
+    patch_fn=None,
 ) -> None:
     """Label + annotation strategic-merge patch with one conflict retry
     (``allocate.go:126,136-150``); feeds the result back into the pod
-    source so the next Allocate cannot re-match this pod."""
+    source so the next Allocate cannot re-match this pod.
+
+    ``patch_fn(ns, name, patch) -> pod`` overrides the write transport —
+    the manager passes the coalesced ``PodPatchPipeline.patch_pod`` so
+    concurrently-committed admissions batch their PATCHes; semantics
+    (response, ApiError statuses, conflict retry) are identical."""
+    patch_fn = patch_fn or api.patch_pod
     patch = {
         "metadata": {
             "annotations": annotations,
@@ -173,7 +180,7 @@ def persist_pod_assignment(
     }
     ns, name = P.namespace(pod), P.name(pod)
     try:
-        updated = api.patch_pod(ns, name, patch)
+        updated = patch_fn(ns, name, patch)
     except ApiError as e:
         if e.status == 404:
             raise _PodGone(f"{ns}/{name}") from e
@@ -181,7 +188,7 @@ def persist_pod_assignment(
             raise AllocationFailure(f"pod patch failed: {e}") from e
         log.warning("patch conflict for %s/%s; retrying once", ns, name)
         try:
-            updated = api.patch_pod(ns, name, patch)
+            updated = patch_fn(ns, name, patch)
         except ApiError as e2:
             if e2.status == 404:
                 raise _PodGone(f"{ns}/{name}") from e2
@@ -201,6 +208,7 @@ class ClusterAllocator:
         unhealthy_chips_fn=None,
         assume: AssumeCache | None = None,
         checkpoint=None,
+        patcher=None,
     ):
         self._inv = inventory
         self._api = api
@@ -209,6 +217,9 @@ class ClusterAllocator:
         self._policy = policy
         self._disable_isolation = disable_isolation
         self._unhealthy_fn = unhealthy_chips_fn or (lambda: [])
+        # Optional coalesced PATCH transport (PodPatchPipeline.patch_pod):
+        # concurrently-committed admissions batch their apiserver writes.
+        self._patcher = patcher
         # Write-ahead journal (allocator.checkpoint): the decision is made
         # durable before the PATCH leaves the node, so a daemon killed
         # mid-persist replays the reservation instead of double-assigning.
@@ -452,7 +463,8 @@ class ClusterAllocator:
 
     def _persist(self, pod, annotations: dict[str, str]) -> None:
         persist_pod_assignment(
-            self._api, self._pods, pod, annotations, const.LABEL_RESOURCE_VALUE
+            self._api, self._pods, pod, annotations,
+            const.LABEL_RESOURCE_VALUE, patch_fn=self._patcher,
         )
 
 
@@ -479,6 +491,7 @@ class ClusterCoreAllocator:
         unhealthy_chips_fn=None,
         assume: AssumeCache | None = None,
         checkpoint=None,
+        patcher=None,
     ):
         self._inv = inventory
         self._api = api
@@ -486,6 +499,8 @@ class ClusterCoreAllocator:
         self._node = node_name
         self._topo = topology
         self._unhealthy_fn = unhealthy_chips_fn or (lambda: [])
+        # shared coalesced PATCH transport — see ClusterAllocator.__init__
+        self._patcher = patcher
         # shared WAL with the mem allocator — see ClusterAllocator.__init__
         self._ckpt = checkpoint
         # shared with the mem allocator — see ClusterAllocator.__init__
@@ -553,7 +568,7 @@ class ClusterCoreAllocator:
                     try:
                         persist_pod_assignment(
                             self._api, self._pods, pod, annotations,
-                            const.LABEL_CORE_VALUE,
+                            const.LABEL_CORE_VALUE, patch_fn=self._patcher,
                         )
                         FAULTS.fire("allocator.post_persist")
                         _journal_resolve(self._ckpt, key, "commit")

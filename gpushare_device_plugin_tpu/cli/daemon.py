@@ -91,6 +91,19 @@ def build_parser() -> argparse.ArgumentParser:
                    "<plugin-dir>/tpushare-allocations.ckpt in cluster mode "
                    "(the device-plugin dir is already a host path, so the "
                    "journal survives container restarts); 'none' disables")
+    p.add_argument("--wal-fsync", default="batch", choices=["always", "batch"],
+                   help="WAL durability mode: 'batch' (group commit — one "
+                   "fsync covers every record queued within the gather "
+                   "window; no admission proceeds past begin until its "
+                   "record is durable) or 'always' (fsync per record)")
+    p.add_argument("--wal-batch-window-ms", type=float, default=2.0,
+                   help="group-commit gather window in milliseconds "
+                   "(--wal-fsync=batch); the writer drains early once "
+                   "arrivals go quiet for a quarter window")
+    p.add_argument("--no-patch-coalesce", action="store_true",
+                   help="disable the coalesced pod-PATCH dispatcher and "
+                   "send one apiserver PATCH per admission from the "
+                   "calling thread (the pre-group-commit behavior)")
     p.add_argument("--reconcile-interval", type=float, default=30.0,
                    help="seconds between drift-reconciler passes "
                    "(annotations vs ledger vs checkpoint); 0 disables")
@@ -143,6 +156,9 @@ def main(argv=None) -> int:
         disable_isolation=args.disable_isolation,
         coredump_dir=args.coredump_dir,
         checkpoint_path=checkpoint_path,
+        wal_fsync=args.wal_fsync,
+        wal_batch_window_s=args.wal_batch_window_ms / 1000.0,
+        patch_coalesce=not args.no_patch_coalesce,
         reconcile_interval_s=args.reconcile_interval,
         drain_timeout_s=args.drain_timeout,
     )

@@ -538,6 +538,14 @@ def main(argv=None) -> int:
                    "warms its in-flight overlay from it instead of "
                    "double-booking chips whose bind is not yet on the "
                    "watch (empty disables)")
+    p.add_argument("--wal-fsync", default="batch",
+                   choices=["always", "batch"],
+                   help="bind-WAL durability mode (same group-commit "
+                   "writer as the device plugin's journal): 'batch' "
+                   "amortizes one fsync across concurrent binds, 'always' "
+                   "fsyncs per record")
+    p.add_argument("--wal-batch-window-ms", type=float, default=2.0,
+                   help="group-commit gather window in milliseconds")
     p.add_argument("--timeout", type=float, default=10.0)
     p.add_argument("--metrics-port", type=int, default=0,
                    help="serve Prometheus /metrics on this port (0 = off)")
@@ -564,7 +572,11 @@ def main(argv=None) -> int:
         from ..allocator.checkpoint import AllocationCheckpoint
 
         try:
-            checkpoint = AllocationCheckpoint(args.checkpoint_path)
+            checkpoint = AllocationCheckpoint(
+                args.checkpoint_path,
+                fsync=args.wal_fsync,
+                batch_window_s=args.wal_batch_window_ms / 1000.0,
+            )
         except OSError as e:
             log.warning("bind checkpoint unavailable (%s); running without", e)
     server = ExtenderHTTPServer(

@@ -52,6 +52,14 @@ class ManagerConfig:
     # disables it; cluster mode should point it at a path that survives
     # container restarts (the device-plugin dir is the natural hostPath).
     checkpoint_path: str = ""
+    # WAL durability mode: "batch" (group commit — one fsync covers every
+    # record queued within the gather window) or "always" (fsync per
+    # record). Durability semantics are identical; see --wal-fsync.
+    wal_fsync: str = "batch"
+    wal_batch_window_s: float = 0.002
+    # Coalesce concurrently-committed pod-annotation PATCHes through one
+    # pipelined dispatcher (cluster/apiserver.py PodPatchPipeline).
+    patch_coalesce: bool = True
     # Drift-reconciler cadence (cluster/reconciler.py); <= 0 disables.
     reconcile_interval_s: float = 30.0
     # How long graceful shutdown waits for in-flight Allocate calls.
@@ -92,12 +100,28 @@ class TpuShareManager:
             from ..allocator.checkpoint import AllocationCheckpoint
 
             try:
-                self._ckpt = AllocationCheckpoint(config.checkpoint_path)
+                self._ckpt = AllocationCheckpoint(
+                    config.checkpoint_path,
+                    fsync=config.wal_fsync,
+                    batch_window_s=config.wal_batch_window_s,
+                )
             except OSError as e:
                 log.warning(
                     "allocation checkpoint unavailable (%s); running "
                     "unjournaled — restart recovery degraded", e,
                 )
+        # Coalesced admission writes: both allocators route their pod
+        # PATCHes through one group-commit dispatcher so a storm of
+        # concurrent admissions batches its apiserver round-trips.
+        self._patch_pipeline = None
+        if (
+            config.patch_coalesce
+            and api_client is not None
+            and not config.standalone
+        ):
+            from ..cluster.apiserver import PodPatchPipeline
+
+            self._patch_pipeline = PodPatchPipeline(api_client)
         self._reconciler = None
         self._restart = threading.Event()
         self._stop = threading.Event()
@@ -139,6 +163,10 @@ class TpuShareManager:
             unhealthy_chips_fn=unhealthy_fn,
             assume=self._alloc_assume,
             checkpoint=self._ckpt,
+            patcher=(
+                self._patch_pipeline.patch_pod
+                if self._patch_pipeline is not None else None
+            ),
         )
         return cluster.allocate
 
@@ -185,6 +213,10 @@ class TpuShareManager:
             unhealthy_chips_fn=unhealthy_fn,
             assume=self._alloc_assume,
             checkpoint=self._ckpt,
+            patcher=(
+                self._patch_pipeline.patch_pod
+                if self._patch_pipeline is not None else None
+            ),
         )
         return core.allocate
 
@@ -480,6 +512,10 @@ class TpuShareManager:
         finally:
             watcher.stop()
             self._stop_all()
+            if self._patch_pipeline is not None:
+                # after the drain: in-flight admissions have finished their
+                # PATCHes, so stopping the dispatcher strands nothing
+                self._patch_pipeline.stop()
             if self._ckpt is not None:
                 # graceful shutdown: the journal is flushed and closed so
                 # the next incarnation loads a clean file

@@ -20,6 +20,12 @@ Registered points (see docs/robustness.md for the failure-mode matrix):
 ``checkpoint.begin``    after the WAL begin record is durably on disk
 ``checkpoint.commit``   after the WAL commit record is durably on disk
 ``checkpoint.abort``    after the WAL abort record is durably on disk
+``checkpoint.wal_queue``  after a record is queued for group commit,
+                        BEFORE its durability wait (crash = the batched
+                        record that never got fsync'd)
+``checkpoint.batch_fsync``  in the group-commit writer, after a batch
+                        became durable (crash = records on disk, every
+                        caller of the batch dead)
 ``allocator.post_persist``  after the pod PATCH landed, before the WAL
                         commit record (the mid-window crash site)
 ==========================================================================
@@ -80,6 +86,8 @@ POINTS = (
     "checkpoint.begin",
     "checkpoint.commit",
     "checkpoint.abort",
+    "checkpoint.wal_queue",
+    "checkpoint.batch_fsync",
     "allocator.post_persist",
 )
 

@@ -86,6 +86,52 @@ class MetricsRegistry:
             row[-2] += seconds
             row[-1] += 1
 
+    # --- programmatic readers (bench / tests) ---------------------------
+
+    def counter_value(self, name: str, **labels: str) -> float:
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            return self._counters.get(key, 0.0)
+
+    def histogram_stats(self, name: str, **labels: str) -> tuple[int, float]:
+        """(observation count, sum) for one labeled histogram series;
+        (0, 0.0) when it has never been observed."""
+        lkey = tuple(sorted(labels.items()))
+        with self._lock:
+            hist = self._hists.get(name)
+            if hist is None:
+                return 0, 0.0
+            row = hist[1].get(lkey)
+            if row is None:
+                return 0, 0.0
+            return row[-1], row[-2]
+
+    def histogram_quantile(self, name: str, q: float, **labels: str) -> float | None:
+        """Approximate quantile from the fixed buckets (linear within the
+        winning bucket, like PromQL's histogram_quantile). None when the
+        series has no observations."""
+        lkey = tuple(sorted(labels.items()))
+        with self._lock:
+            hist = self._hists.get(name)
+            if hist is None:
+                return None
+            bks, series = hist
+            row = series.get(lkey)
+            if row is None or row[-1] == 0:
+                return None
+            total = row[-1]
+            rank = q * total
+            prev_count, prev_bound = 0, 0.0
+            for i, bound in enumerate(bks):
+                if row[i] >= rank:
+                    in_bucket = row[i] - prev_count
+                    if in_bucket <= 0:
+                        return bound
+                    frac = (rank - prev_count) / in_bucket
+                    return prev_bound + (bound - prev_bound) * frac
+                prev_count, prev_bound = row[i], bound
+            return bks[-1]  # beyond the last bucket: clamp like PromQL
+
     def render(self) -> str:
         """Prometheus text exposition format 0.0.4."""
         out: list[str] = []

@@ -361,11 +361,15 @@ class FakeApiServer:
                 # _send serialized every concurrent PATCH behind each
                 # other's socket writes — invisible single-threaded, a
                 # bottleneck for the concurrent-admission benchmark.
-                if self._maybe_fault():
-                    return
+                # Body is read BEFORE any injected fault: a faulted
+                # request that leaves its body unread would poison the
+                # keep-alive connection for the next request (a real
+                # server always drains or closes).
                 u = urlparse(self.path)
                 parts = [p for p in u.path.split("/") if p]
                 body = self._read_body()
+                if self._maybe_fault():
+                    return
                 response = None
                 with store._lock:
                     store.patch_log.append((u.path, body))
@@ -431,11 +435,12 @@ class FakeApiServer:
                 return self._send(*response)
 
             def do_POST(self):
-                if self._maybe_fault():
-                    return
+                # body before fault: see do_PATCH
                 u = urlparse(self.path)
                 parts = [p for p in u.path.split("/") if p]
                 body = self._read_body()
+                if self._maybe_fault():
+                    return
                 with store._lock:
                     rest = parts[2:] if parts[:2] == ["api", "v1"] else []
                     if len(rest) == 5 and rest[2] == "pods" and rest[4] == "binding":

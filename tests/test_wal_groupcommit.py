@@ -1,0 +1,528 @@
+"""Group-commit WAL + coalesced admission pipeline suite (ISSUE 4).
+
+Covers the tentpole's three stages and their failure boundaries:
+
+- **WAL group commit** (`allocator/checkpoint.py` + `utils/batch.py`):
+  batch/always mode replay parity (the tier-1 smoke bit), a 200-seed
+  multi-threaded interleaving property test (like
+  ``tests/test_index_property.py`` but over journal ops), compaction
+  racing a queued batch, a torn tail landing mid-batch (only the fsync'd
+  prefix replays), and the two new ``crash_after`` boundaries:
+  ``checkpoint.wal_queue`` (queued, never fsync'd -> replays as absent)
+  and ``checkpoint.batch_fsync`` (durable, callers dead -> replays as
+  present).
+- **PATCH coalescing** (`cluster/apiserver.py`): the pipelined pod-PATCH
+  dispatcher (batching, per-item ApiError mapping, dead-connection
+  fallback) and the merging node-PATCH coalescer.
+- **Informer batch apply** (`cluster/informer.py`): a watch burst applied
+  under one cache-lock acquisition with exact index maintenance.
+
+``make chaos-restart`` runs this file alongside the restart-recovery
+suite; everything here is tier-1 ('not slow').
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import threading
+import time
+
+import pytest
+
+from gpushare_device_plugin_tpu.allocator import checkpoint as ckpt_mod
+from gpushare_device_plugin_tpu.allocator.assume import AssumeCache
+from gpushare_device_plugin_tpu.allocator.checkpoint import (
+    AllocationCheckpoint,
+    replay_checkpoint,
+)
+from gpushare_device_plugin_tpu.cluster import apiserver as api_mod
+from gpushare_device_plugin_tpu.cluster.apiserver import (
+    ApiError,
+    ApiServerClient,
+    PodPatchPipeline,
+)
+from gpushare_device_plugin_tpu.utils.metrics import REGISTRY
+from gpushare_device_plugin_tpu.utils.faults import FAULTS, SimulatedCrash
+
+from fake_apiserver import FakeApiServer
+from k8s_fixtures import make_pod
+
+NODE = "node-wal"
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    FAULTS.clear()
+    yield
+    FAULTS.clear()
+
+
+def _fsync_count(mode: str) -> int:
+    return REGISTRY.histogram_stats(ckpt_mod.FSYNC_SECONDS, mode=mode)[0]
+
+
+def _canonical_state(ckpt: AllocationCheckpoint) -> str:
+    """Replay state, canonically serialized — 'byte-identical replay
+    state' means these strings match across durability modes."""
+    return json.dumps(
+        sorted((list(k), v) for k, v in ckpt.pending().items()),
+        sort_keys=True,
+    )
+
+
+# --- mode parity ------------------------------------------------------------
+
+
+def test_batch_and_always_replay_byte_identical(tmp_path):
+    """Tier-1 smoke bit: the same admission sequence journaled in batch
+    and always mode must reload to byte-identical replay state."""
+    seq = []
+    for i in range(40):
+        key = ("default", f"p{i % 13}")
+        seq.append(("begin", key, {"kind": "mem", "idx": i % 4, "units": 2}))
+        if i % 3 == 0:
+            seq.append(("commit", key, None))
+        elif i % 3 == 1:
+            seq.append(("abort", key, None))
+        # i % 3 == 2: left unresolved -> must replay
+
+    states = {}
+    for mode in ("always", "batch"):
+        path = str(tmp_path / f"{mode}.ckpt")
+        ckpt = AllocationCheckpoint(path, fsync=mode, batch_window_s=0.001)
+        for op, key, data in seq:
+            if op == "begin":
+                ckpt.begin(key, dict(data))
+            elif op == "commit":
+                ckpt.commit(key)
+            else:
+                ckpt.abort(key)
+        ckpt.close()
+        reopened = AllocationCheckpoint(path, fsync=mode)
+        states[mode] = _canonical_state(reopened)
+        reopened.close()
+    assert states["batch"] == states["always"]
+    assert states["batch"] != "[]"  # the sequence leaves live entries
+
+
+def test_interleaving_property_200_seeds(tmp_path):
+    """Threading stress for the group-commit writer: per seed, 4 threads
+    journal begin/commit/abort over disjoint key spaces with a randomized
+    gather window; after close + reopen the replay set must equal exactly
+    the keys each thread deliberately left unresolved. 200 seeds — any
+    ordering bug between the writer thread, compaction, and the callers
+    has to survive thousands of interleavings to land."""
+    failures = []
+    for seed in range(200):
+        rng = random.Random(seed)
+        path = str(tmp_path / f"s{seed}.ckpt")
+        window = rng.choice([0.0, 0.0002, 0.001])
+        ckpt = AllocationCheckpoint(path, fsync="batch", batch_window_s=window)
+        # pre-decide every key's fate so the expected replay set is exact
+        plans = []
+        expected = set()
+        for t in range(4):
+            plan = []
+            for k in range(5):
+                key = (f"ns{t}", f"p{k}")
+                fate = rng.choice(["leave", "commit", "abort"])
+                plan.append((key, fate))
+                if fate == "leave":
+                    expected.add(key)
+            plans.append(plan)
+
+        def worker(plan):
+            for key, fate in plan:
+                ckpt.begin(key, {"kind": "mem", "idx": 1, "units": 1})
+                if fate == "commit":
+                    ckpt.commit(key)
+                elif fate == "abort":
+                    ckpt.abort(key)
+
+        threads = [
+            threading.Thread(target=worker, args=(p,), daemon=True)
+            for p in plans
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        ckpt.close()
+        reopened = AllocationCheckpoint(path, fsync="batch")
+        got = set(reopened.pending())
+        reopened.close()
+        if got != expected:
+            failures.append((seed, sorted(expected - got), sorted(got - expected)))
+    assert not failures, (
+        f"{len(failures)}/200 seeds diverged; first (seed, missing, extra): "
+        f"{failures[0]}"
+    )
+
+
+# --- compaction vs the writer ----------------------------------------------
+
+
+def test_compaction_races_queued_batch(tmp_path):
+    """Compact while a batch is still queued in the writer: the compacted
+    snapshot plus the late-appended records must replay to the same state,
+    and every surviving line must parse."""
+    path = str(tmp_path / "race.ckpt")
+    ckpt = AllocationCheckpoint(path, fsync="batch", batch_window_s=0.2)
+    keys = [("default", f"p{i}") for i in range(5)]
+    threads = [
+        threading.Thread(
+            target=ckpt.begin,
+            args=(k, {"kind": "mem", "idx": i, "units": 1}),
+            daemon=True,
+        )
+        for i, k in enumerate(keys)
+    ]
+    for t in threads:
+        t.start()
+    time.sleep(0.02)  # inside the 0.2s gather window: the batch is queued
+    ckpt.compact()  # swaps the file under the queued batch
+    for t in threads:
+        t.join(timeout=10)
+    ckpt.close()
+    with open(path) as f:
+        for line in f:
+            if line.strip():
+                json.loads(line)  # no torn/corrupt lines
+    reopened = AllocationCheckpoint(path, fsync="batch")
+    assert set(reopened.pending()) == set(keys)
+    reopened.close()
+
+
+def test_compact_every_bounds_file_under_groupcommit(tmp_path, monkeypatch):
+    """The resolve-triggered compaction still bounds the journal when the
+    records ride the group-commit writer."""
+    monkeypatch.setattr(ckpt_mod, "COMPACT_EVERY", 8)
+    path = str(tmp_path / "bounded.ckpt")
+    ckpt = AllocationCheckpoint(path, fsync="batch", batch_window_s=0.0005)
+    ckpt.begin(("default", "keeper"), {"kind": "mem", "idx": 3, "units": 1})
+    for i in range(40):
+        key = ("default", f"p{i}")
+        ckpt.begin(key, {"kind": "mem", "idx": 0, "units": 1})
+        ckpt.commit(key)
+    ckpt.flush()
+    with open(path) as f:
+        lines = [ln for ln in f if ln.strip()]
+    assert len(lines) < 40  # compaction ran; the file is not append-only
+    ckpt.close()
+    reopened = AllocationCheckpoint(path, fsync="batch")
+    assert set(reopened.pending()) == {("default", "keeper")}
+    reopened.close()
+
+
+# --- torn tail mid-batch ----------------------------------------------------
+
+
+def test_torn_tail_mid_batch_replays_fsynced_prefix(tmp_path):
+    """One fsync covered the whole batch; a crash tearing the batch's last
+    record must replay exactly the intact prefix."""
+    path = str(tmp_path / "torn.ckpt")
+    before = _fsync_count("batch")
+    ckpt = AllocationCheckpoint(path, fsync="batch", batch_window_s=0.2)
+    keys = [("default", f"p{i}") for i in range(3)]
+    threads = [
+        threading.Thread(
+            target=ckpt.begin,
+            args=(k, {"kind": "mem", "idx": i, "units": 1}),
+            daemon=True,
+        )
+        for i, k in enumerate(keys)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    # all three records rode ONE flush+fsync
+    assert _fsync_count("batch") - before == 1
+    ckpt.close()
+    size = os.path.getsize(path)
+    with open(path, "rb") as f:
+        data = f.read()
+    assert data.count(b"\n") == 4  # header + 3 begins
+    with open(path, "r+b") as f:
+        f.truncate(size - 10)  # tear into the batch's final record
+    reopened = AllocationCheckpoint(path, fsync="batch")
+    pending = set(reopened.pending())
+    assert len(pending) == 2 and pending < set(keys)
+    reopened.close()
+
+
+# --- crash_after at the new batch boundaries --------------------------------
+
+
+def test_wal_queue_crash_never_fsynced_replays_absent(tmp_path):
+    """crash_after:checkpoint.wal_queue — the record is queued for group
+    commit but the process dies before the batch fsyncs: a restart must
+    see NO trace of it (the caller never proceeded past begin, so nothing
+    was promised)."""
+    path = str(tmp_path / "queue-crash.ckpt")
+    # huge window: the queued batch provably cannot flush before "death"
+    ckpt = AllocationCheckpoint(path, fsync="batch", batch_window_s=60.0)
+    FAULTS.inject("checkpoint.wal_queue", mode="crash", times=1)
+    with pytest.raises(SimulatedCrash):
+        ckpt.begin(("default", "ghost"), {"kind": "mem", "idx": 0, "units": 2})
+    ckpt.abandon()  # SIGKILL semantics: the queue dies with the process
+    survivor = AllocationCheckpoint(path, fsync="batch")
+    assert survivor.pending() == {}
+    assert replay_checkpoint(survivor, AssumeCache()) == 0
+    survivor.close()
+
+
+def test_batch_fsync_crash_durable_replays_present(tmp_path):
+    """crash_after:checkpoint.batch_fsync — the batch IS durable when the
+    crash kills its callers: a restart must replay every record of it."""
+    path = str(tmp_path / "fsync-crash.ckpt")
+    ckpt = AllocationCheckpoint(path, fsync="batch", batch_window_s=0.001)
+    FAULTS.inject("checkpoint.batch_fsync", mode="crash", times=1)
+    with pytest.raises(SimulatedCrash):
+        ckpt.begin(("default", "durable"), {"kind": "mem", "idx": 1, "units": 4})
+    ckpt.abandon()
+    survivor = AllocationCheckpoint(path, fsync="batch")
+    assert set(survivor.pending()) == {("default", "durable")}
+    assume = AssumeCache()
+    assert replay_checkpoint(survivor, assume) == 1
+    mem_used, _held = assume.overlaid_state(lambda: ({}, set()))
+    assert mem_used == {1: 4}
+    survivor.close()
+
+
+@pytest.mark.parametrize("mode", ["always", "batch"])
+def test_begin_crash_semantics_identical_across_modes(tmp_path, mode):
+    """The restart suite's checkpoint.begin boundary, in BOTH durability
+    modes: the record is durable before the fault fires, whichever path
+    wrote it."""
+    path = str(tmp_path / f"{mode}.ckpt")
+    ckpt = AllocationCheckpoint(path, fsync=mode, batch_window_s=0.001)
+    FAULTS.inject("checkpoint.begin", mode="crash", times=1)
+    with pytest.raises(SimulatedCrash):
+        ckpt.begin(("default", "p"), {"kind": "mem", "idx": 0, "units": 2})
+    survivor = AllocationCheckpoint(path, fsync=mode)
+    assert set(survivor.pending()) == {("default", "p")}
+    survivor.close()
+    ckpt.abandon()
+
+
+def test_flush_is_the_single_durability_barrier(tmp_path):
+    """The old side-channel flush path is gone: ``flush()`` drains the
+    group-commit writer itself, so a record sitting in a long gather
+    window becomes durable the moment anyone needs the barrier."""
+    path = str(tmp_path / "barrier.ckpt")
+    ckpt = AllocationCheckpoint(path, fsync="batch", batch_window_s=60.0)
+    done = threading.Event()
+
+    def begin():
+        ckpt.begin(("default", "slow"), {"kind": "mem", "idx": 0, "units": 1})
+        done.set()
+
+    t = threading.Thread(target=begin, daemon=True)
+    t.start()
+    time.sleep(0.05)
+    assert not done.is_set()  # still gathering: not yet durable
+    ckpt.flush()  # the barrier forces the batch out
+    assert done.wait(5.0)
+    reader = AllocationCheckpoint(path, fsync="batch")
+    assert set(reader.pending()) == {("default", "slow")}
+    reader.close()
+    ckpt.close()
+
+
+# --- coalesced pod-PATCH pipeline -------------------------------------------
+
+
+@pytest.fixture
+def api():
+    srv = FakeApiServer()
+    srv.add_node(NODE)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def _patch_batches() -> tuple[int, float]:
+    return REGISTRY.histogram_stats(api_mod.PATCH_BATCH_RECORDS, kind="pod")
+
+
+def test_pipeline_coalesces_concurrent_pod_patches(api):
+    client = ApiServerClient(api.url)
+    pipeline = PodPatchPipeline(client, window_s=0.05)
+    n = 8
+    for i in range(n):
+        api.add_pod(make_pod(f"pp{i}", 2, node=NODE))
+    batches_before, patches_before = _patch_batches()
+    results: dict[int, dict] = {}
+    errors: list = []
+
+    def patch(i):
+        try:
+            results[i] = pipeline.patch_pod(
+                "default", f"pp{i}",
+                {"metadata": {"annotations": {"wal-test": str(i)}}},
+            )
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=patch, args=(i,), daemon=True) for i in range(n)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    pipeline.stop()
+    assert not errors
+    assert len(results) == n
+    for i, pod in results.items():
+        # each caller got ITS pod's post-PATCH copy, annotation applied
+        assert pod["metadata"]["name"] == f"pp{i}"
+        assert pod["metadata"]["annotations"]["wal-test"] == str(i)
+        assert api.pods[("default", f"pp{i}")]["metadata"]["annotations"][
+            "wal-test"
+        ] == str(i)
+    batches_after, patches_after = _patch_batches()
+    assert patches_after - patches_before == n
+    # coalesced: strictly fewer dispatch batches than patches
+    assert batches_after - batches_before < n
+
+
+def test_pipeline_maps_api_errors_per_item(api):
+    """404/409 surface as the same ApiError a direct patch_pod raises —
+    including on the pipelined (multi-item) path."""
+    client = ApiServerClient(api.url)
+    pipeline = PodPatchPipeline(client, window_s=0.05)
+    api.add_pod(make_pod("real", 2, node=NODE))
+    outcome: dict[str, object] = {}
+
+    def patch(name):
+        try:
+            outcome[name] = pipeline.patch_pod(
+                "default", name, {"metadata": {"annotations": {"a": "1"}}}
+            )
+        except Exception as e:  # noqa: BLE001
+            outcome[name] = e
+
+    threads = [
+        threading.Thread(target=patch, args=(n,), daemon=True)
+        for n in ("real", "missing")
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    assert isinstance(outcome["missing"], ApiError)
+    assert outcome["missing"].status == 404
+    assert isinstance(outcome["real"], dict)
+
+    # conflict injection takes the single-item (sequential) path
+    api.conflicts_to_inject = 1
+    with pytest.raises(ApiError) as ei:
+        pipeline.patch_pod(
+            "default", "real", {"metadata": {"annotations": {"b": "2"}}}
+        )
+    assert ei.value.status == 409
+    pipeline.stop()
+
+
+def test_pipeline_falls_back_when_pipe_connection_dies(api):
+    """A dead pipelined connection must degrade to per-item sequential
+    PATCHes, not fail the batch."""
+    client = ApiServerClient(api.url)
+    pipeline = PodPatchPipeline(client, window_s=0.05, fanout=1)
+    for i in range(4):
+        api.add_pod(make_pod(f"fb{i}", 2, node=NODE))
+
+    def storm(tag):
+        outcome = {}
+
+        def patch(i):
+            outcome[i] = pipeline.patch_pod(
+                "default", f"fb{i}", {"metadata": {"annotations": {tag: "y"}}}
+            )
+
+        threads = [
+            threading.Thread(target=patch, args=(i,), daemon=True)
+            for i in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        return outcome
+
+    assert len(storm("warm")) == 4  # establishes the pipe
+    # sever the pipelined connection behind the dispatcher's back
+    for pipe in pipeline._pipes:
+        if pipe is not None:
+            pipe[0].sock.close()
+    outcome = storm("after")
+    assert len(outcome) == 4
+    for i in range(4):
+        assert api.pods[("default", f"fb{i}")]["metadata"]["annotations"]["after"] == "y"
+    pipeline.stop()
+
+
+def test_node_patch_coalescer_merges_same_node(api):
+    """N concurrent annotation updates to one node collapse into fewer
+    PATCH requests whose merge carries every key."""
+    client = ApiServerClient(api.url)
+    n = 6
+    before = len([p for p, _ in api.patch_log if f"/nodes/{NODE}" in p])
+    results: list = []
+
+    def patch(i):
+        results.append(
+            client.patch_node_merged(
+                NODE, {"metadata": {"annotations": {f"k{i}": str(i)}}}
+            )
+        )
+
+    threads = [
+        threading.Thread(target=patch, args=(i,), daemon=True) for i in range(n)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    assert len(results) == n
+    ann = api.nodes[NODE]["metadata"]["annotations"]
+    for i in range(n):
+        assert ann[f"k{i}"] == str(i)
+    sent = len([p for p, _ in api.patch_log if f"/nodes/{NODE}" in p]) - before
+    assert sent < n  # at least one merge happened
+
+
+# --- informer batched apply -------------------------------------------------
+
+
+def test_informer_apply_batch_single_lock_pass():
+    from gpushare_device_plugin_tpu.cluster import informer as inf_mod
+    from gpushare_device_plugin_tpu.cluster.informer import PodInformer
+
+    inf = PodInformer(client=None, node_name=NODE)
+    inf._synced.set()
+    count_before = REGISTRY.histogram_stats(
+        inf_mod.APPLY_BATCH, scope=NODE
+    )[0]
+    events = []
+    for i in range(10):
+        pod = make_pod(f"b{i}", 2, node=NODE)
+        pod["metadata"]["resourceVersion"] = str(100 + i)
+        events.append(("ADDED", pod))
+    rv, err = inf.apply_batch(events)
+    assert err is None
+    assert rv == "109"
+    assert len(inf.pending_pods()) == 10
+    mem_used, _ = inf.chip_state()
+    assert mem_used == {}  # pending pods don't count toward usage
+    # the whole burst was ONE observed batch (one lock acquisition)
+    assert REGISTRY.histogram_stats(inf_mod.APPLY_BATCH, scope=NODE)[0] == (
+        count_before + 1
+    )
+    # an ERROR event stops the batch and surfaces for relist
+    rv2, err2 = inf.apply_batch([("ERROR", {"code": 410})])
+    assert rv2 is None and err2 == {"code": 410}
