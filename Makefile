@@ -1,7 +1,7 @@
 PY ?= python
 PROTOC ?= protoc
 
-.PHONY: proto native test test-fast test-slow test-stress chaos chaos-restart lint bench bench-smoke bench-wal e2e-kind
+.PHONY: proto native test test-fast test-slow test-stress chaos chaos-restart lint bench bench-smoke bench-serve-smoke bench-wal e2e-kind
 
 # Regenerate protobuf message classes (gRPC bindings are hand-written in
 # gpushare_device_plugin_tpu/plugin/api/api_grpc.py; grpc_tools is not
@@ -74,6 +74,13 @@ bench:
 # tier-1 runs via tests/test_bench_smoke.py. See docs/perf.md.
 bench-smoke:
 	$(PY) bench.py --smoke
+
+# Continuous-batching serving smoke (CPU, seconds): the serve_engine
+# section alone — engine vs static lockstep on a mixed-length Poisson
+# trace, with the zero-retrace compile guard. Tier-1 runs it via
+# tests/test_bench_serve_smoke.py. See docs/serving.md.
+bench-serve-smoke:
+	$(PY) bench_mfu.py --serve-smoke
 
 # Group-commit WAL A/B: the 16-way admission storm with the journal in
 # per-record-fsync ('always') then group-commit ('batch') mode. Reports

@@ -553,19 +553,49 @@ def previous_p50(repo: Path) -> tuple[float, str] | None:
     return previous_metric(repo, "value")
 
 
+def _pct_trend_guard(
+    value: float | None,
+    repo: Path,
+    *,
+    field: str,
+    label: str,
+    pct: float = P99_GUARD_PCT,
+    fmt: str = ".3f",
+    unit: str = "",
+    lower_is_worse: bool = False,
+) -> str | None:
+    """Shared core of every percentage trend guard: compare ``value``
+    against the newest committed record carrying ``field`` and return a
+    failure message when it moved >``pct``% in the worse direction
+    (``lower_is_worse`` flips it for throughput-style metrics); None when
+    within budget, improving, or without history. One implementation so a
+    threshold or message change can never drift between metrics."""
+    if value is None:
+        return None
+    prev = previous_metric(repo, field)
+    if prev is None:
+        return None
+    prev_val, fname = prev
+    if lower_is_worse:
+        if value >= prev_val * (1 - pct / 100.0):
+            return None
+        verb = "dropped"
+    else:
+        if value <= prev_val * (1 + pct / 100.0):
+            return None
+        verb = "regressed"
+    return (
+        f"TREND GUARD: {label} {value:{fmt}}{unit} {verb} >{pct:.0f}% "
+        f"vs {fname} ({prev_val:{fmt}}{unit})"
+    )
+
+
 def trend_guard(p50: float, repo: Path) -> str | None:
     """Failure message when ``p50`` regressed >TREND_GUARD_PCT vs the newest
     committed ``BENCH_r*.json``; None when within budget (or no history)."""
-    prev = previous_p50(repo)
-    if prev is None:
-        return None
-    prev_p50, fname = prev
-    if p50 > prev_p50 * (1 + TREND_GUARD_PCT / 100.0):
-        return (
-            f"TREND GUARD: p50 {p50:.3f}ms regressed >{TREND_GUARD_PCT:.0f}% "
-            f"vs {fname} ({prev_p50:.3f}ms)"
-        )
-    return None
+    return _pct_trend_guard(
+        p50, repo, field="value", label="p50", pct=TREND_GUARD_PCT, unit="ms"
+    )
 
 
 def p99_guard(p99: float, repo: Path) -> str | None:
@@ -574,16 +604,7 @@ def p99_guard(p99: float, repo: Path) -> str | None:
     history). The p50 guard alone let tail-latency regressions land
     silently — a hot path can keep its median while growing a lock-wait
     tail, which is exactly the failure mode a concurrency rework risks."""
-    prev = previous_metric(repo, "p99_ms")
-    if prev is None:
-        return None
-    prev_p99, fname = prev
-    if p99 > prev_p99 * (1 + P99_GUARD_PCT / 100.0):
-        return (
-            f"TREND GUARD: p99 {p99:.3f}ms regressed >{P99_GUARD_PCT:.0f}% "
-            f"vs {fname} ({prev_p99:.3f}ms)"
-        )
-    return None
+    return _pct_trend_guard(p99, repo, field="p99_ms", label="p99", unit="ms")
 
 
 def utilization_guard(util_pct: float, repo: Path) -> str | None:
@@ -608,48 +629,60 @@ def wal_fsync_guard(fsyncs_per_admission: float | None, repo: Path) -> str | Non
     >P99_GUARD_PCT vs the newest committed record carrying it — group
     commit's amortization must not silently erode back toward
     one-fsync-per-record; None when within budget or no history."""
-    if fsyncs_per_admission is None:
-        return None
-    prev = previous_metric(repo, "wal_fsyncs_per_admission")
-    if prev is None:
-        return None
-    prev_val, fname = prev
-    if fsyncs_per_admission > prev_val * (1 + P99_GUARD_PCT / 100.0):
-        return (
-            f"TREND GUARD: wal_fsyncs_per_admission {fsyncs_per_admission:.3f} "
-            f"regressed >{P99_GUARD_PCT:.0f}% vs {fname} ({prev_val:.3f})"
-        )
-    return None
+    return _pct_trend_guard(
+        fsyncs_per_admission, repo, field="wal_fsyncs_per_admission",
+        label="wal_fsyncs_per_admission",
+    )
 
 
 def wal_fsync_p99_guard(p99_ms: float | None, repo: Path) -> str | None:
     """Same budget for the fsync latency tail: a batch that grows cheap in
     count but expensive per sync is still a regression."""
-    if p99_ms is None:
-        return None
-    prev = previous_metric(repo, "wal_fsync_p99_ms")
-    if prev is None:
-        return None
-    prev_val, fname = prev
-    if p99_ms > prev_val * (1 + P99_GUARD_PCT / 100.0):
-        return (
-            f"TREND GUARD: wal_fsync_p99 {p99_ms:.3f}ms regressed "
-            f">{P99_GUARD_PCT:.0f}% vs {fname} ({prev_val:.3f}ms)"
-        )
-    return None
+    return _pct_trend_guard(
+        p99_ms, repo, field="wal_fsync_p99_ms", label="wal_fsync_p99",
+        unit="ms",
+    )
 
 
-def run_compute_bench(repo: Path) -> dict:
+def serve_goodput_guard(tokens_s: float | None, repo: Path) -> str | None:
+    """Failure message when the continuous-batching engine's goodput
+    dropped >P99_GUARD_PCT below the newest committed record carrying it
+    (the serve bench's ``serve_goodput_tokens_per_s``); None when within
+    budget or no history. Lower is worse here, unlike the latency guards."""
+    return _pct_trend_guard(
+        tokens_s, repo, field="serve_goodput_tokens_per_s",
+        label="serve goodput", fmt=".1f", unit=" tokens/s",
+        lower_is_worse=True,
+    )
+
+
+def serve_ttft_guard(p99_ms: float | None, repo: Path) -> str | None:
+    """Same budget for the engine's TTFT tail (``serve_ttft_p99_ms``):
+    admission latency is the metric continuous batching exists to fix, so
+    a regression there must not land silently."""
+    return _pct_trend_guard(
+        p99_ms, repo, field="serve_ttft_p99_ms", label="serve ttft_p99",
+        fmt=".2f", unit="ms",
+    )
+
+
+def run_compute_bench(repo: Path, backend_init_timeout: float = 60.0) -> dict:
     """bench_mfu.py in a subprocess; {} on any failure (never fatal here).
 
     bench_mfu re-prints its cumulative report after every section, so even
     a timeout (dead TPU tunnel mid-compile) salvages the sections that
-    finished — the last parseable dict line wins.
+    finished — the last parseable dict line wins. ``backend_init_timeout``
+    rides through to bench_mfu's subprocess backend-init probe: a wedged
+    TPU tunnel now costs that bound (with the reason + elapsed recorded in
+    the report) instead of a fixed 300 s.
     """
     stdout, stderr, note = "", "", None
     try:
         proc = subprocess.run(
-            [sys.executable, str(repo / "bench_mfu.py")],
+            [
+                sys.executable, str(repo / "bench_mfu.py"),
+                "--backend-init-timeout", str(backend_init_timeout),
+            ],
             capture_output=True, text=True, timeout=1800,
         )
         stdout, stderr = proc.stdout, proc.stderr
@@ -702,6 +735,10 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="run ONLY the concurrent storm, once per WAL mode "
                    "(always then batch), and emit a comparison record "
                    "(make bench-wal)")
+    p.add_argument("--backend-init-timeout", type=float, default=60.0,
+                   help="bound (seconds) on bench_mfu's subprocess "
+                   "backend-init probe — a wedged TPU tunnel costs this "
+                   "much, recorded in the report, instead of 300 s")
     p.add_argument("--wal-window-ms", type=float, default=8.0,
                    help="group-commit gather window for the storm's WAL "
                    "(the --wal-batch-window-ms daemon tunable). The storm "
@@ -829,7 +866,9 @@ def main(argv=None) -> int:
             file=sys.stderr,
         )
 
-    compute = {} if args.no_mfu else run_compute_bench(repo)
+    compute = {} if args.no_mfu else run_compute_bench(
+        repo, backend_init_timeout=args.backend_init_timeout
+    )
     if compute.get("train"):
         t = compute["train"]
         print(
@@ -857,6 +896,12 @@ def main(argv=None) -> int:
         "wal_fsyncs_per_admission": concurrent.get("wal_fsyncs_per_admission"),
         "wal_fsync_p99_ms": concurrent.get("wal_fsync_p99_ms"),
         "patch_coalesce_ratio": concurrent.get("patch_coalesce_ratio"),
+        # Continuous-batching serve numbers, hoisted top-level like the
+        # WAL fields so previous_metric / the trend guards can read them.
+        "serve_goodput_tokens_per_s": compute.get("serve_engine", {})
+        .get("engine", {}).get("goodput_tokens_per_s"),
+        "serve_ttft_p99_ms": compute.get("serve_engine", {})
+        .get("engine", {}).get("ttft_p99_ms"),
         "concurrent": concurrent,
         "extender": extender,
         "compute": compute,
@@ -871,6 +916,8 @@ def main(argv=None) -> int:
         msgs.append(p99_guard(p99, repo))
         msgs.append(wal_fsync_guard(record["wal_fsyncs_per_admission"], repo))
         msgs.append(wal_fsync_p99_guard(record["wal_fsync_p99_ms"], repo))
+        msgs.append(serve_goodput_guard(record["serve_goodput_tokens_per_s"], repo))
+        msgs.append(serve_ttft_guard(record["serve_ttft_p99_ms"], repo))
     if not args.no_util_guard:
         msgs.append(utilization_guard(record["binpack_utilization_pct"], repo))
     failed = [m for m in msgs if m is not None]

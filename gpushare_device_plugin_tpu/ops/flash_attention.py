@@ -66,20 +66,39 @@ def _pad_mask(s, ki, block_k, start):
     return jnp.where(k_pos >= start, s, NEG_INF)
 
 
+def _end_mask(s, ki, block_k, end):
+    """Mask keys at/after this row's sequence length (right padding) —
+    the mirror image of ``_pad_mask`` for the serving engine's
+    RIGHT-padded fresh-slot prompt chunks (``kv_len``)."""
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    return jnp.where(k_pos < end, s, NEG_INF)
+
+
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
 
 
+def _unpack_bounds(rest, has_start, has_end):
+    """Peel the optional per-row start/kv_len operands off ``rest`` —
+    shared by all three kernels so the operand order can never drift."""
+    i = 0
+    start_ref = end_ref = None
+    if has_start:
+        start_ref = rest[i]
+        i += 1
+    if has_end:
+        end_ref = rest[i]
+        i += 1
+    return start_ref, end_ref, rest[i:]
+
+
 def _fwd_kernel(
     q_ref, k_ref, v_ref, *rest,
-    scale, causal, block_q, block_k, num_kv, has_start,
+    scale, causal, block_q, block_k, num_kv, has_start, has_end,
 ):
-    if has_start:
-        start_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
-    else:
-        start_ref = None
-        o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
+    start_ref, end_ref, rest = _unpack_bounds(rest, has_start, has_end)
+    o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -98,6 +117,10 @@ def _fwd_kernel(
         # Left padding: KV blocks entirely before this row's first real
         # position contribute nothing — skip their MXU work too.
         live = live & (ki * block_k + block_k - 1 >= start_ref[0, 0, 0])
+    if has_end:
+        # Right padding: KV blocks entirely at/after this row's length
+        # are all pad — skip them like causal future blocks.
+        live = live & (ki * block_k < end_ref[0, 0, 0])
 
     @pl.when(live)
     def _step():
@@ -114,6 +137,8 @@ def _fwd_kernel(
             s = _causal_mask(s, qi, ki, block_q, block_k)
         if has_start:
             s = _pad_mask(s, ki, block_k, start_ref[0, 0, 0])
+        if has_end:
+            s = _end_mask(s, ki, block_k, end_ref[0, 0, 0])
         m_prev = m_scr[:, :1]  # [bq, 1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         # Fully-masked rows keep m=-inf; shift by 0 there so exp() gives 0.
@@ -167,7 +192,8 @@ def _causal_kv_map(causal, block_q, block_k, heads, kv_heads):
 
 
 def _fwd(
-    q, k, v, start, *, scale, causal, block_q, block_k, heads, kv_heads, interpret
+    q, k, v, start, end, *, scale, causal, block_q, block_k, heads, kv_heads,
+    interpret,
 ):
     BH, S, D = q.shape
     num_q = S // block_q
@@ -176,7 +202,7 @@ def _fwd(
         _fwd_kernel,
         scale=scale, causal=causal,
         block_q=block_q, block_k=block_k, num_kv=num_kv,
-        has_start=start is not None,
+        has_start=start is not None, has_end=end is not None,
     )
     # GQA-native: K/V stay [B*kv_heads, S, D] in HBM; each query head's
     # grid row streams its group's KV blocks directly (no repeated copy),
@@ -188,9 +214,12 @@ def _fwd(
         pl.BlockSpec((1, block_k, D), kv_map),
     ]
     operands = [q, k, v]
-    if start is not None:
-        in_specs.append(pl.BlockSpec((1, 1, STAT_LANES), lambda b, i, j: (b, 0, 0)))
-        operands.append(start)
+    for bound in (start, end):
+        if bound is not None:
+            in_specs.append(
+                pl.BlockSpec((1, 1, STAT_LANES), lambda b, i, j: (b, 0, 0))
+            )
+            operands.append(bound)
     o, lse = pl.pallas_call(
         kernel,
         grid=(BH, num_q, num_kv),
@@ -221,13 +250,10 @@ def _fwd(
 
 def _dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
-    scale, causal, block_q, block_k, num_kv, has_start,
+    scale, causal, block_q, block_k, num_kv, has_start, has_end,
 ):
-    if has_start:
-        start_ref, dq_ref, dq_scr = rest
-    else:
-        start_ref = None
-        dq_ref, dq_scr = rest
+    start_ref, end_ref, rest = _unpack_bounds(rest, has_start, has_end)
+    dq_ref, dq_scr = rest
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -241,6 +267,8 @@ def _dq_kernel(
     live = ki <= last_ki
     if has_start:
         live = live & (ki * block_k + block_k - 1 >= start_ref[0, 0, 0])
+    if has_end:
+        live = live & (ki * block_k < end_ref[0, 0, 0])
 
     @pl.when(live)
     def _step():
@@ -258,6 +286,9 @@ def _dq_kernel(
             s = _causal_mask(s, qi, ki, block_q, block_k)
         if has_start:
             s = _pad_mask(s, ki, block_k, start_ref[0, 0, 0])
+        if has_end:
+            s = _end_mask(s, ki, block_k, end_ref[0, 0, 0])
+        if has_start or has_end:
             # Rows fully inside the pad have lse=-inf; shift by 0 there so
             # exp(-inf - 0) gives the 0 the mask means (not -inf+inf=NaN).
             lse = jnp.where(jnp.isneginf(lse), 0.0, lse)
@@ -277,13 +308,10 @@ def _dq_kernel(
 
 def _dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
-    scale, causal, block_q, block_k, num_q, has_start,
+    scale, causal, block_q, block_k, num_q, has_start, has_end,
 ):
-    if has_start:
-        start_ref, dk_ref, dv_ref, dk_scr, dv_scr = rest
-    else:
-        start_ref = None
-        dk_ref, dv_ref, dk_scr, dv_scr = rest
+    start_ref, end_ref, rest = _unpack_bounds(rest, has_start, has_end)
+    dk_ref, dv_ref, dk_scr, dv_scr = rest
     ki = pl.program_id(1)
     # Innermost dim fuses (group member, q block): dK/dV of one KV head sum
     # contributions from every query head in its group, so the whole group
@@ -303,6 +331,8 @@ def _dkv_kernel(
         # KV blocks wholly inside the pad produce zero dK/dV: skip their
         # MXU work (scratch init at gq==0 is unconditional, so safe).
         live = live & (ki * block_k + block_k - 1 >= start_ref[0, 0, 0])
+    if has_end:
+        live = live & (ki * block_k < end_ref[0, 0, 0])
 
     @pl.when(live)
     def _step():
@@ -320,6 +350,9 @@ def _dkv_kernel(
             s = _causal_mask(s, qi, ki, block_q, block_k)
         if has_start:
             s = _pad_mask(s, ki, block_k, start_ref[0, 0, 0])
+        if has_end:
+            s = _end_mask(s, ki, block_k, end_ref[0, 0, 0])
+        if has_start or has_end:
             lse = jnp.where(jnp.isneginf(lse), 0.0, lse)  # see _dq_kernel
         p = jnp.exp(s - lse)  # [bq, bk] f32
         # dv += p^T @ do
@@ -343,8 +376,8 @@ def _dkv_kernel(
 
 
 def _bwd(
-    q, k, v, o, lse, do, start, *, scale, causal, block_q, block_k, heads,
-    kv_heads, interpret, dlse=None,
+    q, k, v, o, lse, do, start, end, *, scale, causal, block_q, block_k,
+    heads, kv_heads, interpret, dlse=None,
 ):
     BH, S, D = q.shape
     BKV = k.shape[0]
@@ -370,16 +403,17 @@ def _bwd(
         pl.BlockSpec((1, block_q, STAT_LANES), lambda b, i, j: (b, i, 0)),
     ]
     dq_operands = [q, k, v, do, lse, delta]
-    if start is not None:
-        dq_in_specs.append(
-            pl.BlockSpec((1, 1, STAT_LANES), lambda b, i, j: (b, 0, 0))
-        )
-        dq_operands.append(start)
+    for bound in (start, end):
+        if bound is not None:
+            dq_in_specs.append(
+                pl.BlockSpec((1, 1, STAT_LANES), lambda b, i, j: (b, 0, 0))
+            )
+            dq_operands.append(bound)
     dq = pl.pallas_call(
         functools.partial(
             _dq_kernel, scale=scale, causal=causal,
             block_q=block_q, block_k=block_k, num_kv=num_kv,
-            has_start=start is not None,
+            has_start=start is not None, has_end=end is not None,
         ),
         grid=(BH, num_q, num_kv),
         in_specs=dq_in_specs,
@@ -413,21 +447,22 @@ def _bwd(
         pl.BlockSpec((1, block_q, STAT_LANES), q_map),
     ]
     dkv_operands = [q, k, v, do, lse, delta]
-    if start is not None:
-        # start is per batch row (constant over heads): any q-side row of
-        # this KV row's batch reads the same value.
-        dkv_in_specs.append(
-            pl.BlockSpec(
-                (1, 1, STAT_LANES),
-                lambda b, j, gq: ((b // kv_heads) * heads, 0, 0),
+    for bound in (start, end):
+        if bound is not None:
+            # start/kv_len are per batch row (constant over heads): any
+            # q-side row of this KV row's batch reads the same value.
+            dkv_in_specs.append(
+                pl.BlockSpec(
+                    (1, 1, STAT_LANES),
+                    lambda b, j, gq: ((b // kv_heads) * heads, 0, 0),
+                )
             )
-        )
-        dkv_operands.append(start)
+            dkv_operands.append(bound)
     dk, dv = pl.pallas_call(
         functools.partial(
             _dkv_kernel, scale=scale, causal=causal,
             block_q=block_q, block_k=block_k, num_q=num_q,
-            has_start=start is not None,
+            has_start=start is not None, has_end=end is not None,
         ),
         grid=(BKV, num_kv, groups * num_q),
         in_specs=dkv_in_specs,
@@ -454,39 +489,44 @@ def _bwd(
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10))
-def _flash(q, k, v, start, scale, causal, block_q, block_k, heads, kv_heads, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10, 11))
+def _flash(
+    q, k, v, start, end, scale, causal, block_q, block_k, heads, kv_heads,
+    interpret,
+):
     o, _ = _fwd(
-        q, k, v, start, scale=scale, causal=causal, block_q=block_q,
+        q, k, v, start, end, scale=scale, causal=causal, block_q=block_q,
         block_k=block_k, heads=heads, kv_heads=kv_heads, interpret=interpret,
     )
     return o
 
 
 def _flash_fwd(
-    q, k, v, start, scale, causal, block_q, block_k, heads, kv_heads, interpret
+    q, k, v, start, end, scale, causal, block_q, block_k, heads, kv_heads,
+    interpret,
 ):
     o, lse = _fwd(
-        q, k, v, start, scale=scale, causal=causal, block_q=block_q,
+        q, k, v, start, end, scale=scale, causal=causal, block_q=block_q,
         block_k=block_k, heads=heads, kv_heads=kv_heads, interpret=interpret,
     )
-    return o, (q, k, v, o, lse, start)
+    return o, (q, k, v, o, lse, start, end)
 
 
 def _flash_bwd(scale, causal, block_q, block_k, heads, kv_heads, interpret, res, do):
     import numpy as np
 
-    q, k, v, o, lse, start = res
+    q, k, v, o, lse, start, end = res
     dq, dk, dv = _bwd(
-        q, k, v, o, lse, do, start, scale=scale, causal=causal,
+        q, k, v, o, lse, do, start, end, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k, heads=heads, kv_heads=kv_heads,
         interpret=interpret,
     )
-    # start is integer data (pad counts): its cotangent type is float0.
+    # start/kv_len are integer data (pad counts): cotangent type float0.
     dstart = (
         None if start is None else np.zeros(start.shape, jax.dtypes.float0)
     )
-    return dq, dk, dv, dstart
+    dend = None if end is None else np.zeros(end.shape, jax.dtypes.float0)
+    return dq, dk, dv, dstart, dend
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -495,7 +535,7 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
 def _flash_pair(q, k, v, scale, causal, block_q, block_k, heads, kv_heads, interpret):
     o, lse = _fwd(
-        q, k, v, None, scale=scale, causal=causal, block_q=block_q,
+        q, k, v, None, None, scale=scale, causal=causal, block_q=block_q,
         block_k=block_k, heads=heads, kv_heads=kv_heads, interpret=interpret,
     )
     return o, lse[..., 0]  # lse: [BH, S] (drop the lane broadcast)
@@ -505,7 +545,7 @@ def _flash_pair_fwd(
     q, k, v, scale, causal, block_q, block_k, heads, kv_heads, interpret
 ):
     o, lse = _fwd(
-        q, k, v, None, scale=scale, causal=causal, block_q=block_q,
+        q, k, v, None, None, scale=scale, causal=causal, block_q=block_q,
         block_k=block_k, heads=heads, kv_heads=kv_heads, interpret=interpret,
     )
     return (o, lse[..., 0]), (q, k, v, o, lse)
@@ -517,7 +557,7 @@ def _flash_pair_bwd(
     do, dlse = cts
     q, k, v, o, lse = res
     dq, dk, dv = _bwd(
-        q, k, v, o, lse, do, None, scale=scale, causal=causal,
+        q, k, v, o, lse, do, None, None, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k, heads=heads, kv_heads=kv_heads,
         interpret=interpret, dlse=dlse,
     )
@@ -602,6 +642,7 @@ def flash_attention(
     block_q: int | None = None,
     block_k: int | None = None,
     start: jax.Array | None = None,
+    kv_len: jax.Array | None = None,
     interpret: bool | None = None,
 ) -> jax.Array:
     """Flash attention over ``[B, S, H, D]`` arrays (layout of
@@ -620,6 +661,14 @@ def flash_attention(
     queries sit entirely in the pad region produce zeros, never NaN, and
     KV blocks wholly inside the pad are skipped like causal future blocks.
 
+    ``kv_len`` ([B] int32 per-row sequence lengths) is the mirror image
+    for RIGHT padding: keys at positions ``>= kv_len[b]`` are masked and
+    KV blocks wholly past the length are skipped — the continuous-batching
+    engine's fresh-slot prompt chunks (``workloads.generate.prefill_slot``)
+    keep their per-slot length bound in-kernel. Composes with ``start``
+    (a two-sided window) and with ``causal``; real (in-window) rows'
+    outputs are unchanged by either bound.
+
     ``interpret=None`` autodetects: compiled Mosaic on TPU, Pallas
     interpreter elsewhere (CPU tests, the virtual-device mesh harness).
     Sequence length must be divisible by the (auto-shrunk) block sizes
@@ -628,20 +677,23 @@ def flash_attention(
     block_q, block_k, sc, interpret, fold, (B, S, H, D, Hkv) = _entry_prologue(
         q, k, block_q, block_k, scale, interpret
     )
-    start_bh = None
-    if start is not None:
-        if start.shape != (B,):
-            raise ValueError(f"start must be [{B}] (one pad count per row)")
+
+    def _per_row_bound(bound, what):
+        if bound.shape != (B,):
+            raise ValueError(f"{what} must be [{B}] (one bound per row)")
         # One row per folded (batch, head) pair, lane-broadcast to the
         # minimum legal f32/int32 tile (see STAT_LANES).
-        start_bh = jnp.broadcast_to(
-            jnp.repeat(start.astype(jnp.int32), H)[:, None, None],
+        return jnp.broadcast_to(
+            jnp.repeat(bound.astype(jnp.int32), H)[:, None, None],
             (B * H, 1, STAT_LANES),
         )
 
+    start_bh = None if start is None else _per_row_bound(start, "start")
+    end_bh = None if kv_len is None else _per_row_bound(kv_len, "kv_len")
+
     o = _flash(
-        fold(q), fold(k), fold(v), start_bh, sc, causal, block_q, block_k,
-        H, Hkv, interpret,
+        fold(q), fold(k), fold(v), start_bh, end_bh, sc, causal, block_q,
+        block_k, H, Hkv, interpret,
     )
     return o.reshape(B, H, S, D).transpose(0, 2, 1, 3)
 

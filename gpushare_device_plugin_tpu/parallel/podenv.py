@@ -52,6 +52,14 @@ class PodTpuEnv:
         """Whole chip(s) granted — no HBM cap needed."""
         return self.hbm_fraction >= 0.999
 
+    def mem_bytes(self, unit: "const.MemoryUnit | None" = None) -> int:
+        """This container's ``aliyun.com/tpu-mem`` slice in bytes (units
+        are GiB unless the cluster runs ``--memory-unit=MiB``). The
+        serving engine sizes its KV slot pool from exactly this number
+        (``serving.engine.slots_from_pod_env``)."""
+        u = unit if unit is not None else const.MemoryUnit.GiB
+        return self.mem_units_container * u.num_bytes
+
     @classmethod
     def from_env(cls, env: Mapping[str, str] | None = None) -> "PodTpuEnv":
         e = os.environ if env is None else env

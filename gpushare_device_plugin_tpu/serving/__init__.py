@@ -1,0 +1,20 @@
+"""Serving layer: the continuous-batching engine over a slot-pool KV cache.
+
+``engine`` is the subsystem the HBM slices exist for: requests are
+admitted into fixed KV-cache slots and retired per decode step, with
+chunked prefill interleaved between decode steps — see
+``docs/serving.md`` (continuous batching) and ``workloads/generate.py``
+for the slot-cache primitives it composes.
+"""
+
+from .engine import (  # noqa: F401
+    Request,
+    RequestResult,
+    ServeStats,
+    SlotEngine,
+    kv_slot_bytes,
+    poisson_trace,
+    run_static_baseline,
+    slots_for_slice,
+    slots_from_pod_env,
+)

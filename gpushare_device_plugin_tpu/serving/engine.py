@@ -1,0 +1,598 @@
+"""Continuous-batching serving engine: slot-based KV cache, prefill/decode
+interleaving, slice-aware admission.
+
+The static path (``workloads.generate.generate``) is lockstep: every
+request in a batch runs all ``max_new`` steps and nothing is admitted
+until the whole batch retires — short requests subsidize long ones and a
+queued request's TTFT is a full batch lifetime. This engine replaces the
+batch with a fixed pool of KV-cache **slots** (``init_slot_cache``):
+
+- **Admission** packs a waiting request into any free slot row via
+  :func:`~..workloads.generate.prefill_slot` /
+  :func:`~..workloads.generate.extend_slot` — chunked prefill, one fixed
+  -width chunk between decode steps, so in-flight slots keep decoding
+  while a newcomer's prompt streams in.
+- **Decode** advances every occupied slot one token per step through the
+  per-slot :func:`~..workloads.generate.decode_step` (vector ``len``);
+  a slot that emits EOS (or exhausts its ``max_new``) retires and frees
+  its row IMMEDIATELY — the next step can admit into it.
+- **Static shapes throughout**: the pool, chunk width, and step batch
+  never change shape, so XLA compiles exactly three programs (fresh-slot
+  prefill, continuation chunk, decode step) once each; slot churn
+  performs zero retraces (``trace_counts``, guarded in tests and the
+  serve bench).
+
+Greedy decoding only: the engine's contract is that every request's
+tokens are bit-identical to a solo greedy ``generate()`` call — the
+property the serving-correctness tests pin, and what makes goodput
+comparisons against the static baseline apples-to-apples.
+
+**Clocks.** Arrivals and latencies are tracked on two clocks: wall
+seconds, and *ticks* — one tick per model dispatch (a prefill chunk or
+one pool-wide decode step). The tick clock is deterministic (no timer
+jitter), so the smoke test's continuous-vs-static guards can be exact;
+wall numbers are what the bench reports.
+
+**Slice-aware sizing.** :func:`slots_for_slice` derives the slot-pool
+size from a pod's ``aliyun.com/tpu-mem`` HBM slice (weights + per-slot
+KV bytes + headroom), and :func:`slots_from_pod_env` reads the slice
+straight from the plugin-injected container env — the loop back to the
+device plugin this repo exists for (``docs/serving.md`` sizing table).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import time
+from collections import deque
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..const import MemoryUnit
+from ..parallel.podenv import PodTpuEnv
+from ..workloads import generate as G
+from ..workloads.transformer import TransformerConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class Request:
+    """One serving request (host-side). ``arrival`` is in engine ticks."""
+
+    rid: int
+    prompt: tuple[int, ...]
+    max_new: int
+    arrival: float = 0.0
+
+    def __post_init__(self):
+        if len(self.prompt) < 1:
+            raise ValueError(f"request {self.rid}: empty prompt")
+        if self.max_new < 1:
+            raise ValueError(f"request {self.rid}: max_new must be >= 1")
+
+
+@dataclasses.dataclass
+class RequestResult:
+    """Per-request outcome + latency telemetry (both clocks)."""
+
+    rid: int
+    prompt_len: int
+    tokens: list[int]
+    arrival_tick: float
+    first_token_tick: int = -1
+    finish_tick: int = -1
+    arrival_s: float = 0.0
+    first_token_s: float = 0.0
+    finish_s: float = 0.0
+
+    @property
+    def ttft_ticks(self) -> float:
+        return self.first_token_tick - self.arrival_tick
+
+    @property
+    def ttft_s(self) -> float:
+        return self.first_token_s - self.arrival_s
+
+
+@dataclasses.dataclass
+class ServeStats:
+    """One serving run's results + aggregate metrics."""
+
+    results: list[RequestResult]
+    ticks: int
+    wall_s: float
+    trace_counts: dict[str, int]
+
+    @staticmethod
+    def _quantile(vals: list[float], q: float) -> float:
+        if not vals:
+            return float("nan")
+        s = sorted(vals)
+        return s[min(len(s) - 1, int(math.ceil(q * len(s))) - 1)]
+
+    def summary(self) -> dict:
+        """Flat metrics dict (the serve bench's report row)."""
+        tokens = sum(len(r.tokens) for r in self.results)
+        ttft_t = [r.ttft_ticks for r in self.results]
+        ttft_s = [r.ttft_s for r in self.results]
+        return {
+            "requests": len(self.results),
+            "tokens": tokens,
+            "ticks": self.ticks,
+            "wall_s": round(self.wall_s, 4),
+            # Goodput: completed requests' generated tokens over makespan
+            # (post-EOS padding never exists here — retirement is
+            # immediate — so every counted token is useful).
+            "goodput_tokens_per_s": round(tokens / self.wall_s, 1)
+            if self.wall_s > 0 else None,
+            "goodput_tokens_per_tick": round(tokens / max(self.ticks, 1), 3),
+            "ttft_p50_ticks": self._quantile(ttft_t, 0.50),
+            "ttft_p99_ticks": self._quantile(ttft_t, 0.99),
+            "ttft_p50_ms": round(self._quantile(ttft_s, 0.50) * 1e3, 2),
+            "ttft_p99_ms": round(self._quantile(ttft_s, 0.99) * 1e3, 2),
+            "trace_counts": dict(self.trace_counts),
+        }
+
+
+@dataclasses.dataclass
+class _Slot:
+    state: str = "free"  # free | prefill | decode
+    req: Request | None = None
+    done: int = 0  # prompt tokens prefilled so far
+    last: int = 0  # last sampled token (decode input)
+    result: RequestResult | None = None
+
+
+class SlotEngine:
+    """Continuous-batching engine over ``slots`` KV-cache rows.
+
+    ``prefill_chunk`` is the static prompt-chunk width (admission cost
+    granularity); ``max_len`` bounds each slot row (prompt + generated).
+    Admission is slice-aware up front: a request whose
+    ``prompt + max_new`` cannot fit a slot row is rejected at submit
+    time instead of overflowing mid-decode.
+    """
+
+    def __init__(
+        self,
+        params,
+        cfg: TransformerConfig,
+        *,
+        slots: int,
+        max_len: int,
+        prefill_chunk: int = 64,
+        eos_id: int | None = None,
+        kv_dtype: str | None = None,
+    ):
+        if slots < 1:
+            raise ValueError(f"slots must be >= 1, got {slots}")
+        if prefill_chunk < 1:
+            raise ValueError(f"prefill_chunk must be >= 1, got {prefill_chunk}")
+        if max_len > cfg.max_seq:
+            raise ValueError(
+                f"max_len {max_len} exceeds cfg.max_seq {cfg.max_seq} "
+                "(RoPE table bound)"
+            )
+        if prefill_chunk > max_len:
+            raise ValueError(
+                f"prefill_chunk {prefill_chunk} exceeds the slot row "
+                f"({max_len} positions) — even one chunk cannot be packed"
+            )
+        self.params = params
+        self.cfg = cfg
+        self.n_slots = slots
+        self.max_len = max_len
+        self.chunk = prefill_chunk
+        self.eos_id = eos_id
+        self.cache = G.init_slot_cache(cfg, slots, max_len, kv_dtype=kv_dtype)
+        self.ticks = 0
+        # One entry per compiled program; a counting wrapper increments at
+        # TRACE time, so steady-state slot churn must leave these frozen
+        # (the no-retrace guard the tests and serve bench assert).
+        self.trace_counts = {"prefill": 0, "extend": 0, "decode": 0}
+        self._build_fns()
+
+    def _build_fns(self) -> None:
+        cfg = self.cfg
+
+        def prefill_fn(params, tokens, cache, slot, n_real):
+            self.trace_counts["prefill"] += 1
+            logits, cache = G.prefill_slot(
+                params, tokens, cache, cfg, slot=slot, n_real=n_real
+            )
+            return jnp.argmax(logits[0], -1).astype(jnp.int32), cache
+
+        def extend_fn(params, tokens, cache, slot, n_real):
+            self.trace_counts["extend"] += 1
+            logits, cache = G.extend_slot(
+                params, tokens, cache, cfg, slot=slot, n_real=n_real
+            )
+            return jnp.argmax(logits[0], -1).astype(jnp.int32), cache
+
+        def decode_fn(params, tokens, cache, active):
+            self.trace_counts["decode"] += 1
+            logits, new = G.decode_step(params, tokens, cache, cfg)
+            nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+            # Idle rows (free slots, mid-prefill slots) must not advance:
+            # freeze their lengths so the next chunk/decode write lands
+            # where the slot's real content ends.
+            new = {**new, "len": jnp.where(active, new["len"], cache["len"])}
+            return nxt, new
+
+        # Caches are donated: the engine holds the only reference, and a
+        # slot pool big enough to matter should not be double-buffered in
+        # HBM on every step.
+        self._prefill = jax.jit(prefill_fn, donate_argnums=(2,))
+        self._extend = jax.jit(extend_fn, donate_argnums=(2,))
+        self._decode = jax.jit(decode_fn, donate_argnums=(2,))
+
+    def warmup(self) -> None:
+        """Compile all three programs off the clock (fresh-slot prefill,
+        continuation chunk, decode step) so a timed :meth:`run` starts
+        warm — the serving analog of the bench's warmup iterations. Slot
+        0's row is scribbled on, which is safe by the visibility
+        invariant; the tick clock is reset afterwards."""
+        # chunk + 1 tokens forces the continuation (extend) trace too,
+        # when the pool is big enough to ever admit a multi-chunk prompt
+        # (same footprint rule as validate).
+        plen = self.chunk + 1
+        if max(2 * self.chunk, plen + 2) > self.max_len:
+            plen = min(self.chunk, self.max_len - 2)
+        self.run([Request(rid=-1, prompt=tuple(range(1, plen + 1)),
+                          max_new=2, arrival=0.0)])
+        self.ticks = 0
+
+    def validate(self, req: Request) -> None:
+        # Every prefill write is a FULL chunk (static width; the pad tail
+        # is invisible), so the prompt's footprint is its chunk-padded
+        # length: a final chunk that straddled the row end would make
+        # dynamic_update_slice clamp the write start BACKWARDS over
+        # already-cached positions — silent KV corruption, not an error.
+        plen = len(req.prompt)
+        padded = -(-plen // self.chunk) * self.chunk
+        need = max(padded, plen + req.max_new)
+        if need > self.max_len:
+            raise ValueError(
+                f"request {req.rid}: prompt {plen} (chunk-padded {padded}) "
+                f"+ max_new {req.max_new} needs {need} positions, exceeding "
+                f"the slot row ({self.max_len}) — size the pool for the "
+                "workload or reject upstream (slice-aware admission)"
+            )
+
+    def _chunk_arrays(self, req: Request, done: int) -> tuple[jax.Array, int]:
+        real = req.prompt[done : done + self.chunk]
+        buf = np.zeros((self.chunk,), np.int32)
+        buf[: len(real)] = real
+        return jnp.asarray(buf), len(real)
+
+    def run(self, requests: Sequence[Request]) -> ServeStats:
+        """Serve ``requests`` to completion; returns results + metrics.
+
+        The loop per iteration: (1) move arrived requests to the pending
+        queue, (2) admit pending requests into free slots, (3) run ONE
+        prompt chunk for the oldest mid-prefill slot (chunked prefill —
+        bounded interference with decoding neighbors), (4) run one decode
+        step across all decoding slots. Each model dispatch advances the
+        tick clock by one.
+        """
+        for r in requests:
+            self.validate(r)
+        self.ticks = 0  # arrivals are relative to this run's start
+        incoming = sorted(requests, key=lambda r: (r.arrival, r.rid))
+        slots = [_Slot() for _ in range(self.n_slots)]
+        pending: deque[Request] = deque()
+        results: list[RequestResult] = []
+        live: dict[int, RequestResult] = {}
+        i = 0
+        t0 = time.perf_counter()
+
+        def now() -> float:
+            return time.perf_counter() - t0
+
+        def retire(idx: int) -> None:
+            s = slots[idx]
+            s.result.finish_tick = self.ticks
+            s.result.finish_s = now()
+            results.append(s.result)
+            slots[idx] = _Slot()
+
+        while i < len(incoming) or pending or any(
+            s.state != "free" for s in slots
+        ):
+            while i < len(incoming) and incoming[i].arrival <= self.ticks:
+                req = incoming[i]
+                live[req.rid] = RequestResult(
+                    rid=req.rid, prompt_len=len(req.prompt), tokens=[],
+                    arrival_tick=req.arrival, arrival_s=now(),
+                )
+                pending.append(req)
+                i += 1
+            busy = any(s.state != "free" for s in slots)
+            if not busy and not pending:
+                # Pool idle, nothing queued: jump the tick clock to the
+                # next arrival instead of spinning.
+                self.ticks = max(self.ticks, int(math.ceil(incoming[i].arrival)))
+                continue
+
+            for idx, s in enumerate(slots):
+                if s.state == "free" and pending:
+                    req = pending.popleft()
+                    slots[idx] = _Slot(
+                        state="prefill", req=req, done=0, result=live[req.rid]
+                    )
+
+            pre = [idx for idx, s in enumerate(slots) if s.state == "prefill"]
+            if pre:
+                idx = min(pre, key=lambda j: slots[j].result.arrival_tick)
+                s = slots[idx]
+                tokens, n_real = self._chunk_arrays(s.req, s.done)
+                fn = self._prefill if s.done == 0 else self._extend
+                tok, self.cache = fn(
+                    self.params, tokens, self.cache,
+                    np.int32(idx), np.int32(n_real),
+                )
+                self.ticks += 1
+                s.done += n_real
+                if s.done == len(s.req.prompt):
+                    first = int(tok)
+                    s.result.first_token_tick = self.ticks
+                    s.result.first_token_s = now()
+                    s.result.tokens.append(first)
+                    if (
+                        self.eos_id is not None and first == self.eos_id
+                    ) or s.req.max_new == 1:
+                        retire(idx)
+                    else:
+                        s.state = "decode"
+                        s.last = first
+
+            dec = [idx for idx, s in enumerate(slots) if s.state == "decode"]
+            if dec:
+                toks = np.zeros((self.n_slots,), np.int32)
+                active = np.zeros((self.n_slots,), bool)
+                for idx in dec:
+                    toks[idx] = slots[idx].last
+                    active[idx] = True
+                nxt, self.cache = self._decode(
+                    self.params, jnp.asarray(toks), self.cache,
+                    jnp.asarray(active),
+                )
+                self.ticks += 1
+                nxt = np.asarray(nxt)
+                for idx in dec:
+                    s = slots[idx]
+                    t = int(nxt[idx])
+                    s.result.tokens.append(t)
+                    s.last = t
+                    if (
+                        self.eos_id is not None and t == self.eos_id
+                    ) or len(s.result.tokens) >= s.req.max_new:
+                        retire(idx)
+
+        results.sort(key=lambda r: r.rid)
+        return ServeStats(
+            results=results, ticks=self.ticks,
+            wall_s=time.perf_counter() - t0,
+            trace_counts=dict(self.trace_counts),
+        )
+
+
+# ---------------------------------------------------------------------------
+# arrival drivers
+# ---------------------------------------------------------------------------
+
+
+def poisson_trace(
+    n: int,
+    *,
+    seed: int,
+    rate: float,
+    vocab: int,
+    prompt_lens: tuple[int, int],
+    max_new: tuple[int, int] | Sequence[int],
+) -> list[Request]:
+    """Mixed-length Poisson arrival trace: exponential inter-arrival gaps
+    at ``rate`` requests/tick, prompt lengths uniform over the (lo, hi)
+    inclusive range. ``max_new`` as a TUPLE draws uniformly over the
+    (lo, hi) range; a list draws from it as CHOICES — the
+    serving-realistic bimodal mix (many short answers, a few long
+    generations, e.g. ``[4, 4, 4, 40]``) that exposes lockstep's
+    short-subsidizes-long waste. The type, not the length, disambiguates
+    — a two-mode choices list like ``[4, 40]`` stays expressible.
+    Deterministic per seed — the replay driver is ``[Request(...)]``
+    literals."""
+    if isinstance(max_new, tuple) and len(max_new) != 2:
+        raise ValueError(
+            f"max_new tuple must be (lo, hi), got {max_new!r}; pass a list "
+            "for a choices mix"
+        )
+    rng = np.random.RandomState(seed)
+    choices = None if isinstance(max_new, tuple) else list(max_new)
+    t = 0.0
+    out = []
+    for rid in range(n):
+        t += float(rng.exponential(1.0 / rate))
+        plen = int(rng.randint(prompt_lens[0], prompt_lens[1] + 1))
+        mn = (
+            int(choices[rng.randint(len(choices))]) if choices is not None
+            else int(rng.randint(max_new[0], max_new[1] + 1))
+        )
+        out.append(
+            Request(
+                rid=rid,
+                prompt=tuple(int(x) for x in rng.randint(0, vocab, size=plen)),
+                max_new=mn,
+                arrival=t,
+            )
+        )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# static lockstep baseline
+# ---------------------------------------------------------------------------
+
+
+def run_static_baseline(
+    params,
+    cfg: TransformerConfig,
+    requests: Sequence[Request],
+    *,
+    batch: int,
+    eos_id: int | None = None,
+    kv_dtype: str | None = None,
+    warmup: bool = True,
+    trials: int = 1,
+) -> ServeStats:
+    """The pre-engine serving discipline, instrumented for comparison:
+    waves of up to ``batch`` requests run lockstep through ``generate()``
+    (one padded prefill + ``max_new`` decode steps for EVERYONE), and
+    nothing is admitted until the whole wave retires.
+
+    Fair-but-generous accounting: a wave is taken the moment the pool is
+    idle from whatever has ARRIVED (no waiting to fill the batch), the
+    whole wave's prefill costs one tick (the engine pays one per chunk),
+    and every wave decodes the GLOBAL max_new (lockstep cannot stop
+    early — that is the point) at one tick per step. A member's tokens
+    only exist when the batch call returns, so TTFT = wave completion −
+    arrival on both clocks: the full-batch-lifetime TTFT the engine
+    exists to fix. Tokens are truncated to each request's own
+    ``max_new``/EOS so goodput counts the same useful tokens the engine
+    produces (bit-identical, pinned by tests)."""
+    gmax = max(r.max_new for r in requests)
+    tp_max = max(len(r.prompt) for r in requests)
+    gen = G.make_generate(
+        cfg, max_new=gmax, eos_id=eos_id, padded=True, kv_dtype=kv_dtype
+    )
+    incoming = sorted(requests, key=lambda r: (r.arrival, r.rid))
+    if warmup:  # compile off the clock, like SlotEngine.warmup
+        np.asarray(gen(
+            params, jnp.zeros((batch, tp_max), jnp.int32),
+            jnp.ones((batch,), jnp.int32), jax.random.key(0),
+        ))
+    best: ServeStats | None = None
+    for _ in range(max(1, trials)):
+        results: list[RequestResult] = []
+        tick = 0
+        i = 0
+        t0 = time.perf_counter()
+        while i < len(incoming):
+            if incoming[i].arrival > tick:
+                tick = int(math.ceil(incoming[i].arrival))
+            arrived = [r for r in incoming[i:] if r.arrival <= tick]
+            wave = arrived[:batch]
+            i += len(wave)
+            # Fixed (batch, tp_max) shapes: one compile for the whole run.
+            prompts = np.zeros((batch, tp_max), np.int32)
+            lens = np.ones((batch,), np.int32)  # dummy rows: 1-token prompt
+            for row, r in enumerate(wave):
+                prompts[row, : len(r.prompt)] = r.prompt
+                lens[row] = len(r.prompt)
+            out = np.asarray(
+                gen(params, jnp.asarray(prompts), jnp.asarray(lens),
+                    jax.random.key(0))
+            )
+            tick += 1 + gmax  # one prefill tick + lockstep decode ticks
+            wall = time.perf_counter() - t0
+            for row, r in enumerate(wave):
+                toks = [int(x) for x in out[row, : r.max_new]]
+                if eos_id is not None and eos_id in toks:
+                    toks = toks[: toks.index(eos_id) + 1]
+                results.append(RequestResult(
+                    rid=r.rid, prompt_len=len(r.prompt), tokens=toks,
+                    arrival_tick=r.arrival,
+                    first_token_tick=tick, finish_tick=tick,
+                    first_token_s=wall, finish_s=wall,
+                ))
+        wall_total = time.perf_counter() - t0
+        # Tick arrivals have no live wall analog in a lockstep run (tokens
+        # only exist when a wave's batch call returns); convert them at the
+        # run's measured seconds-per-tick so wall TTFT compares
+        # like-for-like with the engine's live-observed arrivals.
+        spt = wall_total / max(tick, 1)
+        for res in results:
+            res.arrival_s = min(res.arrival_tick * spt, res.first_token_s)
+        results.sort(key=lambda r: r.rid)
+        stats = ServeStats(
+            results=results, ticks=tick, wall_s=wall_total, trace_counts={},
+        )
+        # Tokens/ticks are deterministic across trials; only wall time is
+        # noisy — keep the best-of-N wall, like the bench's _timeit.
+        if best is None or stats.wall_s < best.wall_s:
+            best = stats
+    return best
+
+
+# ---------------------------------------------------------------------------
+# slice-aware slot-pool sizing
+# ---------------------------------------------------------------------------
+
+
+def kv_slot_bytes(
+    cfg: TransformerConfig, max_len: int, kv_dtype: str | None = None
+) -> int:
+    """HBM bytes one slot row pins: K+V across layers at ``max_len``
+    positions (+ per-(token, head) scales for int8 caches)."""
+    itemsize = 1 if kv_dtype == "int8" else jnp.dtype(cfg.compute_dtype).itemsize
+    per = 2 * cfg.n_layers * max_len * cfg.kv_heads * cfg.head_dim * itemsize
+    if kv_dtype == "int8":
+        per += 2 * cfg.n_layers * max_len * cfg.kv_heads * 4  # f32 scales
+    return per
+
+
+def slots_for_slice(
+    slice_bytes: int,
+    cfg: TransformerConfig,
+    max_len: int,
+    *,
+    weight_bytes: int,
+    kv_dtype: str | None = None,
+    headroom: float = 0.90,
+) -> int:
+    """Slot-pool size a ``slice_bytes`` HBM slice sustains: weights come
+    off the top, ``headroom`` covers activations + XLA workspace (the
+    plugin's injected cap already shaves 5%, ``parallel/podenv.py``), and
+    the rest divides by per-slot KV bytes. 0 means the slice cannot serve
+    this config at all — callers must reject, not round up."""
+    if not 0.0 < headroom <= 1.0:
+        raise ValueError(f"headroom must be in (0, 1], got {headroom}")
+    usable = slice_bytes * headroom - weight_bytes
+    if usable <= 0:
+        return 0
+    return int(usable // kv_slot_bytes(cfg, max_len, kv_dtype))
+
+
+def slots_from_pod_env(
+    cfg: TransformerConfig,
+    max_len: int,
+    *,
+    weight_bytes: int,
+    env: PodTpuEnv | None = None,
+    kv_dtype: str | None = None,
+    headroom: float = 0.90,
+    unit: MemoryUnit = MemoryUnit.GiB,
+) -> int:
+    """Slot pool for THIS pod's ``aliyun.com/tpu-mem`` slice, read from
+    the plugin-injected env (:class:`~..parallel.podenv.PodTpuEnv`) — the
+    closing of the loop: the device plugin carves the slice, the engine
+    sizes its admission capacity to it. Raises when the slice cannot hold
+    even one slot (a misconfigured pod should fail loudly at startup, not
+    OOM mid-serve)."""
+    pod = env if env is not None else PodTpuEnv.from_env()
+    n = slots_for_slice(
+        pod.mem_bytes(unit), cfg, max_len,
+        weight_bytes=weight_bytes, kv_dtype=kv_dtype, headroom=headroom,
+    )
+    if n < 1:
+        raise ValueError(
+            f"slice of {pod.mem_units_container} {unit.value} cannot hold "
+            f"weights ({weight_bytes / 2**30:.2f} GiB) plus one "
+            f"{max_len}-position KV slot "
+            f"({kv_slot_bytes(cfg, max_len, kv_dtype) / 2**30:.3f} GiB) at "
+            f"headroom {headroom} — request a larger aliyun.com/tpu-mem "
+            "slice, shrink max_len, or quantize (kv_dtype='int8')"
+        )
+    return n

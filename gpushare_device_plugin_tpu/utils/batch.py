@@ -200,6 +200,15 @@ class GroupBatcher:
             with self._cond:
                 batch = self._gather()
                 if not batch:
+                    # Hand the restart duty off BEFORE dying: a submit()
+                    # racing the idle exit would otherwise see a
+                    # still-is_alive() thread that has already made its
+                    # final queue check, enqueue, and hang its ticket
+                    # until some unrelated later submit. Clearing _thread
+                    # under the lock makes that submit start a fresh
+                    # worker.
+                    if self._thread is threading.current_thread():
+                        self._thread = None
                     self._cond.notify_all()
                     return
             try:
@@ -218,6 +227,8 @@ class GroupBatcher:
                             ticket._fail(e)
                         self._completed += len(self._queue)
                         self._queue = []
+                        if self._thread is threading.current_thread():
+                            self._thread = None  # see idle-exit handoff
                         self._cond.notify_all()
                         return
                     self._cond.notify_all()

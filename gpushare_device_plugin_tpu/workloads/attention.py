@@ -34,6 +34,27 @@ def grouped_full_attention(
     return grouped_attention(q, k, v, causal=causal)
 
 
+def chunk_prefill_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, *, n_real: jax.Array,
+    attention: str = "auto",
+) -> jax.Array:
+    """Causal self-attention over a RIGHT-padded prompt chunk — the
+    fresh-slot prefill of the continuous-batching engine
+    (``workloads.generate.prefill_slot``).
+
+    q: [B, C, H, Dh]; k, v: [B, C, Hkv, Dh]; ``n_real`` (traced scalar or
+    [B]) counts each row's real tokens. Pads sit at the chunk's END, so
+    causality already hides them from every real query — plain causal
+    attention is exact as-is. The flash route forwards ``kv_len`` so the
+    kernel skips pad KV blocks' MXU work and keeps fully-padded tail rows
+    at exact zeros (the mirror image of the left-pad ``start`` input).
+    """
+    kv_len = jnp.broadcast_to(jnp.asarray(n_real, jnp.int32), (q.shape[0],))
+    if use_flash(attention, q, None, kv_heads=k.shape[2]):
+        return flash_attention(q, k, v, causal=True, kv_len=kv_len)
+    return grouped_attention(q, k, v, causal=True)
+
+
 def use_flash(
     attention: str,
     q: jax.Array,

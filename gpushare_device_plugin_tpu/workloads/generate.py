@@ -37,7 +37,7 @@ import jax.numpy as jnp
 
 from ..ops import flash_attention
 from ..parallel.ring import grouped_attention
-from .attention import flash_or_plain, use_flash
+from .attention import chunk_prefill_attention, flash_or_plain, use_flash
 from .quant import (
     dequantize_kv,
     embed_lookup,
@@ -46,8 +46,11 @@ from .quant import (
 )
 from .transformer import TransformerConfig, _mlp_block, _project_qkv, _rms_norm
 
-# {"k","v"}: [L, B, Smax, Hkv, Dh]; "len": []. int8 caches additionally
-# carry {"k_scale","v_scale"}: [L, B, Smax, Hkv] f32 (see init_cache).
+# {"k","v"}: [L, B, Smax, Hkv, Dh]; "len": [] (batch caches) or [B]
+# (slot-pool caches, one independent sequence length per row — the
+# continuous-batching layout, see init_slot_cache). int8 caches
+# additionally carry {"k_scale","v_scale"}: [L, B, Smax, Hkv] f32 (see
+# init_cache).
 KVCache = dict[str, jax.Array]
 
 
@@ -79,8 +82,35 @@ def init_cache(
     }
 
 
+def init_slot_cache(
+    cfg: TransformerConfig, slots: int, max_len: int,
+    kv_dtype: str | None = None,
+) -> KVCache:
+    """Slot-pool cache for the continuous-batching engine
+    (``serving.engine``): same buffers as :func:`init_cache`, but ``len``
+    is a ``[slots]`` vector — every row is an independent sequence that
+    starts at its own position 0 and advances at its own pace, so a
+    retired row can be re-packed with a new request while its neighbors
+    keep decoding. All slot rows share one set of static-shaped buffers:
+    admission and retirement never change a traced shape."""
+    cache = init_cache(cfg, slots, max_len, kv_dtype=kv_dtype)
+    return {**cache, "len": jnp.zeros((slots,), jnp.int32)}
+
+
 def _cache_is_q8(cache: KVCache) -> bool:
     return "k_scale" in cache
+
+
+def _row_update(cache_rows: jax.Array, new: jax.Array, pos: jax.Array) -> jax.Array:
+    """Per-row cache insert: write ``new[b]`` into ``cache_rows[b]`` at
+    row offset ``pos[b]`` (the slot-pool analog of the batch path's single
+    scalar-offset ``dynamic_update_slice``). Starts clamp like
+    ``dynamic_update_slice`` — callers bound ``pos + T <= Smax``."""
+
+    def one(c, n, p):
+        return jax.lax.dynamic_update_slice(c, n, (p,) + (0,) * (c.ndim - 1))
+
+    return jax.vmap(one)(cache_rows, new, pos)
 
 
 def _padded_prefill_attention(q, k, v, pad, attention: str = "auto"):
@@ -175,6 +205,138 @@ def prefill(
     return logits[:, 0].astype(jnp.float32), cache
 
 
+def prefill_slot(
+    params: Any,
+    tokens: jax.Array,
+    cache: KVCache,
+    cfg: TransformerConfig,
+    *,
+    slot: jax.Array,
+    n_real: jax.Array,
+) -> tuple[jax.Array, KVCache]:
+    """Pack one request's opening prompt chunk into row ``slot`` of a
+    slot-pool cache (:func:`init_slot_cache`) — the single-row prefill the
+    continuous-batching engine runs when a freed slot admits a request.
+
+    tokens: [C] RIGHT-padded chunk (static width C, so admission never
+    retraces); ``n_real`` (traced scalar, 1..C) counts its real tokens;
+    ``slot`` (traced scalar) picks the row. The chunk runs the training
+    attention path causally — pads sit at the END, so real positions
+    never see them and plain causal attention is already exact; the
+    flash route additionally passes ``kv_len=n_real`` so pad KV blocks
+    cost no MXU work (``workloads.attention.chunk_prefill_attention``).
+    The row restarts at position 0: ``len[slot]`` becomes ``n_real``
+    regardless of the retired occupant, and the stale KV beyond it is
+    invisible by the visibility invariant (a cache position only becomes
+    visible in the same step that overwrites it).
+
+    Returns (last real position's logits [1, vocab] f32, cache) — the
+    logits the engine samples the request's first token from, exactly
+    :func:`prefill`'s last-position logits for the same prompt.
+    """
+    dt = cfg.compute_dtype
+    C = tokens.shape[0]
+    positions = jnp.arange(C)[None, :]
+    x = embed_lookup(params["embed"], tokens[None, :], dt)  # [1, C, d]
+
+    def layer(x, xs):
+        lp, _ = xs
+        h = _rms_norm(x, lp["ln1"])
+        q, k, v = _project_qkv(h, lp, cfg, positions)
+        attn = chunk_prefill_attention(q, k, v, n_real=n_real, attention=cfg.attention)
+        x = x + jnp.einsum("bthn,hnd->btd", attn, matmul_weight(lp["wo"], dt))
+        return _mlp_block(x, lp, cfg), (k, v)
+
+    x, (ks, vs) = jax.lax.scan(
+        layer, x, (params["layers"], jnp.arange(cfg.n_layers))
+    )
+    # ks/vs: [L, 1, C, Hkv, Dh] -> row `slot`, offset 0.
+    slot = jnp.asarray(slot, jnp.int32)
+    if _cache_is_q8(cache):
+        kq8, kscale = quantize_kv(ks)
+        vq8, vscale = quantize_kv(vs)
+        cache = {
+            "k": jax.lax.dynamic_update_slice(cache["k"], kq8, (0, slot, 0, 0, 0)),
+            "v": jax.lax.dynamic_update_slice(cache["v"], vq8, (0, slot, 0, 0, 0)),
+            "k_scale": jax.lax.dynamic_update_slice(
+                cache["k_scale"], kscale, (0, slot, 0, 0)
+            ),
+            "v_scale": jax.lax.dynamic_update_slice(
+                cache["v_scale"], vscale, (0, slot, 0, 0)
+            ),
+            "len": cache["len"],
+        }
+    else:
+        cache = {
+            "k": jax.lax.dynamic_update_slice(
+                cache["k"], ks.astype(cache["k"].dtype), (0, slot, 0, 0, 0)
+            ),
+            "v": jax.lax.dynamic_update_slice(
+                cache["v"], vs.astype(cache["v"].dtype), (0, slot, 0, 0, 0)
+            ),
+            "len": cache["len"],
+        }
+    cache["len"] = jax.lax.dynamic_update_slice(
+        cache["len"], jnp.asarray(n_real, jnp.int32)[None], (slot,)
+    )
+    # Last REAL position's logits (norm after the slice, like prefill).
+    x_last = jax.lax.dynamic_slice(
+        x, (0, jnp.asarray(n_real, jnp.int32) - 1, 0), (1, 1, x.shape[-1])
+    )
+    x_last = _rms_norm(x_last, params["final_norm"])
+    logits = jnp.einsum("btd,dv->btv", x_last, matmul_weight(params["out"], dt))
+    return logits[:, 0].astype(jnp.float32), cache
+
+
+def extend_slot(
+    params: Any,
+    tokens: jax.Array,
+    cache: KVCache,
+    cfg: TransformerConfig,
+    *,
+    slot: jax.Array,
+    n_real: jax.Array,
+) -> tuple[jax.Array, KVCache]:
+    """Continue a partially-prefilled slot row with its next prompt chunk
+    (chunked prefill): run ``tokens`` ([C] right-padded, ``n_real`` real)
+    through :func:`decode_block` against row ``slot``'s cache — the chunk
+    attends the row's existing prefix plus itself, the exact
+    speculative-verification math — then advance ``len[slot]`` by
+    ``n_real`` only (the pad tail is written but stays invisible).
+
+    The row is sliced out, processed as a [1, C] block, and written back,
+    so the other slots' rows are untouched bytes — interleaving this
+    between engine decode steps cannot perturb decoding neighbors.
+    Returns (position ``n_real - 1``'s logits [1, vocab] f32 — the
+    next-token logits when this is the prompt's final chunk, exactly what
+    solo :func:`prefill` would return — and the updated cache).
+    """
+    slot = jnp.asarray(slot, jnp.int32)
+    n_real = jnp.asarray(n_real, jnp.int32)
+    row = {
+        key: jax.lax.dynamic_slice_in_dim(val, slot, 1, axis=1)
+        for key, val in cache.items()
+        if key != "len"
+    }
+    pos = jax.lax.dynamic_slice(cache["len"], (slot,), (1,))  # [1] vector
+    row["len"] = pos
+    logits, row = decode_block(params, tokens[None, :], row, cfg)
+    new = {
+        key: jax.lax.dynamic_update_slice(
+            cache[key], row[key], (0, slot) + (0,) * (cache[key].ndim - 2)
+        )
+        for key in cache
+        if key != "len"
+    }
+    new["len"] = jax.lax.dynamic_update_slice(
+        cache["len"], pos + n_real, (slot,)
+    )
+    last = jax.lax.dynamic_slice(
+        logits, (0, n_real - 1, 0), (1, 1, logits.shape[-1])
+    )
+    return last[:, 0], new
+
+
 def decode_step(
     params: Any,
     token: jax.Array,
@@ -264,22 +426,43 @@ def decode_block(
     position match what T sequential decode_step calls would produce
     (pinned by tests). ``start`` ([B] leading pad counts) offsets RoPE
     positions per row and masks pad slots, as in :func:`prefill`.
+
+    With a slot-pool cache (``len`` a [B] vector, :func:`init_slot_cache`)
+    every row advances from its OWN length: cache inserts land at per-row
+    offsets, visibility and RoPE positions are per-row, and ``len`` grows
+    per-row by T — the primitive under the continuous-batching engine's
+    interleaved decode. Slot rows own their offsets outright (each starts
+    at position 0), so ``start`` does not compose with slot mode.
     """
     dt = cfg.compute_dtype
     B, T = tokens.shape
     pos0 = cache["len"]
-    positions = pos0 + jnp.arange(T)[None, :]  # [1, T] global positions
-    if start is not None:
-        positions = positions - start[:, None]  # [B, T] rope offsets
+    per_slot = pos0.ndim == 1
+    if per_slot and start is not None:
+        raise ValueError(
+            "start is the left-padded batch offset; slot-pool caches "
+            "(vector len) already carry per-row offsets"
+        )
+    if per_slot:
+        positions = pos0[:, None] + jnp.arange(T)[None, :]  # [B, T]
+    else:
+        positions = pos0 + jnp.arange(T)[None, :]  # [1, T] global positions
+        if start is not None:
+            positions = positions - start[:, None]  # [B, T] rope offsets
     positions = jnp.broadcast_to(positions, (B, T))
     x = embed_lookup(params["embed"], tokens, dt)  # [B, T, d]
     q8 = _cache_is_q8(cache)
     Smax = cache["k"].shape[2]
     idx = jnp.arange(Smax)
     # [B|1, T, Smax] visibility: cache prefix + block-causal, minus pads.
-    vis = idx[None, None, :] < (pos0 + jnp.arange(T) + 1)[None, :, None]
-    if start is not None:
-        vis = vis & (idx[None, None, :] >= start[:, None, None])
+    if per_slot:
+        vis = idx[None, None, :] < (
+            pos0[:, None] + jnp.arange(T)[None, :] + 1
+        )[:, :, None]
+    else:
+        vis = idx[None, None, :] < (pos0 + jnp.arange(T) + 1)[None, :, None]
+        if start is not None:
+            vis = vis & (idx[None, None, :] >= start[:, None, None])
 
     def layer(x, xs):
         if q8:
@@ -291,13 +474,24 @@ def decode_block(
         if q8:
             kq8, ks_new = quantize_kv(k)
             vq8, vs_new = quantize_kv(v)
-            k_cache = jax.lax.dynamic_update_slice(k_cache, kq8, (0, pos0, 0, 0))
-            v_cache = jax.lax.dynamic_update_slice(v_cache, vq8, (0, pos0, 0, 0))
-            k_scale = jax.lax.dynamic_update_slice(k_scale, ks_new, (0, pos0, 0))
-            v_scale = jax.lax.dynamic_update_slice(v_scale, vs_new, (0, pos0, 0))
+            if per_slot:
+                k_cache = _row_update(k_cache, kq8, pos0)
+                v_cache = _row_update(v_cache, vq8, pos0)
+                k_scale = _row_update(k_scale, ks_new, pos0)
+                v_scale = _row_update(v_scale, vs_new, pos0)
+            else:
+                k_cache = jax.lax.dynamic_update_slice(k_cache, kq8, (0, pos0, 0, 0))
+                v_cache = jax.lax.dynamic_update_slice(v_cache, vq8, (0, pos0, 0, 0))
+                k_scale = jax.lax.dynamic_update_slice(k_scale, ks_new, (0, pos0, 0))
+                v_scale = jax.lax.dynamic_update_slice(v_scale, vs_new, (0, pos0, 0))
             k_mat = dequantize_kv(k_cache, k_scale, q.dtype)
             v_mat = dequantize_kv(v_cache, v_scale, q.dtype)
             carry = (k_cache, v_cache, k_scale, v_scale)
+        elif per_slot:
+            k_cache = _row_update(k_cache, k.astype(k_cache.dtype), pos0)
+            v_cache = _row_update(v_cache, v.astype(v_cache.dtype), pos0)
+            k_mat, v_mat = k_cache, v_cache
+            carry = (k_cache, v_cache)
         else:
             k_cache = jax.lax.dynamic_update_slice(
                 k_cache, k.astype(k_cache.dtype), (0, pos0, 0, 0)

@@ -154,3 +154,42 @@ def test_concatenated_records_take_last(tmp_path):
     (tmp_path / "BENCH_r01.json").write_text(json.dumps(a) + json.dumps(b))
     p50, _ = bench.previous_p50(tmp_path)
     assert p50 == 2.0
+
+
+def _write_serve_record(tmp: Path, n: int, goodput: float, ttft: float) -> None:
+    rec = {
+        "n": n, "cmd": "python bench.py", "rc": 0,
+        "parsed": {
+            "metric": "allocate_p50_latency", "value": 1.0, "unit": "ms",
+            "serve_goodput_tokens_per_s": goodput,
+            "serve_ttft_p99_ms": ttft,
+        },
+    }
+    (tmp / f"BENCH_r{n:02d}.json").write_text(json.dumps(rec))
+
+
+def test_serve_guards_no_history_pass(tmp_path):
+    assert bench.serve_goodput_guard(1.0, tmp_path) is None
+    assert bench.serve_ttft_guard(999.0, tmp_path) is None
+    assert bench.serve_goodput_guard(None, tmp_path) is None
+    assert bench.serve_ttft_guard(None, tmp_path) is None
+
+
+def test_serve_goodput_guard_lower_is_worse(tmp_path):
+    """Throughput direction is inverted vs the latency guards: a DROP
+    >25% fails, growth never does."""
+    _write_serve_record(tmp_path, 1, goodput=1000.0, ttft=10.0)
+    assert bench.serve_goodput_guard(800.0, tmp_path) is None  # -20% < 25%
+    assert bench.serve_goodput_guard(2000.0, tmp_path) is None  # improvement
+    msg = bench.serve_goodput_guard(700.0, tmp_path)  # -30%
+    assert msg is not None and "serve goodput" in msg and "dropped" in msg
+    assert "BENCH_r01.json" in msg
+
+
+def test_serve_ttft_guard_regression_fails(tmp_path):
+    _write_serve_record(tmp_path, 1, goodput=1000.0, ttft=10.0)
+    assert bench.serve_ttft_guard(12.4, tmp_path) is None  # +24% < 25%
+    assert bench.serve_ttft_guard(5.0, tmp_path) is None  # improvement
+    msg = bench.serve_ttft_guard(13.0, tmp_path)  # +30%
+    assert msg is not None and "serve ttft_p99" in msg
+    assert "BENCH_r01.json" in msg

@@ -33,7 +33,10 @@ def test_bench_mfu_smoke_runs_clean():
     assert report["train"]["steps_timed"] >= 3
     assert report["train"]["tokens_per_s"] > 0
     assert report["decode"][0]["tokens_per_s"] > 0
-    assert report["sections"] == ["decode", "train", "flash", "serve"]
+    assert report["sections"] == [
+        "decode", "train", "flash", "serve", "serve_engine",
+    ]
+    assert report["serve_engine"]["retraces"] == 0
     serve = report["serve"]
     # weight-only int8 halves bf16 parameter HBM (scales are tiny)
     assert 1.8 < serve["hbm_saving_x"] < 2.2

@@ -173,6 +173,94 @@ def test_padded_prefill_flash_path_matches_plain(setup):
     assert (out_plain == out_flash).all()
 
 
+# --- edge hardening: empty prompt rows, first-token EOS ---------------------
+
+def test_mask_after_eos_first_token():
+    """EOS emitted as the very first token: position 0 keeps the EOS,
+    everything after is overwritten with EOS."""
+    gen = jnp.array([[3, 5, 7, 3, 9], [5, 3, 7, 9, 1]], jnp.int32)
+    out = G._mask_after_eos(gen, 3)
+    assert out.tolist() == [[3, 3, 3, 3, 3], [5, 3, 3, 3, 3]]
+
+
+def test_generate_first_token_eos_masks_whole_block(setup):
+    """A prompt whose greedy continuation STARTS with EOS must emit an
+    all-EOS generated block (first token kept, rest masked)."""
+    import numpy as np
+
+    cfg, params, _ = setup
+    probe = None
+    prefill_j = jax.jit(lambda p, t, c: G.prefill(p, t, c, cfg)[0])
+    cache = G.init_cache(cfg, 1, 16)
+    for seed in range(300):
+        rng = np.random.RandomState(seed)
+        cand = jnp.asarray(rng.randint(0, cfg.vocab, size=(1, 5)), jnp.int32)
+        if int(jnp.argmax(prefill_j(params, cand, cache), -1)[0]) == 3:
+            probe = cand
+            break
+    if probe is None:
+        pytest.skip("no prompt with first-token EOS under this seed model")
+    out = G.generate(params, probe, cfg, max_new=6, eos_id=3)
+    assert out[0, probe.shape[1]:].tolist() == [3] * 6
+
+
+@pytest.mark.parametrize("attention", ["plain", "flash"])
+def test_empty_prompt_row_padded_batch(setup, attention):
+    """A prompt_lens row of 0 (fully padded / empty prompt) must not
+    poison the batch: the empty row generates valid in-range tokens with
+    no NaN fallout (dead-row guards on both attention paths), and the
+    other rows still match their solo runs exactly."""
+    cfg, params, _ = setup
+    cfg_run = _cfg(attention=attention) if attention != "plain" else cfg
+    Tp = 16  # 8-aligned so the flash variant stays on the kernel
+    full = demo_batch(jax.random.key(31), 1, Tp, cfg.vocab)
+    prompt = jnp.concatenate([jnp.zeros((1, Tp), jnp.int32), full], axis=0)
+    lens = jnp.array([0, Tp], jnp.int32)
+    got = G.generate(params, prompt, cfg_run, max_new=5, prompt_lens=lens,
+                     eos_id=3)
+    assert got.shape == (2, 5)
+    assert bool(((got >= 0) & (got < cfg.vocab)).all())
+    alone = G.generate(params, full, cfg, max_new=5, eos_id=3)
+    assert got[1].tolist() == alone[0, Tp:].tolist()
+    # eos-mask invariant holds on the empty row too
+    row = got[0].tolist()
+    if 3 in row:
+        assert row[row.index(3):] == [3] * (5 - row.index(3))
+
+
+def test_empty_prompt_row_under_jit(setup):
+    """The padded-serving closure (make_generate(padded=True)) handles a
+    zero-length row without retrace surprises or NaN."""
+    cfg, params, _ = setup
+    gen = G.make_generate(cfg, max_new=4, padded=True, eos_id=3)
+    prompt = demo_batch(jax.random.key(33), 2, 7, cfg.vocab)
+    lens = jnp.array([0, 7], jnp.int32)
+    out = gen(params, prompt, lens, jax.random.key(0))
+    assert out.shape == (2, 4)
+    assert bool(((out >= 0) & (out < cfg.vocab)).all())
+
+
+def test_speculative_first_token_eos(spec_setup):
+    """Speculative decoding with a first-token-EOS continuation must
+    match greedy generate's all-EOS masked block exactly."""
+    t_cfg, d_cfg, t_params, d_params, _ = spec_setup
+    probe = None
+    for seed in range(200):
+        cand = demo_batch(jax.random.key(2000 + seed), 1, 6, t_cfg.vocab)
+        cache = G.init_cache(t_cfg, 1, 16)
+        logits, _ = G.prefill(t_params, cand, cache, t_cfg)
+        first = int(jnp.argmax(logits, -1)[0])
+        ref = G.generate(t_params, cand, t_cfg, max_new=8, eos_id=first)
+        spec = G.speculative_generate(
+            t_params, d_params, cand, t_cfg, d_cfg, max_new=8, k=3,
+            eos_id=first,
+        )
+        assert (spec == ref).all(), (seed, first)
+        probe = cand
+        break
+    assert probe is not None
+
+
 # --- sampling controls ------------------------------------------------------
 
 def test_sample_logits_top_k_one_is_greedy():

@@ -526,3 +526,26 @@ def test_informer_apply_batch_single_lock_pass():
     # an ERROR event stops the batch and surfaces for relist
     rv2, err2 = inf.apply_batch([("ERROR", {"code": 410})])
     assert rv2 is None and err2 == {"code": 410}
+
+
+def test_idle_exit_hands_off_restart_duty():
+    """Idle-exit/submit race: the worker must clear ``_thread`` UNDER THE
+    LOCK before dying, so a submit() racing the exit restarts a fresh
+    worker instead of enqueueing behind a thread that has already made
+    its final queue check (a ticket that would hang until some unrelated
+    later submit)."""
+    from gpushare_device_plugin_tpu.utils.batch import GroupBatcher
+
+    b = GroupBatcher(lambda items: None, window_s=0.0, idle_exit_s=0.05)
+    assert b.submit("a").wait(1.0) is None
+    deadline = time.monotonic() + 2.0
+    while time.monotonic() < deadline:
+        with b._cond:
+            if b._thread is None:
+                break
+        time.sleep(0.005)
+    with b._cond:
+        assert b._thread is None, "idle exit left a dead thread installed"
+    # a post-idle submit restarts cleanly and resolves
+    assert b.submit("b").wait(1.0) is None
+    b.stop()
