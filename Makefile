@@ -1,7 +1,7 @@
 PY ?= python
 PROTOC ?= protoc
 
-.PHONY: proto native test test-fast test-slow test-stress chaos chaos-restart lint bench bench-smoke bench-serve-smoke bench-wal e2e-kind
+.PHONY: proto native test test-fast test-slow test-stress chaos chaos-restart lint bench bench-smoke bench-serve-smoke bench-multichip-smoke bench-wal e2e-kind
 
 # Regenerate protobuf message classes (gRPC bindings are hand-written in
 # gpushare_device_plugin_tpu/plugin/api/api_grpc.py; grpc_tools is not
@@ -81,6 +81,15 @@ bench-smoke:
 # tests/test_bench_serve_smoke.py. See docs/serving.md.
 bench-serve-smoke:
 	$(PY) bench_mfu.py --serve-smoke
+
+# Multi-chip gang serving smoke (CPU, 8 forced virtual devices): the
+# serve_tp section alone — tensor-parallel SlotEngine across a simulated
+# granted gang vs the single-chip engine, hard-gated on bit-identical
+# tokens + zero retraces, with the MULTICHIP_r0*.json dry-run capture
+# folded into the report. Tier-1 runs it via
+# tests/test_bench_multichip_smoke.py. See docs/scheduling.md.
+bench-multichip-smoke:
+	$(PY) bench_mfu.py --multichip-smoke
 
 # Group-commit WAL A/B: the 16-way admission storm with the journal in
 # per-record-fsync ('always') then group-commit ('batch') mode. Reports

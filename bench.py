@@ -443,6 +443,193 @@ def _concurrent_rounds(
     return timed_pods, timed_wall, latencies
 
 
+def run_gang_storm(
+    workers: int,
+    rounds: int = 3,
+    shape: str = "2x1x1",
+    per_chip: int = 8,
+) -> dict:
+    """Gang-admission storm: ``workers`` threads storm Allocate() through
+    the real gRPC socket with MULTI-CHIP gang pods against one node
+    topology — the all-or-nothing claim protocol's hardest case (every
+    worker races for overlapping sub-slices of the same grid). Per round
+    the host packs exactly full with gangs; the audit then asserts the
+    two invariants the gang ledger exists for:
+
+    - **zero partial grants** — every pod is either fully granted (all
+      member chips + per-chip share persisted in one annotation set) or
+      untouched; a pod with SOME gang fields is a protocol violation;
+    - **zero double assignments** — per-chip sums over all gang members
+      never exceed chip capacity, and no two gangs share a chip beyond
+      its capacity.
+
+    Also reports mean ICI hops of the granted slices (the topology
+    scorer's objective) and aggregate gangs/s."""
+    from gpushare_device_plugin_tpu import const
+    from gpushare_device_plugin_tpu.allocator.cluster import ClusterAllocator
+    from gpushare_device_plugin_tpu.cluster.apiserver import ApiServerClient
+    from gpushare_device_plugin_tpu.cluster.informer import PodInformer
+    from gpushare_device_plugin_tpu.device import DeviceInventory
+    from gpushare_device_plugin_tpu.discovery import MockBackend
+    from gpushare_device_plugin_tpu.plugin import PluginConfig, TpuSharePlugin
+    from gpushare_device_plugin_tpu.topology import ChipTopology, shape_size
+
+    from fake_apiserver import FakeApiServer
+    from fake_kubelet import FakeKubelet
+    from k8s_fixtures import make_pod
+
+    tmp = tempfile.mkdtemp(prefix="tpushare-gbench-")
+    api = FakeApiServer()
+    api.add_node(NODE)
+    api.start()
+    kubelet = FakeKubelet(tmp)
+    kubelet.start()
+    client = ApiServerClient(api.url)
+    inv = DeviceInventory(MockBackend(num_chips=CHIPS, hbm_bytes=HBM_GIB << 30).chips())
+    informer = PodInformer(client, NODE).start()
+    allocator = ClusterAllocator(inv, client, informer, NODE)
+    plugin = TpuSharePlugin(
+        inv,
+        allocate_fn=allocator.allocate,
+        config=PluginConfig(plugin_dir=tmp, grpc_workers=max(8, workers + 4)),
+    )
+    plugin.serve()
+    reg = kubelet.wait_for_registration()
+    assert reg.resource_name == const.RESOURCE_MEM
+    kubelet.stub_for(reg.endpoint)
+
+    topo = ChipTopology.default_for(CHIPS)
+    n_members = shape_size(shape)
+    pod_units = per_chip * n_members
+    units_by_index = inv.units_by_index()
+    total_units = sum(units_by_index.values())
+    gangs_per_round = total_units // pod_units  # exact pack
+
+    def wait_until(pred, timeout=10.0):
+        deadline = time.perf_counter() + timeout
+        while time.perf_counter() < deadline:
+            if pred():
+                return True
+            time.sleep(0.001)
+        return False
+
+    partial_grants = 0
+    double_assignments = 0
+    hops: list[int] = []
+    timed_gangs = 0
+    timed_wall = 0.0
+    pod_seq = 0
+    try:
+        for rnd in range(rounds):
+            names = []
+            for _ in range(gangs_per_round):
+                name = f"gbench-{pod_seq}"
+                pod_seq += 1
+                api.add_pod(make_pod(
+                    name, pod_units, node=NODE,
+                    annotations={const.ANN_GANG_SHAPE: shape},
+                ))
+                names.append(name)
+            assert wait_until(
+                lambda: len(informer.pending_pods()) >= gangs_per_round
+            ), "informer never saw the round's pending gang pods"
+
+            jobs = list(range(gangs_per_round))
+            jobs_lock = threading.Lock()
+            errors: list[str] = []
+            barrier = threading.Barrier(workers + 1)
+
+            def worker():
+                barrier.wait()
+                while True:
+                    with jobs_lock:
+                        if not jobs:
+                            return
+                        jobs.pop()
+                    try:
+                        kubelet.allocate(
+                            reg.endpoint,
+                            [[f"g{i}" for i in range(pod_units)]],
+                        )
+                    except Exception as e:  # noqa: BLE001 — audited below
+                        errors.append(str(e))
+
+            threads = [
+                threading.Thread(target=worker, daemon=True)
+                for _ in range(workers)
+            ]
+            for t in threads:
+                t.start()
+            barrier.wait()
+            t0 = time.perf_counter()
+            for t in threads:
+                t.join(timeout=60.0)
+            wall = time.perf_counter() - t0
+            if any(t.is_alive() for t in threads):
+                raise AssertionError("gang storm workers hung past 60s")
+            if errors:
+                raise AssertionError(f"gang Allocate errors: {errors[:3]}")
+
+            # audit: all-or-nothing grants, per-chip capacity, hop stats
+            used_by_chip: dict[int, int] = {}
+            for name in names:
+                pod = client.get_pod("default", name)
+                ann = pod["metadata"].get("annotations", {})
+                gang_keys = [
+                    k for k in (
+                        const.ENV_GANG_CHIPS,
+                        const.ENV_GANG_PER_CHIP,
+                        const.ENV_ASSIGNED_FLAG,
+                    ) if ann.get(k) not in (None, "false")
+                ]
+                if len(gang_keys) not in (0, 3):
+                    partial_grants += 1
+                    continue
+                if not gang_keys:
+                    partial_grants += 1  # storm packs exactly: all must land
+                    continue
+                chips = [int(x) for x in ann[const.ENV_GANG_CHIPS].split(",")]
+                per = int(ann[const.ENV_GANG_PER_CHIP])
+                if len(chips) != n_members or len(set(chips)) != len(chips):
+                    partial_grants += 1
+                    continue
+                hops.append(topo.slice_hops(chips))
+                for c in chips:
+                    used_by_chip[c] = used_by_chip.get(c, 0) + per
+            for idx, used in used_by_chip.items():
+                if used > units_by_index.get(idx, 0):
+                    double_assignments += 1
+            if rnd > 0:
+                timed_gangs += gangs_per_round
+                timed_wall += wall
+            for name in names:
+                api.delete_pod("default", name)
+            assert wait_until(
+                lambda: all(
+                    informer.get_pod("default", n) is None for n in names
+                )
+            ), "informer never drained the round's gang pods"
+    finally:
+        plugin.stop()
+        kubelet.stop()
+        informer.stop()
+        api.stop()
+
+    return {
+        "workers": workers,
+        "shape": shape,
+        "per_chip_units": per_chip,
+        "gangs_per_round": gangs_per_round,
+        "rounds_timed": rounds - 1,
+        "throughput_gangs_s": (
+            round(timed_gangs / timed_wall, 1) if timed_wall else 0.0
+        ),
+        "partial_grants": partial_grants,
+        "double_assignments": double_assignments,
+        "mean_ici_hops": round(sum(hops) / len(hops), 2) if hops else None,
+    }
+
+
 def run_extender_bench(
     n_nodes: int = 32, pods_per_node: int = 30, iters: int = 30
 ) -> dict:
@@ -641,6 +828,17 @@ def wal_fsync_p99_guard(p99_ms: float | None, repo: Path) -> str | None:
     return _pct_trend_guard(
         p99_ms, repo, field="wal_fsync_p99_ms", label="wal_fsync_p99",
         unit="ms",
+    )
+
+
+def gang_storm_guard(gangs_s: float | None, repo: Path) -> str | None:
+    """Failure message when gang-admission throughput dropped
+    >P99_GUARD_PCT below the newest committed record carrying it; None
+    when within budget or no history. Lower is worse (throughput)."""
+    return _pct_trend_guard(
+        gangs_s, repo, field="gang_throughput_gangs_s",
+        label="gang storm throughput", fmt=".1f", unit=" gangs/s",
+        lower_is_worse=True,
     )
 
 
@@ -852,6 +1050,32 @@ def main(argv=None) -> int:
             file=sys.stderr,
         )
 
+    gang = {}
+    if args.workers > 0:
+        gang = run_gang_storm(
+            args.workers,
+            rounds=2 if args.smoke else 3,
+        )
+        print(
+            f"gang storm (workers={args.workers}, shape={gang['shape']}): "
+            f"throughput={gang['throughput_gangs_s']:.1f} gangs/s "
+            f"partial_grants={gang['partial_grants']} "
+            f"double_assignments={gang['double_assignments']} "
+            f"mean_ici_hops={gang['mean_ici_hops']}",
+            file=sys.stderr,
+        )
+        if gang["partial_grants"] or gang["double_assignments"]:
+            # correctness, not performance: a partial gang or a double-
+            # booked chip must fail the bench outright
+            print(json.dumps({"metric": "gang_storm", **gang}))
+            print(
+                f"GANG STORM FAILED: partial_grants="
+                f"{gang['partial_grants']} double_assignments="
+                f"{gang['double_assignments']}",
+                file=sys.stderr,
+            )
+            return 1
+
     extender = {}
     if not args.no_extender:
         extender = run_extender_bench(
@@ -902,7 +1126,13 @@ def main(argv=None) -> int:
         .get("engine", {}).get("goodput_tokens_per_s"),
         "serve_ttft_p99_ms": compute.get("serve_engine", {})
         .get("engine", {}).get("ttft_p99_ms"),
+        # Gang-admission storm numbers, hoisted like the WAL fields; the
+        # zero-partial/zero-double invariants already hard-failed above.
+        "gang_throughput_gangs_s": gang.get("throughput_gangs_s"),
+        "gang_partial_grants": gang.get("partial_grants"),
+        "gang_double_assignments": gang.get("double_assignments"),
         "concurrent": concurrent,
+        "gang": gang,
         "extender": extender,
         "compute": compute,
     }
@@ -918,6 +1148,7 @@ def main(argv=None) -> int:
         msgs.append(wal_fsync_p99_guard(record["wal_fsync_p99_ms"], repo))
         msgs.append(serve_goodput_guard(record["serve_goodput_tokens_per_s"], repo))
         msgs.append(serve_ttft_guard(record["serve_ttft_p99_ms"], repo))
+        msgs.append(gang_storm_guard(record["gang_throughput_gangs_s"], repo))
     if not args.no_util_guard:
         msgs.append(utilization_guard(record["binpack_utilization_pct"], repo))
     failed = [m for m in msgs if m is not None]

@@ -41,6 +41,7 @@ from __future__ import annotations
 import contextlib
 import threading
 import time
+from typing import Sequence
 
 from ..utils.metrics import REGISTRY, timed_acquire
 
@@ -89,6 +90,11 @@ class AssumeCache:
         self._claimed: dict[PodKey, float] = {}  # key -> stamp
         self._mem: dict[PodKey, tuple[int, int]] = {}  # key -> (chip, units)
         self._core: dict[PodKey, tuple[int, ...]] = {}  # key -> chip indices
+        # key -> ((chip, units), ...): one multi-chip gang reservation.
+        # A gang is ONE ledger entry by construction — reserve, release,
+        # and TTL expiry are atomic over every member chip, so no code
+        # path can ever observe (or leave behind) a partial gang.
+        self._gang: dict[PodKey, tuple[tuple[int, int], ...]] = {}
         self._stamps: dict[PodKey, float] = {}  # reservation stamps
         # Legacy full-serialization lock for list-backed pod sources: they
         # expose no get_pod, so a worker cannot re-verify a candidate
@@ -126,6 +132,7 @@ class AssumeCache:
             self._claimed.pop(key, None)
             self._mem.pop(key, None)
             self._core.pop(key, None)
+            self._gang.pop(key, None)
             self._stamps.pop(key, None)
 
     def release_if_unclaimed(self, key: PodKey) -> bool:
@@ -140,10 +147,13 @@ class AssumeCache:
             return True
 
     def _release_expired(self, key: PodKey, kind: str) -> None:
-        """Caller must hold self._lock."""
+        """Caller must hold self._lock. A gang entry drops ALL member
+        chips here in one pass — expiry can never strand a single-chip
+        sliver of a partially-admitted gang."""
         self._claimed.pop(key, None)
         self._mem.pop(key, None)
         self._core.pop(key, None)
+        self._gang.pop(key, None)
         self._stamps.pop(key, None)
         REGISTRY.counter_inc(EXPIRED_METRIC, EXPIRED_HELP, kind=kind)
 
@@ -160,16 +170,28 @@ class AssumeCache:
                     released.append(key)
             for key, stamp in list(self._stamps.items()):
                 if now - stamp > self._ttl:
-                    kind = "mem" if key in self._mem else "core"
+                    if key in self._mem:
+                        kind = "mem"
+                    elif key in self._gang:
+                        kind = "gang"
+                    else:
+                        kind = "core"
                     self._release_expired(key, kind)
                     released.append(key)
         return released
 
     def snapshot(self) -> tuple[dict[PodKey, float], dict, dict]:
         """Introspection for the drift reconciler: (claims with stamps,
-        mem reservations, core reservations) — copies."""
+        mem reservations, core reservations) — copies. Gang reservations
+        are a separate family; see :meth:`gang_snapshot`."""
         with self._lock:
             return dict(self._claimed), dict(self._mem), dict(self._core)
+
+    def gang_snapshot(self) -> dict[PodKey, tuple[tuple[int, int], ...]]:
+        """Copies of the in-flight gang reservations
+        (key -> ((chip, units), ...)) for the reconciler/CLI."""
+        with self._lock:
+            return dict(self._gang)
 
     # --- reservations (call within transaction()) -------------------------
 
@@ -190,6 +212,20 @@ class AssumeCache:
     def reserve_core(self, key: PodKey, chip_indices: list[int]) -> None:
         with self._lock:
             self._core[key] = tuple(chip_indices)
+            self._stamps[key] = self._clock()
+
+    def reserve_gang(
+        self, key: PodKey, members: Sequence[tuple[int, int]]
+    ) -> None:
+        """Reserve ``members`` ((chip, units) per gang member) as ONE
+        atomic entry: a concurrent placement overlaying the ledger sees
+        either every member chip claimed or none — the all-or-nothing
+        half of the gang protocol that the PATCH (one write of all member
+        annotations) completes on the persist side."""
+        if not members:
+            raise ValueError("gang reservation needs at least one member")
+        with self._lock:
+            self._gang[key] = tuple((int(c), int(u)) for c, u in members)
             self._stamps[key] = self._clock()
 
     def overlaid_state(
@@ -216,12 +252,17 @@ class AssumeCache:
             self.expire_stale()  # lazy TTL reaping on every overlay read
             mem = list(self._mem.items())
             core = list(self._core.items())
+            gang = list(self._gang.items())
         if visible_fn is not None:
             mem = [(k, v) for k, v in mem if not visible_fn(k)]
             core = [(k, v) for k, v in core if not visible_fn(k)]
+            gang = [(k, v) for k, v in gang if not visible_fn(k)]
         mem_used, core_held = state_fn()
         for _key, (idx, units) in mem:
             mem_used[idx] = mem_used.get(idx, 0) + units
+        for _key, members in gang:
+            for idx, units in members:
+                mem_used[idx] = mem_used.get(idx, 0) + units
         for _key, indices in core:
             core_held.update(indices)
         return mem_used, core_held

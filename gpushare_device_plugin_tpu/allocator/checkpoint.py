@@ -681,6 +681,17 @@ def replay_checkpoint(ckpt: AllocationCheckpoint, assume: AssumeCache) -> int:
             except (TypeError, ValueError):
                 log.warning("checkpoint replay: malformed core entry for %s", key)
                 continue
+        elif kind == "gang":
+            # one atomic gang entry: every member chip replays protected
+            # together (a partial replay would be exactly the stranded
+            # sliver the gang protocol forbids)
+            try:
+                per = int(data["per_chip"])
+                members = [(int(i), per) for i in (data.get("chips") or [])]
+                assume.reserve_gang(key, members)
+            except (KeyError, TypeError, ValueError):
+                log.warning("checkpoint replay: malformed gang entry for %s", key)
+                continue
         else:
             log.warning("checkpoint replay: unknown entry kind %r for %s", kind, key)
             continue

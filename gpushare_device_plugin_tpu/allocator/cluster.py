@@ -43,6 +43,7 @@ valid placement, never the same one.
 from __future__ import annotations
 
 import contextlib
+import dataclasses
 import threading
 import time
 from typing import Sequence
@@ -54,13 +55,19 @@ from ..cluster.events import REASON_ALLOC_FAILED, emit_pod_event
 from ..cluster.podsource import PodSource
 from ..cluster.usage import pod_counts_toward_usage
 from ..device.fanout import DeviceInventory
+from ..topology import ChipTopology, format_shape, pad3, parse_shape, shape_size
 from ..utils.faults import FAULTS
 from ..utils.log import get_logger
 from ..utils.metrics import timed_acquire
 from .assume import LOCK_WAIT_HELP, LOCK_WAIT_METRIC, AssumeCache, PodKey
 from .checkpoint import StaleDaemonError
 from .binpack import assign_chip
-from .env import ContainerAllocation, build_core_allocation, build_mem_allocation
+from .env import (
+    ContainerAllocation,
+    build_core_allocation,
+    build_gang_allocation,
+    build_mem_allocation,
+)
 
 log = get_logger("allocator.cluster")
 
@@ -149,6 +156,17 @@ class AllocationFailure(RuntimeError):
     """Raised to fail pod admission (gRPC error -> UnexpectedAdmissionError)."""
 
 
+@dataclasses.dataclass(frozen=True)
+class GangPlacement:
+    """One gang decision: the member chips, the realized grid shape, and
+    the HBM units claimed on EACH member (``_place`` returns this instead
+    of a bare chip index for gang pods)."""
+
+    chips: tuple[int, ...]
+    shape: tuple[int, int, int]
+    per_chip: int
+
+
 class _PodGone(RuntimeError):
     """The matched pod 404ed on PATCH: deleted while its cache entry or
     DELETED watch event was in flight. Internal signal — the allocator
@@ -209,6 +227,7 @@ class ClusterAllocator:
         assume: AssumeCache | None = None,
         checkpoint=None,
         patcher=None,
+        chip_topology: ChipTopology | None = None,
     ):
         self._inv = inventory
         self._api = api
@@ -217,6 +236,12 @@ class ClusterAllocator:
         self._policy = policy
         self._disable_isolation = disable_isolation
         self._unhealthy_fn = unhealthy_chips_fn or (lambda: [])
+        # This node's chip grid for gang placement; defaults to the
+        # standard grid for the inventory's chip count (the same rule the
+        # extender applies from the node's topology label).
+        self._chip_topo = chip_topology or ChipTopology.default_for(
+            max(1, len(inventory.units_by_index()))
+        )
         # Optional coalesced PATCH transport (PodPatchPipeline.patch_pod):
         # concurrently-committed admissions batch their apiserver writes.
         self._patcher = patcher
@@ -239,7 +264,30 @@ class ClusterAllocator:
         container_units = [len(ids) for ids in granted]
         log.v(4, "Allocate: pod_units=%d per-container=%s", pod_units, container_units)
         with _serial_guard(self._pods, self._assume):
-            idx, pod = self._admit(pod_units)
+            placement, pod = self._admit(pod_units)
+        if isinstance(placement, GangPlacement):
+            chips_by_id = {c.id: c for c in self._inv.chips()}
+            members = [
+                chips_by_id[self._inv.id_of_index(i)] for i in placement.chips
+            ]
+            log.info(
+                "allocated gang pod %s/%s: %d units/chip on chips %s (shape %s)",
+                P.namespace(pod), P.name(pod), placement.per_chip,
+                list(placement.chips), placement.shape,
+            )
+            return [
+                build_gang_allocation(
+                    chips=members,
+                    shape=placement.shape,
+                    per_chip_units=placement.per_chip,
+                    chip_total_units=self._chip_total(placement.chips[0]),
+                    pod_units=pod_units,
+                    container_units=n,
+                    disable_isolation=self._disable_isolation,
+                )
+                for n in container_units
+            ]
+        idx = placement
         chip = self._inv.chip_by_id(self._inv.id_of_index(idx))
         total = self._chip_total(idx)
         log.info(
@@ -272,14 +320,24 @@ class ClusterAllocator:
         try:
             try:
                 for attempt in (0, 1):
-                    idx, annotations = self._place(pod, pod_units)
+                    placement, annotations = self._place(pod, pod_units)
                     key = _pod_key(pod)
-                    _journal_begin(self._ckpt, key, {
-                        "kind": "mem",
-                        "idx": idx,
-                        "units": pod_units,
-                        "annotations": annotations,
-                    })
+                    if isinstance(placement, GangPlacement):
+                        journal = {
+                            "kind": "gang",
+                            "chips": list(placement.chips),
+                            "shape": list(placement.shape),
+                            "per_chip": placement.per_chip,
+                            "annotations": annotations,
+                        }
+                    else:
+                        journal = {
+                            "kind": "mem",
+                            "idx": placement,
+                            "units": pod_units,
+                            "annotations": annotations,
+                        }
+                    _journal_begin(self._ckpt, key, journal)
                     try:
                         self._persist(pod, annotations)
                         FAULTS.fire("allocator.post_persist")
@@ -324,7 +382,7 @@ class ClusterAllocator:
             # outlive this admission.
             if pod is not None:
                 self._assume.release(_pod_key(pod))
-        return idx, pod
+        return placement, pod
 
     # ------------------------------------------------------------------
 
@@ -403,6 +461,8 @@ class ClusterAllocator:
                 f"{const.RESOURCE_CORE}; dual-resource pods are unsupported "
                 "(the two allocators would race each other's assigned flag)"
             )
+        if P.gang_shape_request(pod):
+            return self._place_gang(pod, pod_units)
         with self._assume.transaction():
             mem_used, core_held = self._assume.overlaid_state(
                 self._pods.chip_state,
@@ -422,6 +482,136 @@ class ClusterAllocator:
             self._assume.reserve_mem(_pod_key(pod), idx, pod_units)
         annotations[const.ENV_ASSUME_TIME] = str(time.time_ns())
         return idx, annotations
+
+    def _place_gang(self, pod, pod_units: int) -> tuple[GangPlacement, dict[str, str]]:
+        """Gang placement: decide (or honor) the member chip set for a
+        multi-chip pod and reserve EVERY member atomically.
+
+        Branch A trusts the extender's persisted gang annotations (like
+        the single-chip assumed path) after re-validating them against
+        the live overlay — a core pod may have grabbed a member chip in
+        the window. Branch B re-runs the topology scorer over the
+        overlaid free vector. Either way the decision enters the ledger
+        as one gang entry inside one transaction: a concurrent placement
+        sees all member chips claimed or none, never a partial gang.
+        """
+        shape_raw = P.gang_shape_request(pod)
+        try:
+            size = shape_size(shape_raw)
+        except ValueError as e:
+            raise AllocationFailure(
+                f"pod {P.name(pod)} has invalid gang shape "
+                f"{shape_raw!r}: {e}"
+            ) from e
+        if size < 1 or pod_units % size != 0:
+            raise AllocationFailure(
+                f"pod {P.name(pod)}: {pod_units} {const.RESOURCE_MEM} units "
+                f"do not divide evenly over gang shape {shape_raw!r} "
+                f"({size} chips)"
+            )
+        per_chip = pod_units // size
+        units_by_index = self._inv.units_by_index()
+        with self._assume.transaction():
+            mem_used, core_held = self._assume.overlaid_state(
+                self._pods.chip_state,
+                visible_fn=lambda key: _counted_by_source(self._pods, key),
+            )
+            excluded = set(self._unhealthy_fn()) | core_held
+            assumed_chips = (
+                P.gang_chips_from_annotation(pod)
+                if P.is_assumed(pod) and not P.is_assigned(pod)
+                else []
+            )
+            if assumed_chips:
+                placement = self._assumed_gang(
+                    pod, assumed_chips, per_chip, units_by_index,
+                    mem_used, excluded,
+                )
+                annotations = {const.ENV_ASSIGNED_FLAG: "true"}
+            else:
+                free = {
+                    i: cap - mem_used.get(i, 0)
+                    for i, cap in units_by_index.items()
+                }
+                cand = self._chip_topo.best_slice(
+                    shape_raw, free, per_chip,
+                    capacity=units_by_index, excluded=excluded,
+                )
+                if cand is None:
+                    raise AllocationFailure(
+                        f"no {shape_raw} sub-slice with {per_chip} free "
+                        f"units per chip on {self._node} "
+                        f"(free: {free}, excluded: {sorted(excluded)})"
+                    )
+                placement = GangPlacement(
+                    chips=cand.chips, shape=cand.shape, per_chip=per_chip
+                )
+                annotations = {
+                    const.ENV_GANG_CHIPS: ",".join(str(i) for i in cand.chips),
+                    const.ENV_GANG_SHAPE: format_shape(cand.shape),
+                    const.ENV_GANG_PER_CHIP: str(per_chip),
+                    const.ENV_MEM_POD: str(pod_units),
+                    const.ENV_MEM_DEV: str(self._chip_total(cand.chips[0])),
+                    const.ENV_ASSIGNED_FLAG: "true",
+                }
+            self._assume.reserve_gang(
+                _pod_key(pod), [(i, per_chip) for i in placement.chips]
+            )
+        annotations[const.ENV_ASSUME_TIME] = str(time.time_ns())
+        return placement, annotations
+
+    def _assumed_gang(
+        self, pod, chips, per_chip, units_by_index, mem_used, excluded
+    ) -> GangPlacement:
+        """Branch A for gangs: honor the extender's member set, but
+        re-validate every chip against the live overlay — all-or-nothing,
+        so ONE bad member fails the whole gang (the kubelet retry re-runs
+        placement from scratch)."""
+        size = P.mem_units_of_pod(pod) // per_chip if per_chip else 0
+        if len(chips) != size or len(set(chips)) != len(chips):
+            # The annotation is user-writable: a truncated or duplicated
+            # member list would book per_chip over the WRONG set (under-
+            # reserving the claim, or stacking one chip twice) — reject
+            # the whole gang rather than trust a garbled grant.
+            raise AllocationFailure(
+                f"pod {P.name(pod)} gang annotation lists chips {chips} "
+                f"but the {P.mem_units_of_pod(pod)}-unit request at "
+                f"{per_chip} units/chip needs {size} distinct members"
+            )
+        for idx in chips:
+            if idx not in units_by_index:
+                raise AllocationFailure(
+                    f"pod {P.name(pod)} assumed onto unknown gang chip {idx}"
+                )
+            if idx in excluded:
+                raise AllocationFailure(
+                    f"pod {P.name(pod)} assumed onto gang chip {idx}, which "
+                    "is core-held or unhealthy"
+                )
+            if mem_used.get(idx, 0) + per_chip > units_by_index[idx]:
+                raise AllocationFailure(
+                    f"pod {P.name(pod)} assumed onto gang chip {idx}, which "
+                    f"no longer has {per_chip} free units"
+                )
+        try:
+            shape = parse_shape(
+                P.annotations(pod).get(const.ENV_GANG_SHAPE, "")
+            )
+            size_of_shape = 1
+            for d in shape:
+                size_of_shape *= d
+            if size_of_shape != len(chips):
+                # stale/tampered shape annotation: a carve-out that does
+                # not match the member count would misconfigure libtpu at
+                # container startup — degrade to a line over the members
+                shape = (len(chips),)
+        except ValueError:
+            shape = (len(chips),)
+        shape3 = pad3(shape)
+        log.v(4, "extender gang placement for %s: chips %s", P.name(pod), chips)
+        return GangPlacement(
+            chips=tuple(sorted(chips)), shape=shape3, per_chip=per_chip
+        )
 
     def _assumed_chip(self, pod, core_held: set[int]) -> int:
         """Branch A: trust the scheduler extender's placement."""

@@ -102,3 +102,55 @@ def build_core_allocation(
                 DeviceMount(container_path=c.device_path, host_path=c.device_path)
             )
     return alloc
+
+
+def build_gang_allocation(
+    *,
+    chips: Sequence[TpuChip],
+    shape: Sequence[int],
+    per_chip_units: int,
+    chip_total_units: int,
+    pod_units: int,
+    container_units: int,
+    disable_isolation: bool = False,
+) -> ContainerAllocation:
+    """Payload for a topology-aware multi-chip gang container: every
+    member chip visible, the granted slice shape as the single-process
+    topology carve-out, and a PER-CHIP cooperative HBM cap (each chip of
+    the gang holds ``per_chip_units`` of ``chip_total_units``).
+
+    ``container_units`` is this container's share of the pod's TOTAL
+    (cross-chip) request; its per-chip fraction scales accordingly so a
+    two-container gang pod cannot double-claim a chip's slice.
+    """
+    from ..topology import format_shape, pad3
+
+    shape3 = pad3(tuple(shape))
+    envs = {
+        const.ENV_TPU_VISIBLE_CHIPS: visible_chips_value([c.index for c in chips]),
+        # one process owning the whole granted sub-slice: libtpu forms the
+        # per-process mesh from the shape carve-out
+        const.ENV_TPU_PROCESS_BOUNDS: "1,1,1",
+        const.ENV_TPU_CHIPS_PER_PROCESS_BOUNDS: ",".join(str(d) for d in shape3),
+        const.ENV_GANG_CHIPS: ",".join(str(c.index) for c in chips),
+        const.ENV_GANG_SHAPE: format_shape(shape3),
+        const.ENV_GANG_PER_CHIP: str(per_chip_units),
+        const.ENV_MEM_POD: str(pod_units),
+        const.ENV_MEM_CONTAINER: str(container_units),
+        const.ENV_MEM_DEV: str(chip_total_units),
+    }
+    if disable_isolation:
+        envs["CTPU_DISABLE"] = "true"
+    elif chip_total_units > 0 and chips:
+        units = container_units if container_units > 0 else pod_units
+        per_chip = units / len(chips)
+        frac = min(1.0, per_chip / chip_total_units)
+        envs[const.ENV_XLA_MEM_FRACTION] = f"{frac:.4f}"
+        envs[const.ENV_XLA_PYTHON_MEM_FRACTION] = f"{frac:.4f}"
+    alloc = ContainerAllocation(envs=envs)
+    for c in chips:
+        if c.device_path:
+            alloc.devices.append(
+                DeviceMount(container_path=c.device_path, host_path=c.device_path)
+            )
+    return alloc

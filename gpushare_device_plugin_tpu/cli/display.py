@@ -66,18 +66,42 @@ def render_summary(infos: list[NodeInfo]) -> str:
     return buf.getvalue()
 
 
+def _gang_cell(pod, info: NodeInfo, unit: str) -> str:
+    """One gang pod's grant, rendered with grid coordinates: e.g.
+    ``2x2x1 @ (0,0,0)(1,0,0)(0,1,0)(1,1,0) · 8 GiB/chip``. Falls back to
+    bare indices when the node's grid is unknown."""
+    members = sorted(i for i in pod.units_by_chip if i != PENDING_IDX)
+    if info.topology is not None:
+        try:
+            coords = "".join(
+                "({},{},{})".format(*info.topology.coords(i)) for i in members
+            )
+        except ValueError:  # annotation points off the grid
+            coords = ",".join(f"chip{i}" for i in members)
+    else:
+        coords = ",".join(f"chip{i}" for i in members)
+    return f"{pod.gang_shape} @ {coords} · {pod.gang_per_chip} {unit}/chip"
+
+
 def render_details(infos: list[NodeInfo]) -> str:
     unit = infer_unit(infos)
     buf = StringIO()
     for info in infos:
         buf.write(f"NAME: {info.name} ({info.address})\n")
-        rows = [["NAMESPACE", "NAME", f"TPU MEMORY ({unit})", "CHIPS"]]
+        any_gang = any(p.is_gang for p in info.pods)
+        header = ["NAMESPACE", "NAME", f"TPU MEMORY ({unit})", "CHIPS"]
+        if any_gang:
+            header.append("GANG (shape @ coords)")
+        rows = [header]
         for pod in sorted(info.pods, key=lambda p: (p.namespace, p.name)):
             chips = ", ".join(
                 ("pending" if idx == PENDING_IDX else f"chip{idx}") + f":{units}"
                 for idx, units in sorted(pod.units_by_chip.items())
             )
-            rows.append([pod.namespace, pod.name, str(pod.total_units), chips])
+            row = [pod.namespace, pod.name, str(pod.total_units), chips]
+            if any_gang:
+                row.append(_gang_cell(pod, info, unit) if pod.is_gang else "-")
+            rows.append(row)
         buf.write(_table(rows))
         buf.write("\n")
         if info.core_holds:

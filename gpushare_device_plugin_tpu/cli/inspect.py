@@ -98,6 +98,20 @@ def render_json(infos: list) -> str:
                     "namespace": p.namespace,
                     "name": p.name,
                     "units_by_chip": {str(k): v for k, v in p.units_by_chip.items()},
+                    **(
+                        {
+                            "gang_shape": p.gang_shape,
+                            "gang_per_chip": p.gang_per_chip,
+                            "gang_coords": {
+                                str(i): list(n.topology.coords(i))
+                                for i in sorted(p.units_by_chip)
+                                if n.topology is not None
+                                and 0 <= i < n.topology.n_chips
+                            },
+                        }
+                        if p.is_gang
+                        else {}
+                    ),
                 }
                 for p in n.pods
             ],

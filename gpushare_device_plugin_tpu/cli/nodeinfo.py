@@ -16,6 +16,7 @@ import json
 from .. import const
 from ..cluster import pods as P
 from ..cluster.noderes import chip_capacity_vector
+from ..topology import ChipTopology
 
 PENDING_IDX = -1
 
@@ -25,10 +26,19 @@ class PodUsage:
     namespace: str
     name: str
     units_by_chip: dict[int, int]  # PENDING_IDX for unattributed
+    # multi-chip gang grants: the granted slice shape ("2x2x1") and the
+    # per-chip HBM share — the inspect CLI renders these with the member
+    # chips' grid coordinates instead of a single device column
+    gang_shape: str = ""
+    gang_per_chip: int = 0
 
     @property
     def total_units(self) -> int:
         return sum(self.units_by_chip.values())
+
+    @property
+    def is_gang(self) -> bool:
+        return bool(self.gang_shape) and len(self.units_by_chip) > 1
 
 
 @dataclasses.dataclass
@@ -57,6 +67,10 @@ class NodeInfo:
     pods: list[PodUsage]
     pending_units: int = 0
     core_holds: list[CoreHold] = dataclasses.field(default_factory=list)
+    # the node's chip grid (topology label or the default for its chip
+    # count) — lets the report print gang member COORDINATES, not bare
+    # indices
+    topology: ChipTopology | None = None
 
     @property
     def total_units(self) -> int:
@@ -95,6 +109,10 @@ def pod_allocation(pod: dict) -> dict[int, int]:
     Fallback: everything pending.
     """
     ann = P.annotations(pod)
+    gang = P.gang_usage_by_chip(pod)
+    if gang:
+        # multi-chip gang: the persisted member set IS the per-chip truth
+        return dict(gang)
     raw = ann.get(const.ANN_EXTENDER_ALLOCATION)
     if raw:
         try:
@@ -123,6 +141,7 @@ def build_node_info(
     """Pods must already be filtered to this node's active share pods;
     ``core_pods`` to its active whole-chip (tpu-core) pods."""
     capacity = chip_capacity_vector(node, const.RESOURCE_MEM, const.RESOURCE_COUNT)
+    topo = ChipTopology.from_node(node, len(capacity)) if capacity else None
     info = NodeInfo(
         name=node.get("metadata", {}).get("name", ""),
         address=node_address(node),
@@ -130,13 +149,20 @@ def build_node_info(
             i: DeviceInfo(index=i, total_units=per) for i, per in capacity.items()
         },
         pods=[],
+        topology=topo,
     )
     for pod in pods:
         usage = pod_allocation(pod)
         if not usage:
             continue
         info.pods.append(
-            PodUsage(namespace=P.namespace(pod), name=P.name(pod), units_by_chip=usage)
+            PodUsage(
+                namespace=P.namespace(pod),
+                name=P.name(pod),
+                units_by_chip=usage,
+                gang_shape=P.annotations(pod).get(const.ENV_GANG_SHAPE, ""),
+                gang_per_chip=P.gang_per_chip_units(pod),
+            )
         )
         for idx, units in usage.items():
             if idx == PENDING_IDX:

@@ -149,6 +149,69 @@ def core_ids_from_annotation(pod: Pod) -> list[int]:
     return out
 
 
+def gang_shape_request(pod: Pod) -> str:
+    """The gang shape this pod ASKS for (``ANN_GANG_SHAPE`` on its spec:
+    "2x2x1" or a bare count "4"), "" for ordinary single-chip pods. The
+    request annotation is user-written; validity is checked where it is
+    consumed (extender filter, allocator placement)."""
+    return str(annotations(pod).get(const.ANN_GANG_SHAPE, "") or "")
+
+
+def is_gang_pod(pod: Pod) -> bool:
+    return bool(gang_shape_request(pod)) and mem_units_of_pod(pod) > 0
+
+
+def gang_chips_from_annotation(pod: Pod) -> list[int]:
+    """Member chip indices of a GRANTED gang (``ENV_GANG_CHIPS``), [] when
+    absent/garbled — same tolerance as ``core_ids_from_annotation``."""
+    v = annotations(pod).get(const.ENV_GANG_CHIPS)
+    if not v:
+        return []
+    out: list[int] = []
+    for part in str(v).split(","):
+        try:
+            out.append(int(part))
+        except ValueError:
+            return []
+    return sorted(out)
+
+
+def gang_per_chip_units(pod: Pod) -> int:
+    """HBM units this gang claims on EACH member chip. Derived from the
+    IMMUTABLE spec (total limits / member count) whenever it divides —
+    the same tamper-resistance rule the single-chip audit gets from
+    counting ``mem_units_of_pod``: an edited ``ENV_GANG_PER_CHIP``
+    annotation must not shrink what every accounting layer books. The
+    persisted annotation is only the fallback for annotation sets whose
+    spec-derivation is impossible. 0 when underivable."""
+    chips = gang_chips_from_annotation(pod)
+    total = mem_units_of_pod(pod)
+    if chips and total > 0 and total % len(chips) == 0:
+        return total // len(chips)
+    v = annotations(pod).get(const.ENV_GANG_PER_CHIP)
+    if v is not None:
+        try:
+            per = int(v)
+            return per if per > 0 else 0
+        except ValueError:
+            return 0
+    return 0
+
+
+def gang_usage_by_chip(pod: Pod) -> dict[int, int]:
+    """Per-chip HBM units one granted gang pod holds ({} when the pod is
+    not an annotated gang). One helper so the allocator overlay, the
+    extender index, the reconciler audit, and the inspect CLI can never
+    disagree about what a gang holds."""
+    chips = gang_chips_from_annotation(pod)
+    if not chips:
+        return {}
+    per = gang_per_chip_units(pod)
+    if per <= 0:
+        return {}
+    return {idx: per for idx in chips}
+
+
 def assume_time_from_annotation(pod: Pod) -> int:
     v = annotations(pod).get(const.ENV_ASSUME_TIME)
     try:
@@ -212,6 +275,13 @@ def used_units_by_chip(pods: Iterable[Pod]) -> dict[int, int]:
         if labels(pod).get(const.LABEL_RESOURCE_KEY) != const.LABEL_RESOURCE_VALUE:
             continue
         if not is_assigned(pod):
+            continue
+        gang = gang_usage_by_chip(pod)
+        if gang:
+            # multi-chip gang: the pod's total spreads per-chip over its
+            # member chips (it deliberately carries no single IDX)
+            for idx, per in gang.items():
+                used[idx] = used.get(idx, 0) + per
             continue
         idx = chip_idx_from_annotation(pod)
         if idx < 0:

@@ -42,6 +42,7 @@ from __future__ import annotations
 import threading
 import time
 
+from .. import const
 from ..allocator.assume import AssumeCache, PodKey
 from ..utils.log import get_logger
 from ..utils.metrics import REGISTRY
@@ -254,7 +255,12 @@ class DriftReconciler:
 
     def _release_orphan_reservations(self, drift) -> None:
         claims, mem, core = self._assume.snapshot()
-        for key in list(mem) + list(core):
+        # Gang reservations are one atomic entry per pod: releasing an
+        # orphaned gang frees EVERY member chip in this same pass — the
+        # ledger cannot represent (and this loop cannot create) a
+        # single-chip sliver of a partially-released gang.
+        gang = self._assume.gang_snapshot()
+        for key in list(mem) + list(core) + list(gang):
             if key in claims:
                 continue  # live admission mid-PATCH: not drift
             if self._ckpt is not None and key in self._ckpt.pending():
@@ -292,6 +298,31 @@ class DriftReconciler:
             if P.core_chips_of_pod(pod) > 0:
                 if not P.core_hold_chips(pod):
                     drift("garbled_annotation")
+                continue
+            # Key on the GRANT annotation only (matching
+            # gang_usage_by_chip): a pod that merely REQUESTS a gang
+            # shape but was admitted single-chip (pre-gang daemon, or a
+            # fallback path) is accounted by its IDX like every layer
+            # accounts it — classing it garbled would drop its real
+            # units from the overcommit sums.
+            if const.ENV_GANG_CHIPS in P.annotations(pod):
+                gang = P.gang_usage_by_chip(pod)
+                if not gang:
+                    # assigned gang with no usable member set / per-chip
+                    # share: the grant is unaccountable
+                    drift("garbled_annotation")
+                    continue
+                bad = [
+                    i for i in gang
+                    if units_by_index is not None and i not in units_by_index
+                ]
+                if bad:
+                    drift("unknown_chip", n=len(bad))
+                for i, per in gang.items():
+                    if i in bad:
+                        continue  # already reported; counting an off-
+                        # inventory chip would re-fire as overcommit too
+                    used[i] = used.get(i, 0) + per
                 continue
             idx = P.chip_idx_from_annotation(pod)
             if idx < 0:

@@ -27,19 +27,24 @@ from .. import const
 from . import pods as P
 
 
-def _mem_contribution(pod: dict) -> tuple[int, int] | None:
-    """(chip index, units) this pod adds to fractional-HBM accounting, or
-    None — the per-pod form of ``P.used_units_by_chip``."""
+def _mem_contributions(pod: dict) -> list[tuple[int, int]]:
+    """[(chip index, units)] this pod adds to fractional-HBM accounting
+    ([] when none) — the per-pod form of ``P.used_units_by_chip``. A
+    multi-chip gang contributes its per-chip share on EVERY member chip;
+    a single-chip pod its total on its IDX chip."""
     if not P.is_active(pod):
-        return None
+        return []
     if P.labels(pod).get(const.LABEL_RESOURCE_KEY) != const.LABEL_RESOURCE_VALUE:
-        return None
+        return []
     if not P.is_assigned(pod):
-        return None
+        return []
+    gang = P.gang_usage_by_chip(pod)
+    if gang:
+        return sorted(gang.items())
     idx = P.chip_idx_from_annotation(pod)
     if idx < 0:
-        return None
-    return idx, P.mem_units_of_pod(pod)
+        return []
+    return [(idx, P.mem_units_of_pod(pod))]
 
 
 def _core_contribution(pod: dict) -> list[int]:
@@ -57,7 +62,7 @@ def pod_counts_toward_usage(pod: dict) -> bool:
     cache holding this copy already accounts for it. The allocator's
     reservation overlay uses this to stop counting an in-flight pod the
     moment its PATCHed copy lands in the pod source."""
-    return _mem_contribution(pod) is not None or bool(_core_contribution(pod))
+    return bool(_mem_contributions(pod)) or bool(_core_contribution(pod))
 
 
 class NodeChipUsage:
@@ -87,17 +92,13 @@ class NodeChipUsage:
     # --- internals (lock held) -------------------------------------------
 
     def _add(self, pod: dict) -> None:
-        mem = _mem_contribution(pod)
-        if mem is not None:
-            idx, units = mem
+        for idx, units in _mem_contributions(pod):
             self._mem_used[idx] = self._mem_used.get(idx, 0) + units
         for idx in _core_contribution(pod):
             self._core_refs[idx] = self._core_refs.get(idx, 0) + 1
 
     def _remove(self, pod: dict) -> None:
-        mem = _mem_contribution(pod)
-        if mem is not None:
-            idx, units = mem
+        for idx, units in _mem_contributions(pod):
             left = self._mem_used.get(idx, 0) - units
             if left > 0:
                 self._mem_used[idx] = left

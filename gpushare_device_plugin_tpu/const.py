@@ -50,6 +50,25 @@ ENV_ASSUME_TIME = "ALIYUN_COM_TPU_MEM_ASSUME_TIME"  # ns timestamp of assignment
 ENV_CORE_IDS = "ALIYUN_COM_TPU_CORE_IDS"
 ENV_CORE_POD = "ALIYUN_COM_TPU_CORE_POD"  # this pod's tpu-core request
 
+# --- Gang (multi-chip) scheduling ------------------------------------------
+# A pod opts into a topology-aware multi-chip gang by annotating its spec
+# with the slice shape it needs — "2x2x1" (exact v4/v5-style grid) or a
+# bare chip count "4" (any arrangement). Its aliyun.com/tpu-mem limit is
+# the TOTAL across the gang; per-chip share = total / shape size.
+ANN_GANG_SHAPE = "tpushare.aliyun.com/gang-shape"
+# Persisted gang decision (annotations on the pod, mirrored into env):
+# comma-separated member chip indices, the normalized shape, and the HBM
+# units claimed on EACH member chip. A gang is only ever persisted whole
+# — all member chips in one PATCH — or not at all (the all-or-nothing
+# claim protocol, docs/scheduling.md).
+ENV_GANG_CHIPS = "ALIYUN_COM_TPU_GANG_CHIPS"
+ENV_GANG_SHAPE = "ALIYUN_COM_TPU_GANG_SHAPE"
+ENV_GANG_PER_CHIP = "ALIYUN_COM_TPU_GANG_PER_CHIP"
+# Node label declaring the host's chip grid ("2x2x1"); absent or garbled,
+# the scheduler derives the default grid from the advertised chip count
+# (topology.ChipTopology.default_for).
+LABEL_NODE_TOPOLOGY = "tpushare.aliyun.com/topology"
+
 # --- TPU workload env (analog of NVIDIA_VISIBLE_DEVICES, const.go:27) ------
 ENV_TPU_VISIBLE_CHIPS = "TPU_VISIBLE_CHIPS"
 ENV_TPU_PROCESS_BOUNDS = "TPU_PROCESS_BOUNDS"

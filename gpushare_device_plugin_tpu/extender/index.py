@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import threading
 
+from .. import const
 from ..cluster import pods as P
 from .logic import RESOURCE_FAMILIES
 
@@ -29,13 +30,20 @@ from .logic import RESOURCE_FAMILIES
 def _contributions(pod: dict) -> tuple[list[tuple[str, int, int]], list[int]]:
     """-> ([(resource, chip idx, units)], [exclusively-held chip idx]).
 
-    Mirrors ``logic.node_usage`` (fractional) and ``P.used_chips``
-    (exclusive) for a single pod."""
+    Mirrors ``logic.node_usage`` (fractional, gang pods spread per-chip)
+    and ``P.used_chips`` (exclusive) for a single pod."""
     if not P.is_active(pod):
         return [], []
     ann = P.annotations(pod)
     frac: list[tuple[str, int, int]] = []
+    gang = P.gang_usage_by_chip(pod)
+    if gang:
+        frac.extend(
+            (const.RESOURCE_MEM, idx, per) for idx, per in sorted(gang.items())
+        )
     for resource, family in RESOURCE_FAMILIES.items():
+        if gang and resource == const.RESOURCE_MEM:
+            continue  # the gang spread above IS this pod's tpu-mem usage
         raw = ann.get(family["idx"])
         if raw is None:
             continue

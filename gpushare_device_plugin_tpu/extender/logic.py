@@ -23,6 +23,7 @@ from .. import const
 from ..allocator.binpack import AssignmentError, assign_chip
 from ..cluster import pods as P
 from ..cluster.noderes import chip_capacity_vector
+from ..topology import ChipTopology, shape_size
 
 # resource name -> annotation/label vocabulary
 RESOURCE_FAMILIES = {
@@ -64,6 +65,9 @@ class NodeView:
     # the device plugin's cross-resource ledger — otherwise it would assume
     # mem pods onto held chips and Allocate would reject them forever)
     core_held: set[int] = dataclasses.field(default_factory=set)
+    # the node's chip grid, for gang (multi-chip) placement; None on
+    # resource families without an interconnect (gpu-mem)
+    topology: ChipTopology | None = None
 
     def free(self) -> dict[int, int]:
         return {
@@ -74,6 +78,15 @@ class NodeView:
             )
             for i in self.capacity
         }
+
+
+def node_topology(node: dict, capacity: dict[int, int]) -> ChipTopology | None:
+    """The node's chip grid (``ChipTopology.from_node`` — the one label
+    rule shared with the daemon and the inspect CLI); None when the node
+    advertises no chips."""
+    if not capacity:
+        return None
+    return ChipTopology.from_node(node, len(capacity))
 
 
 def node_capacity(node: dict, resource: str) -> dict[int, int]:
@@ -98,6 +111,12 @@ def node_usage(node_pods: list[dict], resource: str) -> dict[int, int]:
     for pod in node_pods:
         if P.phase(pod) in ("Succeeded", "Failed"):
             continue
+        if resource == const.RESOURCE_MEM:
+            gang = P.gang_usage_by_chip(pod)
+            if gang:
+                for idx, per in gang.items():
+                    used[idx] = used.get(idx, 0) + per
+                continue
         idx_raw = P.annotations(pod).get(family["idx"])
         if idx_raw is None:
             continue
@@ -116,13 +135,19 @@ def build_node_view(
 ) -> NodeView:
     name = node.get("metadata", {}).get("name", "")
     node_pods = pods_by_node.get(name, [])
+    capacity = node_capacity(node, resource)
     return NodeView(
         name=name,
         resource=resource,
-        capacity=node_capacity(node, resource),
+        capacity=capacity,
         used=node_usage(node_pods, resource),
         core_held=(
             P.used_chips(node_pods) if resource == const.RESOURCE_MEM else set()
+        ),
+        topology=(
+            node_topology(node, capacity)
+            if resource == const.RESOURCE_MEM
+            else None
         ),
     )
 
@@ -133,14 +158,73 @@ def node_fits(view: NodeView, request_units: int) -> bool:
     return any(f >= request_units for f in view.free().values())
 
 
+def pod_gang_shape(pod: dict, resource: str) -> str:
+    """The pod's gang-shape request, "" for single-chip pods. Gangs ride
+    the TPU family only — GPU nodes have no ICI grid to place against."""
+    if resource != const.RESOURCE_MEM:
+        return ""
+    return P.gang_shape_request(pod)
+
+
+def _gang_eval(
+    view: NodeView, shape_raw: str, request_units: int, policy: str
+) -> tuple["object | None", int, str, int]:
+    """One node's gang answer: -> (best candidate or None, per-chip
+    units, failure reason, 0-10 score). The score reuses the single-chip
+    policy semantics at per-chip granularity over the winning slice's
+    members, so gang and single-chip node ranking stay comparable."""
+    try:
+        size = shape_size(shape_raw)
+    except ValueError as e:
+        return None, 0, f"invalid gang shape {shape_raw!r}: {e}", 0
+    if size < 1 or request_units <= 0 or request_units % size:
+        return (
+            None, 0,
+            f"{request_units} units of {view.resource} do not divide "
+            f"evenly over gang shape {shape_raw!r} ({size} chips)",
+            0,
+        )
+    per_chip = request_units // size
+    topo = view.topology or node_topology({}, view.capacity)
+    if topo is None:
+        return None, 0, f"node does not advertise {view.resource}", 0
+    free = view.free()
+    cand = topo.best_slice(
+        shape_raw, free, per_chip,
+        capacity=view.capacity, excluded=view.core_held,
+    )
+    if cand is None:
+        return (
+            None, per_chip,
+            f"no {shape_raw} sub-slice with {per_chip} free units of "
+            f"{view.resource} per chip (free: {free})",
+            0,
+        )
+    score = _score_free(
+        [free[i] for i in cand.chips],
+        max(view.capacity.values(), default=0),
+        per_chip,
+        policy,
+    )
+    return cand, per_chip, "", score
+
+
 def evaluate_filter(
-    request_units: int, views: list[NodeView]
+    request_units: int, views: list[NodeView], gang_shape: str = ""
 ) -> tuple[list[str], dict[str, str]]:
     """Fit check over prebuilt views -> (fitting names, name -> reason)."""
     fits, failed = [], {}
     for view in views:
         if not view.capacity:
             failed[view.name] = f"node does not advertise {view.resource}"
+        elif gang_shape:
+            cand, _per, reason, _s = _gang_eval(
+                view, gang_shape, request_units, "best-fit"
+            )
+            if cand is None:
+                failed[view.name] = reason
+            else:
+                fits.append(view.name)
         elif not node_fits(view, request_units):
             failed[view.name] = (
                 f"no single chip with {request_units} free units of "
@@ -175,7 +259,10 @@ def filter_with_views(
         # the scheduler may still route the pod through the extender)
         return [n.get("metadata", {}).get("name", "") for n in nodes], {}
     request = P.mem_units_of_pod(pod, resource=resource)
-    return evaluate_filter(request, views_fn(resource, nodes))
+    return evaluate_filter(
+        request, views_fn(resource, nodes),
+        gang_shape=pod_gang_shape(pod, resource),
+    )
 
 
 def filter_nodes(
@@ -213,7 +300,10 @@ def score_node(view: NodeView, request_units: int, policy: str = "best-fit") -> 
 
 
 def evaluate_filter_and_scores(
-    request_units: int, views: list[NodeView], policy: str = "best-fit"
+    request_units: int,
+    views: list[NodeView],
+    policy: str = "best-fit",
+    gang_shape: str = "",
 ) -> tuple[list[str], dict[str, str], dict[str, int]]:
     """One pass over prebuilt views -> (fits, failed reasons, scores for
     the fitting nodes). The batched filter+prioritize: each view's free
@@ -225,6 +315,16 @@ def evaluate_filter_and_scores(
     for view in views:
         if not view.capacity:
             failed[view.name] = f"node does not advertise {view.resource}"
+            continue
+        if gang_shape:
+            cand, _per, reason, score = _gang_eval(
+                view, gang_shape, request_units, policy
+            )
+            if cand is None:
+                failed[view.name] = reason
+            else:
+                fits.append(view.name)
+                scores[view.name] = score
             continue
         free = view.free()
         if not any(f >= request_units for f in free.values()):
@@ -244,8 +344,16 @@ def evaluate_filter_and_scores(
 
 
 def evaluate_scores(
-    request_units: int, views: list[NodeView], policy: str = "best-fit"
+    request_units: int,
+    views: list[NodeView],
+    policy: str = "best-fit",
+    gang_shape: str = "",
 ) -> dict[str, int]:
+    if gang_shape:
+        return {
+            v.name: _gang_eval(v, gang_shape, request_units, policy)[3]
+            for v in views
+        }
     return {v.name: score_node(v, request_units, policy) for v in views}
 
 
@@ -256,7 +364,10 @@ def prioritize_with_views(
     if resource is None:
         return {n.get("metadata", {}).get("name", ""): 0 for n in nodes}
     request = P.mem_units_of_pod(pod, resource=resource)
-    return evaluate_scores(request, views_fn(resource, nodes), policy)
+    return evaluate_scores(
+        request, views_fn(resource, nodes), policy,
+        gang_shape=pod_gang_shape(pod, resource),
+    )
 
 
 def prioritize_nodes(
@@ -278,6 +389,47 @@ def choose_chip(
         raise AssignmentError("pod requests no share resource")
     view = build_node_view(node, group_pods_by_node(pods), resource)
     return choose_chip_from_view(pod, view, policy=policy)
+
+
+def choose_gang_from_view(
+    pod: dict, view: NodeView, policy: str = "best-fit"
+) -> tuple[str, tuple[int, ...], int, dict[str, str]]:
+    """Bind-time gang decision over a prebuilt view: -> (resource, member
+    chips, per-chip units, annotations to write). The annotations are the
+    whole gang in ONE write — member chips, normalized shape, per-chip
+    share, assigned=false — so the claim lands all-or-nothing and the
+    device plugin's branch A can re-validate and honor it atomically.
+    Raises ``AssignmentError`` when no feasible sub-slice remains."""
+    resource = view.resource
+    family = RESOURCE_FAMILIES[resource]
+    shape_raw = pod_gang_shape(pod, resource)
+    request = P.mem_units_of_pod(pod, resource=resource)
+    cand, per_chip, reason, _score = _gang_eval(
+        view, shape_raw, request, policy
+    )
+    if cand is None:
+        raise AssignmentError(reason)
+    containers = pod.get("spec", {}).get("containers", [])
+    alloc_map = {}
+    for i, c in enumerate(containers):
+        units = P.mem_units_of_container(c, resource)
+        if units <= 0:
+            continue
+        per = units // len(cand.chips)
+        alloc_map[c.get("name", f"c{i}")] = {
+            str(idx): per for idx in cand.chips
+        }
+    annotations = {
+        const.ENV_GANG_CHIPS: ",".join(str(i) for i in cand.chips),
+        const.ENV_GANG_SHAPE: "x".join(str(d) for d in cand.shape),
+        const.ENV_GANG_PER_CHIP: str(per_chip),
+        family["pod"]: str(request),
+        family["dev"]: str(view.capacity.get(cand.chips[0], 0)),
+        family["assigned"]: "false",  # plugin flips to true at admission
+        family["assume"]: str(time.time_ns()),
+        const.ANN_EXTENDER_ALLOCATION: json.dumps(alloc_map),
+    }
+    return resource, cand.chips, per_chip, annotations
 
 
 def choose_chip_from_view(

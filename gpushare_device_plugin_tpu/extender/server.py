@@ -38,7 +38,12 @@ log = get_logger("extender")
 
 @dataclasses.dataclass
 class _Inflight:
-    """A bind decision the apiserver watch may not reflect yet."""
+    """A bind decision the apiserver watch may not reflect yet.
+
+    Single-chip: ``chips`` is empty, ``units`` lands on ``idx``. Gang:
+    ``chips`` holds every member and ``units`` is the PER-CHIP share —
+    the overlay books all members together, mirroring the all-or-nothing
+    ledger entry on the plugin side."""
 
     node: str
     resource: str
@@ -46,6 +51,7 @@ class _Inflight:
     units: int
     annotations: dict[str, str]
     stamp: float
+    chips: tuple[int, ...] = ()
 
 
 class ExtenderCore:
@@ -119,6 +125,7 @@ class ExtenderCore:
                     units=int(data["units"]),
                     annotations=dict(data.get("annotations") or {}),
                     stamp=now,
+                    chips=tuple(int(i) for i in (data.get("chips") or ())),
                 )
             except (KeyError, TypeError, ValueError):
                 log.warning("checkpoint warmup: malformed bind entry for %s", key)
@@ -207,6 +214,11 @@ class ExtenderCore:
             core_held=(
                 set(core_held) if resource == logic.const.RESOURCE_MEM else set()
             ),
+            topology=(
+                logic.node_topology(node, capacity)
+                if resource == logic.const.RESOURCE_MEM
+                else None
+            ),
         )
 
     def _node_views(self, resource: str, nodes: list[dict]) -> list[logic.NodeView]:
@@ -240,15 +252,20 @@ class ExtenderCore:
                 if cached is not None:
                     if not P.is_active(cached):
                         continue
-                    if (
-                        family["idx"] in P.annotations(cached)
-                        and P.node_name(cached) == entry.node
-                    ):
+                    ann = P.annotations(cached)
+                    marker = (
+                        logic.const.ENV_GANG_CHIPS if entry.chips
+                        else family["idx"]
+                    )
+                    if marker in ann and P.node_name(cached) == entry.node:
                         continue  # watch caught up; the index counts it on node
                 # Otherwise the index either misses the pod or files it
                 # under the wrong node (annotation MODIFIED can precede the
                 # bind MODIFIED, leaving nodeName empty): count it here.
-                view.used[entry.idx] = view.used.get(entry.idx, 0) + entry.units
+                # Gang entries book their PER-CHIP share on every member —
+                # the overlay mirror of the all-or-nothing ledger entry.
+                for member in entry.chips or (entry.idx,):
+                    view.used[member] = view.used.get(member, 0) + entry.units
             return views
         pods = self._active_pods()
         by_node = logic.group_pods_by_node(pods)
@@ -342,7 +359,8 @@ class ExtenderCore:
         request = P.mem_units_of_pod(pod, resource=resource)
         views = self._node_views(resource, nodes)
         fits, failed, scores = logic.evaluate_filter_and_scores(
-            request, views, policy=self._policy
+            request, views, policy=self._policy,
+            gang_shape=logic.pod_gang_shape(pod, resource),
         )
         fit_set = set(fits)
         return {
@@ -381,12 +399,25 @@ class ExtenderCore:
             resource = logic.pod_resource(pod)
             if resource is None:
                 raise AssignmentError("pod requests no share resource")
+            gang_shape = logic.pod_gang_shape(pod, resource)
             with self._lock:
                 view = self._node_views(resource, [node])[0]
-                _, idx, annotations = logic.choose_chip_from_view(
-                    pod, view, policy=self._policy
-                )
-                units = P.mem_units_of_pod(pod, resource=resource)
+                if gang_shape:
+                    # gang bind: ONE decision covering every member chip,
+                    # reserved whole in the in-flight overlay before any
+                    # network write — all-or-nothing from the first moment
+                    _, chips, per_chip, annotations = (
+                        logic.choose_gang_from_view(
+                            pod, view, policy=self._policy
+                        )
+                    )
+                    idx, units = chips[0], per_chip
+                else:
+                    chips = ()
+                    _, idx, annotations = logic.choose_chip_from_view(
+                        pod, view, policy=self._policy
+                    )
+                    units = P.mem_units_of_pod(pod, resource=resource)
                 self._inflight[(ns, name)] = _Inflight(
                     node=node_name,
                     resource=resource,
@@ -394,6 +425,7 @@ class ExtenderCore:
                     units=units,
                     annotations=annotations,
                     stamp=time.monotonic(),
+                    chips=tuple(chips),
                 )
             # WAL begin before the PATCH/Binding: a crash inside the next
             # block leaves an unresolved entry the restarted extender's
@@ -404,6 +436,7 @@ class ExtenderCore:
                     "resource": resource,
                     "idx": idx,
                     "units": units,
+                    "chips": list(chips),
                     "annotations": annotations,
                     "ts": time.time(),  # warmup ages stale entries out by this
                 })
@@ -431,7 +464,13 @@ class ExtenderCore:
                 host=node_name,
             )
             return {"error": str(e)}
-        log.info("bound %s/%s -> %s chip %d", ns, name, node_name, idx)
+        if chips:
+            log.info(
+                "bound gang %s/%s -> %s chips %s (%d units/chip)",
+                ns, name, node_name, list(chips), units,
+            )
+        else:
+            log.info("bound %s/%s -> %s chip %d", ns, name, node_name, idx)
         return {"error": ""}
 
 

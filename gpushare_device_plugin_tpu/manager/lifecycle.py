@@ -167,8 +167,25 @@ class TpuShareManager:
                 self._patch_pipeline.patch_pod
                 if self._patch_pipeline is not None else None
             ),
+            chip_topology=self._node_chip_topology(inventory),
         )
         return cluster.allocate
+
+    def _node_chip_topology(self, inventory: DeviceInventory):
+        """This node's chip grid for gang placement: the same
+        ``tpushare.aliyun.com/topology`` label rule the extender and the
+        inspect CLI apply, so branch-B gang decisions agree with the
+        extender's grid. An unreachable apiserver or missing label falls
+        back to the default grid (None -> ClusterAllocator derives it)."""
+        from ..topology import ChipTopology
+
+        n_chips = len(inventory.units_by_index())
+        node: dict = {}
+        try:
+            node = self._api.get_node(self._cfg.node_name)
+        except Exception as e:  # noqa: BLE001 — degrade to the default grid
+            log.v(4, "node topology label read failed (%s); using default", e)
+        return ChipTopology.from_node(node, max(1, n_chips))
 
     def _build_core_allocate_fn(self, inventory: DeviceInventory, unhealthy_fn):
         """Whole-chip allocator for the tpu-core resource.

@@ -19,6 +19,8 @@ from .podenv import (  # noqa: F401
     MultihostSpec,
     PodTpuEnv,
     configure_jax_from_env,
+    gang_mesh,
+    gang_mesh_spec,
     initialize_multihost,
     multihost_spec,
 )

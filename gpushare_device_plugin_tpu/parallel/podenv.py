@@ -46,19 +46,55 @@ class PodTpuEnv:
     process_bounds: str  # "" on single-host
     chips_per_process_bounds: str
     hbm_fraction: float  # cooperative cap in (0, 1]
+    # Multi-chip gang grant (ALIYUN_COM_TPU_GANG_*): the member chips,
+    # the granted slice shape, and the HBM units claimed on EACH member.
+    # Empty/0 for ordinary single-chip pods.
+    gang_chips: tuple[int, ...] = ()
+    gang_shape: tuple[int, ...] = ()
+    gang_per_chip: int = 0
+    mem_units_pod: int = 0  # the whole pod's HBM units (MEM_POD), 0 unset
 
     @property
     def exclusive(self) -> bool:
         """Whole chip(s) granted — no HBM cap needed."""
         return self.hbm_fraction >= 0.999
 
+    @property
+    def is_gang(self) -> bool:
+        """A topology-aware multi-chip grant: the workload should build a
+        tensor-parallel mesh over its visible chips
+        (:func:`gang_mesh_spec`)."""
+        return len(self.gang_chips) > 1
+
     def mem_bytes(self, unit: "const.MemoryUnit | None" = None) -> int:
         """This container's ``aliyun.com/tpu-mem`` slice in bytes (units
-        are GiB unless the cluster runs ``--memory-unit=MiB``). The
-        serving engine sizes its KV slot pool from exactly this number
-        (``serving.engine.slots_from_pod_env``)."""
+        are GiB unless the cluster runs ``--memory-unit=MiB``). For a
+        gang this is the TOTAL across member chips; the per-chip share is
+        :meth:`gang_per_chip_bytes`. The serving engine sizes its KV slot
+        pool from these (``serving.engine.slots_from_pod_env``)."""
         u = unit if unit is not None else const.MemoryUnit.GiB
         return self.mem_units_container * u.num_bytes
+
+    def gang_per_chip_bytes(self, unit: "const.MemoryUnit | None" = None) -> int:
+        """The HBM slice this gang holds on EACH member chip, in bytes
+        (0 for non-gang pods). POD-level: in a multi-container gang pod
+        this is the whole pod's per-chip share; THIS container's portion
+        is :meth:`gang_container_per_chip_bytes`."""
+        u = unit if unit is not None else const.MemoryUnit.GiB
+        return self.gang_per_chip * u.num_bytes
+
+    def gang_container_per_chip_bytes(
+        self, unit: "const.MemoryUnit | None" = None
+    ) -> int:
+        """This CONTAINER's per-chip share of the gang's slice: the pod
+        per-chip share scaled by the container's fraction of the pod's
+        units. Two serving containers in one gang pod must each size to
+        their own portion — sizing both to the pod share would pin ~2x
+        the granted per-chip HBM."""
+        per = self.gang_per_chip_bytes(unit)
+        if 0 < self.mem_units_container < self.mem_units_pod:
+            return per * self.mem_units_container // self.mem_units_pod
+        return per
 
     @classmethod
     def from_env(cls, env: Mapping[str, str] | None = None) -> "PodTpuEnv":
@@ -67,12 +103,28 @@ class PodTpuEnv:
         def _int(key: str, default: int) -> int:
             return _env_int(e, key, default)
 
-        chips_raw = e.get(const.ENV_TPU_VISIBLE_CHIPS, "")
-        visible = tuple(
-            int(tok) for tok in chips_raw.split(",") if tok.strip().isdigit()
-        )
+        def _int_list(key: str) -> tuple[int, ...]:
+            raw = e.get(key, "")
+            return tuple(
+                int(tok) for tok in raw.split(",") if tok.strip().isdigit()
+            )
+
+        visible = _int_list(const.ENV_TPU_VISIBLE_CHIPS)
         container_units = _int(const.ENV_MEM_CONTAINER, 0)
         chip_units = _int(const.ENV_MEM_DEV, 0)
+        gang_chips = _int_list(const.ENV_GANG_CHIPS)
+        gang_per_chip = _int(const.ENV_GANG_PER_CHIP, 0)
+        gang_shape: tuple[int, ...] = ()
+        shape_raw = e.get(const.ENV_GANG_SHAPE, "")
+        if shape_raw:
+            from ..topology import parse_shape
+
+            try:
+                # the one wire-format parser: rejects non-positive dims
+                # and >3 axes the same way every control-plane consumer does
+                gang_shape = parse_shape(shape_raw)
+            except ValueError:
+                gang_shape = ()
         explicit = None
         frac_raw = e.get(const.ENV_XLA_MEM_FRACTION, "")
         if frac_raw:
@@ -80,7 +132,12 @@ class PodTpuEnv:
                 explicit = min(1.0, max(0.0, float(frac_raw)))
             except ValueError:
                 explicit = None
-        if container_units > 0 and chip_units > 0:
+        if gang_chips and gang_per_chip > 0 and chip_units > 0:
+            # Gang pods cap PER CHIP: each member chip holds gang_per_chip
+            # of its chip_units (the container total spans every member).
+            derived = min(1.0, gang_per_chip / chip_units)
+            fraction = min(explicit, derived) if explicit is not None else derived
+        elif container_units > 0 and chip_units > 0:
             derived = min(1.0, container_units / chip_units)
             # The container never gets more than its own units' fraction,
             # whatever the explicit env says (defense against a stale or
@@ -98,6 +155,10 @@ class PodTpuEnv:
                 const.ENV_TPU_CHIPS_PER_PROCESS_BOUNDS, ""
             ),
             hbm_fraction=fraction,
+            gang_chips=gang_chips,
+            gang_shape=gang_shape,
+            gang_per_chip=gang_per_chip,
+            mem_units_pod=_int(const.ENV_MEM_POD, 0),
         )
 
 
@@ -137,6 +198,54 @@ def configure_jax_from_env(
         for k, v in settings.items():
             os.environ[k] = v
     return settings
+
+
+def gang_mesh_spec(pod: "PodTpuEnv | None" = None, env: Mapping[str, str] | None = None):
+    """The logical mesh a granted gang materializes as: pure tensor
+    parallelism over the member chips (``MeshSpec(tp=n)``) — the serving
+    default, where the model and the slot-pool KV cache shard across the
+    gang and every collective stays inside the granted ICI sub-slice.
+    Training workloads that want dp/fsdp instead can factor the same chip
+    count through ``MeshSpec.auto``. Returns None for non-gang pods."""
+    from .mesh import MeshSpec
+
+    p = pod if pod is not None else PodTpuEnv.from_env(env)
+    if not p.is_gang:
+        return None
+    return MeshSpec(tp=len(p.gang_chips))
+
+
+def gang_mesh(
+    pod: "PodTpuEnv | None" = None,
+    env: Mapping[str, str] | None = None,
+    devices=None,
+):
+    """Build the gang's ``jax.sharding.Mesh`` over the local devices the
+    grant exposes. Call after :func:`configure_jax_from_env` (so the
+    process only sees its gang's chips); ``devices`` overrides for tests.
+    Returns None for non-gang pods; raises when the visible device count
+    does not match the granted gang size (a mis-injected env must fail
+    loudly at startup, not shard onto a neighbor's chip)."""
+    p = pod if pod is not None else PodTpuEnv.from_env(env)
+    spec = gang_mesh_spec(p)
+    if spec is None:
+        return None
+    import jax
+
+    from .mesh import make_mesh
+
+    devs = list(devices) if devices is not None else list(jax.devices())
+    if len(devs) != spec.size:
+        # Either direction is a mis-injected env: fewer devices cannot
+        # form the mesh, and MORE means chips outside the grant leaked
+        # into the container — silently meshing over the first N would
+        # shard onto devices this pod was never granted.
+        raise ValueError(
+            f"gang grant spans {spec.size} chips but {len(devs)} devices "
+            "are visible — TPU_VISIBLE_CHIPS and the gang annotations "
+            "disagree"
+        )
+    return make_mesh(spec, devices=devs)
 
 
 @dataclasses.dataclass(frozen=True)

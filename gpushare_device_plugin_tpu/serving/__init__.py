@@ -15,6 +15,7 @@ from .engine import (  # noqa: F401
     kv_slot_bytes,
     poisson_trace,
     run_static_baseline,
+    slots_for_gang,
     slots_for_slice,
     slots_from_pod_env,
 )

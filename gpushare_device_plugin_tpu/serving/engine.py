@@ -55,7 +55,7 @@ import numpy as np
 from ..const import MemoryUnit
 from ..parallel.podenv import PodTpuEnv
 from ..workloads import generate as G
-from ..workloads.transformer import TransformerConfig
+from ..workloads.transformer import TransformerConfig, shard_params
 
 
 @dataclasses.dataclass(frozen=True)
@@ -166,6 +166,7 @@ class SlotEngine:
         prefill_chunk: int = 64,
         eos_id: int | None = None,
         kv_dtype: str | None = None,
+        mesh=None,
     ):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
@@ -188,12 +189,55 @@ class SlotEngine:
         self.chunk = prefill_chunk
         self.eos_id = eos_id
         self.cache = G.init_slot_cache(cfg, slots, max_len, kv_dtype=kv_dtype)
+        # Tensor-parallel serving across a granted gang: with a mesh (from
+        # ``parallel.podenv.gang_mesh`` inside a multi-chip grant), the
+        # model weights shard per ``transformer.param_specs`` (heads /
+        # mlp-hidden / vocab over tp) and the slot-pool KV cache shards
+        # its kv-heads dimension over the same axis — every chip of the
+        # gang holds 1/tp of the weights and 1/tp of every slot row, and
+        # XLA inserts the psums over the gang's ICI sub-slice (the
+        # NamedSharding/GSPMD pattern; nothing here hand-schedules
+        # communication). The engine's host loop, static shapes, and
+        # compile-count guarantees are unchanged: sharding is a layout
+        # property of the same three programs.
+        self.mesh = mesh if mesh is not None and mesh.shape.get("tp", 1) > 1 else None
+        if self.mesh is not None:
+            self.params = shard_params(self.params, self.mesh, cfg)
+            self.cache = self._shard_cache(self.cache)
         self.ticks = 0
         # One entry per compiled program; a counting wrapper increments at
         # TRACE time, so steady-state slot churn must leave these frozen
         # (the no-retrace guard the tests and serve bench assert).
         self.trace_counts = {"prefill": 0, "extend": 0, "decode": 0}
         self._build_fns()
+
+    def _shard_cache(self, cache):
+        """Place the slot-pool cache tensor-parallel: K/V (and int8
+        scales) shard their kv-heads axis over tp — each gang chip pins
+        ``kv_slot_bytes / tp`` per row, which is what lets a gang's
+        per-chip HBM share hold a pool no single chip could
+        (:func:`slots_for_gang`). A kv-head count tp does not divide
+        falls back to replication for that buffer (the
+        ``prune_unshardable`` rule), keeping correctness over memory."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        tp = self.mesh.shape["tp"]
+        divisible = self.cfg.kv_heads % tp == 0
+
+        def spec_for(name: str, ndim: int):
+            if name == "len" or not divisible:
+                return P()
+            # k/v: [L, slots, max_len, Hkv, Dh]; scales: [L, slots, max_len, Hkv]
+            parts = [None] * ndim
+            parts[3] = "tp"
+            return P(*parts)
+
+        return {
+            name: jax.device_put(
+                val, NamedSharding(self.mesh, spec_for(name, val.ndim))
+            )
+            for name, val in cache.items()
+        }
 
     def _build_fns(self) -> None:
         cfg = self.cfg
@@ -565,6 +609,42 @@ def slots_for_slice(
     return int(usable // kv_slot_bytes(cfg, max_len, kv_dtype))
 
 
+def slots_for_gang(
+    per_chip_bytes: int,
+    n_chips: int,
+    cfg: TransformerConfig,
+    max_len: int,
+    *,
+    weight_bytes: int,
+    kv_dtype: str | None = None,
+    headroom: float = 0.90,
+) -> int:
+    """Slot-pool size a multi-chip gang sustains, computed over the
+    PER-CHIP HBM shares: with the tensor-parallel engine each member chip
+    pins ~``weight_bytes / n`` of the model and ``kv_slot_bytes / n`` per
+    slot row (kv-heads shard over tp), so the binding constraint is one
+    chip's share, not the gang total. When kv-heads do not divide by the
+    gang size the cache replicates (``SlotEngine._shard_cache``) and the
+    per-chip KV cost is the full row — sized here the same way so the
+    estimate can never overshoot what the layout actually pins.
+    0 means the gang cannot serve this config — callers reject."""
+    if n_chips < 1:
+        raise ValueError(f"n_chips must be >= 1, got {n_chips}")
+    if not 0.0 < headroom <= 1.0:
+        raise ValueError(f"headroom must be in (0, 1], got {headroom}")
+    per_slot = kv_slot_bytes(cfg, max_len, kv_dtype)
+    if n_chips > 1 and cfg.kv_heads % n_chips == 0:
+        per_slot_chip = -(-per_slot // n_chips)
+        weights_chip = -(-weight_bytes // n_chips)
+    else:
+        per_slot_chip = per_slot
+        weights_chip = weight_bytes
+    usable = per_chip_bytes * headroom - weights_chip
+    if usable <= 0:
+        return 0
+    return int(usable // per_slot_chip)
+
+
 def slots_from_pod_env(
     cfg: TransformerConfig,
     max_len: int,
@@ -578,17 +658,34 @@ def slots_from_pod_env(
     """Slot pool for THIS pod's ``aliyun.com/tpu-mem`` slice, read from
     the plugin-injected env (:class:`~..parallel.podenv.PodTpuEnv`) — the
     closing of the loop: the device plugin carves the slice, the engine
-    sizes its admission capacity to it. Raises when the slice cannot hold
-    even one slot (a misconfigured pod should fail loudly at startup, not
-    OOM mid-serve)."""
+    sizes its admission capacity to it. Multi-chip gangs size over their
+    PER-CHIP shares (:func:`slots_for_gang`): the tensor-parallel pool is
+    bounded by one member chip's slice, not the gang total. Raises when
+    the slice cannot hold even one slot (a misconfigured pod should fail
+    loudly at startup, not OOM mid-serve)."""
     pod = env if env is not None else PodTpuEnv.from_env()
-    n = slots_for_slice(
-        pod.mem_bytes(unit), cfg, max_len,
-        weight_bytes=weight_bytes, kv_dtype=kv_dtype, headroom=headroom,
-    )
+    if pod.is_gang:
+        # the CONTAINER's portion of the per-chip share: a multi-container
+        # gang pod must not have every container size to the pod's whole
+        # per-chip slice (they would jointly oversubscribe each chip)
+        per_chip_bytes = pod.gang_container_per_chip_bytes(unit)
+        n = slots_for_gang(
+            per_chip_bytes, len(pod.gang_chips), cfg, max_len,
+            weight_bytes=weight_bytes, kv_dtype=kv_dtype, headroom=headroom,
+        )
+        slice_desc = (
+            f"gang slice of {per_chip_bytes / unit.num_bytes:g} "
+            f"{unit.value}/chip x {len(pod.gang_chips)} chips"
+        )
+    else:
+        n = slots_for_slice(
+            pod.mem_bytes(unit), cfg, max_len,
+            weight_bytes=weight_bytes, kv_dtype=kv_dtype, headroom=headroom,
+        )
+        slice_desc = f"slice of {pod.mem_units_container} {unit.value}"
     if n < 1:
         raise ValueError(
-            f"slice of {pod.mem_units_container} {unit.value} cannot hold "
+            f"{slice_desc} cannot hold "
             f"weights ({weight_bytes / 2**30:.2f} GiB) plus one "
             f"{max_len}-position KV slot "
             f"({kv_slot_bytes(cfg, max_len, kv_dtype) / 2**30:.3f} GiB) at "

@@ -1,0 +1,17 @@
+from .model import (
+    ChipTopology,
+    SliceCandidate,
+    format_shape,
+    pad3,
+    parse_shape,
+    shape_size,
+)
+
+__all__ = [
+    "ChipTopology",
+    "SliceCandidate",
+    "format_shape",
+    "pad3",
+    "parse_shape",
+    "shape_size",
+]

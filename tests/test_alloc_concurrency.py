@@ -247,3 +247,128 @@ def test_repeated_storms_leave_no_leaked_claims(stack):
             lambda: all(informer.get_pod("default", n) is None for n in names)
         )
         assert wait_until(lambda: sum(informer.chip_state()[0].values()) == 0)
+
+
+def test_gang_admission_storm_no_partial_grants(stack):
+    """ISSUE 6 satellite: 16-way concurrent MULTI-CHIP gang claims against
+    one topology. Property under storm: ZERO partial grants (every pod is
+    either fully granted — all member chips + per-chip share in one
+    annotation set — or untouched) and ZERO double assignments (per-chip
+    sums across all gangs never exceed chip capacity). The gangs pack the
+    host exactly full, so admission failures are also failures."""
+    api, client, informer, kubelet, reg, inv = stack
+    from gpushare_device_plugin_tpu.topology import ChipTopology
+
+    per_chip, members = 4, 2
+    pod_units = per_chip * members  # 8 units per gang
+    n_gangs = (CHIPS * UNITS_PER_CHIP) // pod_units  # 16 gangs: exact pack
+    names = [f"gang-storm-{i}" for i in range(n_gangs)]
+    for name in names:
+        api.add_pod(make_pod(
+            name, pod_units, node=NODE,
+            annotations={const.ANN_GANG_SHAPE: f"{members}x1"},
+        ))
+    assert wait_until(lambda: len(informer.pending_pods()) == n_gangs)
+
+    errors = _storm(kubelet, reg.endpoint, n_gangs, pod_units, WORKERS)
+    assert errors == [], f"gang admissions failed: {[str(e) for e in errors[:3]]}"
+
+    topo = ChipTopology.default_for(CHIPS)
+    used_by_chip: dict[int, int] = {}
+    partial = []
+    for name in names:
+        pod = client.get_pod("default", name)
+        ann = pod["metadata"]["annotations"]
+        chips = P.gang_chips_from_annotation(pod)
+        per = P.gang_per_chip_units(pod)
+        fully = (
+            ann.get(const.ENV_ASSIGNED_FLAG) == "true"
+            and len(chips) == members
+            and len(set(chips)) == members
+            and per == per_chip
+        )
+        untouched = const.ENV_GANG_CHIPS not in ann and not P.is_assigned(pod)
+        if not fully and not untouched:
+            partial.append((name, dict(ann)))
+        if fully:
+            # granted slices must be genuine topology candidates (axis-
+            # aligned, ICI-adjacent for a 2x1 on the default grid)
+            assert topo.slice_hops(chips) == 1, (name, chips)
+            for c in chips:
+                used_by_chip[c] = used_by_chip.get(c, 0) + per
+    assert partial == [], f"partial gang grants: {partial[:3]}"
+    capacity = inv.units_by_index()
+    over = {i: u for i, u in used_by_chip.items() if u > capacity[i]}
+    assert not over, f"double-assigned chips: {over}"
+    assert sum(used_by_chip.values()) == n_gangs * pod_units  # exact pack
+
+    # incremental accounting converges to the same per-chip truth
+    assert wait_until(
+        lambda: informer.chip_state()[0] == used_by_chip
+    ), (informer.chip_state()[0], used_by_chip)
+
+
+def test_mixed_gang_and_single_storm_share_one_ledger(stack):
+    """Gangs and single-chip pods admitted concurrently must partition the
+    same per-chip capacity: no chip over-commit, no partial gangs, and
+    single pods never land mid-gang."""
+    api, client, informer, kubelet, reg, inv = stack
+    n_gangs, n_single = 8, 16
+    gang_units, single_units = 8, 4  # 8*8 + 16*4 = 128: exact pack
+    for i in range(n_gangs):
+        api.add_pod(make_pod(
+            f"mix-gang-{i}", gang_units, node=NODE,
+            annotations={const.ANN_GANG_SHAPE: "2x1"},
+        ))
+    for i in range(n_single):
+        api.add_pod(make_pod(f"mix-solo-{i}", single_units, node=NODE))
+    assert wait_until(
+        lambda: len(informer.pending_pods()) == n_gangs + n_single
+    )
+
+    jobs = [gang_units] * n_gangs + [single_units] * n_single
+    jobs_lock = threading.Lock()
+    errors: list[Exception] = []
+    barrier = threading.Barrier(WORKERS)
+
+    def worker():
+        barrier.wait()
+        while True:
+            with jobs_lock:
+                if not jobs:
+                    return
+                units = jobs.pop()
+            try:
+                kubelet.allocate(
+                    reg.endpoint, [[f"g{i}" for i in range(units)]]
+                )
+            except Exception as e:  # noqa: BLE001
+                with jobs_lock:
+                    errors.append(e)
+
+    threads = [threading.Thread(target=worker, daemon=True) for _ in range(WORKERS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60.0)
+    assert not any(t.is_alive() for t in threads), "mixed storm hung"
+    assert errors == [], [str(e) for e in errors[:3]]
+
+    used_by_chip: dict[int, int] = {}
+    for i in range(n_gangs):
+        pod = client.get_pod("default", f"mix-gang-{i}")
+        chips = P.gang_chips_from_annotation(pod)
+        per = P.gang_per_chip_units(pod)
+        assert len(chips) == 2 and per == 4, (chips, per)
+        for c in chips:
+            used_by_chip[c] = used_by_chip.get(c, 0) + per
+    for i in range(n_single):
+        pod = client.get_pod("default", f"mix-solo-{i}")
+        assert P.is_assigned(pod)
+        idx = P.chip_idx_from_annotation(pod)
+        assert idx >= 0
+        used_by_chip[idx] = used_by_chip.get(idx, 0) + single_units
+    capacity = inv.units_by_index()
+    over = {i: u for i, u in used_by_chip.items() if u > capacity[i]}
+    assert not over, f"mixed storm over-committed: {over}"
+    assert sum(used_by_chip.values()) == CHIPS * UNITS_PER_CHIP

@@ -1,0 +1,60 @@
+"""bench_mfu.py --multichip-smoke: tensor-parallel gang serving must be
+token-identical to the single-chip engine (ISSUE 6 satellite).
+
+Tier-1 (not slow): the CPU multi-chip smoke is the acceptance gate for
+the topology subsystem's workload half — the TP SlotEngine over a
+simulated granted gang (8 forced virtual devices) must emit tokens
+BIT-IDENTICAL to the single-chip engine on the same trace with zero
+retraces, and the per-chip gang sizing must admit a larger pool than one
+chip's identical slice. Subprocess on purpose, like the other bench
+smokes: the bench must work as shipped (env forcing, argv handling, the
+JSON contract the driver parses)."""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+
+def test_bench_multichip_smoke_tp_engine_token_identical():
+    repo = Path(__file__).resolve().parent.parent
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    # the bench forces its own virtual device count; an inherited
+    # XLA_FLAGS from the test session must not mask that path
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, str(repo / "bench_mfu.py"), "--multichip-smoke"],
+        env=env, capture_output=True, text=True, timeout=600, cwd=str(repo),
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    report = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert report["sections"] == ["serve_tp"]
+    row = report["serve_tp"]
+
+    # the virtual mesh came up and the gang spanned multiple chips
+    assert row["devices"] >= 2
+    assert row["tp"] >= 2
+    assert not row.get("skipped")
+
+    # THE acceptance gates (also hard-asserted inside the bench):
+    # bit-identical tokens and zero retraces across slot churn
+    assert row["tokens_identical"] is True
+    assert row["retraces"] == 0
+    assert row["tp_engine"]["trace_counts"] == {
+        "prefill": 1, "extend": 1, "decode": 1,
+    }
+
+    # same trace served to completion on both engines
+    assert row["tp_engine"]["requests"] == row["single"]["requests"]
+    assert row["tp_engine"]["tokens"] == row["single"]["tokens"]
+    assert row["tp_goodput_ratio"] is not None
+
+    # the capacity story: per-chip gang sizing beats one chip's slice
+    assert row["slots_gang"] > row["slots_single_slice"]
+
+    # the MULTICHIP_r0*.json dry-run capture is folded into the report
+    dry = row["multichip_dryrun"]
+    assert dry["found"] is True
+    assert dry["ok"] is True
+    assert dry["n_devices"] >= 2

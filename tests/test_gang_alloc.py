@@ -1,0 +1,460 @@
+"""Gang (multi-chip) claims through the allocator, ledger, WAL, and
+extender (ISSUE 6 tentpole): all-or-nothing reservation semantics,
+branch A/B placement, extender gang bind, and the per-chip accounting
+every layer must agree on."""
+
+from __future__ import annotations
+
+import tempfile
+import time
+
+import pytest
+
+from gpushare_device_plugin_tpu import const
+from gpushare_device_plugin_tpu.allocator.assume import AssumeCache
+from gpushare_device_plugin_tpu.allocator.checkpoint import (
+    AllocationCheckpoint,
+    replay_checkpoint,
+)
+from gpushare_device_plugin_tpu.allocator.cluster import (
+    AllocationFailure,
+    ClusterAllocator,
+    ClusterCoreAllocator,
+)
+from gpushare_device_plugin_tpu.cluster import pods as P
+from gpushare_device_plugin_tpu.cluster.apiserver import ApiServerClient
+from gpushare_device_plugin_tpu.cluster.informer import PodInformer
+from gpushare_device_plugin_tpu.device import DeviceInventory
+from gpushare_device_plugin_tpu.discovery import MockBackend
+from gpushare_device_plugin_tpu.extender.server import ExtenderCore
+
+from fake_apiserver import FakeApiServer
+from k8s_fixtures import make_pod
+
+NODE = "gang-node"
+CHIPS = 4
+UNITS = 32
+
+
+def wait_until(pred, timeout=10.0, every=0.002):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(every)
+    return False
+
+
+@pytest.fixture()
+def stack():
+    api = FakeApiServer()
+    api.add_node(NODE)
+    api.start()
+    client = ApiServerClient(api.url)
+    inv = DeviceInventory(
+        MockBackend(num_chips=CHIPS, hbm_bytes=UNITS << 30).chips()
+    )
+    informer = PodInformer(client, NODE).start(sync_timeout_s=5)
+    yield api, client, inv, informer
+    informer.stop()
+    api.stop()
+
+
+def gang_pod(name, total, shape, **kw):
+    ann = {const.ANN_GANG_SHAPE: shape}
+    ann.update(kw.pop("annotations", {}))
+    return make_pod(name, total, node=NODE, annotations=ann, **kw)
+
+
+# --- ledger atomicity -------------------------------------------------------
+
+
+def test_gang_reservation_is_one_atomic_entry():
+    assume = AssumeCache()
+    key = ("default", "g")
+    assume.reserve_gang(key, [(0, 8), (1, 8)])
+    mem_used, _ = assume.overlaid_state(lambda: ({}, set()))
+    assert mem_used == {0: 8, 1: 8}
+    assume.release(key)
+    mem_used, _ = assume.overlaid_state(lambda: ({}, set()))
+    assert mem_used == {}
+
+
+def test_gang_ttl_expiry_releases_every_member_in_one_pass():
+    """Satellite: an expired PARTIAL gang admission (claim + gang
+    reservation whose owner died) frees all member chips together —
+    never a single-chip sliver."""
+    now = [0.0]
+    assume = AssumeCache(ttl_s=10.0, clock=lambda: now[0])
+    key = ("default", "dead-gang")
+    assert assume.claim(key)
+    assume.reserve_gang(key, [(0, 8), (1, 8), (2, 8), (3, 8)])
+    now[0] = 5.0
+    mem_used, _ = assume.overlaid_state(lambda: ({}, set()))
+    assert mem_used == {0: 8, 1: 8, 2: 8, 3: 8}  # young: still protective
+    now[0] = 11.0
+    released = assume.expire_stale()
+    assert key in released
+    mem_used, _ = assume.overlaid_state(lambda: ({}, set()))
+    assert mem_used == {}, "partial gang release left a sliver"
+    assert assume.gang_snapshot() == {}
+
+
+def test_gang_checkpoint_replay_reinstalls_whole_gang(tmp_path):
+    ckpt = AllocationCheckpoint(str(tmp_path / "g.ckpt"))
+    ckpt.begin(("default", "g"), {
+        "kind": "gang", "chips": [0, 2], "per_chip": 4,
+        "annotations": {},
+    })
+    ckpt.close()
+    re_ckpt = AllocationCheckpoint(str(tmp_path / "g.ckpt"))
+    assume = AssumeCache()
+    assert replay_checkpoint(re_ckpt, assume) == 1
+    mem_used, _ = assume.overlaid_state(lambda: ({}, set()))
+    assert mem_used == {0: 4, 2: 4}
+    re_ckpt.close()
+
+
+# --- allocator branch B (topology placement) --------------------------------
+
+
+def test_gang_allocate_places_scored_slice_and_persists(stack):
+    api, client, inv, informer = stack
+    alloc = ClusterAllocator(inv, client, informer, NODE)
+    api.add_pod(gang_pod("g1", 16, "2x1"))
+    assert wait_until(lambda: len(informer.pending_pods()) == 1)
+    res = alloc.allocate([[f"d{i}" for i in range(16)]])
+    envs = res[0].envs
+    assert envs[const.ENV_GANG_CHIPS] == "0,1"
+    assert envs[const.ENV_GANG_PER_CHIP] == "8"
+    assert envs[const.ENV_TPU_VISIBLE_CHIPS] == "0,1"
+    assert envs[const.ENV_TPU_PROCESS_BOUNDS] == "1,1,1"
+    assert envs[const.ENV_TPU_CHIPS_PER_PROCESS_BOUNDS] == "2,1,1"
+    # per-chip cooperative cap, not the pod total
+    assert envs[const.ENV_XLA_MEM_FRACTION] == "0.2500"
+    pod = client.get_pod("default", "g1")
+    assert P.gang_chips_from_annotation(pod) == [0, 1]
+    assert P.gang_per_chip_units(pod) == 8
+    assert P.used_units_by_chip([pod]) == {0: 8, 1: 8}
+    # the informer's incremental accounting must agree once the watch lands
+    assert wait_until(lambda: informer.chip_state()[0] == {0: 8, 1: 8})
+
+
+def test_gang_units_must_divide_over_shape(stack):
+    api, client, inv, informer = stack
+    alloc = ClusterAllocator(inv, client, informer, NODE)
+    api.add_pod(gang_pod("bad", 10, "2x2"))  # 10 % 4 != 0
+    assert wait_until(lambda: len(informer.pending_pods()) == 1)
+    with pytest.raises(AllocationFailure, match="divide evenly"):
+        alloc.allocate([[f"d{i}" for i in range(10)]])
+
+
+def test_gang_rejected_when_no_slice_fits(stack):
+    api, client, inv, informer = stack
+    alloc = ClusterAllocator(inv, client, informer, NODE)
+    # 2x2 gang of 33 units/chip exceeds every 32-unit chip
+    api.add_pod(gang_pod("big", 33 * 4, "2x2"))
+    assert wait_until(lambda: len(informer.pending_pods()) == 1)
+    with pytest.raises(AllocationFailure, match="sub-slice"):
+        alloc.allocate([[f"d{i}" for i in range(33 * 4)]])
+
+
+def test_gang_excludes_core_held_chips(stack):
+    api, client, inv, informer = stack
+    assume = AssumeCache()
+    alloc = ClusterAllocator(inv, client, informer, NODE, assume=assume)
+    core = ClusterCoreAllocator(inv, client, informer, NODE, assume=assume)
+    api.add_pod(make_pod("core-pod", 0, node=NODE, tpu_core=2))
+    assert wait_until(lambda: len(informer.pending_pods()) == 1)
+    core.allocate([[inv.id_of_index(0), inv.id_of_index(1)]])
+    api.add_pod(gang_pod("g2", 16, "2x1"))
+    assert wait_until(
+        lambda: informer.get_pod("default", "g2") is not None
+    )
+    res = alloc.allocate([[f"d{i}" for i in range(16)]])
+    chips = res[0].envs[const.ENV_GANG_CHIPS]
+    assert chips == "2,3", f"gang landed on core-held chips: {chips}"
+
+
+# --- allocator branch A (extender-assumed gangs) ----------------------------
+
+
+def test_assumed_gang_is_honored(stack):
+    api, client, inv, informer = stack
+    alloc = ClusterAllocator(inv, client, informer, NODE)
+    api.add_pod(gang_pod(
+        "ag", 16, "2x1",
+        annotations={
+            const.ENV_GANG_CHIPS: "1,3",
+            const.ENV_GANG_SHAPE: "1x2x1",
+            const.ENV_GANG_PER_CHIP: "8",
+            const.ENV_MEM_POD: "16",
+            const.ENV_ASSIGNED_FLAG: "false",
+            const.ENV_ASSUME_TIME: "1",
+        },
+    ))
+    assert wait_until(lambda: len(informer.pending_pods()) == 1)
+    res = alloc.allocate([[f"d{i}" for i in range(16)]])
+    assert res[0].envs[const.ENV_GANG_CHIPS] == "1,3"
+    pod = client.get_pod("default", "ag")
+    assert P.is_assigned(pod)
+    assert P.gang_chips_from_annotation(pod) == [1, 3]
+
+
+def test_assumed_gang_with_conflicting_member_fails_whole_gang(stack):
+    """All-or-nothing on branch A too: ONE bad member chip fails the
+    entire gang admission — no member may be granted alone."""
+    api, client, inv, informer = stack
+    assume = AssumeCache()
+    alloc = ClusterAllocator(inv, client, informer, NODE, assume=assume)
+    # chip 1 is exclusively reserved by an in-flight core admission
+    assume.claim(("default", "other"))
+    assume.reserve_core(("default", "other"), [1])
+    api.add_pod(gang_pod(
+        "ag2", 16, "2x1",
+        annotations={
+            const.ENV_GANG_CHIPS: "0,1",
+            const.ENV_GANG_PER_CHIP: "8",
+            const.ENV_ASSIGNED_FLAG: "false",
+            const.ENV_ASSUME_TIME: "1",
+        },
+    ))
+    assert wait_until(lambda: len(informer.pending_pods()) == 1)
+    with pytest.raises(AllocationFailure, match="core-held or unhealthy"):
+        alloc.allocate([[f"d{i}" for i in range(16)]])
+    # nothing leaked: the failed admission released its claim and no gang
+    # reservation survives
+    assert assume.gang_snapshot() == {}
+    pod = client.get_pod("default", "ag2")
+    assert not P.is_assigned(pod)
+
+
+# --- extender gang placement ------------------------------------------------
+
+
+def topo_node(name, chips=8, units=32, label="2x2x2"):
+    cap = {
+        const.RESOURCE_MEM: str(chips * units),
+        const.RESOURCE_COUNT: str(chips),
+    }
+    return {
+        "metadata": {
+            "name": name,
+            "labels": {const.LABEL_NODE_TOPOLOGY: label},
+            "resourceVersion": "1",
+        },
+        "status": {"capacity": dict(cap), "allocatable": dict(cap)},
+    }
+
+
+@pytest.fixture()
+def extender():
+    api = FakeApiServer()
+    api.start()
+    node = topo_node("xg")
+    api.nodes["xg"] = node
+    client = ApiServerClient(api.url)
+    informer = PodInformer(client).start(sync_timeout_s=10)
+    core = ExtenderCore(client, informer=informer)
+    yield api, client, core, node
+    informer.stop()
+    api.stop()
+
+
+def test_extender_gang_bind_persists_whole_gang(extender):
+    api, client, core, node = extender
+    pod = make_pod("gb", 32, node="", annotations={const.ANN_GANG_SHAPE: "2x2x1"})
+    api.add_pod(pod)
+    res = core.batch({"pod": pod, "nodes": {"items": [node]}})
+    assert res["nodenames"] == ["xg"]
+    assert core.bind(
+        {"podNamespace": "default", "podName": "gb", "node": "xg"}
+    ) == {"error": ""}
+    bound = client.get_pod("default", "gb")
+    ann = bound["metadata"]["annotations"]
+    chips = P.gang_chips_from_annotation(bound)
+    assert len(chips) == 4 and len(set(chips)) == 4
+    assert ann[const.ENV_GANG_PER_CHIP] == "8"
+    assert ann[const.ENV_ASSIGNED_FLAG] == "false"  # plugin flips at admission
+    # the whole grant landed in ONE write: per-container map matches
+    import json as _json
+
+    alloc_map = _json.loads(ann[const.ANN_EXTENDER_ALLOCATION])
+    assert alloc_map == {"c0": {str(i): 8 for i in chips}}
+
+
+def test_extender_inflight_gang_blocks_double_booking(extender):
+    """Two sequential gang binds before any watch event: the second must
+    see the first's in-flight per-chip claims and land elsewhere."""
+    api, client, core, node = extender
+    for name in ("ga", "gbb"):
+        api.add_pod(make_pod(
+            name, 4 * 32, node="",
+            annotations={const.ANN_GANG_SHAPE: "2x2x1"},
+        ))
+    assert core.bind(
+        {"podNamespace": "default", "podName": "ga", "node": "xg"}
+    ) == {"error": ""}
+    assert core.bind(
+        {"podNamespace": "default", "podName": "gbb", "node": "xg"}
+    ) == {"error": ""}
+    a = set(P.gang_chips_from_annotation(client.get_pod("default", "ga")))
+    b = set(P.gang_chips_from_annotation(client.get_pod("default", "gbb")))
+    assert a and b and not (a & b), f"gangs overlap: {a} & {b}"
+
+
+def test_extender_filter_rejects_unfittable_gang(extender):
+    api, client, core, node = extender
+    pod = make_pod(
+        "toobig", 33 * 8, node="",
+        annotations={const.ANN_GANG_SHAPE: "2x2x2"},
+    )
+    fits, failed = (
+        lambda r: (r["nodenames"], r["failedNodes"])
+    )(core.filter({"pod": pod, "nodes": {"items": [node]}}))
+    assert fits == []
+    assert "sub-slice" in failed["xg"]
+
+
+def test_extender_gang_scores_rank_packing(extender):
+    """A node whose feasible slice strands less free HBM scores higher
+    under best-fit (the gang analog of the single-chip policy)."""
+    api, client, core, node = extender
+    import gpushare_device_plugin_tpu.extender.logic as logic
+
+    empty = logic.NodeView(
+        name="empty", resource=const.RESOURCE_MEM,
+        capacity={i: 32 for i in range(4)}, used={},
+        topology=logic.node_topology({}, {i: 32 for i in range(4)}),
+    )
+    packed = logic.NodeView(
+        name="packed", resource=const.RESOURCE_MEM,
+        capacity={i: 32 for i in range(4)}, used={0: 24, 1: 24},
+        topology=logic.node_topology({}, {i: 32 for i in range(4)}),
+    )
+    scores = logic.evaluate_scores(16, [empty, packed], "best-fit", gang_shape="2x1")
+    assert scores["packed"] > scores["empty"]
+
+
+# --- sizing -----------------------------------------------------------------
+
+
+def test_slots_for_gang_per_chip_math():
+    import jax.numpy as jnp
+
+    from gpushare_device_plugin_tpu.serving import (
+        kv_slot_bytes,
+        slots_for_gang,
+        slots_for_slice,
+    )
+    from gpushare_device_plugin_tpu.workloads.transformer import (
+        TransformerConfig,
+    )
+
+    cfg = TransformerConfig(
+        vocab=128, d_model=256, n_layers=2, n_heads=4, n_kv_heads=4,
+        d_ff=512, max_seq=128, compute_dtype=jnp.float32,
+    )
+    per_chip = 1 << 30
+    w = 8 << 20
+    single = slots_for_slice(per_chip, cfg, 128, weight_bytes=w)
+    gang = slots_for_gang(per_chip, 4, cfg, 128, weight_bytes=w)
+    # sharded weights + sharded KV: a gang of 4 serves ~4x the slots of
+    # one chip's identical slice
+    assert gang >= 3 * single
+    # kv-heads not divisible by the gang -> replicated cache, no free lunch
+    cfg_odd = TransformerConfig(
+        vocab=128, d_model=256, n_layers=2, n_heads=3, n_kv_heads=3,
+        d_ff=512, max_seq=128, compute_dtype=jnp.float32,
+    )
+    assert slots_for_gang(per_chip, 2, cfg_odd, 128, weight_bytes=w) <= (
+        slots_for_slice(per_chip, cfg_odd, 128, weight_bytes=w)
+    )
+    assert kv_slot_bytes(cfg, 128) > 0
+    with pytest.raises(ValueError):
+        slots_for_gang(per_chip, 0, cfg, 128, weight_bytes=w)
+
+
+def test_assumed_gang_rejects_truncated_or_duplicated_member_list(stack):
+    """The gang annotation is user-writable: a member list shorter than
+    the request's shape (would under-reserve) or containing duplicates
+    (would stack one chip twice) must fail the whole admission."""
+    api, client, inv, informer = stack
+    alloc = ClusterAllocator(inv, client, informer, NODE)
+    for name, chips in (("trunc", "0"), ("dup", "0,0")):
+        api.add_pod(gang_pod(
+            name, 16, "2x1",
+            annotations={
+                const.ENV_GANG_CHIPS: chips,
+                const.ENV_GANG_PER_CHIP: "8",
+                const.ENV_ASSIGNED_FLAG: "false",
+                const.ENV_ASSUME_TIME: "1",
+            },
+        ))
+    assert wait_until(lambda: len(informer.pending_pods()) == 2)
+    with pytest.raises(AllocationFailure, match="distinct members"):
+        alloc.allocate([[f"d{i}" for i in range(16)]])
+
+
+def test_extender_batch_verb_uses_gang_semantics(extender):
+    """The batched filter+prioritize verb must evaluate gang pods as
+    gangs: a 2x2 gang of 16 units/chip fits the 8x32 node even though no
+    single chip could hold the 64-unit total (the single-chip reading
+    would wrongly reject), and an unfittable per-chip share fails with
+    the gang reason."""
+    api, client, core, node = extender
+    fits_pod = make_pod(
+        "batch-gang", 64, node="",
+        annotations={const.ANN_GANG_SHAPE: "2x2"},
+    )
+    res = core.batch({"pod": fits_pod, "nodes": {"items": [node]}})
+    assert res["nodenames"] == ["xg"], res["failedNodes"]
+    assert res["hostPriorityList"][0]["score"] >= 0
+    nofit_pod = make_pod(
+        "batch-nofit", 33 * 4, node="",
+        annotations={const.ANN_GANG_SHAPE: "2x2"},
+    )
+    res = core.batch({"pod": nofit_pod, "nodes": {"items": [node]}})
+    assert res["nodenames"] == []
+    assert "sub-slice" in res["failedNodes"]["xg"]
+
+
+def test_gang_per_chip_units_prefers_immutable_spec():
+    """A tampered ENV_GANG_PER_CHIP annotation must not shrink what the
+    accounting layers book: the spec's total limits / member count wins
+    whenever it divides."""
+    pod = make_pod("t", 32, annotations={
+        const.ENV_GANG_CHIPS: "0,1,2,3",
+        const.ENV_GANG_PER_CHIP: "1",  # tampered: real share is 8
+    })
+    assert P.gang_per_chip_units(pod) == 8
+    assert P.gang_usage_by_chip(pod) == {0: 8, 1: 8, 2: 8, 3: 8}
+    # underivable from spec (total does not divide): annotation fallback
+    odd = make_pod("o", 7, annotations={
+        const.ENV_GANG_CHIPS: "0,1",
+        const.ENV_GANG_PER_CHIP: "3",
+    })
+    assert P.gang_per_chip_units(odd) == 3
+
+
+def test_assumed_gang_degrades_mismatched_shape_annotation(stack):
+    """A stale/tampered ENV_GANG_SHAPE whose size disagrees with the
+    member count must not reach TPU_CHIPS_PER_PROCESS_BOUNDS — the
+    carve-out degrades to a line over the actual members."""
+    api, client, inv, informer = stack
+    alloc = ClusterAllocator(inv, client, informer, NODE)
+    api.add_pod(gang_pod(
+        "stale-shape", 16, "2x1",
+        annotations={
+            const.ENV_GANG_CHIPS: "1,3",
+            const.ENV_GANG_SHAPE: "3x3x3",  # size 27 != 2 members
+            const.ENV_GANG_PER_CHIP: "8",
+            const.ENV_ASSIGNED_FLAG: "false",
+            const.ENV_ASSUME_TIME: "1",
+        },
+    ))
+    assert wait_until(lambda: len(informer.pending_pods()) == 1)
+    res = alloc.allocate([[f"d{i}" for i in range(16)]])
+    envs = res[0].envs
+    assert envs[const.ENV_GANG_CHIPS] == "1,3"
+    assert envs[const.ENV_TPU_CHIPS_PER_PROCESS_BOUNDS] == "2,1,1"

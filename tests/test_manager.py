@@ -279,3 +279,34 @@ def test_standalone_core_hold_blocks_mem_binpack(tmp_path):
         manager.trigger_stop("test")
         t.join(timeout=5)
         kubelet.stop()
+
+
+def test_manager_reads_node_topology_label_for_gang_placement(tmp_path):
+    """The daemon's gang placement must use the same grid the extender
+    reads from the node's topology label — a 4x1x1-labeled host has no
+    2x2 sub-slice even though the default 4-chip grid (2x2x1) would."""
+    from gpushare_device_plugin_tpu.device import DeviceInventory
+
+    api = FakeApiServer()
+    api.add_node(NODE)
+    api.nodes[NODE].setdefault("metadata", {}).setdefault("labels", {})[
+        const.LABEL_NODE_TOPOLOGY
+    ] = "4x1x1"
+    api.start()
+    try:
+        client = ApiServerClient(api.url)
+        manager = TpuShareManager(
+            MockBackend(num_chips=4, hbm_bytes=32 << 30),
+            ManagerConfig(plugin_dir=str(tmp_path), node_name=NODE),
+            api_client=client,
+            pod_source=ApiServerPodSource(client, NODE),
+        )
+        inv = DeviceInventory(MockBackend(num_chips=4, hbm_bytes=32 << 30).chips())
+        topo = manager._node_chip_topology(inv)
+        assert topo.dims == (4, 1, 1)
+        assert topo.candidates("2x2") == []  # a line has no square slice
+        # garbled/missing label degrades to the default grid
+        api.nodes[NODE]["metadata"]["labels"][const.LABEL_NODE_TOPOLOGY] = "9x9"
+        assert manager._node_chip_topology(inv).dims == (2, 2, 1)
+    finally:
+        api.stop()

@@ -241,3 +241,114 @@ def test_background_loop_runs_and_stops(api):
         assert assume.snapshot()[1] == {}
     finally:
         rec.stop()
+
+
+def test_expired_partial_gang_releases_every_member_in_one_pass(api):
+    """ISSUE 6 satellite: an expired PARTIAL gang reservation (the owner
+    died mid-admission, claim still standing) must release EVERY member
+    chip in one reconcile pass — never leave a single-chip sliver
+    claimed."""
+    now = [0.0]
+    assume = AssumeCache(ttl_s=10.0, clock=lambda: now[0])
+    key = ("default", "dead-gang")
+    assert assume.claim(key)
+    assume.reserve_gang(key, [(0, 8), (1, 8), (2, 8), (3, 8)])
+    now[0] = 11.0
+    rec, _ = make_reconciler(api, assume)
+    counts = rec.reconcile_once()
+    assert counts.get("expired_reservation", 0) >= 1
+    # ALL members gone in the same pass: overlay shows zero residual usage
+    mem_used, _ = assume.overlaid_state(lambda: ({}, set()))
+    assert mem_used == {}, f"partial gang left slivers: {mem_used}"
+    assert assume.gang_snapshot() == {}
+    # the pod is re-admittable
+    assert assume.claim(key)
+
+
+def test_orphaned_gang_reservation_released_whole(api):
+    """A gang whose pod was deleted mid-allocation releases atomically
+    through the orphan path too (not only TTL)."""
+    assume = AssumeCache()
+    assume.reserve_gang(("default", "ghost-gang"), [(1, 4), (2, 4)])
+    rec, _ = make_reconciler(api, assume)
+    counts = rec.reconcile_once()
+    assert counts.get("orphan_reservation") == 1
+    assert assume.gang_snapshot() == {}
+    mem_used, _ = assume.overlaid_state(lambda: ({}, set()))
+    assert mem_used == {}
+
+
+def test_gang_annotation_audit_counts_per_chip(api):
+    """The audit books gang pods per-chip: a gang whose members sum past
+    a chip's inventory is overcommit; a garbled member list is flagged."""
+    from k8s_fixtures import make_pod as mp
+
+    labels = {const.LABEL_RESOURCE_KEY: const.LABEL_RESOURCE_VALUE}
+    api.add_pod(mp(
+        "gang-ok", 8, node=NODE, phase="Running", labels=labels,
+        annotations={
+            const.ENV_ASSIGNED_FLAG: "true",
+            const.ENV_GANG_CHIPS: "0,1",
+            const.ENV_GANG_PER_CHIP: "4",
+        },
+    ))
+    api.add_pod(mp(
+        "gang-fat", 100, node=NODE, phase="Running", labels=labels,
+        annotations={
+            const.ENV_ASSIGNED_FLAG: "true",
+            const.ENV_GANG_CHIPS: "0,1",
+            const.ENV_GANG_PER_CHIP: "50",
+        },
+    ))
+    api.add_pod(mp(
+        "gang-garbled", 8, node=NODE, phase="Running", labels=labels,
+        annotations={
+            const.ENV_ASSIGNED_FLAG: "true",
+            const.ENV_GANG_CHIPS: "zero,one",
+            const.ENV_GANG_PER_CHIP: "4",
+        },
+    ))
+    inv = DeviceInventory(MockBackend(num_chips=2, hbm_bytes=8 << 30).chips())
+    assume = AssumeCache()
+    rec, _ = make_reconciler(api, assume, inventory=inv)
+    counts = rec.reconcile_once()
+    # both chips exceed 8 units (4+50 each) -> overcommit on each
+    assert counts.get("overcommit") == 2
+    assert counts.get("garbled_annotation") == 1
+
+
+def test_gang_unknown_chip_not_double_counted_as_overcommit(api):
+    """A gang member pointing off the inventory is ONE unknown_chip
+    drift; its share must not also inflate the overcommit audit."""
+    from k8s_fixtures import make_pod as mp
+
+    labels = {const.LABEL_RESOURCE_KEY: const.LABEL_RESOURCE_VALUE}
+    api.add_pod(mp(
+        "gang-off-grid", 8, node=NODE, phase="Running", labels=labels,
+        annotations={
+            const.ENV_ASSIGNED_FLAG: "true",
+            const.ENV_GANG_CHIPS: "0,7",
+            const.ENV_GANG_PER_CHIP: "4",
+        },
+    ))
+    inv = DeviceInventory(MockBackend(num_chips=2, hbm_bytes=8 << 30).chips())
+    rec, _ = make_reconciler(api, AssumeCache(), inventory=inv)
+    counts = rec.reconcile_once()
+    assert counts.get("unknown_chip") == 1
+    assert "overcommit" not in counts
+
+
+def test_gang_request_admitted_single_chip_audits_normally(api):
+    """Rolling-upgrade case: a pod that REQUESTS a gang shape but was
+    admitted single-chip (pre-gang daemon) must be audited by its IDX —
+    not classed garbled, and its units must reach the overcommit sums."""
+    pod = assigned_running_pod(
+        "legacy-gang-req", 50, chip_idx=0, node=NODE,
+        annotations={const.ANN_GANG_SHAPE: "2x2"},
+    )
+    api.add_pod(pod)
+    inv = DeviceInventory(MockBackend(num_chips=2, hbm_bytes=8 << 30).chips())
+    rec, _ = make_reconciler(api, AssumeCache(), inventory=inv)
+    counts = rec.reconcile_once()
+    assert "garbled_annotation" not in counts
+    assert counts.get("overcommit") == 1  # 50 units on an 8-unit chip

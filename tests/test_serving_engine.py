@@ -330,3 +330,156 @@ def test_slots_from_pod_env_reads_slice(setup):
     assert n == (1 << 30) // per
     with pytest.raises(ValueError, match="aliyun.com/tpu-mem"):
         slots_from_pod_env(cfg, 32, weight_bytes=4 << 30, env=env)
+
+
+# --- tensor-parallel serving across a granted gang (ISSUE 6) ----------------
+
+
+def _gang_env(tp: int, per_chip: int = 8, chip_units: int = 32):
+    """The env a granted gang container receives from the device plugin."""
+    return PodTpuEnv.from_env({
+        "TPU_VISIBLE_CHIPS": ",".join(str(i) for i in range(tp)),
+        "ALIYUN_COM_TPU_GANG_CHIPS": ",".join(str(i) for i in range(tp)),
+        "ALIYUN_COM_TPU_GANG_SHAPE": f"{tp}x1x1",
+        "ALIYUN_COM_TPU_GANG_PER_CHIP": str(per_chip),
+        "ALIYUN_COM_TPU_MEM_CONTAINER": str(per_chip * tp),
+        "ALIYUN_COM_TPU_MEM_DEV": str(chip_units),
+    })
+
+
+@pytest.mark.parametrize("tp", [2, 4])
+def test_tp_engine_tokens_identical_to_single_chip(tp):
+    """The acceptance bar: the tensor-parallel engine over a granted gang
+    emits tokens BIT-IDENTICAL to the single-chip engine on the same
+    trace, with zero retraces across slot churn (sharding is a layout
+    property of the same three compiled programs)."""
+    from gpushare_device_plugin_tpu.parallel.podenv import gang_mesh
+
+    cfg = _cfg(n_kv_heads=4)  # kv-heads divisible by both tp sizes
+    params = init_params(jax.random.key(1), cfg)
+    reqs = poisson_trace(
+        10, seed=7, rate=0.3, vocab=cfg.vocab, prompt_lens=(2, 10),
+        max_new=[3, 4, 5, 20],
+    )
+    kw = dict(slots=3, max_len=48, prefill_chunk=8, eos_id=EOS)
+    solo = SlotEngine(params, cfg, **kw)
+    solo.warmup()
+    s = solo.run(reqs)
+
+    mesh = gang_mesh(_gang_env(tp), devices=jax.devices()[:tp])
+    eng = SlotEngine(params, cfg, mesh=mesh, **kw)
+    eng.warmup()
+    warm = dict(eng.trace_counts)
+    t = eng.run(reqs)
+    assert sum(eng.trace_counts[k] - warm[k] for k in warm) == 0
+    assert {r.rid: r.tokens for r in t.results} == {
+        r.rid: r.tokens for r in s.results
+    }
+    # and both sides still match the solo-generate oracle
+    assert_parity(reqs, t, params, cfg)
+
+
+def test_tp_engine_int8_kv_cache_shards_too():
+    """int8 KV (quantized values + f32 scales) shards its kv-heads axis
+    the same way; parity bar unchanged."""
+    from gpushare_device_plugin_tpu.parallel.podenv import gang_mesh
+
+    cfg = _cfg(n_kv_heads=4)
+    params = init_params(jax.random.key(2), cfg)
+    reqs = poisson_trace(
+        6, seed=9, rate=0.4, vocab=cfg.vocab, prompt_lens=(2, 8),
+        max_new=[3, 8],
+    )
+    kw = dict(slots=2, max_len=48, prefill_chunk=8, eos_id=EOS,
+              kv_dtype="int8")
+    solo = SlotEngine(params, cfg, **kw)
+    solo.warmup()
+    s = solo.run(reqs)
+    mesh = gang_mesh(_gang_env(2), devices=jax.devices()[:2])
+    eng = SlotEngine(params, cfg, mesh=mesh, **kw)
+    eng.warmup()
+    t = eng.run(reqs)
+    assert {r.rid: r.tokens for r in t.results} == {
+        r.rid: r.tokens for r in s.results
+    }
+
+
+def test_tp_engine_replicates_cache_when_kv_heads_do_not_divide():
+    """kv_heads % tp != 0: the cache falls back to replication (prune
+    rule) instead of an XLA error; tokens still identical."""
+    from gpushare_device_plugin_tpu.parallel.podenv import gang_mesh
+
+    cfg = _cfg(n_heads=4, n_kv_heads=2)
+    params = init_params(jax.random.key(3), cfg)
+    reqs = poisson_trace(
+        4, seed=5, rate=0.5, vocab=cfg.vocab, prompt_lens=(2, 6),
+        max_new=[3, 6],
+    )
+    kw = dict(slots=2, max_len=32, prefill_chunk=8, eos_id=EOS)
+    solo = SlotEngine(params, cfg, **kw)
+    solo.warmup()
+    s = solo.run(reqs)
+    mesh = gang_mesh(_gang_env(4), devices=jax.devices()[:4])
+    eng = SlotEngine(params, cfg, mesh=mesh, **kw)
+    eng.warmup()
+    t = eng.run(reqs)
+    assert {r.rid: r.tokens for r in t.results} == {
+        r.rid: r.tokens for r in s.results
+    }
+
+
+def test_slots_from_pod_env_gang_uses_per_chip_share():
+    """A gang pod sizes its pool over the PER-CHIP slice: 4 chips at the
+    same per-chip share admit ~4x the slots (weights + KV shard)."""
+    cfg = _cfg(n_kv_heads=4)
+    per = kv_slot_bytes(cfg, 32)
+    w = 64 * per
+    gang = _gang_env(4, per_chip=1, chip_units=16)
+    single = PodTpuEnv.from_env({
+        "ALIYUN_COM_TPU_MEM_CONTAINER": "1",
+        "ALIYUN_COM_TPU_MEM_DEV": "16",
+    })
+    n_single = slots_from_pod_env(
+        cfg, 32, weight_bytes=w, env=single, headroom=1.0
+    )
+    n_gang = slots_from_pod_env(
+        cfg, 32, weight_bytes=w, env=gang, headroom=1.0
+    )
+    assert gang.is_gang and gang.gang_per_chip_bytes() == 1 << 30
+    assert n_gang >= 3 * n_single
+
+
+def test_gang_mesh_rejects_device_count_mismatch():
+    """A mis-injected env (more OR fewer visible devices than the gang
+    grants) must fail loudly, never mesh over chips outside the grant."""
+    from gpushare_device_plugin_tpu.parallel.podenv import gang_mesh
+
+    env = _gang_env(2)
+    with pytest.raises(ValueError, match="disagree"):
+        gang_mesh(env, devices=jax.devices()[:4])
+    with pytest.raises(ValueError, match="disagree"):
+        gang_mesh(env, devices=jax.devices()[:1])
+    assert gang_mesh(env, devices=jax.devices()[:2]) is not None
+
+
+def test_slots_from_pod_env_gang_scales_to_container_share():
+    """Multi-container gang pods: each container sizes its pool to ITS
+    portion of the per-chip share, not the pod's whole share."""
+    cfg = _cfg(n_kv_heads=4)
+    per = kv_slot_bytes(cfg, 32)
+    w = 64 * per
+    whole = _gang_env(4, per_chip=2, chip_units=16)
+    half = PodTpuEnv.from_env({
+        "ALIYUN_COM_TPU_GANG_CHIPS": "0,1,2,3",
+        "ALIYUN_COM_TPU_GANG_SHAPE": "4x1x1",
+        "ALIYUN_COM_TPU_GANG_PER_CHIP": "2",
+        "ALIYUN_COM_TPU_MEM_POD": "8",
+        "ALIYUN_COM_TPU_MEM_CONTAINER": "4",  # half the pod's units
+        "ALIYUN_COM_TPU_MEM_DEV": "16",
+    })
+    assert half.gang_container_per_chip_bytes() == 1 << 30  # 2 GiB * 1/2
+    n_whole = slots_from_pod_env(cfg, 32, weight_bytes=w, env=whole,
+                                 headroom=1.0)
+    n_half = slots_from_pod_env(cfg, 32, weight_bytes=w, env=half,
+                                headroom=1.0)
+    assert 0 < n_half < n_whole

@@ -1,0 +1,154 @@
+"""Topology device-shape model (gpushare_device_plugin_tpu/topology):
+shape parsing, grid coordinates, sub-slice enumeration, and the joint
+(hops, stranded, fragmentation) scoring — the pure layer under gang
+placement (ISSUE 6 tentpole)."""
+
+import itertools
+
+import pytest
+
+from gpushare_device_plugin_tpu.topology import (
+    ChipTopology,
+    format_shape,
+    parse_shape,
+    shape_size,
+)
+
+
+# --- shape wire form --------------------------------------------------------
+
+
+def test_parse_shape_forms():
+    assert parse_shape("2x2x1") == (2, 2, 1)
+    assert parse_shape("4") == (4,)
+    assert parse_shape("2X2") == (2, 2)  # case-insensitive
+    assert shape_size("2x2x2") == 8
+    assert shape_size("4") == 4
+    assert format_shape((2, 2, 1)) == "2x2x1"
+
+
+@pytest.mark.parametrize("bad", ["", "0x2", "2x-1", "axb", "2x2x2x2", "1.5"])
+def test_parse_shape_rejects_garbage(bad):
+    with pytest.raises(ValueError):
+        parse_shape(bad)
+
+
+# --- grids ------------------------------------------------------------------
+
+
+def test_default_grids_are_v4_style():
+    assert ChipTopology.default_for(4).dims == (2, 2, 1)
+    assert ChipTopology.default_for(8).dims == (2, 2, 2)
+    assert ChipTopology.default_for(16).dims == (4, 2, 2)
+    assert ChipTopology.default_for(1).dims == (1, 1, 1)
+    # non-power-of-two degrades to a line
+    assert ChipTopology.default_for(6).n_chips == 6
+
+
+def test_from_label_validates_against_chip_count():
+    assert ChipTopology.from_label("4x2x1", 8).dims == (4, 2, 1)
+    # inconsistent or garbled labels fall back to the default grid
+    assert ChipTopology.from_label("2x2x2", 4).dims == (2, 2, 1)
+    assert ChipTopology.from_label("banana", 4).dims == (2, 2, 1)
+    assert ChipTopology.from_label(None, 8).dims == (2, 2, 2)
+
+
+def test_coords_round_trip_and_distance():
+    topo = ChipTopology((2, 2, 2))
+    for i in range(topo.n_chips):
+        assert topo.index(*topo.coords(i)) == i
+    # Manhattan on the grid: 0=(0,0,0), 7=(1,1,1)
+    assert topo.distance(0, 7) == 3
+    assert topo.distance(0, 1) == 1
+    assert topo.distance(0, 0) == 0
+
+
+# --- enumeration ------------------------------------------------------------
+
+
+def test_candidates_enumerate_all_orientations():
+    topo = ChipTopology((2, 2, 2))
+    # "2x2x1" planes exist in all three orientations: 6 distinct sets
+    cands = topo.candidates("2x2x1")
+    assert len(cands) == 6
+    assert all(len(c.chips) == 4 for c in cands)
+    # every candidate is ICI-compact: a 2x2 square has pairwise hop sum 8
+    assert {c.hops for c in cands} == {8}
+
+
+def test_count_request_enumerates_factorizations():
+    topo = ChipTopology((4, 1, 1))
+    # on a line, "4" realizes only as the whole line
+    cands = topo.candidates("4")
+    assert [c.chips for c in cands] == [(0, 1, 2, 3)]
+    # a 2x2 grid realizes "4" as the square (and the square wins on hops
+    # over any line had one existed)
+    sq = ChipTopology((2, 2, 1)).candidates("4")
+    assert [c.chips for c in sq] == [(0, 1, 2, 3)]
+    assert sq[0].hops == 8
+
+
+def test_count_request_prefers_compact_shapes():
+    # 4x2 grid: "4" fits as 4x1 lines, 1x... and 2x2 squares; the square
+    # (hops 8) must rank ahead of the line (hops 10)
+    topo = ChipTopology((4, 2, 1))
+    cands = topo.candidates("4")
+    squares = [c for c in cands if c.shape == (2, 2, 1)]
+    lines = [c for c in cands if c.shape == (4, 1, 1)]
+    assert squares and lines
+    assert all(s.hops < l.hops for s, l in itertools.product(squares, lines))
+    assert cands[0].shape == (2, 2, 1)  # sorted by hops
+
+
+def test_explicit_shape_must_fit():
+    topo = ChipTopology((2, 2, 1))
+    assert topo.candidates("2x2x2") == []
+    assert topo.candidates("3x1x1") == []
+
+
+# --- scoring ----------------------------------------------------------------
+
+
+def test_best_slice_minimizes_stranded_slivers():
+    topo = ChipTopology((2, 2, 1))
+    cap = {i: 32 for i in range(4)}
+    # chips 0,1 already half-used: claiming them leaves less stranded
+    free = {0: 16, 1: 16, 2: 32, 3: 32}
+    best = topo.best_slice("2x1x1", free, 16, capacity=cap)
+    assert best.chips == (0, 1)
+
+
+def test_best_slice_prefers_not_cracking_whole_chips():
+    topo = ChipTopology((2, 2, 1))
+    cap = {i: 32 for i in range(4)}
+    # equal stranding either way (8 left per member), but chips 0,1 are
+    # already cracked — leave 2,3 whole for core/exclusive pods
+    free = {0: 24, 1: 24, 2: 32, 3: 32}
+    best = topo.best_slice("2x1x1", free, 16, capacity=cap)
+    assert best.chips == (0, 1)
+
+
+def test_best_slice_respects_exclusions_and_capacity():
+    topo = ChipTopology((2, 2, 1))
+    cap = {i: 32 for i in range(4)}
+    free = {0: 32, 1: 32, 2: 32, 3: 32}
+    best = topo.best_slice("2x1x1", free, 8, capacity=cap, excluded=[0])
+    assert 0 not in best.chips
+    assert topo.best_slice("2x2x1", {i: 4 for i in range(4)}, 8, capacity=cap) is None
+
+
+def test_best_slice_all_excluded_returns_none():
+    topo = ChipTopology((2, 1, 1))
+    assert (
+        topo.best_slice("2x1x1", {0: 8, 1: 8}, 4, excluded=[0, 1]) is None
+    )
+
+
+def test_from_node_reads_the_label_rule():
+    """The one shared label rule the extender, daemon, and CLI all use."""
+    from gpushare_device_plugin_tpu import const
+
+    node = {"metadata": {"labels": {const.LABEL_NODE_TOPOLOGY: "4x2x1"}}}
+    assert ChipTopology.from_node(node, 8).dims == (4, 2, 1)
+    assert ChipTopology.from_node(node, 4).dims == (2, 2, 1)  # inconsistent
+    assert ChipTopology.from_node({}, 8).dims == (2, 2, 2)  # no label
