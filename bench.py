@@ -440,7 +440,18 @@ def _concurrent_rounds(
             lambda: all(informer.get_pod("default", n) is None for n in names)
         ), "informer never drained the round's deleted pods"
 
+    _assert_lock_order_clean("concurrent admission storm")
     return timed_pods, timed_wall, latencies
+
+
+def _assert_lock_order_clean(context: str) -> None:
+    """Hard gate: when the runtime lock-order witness is enabled
+    (TPUSHARE_LOCK_WITNESS=1 / TPUSHARE_TEST_CHAOS=1), any inversion the
+    storm drove against the declared ranking fails the bench — the
+    deterministic complement to the double-assignment audits."""
+    from gpushare_device_plugin_tpu.utils import lockrank
+
+    lockrank.assert_clean(context)
 
 
 def run_gang_storm(
@@ -615,6 +626,7 @@ def run_gang_storm(
         informer.stop()
         api.stop()
 
+    _assert_lock_order_clean("gang-admission storm")
     return {
         "workers": workers,
         "shape": shape,

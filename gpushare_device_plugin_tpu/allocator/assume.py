@@ -39,11 +39,11 @@ a failed admission never leaks phantom usage.
 from __future__ import annotations
 
 import contextlib
-import threading
 import time
-from typing import Sequence
+from typing import Callable, Iterator, Sequence
 
 from ..utils.metrics import REGISTRY, timed_acquire
+from ..utils.lockrank import make_rlock
 
 PodKey = tuple[str, str]  # (namespace, name)
 
@@ -83,8 +83,12 @@ class AssumeCache:
     re-stamped on re-reservation, so a live retry loop never expires.
     """
 
-    def __init__(self, ttl_s: float = DEFAULT_TTL_S, clock=time.monotonic):
-        self._lock = threading.RLock()
+    def __init__(
+        self,
+        ttl_s: float = DEFAULT_TTL_S,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self._lock = make_rlock("allocator.ledger")
         self._ttl = ttl_s
         self._clock = clock
         self._claimed: dict[PodKey, float] = {}  # key -> stamp
@@ -103,7 +107,7 @@ class AssumeCache:
         # matcher's LIST snapshot. Those sources keep the reference's
         # one-admission-at-a-time semantics; the informer (the default)
         # takes the sharded path. Shared mem/core like everything here.
-        self.serial_lock = threading.RLock()
+        self.serial_lock = make_rlock("allocator.serial")
 
     # --- claims -----------------------------------------------------------
 
@@ -196,7 +200,7 @@ class AssumeCache:
     # --- reservations (call within transaction()) -------------------------
 
     @contextlib.contextmanager
-    def transaction(self):
+    def transaction(self) -> Iterator["AssumeCache"]:
         """Scope one atomic snapshot-overlay-decide-reserve step. In-memory
         work only; the wait is recorded in the lock-wait histogram."""
         with timed_acquire(
@@ -229,7 +233,9 @@ class AssumeCache:
             self._stamps[key] = self._clock()
 
     def overlaid_state(
-        self, state_fn, visible_fn=None
+        self,
+        state_fn: Callable[[], tuple[dict[int, int], set[int]]],
+        visible_fn: Callable[[PodKey], bool] | None = None,
     ) -> tuple[dict[int, int], set[int]]:
         """One usage snapshot with in-flight reservations folded in:
         ``state_fn() -> (mem_used, core_held)`` caller-owned copies.

@@ -67,14 +67,15 @@ from __future__ import annotations
 
 import json
 import os
-import threading
 import time
+from typing import Any
 
 from ..utils.batch import GroupBatcher
 from ..utils.faults import FAULTS
 from ..utils.log import get_logger
 from ..utils.metrics import REGISTRY
 from .assume import AssumeCache, PodKey
+from ..utils.lockrank import make_lock, make_rlock
 
 log = get_logger("allocator.checkpoint")
 
@@ -126,18 +127,18 @@ class AllocationCheckpoint:
         path: str,
         fsync: str = "batch",
         batch_window_s: float = DEFAULT_BATCH_WINDOW_S,
-    ):
+    ) -> None:
         if fsync not in WAL_FSYNC_MODES:
             raise ValueError(f"unknown wal fsync mode: {fsync!r}")
         self._path = path
         self._fsync_mode = fsync
-        self._lock = threading.RLock()
+        self._lock = make_rlock("checkpoint.journal")
         # File-handle discipline: the group-commit writer thread appends
         # while callers mutate in-memory state under self._lock, and
         # compaction swaps the file out from under both — every open/
         # write/fsync/swap happens under this dedicated I/O lock (never
         # held while waiting for self._lock, so no ordering cycle).
-        self._io_lock = threading.Lock()
+        self._io_lock = make_lock("checkpoint.io")
         self._writer: GroupBatcher | None = None
         if fsync == "batch":
             self._writer = GroupBatcher(
@@ -366,16 +367,18 @@ class AllocationCheckpoint:
 
     # --- journal ops ------------------------------------------------------
 
-    def begin(self, key: PodKey, data: dict) -> None:
+    def begin(self, key: PodKey, data: dict) -> int | None:
         """Journal an in-flight decision; MUST precede the PATCH. Raises
         ``StaleDaemonError`` when fenced; I/O failures degrade to
-        unjournaled operation (logged + counted) — a full disk must not
-        take pod admission down with it.
+        unjournaled operation (logged + counted, ``None`` returned) — a
+        full disk must not take pod admission down with it.
 
         Each begin gets a monotonic ``_seq`` stamp (persisted with the
-        record): ``commit``/``abort`` with ``seq`` only resolve the exact
-        incarnation of the entry the caller saw, so the reconciler racing
-        a fresh same-key admission cannot pop the new entry."""
+        record, returned to the caller): ``commit``/``abort`` with
+        ``seq`` only resolve the exact incarnation of the entry the
+        caller saw, so a resolver racing a fresh same-key begin — the
+        reconciler, or the extender's deferred expired-overlay aborts —
+        cannot pop the new entry."""
         ticket = None
         with self._lock:
             if self._fenced:
@@ -383,8 +386,9 @@ class AllocationCheckpoint:
                     f"generation {self._generation} superseded on the node"
                 )
             self._seq += 1
+            seq = self._seq
             data = dict(data)
-            data["_seq"] = self._seq
+            data["_seq"] = seq
             payload = self._encode({"op": "begin", "key": list(key), "data": data})
             if self._writer is None:
                 try:
@@ -394,7 +398,7 @@ class AllocationCheckpoint:
                     REGISTRY.counter_inc(
                         JOURNAL_ERRORS, JOURNAL_ERRORS_HELP, op="begin"
                     )
-                    return
+                    return None
                 self._entries[key] = data
             else:
                 try:
@@ -404,7 +408,7 @@ class AllocationCheckpoint:
                     REGISTRY.counter_inc(
                         JOURNAL_ERRORS, JOURNAL_ERRORS_HELP, op="begin"
                     )
-                    return
+                    return None
                 self._entries[key] = data
         if ticket is not None:
             # crash site: the record is queued but NOT yet durable — a
@@ -422,9 +426,10 @@ class AllocationCheckpoint:
                 with self._lock:
                     if self._entries.get(key) is data:
                         self._entries.pop(key, None)
-                return
+                return None
         REGISTRY.counter_inc(JOURNAL_APPENDS, JOURNAL_APPENDS_HELP, op="begin")
         FAULTS.fire("checkpoint.begin")
+        return seq
 
     def commit(self, key: PodKey, seq: int | None = None) -> bool:
         resolved = self._resolve("commit", key, seq)
@@ -581,7 +586,7 @@ class AllocationCheckpoint:
 
     # --- fencing ----------------------------------------------------------
 
-    def acquire_fence(self, api, node_name: str) -> int:
+    def acquire_fence(self, api: Any, node_name: str) -> int:
         """Claim allocation ownership of the node: ensure our generation
         exceeds whatever the node annotation records, then stamp
         ``<generation>:<incarnation token>``. Called at every (re)build;
@@ -614,7 +619,7 @@ class AllocationCheckpoint:
         )
         return gen
 
-    def verify_fence(self, api, node_name: str) -> bool:
+    def verify_fence(self, api: Any, node_name: str) -> bool:
         """True while this daemon still owns the node. Fences on a newer
         generation OR an equal generation under a foreign token (two
         instances raced the non-CAS acquire to the same number; the last

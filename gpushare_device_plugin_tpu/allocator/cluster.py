@@ -44,9 +44,8 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
-import threading
 import time
-from typing import Sequence
+from typing import Any, Callable, Sequence
 
 from .. import const
 from ..cluster import pods as P
@@ -60,8 +59,9 @@ from ..utils.faults import FAULTS
 from ..utils.log import get_logger
 from ..utils.metrics import timed_acquire
 from .assume import LOCK_WAIT_HELP, LOCK_WAIT_METRIC, AssumeCache, PodKey
-from .checkpoint import StaleDaemonError
+from .checkpoint import AllocationCheckpoint, StaleDaemonError
 from .binpack import assign_chip
+from ..utils.lockrank import make_lock
 from .env import (
     ContainerAllocation,
     build_core_allocation,
@@ -176,10 +176,10 @@ class _PodGone(RuntimeError):
 def persist_pod_assignment(
     api: ApiServerClient,
     pod_source: PodSource,
-    pod,
+    pod: dict,
     annotations: dict[str, str],
     label_value: str,
-    patch_fn=None,
+    patch_fn: Callable[[str, str, dict], dict] | None = None,
 ) -> None:
     """Label + annotation strategic-merge patch with one conflict retry
     (``allocate.go:126,136-150``); feeds the result back into the pod
@@ -223,12 +223,12 @@ class ClusterAllocator:
         node_name: str,
         policy: str = "first-fit",
         disable_isolation: bool = False,
-        unhealthy_chips_fn=None,
+        unhealthy_chips_fn: Callable[[], list[int]] | None = None,
         assume: AssumeCache | None = None,
-        checkpoint=None,
-        patcher=None,
+        checkpoint: AllocationCheckpoint | None = None,
+        patcher: Callable[[str, str, dict], dict] | None = None,
         chip_topology: ChipTopology | None = None,
-    ):
+    ) -> None:
         self._inv = inventory
         self._api = api
         self._pods = pod_source
@@ -255,7 +255,7 @@ class ClusterAllocator:
         # ledgers would let concurrent mem/core Allocates each read a
         # snapshot before the other persists — double-booking the chip.
         self._assume = assume if assume is not None else AssumeCache()
-        self._match_locks = [threading.Lock() for _ in range(NUM_MATCH_STRIPES)]
+        self._match_locks = [make_lock("allocator.match") for _ in range(NUM_MATCH_STRIPES)]
 
     # ------------------------------------------------------------------
 
@@ -677,12 +677,12 @@ class ClusterCoreAllocator:
         api: ApiServerClient,
         pod_source: PodSource,
         node_name: str,
-        topology=None,
-        unhealthy_chips_fn=None,
+        topology: Any = None,
+        unhealthy_chips_fn: Callable[[], list[int]] | None = None,
         assume: AssumeCache | None = None,
-        checkpoint=None,
-        patcher=None,
-    ):
+        checkpoint: AllocationCheckpoint | None = None,
+        patcher: Callable[[str, str, dict], dict] | None = None,
+    ) -> None:
         self._inv = inventory
         self._api = api
         self._pods = pod_source
@@ -695,7 +695,7 @@ class ClusterCoreAllocator:
         self._ckpt = checkpoint
         # shared with the mem allocator — see ClusterAllocator.__init__
         self._assume = assume if assume is not None else AssumeCache()
-        self._match_locks = [threading.Lock() for _ in range(NUM_MATCH_STRIPES)]
+        self._match_locks = [make_lock("allocator.match") for _ in range(NUM_MATCH_STRIPES)]
 
     def allocate(self, granted: Sequence[Sequence[str]]) -> list[ContainerAllocation]:
         total = sum(len(ids) for ids in granted)
@@ -863,7 +863,9 @@ class ClusterCoreAllocator:
             self._assume.reserve_core(_pod_key(pod), indices)
 
 
-def cluster_chip_state(pod_source: PodSource, assume: AssumeCache | None = None):
+def cluster_chip_state(
+    pod_source: PodSource, assume: AssumeCache | None = None
+) -> Callable[[], tuple[dict[int, int], set[int]]]:
     """() -> (mem_used_by_chip, core_held_chips) from one source read,
     with in-flight reservations folded in when the allocators' shared
     ledger is supplied (GetPreferredAllocation should steer kubelet away
@@ -880,7 +882,10 @@ def cluster_chip_state(pod_source: PodSource, assume: AssumeCache | None = None)
     return state
 
 
-def preferred_core_chips(inventory: DeviceInventory, state_fn):
+def preferred_core_chips(
+    inventory: DeviceInventory,
+    state_fn: Callable[[], tuple[dict[int, int], set[int]]],
+) -> Callable[[list[str], int], list[str]]:
     """GetPreferredAllocation hook for the core plugin: steer kubelet toward
     chips with no fractional-HBM usage and no existing exclusive hold, so
     core grants rarely conflict with the mem binpack.

@@ -10,12 +10,12 @@ Frees are driven by ``release(pod_key)`` (bench/tests call it on pod end).
 
 from __future__ import annotations
 
-import threading
 from typing import Sequence
 
 from ..device.fanout import DeviceInventory
 from .binpack import assign_chip
 from .env import ContainerAllocation, build_mem_allocation
+from ..utils.lockrank import make_lock
 
 
 class LocalAllocator:
@@ -24,11 +24,11 @@ class LocalAllocator:
         inventory: DeviceInventory,
         policy: str = "first-fit",
         disable_isolation: bool = False,
-    ):
+    ) -> None:
         self._inv = inventory
         self._policy = policy
         self._disable_isolation = disable_isolation
-        self._lock = threading.Lock()
+        self._lock = make_lock("allocator.local")
         self._used: dict[int, int] = {}  # chip index -> units
         self._by_pod: dict[str, tuple[int, int]] = {}  # pod key -> (chip, units)
         self._unhealthy: set[int] = set()

@@ -23,13 +23,14 @@ import os
 import ssl
 import threading
 import urllib.parse
-from typing import Any, Mapping
+from typing import Any, Callable, Iterator, Mapping
 
 import requests
 
 from ..utils.circuit import CircuitBreaker
 from ..utils.faults import FAULTS
 from ..utils.log import get_logger
+from ..utils.lockrank import make_lock
 
 log = get_logger("cluster.apiserver")
 
@@ -39,7 +40,7 @@ MERGE_PATCH = "application/merge-patch+json"
 
 
 class ApiError(RuntimeError):
-    def __init__(self, status: int, body: str):
+    def __init__(self, status: int, body: str) -> None:
         super().__init__(f"apiserver HTTP {status}: {body[:300]}")
         self.status = status
         self.body = body
@@ -55,7 +56,7 @@ class ApiServerClient:
         insecure: bool = False,
         timeout_s: float = 10.0,
         breaker: CircuitBreaker | None = None,
-    ):
+    ) -> None:
         self.base_url = base_url.rstrip("/")
         self._timeout = timeout_s
         # One breaker across every verb AND the watch: they share the
@@ -99,7 +100,7 @@ class ApiServerClient:
         # Lazily-built node-PATCH coalescer (patch_node_merged): one
         # dispatcher thread per client, created only if the merged verb is
         # actually used.
-        self._coalescer_init_lock = threading.Lock()
+        self._coalescer_init_lock = make_lock("apiserver.coalescer")
         self._node_coalescer: "NodePatchCoalescer | None" = None
 
     def _connection(self) -> http.client.HTTPConnection:
@@ -196,7 +197,7 @@ class ApiServerClient:
                 self._local.conn = None
                 try:
                     conn.close()
-                except Exception:  # noqa: BLE001
+                except OSError:  # already dead; we're replacing it anyway
                     pass
                 retriable = idempotent or not sent or isinstance(
                     e, http.client.RemoteDisconnected
@@ -209,7 +210,7 @@ class ApiServerClient:
                     try:
                         if conn.sock is not None:
                             conn.sock.settimeout(self._timeout)
-                    except Exception:  # noqa: BLE001 — socket already dead
+                    except OSError:  # socket already dead
                         pass
 
     # --- construction ------------------------------------------------------
@@ -372,8 +373,8 @@ class ApiServerClient:
         resource_version: str = "0",
         field_selector: str = "",
         label_selector: str = "",
-        on_response=None,
-    ):
+        on_response: Callable[[Any], None] | None = None,
+    ) -> Iterator[tuple[str, dict]]:
         """Streamed watch: yields (event_type, pod) one at a time until the
         server closes the connection. Compatibility wrapper over
         ``watch_pods_batched`` — consumers that can apply events in bulk
@@ -391,8 +392,8 @@ class ApiServerClient:
         resource_version: str = "0",
         field_selector: str = "",
         label_selector: str = "",
-        on_response=None,
-    ):
+        on_response: Callable[[Any], None] | None = None,
+    ) -> Iterator[list[tuple[str, dict]]]:
         """Streamed watch yielding LISTS of (event_type, pod): every event
         decoded from one transport read is one batch. An idle watch yields
         singletons; a PATCH burst arrives as several lines in one read (the
@@ -573,7 +574,7 @@ class NodePatchCoalescer:
     strategic-merge PATCH. Callers keep synchronous semantics (block until
     the merged PATCH lands, receive the response, see the exception)."""
 
-    def __init__(self, client: "ApiServerClient", window_s: float = 0.002):
+    def __init__(self, client: "ApiServerClient", window_s: float = 0.002) -> None:
         from ..utils.batch import GroupBatcher
 
         self._c = client
@@ -657,7 +658,7 @@ class PodPatchPipeline:
         client: "ApiServerClient",
         window_s: float = 0.002,
         fanout: int = 4,
-    ):
+    ) -> None:
         from ..utils.batch import GroupBatcher
         from ..utils.metrics import REGISTRY
 
@@ -686,7 +687,7 @@ class PodPatchPipeline:
             if pipe is not None:
                 try:
                     pipe[0].close()
-                except Exception:  # noqa: BLE001
+                except OSError:  # teardown race: already closed
                     pass
                 self._pipes[i] = None
 
@@ -763,11 +764,11 @@ class PodPatchPipeline:
         if pipe is not None:
             try:
                 pipe[1].close()
-            except Exception:  # noqa: BLE001
+            except OSError:  # teardown race: already closed
                 pass
             try:
                 pipe[0].close()
-            except Exception:  # noqa: BLE001
+            except OSError:  # teardown race: already closed
                 pass
 
     def _send_shard(

@@ -13,6 +13,7 @@ from __future__ import annotations
 import queue
 import threading
 import time
+from typing import Any
 
 from ..utils.log import get_logger
 
@@ -70,7 +71,7 @@ def _post_event(
 
 
 def emit_node_event(
-    api,
+    api: Any,
     node_name: str,
     reason: str,
     message: str,
@@ -89,7 +90,7 @@ def emit_node_event(
 
 
 def emit_pod_event(
-    api,
+    api: Any,
     pod: dict,
     reason: str,
     message: str,
@@ -125,7 +126,7 @@ class NodeEventEmitter:
     ListAndWatch/allocator, not in Events.
     """
 
-    def __init__(self, api, node_name: str, maxsize: int = 64):
+    def __init__(self, api: Any, node_name: str, maxsize: int = 64) -> None:
         self._api = api
         self._node = node_name
         self._q: "queue.Queue[tuple[str, str, str] | None]" = queue.Queue(maxsize)

@@ -22,10 +22,10 @@ filter at every point (tested property-style in
 
 from __future__ import annotations
 
-import threading
 
 from .. import const
 from . import pods as P
+from ..utils.lockrank import make_lock
 
 _Key = tuple[str, str]
 
@@ -42,7 +42,7 @@ class _BucketedPodIndex:
     """
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = make_lock("cluster.podindex")
         self._all: dict[_Key, dict] = {}
         self._buckets: dict[str, dict[_Key, dict]] = {}
 

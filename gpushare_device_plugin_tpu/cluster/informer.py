@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import threading
 import time
+from typing import Any, Iterable
 
 import requests
 
@@ -34,6 +35,7 @@ from ..utils.metrics import REGISTRY
 from ..utils.retry import Backoff
 from . import pods as P
 from .apiserver import ApiError, ApiServerClient
+from ..utils.lockrank import make_lock
 
 log = get_logger("cluster.informer")
 
@@ -121,7 +123,7 @@ class PodInformer:
     protocol (``pending_pods``/``running_share_pods``) plus the informer
     extras (``refresh``/``note_pod_update``)."""
 
-    def __init__(self, client: ApiServerClient, node_name: str = ""):
+    def __init__(self, client: ApiServerClient, node_name: str = "") -> None:
         """``node_name`` scopes the cache to one node's pods (the daemon's
         use); empty means cluster-wide (the scheduler extender's use —
         placement accounting needs every node's pods, including assumed
@@ -138,7 +140,7 @@ class PodInformer:
         # (PATCH 404); the stamp drives the age/size sweep
         self._tombstones: dict[tuple[str, str], tuple[int, float]] = {}
         self._last_tomb_sweep = time.monotonic()
-        self._lock = threading.Lock()
+        self._lock = make_lock("informer.cache")
         self._synced = threading.Event()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -193,11 +195,11 @@ class PodInformer:
                     sock = resp.raw.connection.sock
                     if sock is not None:
                         sock.shutdown(_socket.SHUT_RDWR)
-                except Exception:  # noqa: BLE001 — already closed/racing
+                except (OSError, AttributeError):  # already closed/racing
                     pass
                 try:
                     resp.close()
-                except Exception:  # noqa: BLE001
+                except OSError:  # already closed
                     pass
                 break
             if _time.monotonic() > deadline:
@@ -234,7 +236,7 @@ class PodInformer:
 
     # --- incremental indexes ----------------------------------------------
 
-    def add_index(self, index) -> "PodInformer":
+    def add_index(self, index: Any) -> "PodInformer":
         """Register an aggregate maintained on every cache mutation.
 
         ``index`` implements ``rebuild(pods)`` (called now, to fold in the
@@ -396,7 +398,9 @@ class PodInformer:
     def _apply(self, etype: str, pod: dict) -> None:
         self.apply_batch([(etype, pod)])
 
-    def apply_batch(self, events) -> tuple[str | None, dict | None]:
+    def apply_batch(
+        self, events: Iterable[tuple[str, dict]]
+    ) -> tuple[str | None, dict | None]:
         """Apply a burst of watch events under ONE cache/index-lock
         acquisition — the watch thread hands every transport read here, so
         an N-event PATCH burst costs one lock round-trip, with the indexes

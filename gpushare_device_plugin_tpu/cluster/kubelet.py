@@ -30,7 +30,7 @@ class KubeletClient:
         client_cert: tuple[str, str] | None = None,
         timeout_s: float = 10.0,
         scheme: str = "https",
-    ):
+    ) -> None:
         self.base_url = f"{scheme}://{host}:{port}"
         self._timeout = timeout_s
         self._session = requests.Session()

@@ -97,7 +97,7 @@ def _chip_state_from(labeled_pods: list[dict]) -> tuple[dict[int, int], set[int]
 
 
 class ApiServerPodSource:
-    def __init__(self, client: ApiServerClient, node_name: str):
+    def __init__(self, client: ApiServerClient, node_name: str) -> None:
         self._c = client
         self._node = node_name
 
@@ -157,7 +157,7 @@ class KubeletPodSource:
         kubelet: KubeletClient,
         fallback: ApiServerPodSource,
         node_name: str,
-    ):
+    ) -> None:
         self._kubelet = kubelet
         self._fallback = fallback
         self._node = node_name

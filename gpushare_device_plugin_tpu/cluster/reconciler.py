@@ -41,9 +41,11 @@ from __future__ import annotations
 
 import threading
 import time
+from typing import Any, Callable
 
 from .. import const
 from ..allocator.assume import AssumeCache, PodKey
+from ..allocator.checkpoint import AllocationCheckpoint
 from ..utils.log import get_logger
 from ..utils.metrics import REGISTRY
 from . import pods as P
@@ -68,16 +70,16 @@ DEFAULT_INTERVAL_S = 30.0
 class DriftReconciler:
     def __init__(
         self,
-        api,
-        pod_source,
+        api: Any,
+        pod_source: Any,
         assume: AssumeCache,
-        checkpoint=None,
+        checkpoint: AllocationCheckpoint | None = None,
         node_name: str = "",
-        inventory=None,
-        kubelet_grants_fn=None,
+        inventory: Any = None,
+        kubelet_grants_fn: Callable[[], dict[PodKey, list[str]]] | None = None,
         interval_s: float = DEFAULT_INTERVAL_S,
-        on_fenced=None,
-    ):
+        on_fenced: Callable[[], None] | None = None,
+    ) -> None:
         """``kubelet_grants_fn() -> dict[PodKey, list[str]]`` supplies
         kubelet's granted device IDs per pod when a feed exists (the fake
         kubelet in tests; the podresources socket in production); None
@@ -197,8 +199,11 @@ class DriftReconciler:
                 if self._on_fenced is not None:
                     try:
                         self._on_fenced()
-                    except Exception:  # noqa: BLE001
-                        pass
+                    except Exception as e:  # noqa: BLE001 — notify hook
+                        # a dead hook must not stop fencing, but eating it
+                        # silently hid real wiring bugs (found by tpulint's
+                        # hygiene rule; docs/analysis.md defects table)
+                        log.warning("fenced-notification hook failed: %s", e)
         return ok
 
     def _fetch_pod(self, key: PodKey) -> tuple[dict | None, bool]:

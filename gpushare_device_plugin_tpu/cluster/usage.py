@@ -21,10 +21,10 @@ surviving hold when one of them dies.
 
 from __future__ import annotations
 
-import threading
 
 from .. import const
 from . import pods as P
+from ..utils.lockrank import make_lock
 
 
 def _mem_contributions(pod: dict) -> list[tuple[int, int]]:
@@ -68,8 +68,8 @@ def pod_counts_toward_usage(pod: dict) -> bool:
 class NodeChipUsage:
     """Per-chip usage aggregates for one node's pods (the daemon's view)."""
 
-    def __init__(self):
-        self._lock = threading.Lock()
+    def __init__(self) -> None:
+        self._lock = make_lock("cluster.usage")
         self._mem_used: dict[int, int] = {}
         self._core_refs: dict[int, int] = {}
 

@@ -20,11 +20,11 @@ a full recomputation over the cache.
 
 from __future__ import annotations
 
-import threading
 
 from .. import const
 from ..cluster import pods as P
 from .logic import RESOURCE_FAMILIES
+from ..utils.lockrank import make_lock
 
 
 def _contributions(pod: dict) -> tuple[list[tuple[str, int, int]], list[int]]:
@@ -62,8 +62,8 @@ def _contributions(pod: dict) -> tuple[list[tuple[str, int, int]], list[int]]:
 class ClusterUsageIndex:
     """Implements the PodInformer index protocol (rebuild/on_change)."""
 
-    def __init__(self):
-        self._lock = threading.Lock()
+    def __init__(self) -> None:
+        self._lock = make_lock("extender.usageindex")
         # node -> {"frac": {resource: {chip: units}}, "core": {chip: refs}}
         self._nodes: dict[str, dict] = {}
         # change detection for the extender's NodeView cache: a per-node

@@ -18,6 +18,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import time
+from typing import Callable
 
 from .. import const
 from ..allocator.binpack import AssignmentError, assign_chip
@@ -235,7 +236,9 @@ def evaluate_filter(
     return fits, failed
 
 
-def views_from_pods(pods: list[dict]):
+def views_from_pods(
+    pods: list[dict],
+) -> Callable[[str, list[dict]], list["NodeView"]]:
     """views_fn over a full pod list (the LIST-backed path); the extender
     server passes its index-backed equivalent instead."""
 
@@ -247,7 +250,9 @@ def views_from_pods(pods: list[dict]):
 
 
 def filter_with_views(
-    pod: dict, nodes: list[dict], views_fn
+    pod: dict,
+    nodes: list[dict],
+    views_fn: Callable[[str, list[dict]], list["NodeView"]],
 ) -> tuple[list[str], dict[str, str]]:
     """-> (fitting node names, failed node -> reason).
 
@@ -358,7 +363,10 @@ def evaluate_scores(
 
 
 def prioritize_with_views(
-    pod: dict, nodes: list[dict], views_fn, policy: str = "best-fit"
+    pod: dict,
+    nodes: list[dict],
+    views_fn: Callable[[str, list[dict]], list["NodeView"]],
+    policy: str = "best-fit",
 ) -> dict[str, int]:
     resource = pod_resource(pod)
     if resource is None:

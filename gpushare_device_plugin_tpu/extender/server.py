@@ -23,6 +23,7 @@ import json
 import sys
 import threading
 import time
+from typing import Any
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..allocator.binpack import AssignmentError
@@ -32,6 +33,7 @@ from ..utils.log import get_logger
 from ..utils import log as logutil
 from . import logic
 from .index import ClusterUsageIndex
+from ..utils.lockrank import make_rlock
 
 log = get_logger("extender")
 
@@ -52,6 +54,12 @@ class _Inflight:
     annotations: dict[str, str]
     stamp: float
     chips: tuple[int, ...] = ()
+    # The journal sequence of this decision's begin record (None when the
+    # begin was degraded/unjournaled). The deferred expired-entry abort
+    # resolves ONLY this incarnation: a fresh same-key begin (pod deleted
+    # and recreated under the same name mid-verb) must not be popped by a
+    # stale entry's cleanup.
+    seq: int | None = None
 
 
 class ExtenderCore:
@@ -59,9 +67,9 @@ class ExtenderCore:
         self,
         api: ApiServerClient,
         policy: str = "best-fit",
-        informer=None,
-        checkpoint=None,
-    ):
+        informer: Any = None,
+        checkpoint: Any = None,
+    ) -> None:
         """``informer``: an optional cluster-wide ``PodInformer`` (no node
         field-selector). With it, filter/prioritize/bind read incremental
         per-node aggregates (``ClusterUsageIndex``) off the watch cache —
@@ -87,9 +95,19 @@ class ExtenderCore:
             informer.add_index(self._index)
         # RLock: bind() holds it across its whole decision and calls
         # _node_views(), which also touches the in-flight cache
-        self._lock = threading.RLock()
+        self._lock = make_rlock("extender.core")
         self._inflight: dict[tuple[str, str], _Inflight] = {}
         self._inflight_ttl_s = 60.0
+        # Overlay entries that aged out but whose journal abort has not
+        # run yet: ((ns, name), begin seq) pairs. The abort blocks on the
+        # WAL writer's fsync ticket, and _live_inflight() runs under the
+        # decision lock on the bind path — so expiry only *queues* here
+        # and each webhook verb drains after its locked section (tpulint's
+        # lock-io rule pins this; journaling them inline under the lock
+        # was a real defect this PR's tooling found — docs/analysis.md).
+        self._expired_unjournaled: list[
+            tuple[tuple[str, str], int | None]
+        ] = []
         # Incremental NodeView cache, keyed (node, resource) with a
         # (node resourceVersion, usage-index generation) change token: a
         # filter round over N unchanged nodes re-parses zero capacity
@@ -126,6 +144,7 @@ class ExtenderCore:
                     annotations=dict(data.get("annotations") or {}),
                     stamp=now,
                     chips=tuple(int(i) for i in (data.get("chips") or ())),
+                    seq=data.get("_seq"),
                 )
             except (KeyError, TypeError, ValueError):
                 log.warning("checkpoint warmup: malformed bind entry for %s", key)
@@ -156,20 +175,42 @@ class ExtenderCore:
         now = time.monotonic()
         with self._lock:
             expired = [
-                k for k, v in self._inflight.items()
+                (k, v.seq) for k, v in self._inflight.items()
                 if now - v.stamp >= self._inflight_ttl_s
             ]
-            for k in expired:
+            for k, _seq in expired:
                 self._inflight.pop(k)
             live = dict(self._inflight)
-        # An overlay entry aging out means the watch has caught up (or the
-        # bind never landed) — the journal entry has served its purpose
-        # and must not be replayed at the next restart. Unjournaled keys
-        # (including already-committed ones) are a no-op inside abort().
-        if self._ckpt is not None:
-            for k in expired:
-                self._ckpt.abort(k)
+            # An overlay entry aging out means the watch has caught up (or
+            # the bind never landed) — the journal entry has served its
+            # purpose and must not be replayed at the next restart. The
+            # abort itself blocks on WAL durability, so it only gets
+            # QUEUED here; _drain_expired_aborts runs it outside the lock.
+            if self._ckpt is not None and expired:
+                self._expired_unjournaled.extend(expired)
         return live
+
+    def _drain_expired_aborts(self) -> None:
+        """Journal aborts for aged-out overlay entries, called at the end
+        of every webhook verb with no lock held: abort() waits on the
+        group-commit writer's fsync ticket, and a disk wait under the
+        decision lock would serialize every concurrent bind behind it.
+        Each abort carries the expired entry's begin seq, so a FRESH
+        same-key begin journaled in the deferral window (same pod name
+        recreated and re-bound) is never popped by the stale cleanup.
+        Unjournaled keys (including already-committed ones) are a no-op
+        inside abort()."""
+        if self._ckpt is None:
+            return
+        with self._lock:
+            expired, self._expired_unjournaled = self._expired_unjournaled, []
+        for k, seq in expired:
+            if seq is None:
+                # this incarnation's begin was degraded (never journaled):
+                # there is nothing of ITS to abort, and an unconditional
+                # abort would pop a fresh same-key begin journaled since
+                continue
+            self._ckpt.abort(k, seq=seq)
 
     def _view_for(self, node: dict, resource: str) -> logic.NodeView:
         """One node's placement view off the incremental index, memoized.
@@ -221,61 +262,87 @@ class ExtenderCore:
             ),
         )
 
-    def _node_views(self, resource: str, nodes: list[dict]) -> list[logic.NodeView]:
+    def _node_views(
+        self, resource: str, nodes: list[dict]
+    ) -> list[logic.NodeView]:
         """Build per-node placement views for ``resource``.
 
-        Index path: O(len(nodes)) reads of the incremental aggregates, then
-        overlay in-flight bind decisions whose annotations have not yet
-        arrived on the watch (once the pod's cached copy carries the IDX
-        annotation the index already counts it — skip to avoid double
-        counting). List path: full scan, identical semantics."""
+        Index path: O(len(nodes)) reads of the incremental aggregates.
+        List path: one LIST (or the synced cache) plus a full scan,
+        identical semantics. This convenience fetches; it is for the
+        UNLOCKED verbs (filter/prioritize/batch) — bind prefetches the
+        raw pods before its decision lock and calls the in-memory halves
+        directly, so no network read ever runs under the lock."""
         if self._use_index():
-            views = []
-            by_name: dict[str, logic.NodeView] = {}
-            for node in nodes:
-                view = self._view_for(node, resource)
-                views.append(view)
-                by_name[view.name] = view
-            family = logic.RESOURCE_FAMILIES[resource]
-            for (ns, pname), entry in self._live_inflight().items():
-                if entry.resource != resource:
+            return self._views_from_index(resource, nodes)
+        return self._views_from_pods(
+            resource, nodes, self._fetch_cluster_pods()
+        )
+
+    def _views_from_index(
+        self, resource: str, nodes: list[dict]
+    ) -> list[logic.NodeView]:
+        """Index path: incremental per-node aggregates, then overlay
+        in-flight bind decisions whose annotations have not yet arrived
+        on the watch (once the pod's cached copy carries the IDX
+        annotation the index already counts it — skip to avoid double
+        counting). Pure memory."""
+        views = []
+        by_name: dict[str, logic.NodeView] = {}
+        for node in nodes:
+            view = self._view_for(node, resource)
+            views.append(view)
+            by_name[view.name] = view
+        family = logic.RESOURCE_FAMILIES[resource]
+        for (ns, pname), entry in self._live_inflight().items():
+            if entry.resource != resource:
+                continue
+            view = by_name.get(entry.node)
+            if view is None:
+                continue
+            cached = self._informer.get_pod(ns, pname)
+            # Not cached yet (reservation made before the pod's watch
+            # event, or before its PATCH even landed): the index cannot
+            # be counting it, so the overlay must — skipping here would
+            # let a concurrent bind double-book the chip. Only a pod
+            # provably finished stops counting early (TTL otherwise).
+            if cached is not None:
+                if not P.is_active(cached):
                     continue
-                view = by_name.get(entry.node)
-                if view is None:
-                    continue
-                cached = self._informer.get_pod(ns, pname)
-                # Not cached yet (reservation made before the pod's watch
-                # event, or before its PATCH even landed): the index cannot
-                # be counting it, so the overlay must — skipping here would
-                # let a concurrent bind double-book the chip. Only a pod
-                # provably finished stops counting early (TTL otherwise).
-                if cached is not None:
-                    if not P.is_active(cached):
-                        continue
-                    ann = P.annotations(cached)
-                    marker = (
-                        logic.const.ENV_GANG_CHIPS if entry.chips
-                        else family["idx"]
-                    )
-                    if marker in ann and P.node_name(cached) == entry.node:
-                        continue  # watch caught up; the index counts it on node
-                # Otherwise the index either misses the pod or files it
-                # under the wrong node (annotation MODIFIED can precede the
-                # bind MODIFIED, leaving nodeName empty): count it here.
-                # Gang entries book their PER-CHIP share on every member —
-                # the overlay mirror of the all-or-nothing ledger entry.
-                for member in entry.chips or (entry.idx,):
-                    view.used[member] = view.used.get(member, 0) + entry.units
-            return views
-        pods = self._active_pods()
+                ann = P.annotations(cached)
+                marker = (
+                    logic.const.ENV_GANG_CHIPS if entry.chips
+                    else family["idx"]
+                )
+                if marker in ann and P.node_name(cached) == entry.node:
+                    continue  # watch caught up; the index counts it on node
+            # Otherwise the index either misses the pod or files it
+            # under the wrong node (annotation MODIFIED can precede the
+            # bind MODIFIED, leaving nodeName empty): count it here.
+            # Gang entries book their PER-CHIP share on every member —
+            # the overlay mirror of the all-or-nothing ledger entry.
+            for member in entry.chips or (entry.idx,):
+                view.used[member] = view.used.get(member, 0) + entry.units
+        return views
+
+    def _views_from_pods(
+        self, resource: str, nodes: list[dict], raw_pods: list[dict]
+    ) -> list[logic.NodeView]:
+        """List path from an already-fetched pod set: overlay + group +
+        build, pure memory (safe under the decision lock)."""
+        pods = self._overlay_pods(raw_pods)
         by_node = logic.group_pods_by_node(pods)
         return [logic.build_node_view(n, by_node, resource) for n in nodes]
 
-    def _active_pods(self) -> list[dict]:
+    def _fetch_cluster_pods(self) -> list[dict]:
+        """The list-fallback's raw pod set: the synced cache, else one
+        apiserver LIST. Network I/O — callers must not hold the decision
+        lock (the lock-io rule pins this)."""
         if self._informer is not None and self._informer.synced:
-            pods = self._informer.all_pods()
-        else:
-            pods = self._api.list_pods()
+            return self._informer.all_pods()
+        return self._api.list_pods()
+
+    def _overlay_pods(self, pods: list[dict]) -> list[dict]:
         out = []
         for pod in pods:
             if pod.get("status", {}).get("phase") in ("Succeeded", "Failed"):
@@ -317,7 +384,12 @@ class ExtenderCore:
     def filter(self, args: dict) -> dict:
         pod = args.get("pod") or {}
         nodes = self._nodes_from_args(args)
-        fits, failed = logic.filter_with_views(pod, nodes, self._node_views)
+        try:
+            fits, failed = logic.filter_with_views(
+                pod, nodes, self._node_views
+            )
+        finally:
+            self._drain_expired_aborts()
         log.v(4, "filter %s: fits=%s failed=%s",
               pod.get("metadata", {}).get("name"), fits, list(failed))
         fit_set = set(fits)
@@ -332,9 +404,12 @@ class ExtenderCore:
     def prioritize(self, args: dict) -> list[dict]:
         pod = args.get("pod") or {}
         nodes = self._nodes_from_args(args)
-        scores = logic.prioritize_with_views(
-            pod, nodes, self._node_views, policy=self._policy
-        )
+        try:
+            scores = logic.prioritize_with_views(
+                pod, nodes, self._node_views, policy=self._policy
+            )
+        finally:
+            self._drain_expired_aborts()
         return [{"host": host, "score": score} for host, score in scores.items()]
 
     def batch(self, args: dict) -> dict:
@@ -357,11 +432,14 @@ class ExtenderCore:
                 "error": "",
             }
         request = P.mem_units_of_pod(pod, resource=resource)
-        views = self._node_views(resource, nodes)
-        fits, failed, scores = logic.evaluate_filter_and_scores(
-            request, views, policy=self._policy,
-            gang_shape=logic.pod_gang_shape(pod, resource),
-        )
+        try:
+            views = self._node_views(resource, nodes)
+            fits, failed, scores = logic.evaluate_filter_and_scores(
+                request, views, policy=self._policy,
+                gang_shape=logic.pod_gang_shape(pod, resource),
+            )
+        finally:
+            self._drain_expired_aborts()
         fit_set = set(fits)
         return {
             "nodes": {"items": [n for n in nodes
@@ -379,12 +457,14 @@ class ExtenderCore:
 
         Concurrency design: the lock guards only the in-memory decision —
         build the node view, choose the chip, and *reserve* it by inserting
-        the in-flight entry — never network I/O. The GET pod/node before it
-        and the PATCH + binding POST after it run unlocked, so binds to
-        different nodes proceed in parallel instead of serializing the
-        whole cluster's admission behind one apiserver round-trip (with the
-        index path the locked section is pure memory; the ``--pod-source
-        list`` fallback still LISTs inside ``_node_views``). The
+        the in-flight entry — never network I/O or a durability wait. The
+        GET pod/node (and, in ``--pod-source list`` fallback mode, the
+        cluster LIST) run *before* the lock; the PATCH + binding POST run
+        after it — so binds to different nodes proceed in parallel instead
+        of serializing the whole cluster's admission behind one apiserver
+        round-trip or one WAL fsync (tpulint's lock-io rule enforces this
+        shape; both the fallback LIST and the expired-entry journal abort
+        used to run under the lock — docs/analysis.md, defects table). The
         reservation is visible to every concurrent decision through the
         in-flight overlay (``_node_views``), which is exactly how mid-PATCH
         decisions were already kept from double-booking; a failed PATCH or
@@ -394,14 +474,36 @@ class ExtenderCore:
         name = args.get("podName", "")
         node_name = args.get("node", "")
         try:
+            return self._bind(args, ns, name, node_name)
+        finally:
+            # failure paths included: keys queued by _live_inflight()
+            # during this verb must not wait for some later verb (an
+            # idle-then-restarted extender would replay their journal
+            # entries as stale reservations)
+            self._drain_expired_aborts()
+
+    def _bind(self, args: dict, ns: str, name: str, node_name: str) -> dict:
+        try:
             pod = self._api.get_pod(ns, name)
             node = self._api.get_node(node_name)
             resource = logic.pod_resource(pod)
             if resource is None:
                 raise AssignmentError("pod requests no share resource")
             gang_shape = logic.pod_gang_shape(pod, resource)
+            # list-fallback prefetch: the LIST is network I/O and must not
+            # run under the decision lock. The in-flight overlay is still
+            # applied under the lock, so concurrent binds see each other;
+            # the LIST data itself is no staler than it already was.
+            raw_pods = (
+                None if self._use_index() else self._fetch_cluster_pods()
+            )
             with self._lock:
-                view = self._node_views(resource, [node])[0]
+                if raw_pods is None:
+                    view = self._views_from_index(resource, [node])[0]
+                else:
+                    view = self._views_from_pods(
+                        resource, [node], raw_pods
+                    )[0]
                 if gang_shape:
                     # gang bind: ONE decision covering every member chip,
                     # reserved whole in the in-flight overlay before any
@@ -430,8 +532,9 @@ class ExtenderCore:
             # WAL begin before the PATCH/Binding: a crash inside the next
             # block leaves an unresolved entry the restarted extender's
             # warmup serves from (and a journal-less crash would forget).
+            seq = None
             if self._ckpt is not None:
-                self._ckpt.begin((ns, name), {
+                seq = self._ckpt.begin((ns, name), {
                     "node": node_name,
                     "resource": resource,
                     "idx": idx,
@@ -440,17 +543,27 @@ class ExtenderCore:
                     "annotations": annotations,
                     "ts": time.time(),  # warmup ages stale entries out by this
                 })
+                # stamp the overlay entry with its begin incarnation so a
+                # later TTL expiry aborts exactly this record
+                with self._lock:
+                    entry = self._inflight.get((ns, name))
+                    if entry is not None:
+                        entry.seq = seq
             try:
                 self._api.patch_pod(ns, name, {"metadata": {"annotations": annotations}})
                 self._api.bind_pod(ns, name, node_name)
             except Exception:
                 with self._lock:
                     self._inflight.pop((ns, name), None)
-                if self._ckpt is not None:
-                    self._ckpt.abort((ns, name))
+                # resolve OUR begin incarnation only: a slow failing PATCH
+                # can overlap a fresh same-key begin (pod recreated under
+                # the same name), which an unguarded abort would pop. A
+                # degraded begin (seq None) journaled nothing to resolve.
+                if self._ckpt is not None and seq is not None:
+                    self._ckpt.abort((ns, name), seq=seq)
                 raise
-            if self._ckpt is not None:
-                self._ckpt.commit((ns, name))
+            if self._ckpt is not None and seq is not None:
+                self._ckpt.commit((ns, name), seq=seq)
         except (ApiError, AssignmentError) as e:
             log.warning("bind %s/%s -> %s failed: %s", ns, name, node_name, e)
             from ..cluster.events import REASON_BIND_FAILED, emit_pod_event
@@ -475,7 +588,7 @@ class ExtenderCore:
 
 
 class ExtenderHTTPServer:
-    def __init__(self, core: ExtenderCore, host: str = "0.0.0.0", port: int = 32766):
+    def __init__(self, core: ExtenderCore, host: str = "0.0.0.0", port: int = 32766) -> None:
         self._core = core
         self._host = host
         self._port = port
@@ -497,10 +610,10 @@ class ExtenderHTTPServer:
             # pay a fresh apiserver TCP/TLS handshake on every verb.
             protocol_version = "HTTP/1.1"
 
-            def log_message(self, fmt, *args):
+            def log_message(self, fmt: str, *args: object) -> None:
                 log.v(6, fmt, *args)
 
-            def _send(self, code: int, body) -> None:
+            def _send(self, code: int, body: object) -> None:
                 data = json.dumps(body).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
@@ -508,12 +621,12 @@ class ExtenderHTTPServer:
                 self.end_headers()
                 self.wfile.write(data)
 
-            def do_GET(self):
+            def do_GET(self) -> None:
                 if self.path in ("/version", "/healthz"):
                     return self._send(200, {"version": "v1", "ok": True})
                 return self._send(404, {"error": "not found"})
 
-            def do_POST(self):
+            def do_POST(self) -> None:
                 from ..utils.metrics import REGISTRY
 
                 n = int(self.headers.get("Content-Length", "0"))
@@ -564,7 +677,7 @@ class ExtenderHTTPServer:
             self._server = None
 
 
-def main(argv=None) -> int:
+def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(prog="tpushare-scheduler-extender")
     p.add_argument("--port", type=int, default=32766)
     p.add_argument("--host", default="0.0.0.0")

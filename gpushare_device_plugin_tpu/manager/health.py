@@ -17,6 +17,7 @@ from ..discovery.base import ChipHealth, DiscoveryBackend, HealthEvent
 from ..utils.faults import FAULTS
 from ..utils.log import get_logger
 from ..utils.retry import Backoff
+from ..utils.lockrank import make_lock
 
 log = get_logger("manager.health")
 
@@ -38,7 +39,7 @@ class HealthWatcher:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._unhealthy_ids: set[str] = set()
-        self._lock = threading.Lock()
+        self._lock = make_lock("manager.health")
         self._restarts = 0
 
     @property

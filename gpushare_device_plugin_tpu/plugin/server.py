@@ -18,7 +18,6 @@ from __future__ import annotations
 
 import dataclasses
 import os
-import threading
 import time
 from concurrent import futures
 from typing import Callable, Sequence
@@ -29,6 +28,7 @@ from .. import const
 from ..device.fanout import DeviceInventory, FakeDevice
 from ..discovery.base import ChipHealth
 from ..utils.log import get_logger
+from ..utils.lockrank import make_condition
 from .api import (
     DevicePluginServicer,
     DevicePluginStub,
@@ -89,7 +89,7 @@ class TpuSharePlugin(DevicePluginServicer):
         self._devices_fn = devices_fn or inventory.mem_fake_devices
         self._preferred_fn = preferred_fn
         self._health: dict[str, ChipHealth] = {}
-        self._cond = threading.Condition()
+        self._cond = make_condition("plugin.stream")
         self._version = 0  # bumped on every health change
         self._stopping = False
         self._inflight_allocates = 0  # guarded by _cond; drain() waits on it

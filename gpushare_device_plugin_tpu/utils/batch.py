@@ -34,6 +34,11 @@ from __future__ import annotations
 import threading
 from typing import Any, Callable, Sequence
 
+from .lockrank import make_condition
+from .log import get_logger
+
+log = get_logger("utils.batch")
+
 
 class Ticket:
     """One submitted item's handle: ``wait()`` blocks until the batch that
@@ -42,7 +47,7 @@ class Ticket:
 
     __slots__ = ("_done", "_result", "_error")
 
-    def __init__(self):
+    def __init__(self) -> None:
         self._done = threading.Event()
         self._result: Any = None
         self._error: BaseException | None = None
@@ -81,7 +86,7 @@ class GroupBatcher:
         name: str = "batcher",
         on_batch: Callable[[int], None] | None = None,
         idle_exit_s: float = 30.0,
-    ):
+    ) -> None:
         self._flush_fn = flush_fn
         self._window = max(0.0, window_s)
         self._name = name
@@ -91,7 +96,7 @@ class GroupBatcher:
         # (clients, checkpoints) and owners are created freely in tests —
         # without the idle exit every one would pin a thread forever.
         self._idle_exit_s = idle_exit_s
-        self._cond = threading.Condition()
+        self._cond = make_condition("wal.batcher")
         self._queue: list[tuple[Any, Ticket]] = []
         self._thread: threading.Thread | None = None
         self._stopping = False
@@ -242,8 +247,8 @@ class GroupBatcher:
             if self._on_batch is not None:
                 try:
                     self._on_batch(len(batch))
-                except Exception:  # noqa: BLE001 — metrics must not kill I/O
-                    pass
+                except Exception as e:  # noqa: BLE001 — metrics must not kill I/O
+                    log.warning("%s: on_batch hook failed: %s", self._name, e)
             with self._cond:
                 self._completed += len(batch)
                 self._cond.notify_all()

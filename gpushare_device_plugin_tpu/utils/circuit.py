@@ -23,12 +23,12 @@ visible on the scrape the moment it engages.
 
 from __future__ import annotations
 
-import threading
 import time
-from typing import Callable
+from typing import Any, Callable
 
 from .log import get_logger
 from .metrics import REGISTRY
+from .lockrank import make_lock
 
 log = get_logger("utils.circuit")
 
@@ -45,7 +45,7 @@ class CircuitOpenError(RuntimeError):
     404-driven evict, a 409 conflict retry) — callers see it as what it
     is, a client-side refusal to dial a known-down endpoint."""
 
-    def __init__(self, name: str, retry_after_s: float):
+    def __init__(self, name: str, retry_after_s: float) -> None:
         super().__init__(
             f"circuit '{name}' open: apiserver unreachable, "
             f"failing fast (next probe in {retry_after_s:.1f}s)"
@@ -60,14 +60,14 @@ class CircuitBreaker:
         failure_threshold: int = 5,
         reset_timeout_s: float = 5.0,
         clock: Callable[[], float] = time.monotonic,
-    ):
+    ) -> None:
         if failure_threshold < 1:
             raise ValueError("failure_threshold must be >= 1")
         self.name = name
         self._threshold = failure_threshold
         self._reset_timeout = reset_timeout_s
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = make_lock("circuit.breaker")
         self._state = CLOSED
         self._failures = 0  # consecutive
         self._opened_at = 0.0
@@ -146,7 +146,7 @@ class CircuitBreaker:
                 self._opened_at = self._clock()
                 self._transition(OPEN)
 
-    def call(self, fn: Callable):
+    def call(self, fn: Callable) -> Any:
         """Convenience guard: ``before()`` + outcome accounting around one
         callable (exception = failure, return = success)."""
         self.before()

@@ -66,11 +66,11 @@ from __future__ import annotations
 
 import contextlib
 import os
-import threading
 import time
-from typing import Callable
+from typing import Any, Callable, Iterator
 
 from .log import get_logger
+from .lockrank import make_lock
 
 log = get_logger("utils.faults")
 
@@ -98,7 +98,7 @@ class FaultError(ConnectionError):
     apiserver client's retry/breaker accounting, the informer's relist
     path, the pod-source fallbacks."""
 
-    def __init__(self, point: str):
+    def __init__(self, point: str) -> None:
         super().__init__(f"injected fault at {point}")
         self.point = point
 
@@ -110,7 +110,7 @@ class SimulatedCrash(BaseException):
     run cleanup a SIGKILL never runs — which is precisely what restart
     recovery must be tested *without*. Only the test harness catches it."""
 
-    def __init__(self, point: str):
+    def __init__(self, point: str) -> None:
         super().__init__(f"simulated crash at {point}")
         self.point = point
 
@@ -167,8 +167,8 @@ class FaultRegistry:
     """Process-wide named injection points. Thread-safe; ``fire`` on an
     unarmed point is one dict read."""
 
-    def __init__(self):
-        self._lock = threading.Lock()
+    def __init__(self) -> None:
+        self._lock = make_lock("faults.registry")
         self._faults: dict[str, _Fault] = {}
 
     def inject(
@@ -227,7 +227,9 @@ class FaultRegistry:
         time.sleep(delay)
 
     @contextlib.contextmanager
-    def injected(self, point: str, mode: str = "error", **kwargs):
+    def injected(
+        self, point: str, mode: str = "error", **kwargs: Any
+    ) -> Iterator["FaultRegistry"]:
         """Scoped arming for tests: disarms the point on exit even when the
         body raises."""
         self.inject(point, mode, **kwargs)

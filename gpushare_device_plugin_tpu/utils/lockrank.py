@@ -1,0 +1,522 @@
+"""Declared lock ranking, ranked-lock factory, and the runtime lock-order
+witness for the concurrent control plane.
+
+Why this exists: after the hot path was sharded (PR 2), journaled (PRs
+3-4), and taught gang claims (PR 6), sixteen modules hold locks and
+several hold more than one at a time. The reference repo leans on
+``go test -race``; our Python stand-in was a probabilistic stress loop.
+This module makes the locking discipline *declared* instead of implied:
+
+- **The ranking** (:data:`RANKS`): a total order over every lock in the
+  package. A thread may only acquire a lock whose rank is strictly
+  greater than every lock it already holds (re-entering the same RLock
+  is fine). Any two code paths that respect the ranking can never
+  deadlock, because a wait-for cycle needs at least one edge that goes
+  down-rank. ``docs/analysis.md`` documents the order and the reasoning
+  behind each level.
+- **The factory** (:func:`make_lock` / :func:`make_rlock` /
+  :func:`make_condition`): every lock in the package is created through
+  it, naming its rank. Production gets plain ``threading`` primitives;
+  under the witness (see below) it returns instrumented wrappers. The
+  name doubles as ground truth for the static analyzer
+  (``tools/tpulint``), which maps ``self._lock = make_lock("x")``
+  declarations to ranks and checks every ``with``-nesting and
+  cross-module call chain against the same table.
+- **The witness**: with ``TPUSHARE_LOCK_WITNESS=1`` or
+  ``TPUSHARE_TEST_CHAOS=1`` in the environment (or
+  :func:`set_witness` ``(True)``, which the test suite uses), acquires
+  are checked against the ranking per thread at runtime, and the
+  acquisition stack of every held lock is recorded so a violation
+  report shows *both* sides of the inversion. Violations are recorded
+  (and optionally raised, ``TPUSHARE_LOCK_WITNESS_RAISE=1``); the test
+  harness fails any test that produced one. This turns the stress suite
+  from a dice roll (an inversion only fails if the interleaving
+  actually deadlocks) into a deterministic detector (an inversion fails
+  the moment either side of the bad ordering *runs*, on any schedule).
+
+This module must stay import-light (stdlib only, no package imports):
+everything else in the package imports it to create locks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+import threading
+from typing import Any, Iterator
+
+
+@dataclasses.dataclass(frozen=True)
+class LockRank:
+    """One declared lock level.
+
+    ``rank``: the total order — acquire strictly upward only.
+    ``kind``: "lock" | "rlock" | "condition" (what the factory returns;
+    the static analyzer uses it to allow same-lock re-entry for rlocks).
+    ``io_ok``: whether blocking I/O (network round-trips, fsync waits)
+    is permitted while the lock is held. The static analyzer enforces
+    this; the runtime witness only checks ordering.
+    """
+
+    name: str
+    rank: int
+    kind: str
+    io_ok: bool
+    doc: str
+
+
+def _r(name: str, rank: int, kind: str, io_ok: bool, doc: str) -> tuple[str, LockRank]:
+    return name, LockRank(name, rank, kind, io_ok, doc)
+
+
+# The declared ranking. Lower rank = acquired earlier (outermost).
+# docs/analysis.md carries the prose version; keep the two in sync.
+RANKS: dict[str, LockRank] = dict(
+    (
+        _r(
+            "allocator.serial", 10, "rlock", True,
+            "Legacy full-serialization guard for list-backed pod sources "
+            "(AssumeCache.serial_lock): wraps an entire admission, PATCH "
+            "included, so it outranks everything and is the one lock "
+            "allowed to cover the full I/O flow.",
+        ),
+        _r(
+            "extender.core", 20, "rlock", False,
+            "ExtenderCore's decision lock: guards the in-flight overlay "
+            "and the view cache while a bind decision is made. In-memory "
+            "only by design — a network or fsync wait here serializes "
+            "every bind in the cluster behind one I/O.",
+        ),
+        _r(
+            "allocator.match", 22, "lock", True,
+            "Per-size match stripes (ClusterAllocator/_CoreAllocator): "
+            "serialize same-size matches. May refresh() the pod source "
+            "(one synchronous LIST) on a match miss — the documented "
+            "close-the-bind-window exception, so I/O is allowed.",
+        ),
+        _r(
+            "allocator.ledger", 30, "rlock", False,
+            "AssumeCache's claim/reservation ledger: one atomic "
+            "snapshot-overlay-decide-reserve step. Pure memory; the "
+            "lock-wait histogram exists to catch I/O creeping back in.",
+        ),
+        _r(
+            "checkpoint.journal", 40, "rlock", True,
+            "AllocationCheckpoint's entry/sequence state. In `always` "
+            "mode the record append+fsync runs under it by design "
+            "(durability before the caller proceeds), so I/O is allowed.",
+        ),
+        _r(
+            "informer.cache", 50, "lock", False,
+            "PodInformer's cache/tombstone map and index fan-out. Watch "
+            "apply, merge, and reads are in-memory; the LIST that feeds "
+            "refresh()/relist runs before the lock is taken.",
+        ),
+        _r(
+            "cluster.usage", 60, "lock", False,
+            "NodeChipUsage per-chip aggregates (maintained under "
+            "informer.cache via the index protocol).",
+        ),
+        _r(
+            "cluster.podindex", 61, "lock", False,
+            "Bucketed pod-set indexes (pending-by-resource, "
+            "labeled-by-value); same nesting as cluster.usage.",
+        ),
+        _r(
+            "extender.usageindex", 62, "lock", False,
+            "ClusterUsageIndex per-node aggregates + generations; "
+            "maintained under informer.cache, read under extender.core.",
+        ),
+        _r(
+            "wal.batcher", 70, "condition", False,
+            "GroupBatcher's queue condition: submit() runs under "
+            "checkpoint.journal; the flush itself happens with the "
+            "condition released (the worker drains, then writes).",
+        ),
+        _r(
+            "checkpoint.io", 75, "lock", True,
+            "The journal's file-handle discipline: open/write/fsync/"
+            "rename. Never held while waiting for checkpoint.journal "
+            "(that ordering is the point of the two-lock split).",
+        ),
+        _r(
+            "apiserver.coalescer", 80, "lock", False,
+            "Lazy construction of the node-PATCH coalescer; the merged "
+            "PATCH itself runs outside it.",
+        ),
+        _r(
+            "plugin.stream", 82, "condition", False,
+            "TpuSharePlugin's ListAndWatch/drain condition: health map, "
+            "version counter, in-flight Allocate count. Allocate "
+            "releases it before delegating to the allocator.",
+        ),
+        _r(
+            "manager.health", 84, "lock", False,
+            "HealthWatcher's unhealthy-chip set.",
+        ),
+        _r(
+            "allocator.local", 86, "lock", False,
+            "LocalAllocator's standalone usage table (never nests over "
+            "cluster locks; ranked near the leaves).",
+        ),
+        _r(
+            "circuit.breaker", 88, "lock", False,
+            "CircuitBreaker state counters; the guarded call runs with "
+            "the lock released.",
+        ),
+        _r(
+            "faults.registry", 90, "lock", False,
+            "Fault-injection rule table; fire() sites run everywhere, "
+            "so this must be a near-leaf.",
+        ),
+        _r(
+            "metrics.registry", 95, "lock", False,
+            "MetricsRegistry: the innermost leaf — counters and "
+            "histograms are recorded under every other lock.",
+        ),
+    )
+)
+
+
+def rank_of(name: str) -> LockRank:
+    try:
+        return RANKS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown lock rank {name!r}; declare it in "
+            "gpushare_device_plugin_tpu/utils/lockrank.py RANKS "
+            "(and docs/analysis.md)"
+        ) from None
+
+
+class LockOrderError(RuntimeError):
+    """A thread acquired locks against the declared ranking."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    """One observed ordering violation: ``acquiring`` was requested while
+    ``holding`` (same or higher rank, different lock) was held."""
+
+    thread: str
+    acquiring: str
+    acquiring_rank: int
+    holding: str
+    holding_rank: int
+    acquire_stack: str
+    held_stack: str
+
+    def brief(self) -> str:
+        return (
+            f"[{self.thread}] acquiring {self.acquiring!r} "
+            f"(rank {self.acquiring_rank}) while holding {self.holding!r} "
+            f"(rank {self.holding_rank})"
+        )
+
+    def report(self) -> str:
+        return (
+            f"{self.brief()}\n"
+            f"--- held lock acquired at ---\n{self.held_stack}"
+            f"--- violating acquire at ---\n{self.acquire_stack}"
+        )
+
+
+# Witness state. The guard is a RAW threading.Lock on purpose: the witness
+# must never recurse into itself.
+_state_lock = threading.Lock()
+_violations: list[Violation] = []
+_forced: bool | None = None  # set_witness() override; None = env decides
+
+_TRUTHY = ("1", "true", "yes", "on")
+
+
+def _env_enabled() -> bool:
+    return (
+        os.environ.get("TPUSHARE_LOCK_WITNESS", "").lower() in _TRUTHY
+        or os.environ.get("TPUSHARE_TEST_CHAOS", "").lower() in _TRUTHY
+    )
+
+
+def witness_enabled() -> bool:
+    """Whether locks created *now* are witnessed."""
+    if _forced is not None:
+        return _forced
+    return _env_enabled()
+
+
+def set_witness(enabled: bool | None) -> None:
+    """Force the witness on/off for locks created from now on (None =
+    defer to the environment again). The witness suites use this per
+    test; plain tier-1 runs with the witness OFF (a few perf-ratio tests
+    measure real lock costs) — `make chaos` / `make test-stress` enable
+    it via the environment, and the conftest fixture fails whichever
+    test recorded an inversion."""
+    global _forced
+    _forced = enabled
+
+
+def violations() -> list[Violation]:
+    with _state_lock:
+        return list(_violations)
+
+
+def reset_violations() -> None:
+    with _state_lock:
+        _violations.clear()
+
+
+def assert_clean(context: str = "") -> None:
+    """Hard gate for benches and suites: raise listing every recorded
+    inversion. The stress/chaos/storm drivers call this so an observed
+    inversion fails the run deterministically."""
+    found = violations()
+    if found:
+        where = f" during {context}" if context else ""
+        raise LockOrderError(
+            f"{len(found)} lock-order violation(s) observed{where}:\n"
+            + "\n".join(v.report() for v in found)
+        )
+
+
+def _record(violation: Violation) -> None:
+    with _state_lock:
+        _violations.append(violation)
+    if os.environ.get("TPUSHARE_LOCK_WITNESS_RAISE", "").lower() in _TRUTHY:
+        raise LockOrderError(violation.report())
+
+
+class _HeldStack(threading.local):
+    def __init__(self) -> None:
+        # [(lock id, name, rank, count, acquisition stack)]
+        self.entries: list[list[Any]] = []
+
+
+_held = _HeldStack()
+
+# Cross-thread Lock handoff support (A acquires, B releases — legal for
+# plain Locks): id(lock) -> the acquiring thread's entries list + entry,
+# so B's release can remove A's bookkeeping instead of leaking it into
+# false violations for the rest of A's life. Guarded by _state_lock;
+# non-reentrant locks only (RLock forbids cross-thread release anyway).
+_handoff: dict[int, tuple[list[list[Any]], list[Any]]] = {}
+
+
+def held_locks() -> list[tuple[str, int]]:
+    """(name, count) for every witnessed lock this thread holds —
+    introspection for tests and violation reports."""
+    return [(e[1], e[3]) for e in _held.entries]
+
+
+def _stack() -> str:
+    # Cheap frame walk (no source-line reads — this runs on EVERY witnessed
+    # acquire): file:line per frame, witness frames dropped, outermost first.
+    frames = []
+    f = sys._getframe(2)
+    for _ in range(10):
+        if f is None:
+            break
+        frames.append(f"  {f.f_code.co_filename}:{f.f_lineno} in {f.f_code.co_name}")
+        f = f.f_back
+    return "\n".join(reversed(frames)) + "\n"
+
+
+class _WitnessedLock:
+    """Order-checking wrapper over a threading Lock/RLock.
+
+    Exposes the primitive protocol (``acquire``/``release``/context
+    manager) plus the pieces ``threading.Condition`` probes for
+    (``_is_owned``, ``_release_save``/``_acquire_restore`` when the
+    inner lock provides them), so a Condition built over a witnessed
+    RLock behaves exactly like one over a bare RLock — including
+    ``wait()``'s release/re-acquire, which the witness tracks."""
+
+    __slots__ = ("_name", "_rank", "_inner", "_reentrant")
+
+    def __init__(self, name: str, inner: Any, reentrant: bool) -> None:
+        self._name = name
+        self._rank = RANKS[name].rank
+        self._inner = inner
+        self._reentrant = reentrant
+
+    # --- witness bookkeeping ---------------------------------------------
+
+    def _entry(self) -> list[Any] | None:
+        me = id(self)
+        for e in _held.entries:
+            if e[0] == me:
+                return e
+        return None
+
+    def _check_order(self) -> None:
+        mine = self._entry()
+        if mine is not None:
+            if self._reentrant:
+                return  # RLock re-entry: always legal
+            # Re-acquiring a held non-reentrant lock is a GUARANTEED
+            # self-deadlock — record it and raise instead of hanging the
+            # suite with zero diagnostics (there is no false-positive
+            # risk: proceeding would block this thread forever).
+            violation = Violation(
+                thread=threading.current_thread().name,
+                acquiring=self._name,
+                acquiring_rank=self._rank,
+                holding=self._name,
+                holding_rank=self._rank,
+                acquire_stack=_stack(),
+                held_stack=mine[4],
+            )
+            _record(violation)
+            raise LockOrderError(
+                "self-deadlock: non-reentrant lock re-acquired by its "
+                "holder\n" + violation.report()
+            )
+        # mine is None here: every self-held case returned or raised above
+        for e in _held.entries:
+            if e[2] >= self._rank:
+                _record(
+                    Violation(
+                        thread=threading.current_thread().name,
+                        acquiring=self._name,
+                        acquiring_rank=self._rank,
+                        holding=e[1],
+                        holding_rank=e[2],
+                        acquire_stack=_stack(),
+                        held_stack=e[4],
+                    )
+                )
+
+    def _push(self, n: int = 1) -> None:
+        mine = self._entry()
+        if mine is not None and self._reentrant:
+            mine[3] += n
+            return
+        entry = [id(self), self._name, self._rank, n, _stack()]
+        _held.entries.append(entry)
+        if not self._reentrant:
+            with _state_lock:
+                _handoff[id(self)] = (_held.entries, entry)
+
+    def _pop(self, n: int = 1) -> None:
+        mine = self._entry()
+        if mine is None:
+            # released by a thread that never acquired (Lock handoff):
+            # remove the acquiring thread's entry so its witness stack
+            # does not leak into false violations
+            with _state_lock:
+                owner = _handoff.pop(id(self), None)
+            if owner is not None:
+                entries, entry = owner
+                if entry in entries:
+                    entries.remove(entry)
+            return
+        mine[3] -= n
+        if mine[3] <= 0:
+            _held.entries.remove(mine)
+            if not self._reentrant:
+                with _state_lock:
+                    _handoff.pop(id(self), None)
+
+    # --- lock protocol ----------------------------------------------------
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        self._check_order()
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._push()
+        return got
+
+    def release(self) -> None:
+        self._inner.release()
+        self._pop()
+
+    def __enter__(self) -> "_WitnessedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.release()
+
+    def __getattr__(self, name: str) -> Any:
+        # Delegate anything else (e.g. Lock.locked, absent on RLock before
+        # py3.14) so the wrapper exposes exactly the inner primitive's
+        # surface — no more, no less.
+        if name == "_inner":  # unset slot (mid-copy): no recursion
+            raise AttributeError(name)
+        return getattr(self._inner, name)
+
+    # --- Condition support ------------------------------------------------
+
+    def _is_owned(self) -> bool:
+        inner = self._inner
+        if hasattr(inner, "_is_owned"):
+            return inner._is_owned()
+        if inner.acquire(False):
+            inner.release()
+            return False
+        return True
+
+    def _release_save(self) -> Any:
+        # Condition.wait() on an RLock: release the full recursion depth.
+        state = self._inner._release_save()
+        mine = self._entry()
+        depth = mine[3] if mine is not None else 1
+        self._pop(depth)
+        return (state, depth)
+
+    def _acquire_restore(self, saved: Any) -> None:
+        state, depth = saved
+        self._inner._acquire_restore(state)
+        self._push(depth)
+
+    def __repr__(self) -> str:
+        return f"<WitnessedLock {self._name} over {self._inner!r}>"
+
+
+def make_lock(name: str) -> Any:
+    """A non-reentrant mutex at the declared rank ``name``. The declared
+    kind must match: handing out a plain Lock for a rank the static
+    analyzer treats as reentrant would bless re-entries that self-deadlock
+    in production (witness off)."""
+    rank = rank_of(name)
+    if rank.kind != "lock":
+        raise ValueError(
+            f"{name} is declared {rank.kind}; use make_{rank.kind}"
+        )
+    if witness_enabled():
+        return _WitnessedLock(name, threading.Lock(), reentrant=False)
+    return threading.Lock()
+
+
+def make_rlock(name: str) -> Any:
+    """A reentrant mutex at the declared rank ``name`` (kind-checked, see
+    :func:`make_lock`)."""
+    rank = rank_of(name)
+    if rank.kind != "rlock":
+        raise ValueError(
+            f"{name} is declared {rank.kind}; use make_{rank.kind}"
+        )
+    if witness_enabled():
+        return _WitnessedLock(name, threading.RLock(), reentrant=True)
+    return threading.RLock()
+
+
+def make_condition(name: str) -> threading.Condition:
+    """A condition variable whose underlying mutex carries rank ``name``
+    (``wait()`` releases and re-acquires through the witness)."""
+    rank = rank_of(name)
+    if rank.kind != "condition":
+        raise ValueError(
+            f"{name} is declared {rank.kind}; use make_{rank.kind}"
+        )
+    if witness_enabled():
+        return threading.Condition(
+            _WitnessedLock(name, threading.RLock(), reentrant=True)
+        )
+    return threading.Condition()
+
+
+def ordered(names: list[str]) -> Iterator[LockRank]:
+    """The declared ranks for ``names``, sorted outermost-first (docs and
+    report tooling)."""
+    return iter(sorted((rank_of(n) for n in names), key=lambda r: r.rank))

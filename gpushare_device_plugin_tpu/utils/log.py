@@ -10,11 +10,12 @@ from __future__ import annotations
 
 import logging
 import sys
+from typing import Any
 
 _VERBOSITY = 0
 
 
-def setup(verbosity: int = 0, stream=None) -> None:
+def setup(verbosity: int = 0, stream: Any = None) -> None:
     global _VERBOSITY
     _VERBOSITY = verbosity
     logging.basicConfig(
@@ -33,23 +34,23 @@ def verbosity() -> int:
 class Logger:
     """Thin wrapper adding ``.v(n)`` gated verbose logging."""
 
-    def __init__(self, name: str):
+    def __init__(self, name: str) -> None:
         self._log = logging.getLogger(name)
 
-    def info(self, msg: str, *args) -> None:
+    def info(self, msg: str, *args: object) -> None:
         self._log.info(msg, *args)
 
-    def warning(self, msg: str, *args) -> None:
+    def warning(self, msg: str, *args: object) -> None:
         self._log.warning(msg, *args)
 
-    def error(self, msg: str, *args) -> None:
+    def error(self, msg: str, *args: object) -> None:
         self._log.error(msg, *args)
 
-    def fatal(self, msg: str, *args) -> None:
+    def fatal(self, msg: str, *args: object) -> None:
         self._log.critical(msg, *args)
         raise SystemExit(255)
 
-    def v(self, level: int, msg: str, *args) -> None:
+    def v(self, level: int, msg: str, *args: object) -> None:
         if _VERBOSITY >= level:
             self._log.debug(msg, *args)
 

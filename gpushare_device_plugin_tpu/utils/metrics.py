@@ -17,7 +17,10 @@ from __future__ import annotations
 import contextlib
 import threading
 import time
+from typing import Any, Iterator
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .lockrank import make_lock
 
 # Latency buckets (seconds): 0.5ms .. 10s, roughly log-spaced around the
 # observed allocate p50 of ~1.4ms.
@@ -43,8 +46,8 @@ def _fmt_labels(labels: tuple[tuple[str, str], ...]) -> str:
 
 
 class MetricsRegistry:
-    def __init__(self):
-        self._lock = threading.Lock()
+    def __init__(self) -> None:
+        self._lock = make_lock("metrics.registry")
         self._counters: dict[tuple[str, tuple], float] = {}
         self._gauges: dict[tuple[str, tuple], float] = {}
         # name -> (buckets, {labels -> [counts..., sum, count]})
@@ -174,9 +177,9 @@ REGISTRY = MetricsRegistry()
 
 @contextlib.contextmanager
 def timed_acquire(
-    mutex, name: str, help_text: str = "",
+    mutex: Any, name: str, help_text: str = "",
     registry: MetricsRegistry | None = None, **labels: str,
-):
+) -> Iterator[Any]:
     """``with timed_acquire(mutex, metric):`` — acquire ``mutex``, recording
     the time spent *waiting* for it (not the hold time) in a histogram.
     The allocator's lock-wait visibility: a healthy sharded hot path shows
@@ -200,7 +203,7 @@ class MetricsServer:
     daemon enables it with --metrics-port)."""
 
     def __init__(self, registry: MetricsRegistry = REGISTRY,
-                 host: str = "0.0.0.0", port: int = 0):
+                 host: str = "0.0.0.0", port: int = 0) -> None:
         self._registry = registry
         self._host = host
         self._port = port
@@ -217,10 +220,10 @@ class MetricsServer:
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
 
-            def log_message(self, fmt, *args):  # quiet
+            def log_message(self, fmt: str, *args: object) -> None:  # quiet
                 pass
 
-            def do_GET(self):
+            def do_GET(self) -> None:
                 if self.path == "/metrics":
                     body = registry.render().encode()
                     ctype = "text/plain; version=0.0.4; charset=utf-8"

@@ -31,7 +31,7 @@ T = TypeVar("T")
 
 
 class RetryError(RuntimeError):
-    def __init__(self, attempts: int, last: Exception, deadline: bool = False):
+    def __init__(self, attempts: int, last: Exception, deadline: bool = False) -> None:
         why = "deadline exceeded after" if deadline else "all"
         super().__init__(f"{why} {attempts} attempts failed: {last}")
         self.attempts = attempts
@@ -53,7 +53,7 @@ class Backoff:
         max_s: float = 5.0,
         factor: float = 2.0,
         rng: random.Random | None = None,
-    ):
+    ) -> None:
         self._base = base_s
         self._max = max_s
         self._factor = factor

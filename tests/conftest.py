@@ -19,6 +19,32 @@ import sys
 import pytest
 
 
+@pytest.fixture(autouse=True)
+def _lock_order_witness():
+    """Fail any test during which the runtime lock-order witness observed
+    an inversion against the declared ranking (utils/lockrank.py).
+
+    The witness instruments locks created while it is enabled —
+    ``TPUSHARE_LOCK_WITNESS=1`` (make chaos) or ``TPUSHARE_TEST_CHAOS=1``
+    (make test-stress) — turning the stress/chaos suites into a
+    deterministic deadlock detector: a bad ordering fails the test that
+    *ran* it, on any thread schedule, whether or not it happened to
+    deadlock."""
+    from gpushare_device_plugin_tpu.utils import lockrank
+
+    lockrank.reset_violations()
+    yield
+    found = lockrank.violations()
+    if found:
+        lockrank.reset_violations()
+        pytest.fail(
+            "lock-order witness observed "
+            f"{len(found)} inversion(s):\n"
+            + "\n".join(v.report() for v in found),
+            pytrace=False,
+        )
+
+
 @pytest.fixture(scope="session", autouse=True)
 def _pin_cpu_platform():
     """Pin jax to CPU at the config level.

@@ -1,0 +1,18 @@
+"""FIXTURE (never imported; fed to the annotations rule under an
+allocator/ path): public surface with missing annotations."""
+
+
+def place(pod, units: int):  # WRONG: pod + return unannotated
+    return units
+
+
+def watch(cb: Callable[[], None]) -> Iterator[int]:  # WRONG: neither name
+    yield 0  # is imported — `from __future__ import annotations` hides it
+
+
+class Ledger:
+    def __init__(self, ttl):  # WRONG: ttl + return unannotated
+        self._ttl = ttl
+
+    def reserve(self, key: str) -> bool:
+        return True

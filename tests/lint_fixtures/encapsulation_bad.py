@@ -1,0 +1,20 @@
+"""FIXTURE (never imported): the PR 6 gang double-booking shape.
+
+The real bug: gang usage was spread into ONE of the usage ledger's
+aggregates by a helper outside the ledger module, so the sibling
+aggregate (and the informer index) kept counting the gang on a single
+chip — a concurrent admission storm double-booked the other members.
+The ledger-encapsulation rule must flag every direct reach into the
+protected internals; the only legal route is the locked methods."""
+
+
+def spread_gang_usage(usage, index, assume, chips, per_chip, node):
+    for idx in chips:
+        # WRONG: mutates one aggregate of NodeChipUsage directly, missing
+        # _core_refs and the lock — the double-booking shape
+        usage._mem_used[idx] = usage._mem_used.get(idx, 0) + per_chip
+    # WRONG: pokes ClusterUsageIndex internals (and skips the generation
+    # bump, so the extender's view cache serves stale state forever)
+    index._nodes[node]["frac"]["tpu-mem"] = dict.fromkeys(chips, per_chip)
+    # WRONG: reads the in-flight gang ledger without its lock (torn read)
+    return list(assume._gang.values())

@@ -1,0 +1,15 @@
+"""FIXTURE (never imported): daemon-hygiene violations — a broad
+except-pass in a supervised loop and an unbounded queue."""
+
+import queue
+
+
+def supervise(watch_fn):
+    q = queue.Queue()  # WRONG: unbounded
+    q2 = queue.Queue(0)  # WRONG: maxsize<=0 is unbounded too
+    while True:
+        try:
+            q.put(watch_fn())
+            q2.put(watch_fn())
+        except Exception:  # WRONG: silently eaten
+            pass

@@ -1,0 +1,20 @@
+"""FIXTURE (never imported): blocking I/O under an in-memory-only lock —
+the exact shape of the real pre-PR-7 extender defect: the decision lock
+held across a journal abort (which waits on the WAL writer's fsync) and
+across an apiserver LIST."""
+
+from gpushare_device_plugin_tpu.utils.lockrank import make_rlock
+
+
+class Core:
+    def __init__(self, api, ckpt) -> None:
+        self._lock = make_rlock("extender.core")
+        self._api = api
+        self._ckpt = ckpt
+
+    def bind(self, ns: str, name: str) -> None:
+        with self._lock:
+            # WRONG: a full cluster LIST under the decision lock
+            self._api.list_pods()
+            # WRONG: abort blocks until its record is durable (fsync)
+            self._ckpt.abort((ns, name))

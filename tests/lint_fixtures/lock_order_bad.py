@@ -1,0 +1,26 @@
+"""FIXTURE (never imported): lock-order inversion — acquires the
+allocator ledger (rank 30) while holding the informer cache lock
+(rank 50). tests/test_lint.py feeds this through the lock-order rule
+with a package-scoped path and expects a finding."""
+
+from gpushare_device_plugin_tpu.utils.lockrank import make_lock, make_rlock
+
+
+class Ledger:
+    def __init__(self) -> None:
+        self._lock = make_rlock("allocator.ledger")
+
+    def claim(self, key: str) -> bool:
+        with self._lock:
+            return True
+
+
+class Cache:
+    def __init__(self, assume: Ledger) -> None:
+        self._lock = make_lock("informer.cache")
+        self._assume = assume
+
+    def apply(self, key: str) -> None:
+        with self._lock:
+            # WRONG: cache (50) held while taking the ledger (30)
+            self._assume.claim(key)

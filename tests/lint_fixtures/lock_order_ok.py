@@ -1,0 +1,23 @@
+"""FIXTURE (never imported): the same shapes as lock_order_bad.py with
+the nesting the ranking declares — must produce zero findings."""
+
+from gpushare_device_plugin_tpu.utils.lockrank import make_lock, make_rlock
+
+
+class Ledger:
+    def __init__(self) -> None:
+        self._lock = make_rlock("allocator.ledger")
+
+    def overlay(self, cache: "Cache") -> None:
+        with self._lock:
+            with self._lock:  # rlock re-entry is legal
+                cache.get("k")
+
+
+class Cache:
+    def __init__(self) -> None:
+        self._lock = make_lock("informer.cache")
+
+    def get(self, key: str) -> None:
+        with self._lock:
+            pass

@@ -1,0 +1,10 @@
+"""FIXTURE (never imported): a lock created outside the ranked factory —
+invisible to both the static rule set and the runtime witness."""
+
+import threading
+
+
+class Rogue:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._cond = threading.Condition()

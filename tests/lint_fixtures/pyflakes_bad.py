@@ -1,0 +1,10 @@
+"""FIXTURE (never imported): one unused import, one unused local."""
+
+import json
+import os  # WRONG: unused
+
+
+def size_of(payload: dict) -> int:
+    encoded = json.dumps(payload)
+    leftovers = len(payload)  # WRONG: assigned, never read
+    return len(encoded)

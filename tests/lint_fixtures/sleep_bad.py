@@ -1,0 +1,10 @@
+"""FIXTURE (never imported; fed to the hygiene rule under a tests/ path):
+a long blind sleep where a deadline poll belongs."""
+
+import time
+
+
+def test_settles_eventually(daemon):
+    daemon.kick()
+    time.sleep(2.0)  # WRONG: blind 2s wait
+    assert daemon.settled

@@ -1,0 +1,35 @@
+"""FIXTURE (never imported): WAL-protocol violations.
+
+- ``admit_returns_unresolved``: a return after begin with no
+  commit/abort — the entry outlives the admission.
+- ``admit_swallows``: a broad handler eats the persist failure without
+  aborting, then completes normally.
+- ``admit_patches_first``: the PATCH runs before the begin — the
+  decision is on the wire before it is durable.
+"""
+
+
+def admit_returns_unresolved(ckpt, api, key, data, patch):
+    ckpt.begin(key, data)
+    if not data:
+        return None  # WRONG: begun entry left pending on a live path
+    api.patch_pod(key[0], key[1], patch)
+    ckpt.commit(key)
+    return data
+
+
+def admit_swallows(ckpt, api, key, data, patch):
+    failed = None
+    try:
+        ckpt.begin(key, data)
+        api.patch_pod(key[0], key[1], patch)
+        ckpt.commit(key)
+    except Exception as e:
+        failed = e  # WRONG: swallowed without commit/abort
+    return failed
+
+
+def admit_patches_first(ckpt, api, key, data, patch):
+    api.patch_pod(key[0], key[1], patch)  # WRONG: persist before begin
+    ckpt.begin(key, data)
+    ckpt.commit(key)

@@ -1,0 +1,37 @@
+"""FIXTURE (never imported): the canonical WAL shapes — all legal.
+
+- ``admit``: the allocator's shape — begin, try persist/commit, abort on
+  handled failures, unhandled exceptions propagate (restart replay +
+  reconciler resolve the pending entry by design).
+- ``admit_finally``: try/finally resolution.
+- ``admit_loop``: begin/resolve per loop iteration (the retry shape).
+"""
+
+
+def admit(ckpt, api, key, data, patch):
+    ckpt.begin(key, data)
+    try:
+        api.patch_pod(key[0], key[1], patch)
+        ckpt.commit(key)
+    except ValueError:
+        ckpt.abort(key)
+        raise
+
+
+def admit_finally(ckpt, api, key, data, patch):
+    ckpt.begin(key, data)
+    try:
+        api.patch_pod(key[0], key[1], patch)
+    finally:
+        ckpt.commit(key)
+
+
+def admit_loop(ckpt, api, key, data, patch):
+    for _attempt in (0, 1):
+        ckpt.begin(key, data)
+        try:
+            api.patch_pod(key[0], key[1], patch)
+            ckpt.commit(key)
+            break
+        except ValueError:
+            ckpt.abort(key)

@@ -3,7 +3,6 @@ durability, torn-tail tolerance, compaction, generation bump, replay, and
 the node-annotation fencing token."""
 
 import json
-import os
 
 import pytest
 

@@ -5,7 +5,6 @@ every layer must agree on."""
 
 from __future__ import annotations
 
-import tempfile
 import time
 
 import pytest

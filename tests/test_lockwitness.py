@@ -1,0 +1,182 @@
+"""Runtime lock-order witness unit tests (utils/lockrank.py).
+
+The witness is the dynamic half of the ``go test -race`` substitute: it
+turns any observed down-rank acquisition into a recorded violation (and
+a test failure via the conftest fixture) regardless of whether that
+particular interleaving would have deadlocked.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from gpushare_device_plugin_tpu.utils import lockrank
+from gpushare_device_plugin_tpu.utils.metrics import REGISTRY, timed_acquire
+
+
+@pytest.fixture
+def witness():
+    lockrank.set_witness(True)
+    lockrank.reset_violations()
+    try:
+        yield lockrank
+    finally:
+        lockrank.reset_violations()
+        lockrank.set_witness(None)
+
+
+def test_up_rank_nesting_is_clean(witness):
+    outer = lockrank.make_rlock("allocator.ledger")     # 30
+    inner = lockrank.make_lock("informer.cache")        # 50
+    with outer:
+        with inner:
+            pass
+    assert lockrank.violations() == []
+
+
+def test_down_rank_acquire_is_recorded_with_both_stacks(witness):
+    outer = lockrank.make_lock("informer.cache")        # 50
+    inner = lockrank.make_rlock("allocator.ledger")     # 30
+    with outer:
+        with inner:
+            pass
+    found = lockrank.violations()
+    assert len(found) == 1
+    v = found[0]
+    assert v.acquiring == "allocator.ledger" and v.holding == "informer.cache"
+    assert v.acquiring_rank == 30 and v.holding_rank == 50
+    # both sides of the inversion carry an acquisition stack
+    assert "test_lockwitness" in v.held_stack
+    assert "test_lockwitness" in v.acquire_stack
+    lockrank.reset_violations()
+
+
+def test_equal_rank_distinct_locks_flagged(witness):
+    a = lockrank.make_lock("allocator.match")
+    b = lockrank.make_lock("allocator.match")
+    with a:
+        with b:  # two stripes held at once: unordered peers
+            pass
+    assert len(lockrank.violations()) == 1
+    lockrank.reset_violations()
+
+
+def test_nonreentrant_self_reacquire_raises_instead_of_hanging(witness):
+    """Re-acquiring a held non-reentrant lock is a guaranteed deadlock:
+    the witness must raise with both stacks instead of letting the suite
+    hang until the CI timeout with zero diagnostics."""
+    lock = lockrank.make_lock("informer.cache")
+    with lock:
+        with pytest.raises(lockrank.LockOrderError, match="self-deadlock"):
+            lock.acquire()
+    assert len(lockrank.violations()) == 1
+    assert lockrank.held_locks() == []
+    lockrank.reset_violations()
+
+
+def test_rlock_reentry_is_legal(witness):
+    lock = lockrank.make_rlock("allocator.ledger")
+    with lock:
+        with lock:
+            assert lockrank.held_locks() == [("allocator.ledger", 2)]
+    assert lockrank.violations() == []
+    assert lockrank.held_locks() == []
+
+
+def test_condition_wait_releases_and_reacquires(witness):
+    cond = lockrank.make_condition("wal.batcher")
+    settled = []
+
+    def waiter() -> None:
+        with cond:
+            cond.wait(timeout=2.0)
+            settled.append(True)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    for _ in range(200):
+        with cond:
+            cond.notify_all()
+        t.join(timeout=0.01)
+        if not t.is_alive():
+            break
+    t.join(timeout=2.0)
+    assert settled == [True]
+    assert lockrank.violations() == []
+
+
+def test_cross_thread_lock_handoff_does_not_leak(witness):
+    """Thread A acquires a plain Lock, thread B releases it (legal
+    handoff): A's witness bookkeeping must be cleaned up, or every later
+    acquire on A records phantom violations."""
+    lock = lockrank.make_lock("informer.cache")
+    lock.acquire()
+    assert lockrank.held_locks() == [("informer.cache", 1)]
+    t = threading.Thread(target=lock.release)
+    t.start()
+    t.join(timeout=2.0)
+    assert lockrank.held_locks() == []
+    # rank 30 < 50: would be a violation if the handoff entry leaked
+    lower = lockrank.make_rlock("allocator.ledger")
+    with lower:
+        pass
+    assert lockrank.violations() == []
+
+
+def test_factory_kind_mismatch_raises():
+    with pytest.raises(ValueError, match="declared rlock"):
+        lockrank.make_lock("allocator.ledger")
+    with pytest.raises(ValueError, match="declared lock"):
+        lockrank.make_rlock("informer.cache")
+    with pytest.raises(ValueError, match="declared condition"):
+        lockrank.make_lock("wal.batcher")
+
+
+def test_assert_clean_raises_with_report(witness):
+    outer = lockrank.make_lock("metrics.registry")      # 95
+    inner = lockrank.make_lock("faults.registry")       # 90
+    with outer:
+        with inner:
+            pass
+    with pytest.raises(lockrank.LockOrderError) as err:
+        lockrank.assert_clean("unit test")
+    assert "faults.registry" in str(err.value)
+    lockrank.reset_violations()
+
+
+def test_timed_acquire_composes_with_witnessed_locks(witness):
+    lock = lockrank.make_rlock("allocator.ledger")
+    with timed_acquire(lock, "tpushare_test_lockwitness_wait", lock="x"):
+        pass
+    count, _total = REGISTRY.histogram_stats(
+        "tpushare_test_lockwitness_wait", lock="x"
+    )
+    assert count >= 1
+    assert lockrank.violations() == []
+
+
+def test_factory_returns_plain_primitives_when_off():
+    lockrank.set_witness(False)
+    try:
+        assert isinstance(lockrank.make_lock("informer.cache"), type(threading.Lock()))
+        assert isinstance(
+            lockrank.make_condition("wal.batcher"), threading.Condition
+        )
+    finally:
+        lockrank.set_witness(None)
+
+
+def test_unknown_rank_name_rejected():
+    with pytest.raises(ValueError):
+        lockrank.make_lock("no.such.lock")
+
+
+def test_every_rank_documented_and_ordered():
+    ranks = sorted(lockrank.RANKS.values(), key=lambda r: r.rank)
+    assert len({r.rank for r in ranks}) == len(ranks), "ranks must be unique"
+    assert len({r.name for r in ranks}) == len(ranks)
+    for r in ranks:
+        assert r.kind in ("lock", "rlock", "condition")
+        assert r.doc.strip(), f"{r.name} needs a rationale"

@@ -1,7 +1,6 @@
 """Lifecycle manager e2e: park, serve both resources, kubelet restart, health."""
 
 import json
-import os
 import threading
 import time
 

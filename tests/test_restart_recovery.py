@@ -30,7 +30,6 @@ from gpushare_device_plugin_tpu.allocator.checkpoint import (
     replay_checkpoint,
 )
 from gpushare_device_plugin_tpu.allocator.cluster import (
-    AllocationFailure,
     ClusterAllocator,
     ClusterCoreAllocator,
 )
@@ -530,3 +529,55 @@ def test_extender_warmup_serves_from_checkpoint(api, tmp_path):
         assert "ext-node" in result["failedNodes"]
     finally:
         informer.stop()
+
+
+def test_expired_bind_abort_journals_outside_the_decision_lock(api, tmp_path):
+    """PR 7 defect regression (docs/analysis.md, defect #1): an overlay
+    entry aging out must still resolve its journal entry — but via the
+    deferred drain at the end of a webhook verb, never inline under the
+    decision lock (the abort blocks on WAL durability; tpulint's lock-io
+    rule pins the code shape, this pins the behavior)."""
+    from gpushare_device_plugin_tpu.extender.server import ExtenderCore
+
+    api.add_node(
+        "ext-node",
+        capacity={const.RESOURCE_COUNT: "1", const.RESOURCE_MEM: "8"},
+    )
+    client = ApiServerClient(api.url)
+    ckpt = AllocationCheckpoint(str(tmp_path / "bind.ckpt"))
+    core = ExtenderCore(client, checkpoint=ckpt)
+
+    key = ("default", "aging-pod")
+    seq = ckpt.begin(key, {
+        "node": "ext-node", "resource": const.RESOURCE_MEM, "idx": 0,
+        "units": 6, "ts": time.time(),
+    })
+    from gpushare_device_plugin_tpu.extender import server as ext_server
+
+    core._inflight[key] = ext_server._Inflight(
+        node="ext-node", resource=const.RESOURCE_MEM, idx=0, units=6,
+        annotations={}, stamp=time.monotonic() - 3600,  # long past the TTL
+        seq=seq,
+    )
+    assert ckpt.pending(), "the bind must be journaled before expiry"
+
+    # expiry itself only queues the abort (no WAL wait under the lock)...
+    assert core._live_inflight() == {}
+    assert core._expired_unjournaled == [(key, seq)]
+    # A FRESH begin for the same key lands in the deferral window (the
+    # pod was deleted and recreated under the same name): the queued
+    # stale abort must not pop the new incarnation.
+    fresh_seq = ckpt.begin(key, {
+        "node": "ext-node", "resource": const.RESOURCE_MEM, "idx": 1,
+        "units": 6, "ts": time.time(),
+    })
+    # ...the verb-end drain aborts only the expired incarnation
+    args = {"pod": make_pod("probe", 6, node=""),
+            "nodes": {"items": [client.get_node("ext-node")]}}
+    core.filter(args)
+    assert core._expired_unjournaled == []
+    pending = ckpt.pending()
+    assert key in pending and pending[key]["_seq"] == fresh_seq, pending
+    ckpt.abort(key, seq=fresh_seq)
+    assert ckpt.pending() == {}
+    ckpt.close()

@@ -1,0 +1,5 @@
+"""Project-specific static analysis (see engine module docstring)."""
+
+from .engine import Finding, Module, load_modules, main, run_rules
+
+__all__ = ["Finding", "Module", "load_modules", "main", "run_rules"]

@@ -1,0 +1,73 @@
+"""Ledger-encapsulation rule: the concurrent ledgers' internals are
+touched only inside their own modules.
+
+PR 6's gang work hit exactly this class of bug: a helper outside the
+index module updated one aggregate of the usage ledger and missed its
+sibling, and only a 16-way admission storm caught the double-booking.
+The ledgers' whole correctness story is that every mutation goes
+through their locked methods — so any ``obj._mem``-style reach into
+another object's protected state from outside the defining module is a
+defect, whether it reads (unlocked snapshot: torn reads) or writes
+(bypasses the lock and the invariant maintenance).
+
+``self._attr`` within any class is fine (that is the object's own
+state); the defining module is fine (the implementation); everything
+else is flagged. Tests exercise the rule against fixtures; the
+production tree must be clean with zero waivers.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .engine import Finding, Module
+
+# class -> (defining module suffix, protected attributes)
+PROTECTED: dict[str, tuple[str, frozenset[str]]] = {
+    "AssumeCache": (
+        "allocator/assume.py",
+        frozenset({"_claimed", "_mem", "_core", "_gang", "_stamps"}),
+    ),
+    "ClusterUsageIndex": (
+        "extender/index.py",
+        frozenset({"_nodes", "_gen", "_epoch"}),
+    ),
+    "NodeChipUsage": (
+        "cluster/usage.py",
+        frozenset({"_mem_used", "_core_refs"}),
+    ),
+}
+
+_ATTR_TO_CLASS: dict[str, str] = {
+    attr: cls for cls, (_mod, attrs) in PROTECTED.items() for attr in attrs
+}
+
+
+def check_encapsulation(modules: list[Module]) -> list[Finding]:
+    findings: list[Finding] = []
+    for mod in modules:
+        if not mod.in_package:
+            continue
+        exempt = {
+            cls for cls, (suffix, _a) in PROTECTED.items()
+            if mod.path.endswith(suffix)
+        }
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Attribute):
+                continue
+            cls = _ATTR_TO_CLASS.get(node.attr)
+            if cls is None or cls in exempt:
+                continue
+            if isinstance(node.value, ast.Name) and node.value.id == "self":
+                continue  # a class's own attribute of the same name
+            findings.append(
+                Finding(
+                    mod.path, node.lineno, "ledger-encapsulation",
+                    f"access to {cls}.{node.attr} outside "
+                    f"{PROTECTED[cls][0]} — ledger internals must be "
+                    "reached through the locked methods "
+                    "(snapshot/overlaid_state/node_state/...), never "
+                    "directly",
+                )
+            )
+    return findings

@@ -1,0 +1,109 @@
+"""Threaded-daemon hygiene rules.
+
+- **broad-except-pass**: an ``except:`` / ``except Exception:`` /
+  ``except BaseException:`` whose body is only ``pass`` inside the
+  package. In a supervised daemon loop this silently eats the failure
+  the supervisor exists to observe (narrow catches like ``except
+  OSError: pass`` around best-effort cleanup are fine and not flagged).
+- **unbounded-queue**: ``queue.Queue()`` with no maxsize in the
+  package. An unbounded queue in front of a slow consumer is the
+  outage-amplifier PR 1 removed from the event emitter; keep it out.
+- **test-blind-sleep**: ``time.sleep(<constant ≥ 0.5s>)`` in tests/.
+  Long blind sleeps make the suite slow *and* flaky — poll with a
+  deadline instead (short poll-loop sleeps stay legal).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .engine import Finding, Module
+
+BROAD = {"Exception", "BaseException"}
+SLEEP_LIMIT_S = 0.5
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    if isinstance(t, ast.Name):
+        return t.id in BROAD
+    if isinstance(t, ast.Tuple):
+        return any(isinstance(e, ast.Name) and e.id in BROAD for e in t.elts)
+    return False
+
+
+def _queue_unbounded(node: ast.Call) -> bool:
+    """No maxsize at all, or an explicit maxsize <= 0 (queue.Queue treats
+    both as unbounded)."""
+    size: ast.expr | None = None
+    if node.args:
+        size = node.args[0]
+    for kw in node.keywords:
+        if kw.arg == "maxsize":
+            size = kw.value
+    if size is None:
+        return True
+    if isinstance(size, ast.Constant) and isinstance(size.value, (int, float)):
+        return size.value <= 0
+    return False  # dynamic maxsize: assume the caller bounded it
+
+
+def check_hygiene(modules: list[Module]) -> list[Finding]:
+    findings: list[Finding] = []
+    for mod in modules:
+        if mod.in_package:
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.ExceptHandler):
+                    only_pass = len(node.body) == 1 and isinstance(
+                        node.body[0], ast.Pass
+                    )
+                    if only_pass and _is_broad(node):
+                        findings.append(
+                            Finding(
+                                mod.path, node.lineno, "hygiene",
+                                "broad except swallowed with bare `pass` — "
+                                "log it (or narrow the exception type); a "
+                                "supervised loop that eats failures "
+                                "silently defeats its supervisor",
+                            )
+                        )
+                elif isinstance(node, ast.Call):
+                    fn = node.func
+                    is_queue = (
+                        isinstance(fn, ast.Attribute)
+                        and fn.attr == "Queue"
+                        and isinstance(fn.value, ast.Name)
+                        and fn.value.id == "queue"
+                    ) or (isinstance(fn, ast.Name) and fn.id == "Queue")
+                    if is_queue and _queue_unbounded(node):
+                        findings.append(
+                            Finding(
+                                mod.path, node.lineno, "hygiene",
+                                "unbounded queue.Queue() — give it a "
+                                "maxsize; an unbounded queue in front of "
+                                "a slow consumer amplifies outages",
+                            )
+                        )
+        if mod.is_test:
+            for node in ast.walk(mod.tree):
+                if (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "sleep"
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id in ("time", "_time")
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, (int, float))
+                    and node.args[0].value >= SLEEP_LIMIT_S
+                ):
+                    findings.append(
+                        Finding(
+                            mod.path, node.lineno, "hygiene",
+                            f"blind {node.args[0].value}s sleep in a test — "
+                            "poll with a deadline instead (slow AND flaky)",
+                        )
+                    )
+    return findings

@@ -1,0 +1,643 @@
+"""Lock-order, IO-under-lock, and unranked-lock rules.
+
+The ground truth is the declared ranking in
+``gpushare_device_plugin_tpu.utils.lockrank.RANKS`` plus the factory
+calls (``make_lock("name")``) that bind every lock attribute in the
+package to a rank. From the ASTs this module builds:
+
+1. a **lock table**: (class, attribute) -> rank, from factory-call
+   assignments (including the match-stripe list comprehension);
+2. per-function **summaries** via a fixpoint over the package call
+   graph: the set of ranks a call may acquire transitively, whether it
+   may block on I/O, and — for lock-returning helpers like
+   ``AssumeCache.transaction()`` / ``_serial_guard()`` — the rank their
+   returned context manager acquires;
+3. the **acquisition graph**: for every ``with``-held rank, an edge to
+   every rank acquired inside the block (directly nested ``with``s and
+   through resolved calls).
+
+Checks:
+- ``lock-order``: every edge must go strictly up-rank (same-lock
+  re-entry is legal for rlocks/conditions), and the edge graph must be
+  acyclic.
+- ``lock-io``: no blocking call (apiserver verbs, checkpoint journal
+  waits, fsync, sleep, Ticket.wait, informer refresh) may run while a
+  lock whose rank declares ``io_ok=False`` is held.
+- ``lock-unranked``: no ``threading.Lock/RLock/Condition`` constructed
+  directly in the package — everything goes through the ranked factory
+  so both this analyzer and the runtime witness see it.
+
+Resolution is deliberately curated rather than clever: cross-object
+calls resolve only through the receiver-name hints below, and only when
+the named method actually exists on the hinted class. Unresolvable
+calls are skipped (under-approximation) — the rule set must hold with
+zero waivers on the real tree, so precision beats recall at the margin;
+the runtime witness covers what static resolution cannot see.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Any, Iterable
+
+from gpushare_device_plugin_tpu.utils.lockrank import RANKS
+
+from .engine import Finding, Module
+
+FACTORY_FUNCS = {"make_lock", "make_rlock", "make_condition"}
+LOCKRANK_PATH = "gpushare_device_plugin_tpu/utils/lockrank.py"
+
+# Receiver-name -> candidate classes, for cross-object call resolution.
+# A method call binds only when the method exists on the hinted class.
+RECEIVER_HINTS: list[tuple[re.Pattern[str], tuple[str, ...]]] = [
+    (re.compile(r"^_?(assume|ledger)$"), ("AssumeCache",)),
+    (re.compile(r"^_?(ckpt|checkpoint)$"), ("AllocationCheckpoint",)),
+    (re.compile(r"^_?(pods|pod_source|informer)$"), ("PodInformer",)),
+    (re.compile(r"^_?usage$"), ("NodeChipUsage",)),
+    (re.compile(r"^_?index$"), ("ClusterUsageIndex",)),
+    (
+        re.compile(r"^(ix|_pending|_labeled)$"),
+        (
+            "ClusterUsageIndex", "NodeChipUsage", "PendingPodIndex",
+            "LabeledPodIndex", "_BucketedPodIndex",
+        ),
+    ),
+    (re.compile(r"^_?(writer|batcher)$"), ("GroupBatcher",)),
+    (re.compile(r"^_?(registry|REGISTRY)$", re.IGNORECASE), ("MetricsRegistry",)),
+    (re.compile(r"^FAULTS$"), ("FaultRegistry",)),
+    (re.compile(r"^_?(api|c|client)$"), ("ApiServerClient",)),
+    (re.compile(r"^ticket$"), ("Ticket",)),
+]
+
+# Blocking-call seeds for the IO rule. Cross-object calls resolved to
+# these (class, method) pairs — or to any ApiServerClient verb — block
+# on I/O; so do the direct calls below.
+IO_SEED_METHODS = {
+    ("AllocationCheckpoint", "begin"),
+    ("AllocationCheckpoint", "commit"),
+    ("AllocationCheckpoint", "abort"),
+    ("AllocationCheckpoint", "flush"),
+    ("AllocationCheckpoint", "compact"),
+    ("AllocationCheckpoint", "acquire_fence"),
+    ("AllocationCheckpoint", "verify_fence"),
+    ("GroupBatcher", "flush"),
+    ("GroupBatcher", "stop"),
+    ("Ticket", "wait"),
+    ("PodInformer", "refresh"),
+}
+IO_ALL_METHODS_CLASSES = {"ApiServerClient"}
+# Direct blocking calls: module.attr form.
+IO_SEED_CALLS = {("os", "fsync"), ("time", "sleep"), ("_time", "sleep")}
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    module: Module
+    cls: str | None  # enclosing class name (None = module-level)
+    name: str
+    node: ast.FunctionDef
+    acquires: set[str] = dataclasses.field(default_factory=set)
+    io: bool = False
+    ctx_rank: str | None = None  # rank acquired by the returned ctx manager
+    calls: list[tuple[str, str]] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class ClassInfo:
+    module: Module
+    name: str
+    bases: list[str]
+    methods: dict[str, FuncInfo] = dataclasses.field(default_factory=dict)
+    lock_attrs: dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+class _Model:
+    """The package-wide lock/call model shared by the three checks."""
+
+    def __init__(self, modules: list[Module]):
+        self.modules = [m for m in modules if m.in_package]
+        self.classes: dict[str, ClassInfo] = {}
+        self.module_funcs: dict[str, dict[str, FuncInfo]] = {}
+        self.global_funcs: dict[str, list[FuncInfo]] = {}
+        self.funcs: list[FuncInfo] = []
+        self._collect()
+        self._fixpoint()
+
+    # --- collection -------------------------------------------------------
+
+    def _collect(self) -> None:
+        for mod in self.modules:
+            if mod.path == LOCKRANK_PATH:
+                continue
+            per_module = self.module_funcs.setdefault(mod.path, {})
+            for node in mod.tree.body:
+                if isinstance(node, ast.ClassDef):
+                    ci = ClassInfo(
+                        mod, node.name,
+                        [b.id for b in node.bases if isinstance(b, ast.Name)],
+                    )
+                    # last definition wins on (unlikely) name collisions
+                    self.classes[node.name] = ci
+                    for sub in node.body:
+                        if isinstance(sub, ast.FunctionDef):
+                            fi = FuncInfo(mod, node.name, sub.name, sub)
+                            ci.methods[sub.name] = fi
+                            self.funcs.append(fi)
+                            self._scan_lock_decls(ci, sub)
+                elif isinstance(node, ast.FunctionDef):
+                    fi = FuncInfo(mod, None, node.name, node)
+                    per_module[node.name] = fi
+                    self.global_funcs.setdefault(node.name, []).append(fi)
+                    self.funcs.append(fi)
+        for fi in self.funcs:
+            self._summarize(fi)
+
+    def _scan_lock_decls(self, ci: ClassInfo, fn: ast.FunctionDef) -> None:
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            target = node.targets[0]
+            if not (
+                isinstance(target, ast.Attribute)
+                and isinstance(target.value, ast.Name)
+                and target.value.id == "self"
+            ):
+                continue
+            rank = _factory_rank(node.value)
+            if rank is not None:
+                ci.lock_attrs[target.attr] = rank
+
+    # --- per-function summaries -------------------------------------------
+
+    def _summarize(self, fi: FuncInfo) -> None:
+        cls = self.classes.get(fi.cls) if fi.cls else None
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    rank = self.with_item_rank(item.context_expr, fi)
+                    if rank is not None:
+                        fi.acquires.add(rank)
+            elif isinstance(node, ast.Call):
+                callee = self._resolve_call(node, fi)
+                if callee is not None:
+                    fi.calls.append(callee)
+                if self._direct_io(node):
+                    fi.io = True
+            elif isinstance(node, ast.Return) and node.value is not None:
+                rank = self._ctx_from_expr(node.value, fi)
+                if rank is not None:
+                    fi.ctx_rank = rank
+        # @contextlib.contextmanager helpers: `with <lock>: yield` means
+        # the returned context manager holds that lock for its body.
+        if _is_contextmanager(fi.node):
+            for node in ast.walk(fi.node):
+                if isinstance(node, ast.With) and any(
+                    isinstance(n, ast.Yield) for n in ast.walk(node)
+                ):
+                    for item in node.items:
+                        rank = self.with_item_rank(item.context_expr, fi)
+                        if rank is not None:
+                            fi.ctx_rank = rank
+        _ = cls
+
+    def _ctx_from_expr(self, expr: ast.expr, fi: FuncInfo) -> str | None:
+        if isinstance(expr, ast.Call) and _callee_name(expr) == "timed_acquire":
+            if expr.args:
+                return self.lock_expr_rank(expr.args[0], fi)
+        return None
+
+    def _direct_io(self, call: ast.Call) -> bool:
+        fn = call.func
+        if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name):
+            if (fn.value.id, fn.attr) in IO_SEED_CALLS:
+                return True
+        return False
+
+    def _resolve_call(
+        self, call: ast.Call, fi: FuncInfo
+    ) -> tuple[str, str] | None:
+        """-> ("class" or "module:<path>", func name) key, or None."""
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            # same module first, then unique package-wide; class
+            # constructors resolve to their __init__
+            name = fn.id
+            if name in self.module_funcs.get(fi.module.path, {}):
+                return ("module:" + fi.module.path, name)
+            if name in self.classes and "__init__" in self.classes[name].methods:
+                return (name, "__init__")
+            defs = self.global_funcs.get(name, [])
+            if len(defs) == 1:
+                return ("module:" + defs[0].module.path, name)
+            return None
+        if not isinstance(fn, ast.Attribute):
+            return None
+        recv = fn.value
+        method = fn.attr
+        if isinstance(recv, ast.Name) and recv.id == "self" and fi.cls:
+            owner = self._find_method(fi.cls, method)
+            if owner is not None:
+                return (owner, method)
+            return None
+        hint = _receiver_hint_name(recv)
+        if hint is None:
+            return None
+        for pattern, class_names in RECEIVER_HINTS:
+            if pattern.match(hint):
+                for cname in class_names:
+                    owner = self._find_method(cname, method)
+                    if owner is not None:
+                        return (owner, method)
+        return None
+
+    def _find_method(self, cls_name: str, method: str) -> str | None:
+        """Walk the (package-local) MRO by name; -> defining class."""
+        seen = set()
+        queue = [cls_name]
+        while queue:
+            cname = queue.pop(0)
+            if cname in seen:
+                continue
+            seen.add(cname)
+            ci = self.classes.get(cname)
+            if ci is None:
+                continue
+            if method in ci.methods:
+                return cname
+            queue.extend(ci.bases)
+        return None
+
+    def func_for(self, key: tuple[str, str]) -> FuncInfo | None:
+        owner, name = key
+        if owner.startswith("module:"):
+            return self.module_funcs.get(owner[len("module:"):], {}).get(name)
+        ci = self.classes.get(owner)
+        return ci.methods.get(name) if ci else None
+
+    def call_is_io_seed(self, key: tuple[str, str]) -> bool:
+        owner, name = key
+        if owner in IO_ALL_METHODS_CLASSES:
+            return True
+        return (owner, name) in IO_SEED_METHODS
+
+    # --- fixpoint ---------------------------------------------------------
+
+    def _fixpoint(self) -> None:
+        changed = True
+        while changed:
+            changed = False
+            for fi in self.funcs:
+                for key in fi.calls:
+                    callee = self.func_for(key)
+                    if self.call_is_io_seed(key) and not fi.io:
+                        fi.io = True
+                        changed = True
+                    if callee is None:
+                        continue
+                    new = (callee.acquires - fi.acquires)
+                    if new:
+                        fi.acquires |= new
+                        changed = True
+                    if callee.ctx_rank and callee.ctx_rank not in fi.acquires:
+                        # calling a ctx factory does not itself acquire;
+                        # but `with f():` callers are handled in the edge
+                        # walk — for summary purposes count it (callers
+                        # that merely *call* without `with` don't hold it,
+                        # a conservative over-approximation kept because
+                        # every such helper in-tree is used via `with`)
+                        fi.acquires.add(callee.ctx_rank)
+                        changed = True
+                    if callee.io and not fi.io:
+                        fi.io = True
+                        changed = True
+
+    # --- expression -> rank resolution ------------------------------------
+
+    def with_item_rank(self, expr: ast.expr, fi: FuncInfo) -> str | None:
+        if isinstance(expr, ast.Call):
+            name = _callee_name(expr)
+            if name == "timed_acquire" and expr.args:
+                return self.lock_expr_rank(expr.args[0], fi)
+            if name == "nullcontext":
+                return None
+            key = self._resolve_call(expr, fi)
+            if key is not None:
+                callee = self.func_for(key)
+                if callee is not None:
+                    return callee.ctx_rank
+            return None
+        return self.lock_expr_rank(expr, fi)
+
+    def lock_expr_rank(self, expr: ast.expr, fi: FuncInfo) -> str | None:
+        if isinstance(expr, ast.Subscript):
+            return self.lock_expr_rank(expr.value, fi)
+        if isinstance(expr, ast.Attribute):
+            attr = expr.attr
+            if isinstance(expr.value, ast.Name) and expr.value.id == "self":
+                if fi.cls:
+                    rank = self._attr_rank(fi.cls, attr)
+                    if rank is not None:
+                        return rank
+                return None
+            hint = _receiver_hint_name(expr.value)
+            if hint is not None:
+                for pattern, class_names in RECEIVER_HINTS:
+                    if pattern.match(hint):
+                        for cname in class_names:
+                            rank = self._attr_rank(cname, attr)
+                            if rank is not None:
+                                return rank
+            return None
+        if isinstance(expr, ast.Name):
+            # simple local alias: stripe = self._match_locks[...]
+            assigned = _local_assignment(fi.node, expr.id)
+            if assigned is not None and not isinstance(assigned, ast.Name):
+                return self.lock_expr_rank(assigned, fi)
+            return None
+        return None
+
+    def _attr_rank(self, cls_name: str, attr: str) -> str | None:
+        seen = set()
+        queue = [cls_name]
+        while queue:
+            cname = queue.pop(0)
+            if cname in seen:
+                continue
+            seen.add(cname)
+            ci = self.classes.get(cname)
+            if ci is None:
+                continue
+            if attr in ci.lock_attrs:
+                return ci.lock_attrs[attr]
+            queue.extend(ci.bases)
+        return None
+
+
+def _factory_rank(value: ast.expr) -> str | None:
+    """make_lock("x") / [make_lock("x") for ...] -> "x"."""
+    if isinstance(value, ast.ListComp):
+        return _factory_rank(value.elt)
+    if isinstance(value, ast.Call):
+        name = _callee_name(value)
+        if name in FACTORY_FUNCS and value.args:
+            arg = value.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                return arg.value
+    return None
+
+
+def _callee_name(call: ast.Call) -> str | None:
+    if isinstance(call.func, ast.Name):
+        return call.func.id
+    if isinstance(call.func, ast.Attribute):
+        return call.func.attr
+    return None
+
+
+def _receiver_hint_name(expr: ast.expr) -> str | None:
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    return None
+
+
+def _local_assignment(fn: ast.FunctionDef, name: str) -> ast.expr | None:
+    found: ast.expr | None = None
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            t = node.targets[0]
+            if isinstance(t, ast.Name) and t.id == name:
+                found = node.value
+    return found
+
+
+def _is_contextmanager(fn: ast.FunctionDef) -> bool:
+    for dec in fn.decorator_list:
+        name = dec.attr if isinstance(dec, ast.Attribute) else (
+            dec.id if isinstance(dec, ast.Name) else None
+        )
+        if name == "contextmanager":
+            return True
+    return False
+
+
+# --- edge extraction --------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Edge:
+    outer: str
+    inner: str
+    path: str
+    line: int
+    via: str
+
+
+def _walk_edges(model: _Model) -> tuple[list[Edge], list[Finding]]:
+    """Edges of the acquisition graph + IO findings, from every
+    with-block in the package."""
+    edges: list[Edge] = []
+    io_findings: list[Finding] = []
+
+    def body_ranks_and_io(
+        stmts: Iterable[ast.stmt], fi: FuncInfo
+    ) -> tuple[set[tuple[str, int, str]], list[tuple[int, str]]]:
+        """(ranks acquired in stmts with (rank, line, via)), blocking
+        calls in stmts as (line, description). Nested withs recurse via
+        the main walker, so only this level's items + calls count here.
+        """
+        ranks: set[tuple[str, int, str]] = set()
+        blocking: list[tuple[int, str]] = []
+        for stmt in stmts:
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.With):
+                    for item in node.items:
+                        rank = model.with_item_rank(item.context_expr, fi)
+                        if rank is not None:
+                            ranks.add((rank, node.lineno, "with"))
+                        if _callee_of_item(item) == "timed_acquire":
+                            # timed_acquire records its wait histogram
+                            # while holding the acquired lock
+                            ranks.add(
+                                ("metrics.registry", node.lineno,
+                                 "timed_acquire")
+                            )
+                elif isinstance(node, ast.Call):
+                    key = model._resolve_call(node, fi)
+                    if key is not None:
+                        callee = model.func_for(key)
+                        desc = f"{key[0]}.{key[1]}"
+                        if model.call_is_io_seed(key):
+                            blocking.append((node.lineno, desc + " (blocking)"))
+                        if callee is not None:
+                            for r in callee.acquires:
+                                ranks.add((r, node.lineno, desc))
+                            if callee.io and not model.call_is_io_seed(key):
+                                blocking.append(
+                                    (node.lineno, desc + " (does I/O)")
+                                )
+                    if model._direct_io(node):
+                        blocking.append(
+                            (node.lineno, ast.unparse(node.func) + " (blocking)")
+                        )
+        return ranks, blocking
+
+    for fi in model.funcs:
+        for node in ast.walk(fi.node):
+            if not isinstance(node, ast.With):
+                continue
+            held: list[str] = []
+            for item in node.items:
+                rank = model.with_item_rank(item.context_expr, fi)
+                if rank is not None:
+                    for outer in held:
+                        edges.append(
+                            Edge(outer, rank, fi.module.path, node.lineno,
+                                 "with-items")
+                        )
+                    held.append(rank)
+            if not held:
+                continue
+            ranks, blocking = body_ranks_and_io(node.body, fi)
+            for outer in held:
+                for rank, line, via in ranks:
+                    edges.append(Edge(outer, rank, fi.module.path, line, via))
+                if not RANKS[outer].io_ok:
+                    for line, desc in blocking:
+                        io_findings.append(
+                            Finding(
+                                fi.module.path, line, "lock-io",
+                                f"blocking call {desc} while holding "
+                                f"{outer!r} (declared in-memory-only; "
+                                f"rank {RANKS[outer].rank})",
+                            )
+                        )
+    return edges, io_findings
+
+
+def _callee_of_item(item: ast.withitem) -> str | None:
+    if isinstance(item.context_expr, ast.Call):
+        return _callee_name(item.context_expr)
+    return None
+
+
+# --- public checks ----------------------------------------------------------
+
+# check_lock_order and check_lock_io share the model + edge walk (the
+# dominant cost of a lint run: full-package AST walk + call-graph
+# fixpoint). One entry, identity-checked, so the same `modules` list —
+# which run_rules passes to every rule — builds the model exactly once.
+_shared: list[Any] = []
+
+
+def _model_and_edges(
+    modules: list[Module],
+) -> tuple[_Model, list["Edge"], list[Finding]]:
+    if _shared and _shared[0] is modules:
+        return _shared[1], _shared[2], _shared[3]
+    model = _Model(modules)
+    edges, io_findings = _walk_edges(model)
+    _shared[:] = [modules, model, edges, io_findings]
+    return model, edges, io_findings
+
+
+def check_lock_order(modules: list[Module]) -> list[Finding]:
+    _model, edges, _io = _model_and_edges(modules)
+    findings: list[Finding] = []
+    graph: dict[str, set[str]] = {}
+    for e in edges:
+        if e.outer == e.inner:
+            if RANKS[e.outer].kind in ("rlock", "condition"):
+                continue  # legal re-entry
+            findings.append(
+                Finding(
+                    e.path, e.line, "lock-order",
+                    f"non-reentrant lock {e.outer!r} re-acquired while "
+                    f"held (via {e.via})",
+                )
+            )
+            continue
+        graph.setdefault(e.outer, set()).add(e.inner)
+        if RANKS[e.outer].rank >= RANKS[e.inner].rank:
+            findings.append(
+                Finding(
+                    e.path, e.line, "lock-order",
+                    f"acquires {e.inner!r} (rank {RANKS[e.inner].rank}) "
+                    f"while holding {e.outer!r} (rank "
+                    f"{RANKS[e.outer].rank}) via {e.via} — against the "
+                    "declared ranking in utils/lockrank.py",
+                )
+            )
+    # cycle check on the observed graph (subsumed by the rank check when
+    # that is clean, but reported independently per the rule contract)
+    cycle = _find_cycle(graph)
+    if cycle:
+        findings.append(
+            Finding(
+                "gpushare_device_plugin_tpu", 0, "lock-order",
+                "acquisition-graph cycle: " + " -> ".join(cycle),
+            )
+        )
+    return findings
+
+
+def _find_cycle(graph: dict[str, set[str]]) -> list[str] | None:
+    WHITE, GRAY, BLACK = 0, 1, 2
+    color = {n: WHITE for n in set(graph) | {v for vs in graph.values() for v in vs}}
+    stack: list[str] = []
+
+    def dfs(n: str) -> list[str] | None:
+        color[n] = GRAY
+        stack.append(n)
+        for m in graph.get(n, ()):
+            if color[m] == GRAY:
+                return stack[stack.index(m):] + [m]
+            if color[m] == WHITE:
+                found = dfs(m)
+                if found:
+                    return found
+        color[n] = BLACK
+        stack.pop()
+        return None
+
+    for n in list(color):
+        if color[n] == WHITE:
+            found = dfs(n)
+            if found:
+                return found
+    return None
+
+
+def check_lock_io(modules: list[Module]) -> list[Finding]:
+    _model, _edges, io_findings = _model_and_edges(modules)
+    return io_findings
+
+
+def check_unranked_locks(modules: list[Module]) -> list[Finding]:
+    findings: list[Finding] = []
+    for mod in modules:
+        if not mod.in_package or mod.path == LOCKRANK_PATH:
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if (
+                isinstance(fn, ast.Attribute)
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id == "threading"
+                and fn.attr in ("Lock", "RLock", "Condition")
+            ):
+                findings.append(
+                    Finding(
+                        mod.path, node.lineno, "lock-unranked",
+                        f"threading.{fn.attr}() created directly; use "
+                        "utils.lockrank.make_lock/make_rlock/"
+                        "make_condition with a declared rank so the "
+                        "static analyzer and runtime witness both see it",
+                    )
+                )
+    return findings

@@ -1,0 +1,158 @@
+"""The pyflakes subset `make lint` gates on: unused imports and unused
+local variables.
+
+The image does not ship pyflakes (and nothing may be installed), so the
+Makefile's old ``pyflakes ... || true`` was doubly toothless: the tool
+was missing AND failures were swallowed. ``make lint`` now runs
+``python -m tools.tpulint --pyflakes``, which prefers the real pyflakes
+when importable and otherwise runs these two rules — either way the
+exit code gates the build.
+
+Both rules are tuned for zero false positives over recall:
+
+- **unused-import** skips ``__init__.py`` (re-export idiom), ``from
+  __future__``, star imports, and anything whose bound name appears in
+  a Load/attribute context or in ``__all__``.
+- **unused-local** flags only simple single-target assignments whose
+  name is never loaded anywhere in the function (nested scopes
+  included), skipping ``_``-prefixed names, tuple unpacking, for/with
+  targets, augmented assignments, and functions that use
+  ``locals``/``eval``/``exec``/``vars``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .engine import Finding, Module
+
+
+def _bound_names(node: ast.stmt) -> list[tuple[str, int]]:
+    out = []
+    if isinstance(node, ast.Import):
+        for alias in node.names:
+            name = alias.asname or alias.name.split(".")[0]
+            out.append((name, node.lineno))
+    elif isinstance(node, ast.ImportFrom):
+        if node.module == "__future__":
+            return []
+        for alias in node.names:
+            if alias.name == "*":
+                return []
+            out.append((alias.asname or alias.name, node.lineno))
+    return out
+
+
+def _used_names(tree: ast.AST) -> set[str]:
+    used: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name) and isinstance(
+            node.ctx, (ast.Load, ast.Del)
+        ):
+            used.add(node.id)
+        elif isinstance(node, ast.Constant) and isinstance(node.value, str):
+            pass  # __all__ strings handled below
+    return used
+
+
+def _all_exports(tree: ast.Module) -> set[str]:
+    exports: set[str] = set()
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for target in node.targets:
+                if isinstance(target, ast.Name) and target.id == "__all__":
+                    if isinstance(node.value, (ast.List, ast.Tuple)):
+                        for elt in node.value.elts:
+                            if isinstance(elt, ast.Constant) and isinstance(
+                                elt.value, str
+                            ):
+                                exports.add(elt.value)
+    return exports
+
+
+def check_unused_imports(modules: list[Module]) -> list[Finding]:
+    findings: list[Finding] = []
+    for mod in modules:
+        if mod.path.endswith("__init__.py"):
+            continue  # re-export idiom
+        used = _used_names(mod.tree) | _all_exports(mod.tree)
+        for node in ast.walk(mod.tree):
+            for name, line in _bound_names(node) if isinstance(
+                node, (ast.Import, ast.ImportFrom)
+            ) else []:
+                if name not in used:
+                    findings.append(
+                        Finding(
+                            mod.path, line, "unused-import",
+                            f"{name!r} imported but unused",
+                        )
+                    )
+    return findings
+
+
+_DYNAMIC = {"locals", "vars", "eval", "exec", "globals"}
+
+
+def _own_scope_stmts(fn: ast.AST):
+    """Statement-level nodes of ``fn``'s own scope: walk, but do not
+    descend into nested function/class definitions."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)
+        ):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def check_unused_locals(modules: list[Module]) -> list[Finding]:
+    findings: list[Finding] = []
+    for mod in modules:
+        for fn in ast.walk(mod.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            calls = {
+                n.func.id
+                for n in ast.walk(fn)
+                if isinstance(n, ast.Call) and isinstance(n.func, ast.Name)
+            }
+            if calls & _DYNAMIC:
+                continue
+            # Loads count across nested scopes (closures capture), but
+            # stores are THIS function's own statements only — an
+            # assignment inside a nested def/class is that scope's
+            # binding (a nested class's `protocol_version = ...` is a
+            # class attribute the framework reads, not a dead local).
+            loads: set[str] = set()
+            stores: dict[str, int] = {}
+            aug: set[str] = set()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Name) and isinstance(
+                    node.ctx, (ast.Load, ast.Del)
+                ):
+                    loads.add(node.id)
+            for node in _own_scope_stmts(fn):
+                if isinstance(node, ast.AugAssign) and isinstance(
+                    node.target, ast.Name
+                ):
+                    aug.add(node.target.id)
+                elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+                    t = node.targets[0]
+                    if isinstance(t, ast.Name):
+                        stores[t.id] = node.lineno
+                elif isinstance(node, ast.AnnAssign) and isinstance(
+                    node.target, ast.Name
+                ):
+                    stores[node.target.id] = node.lineno
+            for name, line in sorted(stores.items(), key=lambda kv: kv[1]):
+                if name.startswith("_") or name in loads or name in aug:
+                    continue
+                findings.append(
+                    Finding(
+                        mod.path, line, "unused-local",
+                        f"local variable {name!r} assigned but never used",
+                    )
+                )
+    return findings

@@ -1,0 +1,335 @@
+"""WAL-protocol rule: every journal ``begin`` is dominated by a
+``commit``/``abort`` on all handled control-flow paths.
+
+The invariant (docs/analysis.md, "WAL begin/commit protocol"): a
+``checkpoint.begin(key, ...)`` journals an in-flight decision durably
+*before* the apiserver PATCH leaves the node. After that:
+
+- on every path the function completes normally on, a ``commit`` or
+  ``abort`` for the entry must have run (try/except/finally aware);
+- a ``return`` that skips resolution is a defect (the entry would stay
+  pending with the admission concluded);
+- an exception that *propagates out of the function* is legal: the
+  entry stays pending on purpose — restart replay re-installs it as a
+  reservation and the drift reconciler retro-resolves it against the
+  apiserver. But an ``except`` handler that *swallows* the exception
+  and completes normally must itself resolve (or re-raise);
+- and no persist write (``patch_pod``/``bind_pod``/
+  ``persist_pod_assignment``/``_persist``) may run before the first
+  ``begin`` in a function that journals — the decision must be durable
+  before the PATCH is on the wire ("no code proceeds past begin before
+  durability" is enforced by ``begin()`` itself blocking on its fsync
+  ticket; this check pins the call *order*).
+
+Recognized begin/resolve forms: calls through a checkpoint-hinted
+receiver (``self._ckpt.begin(...)``, ``ckpt.abort(...)``) and the
+allocator's module helpers ``_journal_begin`` / ``_journal_resolve``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .engine import Finding, Module
+
+CKPT_RECEIVERS = ("_ckpt", "ckpt", "checkpoint", "_checkpoint")
+BEGIN_HELPERS = ("_journal_begin",)
+RESOLVE_HELPERS = ("_journal_resolve",)
+RESOLVE_METHODS = ("commit", "abort")
+PERSIST_CALLS = (
+    "patch_pod", "bind_pod", "persist_pod_assignment", "_persist",
+)
+
+
+def _is_ckpt_call(node: ast.Call, methods: tuple[str, ...]) -> bool:
+    fn = node.func
+    if isinstance(fn, ast.Attribute) and fn.attr in methods:
+        recv = fn.value
+        name = None
+        if isinstance(recv, ast.Name):
+            name = recv.id
+        elif isinstance(recv, ast.Attribute):
+            name = recv.attr
+        return name in CKPT_RECEIVERS
+    return False
+
+
+def _is_begin(node: ast.stmt) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            if _is_ckpt_call(n, ("begin",)):
+                return True
+            if isinstance(n.func, ast.Name) and n.func.id in BEGIN_HELPERS:
+                return True
+    return False
+
+
+def _is_resolve(node: ast.stmt) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            if _is_ckpt_call(n, RESOLVE_METHODS):
+                return True
+            if isinstance(n.func, ast.Name) and n.func.id in RESOLVE_HELPERS:
+                return True
+    return False
+
+
+# Path outcomes for the CFG-lite evaluator.
+R = "resolved"      # a resolve ran; subsequent flow is fine
+T = "terminated"    # raised: entry stays pending for replay (legal)
+F = "fallthrough"   # completed the block without resolving yet
+RET = "returned"    # returned without resolving: a defect
+
+
+def _stmt_outcomes(stmt: ast.stmt) -> set[str]:
+    if _is_resolve(stmt):
+        return {R}
+    if isinstance(stmt, ast.Raise):
+        return {T}
+    if isinstance(stmt, ast.Return):
+        return {RET}
+    if isinstance(stmt, ast.Try):
+        body = _eval(stmt.body)
+        if F in body and stmt.orelse:
+            body = (body - {F}) | _eval(stmt.orelse)
+        out = set(body)
+        for handler in stmt.handlers:
+            hout = _eval(handler.body)
+            # a handler can be entered from any point in the body —
+            # including before a resolve — so its own outcomes stand alone
+            out |= hout
+        if stmt.finalbody:
+            fin = _eval(stmt.finalbody)
+            if fin == {R}:
+                # the finally resolves unconditionally: every exit path
+                # (normal, return, raise) passes through it
+                return {R}
+            out |= fin - {F}
+        return out
+    if isinstance(stmt, ast.If):
+        return _eval(stmt.body) | (_eval(stmt.orelse) if stmt.orelse else {F})
+    if isinstance(stmt, (ast.For, ast.While)):
+        body = _eval(stmt.body)
+        # the loop may run zero times (fallthrough), and break/continue
+        # fold into fallthrough/retry conservatively
+        out = {F} | (body - {F})
+        if stmt.orelse:
+            out |= _eval(stmt.orelse)
+        return out
+    if isinstance(stmt, ast.With):
+        return _eval(stmt.body)
+    if isinstance(stmt, (ast.Break, ast.Continue)):
+        return {F}
+    return {F}
+
+
+def _eval(stmts: list[ast.stmt]) -> set[str]:
+    """Outcomes of executing a statement list from its start."""
+    outcomes = {F}
+    for stmt in stmts:
+        if F not in outcomes:
+            break
+        outcomes.discard(F)
+        outcomes |= _stmt_outcomes(stmt)
+    return outcomes
+
+
+def _path_to(stmts: list[ast.stmt], target: ast.stmt) -> list[tuple[list[ast.stmt], int]] | None:
+    """Chain of (block, index) leading to ``target`` within ``stmts``."""
+    for i, stmt in enumerate(stmts):
+        if stmt is target:
+            return [(stmts, i)]
+        for block in _child_blocks(stmt):
+            sub = _path_to(block, target)
+            if sub is not None:
+                return [(stmts, i)] + sub
+    return None
+
+
+def _child_blocks(stmt: ast.stmt) -> list[list[ast.stmt]]:
+    blocks = []
+    for field in ("body", "orelse", "finalbody"):
+        val = getattr(stmt, field, None)
+        if val:
+            blocks.append(val)
+    for handler in getattr(stmt, "handlers", []) or []:
+        blocks.append(handler.body)
+    return blocks
+
+
+def _check_begin_site(
+    fn: ast.FunctionDef, begin_stmt: ast.stmt
+) -> str | None:
+    """None when the begin is properly dominated; else a message."""
+    path = _path_to(fn.body, begin_stmt)
+    if path is None:
+        return None  # begin nested in a lambda/def we don't model
+    # Evaluate the continuation: the rest of each enclosing block,
+    # innermost first; fallthrough propagates outward.
+    outcomes = {F}
+    for block, idx in reversed(path):
+        if F not in outcomes:
+            break
+        outcomes.discard(F)
+        outcomes |= _eval(block[idx + 1:])
+        # when this block is a try body, an exception after the begin
+        # can divert into its handlers; find the enclosing Try (if any)
+        # one level up and require its handlers to resolve or re-raise
+    if RET in outcomes:
+        return (
+            "journal begin may be followed by a return without "
+            "commit()/abort() — the entry would stay pending with the "
+            "admission concluded"
+        )
+    if F in outcomes:
+        return (
+            "journal begin is not dominated by commit()/abort() on every "
+            "normal completion path of this function"
+        )
+    return None
+
+
+def _broad_handler(handler: ast.excepthandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    names = [t] if not isinstance(t, ast.Tuple) else list(t.elts)
+    return any(
+        isinstance(n, ast.Name) and n.id in ("Exception", "BaseException")
+        for n in names
+    )
+
+
+def _try_emits_unresolved(t: ast.Try) -> bool:
+    """True when an exception raised in ``t``'s body can leave ``t``
+    without a resolve having run: either a type no handler catches
+    propagates (no broad catch), or a handler re-raises before
+    resolving."""
+    if t.finalbody and _eval(t.finalbody) == {R}:
+        return False  # the finally resolves on every exit
+    if not any(_broad_handler(h) for h in t.handlers):
+        return True
+    for h in t.handlers:
+        if T in _eval(h.body):  # raise with no prior resolve in the handler
+            return True
+    return False
+
+
+def _post_begin_emits_unresolved(block: list[ast.stmt], idx: int) -> bool:
+    """Can a statement after the begin (at the begin's block level) raise
+    an exception that escapes this level *unresolved*? Plain calls are
+    assumed non-raising here — the journal API degrades instead of
+    raising by design (see AllocationCheckpoint) — so the signal is
+    explicit raises and try-blocks that let exceptions out unresolved."""
+    for stmt in block[idx + 1:]:
+        if _is_resolve(stmt):
+            return False  # resolution reached; later raises are post-resolve
+        if isinstance(stmt, ast.Raise):
+            return True
+        if isinstance(stmt, ast.Try):
+            if _try_emits_unresolved(stmt):
+                return True
+        elif _contains_persist_call(stmt):
+            # persist calls raise by contract (ApiError and friends) —
+            # a bare one after begin reaches the enclosing handlers
+            return True
+    return False
+
+
+def _contains_persist_call(stmt: ast.stmt) -> bool:
+    for n in ast.walk(stmt):
+        if isinstance(n, ast.Call):
+            name = (
+                n.func.attr if isinstance(n.func, ast.Attribute)
+                else n.func.id if isinstance(n.func, ast.Name) else None
+            )
+            if name in PERSIST_CALLS:
+                return True
+    return False
+
+
+def _handlers_resolve(fn: ast.FunctionDef, begin_stmt: ast.stmt) -> str | None:
+    """For a begin inside a try body: a handler that *swallows* (completes
+    normally or returns) an exception that can be raised unresolved after
+    the begin must itself resolve. Handlers that only see
+    already-resolved exceptions (re-raised by an inner resolving handler)
+    are fine, as are handlers that re-raise — propagation keeps the
+    entry pending for the restart replay + reconciler by design."""
+    for node in ast.walk(fn):
+        if not (isinstance(node, ast.Try) and _contains(node.body, begin_stmt)):
+            continue
+        body_path = _path_to(node.body, begin_stmt)
+        assert body_path is not None
+        # a begin nested deeper (an if/with inside the try body) is
+        # positioned at its enclosing top-level statement
+        block, idx = body_path[0]
+        if not _post_begin_emits_unresolved(block, idx):
+            continue
+        for handler in node.handlers:
+            hout = _eval(handler.body)
+            if F in hout or RET in hout:
+                return (
+                    f"except handler at line {handler.lineno} can swallow "
+                    "a failure after journal begin without "
+                    "commit()/abort()"
+                )
+    return None
+
+
+def _contains(stmts: list[ast.stmt], target: ast.stmt) -> bool:
+    return _path_to(stmts, target) is not None
+
+
+def check_wal_protocol(modules: list[Module]) -> list[Finding]:
+    findings: list[Finding] = []
+    for mod in modules:
+        if not mod.in_package:
+            continue
+        if mod.path.endswith("allocator/checkpoint.py"):
+            continue  # the journal's own implementation
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.FunctionDef):
+                continue
+            if node.name in BEGIN_HELPERS + RESOLVE_HELPERS:
+                continue  # the thin delegation helpers themselves
+            begin_stmts = [s for s in ast.walk(node)
+                           if isinstance(s, ast.stmt) and _is_begin(s)
+                           and not any(_is_begin(c) for c in _sub_stmts(s))]
+            if not begin_stmts:
+                continue
+            # order: no persist call on a line before the first begin
+            first_begin_line = min(s.lineno for s in begin_stmts)
+            for call in ast.walk(node):
+                if isinstance(call, ast.Call):
+                    name = (
+                        call.func.attr if isinstance(call.func, ast.Attribute)
+                        else call.func.id if isinstance(call.func, ast.Name)
+                        else None
+                    )
+                    if name in PERSIST_CALLS and call.lineno < first_begin_line:
+                        findings.append(
+                            Finding(
+                                mod.path, call.lineno, "wal-protocol",
+                                f"persist call {name}() runs before the "
+                                "journal begin — the decision must be "
+                                "durable before the PATCH is on the wire",
+                            )
+                        )
+            for stmt in begin_stmts:
+                msg = _check_begin_site(node, stmt) or _handlers_resolve(
+                    node, stmt
+                )
+                if msg:
+                    findings.append(
+                        Finding(mod.path, stmt.lineno, "wal-protocol", msg)
+                    )
+    return findings
+
+
+def _sub_stmts(stmt: ast.stmt) -> list[ast.stmt]:
+    out = []
+    for block in _child_blocks(stmt):
+        for s in block:
+            out.append(s)
+            out.extend(_sub_stmts(s))
+    return out
