@@ -1,7 +1,7 @@
 PY ?= python
 PROTOC ?= protoc
 
-.PHONY: proto native test test-fast test-slow test-stress chaos chaos-restart lint lint-strict typecheck bench bench-smoke bench-serve-smoke bench-multichip-smoke bench-wal e2e-kind
+.PHONY: proto native test test-fast test-slow test-stress chaos chaos-restart lint lint-strict typecheck bench bench-smoke bench-serve-smoke bench-multichip-smoke bench-wal bench-trace trace-smoke e2e-kind
 
 # Regenerate protobuf message classes (gRPC bindings are hand-written in
 # gpushare_device_plugin_tpu/plugin/api/api_grpc.py; grpc_tools is not
@@ -120,6 +120,20 @@ bench-multichip-smoke:
 # coalescing ratio for both. See docs/perf.md.
 bench-wal:
 	$(PY) bench.py --wal-bench --workers 16
+
+# Tracing-overhead A/B: the concurrent admission storm with every
+# admission traced vs --no-trace, median-of-3 per mode; HARD-FAILS when
+# the traced p99 inflates >5% over untraced. See docs/observability.md.
+bench-trace:
+	$(PY) bench.py --trace-bench --workers 8
+
+# End-to-end tracing smoke (seconds, in tier-1 via tests/): one admission
+# through the real extender + allocator produces ONE stitched trace
+# (filter -> bind -> WAL -> PATCH -> Allocate -> env), the flight
+# recorder dumps on SIGUSR1/injected crash, exemplars land in /metrics,
+# and `inspect trace` renders the timeline. See docs/observability.md.
+trace-smoke:
+	$(PY) -m pytest tests/test_trace_pipeline.py -x -q
 
 # Full on-chip compute capture: decode/train/flash/serve plus the step-
 # time ablation and the flash block-size sweep (real TPU required; off

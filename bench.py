@@ -79,6 +79,9 @@ P99_GUARD_PCT = 25.0
 DEFAULT_WORKERS = 8
 CONCURRENT_ROUNDS = 4  # first round is warmup, like the serial trial
 CONCURRENT_POD_UNITS = 2
+# Tracing-overhead hard gate (--trace-bench): the traced storm's p99 may
+# inflate at most this much over the --no-trace storm. docs/observability.md.
+TRACE_OVERHEAD_PCT = 5.0
 
 
 def run_allocate_trial(
@@ -945,6 +948,14 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="run ONLY the concurrent storm, once per WAL mode "
                    "(always then batch), and emit a comparison record "
                    "(make bench-wal)")
+    p.add_argument("--no-trace", action="store_true",
+                   help="disable admission tracing for this run (sample "
+                   "ratio 0 — the unsampled hot path is O(ns); the "
+                   "baseline half of the --trace-bench A/B)")
+    p.add_argument("--trace-bench", action="store_true",
+                   help="run ONLY the concurrent storm, traced vs "
+                   "--no-trace, and HARD-FAIL if tracing inflates the "
+                   "admission p99 more than 5% (make bench-trace)")
     p.add_argument("--backend-init-timeout", type=float, default=60.0,
                    help="bound (seconds) on bench_mfu's subprocess "
                    "backend-init probe — a wedged TPU tunnel costs this "
@@ -991,9 +1002,97 @@ def run_wal_bench(
     return 0
 
 
+def run_trace_bench(
+    workers: int, rounds: int = CONCURRENT_ROUNDS, trials: int = 3
+) -> int:
+    """A/B the tracing layer under the concurrent-admission storm: the
+    same storm with every admission traced (sample ratio 1.0, the daemon
+    default) and with tracing off (``--no-trace``). HARD GATE: the
+    traced p99 may not inflate more than ``TRACE_OVERHEAD_PCT`` over
+    untraced — tracing that taxes the admission tail is a regression,
+    not a feature (``make bench-trace``).
+
+    Methodology: the storm runs WAL-off — the group-commit fsync waits
+    dominate the journaled storm's tail with stalls that have nothing to
+    do with tracing, and a QUIETER baseline makes the gate STRICTER (a
+    fixed per-span tax is a larger fraction of a smaller p99). Modes
+    alternate per trial (untraced, traced, untraced, ...) so box drift
+    cannot masquerade as overhead, and each mode's figure is its
+    BEST-of-N p99 — the bench's convention for noisy wall numbers (cf.
+    best-of-3 walls in bench_mfu): a systematic tax shifts the minimum
+    too, while GC/loopback noise only inflates it."""
+    from gpushare_device_plugin_tpu.utils.tracing import STORE, TRACER
+
+    record: dict = {
+        "metric": "trace_overhead", "workers": workers, "trials": trials,
+    }
+    results: dict = {
+        "untraced": {"p50": [], "p99": []},
+        "traced": {"p50": [], "p99": []},
+    }
+    try:
+        run_concurrent_trial(workers, rounds=rounds, wal_mode="off")  # warmup
+        for _ in range(trials):
+            for mode, ratio in (("untraced", 0.0), ("traced", 1.0)):
+                TRACER.configure(sample_ratio=ratio)
+                trial = run_concurrent_trial(
+                    workers, rounds=rounds, wal_mode="off"
+                )
+                if trial["p50_ms"] is not None:
+                    results[mode]["p50"].append(trial["p50_ms"])
+                if trial["p99_ms"] is not None:
+                    results[mode]["p99"].append(trial["p99_ms"])
+    finally:
+        TRACER.configure(sample_ratio=1.0)
+    p99 = {}
+    for mode in ("untraced", "traced"):
+        p50s, p99s = results[mode]["p50"], results[mode]["p99"]
+        record[mode] = {
+            "sample_ratio": 0.0 if mode == "untraced" else 1.0,
+            "p50_ms": round(min(p50s), 3) if p50s else None,
+            "p99_ms": round(min(p99s), 3) if p99s else None,
+            "p99_ms_trials": p99s,
+        }
+        p99[mode] = record[mode]["p99_ms"]
+        print(
+            f"trace={mode}: p50={record[mode]['p50_ms']}ms "
+            f"p99={record[mode]['p99_ms']}ms (trials {p99s})",
+            file=sys.stderr,
+        )
+    record["traced_store_traces"] = len(STORE.trace_ids())
+    if p99.get("untraced") and p99.get("traced") is not None:
+        overhead = 100.0 * (p99["traced"] - p99["untraced"]) / p99["untraced"]
+        record["p99_overhead_pct"] = round(overhead, 1)
+    record["gate_pct"] = TRACE_OVERHEAD_PCT
+    print(json.dumps(record))
+    overhead = record.get("p99_overhead_pct")
+    if overhead is None:
+        print("TRACE BENCH: not enough samples for p99", file=sys.stderr)
+        return 1
+    if overhead > TRACE_OVERHEAD_PCT:
+        print(
+            f"TRACE OVERHEAD GUARD FAILED: traced p99 "
+            f"{p99['traced']:.3f}ms is {overhead:+.1f}% vs untraced "
+            f"{p99['untraced']:.3f}ms (gate {TRACE_OVERHEAD_PCT:.0f}%)",
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"trace overhead: p99 {overhead:+.1f}% (gate {TRACE_OVERHEAD_PCT:.0f}%)",
+        file=sys.stderr,
+    )
+    return 0
+
+
 def main(argv=None) -> int:
     args = parse_args(argv)
     repo = Path(__file__).resolve().parent
+    if args.no_trace:
+        from gpushare_device_plugin_tpu.utils.tracing import TRACER
+
+        TRACER.configure(sample_ratio=0.0)
+    if args.trace_bench:
+        return run_trace_bench(max(1, args.workers))
     if args.wal_bench:
         return run_wal_bench(
             max(1, args.workers), wal_window_s=args.wal_window_ms / 1000.0

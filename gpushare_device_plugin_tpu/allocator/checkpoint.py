@@ -74,6 +74,7 @@ from ..utils.batch import GroupBatcher
 from ..utils.faults import FAULTS
 from ..utils.log import get_logger
 from ..utils.metrics import REGISTRY
+from ..utils.tracing import TRACER
 from .assume import AssumeCache, PodKey
 from ..utils.lockrank import make_lock, make_rlock
 
@@ -415,7 +416,12 @@ class AllocationCheckpoint:
             # death here must replay as if begin never happened
             FAULTS.fire("checkpoint.wal_queue")
             try:
-                ticket.wait()
+                # The group-commit gather window as a child span of the
+                # admission's wal.begin: a trace shows exactly how much
+                # of an admission's latency was spent waiting for its
+                # batch's fsync (no-op outside a sampled trace).
+                with TRACER.span("wal.batch_wait", child_only=True):
+                    ticket.wait()
             except (OSError, RuntimeError) as e:
                 # the batch fsync failed (sick disk): degrade to
                 # unjournaled operation like the always path does
@@ -478,7 +484,8 @@ class AllocationCheckpoint:
         if ticket is not None:
             while True:
                 try:
-                    ticket.wait()
+                    with TRACER.span("wal.batch_wait", child_only=True):
+                        ticket.wait()
                 except (OSError, RuntimeError) as e:
                     # The resolve record may never hit disk: the entry
                     # stays pending, replays as unresolved at restart, and

@@ -58,6 +58,7 @@ from ..topology import ChipTopology, format_shape, pad3, parse_shape, shape_size
 from ..utils.faults import FAULTS
 from ..utils.log import get_logger
 from ..utils.metrics import timed_acquire
+from ..utils.tracing import TRACER, parse_context
 from .assume import LOCK_WAIT_HELP, LOCK_WAIT_METRIC, AssumeCache, PodKey
 from .checkpoint import AllocationCheckpoint, StaleDaemonError
 from .binpack import assign_chip
@@ -79,6 +80,17 @@ NUM_MATCH_STRIPES = 8
 
 def _pod_key(pod) -> PodKey:
     return P.namespace(pod), P.name(pod)
+
+
+def _adopt_pod_trace(pod) -> None:
+    """Stitch this Allocate into the extender's admission trace: the pod
+    identity is only known after the match, so the open span stack is
+    re-parented under the bind-span context the extender recorded in the
+    ``tpushare.aliyun.com/trace-id`` annotation (no-op for branch-B pods
+    the extender never touched, and for garbled annotations)."""
+    TRACER.adopt_current_trace(
+        parse_context(P.annotations(pod).get(const.ANN_TRACE_ID))
+    )
 
 
 def _counted_by_source(pod_source, key: PodKey) -> bool:
@@ -263,47 +275,62 @@ class ClusterAllocator:
         pod_units = sum(len(ids) for ids in granted)
         container_units = [len(ids) for ids in granted]
         log.v(4, "Allocate: pod_units=%d per-container=%s", pod_units, container_units)
-        with _serial_guard(self._pods, self._assume):
-            placement, pod = self._admit(pod_units)
-        if isinstance(placement, GangPlacement):
-            chips_by_id = {c.id: c for c in self._inv.chips()}
-            members = [
-                chips_by_id[self._inv.id_of_index(i)] for i in placement.chips
-            ]
-            log.info(
-                "allocated gang pod %s/%s: %d units/chip on chips %s (shape %s)",
-                P.namespace(pod), P.name(pod), placement.per_chip,
-                list(placement.chips), placement.shape,
-            )
-            return [
-                build_gang_allocation(
-                    chips=members,
-                    shape=placement.shape,
-                    per_chip_units=placement.per_chip,
-                    chip_total_units=self._chip_total(placement.chips[0]),
-                    pod_units=pod_units,
-                    container_units=n,
-                    disable_isolation=self._disable_isolation,
+        # The allocator's admission span: match through env injection.
+        # Nests under the plugin server's gRPC-entry span when driven by
+        # kubelet; once the pod is matched, _admit adopts the extender's
+        # trace context off the pod annotation and the whole open stack
+        # re-parents under the bind span — one stitched trace across the
+        # two processes.
+        with TRACER.span(
+            "allocator.admit",
+            attributes={"resource": const.RESOURCE_MEM, "pod_units": pod_units},
+        ) as asp:
+            with _serial_guard(self._pods, self._assume):
+                placement, pod = self._admit(pod_units)
+            asp.set_attribute("pod", f"{P.namespace(pod)}/{P.name(pod)}")
+            with TRACER.span("allocator.env", child_only=True):
+                if isinstance(placement, GangPlacement):
+                    asp.set_attribute("chips", list(placement.chips))
+                    chips_by_id = {c.id: c for c in self._inv.chips()}
+                    members = [
+                        chips_by_id[self._inv.id_of_index(i)]
+                        for i in placement.chips
+                    ]
+                    log.info(
+                        "allocated gang pod %s/%s: %d units/chip on chips %s (shape %s)",
+                        P.namespace(pod), P.name(pod), placement.per_chip,
+                        list(placement.chips), placement.shape,
+                    )
+                    return [
+                        build_gang_allocation(
+                            chips=members,
+                            shape=placement.shape,
+                            per_chip_units=placement.per_chip,
+                            chip_total_units=self._chip_total(placement.chips[0]),
+                            pod_units=pod_units,
+                            container_units=n,
+                            disable_isolation=self._disable_isolation,
+                        )
+                        for n in container_units
+                    ]
+                idx = placement
+                asp.set_attribute("chip", idx)
+                chip = self._inv.chip_by_id(self._inv.id_of_index(idx))
+                total = self._chip_total(idx)
+                log.info(
+                    "allocated pod %s/%s: %d units on chip %d (%s)",
+                    P.namespace(pod), P.name(pod), pod_units, idx, chip.id,
                 )
-                for n in container_units
-            ]
-        idx = placement
-        chip = self._inv.chip_by_id(self._inv.id_of_index(idx))
-        total = self._chip_total(idx)
-        log.info(
-            "allocated pod %s/%s: %d units on chip %d (%s)",
-            P.namespace(pod), P.name(pod), pod_units, idx, chip.id,
-        )
-        return [
-            build_mem_allocation(
-                chip=chip,
-                chip_total_units=total,
-                pod_units=pod_units,
-                container_units=n,
-                disable_isolation=self._disable_isolation,
-            )
-            for n in container_units
-        ]
+                return [
+                    build_mem_allocation(
+                        chip=chip,
+                        chip_total_units=total,
+                        pod_units=pod_units,
+                        container_units=n,
+                        disable_isolation=self._disable_isolation,
+                    )
+                    for n in container_units
+                ]
 
     def _admit(self, pod_units: int):
         """Match, place, journal, persist; -> (chip index, the matched pod).
@@ -317,10 +344,12 @@ class ClusterAllocator:
         the reconciler resolves against the apiserver.
         """
         pod = self._claim_pod(pod_units)
+        _adopt_pod_trace(pod)
         try:
             try:
                 for attempt in (0, 1):
-                    placement, annotations = self._place(pod, pod_units)
+                    with TRACER.span("allocator.place", child_only=True):
+                        placement, annotations = self._place(pod, pod_units)
                     key = _pod_key(pod)
                     if isinstance(placement, GangPlacement):
                         journal = {
@@ -337,17 +366,21 @@ class ClusterAllocator:
                             "units": pod_units,
                             "annotations": annotations,
                         }
-                    _journal_begin(self._ckpt, key, journal)
+                    with TRACER.span("wal.begin", child_only=True):
+                        _journal_begin(self._ckpt, key, journal)
                     try:
-                        self._persist(pod, annotations)
+                        with TRACER.span("pod.patch", child_only=True):
+                            self._persist(pod, annotations)
                         FAULTS.fire("allocator.post_persist")
-                        _journal_resolve(self._ckpt, key, "commit")
+                        with TRACER.span("wal.commit", child_only=True):
+                            _journal_resolve(self._ckpt, key, "commit")
                         break
                     except _PodGone:
                         # The matched pod was deleted with its cache entry
                         # still live — evict it and re-match so a live
                         # same-size pod is not failed for a ghost's sake.
-                        _journal_resolve(self._ckpt, key, "abort")
+                        with TRACER.span("wal.abort", child_only=True):
+                            _journal_resolve(self._ckpt, key, "abort")
                         log.warning(
                             "pod %s/%s vanished during persist; re-matching",
                             P.namespace(pod), P.name(pod),
@@ -361,9 +394,11 @@ class ClusterAllocator:
                                 f"requesting {pod_units} {const.RESOURCE_MEM}"
                             ) from None
                         pod = self._claim_pod(pod_units, refresh_first=True)
+                        _adopt_pod_trace(pod)
                     except AllocationFailure:
                         # the PATCH conclusively failed — nothing persisted
-                        _journal_resolve(self._ckpt, key, "abort")
+                        with TRACER.span("wal.abort", child_only=True):
+                            _journal_resolve(self._ckpt, key, "abort")
                         raise
             except AllocationFailure as e:
                 # kubelet only logs the gRPC error; a Warning event on the
@@ -707,27 +742,34 @@ class ClusterCoreAllocator:
             raise AllocationFailure(f"granted unknown chip id: {e}") from e
         indices = sorted(i for ids in per_container for i in ids)
         log.v(4, "core Allocate: chips %s", indices)
-        with _serial_guard(self._pods, self._assume):
-            pod = self._admit(total, indices)
-        log.info(
-            "allocated core pod %s/%s: chips %s",
-            P.namespace(pod), P.name(pod), indices,
-        )
-        chips_by_id = {c.id: c for c in self._inv.chips()}
-        return [
-            build_core_allocation(
-                chips=[chips_by_id[self._inv.id_of_index(i)] for i in ids],
-                process_bounds=getattr(self._topo, "process_bounds", ""),
-                chips_per_process_bounds=getattr(
-                    self._topo, "chips_per_process_bounds", ""
-                ),
+        with TRACER.span(
+            "allocator.admit",
+            attributes={"resource": const.RESOURCE_CORE, "chips": indices},
+        ) as asp:
+            with _serial_guard(self._pods, self._assume):
+                pod = self._admit(total, indices)
+            asp.set_attribute("pod", f"{P.namespace(pod)}/{P.name(pod)}")
+            log.info(
+                "allocated core pod %s/%s: chips %s",
+                P.namespace(pod), P.name(pod), indices,
             )
-            for ids in per_container
-        ]
+            with TRACER.span("allocator.env", child_only=True):
+                chips_by_id = {c.id: c for c in self._inv.chips()}
+                return [
+                    build_core_allocation(
+                        chips=[chips_by_id[self._inv.id_of_index(i)] for i in ids],
+                        process_bounds=getattr(self._topo, "process_bounds", ""),
+                        chips_per_process_bounds=getattr(
+                            self._topo, "chips_per_process_bounds", ""
+                        ),
+                    )
+                    for ids in per_container
+                ]
 
     def _admit(self, total: int, indices: list[int]):
         """Match, validate+reserve, persist; -> the matched pod."""
         pod = self._claim_pod(total)
+        _adopt_pod_trace(pod)
         try:
             try:
                 # Validation runs per attempt: a pod re-matched after
@@ -749,25 +791,30 @@ class ClusterCoreAllocator:
                         const.ENV_ASSUME_TIME: str(time.time_ns()),
                     }
                     key = _pod_key(pod)
-                    _journal_begin(self._ckpt, key, {
-                        "kind": "core",
-                        "ids": list(indices),
-                        "units": total,
-                        "annotations": annotations,
-                    })
+                    with TRACER.span("wal.begin", child_only=True):
+                        _journal_begin(self._ckpt, key, {
+                            "kind": "core",
+                            "ids": list(indices),
+                            "units": total,
+                            "annotations": annotations,
+                        })
                     try:
-                        persist_pod_assignment(
-                            self._api, self._pods, pod, annotations,
-                            const.LABEL_CORE_VALUE, patch_fn=self._patcher,
-                        )
+                        with TRACER.span("pod.patch", child_only=True):
+                            persist_pod_assignment(
+                                self._api, self._pods, pod, annotations,
+                                const.LABEL_CORE_VALUE, patch_fn=self._patcher,
+                            )
                         FAULTS.fire("allocator.post_persist")
-                        _journal_resolve(self._ckpt, key, "commit")
+                        with TRACER.span("wal.commit", child_only=True):
+                            _journal_resolve(self._ckpt, key, "commit")
                         break
                     except AllocationFailure:
-                        _journal_resolve(self._ckpt, key, "abort")
+                        with TRACER.span("wal.abort", child_only=True):
+                            _journal_resolve(self._ckpt, key, "abort")
                         raise
                     except _PodGone:
-                        _journal_resolve(self._ckpt, key, "abort")
+                        with TRACER.span("wal.abort", child_only=True):
+                            _journal_resolve(self._ckpt, key, "abort")
                         log.warning(
                             "core pod %s/%s vanished during persist; re-matching",
                             P.namespace(pod), P.name(pod),
@@ -783,6 +830,7 @@ class ClusterCoreAllocator:
                                 f"{total} {const.RESOURCE_CORE}"
                             ) from None
                         pod = self._claim_pod(total, refresh_first=True)
+                        _adopt_pod_trace(pod)
             except AllocationFailure as e:
                 if pod is not None:
                     emit_pod_event(

@@ -76,8 +76,22 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--node-name", default=os.environ.get("NODE_NAME", ""))
     p.add_argument("--coredump-dir", default="/etc/kubernetes")
     p.add_argument("--metrics-port", type=int, default=0,
-                   help="serve Prometheus /metrics on this port (0 = off; "
-                   "the reference had no metrics at all)")
+                   help="serve Prometheus /metrics (+ /traces OTLP-JSON) "
+                   "on this port (0 = off; the reference had no metrics "
+                   "at all)")
+    # observability (docs/observability.md)
+    p.add_argument("--trace-sample", type=float, default=1.0,
+                   help="admission-trace sample ratio in [0,1]: each "
+                   "Allocate's trace is kept with this probability; 0 "
+                   "disables and the unsampled hot path costs O(ns). "
+                   "Run the extender at the SAME ratio — each process "
+                   "samples its own half, so mismatched ratios produce "
+                   "partial traces")
+    p.add_argument("--flightrecord-dir", default="",
+                   help="crash/postmortem flight-recorder directory "
+                   "(last N admission traces + recent log ring, dumped "
+                   "on SIGUSR1, fatal exit, and injected crash sites); "
+                   "default is the coredump dir, 'none' disables")
     # degraded-mode knobs (docs/robustness.md)
     p.add_argument("--breaker-threshold", type=int, default=5,
                    help="consecutive apiserver failures before the circuit "
@@ -135,6 +149,15 @@ def main(argv=None) -> int:
     if FAULTS.install_from_env():
         log.warning("fault injection ACTIVE at points: %s", FAULTS.active())
 
+    from ..utils.tracing import TRACER
+
+    TRACER.configure(sample_ratio=args.trace_sample)
+    flightrecord_dir = args.flightrecord_dir
+    if flightrecord_dir == "none":
+        flightrecord_dir = ""
+    elif not flightrecord_dir:
+        flightrecord_dir = args.coredump_dir
+
     backend = from_name(args.discovery)
     # WAL default: on in cluster mode, under the plugin dir (a hostPath in
     # every real deployment, so the journal outlives the container).
@@ -161,6 +184,7 @@ def main(argv=None) -> int:
         patch_coalesce=not args.no_patch_coalesce,
         reconcile_interval_s=args.reconcile_interval,
         drain_timeout_s=args.drain_timeout,
+        flightrecord_dir=flightrecord_dir,
     )
 
     api_client = None

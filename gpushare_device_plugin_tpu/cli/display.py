@@ -83,6 +83,111 @@ def _gang_cell(pod, info: NodeInfo, unit: str) -> str:
     return f"{pod.gang_shape} @ {coords} · {pod.gang_per_chip} {unit}/chip"
 
 
+def render_trace(spans: list[dict]) -> str:
+    """Render one admission/serving trace as an offset/duration tree.
+
+    ``spans`` are flat span dicts (``utils.tracing.spans_from_otlp`` /
+    ``Span.to_dict``); offsets are milliseconds from the earliest span's
+    start. Orphans (parent span not in the set — e.g. only one process's
+    ``/traces`` endpoint was reachable) render as extra roots, so a
+    partial fetch still shows a timeline. Deterministic for a given span
+    set (golden-tested)."""
+    if not spans:
+        return "(no spans)\n"
+    by_id = {s["span_id"]: s for s in spans if s.get("span_id")}
+    children: dict[str, list[dict]] = {}
+    roots: list[dict] = []
+    for s in spans:
+        parent = s.get("parent_id", "")
+        if parent and parent in by_id:
+            children.setdefault(parent, []).append(s)
+        else:
+            roots.append(s)
+    t0 = min(s["start_ns"] for s in spans)
+    buf = StringIO()
+    trace_ids = sorted({s.get("trace_id", "") for s in spans})
+    buf.write(f"trace {', '.join(t for t in trace_ids if t)}\n")
+
+    def attr_note(s: dict) -> str:
+        attrs = s.get("attributes") or {}
+        parts = []
+        for key in ("pod", "node", "chip", "chips", "rid", "error"):
+            if key in attrs:
+                parts.append(f"{key}={attrs[key]}")
+        if s.get("status") not in (None, "ok"):
+            parts.append(f"status={s['status']}")
+        return ("  " + " ".join(parts)) if parts else ""
+
+    def emit(s: dict, prefix: str, tail: str, child_prefix: str) -> None:
+        start_ms = (s["start_ns"] - t0) / 1e6
+        dur_ms = max(0, s.get("end_ns", 0) - s["start_ns"]) / 1e6
+        name = f"{prefix}{tail}{s.get('name', '?')}"
+        buf.write(
+            f"{name:<44} +{start_ms:9.3f}ms {dur_ms:9.3f}ms{attr_note(s)}\n"
+        )
+        kids = sorted(
+            children.get(s.get("span_id", ""), ()),
+            key=lambda c: (c["start_ns"], c.get("name", "")),
+        )
+        for i, kid in enumerate(kids):
+            last = i == len(kids) - 1
+            emit(
+                kid,
+                prefix + child_prefix,
+                "└─ " if last else "├─ ",
+                "   " if last else "│  ",
+            )
+
+    for root in sorted(roots, key=lambda s: (s["start_ns"], s.get("name", ""))):
+        emit(root, "", "", "")
+    return buf.getvalue()
+
+
+def render_flightrecord(doc: dict, max_traces: int = 5, max_logs: int = 20) -> str:
+    """Human summary of a flight-record dump (utils/flightrec.py): the
+    header, the most recent traces as timeline trees, and the tail of
+    the log ring with trace correlation."""
+    import datetime
+
+    from ..utils.tracing import spans_from_otlp
+
+    buf = StringIO()
+    when = datetime.datetime.fromtimestamp(
+        doc.get("time_unix", 0), tz=datetime.timezone.utc
+    ).strftime("%Y-%m-%d %H:%M:%S UTC")
+    buf.write(f"flight record: reason={doc.get('reason', '?')}\n")
+    buf.write(f"captured     : {when} (pid {doc.get('pid', '?')})\n")
+    buf.write(
+        f"traces       : {doc.get('trace_count', 0)} retained, "
+        f"{doc.get('dropped_traces', 0)} older evicted\n"
+    )
+    spans = spans_from_otlp(doc.get("traces") or {})
+    by_trace: dict[str, list[dict]] = {}
+    for s in spans:
+        by_trace.setdefault(s["trace_id"], []).append(s)
+    # newest last in store order; show the most recent max_traces
+    shown = list(by_trace.items())[-max_traces:]
+    if len(by_trace) > len(shown):
+        buf.write(f"(showing the last {len(shown)} of {len(by_trace)} traces)\n")
+    for _tid, tspans in shown:
+        buf.write("\n")
+        buf.write(render_trace(tspans))
+    logs = doc.get("logs") or []
+    if logs:
+        buf.write(f"\nlast {min(max_logs, len(logs))} log records:\n")
+        for entry in logs[-max_logs:]:
+            trace = (
+                f" [{entry['trace_id'][:8]}/{entry['span_id'][:8]}]"
+                if entry.get("trace_id")
+                else ""
+            )
+            buf.write(
+                f"  {entry.get('level', '?'):<8} {entry.get('logger', '?')}"
+                f"{trace} {entry.get('message', '')}\n"
+            )
+    return buf.getvalue()
+
+
 def render_details(infos: list[NodeInfo]) -> str:
     unit = infer_unit(infos)
     buf = StringIO()

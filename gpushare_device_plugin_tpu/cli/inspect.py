@@ -1,19 +1,35 @@
-"""``kubectl-inspect-tpushare``: cluster TPU-share utilization report.
+"""``kubectl-inspect-tpushare``: cluster TPU-share utilization report,
+admission-trace timelines, and flight-record postmortems.
 
 Reference: ``cmd/inspect/main.go:31-74`` — optional node-name argument
 narrows the report; ``-d`` shows per-pod details. Reads only the apiserver
 (kubeconfig from ``$KUBECONFIG``/``~/.kube/config``, else in-cluster), with
 the reference CLI's 5 x 100 ms list retry budget (``podinfo.go:24,64-69``).
+
+Observability subcommands (docs/observability.md):
+
+- ``inspect trace [ns/]pod --traces-url http://node:PORT [...]`` — read
+  the pod's ``tpushare.aliyun.com/trace-id`` annotation, fetch the trace
+  from each given ``/traces`` endpoint (the extender's and the node
+  daemon's metrics ports), merge, and render the admission timeline.
+- ``inspect flightrecord <file>`` — summarize a flight-recorder dump.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 
+from .. import const
 from ..cluster.apiserver import ApiServerClient
 from ..utils.retry import retry
-from .display import render_details, render_summary
+from .display import (
+    render_details,
+    render_flightrecord,
+    render_summary,
+    render_trace,
+)
 from .nodeinfo import build_all_node_infos
 
 LIST_RETRIES = 5
@@ -34,7 +50,123 @@ def gather(client: ApiServerClient, node_name: str = "") -> tuple[list, list]:
     return nodes, pods
 
 
+def fetch_trace_spans(urls: list[str], trace_id: str) -> list[dict]:
+    """Fetch + merge one trace from every ``/traces`` endpoint given
+    (extender and node daemon each hold their process's half; spans are
+    deduped by span id). Unreachable endpoints are reported but do not
+    fail the merge — a partial timeline beats none."""
+    import requests
+
+    from ..utils.tracing import spans_from_otlp
+
+    spans: dict[str, dict] = {}
+    for url in urls:
+        full = url.rstrip("/")
+        if not full.endswith("/traces"):
+            full += "/traces"
+        try:
+            resp = requests.get(full, params={"trace_id": trace_id}, timeout=10)
+            resp.raise_for_status()
+            doc = resp.json()
+        except Exception as e:  # noqa: BLE001 — partial merge by design
+            print(f"warning: {full} unreachable: {e}", file=sys.stderr)
+            continue
+        for span in spans_from_otlp(doc):
+            spans.setdefault(span["span_id"], span)
+    return sorted(spans.values(), key=lambda s: (s["start_ns"], s["name"]))
+
+
+def trace_main(argv: list[str]) -> int:
+    p = argparse.ArgumentParser(
+        prog="kubectl-inspect-tpushare trace",
+        description="Render one pod's admission trace timeline",
+    )
+    p.add_argument("pod", help="[namespace/]name of an admitted share pod")
+    p.add_argument("--traces-url", action="append", default=[],
+                   help="a /traces endpoint to fetch spans from (the "
+                   "extender's and/or node daemon's --metrics-port); "
+                   "repeatable — spans from all endpoints are merged")
+    p.add_argument("-o", "--output", default="tree", choices=["tree", "json"])
+    args = p.parse_args(argv)
+    ns, _, name = args.pod.rpartition("/")
+    ns = ns or "default"
+    try:
+        pod = _client().get_pod(ns, name)
+    except Exception as e:  # config errors / 404
+        print(f"error: cannot read pod {ns}/{name}: {e}", file=sys.stderr)
+        return 1
+    raw = (pod.get("metadata", {}).get("annotations") or {}).get(
+        const.ANN_TRACE_ID
+    )
+    if not raw:
+        print(
+            f"error: pod {ns}/{name} carries no {const.ANN_TRACE_ID} "
+            "annotation (admitted before tracing, branch-B placement "
+            "without the extender, or the trace was not sampled)",
+            file=sys.stderr,
+        )
+        return 1
+    trace_id = raw.split(":", 1)[0]
+    if not args.traces_url:
+        print(
+            f"trace id: {trace_id}\n"
+            "error: no --traces-url given — point me at the extender's "
+            "and/or node daemon's metrics port (e.g. "
+            "--traces-url http://node:9114)",
+            file=sys.stderr,
+        )
+        return 1
+    spans = fetch_trace_spans(args.traces_url, trace_id)
+    if not spans:
+        print(f"error: no spans found for trace {trace_id}", file=sys.stderr)
+        return 1
+    if args.output == "json":
+        json.dump(spans, sys.stdout, indent=2)
+        print()
+        return 0
+    sys.stdout.write(f"pod {ns}/{name}\n")
+    sys.stdout.write(render_trace(spans))
+    return 0
+
+
+def flightrecord_main(argv: list[str]) -> int:
+    p = argparse.ArgumentParser(
+        prog="kubectl-inspect-tpushare flightrecord",
+        description="Summarize a flight-recorder dump file",
+    )
+    p.add_argument("path", help="a tpushare-flightrec-*.json dump")
+    p.add_argument("-o", "--output", default="summary",
+                   choices=["summary", "json"])
+    p.add_argument("--max-traces", type=int, default=5)
+    p.add_argument("--max-logs", type=int, default=20)
+    args = p.parse_args(argv)
+    from ..utils.flightrec import load_dump
+
+    try:
+        doc = load_dump(args.path)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"error: cannot read flight record: {e}", file=sys.stderr)
+        return 1
+    if args.output == "json":
+        json.dump(doc, sys.stdout, indent=2)
+        print()
+        return 0
+    sys.stdout.write(
+        render_flightrecord(
+            doc, max_traces=args.max_traces, max_logs=args.max_logs
+        )
+    )
+    return 0
+
+
 def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    # Subcommand dispatch ahead of the legacy flat interface: the node
+    # positional stays `inspect [node]`, observability verbs get words.
+    if argv and argv[0] == "trace":
+        return trace_main(argv[1:])
+    if argv and argv[0] == "flightrecord":
+        return flightrecord_main(argv[1:])
     p = argparse.ArgumentParser(
         prog="kubectl-inspect-tpushare",
         description="Display TPU-share HBM utilization across the cluster",

@@ -92,6 +92,15 @@ ENV_XLA_PYTHON_PREALLOCATE = "XLA_PYTHON_CLIENT_PREALLOCATE"
 # label cgpu.disable.isolation=true read at podmanager.go:59-72).
 LABEL_DISABLE_ISOLATION = "ctpu.disable.isolation"
 
+# --- Tracing (utils/tracing.py) --------------------------------------------
+# "trace_id:span_id" of the admission trace, written by the scheduler
+# extender with its bind annotations and adopted by the device plugin's
+# allocator after the pod match — the cross-process stitch that makes
+# filter -> bind -> WAL -> PATCH -> Allocate -> env one trace. Must stay
+# equal to utils.tracing.TRACE_ANNOTATION (that module is import-light
+# by design; test_tracing pins the two strings agree).
+ANN_TRACE_ID = "tpushare.aliyun.com/trace-id"
+
 # --- Scheduler-extender annotation (reference: cmd/inspect/main.go:23) -----
 # JSON map[containerName]map[chipIdx]memUnits written by the extender at bind
 # time; the inspect CLI prefers it for per-chip attribution.

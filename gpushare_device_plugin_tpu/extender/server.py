@@ -31,6 +31,7 @@ from ..cluster import pods as P
 from ..cluster.apiserver import ApiError, ApiServerClient
 from ..utils.log import get_logger
 from ..utils import log as logutil
+from ..utils.tracing import ADMISSIONS, TRACER, SpanContext
 from . import logic
 from .index import ClusterUsageIndex
 from ..utils.lockrank import make_rlock
@@ -381,13 +382,32 @@ class ExtenderCore:
 
     # --- webhook verbs ----------------------------------------------------
 
+    def _admission_ctx(self, pod: dict) -> SpanContext | None:
+        """The pod's admission-trace root context (created on first
+        touch): what stitches the scheduler's separate filter/prioritize/
+        bind webhook calls into ONE trace per admission. None for
+        anonymous pods and unsampled traces — every verb span is
+        ``child_only``, so None means the verb records nothing."""
+        meta = pod.get("metadata", {}) if pod else {}
+        name = meta.get("name", "")
+        if not name:
+            return None
+        return ADMISSIONS.root(meta.get("namespace", "default"), name)
+
     def filter(self, args: dict) -> dict:
         pod = args.get("pod") or {}
         nodes = self._nodes_from_args(args)
+        ctx = self._admission_ctx(pod)
         try:
-            fits, failed = logic.filter_with_views(
-                pod, nodes, self._node_views
-            )
+            with TRACER.span(
+                "extender.filter", parent=ctx, child_only=True,
+                attributes={"nodes": len(nodes)},
+            ) as sp:
+                fits, failed = logic.filter_with_views(
+                    pod, nodes, self._node_views
+                )
+                sp.set_attribute("fits", len(fits))
+                sp.set_attribute("failed", len(failed))
         finally:
             self._drain_expired_aborts()
         log.v(4, "filter %s: fits=%s failed=%s",
@@ -404,10 +424,16 @@ class ExtenderCore:
     def prioritize(self, args: dict) -> list[dict]:
         pod = args.get("pod") or {}
         nodes = self._nodes_from_args(args)
+        ctx = self._admission_ctx(pod)
         try:
-            scores = logic.prioritize_with_views(
-                pod, nodes, self._node_views, policy=self._policy
-            )
+            with TRACER.span(
+                "extender.prioritize", parent=ctx, child_only=True,
+                attributes={"nodes": len(nodes)},
+            ) as sp:
+                scores = logic.prioritize_with_views(
+                    pod, nodes, self._node_views, policy=self._policy
+                )
+                sp.set_attribute("scored", len(scores))
         finally:
             self._drain_expired_aborts()
         return [{"host": host, "score": score} for host, score in scores.items()]
@@ -432,12 +458,18 @@ class ExtenderCore:
                 "error": "",
             }
         request = P.mem_units_of_pod(pod, resource=resource)
+        ctx = self._admission_ctx(pod)
         try:
-            views = self._node_views(resource, nodes)
-            fits, failed, scores = logic.evaluate_filter_and_scores(
-                request, views, policy=self._policy,
-                gang_shape=logic.pod_gang_shape(pod, resource),
-            )
+            with TRACER.span(
+                "extender.batch", parent=ctx, child_only=True,
+                attributes={"nodes": len(nodes)},
+            ) as sp:
+                views = self._node_views(resource, nodes)
+                fits, failed, scores = logic.evaluate_filter_and_scores(
+                    request, views, policy=self._policy,
+                    gang_shape=logic.pod_gang_shape(pod, resource),
+                )
+                sp.set_attribute("fits", len(fits))
         finally:
             self._drain_expired_aborts()
         fit_set = set(fits)
@@ -473,8 +505,26 @@ class ExtenderCore:
         ns = args.get("podNamespace", "default")
         name = args.get("podName", "")
         node_name = args.get("node", "")
+        ctx = ADMISSIONS.root(ns, name) if name else None
         try:
-            return self._bind(args, ns, name, node_name)
+            with TRACER.span(
+                "extender.bind", parent=ctx, child_only=True,
+                attributes={"node": node_name},
+            ) as bsp:
+                result = self._bind(args, ns, name, node_name, bsp)
+                if result.get("error"):
+                    bsp.set_attribute("bind_error", result["error"])
+                    bsp.end("error")
+        except BaseException:
+            if name:
+                ADMISSIONS.finish(ns, name, "error")
+            raise
+        else:
+            if name:
+                ADMISSIONS.finish(
+                    ns, name, "error" if result.get("error") else "ok"
+                )
+            return result
         finally:
             # failure paths included: keys queued by _live_inflight()
             # during this verb must not wait for some later verb (an
@@ -482,7 +532,9 @@ class ExtenderCore:
             # entries as stale reservations)
             self._drain_expired_aborts()
 
-    def _bind(self, args: dict, ns: str, name: str, node_name: str) -> dict:
+    def _bind(
+        self, args: dict, ns: str, name: str, node_name: str, bsp: Any
+    ) -> dict:
         try:
             pod = self._api.get_pod(ns, name)
             node = self._api.get_node(node_name)
@@ -497,52 +549,61 @@ class ExtenderCore:
             raw_pods = (
                 None if self._use_index() else self._fetch_cluster_pods()
             )
-            with self._lock:
-                if raw_pods is None:
-                    view = self._views_from_index(resource, [node])[0]
-                else:
-                    view = self._views_from_pods(
-                        resource, [node], raw_pods
-                    )[0]
-                if gang_shape:
-                    # gang bind: ONE decision covering every member chip,
-                    # reserved whole in the in-flight overlay before any
-                    # network write — all-or-nothing from the first moment
-                    _, chips, per_chip, annotations = (
-                        logic.choose_gang_from_view(
+            with TRACER.span("extender.decide", child_only=True) as dsp:
+                with self._lock:
+                    if raw_pods is None:
+                        view = self._views_from_index(resource, [node])[0]
+                    else:
+                        view = self._views_from_pods(
+                            resource, [node], raw_pods
+                        )[0]
+                    if gang_shape:
+                        # gang bind: ONE decision covering every member
+                        # chip, reserved whole in the in-flight overlay
+                        # before any network write — all-or-nothing from
+                        # the first moment
+                        _, chips, per_chip, annotations = (
+                            logic.choose_gang_from_view(
+                                pod, view, policy=self._policy
+                            )
+                        )
+                        idx, units = chips[0], per_chip
+                    else:
+                        chips = ()
+                        _, idx, annotations = logic.choose_chip_from_view(
                             pod, view, policy=self._policy
                         )
+                        units = P.mem_units_of_pod(pod, resource=resource)
+                    self._inflight[(ns, name)] = _Inflight(
+                        node=node_name,
+                        resource=resource,
+                        idx=idx,
+                        units=units,
+                        annotations=annotations,
+                        stamp=time.monotonic(),
+                        chips=tuple(chips),
                     )
-                    idx, units = chips[0], per_chip
-                else:
-                    chips = ()
-                    _, idx, annotations = logic.choose_chip_from_view(
-                        pod, view, policy=self._policy
-                    )
-                    units = P.mem_units_of_pod(pod, resource=resource)
-                self._inflight[(ns, name)] = _Inflight(
-                    node=node_name,
-                    resource=resource,
-                    idx=idx,
-                    units=units,
-                    annotations=annotations,
-                    stamp=time.monotonic(),
-                    chips=tuple(chips),
-                )
+                dsp.set_attribute("chip", list(chips) if chips else idx)
+            # The bind span's context rides the PATCH as the trace-id
+            # annotation: the device plugin's allocator adopts it after
+            # the pod match, stitching the two processes into one trace.
+            if bsp.recording:
+                annotations[logic.const.ANN_TRACE_ID] = bsp.context().encode()
             # WAL begin before the PATCH/Binding: a crash inside the next
             # block leaves an unresolved entry the restarted extender's
             # warmup serves from (and a journal-less crash would forget).
             seq = None
             if self._ckpt is not None:
-                seq = self._ckpt.begin((ns, name), {
-                    "node": node_name,
-                    "resource": resource,
-                    "idx": idx,
-                    "units": units,
-                    "chips": list(chips),
-                    "annotations": annotations,
-                    "ts": time.time(),  # warmup ages stale entries out by this
-                })
+                with TRACER.span("wal.begin", child_only=True):
+                    seq = self._ckpt.begin((ns, name), {
+                        "node": node_name,
+                        "resource": resource,
+                        "idx": idx,
+                        "units": units,
+                        "chips": list(chips),
+                        "annotations": annotations,
+                        "ts": time.time(),  # warmup ages stale entries out by this
+                    })
                 # stamp the overlay entry with its begin incarnation so a
                 # later TTL expiry aborts exactly this record
                 with self._lock:
@@ -550,8 +611,12 @@ class ExtenderCore:
                     if entry is not None:
                         entry.seq = seq
             try:
-                self._api.patch_pod(ns, name, {"metadata": {"annotations": annotations}})
-                self._api.bind_pod(ns, name, node_name)
+                with TRACER.span("pod.patch", child_only=True):
+                    self._api.patch_pod(
+                        ns, name, {"metadata": {"annotations": annotations}}
+                    )
+                with TRACER.span("pod.bindv1", child_only=True):
+                    self._api.bind_pod(ns, name, node_name)
             except Exception:
                 with self._lock:
                     self._inflight.pop((ns, name), None)
@@ -560,10 +625,12 @@ class ExtenderCore:
                 # the same name), which an unguarded abort would pop. A
                 # degraded begin (seq None) journaled nothing to resolve.
                 if self._ckpt is not None and seq is not None:
-                    self._ckpt.abort((ns, name), seq=seq)
+                    with TRACER.span("wal.abort", child_only=True):
+                        self._ckpt.abort((ns, name), seq=seq)
                 raise
             if self._ckpt is not None and seq is not None:
-                self._ckpt.commit((ns, name), seq=seq)
+                with TRACER.span("wal.commit", child_only=True):
+                    self._ckpt.commit((ns, name), seq=seq)
         except (ApiError, AssignmentError) as e:
             log.warning("bind %s/%s -> %s failed: %s", ns, name, node_name, e)
             from ..cluster.events import REASON_BIND_FAILED, emit_pod_event
@@ -700,10 +767,17 @@ def main(argv: list[str] | None = None) -> int:
                    help="group-commit gather window in milliseconds")
     p.add_argument("--timeout", type=float, default=10.0)
     p.add_argument("--metrics-port", type=int, default=0,
-                   help="serve Prometheus /metrics on this port (0 = off)")
+                   help="serve Prometheus /metrics (+ /traces OTLP-JSON) "
+                   "on this port (0 = off)")
+    p.add_argument("--trace-sample", type=float, default=1.0,
+                   help="admission-trace sample ratio in [0,1]: each "
+                   "pod's filter->bind trace is kept with this "
+                   "probability (0 disables tracing; unsampled "
+                   "admissions pay O(ns))")
     p.add_argument("-v", "--verbosity", type=int, default=0)
     args = p.parse_args(argv)
     logutil.setup(args.verbosity)
+    TRACER.configure(sample_ratio=args.trace_sample)
     metrics_server = None
     if args.metrics_port:
         from ..utils.metrics import MetricsServer

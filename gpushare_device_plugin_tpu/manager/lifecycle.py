@@ -64,6 +64,10 @@ class ManagerConfig:
     reconcile_interval_s: float = 30.0
     # How long graceful shutdown waits for in-flight Allocate calls.
     drain_timeout_s: float = 5.0
+    # Flight-recorder dump directory (utils/flightrec.py): SIGUSR1, fatal
+    # exit, and injected-crash postmortems land here. Empty disables (the
+    # daemon defaults it to the coredump dir).
+    flightrecord_dir: str = ""
 
 
 class TpuShareManager:
@@ -478,6 +482,13 @@ class TpuShareManager:
             )
         except (OSError, ValueError):
             pass
+        # SIGUSR1: live postmortem — dump the flight recorder (last N
+        # admission traces + recent log ring) without disturbing the
+        # daemon, the trace analog of SIGQUIT's stack dump.
+        if self._cfg.flightrecord_dir:
+            from ..utils.flightrec import FLIGHT
+
+            FLIGHT.install_signal_handler()
 
     def trigger_restart(self, reason: str = "") -> None:
         log.info("restart requested (%s)", reason or "socket watcher")
@@ -492,6 +503,12 @@ class TpuShareManager:
 
     def run(self) -> None:
         """Blocking main loop; returns only on stop."""
+        # Flight recorder first: from here on a fatal exit or an injected
+        # crash leaves a postmortem (traces + recent logs) on disk.
+        if self._cfg.flightrecord_dir:
+            from ..utils.flightrec import FLIGHT
+
+            FLIGHT.install(self._cfg.flightrecord_dir)
         if self._build_inventory() is None:
             # No TPUs here: park forever instead of crash-looping, so the
             # DaemonSet stays green on heterogenous fleets

@@ -29,6 +29,7 @@ from ..device.fanout import DeviceInventory, FakeDevice
 from ..discovery.base import ChipHealth
 from ..utils.log import get_logger
 from ..utils.lockrank import make_condition
+from ..utils.tracing import TRACER
 from .api import (
     DevicePluginServicer,
     DevicePluginStub,
@@ -230,28 +231,39 @@ class TpuSharePlugin(DevicePluginServicer):
         log.v(4, "Allocate: granted id counts %s", [len(g) for g in granted])
         if self._allocate_fn is None:
             context.abort(grpc.StatusCode.FAILED_PRECONDITION, "allocator not bound")
-        t0 = time.perf_counter()
-        try:
-            allocations = self._allocate_fn(granted)
-        except Exception as e:  # business errors -> admission failure
-            log.warning("Allocate failed: %s", e)
+        # The admission's plugin-process root span: the kubelet-facing
+        # gRPC entry. The allocator's spans nest under it, and once the
+        # pod is matched its trace-id annotation re-parents this whole
+        # stack under the extender's bind span (one stitched trace). The
+        # latency observation runs inside the span so the histogram
+        # bucket carries this admission's trace id as an exemplar.
+        with TRACER.span(
+            "plugin.allocate",
+            attributes={"resource": self._cfg.resource_name},
+        ) as sp:
+            sp.set_attribute("granted", [len(g) for g in granted])
+            t0 = time.perf_counter()
+            try:
+                allocations = self._allocate_fn(granted)
+            except Exception as e:  # business errors -> admission failure
+                log.warning("Allocate failed: %s", e)
+                REGISTRY.counter_inc(
+                    "tpushare_allocate_total",
+                    "Allocate RPCs by outcome",
+                    resource=self._cfg.resource_name, outcome="error",
+                )
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+            REGISTRY.observe(
+                "tpushare_allocate_seconds",
+                time.perf_counter() - t0,
+                "Allocate placement latency",
+                resource=self._cfg.resource_name,
+            )
             REGISTRY.counter_inc(
                 "tpushare_allocate_total",
                 "Allocate RPCs by outcome",
-                resource=self._cfg.resource_name, outcome="error",
+                resource=self._cfg.resource_name, outcome="ok",
             )
-            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
-        REGISTRY.observe(
-            "tpushare_allocate_seconds",
-            time.perf_counter() - t0,
-            "Allocate placement latency",
-            resource=self._cfg.resource_name,
-        )
-        REGISTRY.counter_inc(
-            "tpushare_allocate_total",
-            "Allocate RPCs by outcome",
-            resource=self._cfg.resource_name, outcome="ok",
-        )
         resp = pb.AllocateResponse()
         for alloc in allocations:
             cresp = resp.container_responses.add()

@@ -54,6 +54,7 @@ import numpy as np
 
 from ..const import MemoryUnit
 from ..parallel.podenv import PodTpuEnv
+from ..utils.tracing import TRACER
 from ..workloads import generate as G
 from ..workloads.transformer import TransformerConfig, shard_params
 
@@ -87,6 +88,11 @@ class RequestResult:
     arrival_s: float = 0.0
     first_token_s: float = 0.0
     finish_s: float = 0.0
+    # slot admission (end of queue wait), both clocks
+    admit_tick: int = -1
+    admit_s: float = 0.0
+    # the request's serve trace (utils.tracing), "" when unsampled
+    trace_id: str = ""
 
     @property
     def ttft_ticks(self) -> float:
@@ -312,6 +318,54 @@ class SlotEngine:
         buf[: len(real)] = real
         return jnp.asarray(buf), len(real)
 
+    def _record_request_trace(self, res: RequestResult, base_ns: int) -> None:
+        """Emit the request's span timeline (queue wait -> prefill chunks
+        -> decode steps -> retire) into the process trace store.
+
+        Reconstructed from the timestamps the engine already collects, at
+        retire time only — the per-token hot loop pays zero tracing cost
+        and the compile-count/bit-identity guarantees are untouched.
+        Unsampled requests (``TRACER.sample_ratio``) record nothing; the
+        warmup's synthetic request (rid < 0) is skipped."""
+        if res.rid < 0:
+            return
+
+        def at(seconds: float) -> int:
+            return base_ns + int(seconds * 1e9)
+
+        ctx = TRACER.record_span(
+            "serve.request", at(res.arrival_s), at(res.finish_s),
+            attributes={
+                "rid": res.rid,
+                "prompt_len": res.prompt_len,
+                "tokens": len(res.tokens),
+                "ttft_ticks": res.ttft_ticks,
+                "slots": self.n_slots,
+            },
+        )
+        if ctx is None:
+            return
+        res.trace_id = ctx.trace_id
+        admit = res.admit_s if res.admit_tick >= 0 else res.arrival_s
+        TRACER.record_span(
+            "serve.queue", at(res.arrival_s), at(admit), parent=ctx,
+            attributes={"wait_ticks": max(0, res.admit_tick - res.arrival_tick)},
+        )
+        chunks = -(-res.prompt_len // self.chunk)
+        TRACER.record_span(
+            "serve.prefill", at(admit), at(res.first_token_s), parent=ctx,
+            attributes={"chunks": chunks, "chunk_width": self.chunk},
+        )
+        TRACER.record_span(
+            "serve.decode", at(res.first_token_s), at(res.finish_s),
+            parent=ctx,
+            attributes={"decode_steps": max(0, len(res.tokens) - 1)},
+        )
+        TRACER.record_span(
+            "serve.retire", at(res.finish_s), at(res.finish_s), parent=ctx,
+            attributes={"finish_tick": res.finish_tick},
+        )
+
     def run(self, requests: Sequence[Request]) -> ServeStats:
         """Serve ``requests`` to completion; returns results + metrics.
 
@@ -332,6 +386,7 @@ class SlotEngine:
         live: dict[int, RequestResult] = {}
         i = 0
         t0 = time.perf_counter()
+        base_ns = time.time_ns()  # wall anchor for the request spans
 
         def now() -> float:
             return time.perf_counter() - t0
@@ -341,6 +396,7 @@ class SlotEngine:
             s.result.finish_tick = self.ticks
             s.result.finish_s = now()
             results.append(s.result)
+            self._record_request_trace(s.result, base_ns)
             slots[idx] = _Slot()
 
         while i < len(incoming) or pending or any(
@@ -364,8 +420,11 @@ class SlotEngine:
             for idx, s in enumerate(slots):
                 if s.state == "free" and pending:
                     req = pending.popleft()
+                    res = live[req.rid]
+                    res.admit_tick = self.ticks
+                    res.admit_s = now()
                     slots[idx] = _Slot(
-                        state="prefill", req=req, done=0, result=live[req.rid]
+                        state="prefill", req=req, done=0, result=res
                     )
 
             pre = [idx for idx, s in enumerate(slots) if s.state == "prefill"]

@@ -170,6 +170,15 @@ class FaultRegistry:
     def __init__(self) -> None:
         self._lock = make_lock("faults.registry")
         self._faults: dict[str, _Fault] = {}
+        # Flight-recorder hook: called (outside the registry lock, the
+        # dump does file I/O) with the point name just before a crash-
+        # mode fault raises SimulatedCrash — so injected kills leave the
+        # same postmortem a production SIGKILL site would.
+        self._crash_hook: Callable[[str], Any] | None = None
+
+    def set_crash_hook(self, hook: Callable[[str], Any] | None) -> None:
+        with self._lock:
+            self._crash_hook = hook
 
     def inject(
         self,
@@ -210,20 +219,33 @@ class FaultRegistry:
         """Called at the injection site. No-op unless the point is armed."""
         if not self._faults:  # fast path: nothing armed anywhere
             return
+        crash: SimulatedCrash | None = None
         with self._lock:
             fault = self._faults.get(point)
             if fault is None:
                 return
-            # counters/cycle mutate under the lock; the latency sleep must
-            # not hold it (it would serialize unrelated points)
+            # counters/cycle mutate under the lock; the latency sleep and
+            # the crash hook's dump I/O must not hold it (they would
+            # serialize unrelated points)
             if fault.mode == "latency":
                 if fault.times is not None and fault.fired >= fault.times:
                     return
                 fault.fired += 1
                 delay = fault.latency_s
             else:
-                fault.apply()  # raises or passes through
-                return
+                try:
+                    fault.apply()  # raises or passes through
+                    return
+                except SimulatedCrash as e:
+                    crash = e
+            hook = self._crash_hook
+        if crash is not None:
+            if hook is not None:
+                try:
+                    hook(point)
+                except Exception as e:  # noqa: BLE001 — crashing anyway
+                    log.warning("crash hook failed at %s: %s", point, e)
+            raise crash
         time.sleep(delay)
 
     @contextlib.contextmanager

@@ -171,6 +171,27 @@ RANKS: dict[str, LockRank] = dict(
             "so this must be a near-leaf.",
         ),
         _r(
+            "tracing.admissions", 92, "lock", False,
+            "AdmissionTraces' per-pod root-span registry: correlates the "
+            "extender's separate webhook verbs into one trace. Ends "
+            "spans (which append to tracing.store, rank 93) under it, "
+            "so it sits just below the store.",
+        ),
+        _r(
+            "tracing.store", 93, "lock", False,
+            "TraceStore's finished-span ring: spans end under almost "
+            "any other lock (a traced section can close inside a locked "
+            "region), so the store is a near-leaf like the metrics "
+            "registry. Pure memory — export snapshots, then serializes "
+            "outside the lock.",
+        ),
+        _r(
+            "flightrec.ring", 94, "lock", False,
+            "FlightRecorder's bounded log-record ring: fed from a "
+            "logging handler, which can run under any lock that logs. "
+            "dump() snapshots under it and writes the file outside.",
+        ),
+        _r(
             "metrics.registry", 95, "lock", False,
             "MetricsRegistry: the innermost leaf — counters and "
             "histograms are recorded under every other lock.",

@@ -1,27 +1,62 @@
-"""Leveled logging with glog-style verbosity tiers.
+"""Leveled logging with glog-style verbosity tiers and trace correlation.
 
 The reference logs through glog with ``--v`` verbosity (V(1) lifecycle,
 V(4)/V(6) per-decision detail; DaemonSet runs ``--v=5``). We map that onto
 stdlib logging: ``V(n)`` messages are emitted at DEBUG with a per-module
 verbosity gate, so ``--v=5`` shows V(1)..V(5).
+
+Trace correlation: ``setup()`` installs a LogRecord factory that stamps
+every record with the trace/span ids of the span current on the emitting
+thread (``utils.tracing``), rendered as `` [trace/span]`` between the
+logger name and the message — so a grep for one admission's trace id
+pulls its log lines, and the flight recorder's ring keeps the ids in
+structured form.
+
+Fatal hooks: ``Logger.fatal`` runs registered hooks (the flight
+recorder's dump-on-fatal) before raising SystemExit, so a dying daemon
+leaves a postmortem behind.
 """
 
 from __future__ import annotations
 
 import logging
 import sys
-from typing import Any
+from typing import Any, Callable
+
+from . import tracing
 
 _VERBOSITY = 0
+_factory_installed = False
+_fatal_hooks: list[Callable[[str], Any]] = []
+
+
+def _install_record_factory() -> None:
+    """Wrap the active LogRecord factory to add ``record.trace``: empty
+    outside spans, `` [<trace8>/<span8>]`` inside a sampled one. Runs on
+    every record so handlers installed before setup() see it too."""
+    global _factory_installed
+    if _factory_installed:
+        return
+    _factory_installed = True
+    old_factory = logging.getLogRecordFactory()
+
+    def factory(*args: Any, **kwargs: Any) -> logging.LogRecord:
+        record = old_factory(*args, **kwargs)
+        ids = tracing.current_trace_ids()
+        record.trace = f" [{ids[0][:8]}/{ids[1][:8]}]" if ids else ""
+        return record
+
+    logging.setLogRecordFactory(factory)
 
 
 def setup(verbosity: int = 0, stream: Any = None) -> None:
     global _VERBOSITY
     _VERBOSITY = verbosity
+    _install_record_factory()
     logging.basicConfig(
         level=logging.DEBUG if verbosity > 0 else logging.INFO,
         stream=stream or sys.stderr,
-        format="%(levelname).1s%(asctime)s %(name)s] %(message)s",
+        format="%(levelname).1s%(asctime)s %(name)s%(trace)s] %(message)s",
         datefmt="%m%d %H:%M:%S",
         force=True,  # re-apply on verbosity reload / under pytest handlers
     )
@@ -29,6 +64,15 @@ def setup(verbosity: int = 0, stream: Any = None) -> None:
 
 def verbosity() -> int:
     return _VERBOSITY
+
+
+def on_fatal(hook: Callable[[str], Any]) -> None:
+    """Register a hook run (with the fatal message) before a fatal exit."""
+    _fatal_hooks.append(hook)
+
+
+def clear_fatal_hooks() -> None:
+    _fatal_hooks.clear()
 
 
 class Logger:
@@ -48,6 +92,12 @@ class Logger:
 
     def fatal(self, msg: str, *args: object) -> None:
         self._log.critical(msg, *args)
+        rendered = msg % args if args else msg
+        for hook in list(_fatal_hooks):
+            try:
+                hook(rendered)
+            except Exception as e:  # noqa: BLE001 — dying anyway; best effort
+                self._log.error("fatal hook failed: %s", e)
         raise SystemExit(255)
 
     def v(self, level: int, msg: str, *args: object) -> None:

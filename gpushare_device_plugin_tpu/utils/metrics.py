@@ -20,6 +20,7 @@ import time
 from typing import Any, Iterator
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from . import tracing
 from .lockrank import make_lock
 
 # Latency buckets (seconds): 0.5ms .. 10s, roughly log-spaced around the
@@ -38,10 +39,23 @@ LOCK_WAIT_BUCKETS = (
 )
 
 
+def _escape_label_value(value: str) -> str:
+    """Prometheus text-format label-value escaping: backslash, double
+    quote, and newline must be escaped or a scraper mis-parses the line
+    (exposition format 0.0.4; pod names and error strings end up in
+    labels, so this is not theoretical)."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
 def _fmt_labels(labels: tuple[tuple[str, str], ...]) -> str:
     if not labels:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in labels)
+    inner = ",".join(f'{k}="{_escape_label_value(v)}"' for k, v in labels)
     return "{" + inner + "}"
 
 
@@ -53,6 +67,13 @@ class MetricsRegistry:
         # name -> (buckets, {labels -> [counts..., sum, count]})
         self._hists: dict[str, tuple[tuple[float, ...], dict]] = {}
         self._help: dict[str, tuple[str, str]] = {}  # name -> (type, help)
+        # Exemplars: (name, labels) -> {bucket index -> (trace_id, value,
+        # unix ts)}; bucket index len(buckets) is +Inf. Recorded when an
+        # observation happens inside a sampled trace span, so a scrape's
+        # latency buckets link straight to the admission trace that put
+        # mass there (rendered in the OpenMetrics exposition only — the
+        # classic 0.0.4 text format has no exemplar syntax).
+        self._exemplars: dict[tuple[str, tuple], dict[int, tuple[str, float, float]]] = {}
 
     def _describe(self, name: str, mtype: str, help_text: str) -> None:
         self._help.setdefault(name, (mtype, help_text))
@@ -79,15 +100,24 @@ class MetricsRegistry:
         buckets: tuple[float, ...] = DEFAULT_BUCKETS, **labels: str,
     ) -> None:
         lkey = tuple(sorted(labels.items()))
+        # Read the current trace OUTSIDE the registry lock (one TLS read;
+        # None on the unsampled/untraced fast path).
+        ids = tracing.current_trace_ids()
         with self._lock:
             self._describe(name, "histogram", help_text)
             bks, series = self._hists.setdefault(name, (buckets, {}))
             row = series.setdefault(lkey, [0] * len(bks) + [0.0, 0])
+            bucket_i = len(bks)  # +Inf unless a finite bucket catches it
             for i, b in enumerate(bks):
                 if seconds <= b:
                     row[i] += 1
+                    bucket_i = min(bucket_i, i)
             row[-2] += seconds
             row[-1] += 1
+            if ids is not None:
+                self._exemplars.setdefault((name, lkey), {})[bucket_i] = (
+                    ids[0], seconds, time.time(),
+                )
 
     # --- programmatic readers (bench / tests) ---------------------------
 
@@ -135,8 +165,21 @@ class MetricsRegistry:
                 prev_count, prev_bound = row[i], bound
             return bks[-1]  # beyond the last bucket: clamp like PromQL
 
-    def render(self) -> str:
-        """Prometheus text exposition format 0.0.4."""
+    def exemplar(self, name: str, **labels: str) -> dict[int, tuple[str, float, float]]:
+        """Bucket-index -> (trace_id, value, ts) exemplars for one series
+        (test/debug reader)."""
+        lkey = tuple(sorted(labels.items()))
+        with self._lock:
+            return dict(self._exemplars.get((name, lkey), {}))
+
+    def render(self, openmetrics: bool = False) -> str:
+        """Prometheus text exposition.
+
+        Default: classic text format 0.0.4 (no exemplar syntax exists
+        there). ``openmetrics=True``: the same families with OpenMetrics
+        exemplar suffixes on histogram bucket lines — ``# {trace_id=
+        "..."} value timestamp`` — plus the ``# EOF`` terminator; served
+        when a scraper negotiates ``application/openmetrics-text``."""
         out: list[str] = []
         with self._lock:
             seen: set[str] = set()
@@ -146,9 +189,18 @@ class MetricsRegistry:
                     return
                 seen.add(name)
                 mtype, help_text = self._help.get(name, ("untyped", ""))
+                if openmetrics and mtype == "untyped":
+                    mtype = "unknown"  # OM's spelling of untyped
+                family = name
+                if openmetrics and mtype == "counter" and name.endswith("_total"):
+                    # OpenMetrics names the FAMILY without the _total
+                    # suffix (samples keep it); a strict OM parser —
+                    # which modern Prometheus negotiates by default —
+                    # rejects the whole scrape otherwise.
+                    family = name[: -len("_total")]
                 if help_text:
-                    out.append(f"# HELP {name} {help_text}")
-                out.append(f"# TYPE {name} {mtype}")
+                    out.append(f"# HELP {family} {help_text}")
+                out.append(f"# TYPE {family} {mtype}")
 
             for (name, labels), val in sorted(self._counters.items()):
                 header(name)
@@ -159,15 +211,31 @@ class MetricsRegistry:
             for name, (bks, series) in sorted(self._hists.items()):
                 header(name)
                 for lkey, row in sorted(series.items()):
+                    exemplars = (
+                        self._exemplars.get((name, lkey), {})
+                        if openmetrics else {}
+                    )
+
+                    def _ex(i: int) -> str:
+                        ex = exemplars.get(i)
+                        if ex is None:
+                            return ""
+                        tid, value, ts = ex
+                        return (
+                            f' # {{trace_id="{tid}"}} {value:g} {ts:.3f}'
+                        )
+
                     cum = 0
                     for i, b in enumerate(bks):
                         cum = row[i]
                         lbl = _fmt_labels(lkey + (("le", f"{b:g}"),))
-                        out.append(f"{name}_bucket{lbl} {cum}")
+                        out.append(f"{name}_bucket{lbl} {cum}{_ex(i)}")
                     lbl = _fmt_labels(lkey + (("le", "+Inf"),))
-                    out.append(f"{name}_bucket{lbl} {row[-1]}")
+                    out.append(f"{name}_bucket{lbl} {row[-1]}{_ex(len(bks))}")
                     out.append(f"{name}_sum{_fmt_labels(lkey)} {row[-2]:g}")
                     out.append(f"{name}_count{_fmt_labels(lkey)} {row[-1]}")
+        if openmetrics:
+            out.append("# EOF")
         return "\n".join(out) + "\n"
 
 
@@ -199,14 +267,23 @@ def timed_acquire(
 
 
 class MetricsServer:
-    """Minimal /metrics + /healthz HTTP endpoint (off by default; the
-    daemon enables it with --metrics-port)."""
+    """Minimal /metrics + /traces + /healthz HTTP endpoint (off by
+    default; the daemon enables it with --metrics-port).
+
+    ``/metrics`` negotiates the exposition: classic text format 0.0.4 by
+    default, OpenMetrics (with histogram exemplars linking latency
+    buckets to trace ids) when the scraper's Accept header names
+    ``application/openmetrics-text``. ``/traces`` serves the in-process
+    trace store as OTLP-JSON (``?trace_id=<id>`` narrows to one trace —
+    what ``kubectl-inspect-tpushare trace`` fetches)."""
 
     def __init__(self, registry: MetricsRegistry = REGISTRY,
-                 host: str = "0.0.0.0", port: int = 0) -> None:
+                 host: str = "0.0.0.0", port: int = 0,
+                 trace_store: "tracing.TraceStore | None" = None) -> None:
         self._registry = registry
         self._host = host
         self._port = port
+        self._store = trace_store if trace_store is not None else tracing.STORE
         self._server: ThreadingHTTPServer | None = None
 
     @property
@@ -216,6 +293,7 @@ class MetricsServer:
 
     def start(self) -> "MetricsServer":
         registry = self._registry
+        store = self._store
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
@@ -224,10 +302,26 @@ class MetricsServer:
                 pass
 
             def do_GET(self) -> None:
-                if self.path == "/metrics":
-                    body = registry.render().encode()
-                    ctype = "text/plain; version=0.0.4; charset=utf-8"
-                elif self.path == "/healthz":
+                import json as _json
+                from urllib.parse import parse_qs, urlparse
+
+                url = urlparse(self.path)
+                if url.path == "/metrics":
+                    accept = self.headers.get("Accept", "")
+                    openmetrics = "application/openmetrics-text" in accept
+                    body = registry.render(openmetrics=openmetrics).encode()
+                    ctype = (
+                        "application/openmetrics-text; version=1.0.0; "
+                        "charset=utf-8"
+                        if openmetrics
+                        else "text/plain; version=0.0.4; charset=utf-8"
+                    )
+                elif url.path == "/traces":
+                    q = parse_qs(url.query)
+                    tid = (q.get("trace_id") or [None])[0]
+                    body = _json.dumps(store.to_otlp(trace_id=tid)).encode()
+                    ctype = "application/json"
+                elif url.path == "/healthz":
                     body = b"ok\n"
                     ctype = "text/plain"
                 else:

@@ -1,0 +1,686 @@
+"""Zero-dependency distributed tracing for the admission and serving paths.
+
+The reference plugin's observability story is glog lines plus the inspect
+CLI reading apiserver state (SURVEY.md section 5); metrics (``.metrics``)
+added the aggregate half. This module adds the *per-decision* half: why
+did THIS pod's admission take 40 ms / fail / land on that chip, and why
+did THIS request's TTFT blow its SLO. It is deliberately OpenTelemetry-
+shaped (spans with ids/parents/attributes/events, OTLP-JSON export)
+without the dependency — the image installs nothing.
+
+Pieces:
+
+- :class:`Span` / :class:`SpanContext` — one timed operation with a
+  128-bit trace id, 64-bit span id, parent link, attributes, and events.
+- :class:`Tracer` — creates spans; keeps a per-thread stack so nested
+  ``with TRACER.span(...)`` blocks parent automatically; sampling is
+  decided once per root span (``sample_ratio``) and inherited by
+  children. A non-sampled span is a shared no-op singleton: the unsampled
+  hot path is two dict/attr reads and a float compare — O(ns), no id
+  generation, no store append.
+- :class:`TraceStore` — bounded in-process ring of finished spans keyed
+  by trace id (the flight recorder's raw material), exported as
+  OTLP-JSON via :meth:`TraceStore.to_otlp` and served on the metrics
+  endpoint's ``/traces`` path (``.metrics.MetricsServer``).
+- :class:`AdmissionTraces` — per-pod root spans that stitch the
+  scheduler extender's *separate* webhook verbs (filter → prioritize →
+  bind) into one admission trace.
+- **Cross-process propagation**: the extender records its bind span's
+  context in the pod annotation ``tpushare.aliyun.com/trace-id``
+  (``const.ANN_TRACE_ID``); the device plugin's allocator reads it after
+  matching the pod and *adopts* the context
+  (:meth:`Tracer.adopt_current_trace`), re-parenting its open span stack
+  — so the two processes' spans stitch into one trace with no collector
+  in between (``inspect trace <pod>`` merges the two ``/traces``
+  endpoints).
+
+The per-pod admission root spans held open across webhook verbs live
+inside :class:`AdmissionTraces` (bounded + TTL'd); this module is the
+one place allowed to hold spans open across function boundaries — the
+``span-leak`` tpulint rule exempts it and requires every other
+``start_span`` to be dominated by ``end()``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import random
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Iterator
+
+from .lockrank import make_lock
+
+# Annotation key carrying "trace_id:span_id" across the extender ->
+# plugin process boundary (duplicated in const.ANN_TRACE_ID; const
+# imports nothing and this module must stay import-light, so the string
+# lives in both — test_tracing pins they agree).
+TRACE_ANNOTATION = "tpushare.aliyun.com/trace-id"
+
+STATUS_OK = "ok"
+STATUS_ERROR = "error"
+
+
+class SpanContext:
+    """Immutable (trace id, span id, sampled) triple — what crosses a
+    process boundary."""
+
+    __slots__ = ("trace_id", "span_id", "sampled")
+
+    def __init__(self, trace_id: str, span_id: str, sampled: bool = True) -> None:
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.sampled = sampled
+
+    def encode(self) -> str:
+        """Wire form for the pod annotation: ``<trace_id>:<span_id>``."""
+        return f"{self.trace_id}:{self.span_id}"
+
+    def __repr__(self) -> str:
+        return f"SpanContext({self.trace_id}, {self.span_id})"
+
+
+def parse_context(value: str | None) -> SpanContext | None:
+    """Parse the annotation form; tolerant of a bare trace id and of
+    garbage (the annotation is user-writable — a garbled value must not
+    break admission, just break stitching)."""
+    if not value:
+        return None
+    head, _, tail = value.partition(":")
+    trace_id = head.strip()
+    span_id = tail.strip()
+    if not _is_hex(trace_id, 32):
+        return None
+    if span_id and not _is_hex(span_id, 16):
+        span_id = ""
+    return SpanContext(trace_id, span_id, sampled=True)
+
+
+def _is_hex(s: str, width: int) -> bool:
+    if len(s) != width:
+        return False
+    try:
+        int(s, 16)
+        return True
+    except ValueError:
+        return False
+
+
+# Span/trace ids need uniqueness, not cryptographic strength — and
+# os.urandom is a syscall (~15us under some container kernels), which at
+# several spans per admission is real hot-path money. One PRNG seeded
+# from the OS once; getrandbits is a single C call, atomic under the GIL.
+_ID_RNG = random.Random(os.urandom(16))
+
+
+def _new_trace_id() -> str:
+    return f"{_ID_RNG.getrandbits(128):032x}"
+
+
+def _new_span_id() -> str:
+    return f"{_ID_RNG.getrandbits(64):016x}"
+
+
+class Span:
+    """One timed operation. Mutation methods are no-ops on non-recording
+    spans, so call sites never branch on sampling themselves."""
+
+    __slots__ = (
+        "name", "trace_id", "span_id", "parent_id", "start_ns", "end_ns",
+        "attributes", "events", "status", "_recording", "_store",
+    )
+
+    def __init__(
+        self,
+        name: str,
+        trace_id: str,
+        span_id: str,
+        parent_id: str = "",
+        start_ns: int | None = None,
+        attributes: dict[str, Any] | None = None,
+        recording: bool = True,
+        store: "TraceStore | None" = None,
+    ) -> None:
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.start_ns = time.time_ns() if start_ns is None else start_ns
+        self.end_ns = 0
+        self.attributes: dict[str, Any] = dict(attributes or {})
+        self.events: list[tuple[str, int, dict[str, Any]]] = []
+        self.status = STATUS_OK
+        self._recording = recording
+        self._store = store
+
+    @property
+    def recording(self) -> bool:
+        return self._recording
+
+    def context(self) -> SpanContext:
+        return SpanContext(self.trace_id, self.span_id, sampled=self._recording)
+
+    def set_attribute(self, key: str, value: Any) -> None:
+        if self._recording:
+            self.attributes[key] = value
+
+    def add_event(self, name: str, **attributes: Any) -> None:
+        if self._recording:
+            self.events.append((name, time.time_ns(), dict(attributes)))
+
+    def end(self, status: str | None = None, end_ns: int | None = None) -> None:
+        """Finish the span (idempotent) and hand it to the store."""
+        if not self._recording or self.end_ns:
+            return
+        if status is not None:
+            self.status = status
+        self.end_ns = time.time_ns() if end_ns is None else end_ns
+        if self._store is not None:
+            self._store.add(self)
+
+    @property
+    def duration_ms(self) -> float:
+        if not self.end_ns:
+            return 0.0
+        return (self.end_ns - self.start_ns) / 1e6
+
+    def to_dict(self) -> dict[str, Any]:
+        """Flat dict form (the CLI's working format)."""
+        return {
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "name": self.name,
+            "start_ns": self.start_ns,
+            "end_ns": self.end_ns,
+            "status": self.status,
+            "attributes": dict(self.attributes),
+            "events": [
+                {"name": n, "time_ns": t, "attributes": a}
+                for n, t, a in self.events
+            ],
+        }
+
+
+class _NoopSpan(Span):
+    """Shared singleton for unsampled work: every method returns
+    immediately, nothing allocates per call."""
+
+    def __init__(self) -> None:
+        super().__init__("noop", "", "", recording=False)
+
+    def end(self, status: str | None = None, end_ns: int | None = None) -> None:
+        return None
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+class TraceStore:
+    """Bounded in-process ring of finished spans, keyed by trace id.
+
+    Insertion order doubles as eviction order (oldest trace evicted
+    whole when ``max_traces`` is exceeded) — exactly the "last N
+    admission traces" the flight recorder dumps. Pure memory under its
+    lock; exports snapshot first and serialize outside."""
+
+    def __init__(self, max_traces: int = 256, max_spans_per_trace: int = 512) -> None:
+        self._lock = make_lock("tracing.store")
+        self._max_traces = max_traces
+        self._max_spans = max_spans_per_trace
+        self._traces: OrderedDict[str, list[Span]] = OrderedDict()
+        self._dropped = 0
+
+    def add(self, span: Span) -> None:
+        if not span.trace_id:
+            return
+        with self._lock:
+            spans = self._traces.get(span.trace_id)
+            if spans is None:
+                spans = []
+                self._traces[span.trace_id] = spans
+                while len(self._traces) > self._max_traces:
+                    self._traces.popitem(last=False)
+                    self._dropped += 1
+            if len(spans) < self._max_spans:
+                spans.append(span)
+
+    def trace(self, trace_id: str) -> list[Span]:
+        with self._lock:
+            return list(self._traces.get(trace_id, ()))
+
+    def trace_ids(self) -> list[str]:
+        with self._lock:
+            return list(self._traces)
+
+    def snapshot(self) -> dict[str, list[Span]]:
+        with self._lock:
+            return {tid: list(spans) for tid, spans in self._traces.items()}
+
+    def dropped(self) -> int:
+        with self._lock:
+            return self._dropped
+
+    def clear(self) -> None:
+        with self._lock:
+            self._traces.clear()
+            self._dropped = 0
+
+    def to_otlp(
+        self, trace_id: str | None = None, service: str = "tpushare"
+    ) -> dict[str, Any]:
+        """OTLP/JSON-shaped export (the ``/traces`` endpoint body): the
+        ``resourceSpans``/``scopeSpans``/``spans`` nesting an OTLP
+        consumer expects, attributes as keyed ``stringValue``s."""
+        if trace_id is not None:
+            spans = self.trace(trace_id)
+        else:
+            spans = [s for ss in self.snapshot().values() for s in ss]
+        return {
+            "resourceSpans": [
+                {
+                    "resource": {
+                        "attributes": [_otlp_attr("service.name", service)]
+                    },
+                    "scopeSpans": [
+                        {
+                            "scope": {"name": "gpushare_device_plugin_tpu.tracing"},
+                            "spans": [_otlp_span(s) for s in spans],
+                        }
+                    ],
+                }
+            ]
+        }
+
+
+def _otlp_attr(key: str, value: Any) -> dict[str, Any]:
+    if isinstance(value, bool):
+        return {"key": key, "value": {"boolValue": value}}
+    if isinstance(value, int):
+        return {"key": key, "value": {"intValue": str(value)}}
+    if isinstance(value, float):
+        return {"key": key, "value": {"doubleValue": value}}
+    return {"key": key, "value": {"stringValue": str(value)}}
+
+
+def _otlp_span(span: Span) -> dict[str, Any]:
+    return {
+        "traceId": span.trace_id,
+        "spanId": span.span_id,
+        "parentSpanId": span.parent_id,
+        "name": span.name,
+        "kind": 1,  # SPAN_KIND_INTERNAL
+        "startTimeUnixNano": str(span.start_ns),
+        "endTimeUnixNano": str(span.end_ns),
+        "attributes": [_otlp_attr(k, v) for k, v in span.attributes.items()],
+        "events": [
+            {
+                "timeUnixNano": str(t),
+                "name": n,
+                "attributes": [_otlp_attr(k, v) for k, v in a.items()],
+            }
+            for n, t, a in span.events
+        ],
+        "status": {"code": 2 if span.status == STATUS_ERROR else 1},
+    }
+
+
+def _otlp_value(value: dict[str, Any]) -> Any:
+    for k in ("stringValue", "boolValue", "doubleValue"):
+        if k in value:
+            return value[k]
+    if "intValue" in value:
+        try:
+            return int(value["intValue"])
+        except (TypeError, ValueError):
+            return value["intValue"]
+    return None
+
+
+def spans_from_otlp(doc: dict[str, Any]) -> list[dict[str, Any]]:
+    """Flatten an OTLP-JSON document back to the flat-dict span form
+    (the inspect CLI consumes ``/traces`` bodies through this)."""
+    out: list[dict[str, Any]] = []
+    for rs in doc.get("resourceSpans", ()):
+        for ss in rs.get("scopeSpans", ()):
+            for sp in ss.get("spans", ()):
+                out.append(
+                    {
+                        "trace_id": sp.get("traceId", ""),
+                        "span_id": sp.get("spanId", ""),
+                        "parent_id": sp.get("parentSpanId", ""),
+                        "name": sp.get("name", ""),
+                        "start_ns": int(sp.get("startTimeUnixNano", 0) or 0),
+                        "end_ns": int(sp.get("endTimeUnixNano", 0) or 0),
+                        "status": (
+                            STATUS_ERROR
+                            if sp.get("status", {}).get("code") == 2
+                            else STATUS_OK
+                        ),
+                        "attributes": {
+                            a["key"]: _otlp_value(a.get("value", {}))
+                            for a in sp.get("attributes", ())
+                            if "key" in a
+                        },
+                        "events": [
+                            {
+                                "name": e.get("name", ""),
+                                "time_ns": int(e.get("timeUnixNano", 0) or 0),
+                                "attributes": {
+                                    a["key"]: _otlp_value(a.get("value", {}))
+                                    for a in e.get("attributes", ())
+                                    if "key" in a
+                                },
+                            }
+                            for e in sp.get("events", ())
+                        ],
+                    }
+                )
+    return out
+
+
+class Tracer:
+    """Creates spans against one store with one sampling policy.
+
+    Thread-local span stack: ``with TRACER.span(...)`` pushes, nested
+    spans parent automatically, and the stack is what
+    :meth:`adopt_current_trace` re-parents when the allocator discovers
+    (mid-admission, after the pod match) that the extender already
+    started this pod's trace."""
+
+    def __init__(
+        self,
+        store: TraceStore | None = None,
+        sample_ratio: float = 1.0,
+        service: str = "tpushare",
+    ) -> None:
+        self._store = store if store is not None else TraceStore()
+        self._ratio = float(sample_ratio)
+        self.service = service
+        self._tls = threading.local()
+
+    # --- configuration ----------------------------------------------------
+
+    @property
+    def store(self) -> TraceStore:
+        return self._store
+
+    @property
+    def sample_ratio(self) -> float:
+        return self._ratio
+
+    def configure(self, sample_ratio: float | None = None) -> None:
+        """Runtime reconfiguration (the daemon's ``--trace-sample`` flag,
+        the bench's ``--no-trace``)."""
+        if sample_ratio is not None:
+            self._ratio = float(sample_ratio)
+
+    # --- span stack -------------------------------------------------------
+
+    def _stack(self) -> list[Span]:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = []
+            self._tls.stack = stack
+        return stack
+
+    def current_span(self) -> Span | None:
+        stack = getattr(self._tls, "stack", None)
+        if not stack:
+            return None
+        return stack[-1]
+
+    def current_context(self) -> SpanContext | None:
+        """The innermost *recording* span's context, or None — what log
+        correlation and histogram exemplars stamp."""
+        span = self.current_span()
+        if span is None or not span.recording:
+            return None
+        return span.context()
+
+    def _sampled_root(self) -> bool:
+        if self._ratio >= 1.0:
+            return True
+        if self._ratio <= 0.0:
+            return False
+        return random.random() < self._ratio
+
+    # --- span creation ----------------------------------------------------
+
+    def start_span(
+        self,
+        name: str,
+        parent: SpanContext | Span | None = None,
+        attributes: dict[str, Any] | None = None,
+        child_only: bool = False,
+    ) -> Span:
+        """Create a span. ``parent`` may be a Span, a SpanContext, or
+        None (None: parent under the thread's current span, else start a
+        new root). ``child_only`` spans never start a trace of their own
+        — without a recording parent they are the no-op singleton (used
+        by deep helpers like the WAL batch wait, which would otherwise
+        mint orphan root traces when driven outside an admission).
+
+        Callers of this method MUST end the span on every path — the
+        ``span-leak`` tpulint rule enforces it; prefer :meth:`span`.
+        """
+        if isinstance(parent, Span):
+            if not parent.recording:
+                return NOOP_SPAN  # inherit the parent's unsampled decision
+            parent = parent.context()
+        if parent is None:
+            cur = self.current_span()
+            if cur is not None:
+                # The root's sampling decision is inherited DOWN the open
+                # stack: under an unsampled span, nested spans must not
+                # re-roll and mint orphan root traces.
+                if not cur.recording:
+                    return NOOP_SPAN
+                parent = cur.context()
+            elif child_only:
+                return NOOP_SPAN
+        if parent is not None:
+            if not parent.sampled:
+                return NOOP_SPAN
+            return Span(
+                name,
+                trace_id=parent.trace_id,
+                span_id=_new_span_id(),
+                parent_id=parent.span_id,
+                attributes=attributes,
+                store=self._store,
+            )
+        if not self._sampled_root():
+            return NOOP_SPAN
+        return Span(
+            name,
+            trace_id=_new_trace_id(),
+            span_id=_new_span_id(),
+            attributes=attributes,
+            store=self._store,
+        )
+
+    @contextlib.contextmanager
+    def span(
+        self,
+        name: str,
+        parent: SpanContext | Span | None = None,
+        attributes: dict[str, Any] | None = None,
+        child_only: bool = False,
+    ) -> Iterator[Span]:
+        """``with TRACER.span("allocator.place") as sp:`` — the span is
+        pushed as the thread's current (children parent under it), ended
+        on exit, marked ``error`` with the exception repr when the body
+        raises."""
+        sp = self.start_span(
+            name, parent=parent, attributes=attributes, child_only=child_only
+        )
+        stack = self._stack()
+        stack.append(sp)
+        try:
+            yield sp
+        except BaseException as e:
+            sp.set_attribute("error", repr(e))
+            sp.end(STATUS_ERROR)
+            raise
+        finally:
+            # pop by identity: an adopting callee may have replaced ids,
+            # but the object is the same
+            if stack and stack[-1] is sp:
+                stack.pop()
+            elif sp in stack:
+                stack.remove(sp)
+        sp.end()
+
+    def record_span(
+        self,
+        name: str,
+        start_ns: int,
+        end_ns: int,
+        parent: SpanContext | None = None,
+        attributes: dict[str, Any] | None = None,
+        status: str = STATUS_OK,
+        events: list[tuple[str, int, dict[str, Any]]] | None = None,
+    ) -> SpanContext | None:
+        """Create an already-finished span from explicit timestamps (the
+        serving engine reconstructs each request's timeline at retire
+        time — zero tracing work on the per-token hot loop). Returns the
+        span's context for building children, or None when unsampled."""
+        if parent is None:
+            if not self._sampled_root():
+                return None
+            trace_id = _new_trace_id()
+            parent_id = ""
+        else:
+            if not parent.sampled:
+                return None
+            trace_id = parent.trace_id
+            parent_id = parent.span_id
+        sp = Span(
+            name,
+            trace_id=trace_id,
+            span_id=_new_span_id(),
+            parent_id=parent_id,
+            start_ns=start_ns,
+            attributes=attributes,
+            store=self._store,
+        )
+        if events:
+            sp.events.extend(events)
+        sp.status = status
+        sp.end_ns = end_ns
+        self._store.add(sp)
+        return sp.context()
+
+    # --- cross-process adoption -------------------------------------------
+
+    def adopt_current_trace(self, ctx: SpanContext | None) -> bool:
+        """Re-parent this thread's OPEN span stack under ``ctx``.
+
+        The device plugin's Allocate starts its span before it knows
+        which pod it is admitting; once the pod is matched and its
+        ``tpushare.aliyun.com/trace-id`` annotation read, adoption
+        rewrites the open spans' trace ids and links the outermost one
+        under the extender's bind span — one stitched trace. Spans that
+        already ended keep their original ids (adopt early). No-op on
+        None/unsampled contexts. Returns True when anything changed."""
+        if ctx is None or not ctx.sampled or not ctx.trace_id:
+            return False
+        stack = [s for s in self._stack() if s.recording and not s.end_ns]
+        if not stack:
+            return False
+        stack[0].parent_id = ctx.span_id
+        for sp in stack:
+            sp.trace_id = ctx.trace_id
+        return True
+
+
+class AdmissionTraces:
+    """Per-pod admission root spans: the glue that makes the extender's
+    separate filter/prioritize/bind webhook calls one trace.
+
+    ``root(ns, name)`` starts (or returns) the pod's admission root span
+    context; each verb then parents its own span under it. ``finish``
+    ends the root. Bounded and TTL'd: a pod the scheduler filtered but
+    never bound must not pin a span forever — stale roots are ended with
+    status ``unfinished`` on eviction."""
+
+    def __init__(
+        self,
+        tracer: Tracer,
+        max_pods: int = 512,
+        ttl_s: float = 300.0,
+    ) -> None:
+        self._tracer = tracer
+        self._max = max_pods
+        self._ttl = ttl_s
+        self._lock = make_lock("tracing.admissions")
+        self._roots: OrderedDict[tuple[str, str], tuple[Span, float]] = (
+            OrderedDict()
+        )
+
+    def root(
+        self, namespace: str, name: str, attributes: dict[str, Any] | None = None
+    ) -> SpanContext | None:
+        """The pod's admission root context, created on first touch.
+        Returns None when the trace was not sampled (every verb's span
+        then no-ops)."""
+        key = (namespace, name)
+        now = time.monotonic()
+        evicted: list[Span] = []
+        with self._lock:
+            entry = self._roots.get(key)
+            if entry is not None and now - entry[1] <= self._ttl:
+                # recency touch: a pod actively going filter->prioritize
+                # ->bind must not be the one max_pods pressure evicts
+                self._roots.move_to_end(key)
+                span = entry[0]
+            else:
+                if entry is not None:  # stale: end the old incarnation
+                    evicted.append(entry[0])
+                    self._roots.pop(key, None)
+                span = self._tracer.start_span(
+                    "admission",
+                    parent=None,
+                    attributes={"pod": f"{namespace}/{name}", **(attributes or {})},
+                )
+                if span.recording:
+                    self._roots[key] = (span, now)
+                while len(self._roots) > self._max:
+                    _, (old, _stamp) = self._roots.popitem(last=False)
+                    evicted.append(old)
+        for old in evicted:
+            old.end("unfinished")
+        if not span.recording:
+            return None
+        return span.context()
+
+    def finish(
+        self, namespace: str, name: str, status: str = STATUS_OK
+    ) -> None:
+        with self._lock:
+            entry = self._roots.pop((namespace, name), None)
+        if entry is not None:
+            entry[0].end(status)
+
+    def open_count(self) -> int:
+        with self._lock:
+            return len(self._roots)
+
+
+# Process-wide defaults, mirroring utils.metrics.REGISTRY / utils.faults
+# .FAULTS: one store, one tracer, one admission registry per process.
+STORE = TraceStore()
+TRACER = Tracer(store=STORE)
+ADMISSIONS = AdmissionTraces(TRACER)
+
+
+def current_trace_ids() -> tuple[str, str] | None:
+    """(trace_id, span_id) of the innermost recording span on this
+    thread, or None — the log-correlation / exemplar hook."""
+    ctx = TRACER.current_context()
+    if ctx is None:
+        return None
+    return ctx.trace_id, ctx.span_id

@@ -1,0 +1,28 @@
+"""span-leak fixtures: every shape the rule must flag."""
+
+from gpushare_device_plugin_tpu.utils.tracing import TRACER
+
+
+def discarded() -> None:
+    # finding 1: result discarded — nothing can ever end() it
+    TRACER.start_span("orphan")
+
+
+def fallthrough_leak() -> None:
+    sp = TRACER.start_span("leaky")  # finding 2: no end() before fn end
+    sp.set_attribute("k", "v")
+
+
+def return_leak(flag: bool) -> int:
+    sp = TRACER.start_span("leaky")  # finding 3: early return skips end()
+    if flag:
+        return 1
+    sp.end()
+    return 0
+
+
+def raise_leak(flag: bool) -> None:
+    sp = TRACER.start_span("leaky")  # finding 4: raise path skips end()
+    if flag:
+        raise RuntimeError("boom")
+    sp.end()

@@ -1,0 +1,46 @@
+"""span-leak fixtures: canonical safe shapes the rule must accept."""
+
+from gpushare_device_plugin_tpu.utils.tracing import TRACER
+
+
+def context_manager() -> None:
+    # the structurally-safe form: exit always ends
+    with TRACER.span("safe") as sp:
+        sp.set_attribute("k", "v")
+
+
+def try_finally() -> None:
+    sp = TRACER.start_span("safe")
+    try:
+        sp.set_attribute("k", "v")
+    finally:
+        sp.end()
+
+
+def start_inside_try(flag: bool) -> int:
+    # the shape the rule's message recommends: start INSIDE the try,
+    # end in its finally — every exit (return/raise included) resolves
+    try:
+        sp = TRACER.start_span("safe")
+        sp.set_attribute("k", "v")
+        if flag:
+            return 1
+        raise RuntimeError("boom")
+    finally:
+        sp.end()
+
+
+def branch_both_end(flag: bool) -> None:
+    sp = TRACER.start_span("safe")
+    if flag:
+        sp.end("error")
+    else:
+        sp.end()
+
+
+def end_before_raise(flag: bool) -> None:
+    sp = TRACER.start_span("safe")
+    if flag:
+        sp.end("error")
+        raise RuntimeError("boom")
+    sp.end()

@@ -79,10 +79,37 @@ def test_demo_commands_reference_importable_modules():
         importlib.import_module(mod)
 
 
-def test_console_scripts_importable():
-    import tomllib
+def _project_scripts(text: str) -> dict:
+    """The [project.scripts] table from pyproject.toml.
 
-    scripts = tomllib.loads((ROOT / "pyproject.toml").read_text())["project"]["scripts"]
+    tomllib is stdlib only from 3.11; this image runs 3.10 (and installs
+    nothing), so fall back to tomli and then to a minimal line parse of
+    the one flat table this test needs — the tier-1 gate must not depend
+    on the interpreter minor version."""
+    try:
+        import tomllib
+    except ImportError:
+        try:
+            import tomli as tomllib  # type: ignore[no-redef]
+        except ImportError:
+            tomllib = None
+    if tomllib is not None:
+        return tomllib.loads(text)["project"]["scripts"]
+    scripts = {}
+    in_table = False
+    for line in text.splitlines():
+        stripped = line.strip()
+        if stripped.startswith("["):
+            in_table = stripped == "[project.scripts]"
+            continue
+        if in_table and "=" in stripped and not stripped.startswith("#"):
+            key, _, value = stripped.partition("=")
+            scripts[key.strip().strip('"')] = value.strip().strip('"')
+    return scripts
+
+
+def test_console_scripts_importable():
+    scripts = _project_scripts((ROOT / "pyproject.toml").read_text())
     assert scripts, "no console scripts declared"
     for target in scripts.values():
         mod, func = target.split(":")

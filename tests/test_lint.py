@@ -136,6 +136,44 @@ def test_wal_rule_accepts_canonical_shapes():
     assert _rules([mod], "wal-protocol") == []
 
 
+# --- span leak --------------------------------------------------------------
+
+
+def test_span_leak_flags_all_bad_shapes():
+    mod = _fixture("span_leak_bad.py", PKG + "span_leak_bad.py")
+    found = _rules([mod], "span-leak")
+    assert len(found) == 4, found
+    messages = " | ".join(f.message for f in found)
+    assert "result discarded" in messages
+    assert "a normal completion path" in messages
+    assert "a return path" in messages
+    assert "a raise path" in messages
+
+
+def test_span_leak_accepts_canonical_shapes():
+    mod = _fixture("span_leak_ok.py", PKG + "span_leak_ok.py")
+    assert _rules([mod], "span-leak") == []
+
+
+def test_span_leak_exempts_tracing_module():
+    """utils/tracing.py holds per-pod admission roots open across webhook
+    verbs by design (bounded + TTL'd in AdmissionTraces) — the rule must
+    not fire inside the tracing module itself."""
+    src = (
+        "def root(self):\n"
+        "    span = self._tracer.start_span('admission')\n"
+        "    return span\n"
+    )
+    exempt = Module(
+        "gpushare_device_plugin_tpu/utils/tracing.py", src, ast.parse(src)
+    )
+    assert _rules([exempt], "span-leak") == []
+    elsewhere = Module(
+        "gpushare_device_plugin_tpu/utils/other.py", src, ast.parse(src)
+    )
+    assert len(_rules([elsewhere], "span-leak")) == 1
+
+
 # --- ledger encapsulation ---------------------------------------------------
 
 

@@ -39,6 +39,84 @@ def test_histogram_buckets_cumulative():
     assert "lat_seconds_count 3" in text
 
 
+def test_label_values_escaped():
+    """Exposition hardening: backslash, double quote, and newline in
+    label values must be escaped per the text format 0.0.4 spec, or a
+    strict scraper rejects the whole page."""
+    r = MetricsRegistry()
+    r.counter_inc("esc_total", "x", reason='say "hi"\nback\\slash')
+    line = next(
+        l for l in r.render().splitlines() if l.startswith("esc_total")
+    )
+    assert line == 'esc_total{reason="say \\"hi\\"\\nback\\\\slash"} 1'
+
+
+_NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+_LABEL_VALUE = r'"(?:[^"\\\n]|\\\\|\\"|\\n)*"'
+_LABELS = rf"\{{{_NAME}={_LABEL_VALUE}(?:,{_NAME}={_LABEL_VALUE})*\}}"
+_NUMBER = r"[+-]?(?:[0-9]*\.?[0-9]+(?:e[+-]?[0-9]+)?|Inf|NaN)"
+_EXEMPLAR = rf' # \{{trace_id="[0-9a-f]{{32}}"\}} {_NUMBER} {_NUMBER}'
+
+
+def _strict_parse(text: str, openmetrics: bool = False) -> int:
+    """Line-strict parser for the exposition format: every line must be
+    a HELP/TYPE comment, a sample (with optional OpenMetrics exemplar),
+    or the EOF terminator. Returns the sample count."""
+    import re
+
+    sample = re.compile(
+        rf"^{_NAME}(?:{_LABELS})? {_NUMBER}"
+        + (rf"(?:{_EXEMPLAR})?" if openmetrics else "")
+        + "$"
+    )
+    comment = re.compile(rf"^# (?:HELP|TYPE) {_NAME} .+$")
+    samples = 0
+    lines = text.splitlines()
+    assert lines and text.endswith("\n")
+    for i, line in enumerate(lines):
+        if line == "# EOF":
+            assert openmetrics and i == len(lines) - 1
+            continue
+        if line.startswith("#"):
+            assert comment.match(line), f"bad comment line: {line!r}"
+            continue
+        assert sample.match(line), f"bad sample line: {line!r}"
+        samples += 1
+    return samples
+
+
+def test_strict_parser_accepts_full_exposition():
+    """Scrape test: a registry exercising every metric kind — awkward
+    label values included — renders pages a line-strict parser accepts
+    in both classic and OpenMetrics modes."""
+    from gpushare_device_plugin_tpu.utils import tracing
+
+    r = MetricsRegistry()
+    r.counter_inc("ops_total", "ops", outcome="ok", pod='we"ird\npod\\name')
+    r.gauge_set("level", -3.5, "level")
+    with tracing.TRACER.span("scrape-span"):
+        r.observe("lat_seconds", 0.003, "latency", buckets=(0.001, 0.01, 1.0))
+    r.observe("lat_seconds", 99.0, "latency", buckets=(0.001, 0.01, 1.0))
+    assert _strict_parse(r.render()) >= 8
+    assert _strict_parse(r.render(openmetrics=True), openmetrics=True) >= 8
+
+
+def test_exemplar_recorded_per_bucket():
+    from gpushare_device_plugin_tpu.utils import tracing
+
+    r = MetricsRegistry()
+    with tracing.TRACER.span("x") as sp:
+        r.observe("h_seconds", 0.005, buckets=(0.001, 0.01, 1.0))
+        r.observe("h_seconds", 50.0, buckets=(0.001, 0.01, 1.0))  # +Inf
+    ex = r.exemplar("h_seconds")
+    assert ex[1][0] == sp.trace_id  # 0.005 fell in the 0.01 bucket
+    assert ex[3][0] == sp.trace_id  # 50.0 fell beyond the last bucket
+    # outside any span: no exemplar recorded
+    r2 = MetricsRegistry()
+    r2.observe("h_seconds", 0.005, buckets=(0.001, 0.01, 1.0))
+    assert r2.exemplar("h_seconds") == {}
+
+
 def test_metrics_server_endpoint():
     r = MetricsRegistry()
     r.counter_inc("served_total", "hits")
@@ -48,7 +126,19 @@ def test_metrics_server_endpoint():
         resp = requests.get(f"{url}/metrics")
         assert resp.status_code == 200
         assert "served_total 1" in resp.text
-        assert "text/plain" in resp.headers["Content-Type"]
+        # exposition content type, version pinned (satellite: strict
+        # scrapers key the parser off this header)
+        assert resp.headers["Content-Type"] == (
+            "text/plain; version=0.0.4; charset=utf-8"
+        )
+        om = requests.get(
+            f"{url}/metrics",
+            headers={"Accept": "application/openmetrics-text"},
+        )
+        assert om.headers["Content-Type"].startswith(
+            "application/openmetrics-text; version=1.0.0"
+        )
+        assert om.text.rstrip().endswith("# EOF")
         assert requests.get(f"{url}/healthz").text == "ok\n"
         assert requests.get(f"{url}/nope").status_code == 404
     finally:

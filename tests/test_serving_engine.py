@@ -483,3 +483,51 @@ def test_slots_from_pod_env_gang_scales_to_container_share():
     n_half = slots_from_pod_env(cfg, 32, weight_bytes=w, env=half,
                                 headroom=1.0)
     assert 0 < n_half < n_whole
+
+
+def test_engine_emits_request_spans(setup):
+    """Observability contract: each served request leaves a serve.request
+    trace with queue/prefill/decode/retire child spans, reconstructed at
+    retire time (zero work on the per-token loop; warmup's synthetic
+    request records nothing)."""
+    from gpushare_device_plugin_tpu.utils import tracing
+
+    cfg, params = setup
+    tracing.STORE.clear()
+    tracing.TRACER.configure(sample_ratio=1.0)
+    try:
+        eng = SlotEngine(params, cfg, slots=2, max_len=32, prefill_chunk=4,
+                         eos_id=EOS)
+        eng.warmup()
+        assert tracing.STORE.trace_ids() == []  # warmup is untraced
+        stats = eng.run([
+            Request(rid=0, prompt=(5, 6, 7, 8, 9), max_new=6, arrival=0.0),
+            Request(rid=1, prompt=(10, 11), max_new=4, arrival=2.0),
+        ])
+        for res in stats.results:
+            assert res.trace_id, res
+            spans = {s.name: s for s in tracing.STORE.trace(res.trace_id)}
+            assert sorted(spans) == [
+                "serve.decode", "serve.prefill", "serve.queue",
+                "serve.request", "serve.retire",
+            ]
+            root = spans["serve.request"]
+            assert root.attributes["rid"] == res.rid
+            assert root.attributes["tokens"] == len(res.tokens)
+            for name, span in spans.items():
+                if name != "serve.request":
+                    assert span.parent_id == root.span_id
+            # timeline sanity: queue ends where prefill starts; the root
+            # covers everything
+            assert spans["serve.queue"].end_ns == spans["serve.prefill"].start_ns
+            assert root.start_ns <= spans["serve.queue"].start_ns
+            assert root.end_ns >= spans["serve.retire"].end_ns
+        # unsampled runs record nothing and leave results unstamped
+        tracing.STORE.clear()
+        tracing.TRACER.configure(sample_ratio=0.0)
+        stats = eng.run([Request(rid=2, prompt=(5, 6), max_new=2)])
+        assert stats.results[0].trace_id == ""
+        assert tracing.STORE.trace_ids() == []
+    finally:
+        tracing.TRACER.configure(sample_ratio=1.0)
+        tracing.STORE.clear()

@@ -1,0 +1,396 @@
+"""End-to-end observability pipeline (``make trace-smoke``): one
+admission produces ONE stitched trace across the extender and plugin
+processes (filter -> bind -> WAL -> PATCH -> Allocate -> env), visible
+through the /traces endpoint and `kubectl-inspect-tpushare trace`; the
+flight recorder dumps on SIGUSR1 / injected crash / fatal exit; latency
+histograms carry trace exemplars; log lines carry trace ids."""
+
+import io
+import json
+import os
+import signal
+import time
+
+import pytest
+import requests
+
+from gpushare_device_plugin_tpu import const
+from gpushare_device_plugin_tpu.allocator.cluster import ClusterAllocator
+from gpushare_device_plugin_tpu.cli import inspect as inspect_cli
+from gpushare_device_plugin_tpu.cli.display import (
+    render_flightrecord,
+    render_trace,
+)
+from gpushare_device_plugin_tpu.cluster.apiserver import ApiServerClient
+from gpushare_device_plugin_tpu.cluster.informer import PodInformer
+from gpushare_device_plugin_tpu.device import DeviceInventory
+from gpushare_device_plugin_tpu.discovery import MockBackend
+from gpushare_device_plugin_tpu.extender.server import ExtenderCore
+from gpushare_device_plugin_tpu.plugin import PluginConfig, TpuSharePlugin
+from gpushare_device_plugin_tpu.utils import flightrec, tracing
+from gpushare_device_plugin_tpu.utils import log as logutil
+from gpushare_device_plugin_tpu.utils.faults import FAULTS, SimulatedCrash
+from gpushare_device_plugin_tpu.utils.metrics import MetricsServer
+
+from fake_apiserver import FakeApiServer
+from fake_kubelet import FakeKubelet
+from k8s_fixtures import make_pod
+
+NODE = "trace-node"
+
+
+@pytest.fixture(autouse=True)
+def _fresh_store():
+    tracing.STORE.clear()
+    tracing.TRACER.configure(sample_ratio=1.0)
+    yield
+    tracing.STORE.clear()
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    api = FakeApiServer()
+    api.add_node(
+        NODE,
+        capacity={const.RESOURCE_MEM: "128", const.RESOURCE_COUNT: "4"},
+    )
+    api.start()
+    client = ApiServerClient(api.url)
+    informer = PodInformer(client, NODE).start()
+    yield api, client, informer
+    informer.stop()
+    api.stop()
+
+
+def _admit_one(api, client, informer, tmp_path, name="p1", units=4):
+    """One full admission: extender filter + bind, then a REAL gRPC
+    Allocate through the plugin server (the kubelet half). Returns the
+    pod's trace-id annotation value."""
+    api.add_pod(make_pod(name, units, node=""))
+    core = ExtenderCore(client)
+    node = client.get_node(NODE)
+    core.filter({
+        "pod": client.get_pod("default", name), "nodes": {"items": [node]},
+    })
+    r = core.bind({"podName": name, "podNamespace": "default", "node": NODE})
+    assert r["error"] == "", r
+    ann = client.get_pod("default", name)["metadata"]["annotations"]
+    raw = ann[const.ANN_TRACE_ID]
+    # wait for the assumed pod to land in the informer cache
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        cached = informer.get_pod("default", name)
+        if cached is not None and const.ENV_MEM_IDX in (
+            cached["metadata"].get("annotations") or {}
+        ):
+            break
+        time.sleep(0.01)
+    inv = DeviceInventory(
+        MockBackend(num_chips=4, hbm_bytes=32 << 30).chips()
+    )
+    kubelet = FakeKubelet(str(tmp_path))
+    kubelet.start()
+    allocator = ClusterAllocator(inv, client, informer, NODE)
+    plugin = TpuSharePlugin(
+        inv,
+        allocate_fn=allocator.allocate,
+        config=PluginConfig(plugin_dir=str(tmp_path)),
+    )
+    plugin.serve()
+    try:
+        reg = kubelet.wait_for_registration()
+        resp = kubelet.allocate(
+            reg.endpoint, [[f"g{i}" for i in range(units)]]
+        )
+        assert resp.container_responses[0].envs[const.ENV_TPU_VISIBLE_CHIPS]
+    finally:
+        plugin.stop()
+        kubelet.stop()
+    return raw
+
+
+def test_one_admission_one_stitched_trace(cluster, tmp_path):
+    """The acceptance property: extender verbs, WAL, PATCH, the gRPC
+    Allocate, and env injection all land in ONE trace, with the plugin's
+    root span parented under the extender's bind span."""
+    api, client, informer = cluster
+    raw = _admit_one(api, client, informer, tmp_path)
+    trace_id, _, bind_span_id = raw.partition(":")
+    spans = tracing.STORE.trace(trace_id)
+    names = {s.name for s in spans}
+    for required in (
+        "admission", "extender.filter", "extender.decide", "extender.bind",
+        "pod.patch", "pod.bindv1", "plugin.allocate", "allocator.admit",
+        "allocator.place", "wal.begin", "wal.commit", "allocator.env",
+    ):
+        assert required in names, (required, sorted(names))
+    plugin_root = next(s for s in spans if s.name == "plugin.allocate")
+    assert plugin_root.parent_id == bind_span_id
+    bind = next(s for s in spans if s.name == "extender.bind")
+    admission = next(s for s in spans if s.name == "admission")
+    assert bind.parent_id == admission.span_id
+    assert admission.status == "ok"
+    # every span in the set belongs to the one trace
+    assert {s.trace_id for s in spans} == {trace_id}
+
+
+def test_unsampled_admission_records_nothing(cluster, tmp_path):
+    api, client, informer = cluster
+    tracing.TRACER.configure(sample_ratio=0.0)
+    api.add_pod(make_pod("p0", 4, node=""))
+    core = ExtenderCore(client)
+    node = client.get_node(NODE)
+    core.filter({
+        "pod": client.get_pod("default", "p0"), "nodes": {"items": [node]},
+    })
+    r = core.bind({"podName": "p0", "podNamespace": "default", "node": NODE})
+    assert r["error"] == ""
+    ann = client.get_pod("default", "p0")["metadata"]["annotations"]
+    assert const.ANN_TRACE_ID not in ann
+    assert tracing.STORE.trace_ids() == []
+
+
+def test_traces_endpoint_serves_otlp(cluster, tmp_path):
+    api, client, informer = cluster
+    raw = _admit_one(api, client, informer, tmp_path)
+    trace_id = raw.split(":")[0]
+    srv = MetricsServer(host="127.0.0.1", port=0).start()
+    try:
+        url = f"http://127.0.0.1:{srv.port}"
+        doc = requests.get(f"{url}/traces", params={"trace_id": trace_id}).json()
+        flat = tracing.spans_from_otlp(doc)
+        assert {s["trace_id"] for s in flat} == {trace_id}
+        assert "plugin.allocate" in {s["name"] for s in flat}
+        # the unfiltered export contains it too
+        everything = tracing.spans_from_otlp(requests.get(f"{url}/traces").json())
+        assert trace_id in {s["trace_id"] for s in everything}
+    finally:
+        srv.stop()
+
+
+def test_inspect_trace_cli_renders_timeline(cluster, tmp_path, capsys, monkeypatch):
+    api, client, informer = cluster
+    _admit_one(api, client, informer, tmp_path)
+    monkeypatch.setattr(inspect_cli, "_client", lambda *a, **k: client)
+    srv = MetricsServer(host="127.0.0.1", port=0).start()
+    try:
+        rc = inspect_cli.main([
+            "trace", "default/p1",
+            "--traces-url", f"http://127.0.0.1:{srv.port}",
+        ])
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        for needle in (
+            "pod default/p1", "admission", "extender.bind",
+            "└─", "plugin.allocate", "allocator.env", "ms",
+        ):
+            assert needle in out, (needle, out)
+        # json mode emits the flat span list
+        rc = inspect_cli.main([
+            "trace", "default/p1",
+            "--traces-url", f"http://127.0.0.1:{srv.port}",
+            "-o", "json",
+        ])
+        assert rc == 0
+        spans = json.loads(capsys.readouterr().out)
+        assert any(s["name"] == "extender.bind" for s in spans)
+    finally:
+        srv.stop()
+
+
+def test_inspect_trace_cli_errors(cluster, capsys, monkeypatch):
+    api, client, informer = cluster
+    monkeypatch.setattr(inspect_cli, "_client", lambda *a, **k: client)
+    # pod without the annotation
+    api.add_pod(make_pod("bare", 4, node=NODE))
+    assert inspect_cli.main(["trace", "default/bare"]) == 1
+    assert "no " + const.ANN_TRACE_ID in capsys.readouterr().err.replace(
+        "carries no", "no"
+    )
+    # no --traces-url
+    api.add_pod(make_pod(
+        "annotated", 4, node=NODE,
+        annotations={const.ANN_TRACE_ID: "ab" * 16 + ":" + "cd" * 8},
+    ))
+    assert inspect_cli.main(["trace", "default/annotated"]) == 1
+    assert "--traces-url" in capsys.readouterr().err
+
+
+GOLDEN_SPANS = [
+    {"trace_id": "t1", "span_id": "a", "parent_id": "", "name": "admission",
+     "start_ns": 1_000_000_000, "end_ns": 1_012_000_000, "status": "ok",
+     "attributes": {"pod": "default/p1"}, "events": []},
+    {"trace_id": "t1", "span_id": "b", "parent_id": "a",
+     "name": "extender.filter", "start_ns": 1_000_100_000,
+     "end_ns": 1_000_900_000, "status": "ok", "attributes": {}, "events": []},
+    {"trace_id": "t1", "span_id": "c", "parent_id": "a",
+     "name": "extender.bind", "start_ns": 1_002_000_000,
+     "end_ns": 1_011_000_000, "status": "ok", "attributes": {"node": "n1"},
+     "events": []},
+    {"trace_id": "t1", "span_id": "d", "parent_id": "c", "name": "wal.begin",
+     "start_ns": 1_002_100_000, "end_ns": 1_003_100_000, "status": "ok",
+     "attributes": {}, "events": []},
+    {"trace_id": "t1", "span_id": "e", "parent_id": "c", "name": "pod.patch",
+     "start_ns": 1_003_200_000, "end_ns": 1_006_400_000, "status": "ok",
+     "attributes": {}, "events": []},
+]
+
+GOLDEN = """\
+trace t1
+admission                                    +    0.000ms    12.000ms  pod=default/p1
+├─ extender.filter                           +    0.100ms     0.800ms
+└─ extender.bind                             +    2.000ms     9.000ms  node=n1
+   ├─ wal.begin                              +    2.100ms     1.000ms
+   └─ pod.patch                              +    3.200ms     3.200ms
+"""
+
+
+def test_render_trace_golden():
+    assert render_trace(GOLDEN_SPANS) == GOLDEN
+
+
+def test_render_trace_orphans_become_roots():
+    # only the plugin process's endpoint was reachable: its spans point
+    # at a bind span we never fetched — they must still render
+    orphan = [dict(GOLDEN_SPANS[3], parent_id="missing")]
+    out = render_trace(orphan)
+    assert "wal.begin" in out
+    assert render_trace([]) == "(no spans)\n"
+
+
+# --- flight recorder --------------------------------------------------------
+
+
+@pytest.fixture
+def recorder(tmp_path):
+    fr = flightrec.FlightRecorder(store=tracing.STORE)
+    fr.install(str(tmp_path / "fr"))
+    yield fr
+    fr.uninstall()
+
+
+def test_flight_recorder_sigusr1(recorder, tmp_path):
+    with tracing.TRACER.span("admission"):
+        logutil.get_logger("test").warning("inside the admission")
+    assert recorder.install_signal_handler()
+    try:
+        os.kill(os.getpid(), signal.SIGUSR1)
+        deadline = time.monotonic() + 5
+        while recorder.dump_count == 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+    finally:
+        signal.signal(signal.SIGUSR1, signal.SIG_DFL)
+    assert recorder.dump_count == 1
+    files = list((tmp_path / "fr").glob("tpushare-flightrec-*-SIGUSR1.json"))
+    assert len(files) == 1
+    doc = flightrec.load_dump(str(files[0]))
+    assert doc["reason"] == "SIGUSR1"
+    assert doc["trace_count"] == 1
+    names = {s["name"] for s in tracing.spans_from_otlp(doc["traces"])}
+    assert "admission" in names
+    entry = next(e for e in doc["logs"] if "inside the admission" in e["message"])
+    assert entry["trace_id"]  # log ring carries trace correlation
+
+
+def test_flight_recorder_on_injected_crash(recorder, tmp_path):
+    with FAULTS.injected("checkpoint.begin", "crash", times=1):
+        with pytest.raises(SimulatedCrash):
+            FAULTS.fire("checkpoint.begin")
+    files = list((tmp_path / "fr").glob("*crash-checkpoint-begin*.json"))
+    assert len(files) == 1
+    assert flightrec.load_dump(str(files[0]))["reason"] == "crash:checkpoint.begin"
+
+
+def test_flight_recorder_on_fatal(recorder, tmp_path):
+    with pytest.raises(SystemExit):
+        logutil.get_logger("test").fatal("config exploded")
+    files = list((tmp_path / "fr").glob("*fatal*.json"))
+    assert len(files) == 1
+    doc = flightrec.load_dump(str(files[0]))
+    assert doc["reason"].startswith("fatal:")
+    assert any("config exploded" in e["message"] for e in doc["logs"])
+
+
+def test_flight_recorder_log_ring_bounded(tmp_path):
+    fr = flightrec.FlightRecorder(store=tracing.STORE, max_logs=5)
+    fr.install(str(tmp_path / "fr2"))
+    try:
+        lg = logutil.get_logger("ringtest")
+        for i in range(20):
+            lg.warning("msg %d", i)
+        ring = [e for e in fr.recent_logs() if e["logger"] == "ringtest"]
+        assert len(ring) <= 5
+        assert ring[-1]["message"] == "msg 19"
+    finally:
+        fr.uninstall()
+
+
+def test_inspect_flightrecord_cli(recorder, tmp_path, capsys):
+    with tracing.TRACER.span("admission", attributes={"pod": "default/p9"}):
+        logutil.get_logger("test").warning("chip pressure")
+    path = recorder.dump("unit-test")
+    rc = inspect_cli.main(["flightrecord", path])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "reason=unit-test" in out
+    assert "admission" in out
+    assert "chip pressure" in out
+    rc = inspect_cli.main(["flightrecord", path, "-o", "json"])
+    assert rc == 0
+    assert json.loads(capsys.readouterr().out)["reason"] == "unit-test"
+    assert inspect_cli.main(["flightrecord", str(tmp_path / "nope.json")]) == 1
+    capsys.readouterr()
+
+
+def test_render_flightrecord_caps_traces(recorder):
+    for i in range(8):
+        with tracing.TRACER.span(f"admission-{i}"):
+            pass
+    out = render_flightrecord(recorder.snapshot("cap"), max_traces=3)
+    assert "showing the last 3 of 8 traces" in out
+
+
+# --- exemplars + log correlation -------------------------------------------
+
+
+def test_exemplars_link_metrics_to_traces(cluster, tmp_path):
+    """The /metrics histogram buckets carry exemplar trace ids (in the
+    OpenMetrics exposition) pointing at real admission traces."""
+    from gpushare_device_plugin_tpu.utils.metrics import REGISTRY
+
+    api, client, informer = cluster
+    raw = _admit_one(api, client, informer, tmp_path)
+    trace_id = raw.split(":")[0]
+    srv = MetricsServer(registry=REGISTRY, host="127.0.0.1", port=0).start()
+    try:
+        url = f"http://127.0.0.1:{srv.port}/metrics"
+        om = requests.get(
+            url, headers={"Accept": "application/openmetrics-text"}
+        )
+        assert "openmetrics" in om.headers["Content-Type"]
+        exemplar_lines = [
+            line for line in om.text.splitlines()
+            if "tpushare_allocate_seconds_bucket" in line and "trace_id=" in line
+        ]
+        assert exemplar_lines, om.text[-2000:]
+        assert any(trace_id in line for line in exemplar_lines)
+        assert om.text.rstrip().endswith("# EOF")
+        # the classic 0.0.4 exposition stays exemplar-free
+        classic = requests.get(url)
+        assert "version=0.0.4" in classic.headers["Content-Type"]
+        assert "trace_id=" not in classic.text
+    finally:
+        srv.stop()
+
+
+def test_log_lines_carry_trace_ids():
+    buf = io.StringIO()
+    logutil.setup(0, stream=buf)
+    lg = logutil.get_logger("corr")
+    lg.info("outside")
+    with tracing.TRACER.span("admission") as sp:
+        lg.info("inside")
+    out = buf.getvalue()
+    outside, inside = [l for l in out.splitlines() if "side" in l]
+    assert sp.trace_id[:8] not in outside
+    assert sp.trace_id[:8] in inside

@@ -1,0 +1,222 @@
+"""Unit tests for the zero-dependency tracing layer (utils/tracing.py):
+span lifecycle, thread-local parenting, sampling, cross-process context
+propagation/adoption, store bounding, OTLP-JSON shape, and the admission
+root registry."""
+
+import threading
+
+import pytest
+
+from gpushare_device_plugin_tpu import const
+from gpushare_device_plugin_tpu.utils import tracing
+from gpushare_device_plugin_tpu.utils.tracing import (
+    NOOP_SPAN,
+    AdmissionTraces,
+    SpanContext,
+    TraceStore,
+    Tracer,
+    parse_context,
+    spans_from_otlp,
+)
+
+
+@pytest.fixture
+def tracer():
+    return Tracer(store=TraceStore())
+
+
+def test_annotation_key_agrees_with_const():
+    # tracing must stay import-light (no package imports), so the
+    # annotation key is duplicated; this is the contract they agree
+    assert tracing.TRACE_ANNOTATION == const.ANN_TRACE_ID
+
+
+def test_span_nesting_and_store(tracer):
+    with tracer.span("root", attributes={"k": 1}) as root:
+        assert tracer.current_span() is root
+        with tracer.span("child") as child:
+            assert child.trace_id == root.trace_id
+            assert child.parent_id == root.span_id
+    spans = tracer.store.trace(root.trace_id)
+    assert sorted(s.name for s in spans) == ["child", "root"]
+    assert all(s.end_ns >= s.start_ns for s in spans)
+    assert tracer.current_span() is None
+
+
+def test_span_error_status(tracer):
+    with pytest.raises(ValueError):
+        with tracer.span("boom") as sp:
+            raise ValueError("x")
+    (span,) = tracer.store.trace(sp.trace_id)
+    assert span.status == "error"
+    assert "ValueError" in span.attributes["error"]
+
+
+def test_sampling_zero_is_noop(tracer):
+    t = Tracer(store=TraceStore(), sample_ratio=0.0)
+    with t.span("x") as sp:
+        assert sp is NOOP_SPAN
+        sp.set_attribute("k", "v")  # all no-ops
+        sp.add_event("e")
+    assert t.store.trace_ids() == []
+    # children of an unsampled root are unsampled too
+    with t.span("root"):
+        with t.span("child") as c:
+            assert not c.recording
+
+
+def test_child_only_never_roots(tracer):
+    with tracer.span("deep", child_only=True) as sp:
+        assert not sp.recording  # no current span -> no-op, not a new root
+    assert tracer.store.trace_ids() == []
+    with tracer.span("root") as root:
+        with tracer.span("deep", child_only=True) as sp:
+            assert sp.recording and sp.trace_id == root.trace_id
+
+
+def test_context_encode_parse_roundtrip(tracer):
+    with tracer.span("x") as sp:
+        ctx = sp.context()
+    parsed = parse_context(ctx.encode())
+    assert parsed.trace_id == ctx.trace_id
+    assert parsed.span_id == ctx.span_id
+    # tolerant forms
+    bare = parse_context(ctx.trace_id)
+    assert bare.trace_id == ctx.trace_id and bare.span_id == ""
+    assert parse_context("") is None
+    assert parse_context(None) is None
+    assert parse_context("garbage") is None
+    assert parse_context("zz" * 16 + ":" + "ab" * 8) is None
+    # garbled span half degrades to trace-only
+    assert parse_context(ctx.trace_id + ":nothex").span_id == ""
+
+
+def test_adopt_current_trace(tracer):
+    remote = SpanContext("ab" * 16, "cd" * 8)
+    with tracer.span("plugin.allocate") as outer:
+        with tracer.span("inner") as inner:
+            assert tracer.adopt_current_trace(remote)
+            assert outer.trace_id == remote.trace_id
+            assert inner.trace_id == remote.trace_id
+            assert outer.parent_id == remote.span_id
+            # children created after adoption land in the adopted trace
+            with tracer.span("late") as late:
+                assert late.trace_id == remote.trace_id
+    assert len(tracer.store.trace(remote.trace_id)) == 3
+    # no open spans -> nothing to adopt
+    assert not tracer.adopt_current_trace(remote)
+    # None / unsampled contexts are no-ops
+    with tracer.span("x"):
+        assert not tracer.adopt_current_trace(None)
+        assert not tracer.adopt_current_trace(
+            SpanContext("ef" * 16, "ab" * 8, sampled=False)
+        )
+
+
+def test_threads_have_independent_stacks(tracer):
+    seen = {}
+
+    def worker():
+        seen["worker_current"] = tracer.current_span()
+
+    with tracer.span("main-root"):
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+    assert seen["worker_current"] is None
+
+
+def test_store_bounded_eviction():
+    store = TraceStore(max_traces=3)
+    t = Tracer(store=store)
+    ids = []
+    for i in range(5):
+        with t.span(f"r{i}") as sp:
+            ids.append(sp.trace_id)
+    kept = store.trace_ids()
+    assert len(kept) == 3
+    assert kept == ids[-3:]  # oldest evicted whole
+    assert store.dropped() == 2
+
+
+def test_store_span_cap():
+    store = TraceStore(max_spans_per_trace=2)
+    t = Tracer(store=store)
+    with t.span("root") as root:
+        for i in range(4):
+            with t.span(f"c{i}"):
+                pass
+    assert len(store.trace(root.trace_id)) == 2
+
+
+def test_record_span_explicit_timestamps(tracer):
+    ctx = tracer.record_span("serve.request", 100, 200, attributes={"rid": 7})
+    tracer.record_span("serve.queue", 100, 120, parent=ctx)
+    spans = {s.name: s for s in tracer.store.trace(ctx.trace_id)}
+    assert spans["serve.request"].start_ns == 100
+    assert spans["serve.request"].end_ns == 200
+    assert spans["serve.queue"].parent_id == ctx.span_id
+    # unsampled tracer records nothing
+    t0 = Tracer(store=TraceStore(), sample_ratio=0.0)
+    assert t0.record_span("x", 0, 1) is None
+
+
+def test_otlp_export_shape_and_roundtrip(tracer):
+    with tracer.span("root", attributes={"pod": "default/p", "n": 3}) as sp:
+        sp.add_event("claimed", chip=2)
+    doc = tracer.store.to_otlp()
+    (rs,) = doc["resourceSpans"]
+    assert rs["resource"]["attributes"][0]["key"] == "service.name"
+    flat = spans_from_otlp(doc)
+    (span,) = flat
+    assert span["name"] == "root"
+    assert span["trace_id"] == sp.trace_id
+    assert span["attributes"]["pod"] == "default/p"
+    assert span["attributes"]["n"] == 3
+    assert span["events"][0]["name"] == "claimed"
+    assert span["events"][0]["attributes"]["chip"] == 2
+    # narrowing by trace id
+    assert spans_from_otlp(tracer.store.to_otlp(trace_id="no-such")) == []
+
+
+def test_admission_traces_registry(tracer):
+    adm = AdmissionTraces(tracer)
+    ctx = adm.root("default", "p1")
+    assert ctx is not None
+    assert adm.root("default", "p1").trace_id == ctx.trace_id  # same trace
+    assert adm.open_count() == 1
+    adm.finish("default", "p1", "ok")
+    assert adm.open_count() == 0
+    (root,) = tracer.store.trace(ctx.trace_id)
+    assert root.name == "admission" and root.status == "ok"
+    # finish on an unknown pod is a no-op
+    adm.finish("default", "nope")
+
+
+def test_admission_traces_bounded():
+    t = Tracer(store=TraceStore(max_traces=64))
+    adm = AdmissionTraces(t, max_pods=2)
+    c1 = adm.root("ns", "a")
+    adm.root("ns", "b")
+    adm.root("ns", "c")  # evicts a
+    assert adm.open_count() == 2
+    (root_a,) = t.store.trace(c1.trace_id)
+    assert root_a.status == "unfinished"
+
+
+def test_admission_traces_unsampled():
+    t = Tracer(store=TraceStore(), sample_ratio=0.0)
+    adm = AdmissionTraces(t)
+    assert adm.root("ns", "a") is None
+    assert adm.open_count() == 0
+
+
+def test_unsampled_hot_path_allocates_no_ids():
+    """The O(ns) claim in spirit: an unsampled root span is the shared
+    no-op singleton — no id generation, no store append, reusable."""
+    t = Tracer(store=TraceStore(), sample_ratio=0.0)
+    spans = [t.start_span(f"s{i}") for i in range(3)]
+    assert all(sp is NOOP_SPAN for sp in spans)
+    for sp in spans:
+        sp.end()
+    assert t.store.trace_ids() == []

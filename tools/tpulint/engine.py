@@ -16,6 +16,11 @@ here are *project-specific theorems*, not generic style checks:
   paths (try/except/finally aware; unhandled propagation is legal — the
   restart replay + reconciler resolve those), and no persist write runs
   before its begin.
+- ``span-leak`` (rules_spans): every ``tracer.start_span()`` is
+  dominated by ``end()`` on ALL paths out of the function (raise paths
+  included — nothing replays a leaked span); discarded start_span
+  results are findings outright. ``with TRACER.span(...)`` is the
+  structurally-safe form. Same CFG-outcome machinery as wal-protocol.
 - ``ledger-encapsulation`` (rules_encapsulation): the AssumeCache /
   ClusterUsageIndex / NodeChipUsage internals are mutated only inside
   their own modules — the exact class of bug PR 6's gang storms caught.
@@ -142,6 +147,7 @@ def _registry() -> dict[str, RuleFn]:
         rules_hygiene,
         rules_locks,
         rules_pyflakes_lite,
+        rules_spans,
         rules_wal,
     )
 
@@ -150,6 +156,7 @@ def _registry() -> dict[str, RuleFn]:
         "lock-io": rules_locks.check_lock_io,
         "lock-unranked": rules_locks.check_unranked_locks,
         "wal-protocol": rules_wal.check_wal_protocol,
+        "span-leak": rules_spans.check_span_leak,
         "ledger-encapsulation": rules_encapsulation.check_encapsulation,
         "hygiene": rules_hygiene.check_hygiene,
         "unused-import": rules_pyflakes_lite.check_unused_imports,

@@ -1,0 +1,157 @@
+"""``span-leak`` rule: every manually started tracing span is dominated
+by ``end()`` on all paths.
+
+The tracing API (``utils/tracing.py``) has two shapes: the context
+manager ``with TRACER.span(...)`` — structurally leak-free, exit always
+ends — and the explicit ``sp = TRACER.start_span(...)`` escape hatch for
+spans whose lifetime crosses statement structure. A started span that is
+never ended silently never reaches the trace store: the admission it
+described vanishes from ``/traces``, the flight recorder, and the
+exemplars — an observability hole that "works" in every test that only
+checks behavior. This rule makes the hole a lint finding:
+
+- ``X.start_span(...)`` whose result is discarded is a leak outright;
+- an assigned span must reach a ``<var>.end(...)`` on **every** path out
+  of the function — fallthrough, ``return``, and explicit ``raise``
+  included (unlike the WAL rule, where propagation is legal because
+  restart replay resolves the entry, nothing resolves a leaked span);
+  wrap the region in ``try/finally`` or use the context manager.
+
+``utils/tracing.py`` itself is exempt: the ``AdmissionTraces`` registry
+holds per-pod root spans open across webhook verbs by design (bounded +
+TTL'd there). Receiver hints: ``TRACER``/``tracer``/``_tracer``, same
+curated-name approach as the lock and WAL rules.
+
+Shares the CFG-outcome machinery with ``rules_wal`` (R/T/F/RET lattice
+over try/except/finally/loops) via a span-specific resolve predicate.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .engine import Finding, Module
+from .rules_wal import F, R, RET, T, _path_to, eval_outcomes
+
+TRACER_RECEIVERS = ("TRACER", "tracer", "_tracer")
+EXEMPT = ("gpushare_device_plugin_tpu/utils/tracing.py",)
+
+
+def _is_start_span_call(node: ast.Call) -> bool:
+    fn = node.func
+    if not (isinstance(fn, ast.Attribute) and fn.attr == "start_span"):
+        return False
+    recv = fn.value
+    name = None
+    if isinstance(recv, ast.Name):
+        name = recv.id
+    elif isinstance(recv, ast.Attribute):
+        name = recv.attr
+    return name in TRACER_RECEIVERS
+
+
+def _ends_var(var: str):
+    """Resolve predicate: does this statement call ``<var>.end(...)``?"""
+
+    def is_resolve(stmt: ast.stmt) -> bool:
+        for n in ast.walk(stmt):
+            if isinstance(n, ast.Call):
+                fn = n.func
+                if (
+                    isinstance(fn, ast.Attribute)
+                    and fn.attr == "end"
+                    and isinstance(fn.value, ast.Name)
+                    and fn.value.id == var
+                ):
+                    return True
+        return False
+
+    return is_resolve
+
+
+def _start_assignments(
+    fn: ast.FunctionDef,
+) -> list[tuple[ast.stmt, str | None]]:
+    """(statement, assigned-name-or-None) for every start_span call at
+    statement level; None means the span object was discarded."""
+    out: list[tuple[ast.stmt, str | None]] = []
+    for node in ast.walk(fn):
+        if not isinstance(node, (ast.Assign, ast.Expr)):
+            continue
+        value = node.value
+        if not (isinstance(value, ast.Call) and _is_start_span_call(value)):
+            continue
+        var = None
+        if isinstance(node, ast.Assign):
+            if len(node.targets) == 1 and isinstance(node.targets[0], ast.Name):
+                var = node.targets[0].id
+        out.append((node, var))
+    return out
+
+
+def _leak_message(fn: ast.FunctionDef, stmt: ast.stmt, var: str) -> str | None:
+    path = _path_to(fn.body, stmt)
+    if path is None:
+        return None  # inside a lambda/nested def we don't model
+    is_resolve = _ends_var(var)
+    outcomes = {F}
+    for level in range(len(path) - 1, -1, -1):
+        block, idx = path[level]
+        if F in outcomes:
+            outcomes.discard(F)
+            outcomes |= eval_outcomes(block[idx + 1:], is_resolve)
+        # Leaving a try's body/handler/orelse passes through its finally
+        # on EVERY path — raise and return included — so an enclosing
+        # finally that resolves unconditionally absolves all outcomes:
+        # the canonical "start inside try / end() in a finally" shape.
+        # (Never break early on a resolved-looking outcome set: an outer
+        # resolving finally can still matter for T/RET paths.)
+        owner = path[level - 1][0][path[level - 1][1]] if level else None
+        if (
+            isinstance(owner, ast.Try)
+            and owner.finalbody
+            and block is not owner.finalbody
+            and eval_outcomes(owner.finalbody, is_resolve) == {R}
+        ):
+            return None
+    leaks = []
+    if F in outcomes:
+        leaks.append("a normal completion path")
+    if RET in outcomes:
+        leaks.append("a return path")
+    if T in outcomes:
+        leaks.append("a raise path")
+    if not leaks:
+        return None
+    return (
+        f"span {var!r} from start_span() is not end()ed on "
+        + " and ".join(leaks)
+        + " — the span never reaches the trace store; use "
+        "`with TRACER.span(...)` or end() in a finally"
+    )
+
+
+def check_span_leak(modules: list[Module]) -> list[Finding]:
+    findings: list[Finding] = []
+    for mod in modules:
+        if mod.path in EXEMPT:
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.FunctionDef):
+                continue
+            for stmt, var in _start_assignments(node):
+                if var is None:
+                    findings.append(
+                        Finding(
+                            mod.path, stmt.lineno, "span-leak",
+                            "start_span() result discarded — the span can "
+                            "never be end()ed; use `with TRACER.span(...)`",
+                        )
+                    )
+                    continue
+                msg = _leak_message(node, stmt, var)
+                if msg:
+                    findings.append(
+                        Finding(mod.path, stmt.lineno, "span-leak", msg)
+                    )
+    return findings

@@ -74,32 +74,38 @@ def _is_resolve(node: ast.stmt) -> bool:
     return False
 
 
-# Path outcomes for the CFG-lite evaluator.
+# Path outcomes for the CFG-lite evaluator. The machinery is shared: the
+# ``span-leak`` rule (rules_spans) evaluates the same outcome lattice
+# with a different resolve predicate, so both predicates thread through.
 R = "resolved"      # a resolve ran; subsequent flow is fine
 T = "terminated"    # raised: entry stays pending for replay (legal)
 F = "fallthrough"   # completed the block without resolving yet
 RET = "returned"    # returned without resolving: a defect
 
 
-def _stmt_outcomes(stmt: ast.stmt) -> set[str]:
-    if _is_resolve(stmt):
+def stmt_outcomes(stmt: ast.stmt, is_resolve=None) -> set[str]:
+    """Outcome set of one statement under ``is_resolve`` (defaults to
+    the WAL commit/abort detector)."""
+    if is_resolve is None:
+        is_resolve = _is_resolve
+    if is_resolve(stmt):
         return {R}
     if isinstance(stmt, ast.Raise):
         return {T}
     if isinstance(stmt, ast.Return):
         return {RET}
     if isinstance(stmt, ast.Try):
-        body = _eval(stmt.body)
+        body = eval_outcomes(stmt.body, is_resolve)
         if F in body and stmt.orelse:
-            body = (body - {F}) | _eval(stmt.orelse)
+            body = (body - {F}) | eval_outcomes(stmt.orelse, is_resolve)
         out = set(body)
         for handler in stmt.handlers:
-            hout = _eval(handler.body)
+            hout = eval_outcomes(handler.body, is_resolve)
             # a handler can be entered from any point in the body —
             # including before a resolve — so its own outcomes stand alone
             out |= hout
         if stmt.finalbody:
-            fin = _eval(stmt.finalbody)
+            fin = eval_outcomes(stmt.finalbody, is_resolve)
             if fin == {R}:
                 # the finally resolves unconditionally: every exit path
                 # (normal, return, raise) passes through it
@@ -107,31 +113,37 @@ def _stmt_outcomes(stmt: ast.stmt) -> set[str]:
             out |= fin - {F}
         return out
     if isinstance(stmt, ast.If):
-        return _eval(stmt.body) | (_eval(stmt.orelse) if stmt.orelse else {F})
+        return eval_outcomes(stmt.body, is_resolve) | (
+            eval_outcomes(stmt.orelse, is_resolve) if stmt.orelse else {F}
+        )
     if isinstance(stmt, (ast.For, ast.While)):
-        body = _eval(stmt.body)
+        body = eval_outcomes(stmt.body, is_resolve)
         # the loop may run zero times (fallthrough), and break/continue
         # fold into fallthrough/retry conservatively
         out = {F} | (body - {F})
         if stmt.orelse:
-            out |= _eval(stmt.orelse)
+            out |= eval_outcomes(stmt.orelse, is_resolve)
         return out
     if isinstance(stmt, ast.With):
-        return _eval(stmt.body)
+        return eval_outcomes(stmt.body, is_resolve)
     if isinstance(stmt, (ast.Break, ast.Continue)):
         return {F}
     return {F}
 
 
-def _eval(stmts: list[ast.stmt]) -> set[str]:
+def eval_outcomes(stmts: list[ast.stmt], is_resolve=None) -> set[str]:
     """Outcomes of executing a statement list from its start."""
     outcomes = {F}
     for stmt in stmts:
         if F not in outcomes:
             break
         outcomes.discard(F)
-        outcomes |= _stmt_outcomes(stmt)
+        outcomes |= stmt_outcomes(stmt, is_resolve)
     return outcomes
+
+
+def _eval(stmts: list[ast.stmt]) -> set[str]:
+    return eval_outcomes(stmts, _is_resolve)
 
 
 def _path_to(stmts: list[ast.stmt], target: ast.stmt) -> list[tuple[list[ast.stmt], int]] | None:
