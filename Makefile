@@ -1,7 +1,7 @@
 PY ?= python
 PROTOC ?= protoc
 
-.PHONY: proto native test test-fast test-slow test-stress chaos chaos-restart lint lint-strict typecheck bench bench-smoke bench-serve-smoke bench-multichip-smoke bench-wal bench-trace trace-smoke e2e-kind
+.PHONY: proto native test test-fast test-slow test-stress chaos chaos-restart lint lint-strict typecheck bench bench-smoke bench-serve-smoke bench-multichip-smoke bench-paged-smoke bench-wal bench-trace trace-smoke e2e-kind
 
 # Regenerate protobuf message classes (gRPC bindings are hand-written in
 # gpushare_device_plugin_tpu/plugin/api/api_grpc.py; grpc_tools is not
@@ -113,6 +113,15 @@ bench-serve-smoke:
 # tests/test_bench_multichip_smoke.py. See docs/scheduling.md.
 bench-multichip-smoke:
 	$(PY) bench_mfu.py --multichip-smoke
+
+# Paged-KV serving smoke (CPU, seconds): the serve_paged section alone —
+# the paged+radix engine vs the contiguous slot engine on the SAME
+# aliyun.com/tpu-mem byte budget over a shared-prefix Poisson trace with
+# SLO tiers. Hard-fails on retraces, token-parity loss, <2x admitted
+# concurrency, or zero prefix-cache hits. Tier-1 runs it via
+# tests/test_bench_paged_smoke.py. See docs/serving.md.
+bench-paged-smoke:
+	$(PY) bench_mfu.py --paged-smoke
 
 # Group-commit WAL A/B: the 16-way admission storm with the journal in
 # per-record-fsync ('always') then group-commit ('batch') mode. Reports

@@ -879,6 +879,29 @@ def serve_ttft_guard(p99_ms: float | None, repo: Path) -> str | None:
     )
 
 
+def serve_paged_goodput_guard(tokens_s: float | None, repo: Path) -> str | None:
+    """Failure message when the PAGED engine's goodput dropped
+    >P99_GUARD_PCT below the newest committed record carrying it (the
+    paged bench's ``serve_paged_goodput_tokens_per_s``); None when within
+    budget or no history. Lower is worse (throughput)."""
+    return _pct_trend_guard(
+        tokens_s, repo, field="serve_paged_goodput_tokens_per_s",
+        label="serve paged goodput", fmt=".1f", unit=" tokens/s",
+        lower_is_worse=True,
+    )
+
+
+def prefix_hit_guard(ratio: float | None, repo: Path) -> str | None:
+    """Same budget for the radix prefix-cache hit ratio
+    (``serve_prefix_hit_ratio``) on the bench's shared-prefix trace: a
+    silent drop means requests re-prefill system prompts the cache used
+    to serve — the capacity the paged pool exists to reclaim."""
+    return _pct_trend_guard(
+        ratio, repo, field="serve_prefix_hit_ratio",
+        label="prefix hit ratio", fmt=".4f", lower_is_worse=True,
+    )
+
+
 def run_compute_bench(repo: Path, backend_init_timeout: float = 60.0) -> dict:
     """bench_mfu.py in a subprocess; {} on any failure (never fatal here).
 
@@ -1237,6 +1260,13 @@ def main(argv=None) -> int:
         .get("engine", {}).get("goodput_tokens_per_s"),
         "serve_ttft_p99_ms": compute.get("serve_engine", {})
         .get("engine", {}).get("ttft_p99_ms"),
+        # Paged-KV serve numbers (serve_paged section), hoisted for the
+        # trend guards: paged goodput and the radix prefix-hit ratio on
+        # the shared-prefix trace.
+        "serve_paged_goodput_tokens_per_s": compute.get("serve_paged", {})
+        .get("paged", {}).get("goodput_tokens_per_s"),
+        "serve_prefix_hit_ratio": compute.get("serve_paged", {})
+        .get("prefix_hit_ratio"),
         # Gang-admission storm numbers, hoisted like the WAL fields; the
         # zero-partial/zero-double invariants already hard-failed above.
         "gang_throughput_gangs_s": gang.get("throughput_gangs_s"),
@@ -1259,6 +1289,10 @@ def main(argv=None) -> int:
         msgs.append(wal_fsync_p99_guard(record["wal_fsync_p99_ms"], repo))
         msgs.append(serve_goodput_guard(record["serve_goodput_tokens_per_s"], repo))
         msgs.append(serve_ttft_guard(record["serve_ttft_p99_ms"], repo))
+        msgs.append(serve_paged_goodput_guard(
+            record["serve_paged_goodput_tokens_per_s"], repo
+        ))
+        msgs.append(prefix_hit_guard(record["serve_prefix_hit_ratio"], repo))
         msgs.append(gang_storm_guard(record["gang_throughput_gangs_s"], repo))
     if not args.no_util_guard:
         msgs.append(utilization_guard(record["binpack_utilization_pct"], repo))

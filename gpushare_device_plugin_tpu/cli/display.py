@@ -83,6 +83,38 @@ def _gang_cell(pod, info: NodeInfo, unit: str) -> str:
     return f"{pod.gang_shape} @ {coords} · {pod.gang_per_chip} {unit}/chip"
 
 
+def _engine_cell(row: dict[str, float]) -> str:
+    """One serving pod's cache telemetry as a compact cell: KV page
+    occupancy, radix prefix-cache hit ratio, and the preemption count —
+    the ``tpushare_engine_*`` families scraped from the pod's
+    ``/metrics`` endpoint (``inspect.parse_engine_metrics`` keys, prefix
+    already stripped)."""
+    parts = []
+    total = row.get("kv_pages_total")
+    if total is not None:
+        used = row.get("kv_pages_used")
+        if used is None:
+            used = total - row.get("kv_pages_free", 0.0)
+        parts.append(f"pages {int(used)}/{int(total)}")
+    hit = row.get("prefix_hit_ratio")
+    if hit is not None:
+        parts.append(f"prefix {100.0 * hit:.0f}%")
+    pre = row.get("preemptions_total", row.get("preemptions"))
+    if pre is not None:
+        parts.append(f"preempt {int(pre)}")
+    return " · ".join(parts) or "-"
+
+
+def engine_row_for(pod, engine: dict[str, dict[str, float]] | None):
+    """The scraped telemetry row for ``pod``, matched by the engine's
+    ``pod`` metrics label: ``namespace/name`` first, then the bare pod
+    name (what a pod that only knows its own name exports). ``None``
+    when the pod runs no serving engine (or none was scraped)."""
+    if not engine:
+        return None
+    return engine.get(f"{pod.namespace}/{pod.name}") or engine.get(pod.name)
+
+
 def render_trace(spans: list[dict]) -> str:
     """Render one admission/serving trace as an offset/duration tree.
 
@@ -188,15 +220,23 @@ def render_flightrecord(doc: dict, max_traces: int = 5, max_logs: int = 20) -> s
     return buf.getvalue()
 
 
-def render_details(infos: list[NodeInfo]) -> str:
+def render_details(
+    infos: list[NodeInfo],
+    engine: dict[str, dict[str, float]] | None = None,
+) -> str:
     unit = infer_unit(infos)
     buf = StringIO()
     for info in infos:
         buf.write(f"NAME: {info.name} ({info.address})\n")
         any_gang = any(p.is_gang for p in info.pods)
+        any_engine = engine is not None and any(
+            engine_row_for(p, engine) for p in info.pods
+        )
         header = ["NAMESPACE", "NAME", f"TPU MEMORY ({unit})", "CHIPS"]
         if any_gang:
             header.append("GANG (shape @ coords)")
+        if any_engine:
+            header.append("SERVING CACHE")
         rows = [header]
         for pod in sorted(info.pods, key=lambda p: (p.namespace, p.name)):
             chips = ", ".join(
@@ -206,6 +246,9 @@ def render_details(infos: list[NodeInfo]) -> str:
             row = [pod.namespace, pod.name, str(pod.total_units), chips]
             if any_gang:
                 row.append(_gang_cell(pod, info, unit) if pod.is_gang else "-")
+            if any_engine:
+                erow = engine_row_for(pod, engine)
+                row.append(_engine_cell(erow) if erow else "-")
             rows.append(row)
         buf.write(_table(rows))
         buf.write("\n")

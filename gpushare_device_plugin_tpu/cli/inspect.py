@@ -76,6 +76,60 @@ def fetch_trace_spans(urls: list[str], trace_id: str) -> list[dict]:
     return sorted(spans.values(), key=lambda s: (s["start_ns"], s["name"]))
 
 
+def parse_engine_metrics(text: str) -> dict[str, dict[str, float]]:
+    """Pull the serving engine's cache telemetry out of a ``/metrics``
+    exposition: ``tpushare_engine_*`` samples keyed by their ``pod``
+    label (``""`` for unlabeled engines). Families: KV page occupancy
+    (``kv_pages_total/used/free``), ``prefix_hit_ratio``,
+    ``prefix_cached_pages``, and the ``preemptions`` gauge /
+    ``preemptions_total`` counter."""
+    out: dict[str, dict[str, float]] = {}
+    for line in text.splitlines():
+        if not line.startswith("tpushare_engine_") or line.startswith("#"):
+            continue
+        try:
+            metric, value = line.rsplit(None, 1)
+            val = float(value)
+        except ValueError:
+            continue
+        pod = ""
+        if "{" in metric:
+            name, labels = metric.split("{", 1)
+            labels = labels.rstrip("}")
+            for part in labels.split(","):
+                if part.startswith("pod="):
+                    pod = part[4:].strip('"').replace('\\"', '"')
+        else:
+            name = metric
+        short = name[len("tpushare_engine_"):]
+        out.setdefault(pod, {})[short] = val
+    return out
+
+
+def fetch_engine_metrics(urls: list[str]) -> dict[str, dict[str, float]]:
+    """Scrape serving-cache telemetry from every ``/metrics`` endpoint
+    given (each serving pod's engine exports under its own ``pod``
+    label). Unreachable endpoints warn but do not fail — partial
+    telemetry beats none (same policy as :func:`fetch_trace_spans`)."""
+    import requests
+
+    out: dict[str, dict[str, float]] = {}
+    for url in urls:
+        full = url.rstrip("/")
+        if not full.endswith("/metrics"):
+            full += "/metrics"
+        try:
+            resp = requests.get(full, timeout=10)
+            resp.raise_for_status()
+            text = resp.text
+        except Exception as e:  # noqa: BLE001 — partial scrape by design
+            print(f"warning: {full} unreachable: {e}", file=sys.stderr)
+            continue
+        for pod, row in parse_engine_metrics(text).items():
+            out.setdefault(pod, {}).update(row)
+    return out
+
+
 def trace_main(argv: list[str]) -> int:
     p = argparse.ArgumentParser(
         prog="kubectl-inspect-tpushare trace",
@@ -176,6 +230,12 @@ def main(argv=None) -> int:
     p.add_argument("-o", "--output", default="table", choices=["table", "json"],
                    help="output format (json: machine-readable, for "
                    "dashboards/automation)")
+    p.add_argument("--metrics-url", action="append", default=[],
+                   metavar="URL",
+                   help="serving pod /metrics endpoint to scrape for KV-"
+                   "page / prefix-cache / preemption telemetry (repeat "
+                   "per pod; implies --details so the per-pod SERVING "
+                   "CACHE column has rows to land on)")
     args = p.parse_args(argv)
 
     try:
@@ -187,22 +247,32 @@ def main(argv=None) -> int:
         print(f"error: cannot reach the cluster: {e}", file=sys.stderr)
         return 1
     infos = build_all_node_infos(nodes, pods)
+    engine = fetch_engine_metrics(args.metrics_url) if args.metrics_url else None
     if args.output == "json":
-        sys.stdout.write(render_json(infos))
+        sys.stdout.write(render_json(infos, engine))
         return 0
     if not infos:
         print("no shared-TPU nodes found (allocatable aliyun.com/tpu-mem is 0 everywhere)")
         return 0
-    out = render_details(infos) if args.details else render_summary(infos)
+    out = (
+        render_details(infos, engine)
+        if args.details or engine is not None
+        else render_summary(infos)
+    )
     sys.stdout.write(out)
     return 0
 
 
-def render_json(infos: list) -> str:
+def render_json(
+    infos: list, engine: dict[str, dict[str, float]] | None = None
+) -> str:
     """Machine-readable report: the same numbers the tables show,
-    including the north-star cluster utilization line."""
+    including the north-star cluster utilization line. ``engine``
+    (``fetch_engine_metrics`` output) attaches each serving pod's cache
+    telemetry as a ``serving_cache`` sub-document."""
     import json
 
+    from .display import engine_row_for
     from .nodeinfo import infer_unit
 
     total = sum(n.total_units for n in infos)
@@ -242,6 +312,11 @@ def render_json(infos: list) -> str:
                             },
                         }
                         if p.is_gang
+                        else {}
+                    ),
+                    **(
+                        {"serving_cache": engine_row_for(p, engine)}
+                        if engine_row_for(p, engine)
                         else {}
                     ),
                 }

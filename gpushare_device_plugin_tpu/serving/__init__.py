@@ -4,18 +4,34 @@
 admitted into fixed KV-cache slots and retired per decode step, with
 chunked prefill interleaved between decode steps — see
 ``docs/serving.md`` (continuous batching) and ``workloads/generate.py``
-for the slot-cache primitives it composes.
+for the slot-cache primitives it composes. ``pages`` + ``radix`` +
+:class:`~.engine.PagedSlotEngine` replace the per-request ``max_len``
+row with reference-counted fixed-size KV pages, a shared-prefix radix
+cache, and SLO-tiered admission with best-effort preemption
+(``docs/serving.md``, paged KV section).
 """
 
 from .engine import (  # noqa: F401
+    TIER_BEST_EFFORT,
+    TIER_CRITICAL,
+    PagedSlotEngine,
     Request,
     RequestResult,
     ServeStats,
     SlotEngine,
     kv_slot_bytes,
+    paged_plan_from_pod_env,
     poisson_trace,
     run_static_baseline,
+    shared_prefix_trace,
     slots_for_gang,
     slots_for_slice,
     slots_from_pod_env,
 )
+from .pages import (  # noqa: F401
+    PageAllocator,
+    PagedPlan,
+    paged_plan_for_slice,
+    pages_for,
+)
+from .radix import RadixCache  # noqa: F401

@@ -54,25 +54,55 @@ import numpy as np
 
 from ..const import MemoryUnit
 from ..parallel.podenv import PodTpuEnv
+from ..utils.metrics import REGISTRY
 from ..utils.tracing import TRACER
 from ..workloads import generate as G
 from ..workloads.transformer import TransformerConfig, shard_params
+from .pages import (
+    SCRATCH,
+    PageAllocator,
+    PagedPlan,
+    paged_plan_for_slice,
+    pages_for,
+    row_span_for,
+)
+from .radix import RadixCache
+
+# SLO tiers (the Tally-style priority split, PAPERS.md 2410.07381):
+# latency-critical requests admit first and may preempt best-effort
+# victims' pages; best-effort requests absorb the queueing.
+TIER_CRITICAL = "critical"
+TIER_BEST_EFFORT = "best_effort"
+_TIERS = (TIER_CRITICAL, TIER_BEST_EFFORT)
 
 
 @dataclasses.dataclass(frozen=True)
 class Request:
-    """One serving request (host-side). ``arrival`` is in engine ticks."""
+    """One serving request (host-side). ``arrival`` is in engine ticks.
+
+    ``tier`` picks the SLO class (:data:`TIER_CRITICAL` admits ahead of
+    :data:`TIER_BEST_EFFORT` and may evict its pages under pressure);
+    ``slo_ttft_ticks`` / ``slo_tpot_ticks`` are the tier's latency
+    targets on the deterministic tick clock, set by the trace driver and
+    scored in :meth:`ServeStats.summary`."""
 
     rid: int
     prompt: tuple[int, ...]
     max_new: int
     arrival: float = 0.0
+    tier: str = TIER_CRITICAL
+    slo_ttft_ticks: float | None = None
+    slo_tpot_ticks: float | None = None
 
     def __post_init__(self):
         if len(self.prompt) < 1:
             raise ValueError(f"request {self.rid}: empty prompt")
         if self.max_new < 1:
             raise ValueError(f"request {self.rid}: max_new must be >= 1")
+        if self.tier not in _TIERS:
+            raise ValueError(
+                f"request {self.rid}: tier {self.tier!r} not in {_TIERS}"
+            )
 
 
 @dataclasses.dataclass
@@ -93,6 +123,15 @@ class RequestResult:
     admit_s: float = 0.0
     # the request's serve trace (utils.tracing), "" when unsampled
     trace_id: str = ""
+    # SLO tier + targets (copied from the Request by the paged engine)
+    tier: str = TIER_CRITICAL
+    slo_ttft_ticks: float | None = None
+    slo_tpot_ticks: float | None = None
+    # paged-engine telemetry: prompt tokens served from the radix cache,
+    # and one dict per preemption ({evict,readmit}_{tick,s}) — a request
+    # evicted mid-decode re-prefills its generated tokens on re-admission
+    prefix_tokens: int = 0
+    preemptions: list[dict] = dataclasses.field(default_factory=list)
 
     @property
     def ttft_ticks(self) -> float:
@@ -101,6 +140,31 @@ class RequestResult:
     @property
     def ttft_s(self) -> float:
         return self.first_token_s - self.arrival_s
+
+    @property
+    def tpot_ticks(self) -> float:
+        """Ticks per output token after the first (nan for 1-token
+        outputs — there is no decode interval to score)."""
+        if len(self.tokens) <= 1:
+            return float("nan")
+        return (self.finish_tick - self.first_token_tick) / (
+            len(self.tokens) - 1
+        )
+
+    def meets_slo(self) -> bool | None:
+        """True/False against the request's tick-clock targets; None when
+        the trace driver set none."""
+        if self.slo_ttft_ticks is None and self.slo_tpot_ticks is None:
+            return None
+        if self.slo_ttft_ticks is not None and (
+            self.ttft_ticks > self.slo_ttft_ticks
+        ):
+            return False
+        if self.slo_tpot_ticks is not None and len(self.tokens) > 1 and (
+            self.tpot_ticks > self.slo_tpot_ticks
+        ):
+            return False
+        return True
 
 
 @dataclasses.dataclass
@@ -111,6 +175,9 @@ class ServeStats:
     ticks: int
     wall_s: float
     trace_counts: dict[str, int]
+    # paged-engine cache telemetry (page occupancy, prefix-hit ratio,
+    # preemptions); empty for the contiguous engine / static baseline
+    engine_cache: dict = dataclasses.field(default_factory=dict)
 
     @staticmethod
     def _quantile(vals: list[float], q: float) -> float:
@@ -119,12 +186,36 @@ class ServeStats:
         s = sorted(vals)
         return s[min(len(s) - 1, int(math.ceil(q * len(s))) - 1)]
 
+    def tier_summary(self) -> dict:
+        """Per-SLO-tier latency + attainment rows (tick clock: the
+        deterministic one the trace driver's targets are set on)."""
+        out: dict = {}
+        for tier in sorted({r.tier for r in self.results}):
+            rs = [r for r in self.results if r.tier == tier]
+            ttft = [r.ttft_ticks for r in rs]
+            tpot = [r.tpot_ticks for r in rs if len(r.tokens) > 1]
+            scored = [r.meets_slo() for r in rs]
+            scored = [s for s in scored if s is not None]
+            out[tier] = {
+                "requests": len(rs),
+                "ttft_p50_ticks": self._quantile(ttft, 0.50),
+                "ttft_p99_ticks": self._quantile(ttft, 0.99),
+                "tpot_p50_ticks": round(self._quantile(tpot, 0.50), 3)
+                if tpot else None,
+                "tpot_p99_ticks": round(self._quantile(tpot, 0.99), 3)
+                if tpot else None,
+                "preemptions": sum(len(r.preemptions) for r in rs),
+                "slo_attainment": round(sum(scored) / len(scored), 3)
+                if scored else None,
+            }
+        return out
+
     def summary(self) -> dict:
         """Flat metrics dict (the serve bench's report row)."""
         tokens = sum(len(r.tokens) for r in self.results)
         ttft_t = [r.ttft_ticks for r in self.results]
         ttft_s = [r.ttft_s for r in self.results]
-        return {
+        out = {
             "requests": len(self.results),
             "tokens": tokens,
             "ticks": self.ticks,
@@ -141,6 +232,14 @@ class ServeStats:
             "ttft_p99_ms": round(self._quantile(ttft_s, 0.99) * 1e3, 2),
             "trace_counts": dict(self.trace_counts),
         }
+        if any(
+            r.tier != TIER_CRITICAL or r.meets_slo() is not None
+            for r in self.results
+        ):
+            out["tiers"] = self.tier_summary()
+        if self.engine_cache:
+            out["cache"] = dict(self.engine_cache)
+        return out
 
 
 @dataclasses.dataclass
@@ -194,7 +293,7 @@ class SlotEngine:
         self.max_len = max_len
         self.chunk = prefill_chunk
         self.eos_id = eos_id
-        self.cache = G.init_slot_cache(cfg, slots, max_len, kv_dtype=kv_dtype)
+        self.cache = self._make_cache(kv_dtype)
         # Tensor-parallel serving across a granted gang: with a mesh (from
         # ``parallel.podenv.gang_mesh`` inside a multi-chip grant), the
         # model weights shard per ``transformer.param_specs`` (heads /
@@ -216,6 +315,14 @@ class SlotEngine:
         # (the no-retrace guard the tests and serve bench assert).
         self.trace_counts = {"prefill": 0, "extend": 0, "decode": 0}
         self._build_fns()
+
+    def _make_cache(self, kv_dtype: str | None):
+        """The KV layout this engine runs on — :class:`PagedSlotEngine`
+        overrides with the paged buffers; called from ``__init__`` before
+        any sharding/compilation."""
+        return G.init_slot_cache(
+            self.cfg, self.n_slots, self.max_len, kv_dtype=kv_dtype
+        )
 
     def _shard_cache(self, cache):
         """Place the slot-pool cache tensor-parallel: K/V (and int8
@@ -333,15 +440,19 @@ class SlotEngine:
         def at(seconds: float) -> int:
             return base_ns + int(seconds * 1e9)
 
+        attrs = {
+            "rid": res.rid,
+            "prompt_len": res.prompt_len,
+            "tokens": len(res.tokens),
+            "ttft_ticks": res.ttft_ticks,
+            "slots": self.n_slots,
+            "tier": res.tier,
+        }
+        if res.prefix_tokens:
+            attrs["prefix_tokens"] = res.prefix_tokens
         ctx = TRACER.record_span(
             "serve.request", at(res.arrival_s), at(res.finish_s),
-            attributes={
-                "rid": res.rid,
-                "prompt_len": res.prompt_len,
-                "tokens": len(res.tokens),
-                "ttft_ticks": res.ttft_ticks,
-                "slots": self.n_slots,
-            },
+            attributes=attrs,
         )
         if ctx is None:
             return
@@ -365,6 +476,20 @@ class SlotEngine:
             "serve.retire", at(res.finish_s), at(res.finish_s), parent=ctx,
             attributes={"finish_tick": res.finish_tick},
         )
+        for pre in res.preemptions:
+            # one span per eviction: evict -> re-admission (or finish,
+            # for a request still preempted when the run drained)
+            TRACER.record_span(
+                "serve.preempt",
+                at(pre["evict_s"]),
+                at(pre.get("readmit_s", res.finish_s)),
+                parent=ctx,
+                attributes={
+                    "evict_tick": pre["evict_tick"],
+                    "readmit_tick": pre.get("readmit_tick", -1),
+                    "tier": res.tier,
+                },
+            )
 
     def run(self, requests: Sequence[Request]) -> ServeStats:
         """Serve ``requests`` to completion; returns results + metrics.
@@ -484,6 +609,576 @@ class SlotEngine:
 
 
 # ---------------------------------------------------------------------------
+# paged engine: page-table KV + radix prefix cache + SLO-tiered admission
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class _PagedSlot:
+    state: str = "free"  # free | prefill | decode
+    req: Request | None = None
+    # effective prompt: original prompt + tokens regenerated after a
+    # preemption (re-admission re-prefills them — bit-identical by the
+    # chunked-verification math extend_slot is built on)
+    prompt: tuple[int, ...] = ()
+    done: int = 0  # prompt tokens materialized in the row (incl. prefix hits)
+    pos: int = 0  # logical row length (host mirror of len[slot])
+    last: int = 0
+    result: RequestResult | None = None
+    pages: list[int] = dataclasses.field(default_factory=list)
+    shared: int = 0  # leading pages matched from the radix tree (read-only)
+    table: np.ndarray | None = None  # [row_pages] int32 physical page ids
+
+
+class PagedSlotEngine(SlotEngine):
+    """:class:`SlotEngine` over **paged** KV: rows read and write through
+    per-request page tables (``serving/pages.py``) instead of owning a
+    contiguous ``max_len`` strip, so a request pins only the pages its
+    tokens occupy — the ParvaGPU-style spatial sharing of one
+    ``aliyun.com/tpu-mem`` slice. On top of the allocator:
+
+    - a **radix prefix cache** (``serving/radix.py``): requests sharing a
+      system prompt prefill it once and branch by reference-counted
+      pages (``radix=False`` disables);
+    - **SLO-tiered admission**: :data:`TIER_CRITICAL` requests admit
+      ahead of :data:`TIER_BEST_EFFORT` and, under page pressure, evict
+      radix pages and then preempt best-effort victims (whose requests
+      re-queue and re-prefill on re-admission).
+
+    Correctness bar unchanged from the contiguous engine: greedy tokens
+    BIT-IDENTICAL to solo ``generate()`` — the paged kernels gather each
+    row's pages into exactly the contiguous logical layout before
+    running the shared ``decode_block`` — with zero retraces across
+    churn, preemption included (page tables are data, not shapes).
+
+    Geometry: ``prefill_chunk`` must be a page-size multiple (radix
+    matches floor to chunk boundaries, so shared pages always cover
+    whole chunks) and ``total_pages`` must cover one ``max_len`` row
+    (the progress guarantee: a lone request can always finish after the
+    pool drains around it).
+    """
+
+    def __init__(
+        self,
+        params,
+        cfg: TransformerConfig,
+        *,
+        slots: int,
+        max_len: int,
+        total_pages: int,
+        page_size: int,
+        prefill_chunk: int = 64,
+        eos_id: int | None = None,
+        kv_dtype: str | None = None,
+        mesh=None,
+        radix: bool = True,
+        metrics_pod: str = "",
+    ):
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        if prefill_chunk % page_size != 0:
+            raise ValueError(
+                f"prefill_chunk {prefill_chunk} must be a multiple of "
+                f"page_size {page_size} (radix matches floor to chunk "
+                "boundaries, so shared pages must cover whole chunks)"
+            )
+        if total_pages < pages_for(max_len, page_size):
+            raise ValueError(
+                f"total_pages {total_pages} cannot cover one {max_len}"
+                f"-position row ({pages_for(max_len, page_size)} pages of "
+                f"{page_size}) — even a lone request could deadlock; size "
+                "the pool with paged_plan_for_slice"
+            )
+        self.page_size = page_size
+        self.total_pages = total_pages
+        # The page table spans max_len rounded UP to a chunk multiple:
+        # the final chunk's static-width pad tail scatters through table
+        # entries (landing on SCRATCH), and a narrower table would let
+        # JAX's index clamping fold those writes into the last REAL page.
+        # row_span_for keeps this width and the sizing math's in lockstep.
+        self.row_pages = row_span_for(max_len, prefill_chunk) // page_size
+        self.metrics_pod = metrics_pod
+        super().__init__(
+            params, cfg, slots=slots, max_len=max_len,
+            prefill_chunk=prefill_chunk, eos_id=eos_id, kv_dtype=kv_dtype,
+            mesh=mesh,
+        )
+        self.allocator = PageAllocator(total_pages)
+        self.radix = RadixCache(page_size, self.allocator) if radix else None
+        self.preemptions = 0
+
+    def _make_cache(self, kv_dtype: str | None):
+        # +1: physical page 0 is the scratch write sink (pages.SCRATCH)
+        return G.init_paged_cache(
+            self.cfg, self.n_slots, self.total_pages + 1, self.page_size,
+            kv_dtype=kv_dtype,
+        )
+
+    def _build_fns(self) -> None:
+        cfg = self.cfg
+
+        def prefill_fn(params, tokens, cache, slot, table, n_real):
+            self.trace_counts["prefill"] += 1
+            logits, cache = G.paged_prefill_slot(
+                params, tokens, cache, cfg, slot=slot, page_table=table,
+                n_real=n_real,
+            )
+            return jnp.argmax(logits[0], -1).astype(jnp.int32), cache
+
+        def extend_fn(params, tokens, cache, slot, table, pos, n_real):
+            self.trace_counts["extend"] += 1
+            logits, cache = G.paged_extend_slot(
+                params, tokens, cache, cfg, slot=slot, page_table=table,
+                pos=pos, n_real=n_real,
+            )
+            return jnp.argmax(logits[0], -1).astype(jnp.int32), cache
+
+        def decode_fn(params, tokens, cache, tables, active):
+            self.trace_counts["decode"] += 1
+            logits, new = G.paged_decode_step(
+                params, tokens, cache, cfg, page_tables=tables
+            )
+            nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+            new = {**new, "len": jnp.where(active, new["len"], cache["len"])}
+            return nxt, new
+
+        self._prefill = jax.jit(prefill_fn, donate_argnums=(2,))
+        self._extend = jax.jit(extend_fn, donate_argnums=(2,))
+        self._decode = jax.jit(decode_fn, donate_argnums=(2,))
+
+    def warmup(self) -> None:
+        """Compile all three paged programs off the clock, then flush the
+        synthetic request's footprint: radix adoptions, telemetry, and
+        the preemption counter all reset to a cold start."""
+        super().warmup()
+        if self.radix is not None:
+            self.radix.clear()
+            self.radix.reset_stats()
+        self.allocator.reset_stats()
+        self.preemptions = 0
+
+    def publish_metrics(self) -> None:
+        """Export cache occupancy / prefix-hit / preemption telemetry to
+        the ``/metrics`` registry (rendered by ``kubectl-inspect-tpushare``
+        next to the gang/slice columns)."""
+        labels = {"pod": self.metrics_pod} if self.metrics_pod else {}
+        self.allocator.publish(REGISTRY, pod=self.metrics_pod)
+        if self.radix is not None:
+            REGISTRY.gauge_set(
+                "tpushare_engine_prefix_hit_ratio", self.radix.hit_ratio(),
+                "Fraction of looked-up prompt tokens served from the "
+                "radix prefix cache", **labels,
+            )
+            REGISTRY.gauge_set(
+                "tpushare_engine_prefix_cached_pages",
+                self.radix.cached_pages,
+                "KV pages held by the radix prefix cache", **labels,
+            )
+        REGISTRY.gauge_set(
+            "tpushare_engine_preemptions", self.preemptions,
+            "Requests preempted by page eviction since engine start",
+            **labels,
+        )
+
+    def cache_stats(self) -> dict:
+        """The engine-cache telemetry row (``ServeStats.engine_cache``)."""
+        out = {
+            "total_pages": self.total_pages,
+            "free_pages": self.allocator.free_pages,
+            "used_pages": self.allocator.used_pages,
+            "high_water_pages": self.allocator.high_water,
+            "page_size": self.page_size,
+            "preemptions": self.preemptions,
+        }
+        if self.radix is not None:
+            out.update(
+                prefix_hit_ratio=round(self.radix.hit_ratio(), 4),
+                prefix_hit_requests=self.radix.hit_requests,
+                prefix_cached_pages=self.radix.cached_pages,
+                prefix_evicted_pages=self.radix.evicted_pages,
+            )
+        return out
+
+    # --- page bookkeeping -------------------------------------------------
+
+    def _fresh_slot(self) -> _PagedSlot:
+        return _PagedSlot(
+            table=np.full((self.row_pages,), SCRATCH, np.int32)
+        )
+
+    def _grow(self, s: _PagedSlot, got: list[int]) -> None:
+        """Append freshly-granted pages to a row and map them in its
+        table (allocated entries are always a prefix of the row)."""
+        base = len(s.pages)
+        s.pages.extend(got)
+        s.table[base : base + len(got)] = got
+
+    def run(self, requests: Sequence[Request]) -> ServeStats:
+        """Serve to completion with paged admission. Per iteration:
+        (1) enqueue arrivals, (2) admit pending requests in (tier,
+        arrival) order — radix-matching each prompt and allocating first
+        -chunk pages, evicting radix LRU pages and then preempting
+        best-effort victims when a critical request is short, (3) one
+        prompt chunk for the oldest mid-prefill row, (4) one pool-wide
+        decode step over rows whose next position is page-backed. A row
+        that cannot get its next page stalls in place (its neighbors
+        keep decoding) until pages free up or preemption policy frees
+        them."""
+        for r in requests:
+            self.validate(r)
+        self.ticks = 0
+        incoming = sorted(requests, key=lambda r: (r.arrival, r.rid))
+        slots = [self._fresh_slot() for _ in range(self.n_slots)]
+        pending: list[Request] = []
+        results: list[RequestResult] = []
+        live: dict[int, RequestResult] = {}
+        i = 0
+        t0 = time.perf_counter()
+        base_ns = time.time_ns()
+        ps = self.page_size
+
+        def now() -> float:
+            return time.perf_counter() - t0
+
+        def tier_key(req: Request) -> tuple:
+            return (0 if req.tier == TIER_CRITICAL else 1, req.arrival,
+                    req.rid)
+
+        def release_row(s: _PagedSlot) -> None:
+            if s.pages:
+                self.allocator.release(s.pages)
+            s.pages = []
+            s.table[:] = SCRATCH
+
+        def preempt_one(critical_only: bool = True,
+                        protect: int | None = None) -> bool:
+            """Evict one victim's pages and re-queue its request. Victims
+            are best-effort rows, youngest admission first; with
+            ``critical_only=False`` (the zero-progress fallback) any tier
+            may be chosen except the protected (oldest) row, so the
+            oldest request makes monotonic progress and the loop
+            terminates."""
+            cands = [
+                (idx, s) for idx, s in enumerate(slots)
+                if s.state != "free" and idx != protect
+                and (s.req.tier == TIER_BEST_EFFORT or not critical_only)
+            ]
+            if not cands:
+                return False
+            # best-effort before critical, then youngest admission
+            idx, s = max(
+                cands,
+                key=lambda p: (p[1].req.tier == TIER_BEST_EFFORT,
+                               p[1].req.arrival, p[1].req.rid),
+            )
+            res = s.result
+            res.preemptions.append(
+                {"evict_tick": self.ticks, "evict_s": now()}
+            )
+            self.preemptions += 1
+            labels = (
+                {"pod": self.metrics_pod} if self.metrics_pod else {}
+            )
+            REGISTRY.counter_inc(
+                "tpushare_engine_preemptions_total",
+                "Paged-engine preemptions (victim pages evicted for a "
+                "higher-priority request)", **labels,
+            )
+            release_row(s)
+            pending.append(s.req)
+            slots[idx] = self._fresh_slot()
+            return True
+
+        def try_pages(n: int, tier: str) -> list[int] | None:
+            """All-or-nothing grant of ``n`` pages: free list first, then
+            radix LRU eviction (cache shrink — allowed for any tier),
+            then best-effort preemption for critical requesters.
+
+            The destructive steps are gated on ``freeable``: unless
+            releasing the whole escalation set (cached pages, plus
+            best-effort victims' rows for a critical requester) would
+            actually cover ``n``, nothing is evicted — a doomed grant
+            must not dump the prefix cache or destroy victims' decode
+            progress only to leave the requester blocked anyway."""
+            got = self.allocator.alloc(n)
+            if got is not None:
+                return got
+            groups: list[list[int]] = []
+            if self.radix is not None:
+                groups.append(self.radix.pages())
+            if tier == TIER_CRITICAL:
+                groups.extend(
+                    s.pages for s in slots
+                    if s.state != "free" and s.req.tier == TIER_BEST_EFFORT
+                )
+            if self.allocator.free_pages + self.allocator.freeable(
+                groups
+            ) < n:
+                return None
+            if self.radix is not None:
+                while self.allocator.free_pages < n:
+                    if not self.radix.evict(n - self.allocator.free_pages):
+                        break
+                got = self.allocator.alloc(n)
+                if got is not None:
+                    return got
+            if tier == TIER_CRITICAL:
+                while self.allocator.free_pages < n:
+                    if not preempt_one():
+                        break
+                got = self.allocator.alloc(n)
+            return got
+
+        def retire(idx: int) -> None:
+            s = slots[idx]
+            res = s.result
+            res.finish_tick = self.ticks
+            res.finish_s = now()
+            results.append(res)
+            self._record_request_trace(res, base_ns)
+            # Adopt the ORIGINAL prompt's full pages into the radix tree
+            # (they hold exactly those tokens' KV; pages past the prompt
+            # mix in generated content and are simply freed). The tree
+            # takes its own reference, so releasing the engine's below
+            # recycles only the unshared tail.
+            if self.radix is not None and s.req.rid >= 0:
+                full = len(s.req.prompt) // ps
+                if full:
+                    self.radix.insert(
+                        tuple(s.req.prompt[: full * ps]), s.pages[:full]
+                    )
+            release_row(s)
+            slots[idx] = self._fresh_slot()
+
+        while i < len(incoming) or pending or any(
+            s.state != "free" for s in slots
+        ):
+            while i < len(incoming) and incoming[i].arrival <= self.ticks:
+                req = incoming[i]
+                live[req.rid] = RequestResult(
+                    rid=req.rid, prompt_len=len(req.prompt), tokens=[],
+                    arrival_tick=req.arrival, arrival_s=now(),
+                    tier=req.tier, slo_ttft_ticks=req.slo_ttft_ticks,
+                    slo_tpot_ticks=req.slo_tpot_ticks,
+                )
+                pending.append(req)
+                i += 1
+            busy = any(s.state != "free" for s in slots)
+            if not busy and not pending:
+                self.ticks = max(
+                    self.ticks, int(math.ceil(incoming[i].arrival))
+                )
+                continue
+            dispatched = False
+
+            # --- admission: strict (tier, arrival) order; a blocked head
+            # blocks the line so best-effort can never overtake a
+            # page-starved critical request
+            free_rows = [
+                idx for idx, s in enumerate(slots) if s.state == "free"
+            ]
+            while pending and free_rows:
+                # re-sort each pass: a preemption inside try_pages can
+                # re-queue its victim mid-loop
+                pending.sort(key=tier_key)
+                req = pending[0]
+                res = live[req.rid]
+                eff = req.prompt + tuple(res.tokens)
+                matched, mpages = 0, []
+                if self.radix is not None:
+                    # count=False: a page-starved head re-matches every
+                    # iteration it stays blocked; the lookup is recorded
+                    # once below, when the admission lands
+                    matched, mpages = self.radix.match(eff, count=False)
+                    # floor to a chunk boundary: the chunk walk then
+                    # lands exactly where a fresh prefill's would, so
+                    # the padded write extent never grows past the table
+                    aligned = (matched // self.chunk) * self.chunk
+                    keep = aligned // ps
+                    if keep < len(mpages):
+                        self.allocator.release(mpages[keep:])
+                        mpages = mpages[:keep]
+                        matched = aligned
+                first_real = min(self.chunk, len(eff) - matched)
+                need = pages_for(matched + first_real, ps) - len(mpages)
+                fresh = try_pages(max(need, 0), req.tier)
+                if fresh is None:
+                    if mpages:
+                        self.allocator.release(mpages)
+                    break
+                pending.pop(0)
+                if self.radix is not None:
+                    self.radix.record_lookup(len(eff), matched)
+                idx = free_rows.pop(0)
+                s = slots[idx]
+                s.state = "prefill"
+                s.req = req
+                s.prompt = eff
+                s.done = matched
+                s.pos = matched
+                s.result = res
+                self._grow(s, mpages)
+                s.shared = len(mpages)
+                self._grow(s, fresh)
+                if res.preemptions and "readmit_tick" not in res.preemptions[-1]:
+                    res.preemptions[-1]["readmit_tick"] = self.ticks
+                    res.preemptions[-1]["readmit_s"] = now()
+                else:
+                    res.admit_tick = self.ticks
+                    res.admit_s = now()
+                if matched and req.rid >= 0:
+                    res.prefix_tokens += matched
+                    # live span (one per admission, off the per-token
+                    # path) so the histogram bucket carries a trace-id
+                    # exemplar linking /metrics to /traces
+                    with TRACER.span(
+                        "serve.prefix_hit",
+                        attributes={"rid": req.rid, "tokens": matched},
+                    ):
+                        REGISTRY.observe(
+                            "tpushare_engine_prefix_hit_tokens",
+                            float(matched),
+                            "Prompt tokens served from the radix prefix "
+                            "cache per admission",
+                            buckets=(1.0, 4.0, 16.0, 64.0, 256.0, 1024.0,
+                                     4096.0),
+                            **(
+                                {"pod": self.metrics_pod}
+                                if self.metrics_pod else {}
+                            ),
+                        )
+
+            # --- one prompt chunk for the oldest mid-prefill row
+            pre = [idx for idx, s in enumerate(slots) if s.state == "prefill"]
+            if pre:
+                idx = min(pre, key=lambda j: slots[j].result.arrival_tick)
+                s = slots[idx]
+                real = s.prompt[s.done : s.done + self.chunk]
+                n_real = len(real)
+                need = pages_for(s.done + n_real, ps) - len(s.pages)
+                got = try_pages(need, s.req.tier) if need > 0 else []
+                # got is None: the row stalls in place, retried next
+                # iteration (the decode pool below still dispatches)
+                if got is not None:
+                    self._grow(s, got)
+                    buf = np.zeros((self.chunk,), np.int32)
+                    buf[:n_real] = real
+                    table = jnp.asarray(s.table)
+                    if s.done == 0:
+                        tok, self.cache = self._prefill(
+                            self.params, jnp.asarray(buf), self.cache,
+                            np.int32(idx), table, np.int32(n_real),
+                        )
+                    else:
+                        tok, self.cache = self._extend(
+                            self.params, jnp.asarray(buf), self.cache,
+                            np.int32(idx), table, np.int32(s.done),
+                            np.int32(n_real),
+                        )
+                    self.ticks += 1
+                    dispatched = True
+                    s.done += n_real
+                    s.pos = s.done
+                    if s.done == len(s.prompt):
+                        t = int(tok)
+                        if not s.result.tokens:
+                            s.result.first_token_tick = self.ticks
+                            s.result.first_token_s = now()
+                        s.result.tokens.append(t)
+                        if (
+                            self.eos_id is not None and t == self.eos_id
+                        ) or len(s.result.tokens) >= s.req.max_new:
+                            retire(idx)
+                        else:
+                            s.state = "decode"
+                            s.last = t
+
+            # --- pool-wide decode over page-backed rows
+            dec = [idx for idx, s in enumerate(slots) if s.state == "decode"]
+            for idx in dec:
+                s = slots[idx]
+                # a try_pages below may preempt a best-effort row LATER
+                # in this same pass: its slot is fresh (req=None) by the
+                # time we reach it, and must not be granted a page
+                if s.state != "decode":
+                    continue
+                if pages_for(s.pos + 1, ps) > len(s.pages):
+                    got = try_pages(1, s.req.tier)
+                    if got is not None:
+                        self._grow(s, got)
+            # a preemption above may have evicted a decode row
+            active_rows = [
+                idx for idx in dec
+                if slots[idx].state == "decode"
+                and pages_for(slots[idx].pos + 1, ps) <= len(slots[idx].pages)
+            ]
+            if active_rows:
+                toks = np.zeros((self.n_slots,), np.int32)
+                active = np.zeros((self.n_slots,), bool)
+                # Rows not decoding get an all-SCRATCH table: their
+                # device-side len is stale (a retired occupant's, or
+                # mid-prefill), and the step's masked write must not be
+                # able to land in a page another row shares.
+                tables = np.full(
+                    (self.n_slots, self.row_pages), SCRATCH, np.int32
+                )
+                for idx in dec:
+                    tables[idx] = slots[idx].table
+                for idx in active_rows:
+                    toks[idx] = slots[idx].last
+                    active[idx] = True
+                nxt, self.cache = self._decode(
+                    self.params, jnp.asarray(toks), self.cache,
+                    jnp.asarray(tables), jnp.asarray(active),
+                )
+                self.ticks += 1
+                dispatched = True
+                nxt = np.asarray(nxt)
+                for idx in active_rows:
+                    s = slots[idx]
+                    s.pos += 1
+                    t = int(nxt[idx])
+                    s.result.tokens.append(t)
+                    s.last = t
+                    if (
+                        self.eos_id is not None and t == self.eos_id
+                    ) or len(s.result.tokens) >= s.req.max_new:
+                        retire(idx)
+
+            if not dispatched:
+                # Zero-progress iteration: every occupied row (and the
+                # pending head) is page-starved. A radix drain cannot
+                # help here — reaching this point means some try_pages
+                # failed its freeable gate this iteration, and that gate
+                # already counted everything a full drain could free —
+                # so go straight to preempting the youngest row of ANY
+                # tier, never the oldest, which therefore makes
+                # monotonic progress and bounds the loop (the init
+                # guarantee: one max_len row always fits the pool).
+                occupied = [
+                    (s.req.arrival, s.req.rid, idx)
+                    for idx, s in enumerate(slots) if s.state != "free"
+                ]
+                protect = min(occupied)[2] if occupied else None
+                if not preempt_one(critical_only=False, protect=protect):
+                    raise RuntimeError(
+                        "paged pool wedged: no dispatch possible, "
+                        "no preemptable row — total_pages "
+                        f"{self.total_pages} cannot make progress "
+                        f"(free {self.allocator.free_pages})"
+                    )
+
+        self.publish_metrics()
+        results.sort(key=lambda r: r.rid)
+        return ServeStats(
+            results=results, ticks=self.ticks,
+            wall_s=time.perf_counter() - t0,
+            trace_counts=dict(self.trace_counts),
+            engine_cache=self.cache_stats(),
+        )
+
+
+# ---------------------------------------------------------------------------
 # arrival drivers
 # ---------------------------------------------------------------------------
 
@@ -531,6 +1226,67 @@ def poisson_trace(
                 arrival=t,
             )
         )
+    return out
+
+
+def shared_prefix_trace(
+    n: int,
+    *,
+    seed: int,
+    rate: float,
+    vocab: int,
+    prefixes: tuple[int, int],
+    tail_lens: tuple[int, int],
+    max_new: tuple[int, int] | Sequence[int],
+    tiers: Sequence[tuple[str, float, float | None, float | None]] | None = None,
+) -> list[Request]:
+    """Poisson arrivals whose prompts share system prompts: ``prefixes``
+    is ``(count, length)`` — ``count`` distinct shared prefixes of
+    ``length`` tokens are drawn once, and each request picks one
+    uniformly and appends a unique tail of ``tail_lens`` (lo, hi)
+    tokens. This is the radix-cache workload: every prefix past the
+    first user prefills once and branches by reference-counted pages.
+
+    ``tiers`` assigns SLO classes: a list of ``(tier_name, weight,
+    slo_ttft_ticks, slo_tpot_ticks)`` rows sampled by weight — the
+    targets ride on each :class:`Request` and are scored per tier in
+    ``ServeStats.summary()``. None keeps every request
+    :data:`TIER_CRITICAL` with no targets. ``max_new`` follows
+    :func:`poisson_trace`'s tuple-range / choices-list convention.
+    Deterministic per seed."""
+    n_pre, pre_len = prefixes
+    if n_pre < 1 or pre_len < 0:
+        raise ValueError(f"prefixes must be (count>=1, len>=0), got {prefixes}")
+    rng = np.random.RandomState(seed)
+    pres = [
+        tuple(int(x) for x in rng.randint(0, vocab, size=pre_len))
+        for _ in range(n_pre)
+    ]
+    choices = None if isinstance(max_new, tuple) else list(max_new)
+    if tiers is not None:
+        weights = np.asarray([t[1] for t in tiers], np.float64)
+        weights = weights / weights.sum()
+    t = 0.0
+    out = []
+    for rid in range(n):
+        t += float(rng.exponential(1.0 / rate))
+        pre = pres[rng.randint(n_pre)]
+        tlen = int(rng.randint(tail_lens[0], tail_lens[1] + 1))
+        tail = tuple(int(x) for x in rng.randint(0, vocab, size=tlen))
+        mn = (
+            int(choices[rng.randint(len(choices))]) if choices is not None
+            else int(rng.randint(max_new[0], max_new[1] + 1))
+        )
+        tier, slo_ttft, slo_tpot = TIER_CRITICAL, None, None
+        if tiers is not None:
+            name, _, slo_ttft, slo_tpot = tiers[
+                int(rng.choice(len(tiers), p=weights))
+            ]
+            tier = name
+        out.append(Request(
+            rid=rid, prompt=pre + tail, max_new=mn, arrival=t, tier=tier,
+            slo_ttft_ticks=slo_ttft, slo_tpot_ticks=slo_tpot,
+        ))
     return out
 
 
@@ -752,3 +1508,58 @@ def slots_from_pod_env(
             "slice, shrink max_len, or quantize (kv_dtype='int8')"
         )
     return n
+
+
+def paged_plan_from_pod_env(
+    cfg: TransformerConfig,
+    max_len: int,
+    *,
+    weight_bytes: int,
+    page_size: int,
+    prefill_chunk: int = 64,
+    env: PodTpuEnv | None = None,
+    kv_dtype: str | None = None,
+    headroom: float = 0.90,
+    unit: MemoryUnit = MemoryUnit.GiB,
+    slots: int | None = None,
+) -> PagedPlan:
+    """The paged mode of :func:`slots_from_pod_env`: size a
+    :class:`PagedSlotEngine` pool (dispatch rows + KV pages) for THIS
+    pod's ``aliyun.com/tpu-mem`` slice, read from the plugin-injected
+    env. The page-table and free-list overhead is charged against the
+    same byte budget, so a fully-admitted paged pool can never exceed
+    the slice (the exact-budget accounting pinned in
+    ``tests/test_pages_radix.py``). Gangs size over the container's
+    PER-CHIP share with page bytes sharded on the kv-heads axis, exactly
+    as :func:`slots_for_gang`. Raises when the slice cannot cover even
+    one ``max_len`` row of pages — the paged engine's progress guarantee
+    needs at least that many."""
+    pod = env if env is not None else PodTpuEnv.from_env()
+    if pod.is_gang:
+        per_chip_bytes = pod.gang_container_per_chip_bytes(unit)
+        plan = paged_plan_for_slice(
+            per_chip_bytes, cfg, max_len, page_size=page_size,
+            prefill_chunk=prefill_chunk, weight_bytes=weight_bytes,
+            kv_dtype=kv_dtype, headroom=headroom, slots=slots,
+            n_chips=len(pod.gang_chips),
+        )
+        slice_desc = (
+            f"gang slice of {per_chip_bytes / unit.num_bytes:g} "
+            f"{unit.value}/chip x {len(pod.gang_chips)} chips"
+        )
+    else:
+        plan = paged_plan_for_slice(
+            pod.mem_bytes(unit), cfg, max_len, page_size=page_size,
+            prefill_chunk=prefill_chunk, weight_bytes=weight_bytes,
+            kv_dtype=kv_dtype, headroom=headroom, slots=slots,
+        )
+        slice_desc = f"slice of {pod.mem_units_container} {unit.value}"
+    if plan.total_pages < pages_for(max_len, page_size):
+        raise ValueError(
+            f"{slice_desc} cannot hold weights "
+            f"({weight_bytes / 2**30:.2f} GiB) plus one {max_len}-position "
+            f"row of {page_size}-token KV pages at headroom {headroom} — "
+            "request a larger aliyun.com/tpu-mem slice, shrink max_len, or "
+            "quantize (kv_dtype='int8')"
+        )
+    return plan

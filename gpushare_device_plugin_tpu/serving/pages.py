@@ -1,0 +1,320 @@
+"""Paged KV-cache page allocator + slice-aware paged-pool sizing.
+
+The slot pool (PR 5) reserves a full ``max_len`` KV row per admitted
+request, so the ``aliyun.com/tpu-mem`` slice strands most of its HBM on
+short requests — the exact waste the plugin's fractional-HBM model
+exists to eliminate. This module is the host-side half of the paged
+replacement (the ParvaGPU direction, PAPERS.md 2409.14447, applied
+inside one slice):
+
+- :class:`PageAllocator`: fixed-size KV **pages** carved from the slice
+  budget, handed out O(1) from a free-list stack and returned O(1) on
+  release. Pages are **reference counted** so the radix prefix cache
+  (``serving/radix.py``) can share one physical page between any number
+  of requests whose prompts agree on its tokens; a page returns to the
+  free list only when the last reference drops.
+- :class:`PagedPlan` / :func:`paged_plan_for_slice`: the sizing math
+  that converts a byte slice into (dispatch slots, page count) with the
+  page-table + free-list overhead **counted against the budget**, so a
+  fully-admitted paged pool can never exceed the injected
+  ``aliyun.com/tpu-mem`` bytes (exact-budget accounting pinned in
+  ``tests/test_pages_radix.py``).
+
+Device-side, the physical cache is ``[L, pages, page_size, Hkv, Dh]``
+with page id :data:`SCRATCH` (0) reserved as a write sink for idle
+rows — never allocated, never read (``workloads/generate.py`` paged
+primitives). The allocator hands out ids ``1..total_pages``.
+
+Thread-safety: the engine's host loop is single-threaded, but the
+``/metrics`` endpoint scrapes occupancy from another thread, so counters
+sit behind a ranked lock (``serving.pages``, ``utils/lockrank.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from ..utils.lockrank import make_lock
+from ..utils.metrics import REGISTRY, MetricsRegistry
+
+# Physical page id 0: the scratch page. Idle slot rows' page tables point
+# every entry here, so a pool-wide decode step's (masked, never-read)
+# writes land somewhere harmless without a dynamic dispatch shape.
+SCRATCH = 0
+
+# Host bookkeeping bytes charged per page against the slice budget: a
+# free-list slot plus a refcount entry. Deliberately conservative — the
+# point is that the accounting test can bound the WHOLE paged pool, not
+# that these live in HBM.
+FREELIST_BYTES_PER_PAGE = 8
+
+
+class PageAllocator:
+    """O(1) free-list allocator over ``total_pages`` KV pages with
+    per-page reference counts.
+
+    ``alloc`` is all-or-nothing (a request's chunk either gets every
+    page its write needs or none — partial grants would corrupt the
+    page-table invariant that allocated entries are a prefix of the
+    row). ``share`` adds a reference (radix prefix sharing);
+    ``release`` drops one and recycles the page at zero.
+    """
+
+    def __init__(self, total_pages: int) -> None:
+        if total_pages < 1:
+            raise ValueError(f"total_pages must be >= 1, got {total_pages}")
+        self._lock = make_lock("serving.pages")
+        self.total = total_pages
+        # Stack of free ids (1..total; SCRATCH is never handed out):
+        # pop/append from the end — O(1) alloc and free.
+        self._free: list[int] = list(range(total_pages, 0, -1))
+        self._refs: dict[int, int] = {}
+        self.alloc_count = 0
+        self.free_count_total = 0
+        self.high_water = 0
+
+    @property
+    def free_pages(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def used_pages(self) -> int:
+        with self._lock:
+            return self.total - len(self._free)
+
+    def alloc(self, n: int) -> list[int] | None:
+        """``n`` fresh pages (refcount 1 each), or None when the free
+        list cannot cover all of them (all-or-nothing)."""
+        if n < 0:
+            raise ValueError(f"n must be >= 0, got {n}")
+        with self._lock:
+            if n > len(self._free):
+                return None
+            got = [self._free.pop() for _ in range(n)]
+            for p in got:
+                self._refs[p] = 1
+            self.alloc_count += n
+            self.high_water = max(self.high_water, self.total - len(self._free))
+            return got
+
+    def share(self, pages: list[int] | tuple[int, ...]) -> None:
+        """Add one reference to each page (a prefix-cache hit, or the
+        radix tree adopting a retiring request's prompt pages)."""
+        with self._lock:
+            for p in pages:
+                if p not in self._refs:
+                    raise ValueError(f"share of unallocated page {p}")
+                self._refs[p] += 1
+
+    def release(self, pages: list[int] | tuple[int, ...]) -> None:
+        """Drop one reference from each page; a page whose count hits
+        zero returns to the free list (O(1) per page)."""
+        with self._lock:
+            for p in pages:
+                refs = self._refs.get(p)
+                if refs is None:
+                    raise ValueError(f"release of unallocated page {p}")
+                if refs == 1:
+                    del self._refs[p]
+                    self._free.append(p)
+                    self.free_count_total += 1
+                else:
+                    self._refs[p] = refs - 1
+
+    def refcount(self, page: int) -> int:
+        with self._lock:
+            return self._refs.get(page, 0)
+
+    def freeable(self, groups: list[list[int]]) -> int:
+        """How many pages would return to the free list if every listed
+        reference were released: each inner list is one holder's pages
+        (a preemption victim's row, the radix tree's cached set); a page
+        frees only when the groups cover ALL its references. The paged
+        engine gates destructive escalation on this, so it never evicts
+        a cache or preempts a victim unless the grant will succeed."""
+        counts: dict[int, int] = {}
+        for group in groups:
+            for p in group:
+                counts[p] = counts.get(p, 0) + 1
+        with self._lock:
+            return sum(
+                1 for p, c in counts.items() if self._refs.get(p, 0) <= c
+            )
+
+    def reset_stats(self) -> None:
+        """Zero the cumulative counters (engine warmup flush) — the free
+        list and live refcounts are untouched."""
+        with self._lock:
+            self.alloc_count = 0
+            self.free_count_total = 0
+            self.high_water = self.total - len(self._free)
+
+    def publish(
+        self, registry: MetricsRegistry = REGISTRY, pod: str = ""
+    ) -> None:
+        """Export occupancy gauges to the ``/metrics`` registry (reads
+        under the pages lock, writes to the registry outside it — the
+        lock ranking allows the nesting, but there is no reason to hold
+        two locks)."""
+        with self._lock:
+            free = len(self._free)
+        labels = {"pod": pod} if pod else {}
+        registry.gauge_set(
+            "tpushare_engine_kv_pages_total", self.total,
+            "KV page-pool capacity (pages)", **labels,
+        )
+        registry.gauge_set(
+            "tpushare_engine_kv_pages_free", free,
+            "KV pages on the free list", **labels,
+        )
+        registry.gauge_set(
+            "tpushare_engine_kv_pages_used", self.total - free,
+            "KV pages referenced by live requests or the prefix cache",
+            **labels,
+        )
+
+
+# ---------------------------------------------------------------------------
+# slice-aware paged-pool sizing
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class PagedPlan:
+    """A paged pool sized to a byte budget: ``slots`` dispatch rows over
+    ``total_pages`` KV pages of ``page_size`` positions each. The byte
+    fields are the exact accounting the budget test pins: weights +
+    ``kv_bytes`` (pages incl. the scratch page) + ``table_bytes`` (int32
+    page tables + per-row len) + ``freelist_bytes`` never exceed the
+    slice at the chosen headroom."""
+
+    slots: int
+    total_pages: int
+    page_size: int
+    page_bytes: int
+    kv_bytes: int
+    table_bytes: int
+    freelist_bytes: int
+
+    @property
+    def max_pages_per_row(self) -> int:
+        # set by the planner: table_bytes = slots * (max_pages*4 + 4)
+        if self.slots == 0:
+            return 0
+        return (self.table_bytes // self.slots - 4) // 4
+
+    @property
+    def pool_bytes(self) -> int:
+        """Everything the paged pool itself pins against the slice."""
+        return self.kv_bytes + self.table_bytes + self.freelist_bytes
+
+
+def pages_for(length: int, page_size: int) -> int:
+    """Pages covering ``length`` positions (ceil)."""
+    return -(-length // page_size)
+
+
+def row_span_for(max_len: int, prefill_chunk: int) -> int:
+    """Logical positions one request's page table spans: ``max_len``
+    rounded UP to a prefill-chunk multiple. The chunk pad tail must map
+    to SCRATCH entries rather than clamp into real pages, so the engine
+    allocates tables this wide and the sizing math must charge exactly
+    the same width — both call here."""
+    return -(-max_len // prefill_chunk) * prefill_chunk
+
+
+def paged_plan_for_slice(
+    slice_bytes: int,
+    cfg,
+    max_len: int,
+    *,
+    page_size: int,
+    weight_bytes: int,
+    prefill_chunk: int = 1,
+    kv_dtype: str | None = None,
+    headroom: float = 0.90,
+    slots: int | None = None,
+    n_chips: int = 1,
+) -> PagedPlan:
+    """Size a paged pool for a ``slice_bytes`` HBM slice.
+
+    Weights come off the top and ``headroom`` covers activations + XLA
+    workspace exactly as in :func:`~.engine.slots_for_slice`; the rest
+    buys KV **pages** (plus one scratch page) with the page-table and
+    free-list overhead charged against the same budget. ``slots`` (the
+    dispatch width — max concurrent requests) defaults to 4x what the
+    contiguous slot math would grant, capped at the page count: more
+    rows than pages is useless because every admitted request pins at
+    least one page. ``n_chips > 1`` sizes over a tensor-parallel gang's
+    PER-CHIP share: page bytes and weights divide by the gang size when
+    the kv-heads axis shards (mirror of :func:`~.engine.slots_for_gang`).
+
+    ``total_pages == 0`` means the slice cannot hold even one page —
+    callers must reject, not round up.
+    """
+    # Late import: engine imports this module for PageAllocator; the
+    # per-slot/per-page byte math lives in engine (kv_slot_bytes).
+    from .engine import kv_slot_bytes
+
+    if page_size < 1:
+        raise ValueError(f"page_size must be >= 1, got {page_size}")
+    if max_len < page_size:
+        raise ValueError(
+            f"max_len {max_len} smaller than page_size {page_size}"
+        )
+    if not 0.0 < headroom <= 1.0:
+        raise ValueError(f"headroom must be in (0, 1], got {headroom}")
+    if n_chips < 1:
+        raise ValueError(f"n_chips must be >= 1, got {n_chips}")
+    if prefill_chunk < 1:
+        raise ValueError(f"prefill_chunk must be >= 1, got {prefill_chunk}")
+    page_b = kv_slot_bytes(cfg, page_size, kv_dtype)
+    row_b = kv_slot_bytes(cfg, max_len, kv_dtype)
+    if n_chips > 1 and cfg.kv_heads % n_chips == 0:
+        page_b = -(-page_b // n_chips)
+        row_b = -(-row_b // n_chips)
+        weight_bytes = -(-weight_bytes // n_chips)
+    # Per-row page-table entries: row_span_for is the exact width
+    # PagedSlotEngine allocates, so table_bytes is exact.
+    row_span = row_span_for(max_len, prefill_chunk)
+    max_pages = pages_for(row_span, page_size)
+
+    def zero() -> PagedPlan:
+        return PagedPlan(0, 0, page_size, page_b, 0, 0, 0)
+
+    usable = int(slice_bytes * headroom) - weight_bytes
+    if usable <= 0:
+        return zero()
+
+    def pages_at(n_slots: int) -> int:
+        table = n_slots * (max_pages * 4 + 4)
+        # scratch page off the top, then each page costs its KV bytes
+        # plus its free-list/refcount bookkeeping share
+        left = usable - table - page_b
+        if left <= 0:
+            return 0
+        return left // (page_b + FREELIST_BYTES_PER_PAGE)
+
+    if slots is None:
+        contiguous = max(usable // row_b, 1)
+        slots = max(1, min(pages_at(1), 4 * contiguous))
+    if slots < 1:
+        raise ValueError(f"slots must be >= 1, got {slots}")
+    pages = pages_at(slots)
+    # More rows than pages is dead weight; shrinking slots only grows
+    # pages, so one clamp+recompute converges.
+    if pages and slots > pages:
+        slots = pages
+        pages = pages_at(slots)
+    if pages < 1:
+        return zero()
+    return PagedPlan(
+        slots=int(slots),
+        total_pages=int(pages),
+        page_size=page_size,
+        page_bytes=page_b,
+        kv_bytes=(int(pages) + 1) * page_b,
+        table_bytes=int(slots) * (max_pages * 4 + 4),
+        freelist_bytes=int(pages) * FREELIST_BYTES_PER_PAGE,
+    )

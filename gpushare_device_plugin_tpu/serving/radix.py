@@ -1,0 +1,258 @@
+"""Radix-tree shared-prefix cache over KV pages.
+
+Requests that share a system prompt should prefill it ONCE: the
+prefill-once/branch-many cache-snapshot semantics already pinned by
+``test_prefix_cache_reuse_branches_continuations`` (caches are immutable
+pytrees; a branch never invalidates the snapshot), lifted from a
+host-managed snapshot object to the paged slot pool. The tree maps token
+sequences to the physical pages holding their KV:
+
+- **Edges are one page wide.** Every node owns exactly one page and the
+  ``page_size`` tokens it caches; a path from the root spells a prompt
+  prefix in full pages. This is the fixed-stride radix layout (one dict
+  hop per page — the block-hash design ParvaGPU-era serving stacks use)
+  rather than arbitrary-length compressed edges: page granularity is
+  what the allocator shares, so finer edges could never match more.
+- **Reference counting, not copying.** :meth:`match` hands back the
+  matched pages and takes one allocator reference per page for the
+  requesting row; the tree holds its own reference from
+  :meth:`insert`. A page is recycled only when the tree evicts it AND
+  no live request still reads it — eviction during use is safe by
+  construction.
+- **LRU leaf eviction.** :meth:`evict` releases least-recently-matched
+  leaves first (a parent is only evictable after all its children),
+  preserving the prefix property: every cached path stays contiguous
+  from the root.
+
+Correctness note (why sharing preserves bit-identity): a page caches
+positions ``[i*ps, (i+1)*ps)`` of a prompt, and a position's K/V depend
+only on tokens at or before it (causal attention; pad/neighbor lanes
+contribute exact zeros — the same visibility invariant the slot pool
+relies on). Two prompts that agree on a page's tokens therefore compute
+bitwise-identical page contents, so reading one request's page from
+another request's row is indistinguishable from having prefilled it —
+pinned against solo ``generate()`` in ``tests/test_paged_engine.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator
+
+from ..utils.lockrank import make_lock
+from .pages import PageAllocator
+
+
+@dataclasses.dataclass
+class _Node:
+    """One cached page: ``tokens`` (exactly ``page_size`` of them) keyed
+    under the parent, holding physical page ``page``."""
+
+    tokens: tuple[int, ...]
+    page: int
+    parent: "_Node | None"
+    children: dict[tuple[int, ...], "_Node"] = dataclasses.field(
+        default_factory=dict
+    )
+    last_use: int = 0
+
+
+class RadixCache:
+    """Page-granular radix tree over prompt-token sequences.
+
+    The tree owns one allocator reference per cached page; ``match``
+    acquires an additional reference per matched page for the caller
+    (released by the engine when the request retires or is evicted).
+    """
+
+    def __init__(self, page_size: int, allocator: PageAllocator) -> None:
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        self._lock = make_lock("serving.radix")
+        self.page_size = page_size
+        self._alloc = allocator
+        self._root: dict[tuple[int, ...], _Node] = {}
+        self._clock = 0
+        self._cached = 0
+        # telemetry (tokens, not requests: a 3-page hit counts 3*ps)
+        self.hit_tokens = 0
+        self.lookup_tokens = 0
+        self.hit_requests = 0
+        self.lookup_requests = 0
+        self.evicted_pages = 0
+
+    @property
+    def cached_pages(self) -> int:
+        with self._lock:
+            return self._cached
+
+    def reset_stats(self) -> None:
+        """Zero the hit/lookup/eviction telemetry (engine warmup flush);
+        the tree itself is untouched."""
+        with self._lock:
+            self.hit_tokens = 0
+            self.lookup_tokens = 0
+            self.hit_requests = 0
+            self.lookup_requests = 0
+            self.evicted_pages = 0
+
+    def hit_ratio(self) -> float:
+        """Cumulative fraction of looked-up prompt tokens served from
+        the cache (0.0 before any lookup)."""
+        with self._lock:
+            if self.lookup_tokens == 0:
+                return 0.0
+            return self.hit_tokens / self.lookup_tokens
+
+    def _chunks(self, tokens: tuple[int, ...]) -> Iterator[tuple[int, ...]]:
+        ps = self.page_size
+        for i in range(0, len(tokens) - len(tokens) % ps, ps):
+            yield tokens[i : i + ps]
+
+    def match(
+        self, tokens: tuple[int, ...], *, count: bool = True
+    ) -> tuple[int, list[int]]:
+        """Longest cached full-page prefix of ``tokens``: returns
+        ``(matched_token_count, page_ids)`` with one allocator reference
+        acquired per returned page (caller releases on retire/evict).
+
+        The match is capped at ``len(tokens) - 1``: at least one real
+        token must still prefill so the engine has last-position logits
+        to sample the first generated token from.
+
+        ``count=False`` skips the hit/lookup telemetry (the LRU clock
+        still advances): the engine matches a page-starved pending head
+        every iteration it stays blocked, and counting each retry would
+        make the exported hit ratio stall-dependent — it records via
+        :meth:`record_lookup` once the admission actually lands.
+        """
+        ps = self.page_size
+        cap = (len(tokens) - 1) // ps  # full pages, leaving >= 1 token
+        pages: list[int] = []
+        with self._lock:
+            self._clock += 1
+            if count:
+                self.lookup_requests += 1
+                self.lookup_tokens += len(tokens)
+            level = self._root
+            for chunk in self._chunks(tokens):
+                if len(pages) >= cap:
+                    break
+                node = level.get(chunk)
+                if node is None:
+                    break
+                node.last_use = self._clock
+                pages.append(node.page)
+                level = node.children
+            if pages and count:
+                self.hit_requests += 1
+                self.hit_tokens += len(pages) * ps
+        if pages:
+            self._alloc.share(pages)
+        return len(pages) * ps, pages
+
+    def record_lookup(self, looked_tokens: int, hit_tokens: int) -> None:
+        """Telemetry for a ``match(count=False)`` whose admission
+        succeeded: one lookup of ``looked_tokens``, ``hit_tokens`` of
+        them served from the cache (0 for a clean miss)."""
+        with self._lock:
+            self.lookup_requests += 1
+            self.lookup_tokens += looked_tokens
+            if hit_tokens:
+                self.hit_requests += 1
+                self.hit_tokens += hit_tokens
+
+    def pages(self) -> list[int]:
+        """Every page id the tree currently holds a reference on (the
+        engine's escalation gate feeds these to
+        :meth:`~.pages.PageAllocator.freeable`)."""
+        with self._lock:
+            return [n.page for n in self._walk_all()]
+
+    def insert(self, tokens: tuple[int, ...], pages: list[int]) -> int:
+        """Cache the full pages of ``tokens`` (a retiring request's
+        prompt): ``pages[i]`` holds tokens ``[i*ps, (i+1)*ps)``. Nodes
+        already present are refreshed (their pages win — both copies are
+        bitwise identical, so the newcomer's page simply keeps its
+        engine reference and is freed normally); new nodes take one
+        allocator reference each. Returns how many pages were newly
+        adopted."""
+        adopted: list[int] = []
+        with self._lock:
+            self._clock += 1
+            level = self._root
+            parent: _Node | None = None
+            for i, chunk in enumerate(self._chunks(tokens)):
+                if i >= len(pages):
+                    break
+                node = level.get(chunk)
+                if node is None:
+                    node = _Node(tokens=chunk, page=pages[i], parent=parent)
+                    level[chunk] = node
+                    adopted.append(pages[i])
+                    self._cached += 1
+                node.last_use = self._clock
+                parent = node
+                level = node.children
+        if adopted:
+            self._alloc.share(adopted)
+        return len(adopted)
+
+    def _leaves(self) -> list[_Node]:
+        out: list[_Node] = []
+        stack = list(self._root.values())
+        while stack:
+            n = stack.pop()
+            if n.children:
+                stack.extend(n.children.values())
+            else:
+                out.append(n)
+        return out
+
+    def evict(self, n_pages: int) -> int:
+        """Drop up to ``n_pages`` least-recently-used LEAF pages,
+        releasing the tree's reference on each (the allocator recycles a
+        page only once no live request shares it). Evicting a leaf can
+        expose its parent for the next round; one call loops until the
+        quota is met or the tree is empty. Returns pages released."""
+        if n_pages <= 0:
+            return 0
+        released: list[int] = []
+        with self._lock:
+            while len(released) < n_pages:
+                leaves = self._leaves()
+                if not leaves:
+                    break
+                leaves.sort(key=lambda n: n.last_use)
+                for node in leaves:
+                    if len(released) >= n_pages:
+                        break
+                    if node.parent is None:
+                        self._root.pop(node.tokens, None)
+                    else:
+                        node.parent.children.pop(node.tokens, None)
+                    released.append(node.page)
+                    self._cached -= 1
+            self.evicted_pages += len(released)
+        if released:
+            self._alloc.release(released)
+        return len(released)
+
+    def clear(self) -> int:
+        """Release every cached page (engine warmup flush)."""
+        with self._lock:
+            pages = [n.page for n in self._walk_all()]
+            self._root = {}
+            self._cached = 0
+        if pages:
+            self._alloc.release(pages)
+        return len(pages)
+
+    def _walk_all(self) -> list[_Node]:
+        out: list[_Node] = []
+        stack = list(self._root.values())
+        while stack:
+            n = stack.pop()
+            out.append(n)
+            stack.extend(n.children.values())
+        return out

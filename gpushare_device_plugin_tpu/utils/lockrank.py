@@ -156,9 +156,24 @@ RANKS: dict[str, LockRank] = dict(
             "HealthWatcher's unhealthy-chip set.",
         ),
         _r(
+            "serving.radix", 85, "lock", False,
+            "RadixCache's shared-prefix tree (nodes, LRU clock, hit "
+            "telemetry). Page reference updates (serving.pages, rank "
+            "87) run after the tree lock is dropped; any unavoidable "
+            "nesting goes radix -> pages, strictly up-rank.",
+        ),
+        _r(
             "allocator.local", 86, "lock", False,
             "LocalAllocator's standalone usage table (never nests over "
             "cluster locks; ranked near the leaves).",
+        ),
+        _r(
+            "serving.pages", 87, "lock", False,
+            "PageAllocator's free list + refcounts: the serving "
+            "engine's host loop and the /metrics scrape thread both "
+            "read occupancy. Pure memory, near-leaf; publish() snapshots "
+            "under it and writes gauges (metrics.registry, rank 95) "
+            "outside.",
         ),
         _r(
             "circuit.breaker", 88, "lock", False,

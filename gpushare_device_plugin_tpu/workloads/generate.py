@@ -97,6 +97,222 @@ def init_slot_cache(
     return {**cache, "len": jnp.zeros((slots,), jnp.int32)}
 
 
+def init_paged_cache(
+    cfg: TransformerConfig, slots: int, pages: int, page_size: int,
+    kv_dtype: str | None = None,
+) -> KVCache:
+    """Paged slot-pool cache for the paged serving engine
+    (``serving/engine.py`` + ``serving/pages.py``): K/V live in ``pages``
+    fixed-size pages — physical ``[L, pages, page_size, Hkv, Dh]`` (int8
+    scales ``[L, pages, page_size, Hkv]``) — and every request reads and
+    writes through a per-row **page table** instead of owning a
+    contiguous ``max_len`` row. ``pages`` counts PHYSICAL pages including
+    the scratch page (``serving.pages.SCRATCH``, id 0) that idle rows'
+    tables point at. ``len`` stays the per-row ``[slots]`` vector of the
+    slot pool; the batch axis of the K/V buffers is now pages, not slots.
+    """
+    cache = init_cache(cfg, pages, page_size, kv_dtype=kv_dtype)
+    return {**cache, "len": jnp.zeros((slots,), jnp.int32)}
+
+
+def _gather_paged(cache: KVCache, page_tables: jax.Array) -> KVCache:
+    """Materialize logical rows from a paged cache: ``page_tables``
+    ``[B, MP]`` physical page ids -> a view ``{k, v, (scales)}`` of shape
+    ``[L, B, MP*page_size, ...]`` — exactly the contiguous slot-pool
+    layout, so :func:`decode_block` runs on it unchanged and its logits
+    are bitwise what the contiguous engine computes (the gather copies
+    values; positions beyond each row's ``len`` stay invisible by the
+    same mask that hides a retired occupant's stale KV)."""
+    out: KVCache = {}
+    for key, val in cache.items():
+        if key == "len":
+            continue
+        ps = val.shape[2]
+        g = jnp.take(val, page_tables, axis=1)  # [L, B, MP, ps, ...]
+        out[key] = g.reshape(
+            (g.shape[0], g.shape[1], g.shape[2] * ps) + g.shape[4:]
+        )
+    return out
+
+
+def _paged_write(
+    cache: KVCache,
+    new: dict[str, jax.Array],
+    page_table: jax.Array,
+    logical: jax.Array,
+) -> KVCache:
+    """Scatter per-position K/V (``new[key]``: ``[L, N, ...]`` for
+    logical positions ``logical`` ``[N]``) into the physical pages named
+    by ``page_table`` ``[MP]``: position ``p`` lands at
+    ``(page_table[p // ps], p % ps)``. Duplicate targets (idle rows
+    parked on the scratch page) resolve arbitrarily — by construction
+    nothing ever reads them."""
+    ps = cache["k"].shape[2]
+    pids = jnp.take(page_table, logical // ps)
+    offs = logical % ps
+    out = dict(cache)
+    for key, val in new.items():
+        out[key] = cache[key].at[:, pids, offs].set(val)
+    return out
+
+
+def paged_prefill_slot(
+    params: Any,
+    tokens: jax.Array,
+    cache: KVCache,
+    cfg: TransformerConfig,
+    *,
+    slot: jax.Array,
+    page_table: jax.Array,
+    n_real: jax.Array,
+) -> tuple[jax.Array, KVCache]:
+    """:func:`prefill_slot` through a page table: pack one request's
+    OPENING prompt chunk (``tokens`` [C] right-padded, ``n_real`` real)
+    into the pages of row ``slot``, restarting the row at logical
+    position 0. The chunk's self-attention is identical to
+    :func:`prefill_slot` (causal over the chunk; pads at the end are
+    invisible); only the cache write changes — positions ``0..C-1``
+    scatter through ``page_table`` ([MP] physical ids) instead of a
+    contiguous row, so the row only pins the pages its tokens occupy.
+    Returns the last real position's logits ``[1, vocab]`` f32 and the
+    updated cache, bitwise :func:`prefill_slot`'s for the same tokens.
+    """
+    dt = cfg.compute_dtype
+    C = tokens.shape[0]
+    positions = jnp.arange(C)[None, :]
+    x = embed_lookup(params["embed"], tokens[None, :], dt)  # [1, C, d]
+
+    def layer(x, xs):
+        lp, _ = xs
+        h = _rms_norm(x, lp["ln1"])
+        q, k, v = _project_qkv(h, lp, cfg, positions)
+        attn = chunk_prefill_attention(q, k, v, n_real=n_real, attention=cfg.attention)
+        x = x + jnp.einsum("bthn,hnd->btd", attn, matmul_weight(lp["wo"], dt))
+        return _mlp_block(x, lp, cfg), (k, v)
+
+    x, (ks, vs) = jax.lax.scan(
+        layer, x, (params["layers"], jnp.arange(cfg.n_layers))
+    )
+    # ks/vs: [L, 1, C, Hkv, Dh] -> pages of `page_table`, offsets 0..C-1.
+    slot = jnp.asarray(slot, jnp.int32)
+    logical = jnp.arange(C)
+    if _cache_is_q8(cache):
+        kq8, kscale = quantize_kv(ks)
+        vq8, vscale = quantize_kv(vs)
+        cache = _paged_write(
+            cache,
+            {
+                "k": kq8[:, 0], "v": vq8[:, 0],
+                "k_scale": kscale[:, 0], "v_scale": vscale[:, 0],
+            },
+            page_table, logical,
+        )
+    else:
+        cache = _paged_write(
+            cache,
+            {
+                "k": ks[:, 0].astype(cache["k"].dtype),
+                "v": vs[:, 0].astype(cache["v"].dtype),
+            },
+            page_table, logical,
+        )
+    cache["len"] = jax.lax.dynamic_update_slice(
+        cache["len"], jnp.asarray(n_real, jnp.int32)[None], (slot,)
+    )
+    x_last = jax.lax.dynamic_slice(
+        x, (0, jnp.asarray(n_real, jnp.int32) - 1, 0), (1, 1, x.shape[-1])
+    )
+    x_last = _rms_norm(x_last, params["final_norm"])
+    logits = jnp.einsum("btd,dv->btv", x_last, matmul_weight(params["out"], dt))
+    return logits[:, 0].astype(jnp.float32), cache
+
+
+def paged_extend_slot(
+    params: Any,
+    tokens: jax.Array,
+    cache: KVCache,
+    cfg: TransformerConfig,
+    *,
+    slot: jax.Array,
+    page_table: jax.Array,
+    pos: jax.Array,
+    n_real: jax.Array,
+) -> tuple[jax.Array, KVCache]:
+    """:func:`extend_slot` through a page table: continue row ``slot``
+    with its next prompt chunk against the prefix its pages already
+    hold. ``pos`` is the EXPLICIT continuation offset (the engine's
+    host-tracked prefix length) rather than the stored ``len`` — that is
+    what lets a radix prefix hit start a fresh occupant mid-row (the
+    shared pages were written by an earlier request; the retired
+    occupant's stale ``len`` means nothing). The row's logical view is
+    gathered from its pages, run through :func:`decode_block` (the chunk
+    attends prefix + itself — the speculative-verification math, exactly
+    :func:`extend_slot`), and only the chunk's C new positions scatter
+    back — shared prefix pages are READ, never written. ``len[slot]``
+    becomes ``pos + n_real``. Returns position ``n_real - 1``'s logits
+    ``[1, vocab]`` f32 and the cache.
+    """
+    slot = jnp.asarray(slot, jnp.int32)
+    pos = jnp.asarray(pos, jnp.int32)
+    n_real = jnp.asarray(n_real, jnp.int32)
+    C = tokens.shape[0]
+    row = _gather_paged(cache, page_table[None, :])  # [L, 1, V, ...]
+    row["len"] = pos[None]
+    logits, row = decode_block(params, tokens[None, :], row, cfg)
+    logical = pos + jnp.arange(C)
+    new = {
+        key: jnp.take(row[key], logical, axis=2)[:, 0]
+        for key in row
+        if key != "len"
+    }
+    cache = _paged_write(cache, new, page_table, logical)
+    cache["len"] = jax.lax.dynamic_update_slice(
+        cache["len"], (pos + n_real)[None], (slot,)
+    )
+    last = jax.lax.dynamic_slice(
+        logits, (0, n_real - 1, 0), (1, 1, logits.shape[-1])
+    )
+    return last[:, 0], cache
+
+
+def paged_decode_step(
+    params: Any,
+    token: jax.Array,
+    cache: KVCache,
+    cfg: TransformerConfig,
+    *,
+    page_tables: jax.Array,
+) -> tuple[jax.Array, KVCache]:
+    """Pool-wide decode step through per-row page tables: gather every
+    row's logical view ``[L, B, MP*ps, ...]`` from its pages, run the
+    slot-pool :func:`decode_block` on it unchanged, and scatter each
+    row's ONE new KV entry back to ``(page_tables[b, len[b]//ps],
+    len[b]%ps)``. Rows whose table still points at the scratch page
+    (free, or mid-prefill at a page boundary) write garbage there —
+    never read, same visibility contract as the contiguous pool's idle
+    rows. ``len`` advances by one for every row; the engine freezes idle
+    rows' entries exactly as in contiguous mode. Logits are bitwise the
+    contiguous :func:`decode_step`'s for the same logical contents.
+    """
+    pos0 = cache["len"]
+    B = pos0.shape[0]
+    ps = cache["k"].shape[2]
+    view = _gather_paged(cache, page_tables)
+    view["len"] = pos0
+    logits, new_view = decode_block(params, token[:, None], view, cfg)
+    pids = jnp.take_along_axis(page_tables, (pos0 // ps)[:, None], axis=1)[:, 0]
+    offs = pos0 % ps
+    out = dict(cache)
+    for key, val in new_view.items():
+        if key == "len":
+            continue
+        idx = pos0.reshape((1, B, 1) + (1,) * (val.ndim - 3))
+        tok_kv = jnp.take_along_axis(val, idx, axis=2)[:, :, 0]  # [L, B, ...]
+        out[key] = cache[key].at[:, pids, offs].set(tok_kv)
+    out["len"] = pos0 + 1
+    return logits[:, 0], out
+
+
 def _cache_is_q8(cache: KVCache) -> bool:
     return "k_scale" in cache
 

@@ -205,3 +205,117 @@ def test_no_core_holds_keeps_reference_layout(api, capsys, monkeypatch):
     out = capsys.readouterr().out
     assert "EXCLUSIVE" not in out
     assert "chip0: 8/32" in out
+
+
+def _engine_exposition(pod_label: str) -> str:
+    """A real /metrics exposition carrying one serving engine's cache
+    telemetry, rendered by the actual registry so the CLI parser is
+    exercised against the same bytes a pod serves."""
+    from gpushare_device_plugin_tpu.utils.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    labels = {"pod": pod_label}
+    reg.gauge_set("tpushare_engine_kv_pages_total", 64.0,
+                  help_text="KV pages in the slice pool", **labels)
+    reg.gauge_set("tpushare_engine_kv_pages_used", 48.0,
+                  help_text="KV pages allocated", **labels)
+    reg.gauge_set("tpushare_engine_kv_pages_free", 16.0,
+                  help_text="KV pages free", **labels)
+    reg.gauge_set("tpushare_engine_prefix_hit_ratio", 0.37,
+                  help_text="radix prefix-cache hit ratio", **labels)
+    reg.gauge_set("tpushare_engine_preemptions", 2.0,
+                  help_text="best-effort preemptions", **labels)
+    reg.counter_inc("tpushare_engine_preemptions_total", value=2.0,
+                    help_text="best-effort preemptions", **labels)
+    return reg.render()
+
+
+def test_parse_engine_metrics_real_exposition():
+    text = _engine_exposition("default/serve-1")
+    rows = inspect_cli.parse_engine_metrics(text)
+    assert rows == {
+        "default/serve-1": {
+            "kv_pages_total": 64.0,
+            "kv_pages_used": 48.0,
+            "kv_pages_free": 16.0,
+            "prefix_hit_ratio": 0.37,
+            "preemptions": 2.0,
+            "preemptions_total": 2.0,
+        }
+    }
+    # non-engine families and comments are ignored; unlabeled engines
+    # key under ""
+    extra = "# HELP x y\ntpushare_admissions_total 5\ntpushare_engine_kv_pages_total 8\n"
+    assert inspect_cli.parse_engine_metrics(extra) == {
+        "": {"kv_pages_total": 8.0}
+    }
+
+
+def test_cli_details_serving_cache_column(api, capsys, monkeypatch):
+    """--metrics-url adds the SERVING CACHE column next to the existing
+    pod columns (and implies -d so it has pod rows to land on)."""
+    api.nodes["node-a"] = shared_node("node-a")
+    api.add_pod(assigned_running_pod("serve-1", 16, chip_idx=0, node="node-a"))
+    api.add_pod(assigned_running_pod("batch-1", 4, chip_idx=1, node="node-a"))
+    monkeypatch.setattr(inspect_cli, "_client", lambda: ApiServerClient(api.url))
+    monkeypatch.setattr(
+        inspect_cli, "fetch_engine_metrics",
+        lambda urls: inspect_cli.parse_engine_metrics(
+            _engine_exposition("default/serve-1")
+        ),
+    )
+
+    assert inspect_cli.main(["--metrics-url", "http://node-a:9410"]) == 0
+    out = capsys.readouterr().out
+    assert "SERVING CACHE" in out
+    assert "pages 48/64 · prefix 37% · preempt 2" in out
+    # the non-serving pod gets a placeholder, not a blank
+    batch_row = next(line for line in out.splitlines() if "batch-1" in line)
+    assert batch_row.rstrip().endswith("-")
+
+
+def test_cli_serving_cache_matches_bare_pod_name(api, capsys, monkeypatch):
+    """Engines that only know their own pod name (no namespace) still
+    attach to the right row."""
+    api.nodes["node-a"] = shared_node("node-a")
+    api.add_pod(assigned_running_pod("serve-1", 16, chip_idx=0, node="node-a"))
+    monkeypatch.setattr(inspect_cli, "_client", lambda: ApiServerClient(api.url))
+    monkeypatch.setattr(
+        inspect_cli, "fetch_engine_metrics",
+        lambda urls: inspect_cli.parse_engine_metrics(
+            _engine_exposition("serve-1")
+        ),
+    )
+    assert inspect_cli.main(["-d", "--metrics-url", "http://x"]) == 0
+    out = capsys.readouterr().out
+    assert "pages 48/64" in out
+
+
+def test_cli_json_serving_cache(api, capsys, monkeypatch):
+    api.nodes["node-a"] = shared_node("node-a")
+    api.add_pod(assigned_running_pod("serve-1", 16, chip_idx=0, node="node-a"))
+    api.add_pod(assigned_running_pod("batch-1", 4, chip_idx=1, node="node-a"))
+    monkeypatch.setattr(inspect_cli, "_client", lambda: ApiServerClient(api.url))
+    monkeypatch.setattr(
+        inspect_cli, "fetch_engine_metrics",
+        lambda urls: inspect_cli.parse_engine_metrics(
+            _engine_exposition("default/serve-1")
+        ),
+    )
+
+    assert inspect_cli.main(["-o", "json", "--metrics-url", "http://x"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    pods = {p["name"]: p for p in doc["nodes"][0]["pods"]}
+    assert pods["serve-1"]["serving_cache"]["prefix_hit_ratio"] == 0.37
+    assert pods["serve-1"]["serving_cache"]["kv_pages_used"] == 48.0
+    assert "serving_cache" not in pods["batch-1"]
+
+
+def test_cli_no_metrics_url_keeps_reference_layout(api, capsys, monkeypatch):
+    """Without --metrics-url the details table keeps the reference
+    column set — no SERVING CACHE header appears."""
+    api.nodes["node-a"] = shared_node("node-a")
+    api.add_pod(assigned_running_pod("serve-1", 16, chip_idx=0, node="node-a"))
+    monkeypatch.setattr(inspect_cli, "_client", lambda: ApiServerClient(api.url))
+    assert inspect_cli.main(["-d"]) == 0
+    assert "SERVING CACHE" not in capsys.readouterr().out

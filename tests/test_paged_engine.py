@@ -1,0 +1,433 @@
+"""Paged serving engine correctness (serving/engine.py PagedSlotEngine).
+
+The contract: paged KV (page tables + radix prefix sharing + SLO-tiered
+preemption) changes WHERE bytes live, never WHAT tokens come out — every
+request's greedy tokens are BIT-IDENTICAL to a solo ``generate()`` call,
+including requests admitted mid-flight, requests served from shared
+radix pages, and requests evicted mid-decode and re-admitted. Slot and
+page churn never retrace a compiled program.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow
+
+from gpushare_device_plugin_tpu.serving import (
+    TIER_BEST_EFFORT,
+    TIER_CRITICAL,
+    PagedSlotEngine,
+    Request,
+    SlotEngine,
+    pages_for,
+    poisson_trace,
+    shared_prefix_trace,
+)
+from gpushare_device_plugin_tpu.workloads import generate as G
+from gpushare_device_plugin_tpu.workloads.transformer import (
+    TransformerConfig,
+    init_params,
+)
+
+EOS = 3
+
+
+def _cfg(**kw):
+    # float32: the bar is bit-identity with solo generate()
+    base = dict(
+        vocab=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2, d_ff=64,
+        max_seq=64, compute_dtype=jnp.float32,
+    )
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = _cfg()
+    params = init_params(jax.random.key(0), cfg)
+    return cfg, params
+
+
+def solo_tokens(params, cfg, req, kv_dtype=None):
+    prompt = jnp.asarray(req.prompt, jnp.int32)[None, :]
+    out = G.generate(
+        params, prompt, cfg, max_new=req.max_new, eos_id=EOS,
+        kv_dtype=kv_dtype,
+    )
+    return [int(x) for x in np.asarray(out)[0, len(req.prompt):]]
+
+
+def assert_parity(reqs, stats, params, cfg, kv_dtype=None):
+    by_rid = {r.rid: r for r in reqs}
+    assert len(stats.results) == len(reqs)
+    for res in stats.results:
+        req = by_rid[res.rid]
+        got = res.tokens
+        assert 1 <= len(got) <= req.max_new
+        expect = got + [EOS] * (req.max_new - len(got))
+        solo = solo_tokens(params, cfg, req, kv_dtype=kv_dtype)
+        assert solo == expect, (res.rid, got, solo)
+
+
+def _paged(params, cfg, **kw):
+    base = dict(
+        slots=2, max_len=32, total_pages=24, page_size=4, prefill_chunk=4,
+        eos_id=EOS,
+    )
+    base.update(kw)
+    return PagedSlotEngine(params, cfg, **base)
+
+
+def test_paged_matches_solo_incl_midflight(setup):
+    """Mixed-length Poisson trace, more requests than slots: mid-flight
+    admissions through page tables stay bit-identical to solo runs."""
+    cfg, params = setup
+    reqs = poisson_trace(
+        10, seed=7, rate=0.15, vocab=cfg.vocab, prompt_lens=(1, 9),
+        max_new=(2, 12),
+    )
+    eng = _paged(params, cfg)
+    stats = eng.run(reqs)
+    assert_parity(reqs, stats, params, cfg)
+    waits = [r.ttft_ticks for r in stats.results]
+    assert max(waits) > min(waits)  # someone queued behind a retirement
+
+
+def test_paged_matches_contiguous_engine(setup):
+    """Same trace through the paged and the contiguous engine: identical
+    tokens (both equal solo; this pins them against each other too)."""
+    cfg, params = setup
+    reqs = poisson_trace(
+        8, seed=11, rate=0.25, vocab=cfg.vocab, prompt_lens=(2, 10),
+        max_new=[2, 4, 9],
+    )
+    cont = SlotEngine(params, cfg, slots=2, max_len=32, prefill_chunk=4,
+                      eos_id=EOS)
+    paged = _paged(params, cfg)
+    c, p = cont.run(reqs), paged.run(reqs)
+    assert {r.rid: r.tokens for r in c.results} == {
+        r.rid: r.tokens for r in p.results
+    }
+
+
+def test_zero_retraces_across_page_churn(setup):
+    """Compile-count guard: admission, retirement, radix hits, and page
+    recycling all reuse the same three compiled programs."""
+    cfg, params = setup
+    eng = _paged(params, cfg)
+    eng.warmup()
+    warm = dict(eng.trace_counts)
+    assert warm == {"prefill": 1, "extend": 1, "decode": 1}
+    reqs = shared_prefix_trace(
+        12, seed=21, rate=0.4, vocab=cfg.vocab, prefixes=(2, 8),
+        tail_lens=(1, 8), max_new=[1, 3, 10],
+    )
+    eng.run(reqs)
+    eng.run(reqs)
+    assert eng.trace_counts == warm, (
+        f"page churn retraced: {eng.trace_counts} vs {warm}"
+    )
+
+
+def test_prompt_exactly_on_page_boundary(setup):
+    """Prompt lengths hitting page and chunk boundaries exactly (4, 8,
+    16 with page_size=4): the last page is full, no pad scatter into a
+    fresh page, and the first decode write opens a new page."""
+    cfg, params = setup
+    rng = np.random.RandomState(3)
+    reqs = [
+        Request(rid=i, prompt=tuple(int(x) for x in rng.randint(0, cfg.vocab, size=n)),
+                max_new=6, arrival=0.0)
+        for i, n in enumerate([4, 8, 16, 12])
+    ]
+    eng = _paged(params, cfg)
+    stats = eng.run(reqs)
+    assert_parity(reqs, stats, params, cfg)
+
+
+def test_single_token_prompts(setup):
+    """1-token prompts: zero full pages to match or cache, one page
+    allocated for the opening chunk."""
+    cfg, params = setup
+    reqs = [
+        Request(rid=i, prompt=(int(7 + i),), max_new=m, arrival=0.0)
+        for i, m in enumerate([1, 2, 8])
+    ]
+    eng = _paged(params, cfg)
+    stats = eng.run(reqs)
+    assert_parity(reqs, stats, params, cfg)
+    assert eng.radix.cached_pages == 0  # nothing cacheable from 1 token
+
+
+def test_shared_prefix_prefills_once_and_branches(setup):
+    """The radix acceptance property: requests sharing a system prompt
+    hit the cache (prefill ticks drop vs radix=False), branch by
+    reference-counted pages, and stay bit-identical to solo runs."""
+    cfg, params = setup
+    reqs = shared_prefix_trace(
+        8, seed=5, rate=0.3, vocab=cfg.vocab, prefixes=(1, 8),
+        tail_lens=(1, 6), max_new=[2, 4, 8],
+    )
+    hot = _paged(params, cfg, slots=3, total_pages=30)
+    hot_stats = hot.run(reqs)
+    assert_parity(reqs, hot_stats, params, cfg)
+    cache = hot_stats.engine_cache
+    assert cache["prefix_hit_requests"] > 0
+    assert cache["prefix_hit_ratio"] > 0.2
+    cold = _paged(params, cfg, slots=3, total_pages=30, radix=False)
+    cold_stats = cold.run(reqs)
+    assert {r.rid: r.tokens for r in cold_stats.results} == {
+        r.rid: r.tokens for r in hot_stats.results
+    }
+    # shared prefixes skipped whole prefill chunks: fewer total ticks
+    assert hot_stats.ticks < cold_stats.ticks
+
+
+def test_radix_refcounts_release_on_eos_retirement(setup):
+    """After every request retires, the ONLY page references left are
+    the radix tree's (engine refs all released); clearing the tree
+    returns the pool to empty — the no-leak invariant."""
+    cfg, params = setup
+    reqs = shared_prefix_trace(
+        6, seed=9, rate=0.5, vocab=cfg.vocab, prefixes=(2, 4),
+        tail_lens=(1, 5), max_new=[2, 5],
+    )
+    eng = _paged(params, cfg, slots=3, total_pages=30)
+    eng.run(reqs)
+    assert eng.allocator.used_pages == eng.radix.cached_pages
+    eng.radix.clear()
+    assert eng.allocator.used_pages == 0
+    assert eng.allocator.free_pages == eng.total_pages
+
+
+def test_preemption_evicts_best_effort_and_readmits(setup):
+    """Page pressure: a critical arrival evicts a best-effort victim's
+    pages mid-decode; the victim re-queues, re-prefills its generated
+    tokens on re-admission, and still emits bit-identical tokens."""
+    cfg, params = setup
+    reqs = [
+        Request(rid=0, prompt=tuple(range(5, 13)), max_new=16, arrival=0.0,
+                tier=TIER_BEST_EFFORT),
+        Request(rid=1, prompt=tuple(range(20, 26)), max_new=16, arrival=4.0,
+                tier=TIER_CRITICAL),
+    ]
+    eng = _paged(params, cfg, total_pages=8, radix=False)
+    eng.warmup()
+    warm = dict(eng.trace_counts)
+    stats = eng.run(reqs)
+    assert_parity(reqs, stats, params, cfg)
+    assert sum(eng.trace_counts[k] - warm[k] for k in warm) == 0
+    assert stats.engine_cache["preemptions"] > 0
+    victim = [r for r in stats.results if r.rid == 0][0]
+    assert victim.preemptions and victim.tier == TIER_BEST_EFFORT
+    for pre in victim.preemptions[:-1]:
+        assert pre["readmit_tick"] >= pre["evict_tick"]
+    crit = [r for r in stats.results if r.rid == 1][0]
+    assert not crit.preemptions
+
+
+def test_decode_loop_preemption_of_later_indexed_row(setup):
+    """A critical row early in the decode pass preempts a best-effort
+    victim whose slot index comes LATER in the same pass: the victim's
+    slot is fresh (req=None, pages=[]) when the grant loop reaches it,
+    and must be skipped, not granted a page (regression: AttributeError
+    on s.req.tier, and a page leaked into the fresh slot's table)."""
+    cfg, params = setup
+    reqs = [
+        # critical admitted first -> slot 0; victim decodes in slot 1
+        Request(rid=0, prompt=tuple(range(5, 11)), max_new=16, arrival=0.0,
+                tier=TIER_CRITICAL),
+        Request(rid=1, prompt=tuple(range(20, 26)), max_new=16, arrival=0.5,
+                tier=TIER_BEST_EFFORT),
+    ]
+    eng = _paged(params, cfg, total_pages=8, radix=False)
+    eng.warmup()
+    warm = dict(eng.trace_counts)
+    stats = eng.run(reqs)
+    assert_parity(reqs, stats, params, cfg)
+    assert sum(eng.trace_counts[k] - warm[k] for k in warm) == 0
+    victim = [r for r in stats.results if r.rid == 1][0]
+    assert victim.preemptions and victim.tier == TIER_BEST_EFFORT
+    assert not [r for r in stats.results if r.rid == 0][0].preemptions
+
+
+def test_preempt_spans_and_tier_summary(setup):
+    """Observability: an evicted request's trace carries serve.preempt
+    child spans, and summary() reports per-tier TTFT/TPOT + SLO
+    attainment from the trace driver's targets."""
+    from gpushare_device_plugin_tpu.utils import tracing
+
+    cfg, params = setup
+    tracing.STORE.clear()
+    tracing.TRACER.configure(sample_ratio=1.0)
+    try:
+        reqs = [
+            Request(rid=0, prompt=tuple(range(5, 13)), max_new=16,
+                    arrival=0.0, tier=TIER_BEST_EFFORT,
+                    slo_ttft_ticks=500.0, slo_tpot_ticks=500.0),
+            Request(rid=1, prompt=tuple(range(20, 26)), max_new=16,
+                    arrival=4.0, tier=TIER_CRITICAL,
+                    slo_ttft_ticks=8.0, slo_tpot_ticks=4.0),
+        ]
+        eng = _paged(params, cfg, total_pages=8, radix=False)
+        eng.warmup()
+        stats = eng.run(reqs)
+        victim = [r for r in stats.results if r.rid == 0][0]
+        assert victim.preemptions
+        spans = [
+            s.name for s in tracing.STORE.trace(victim.trace_id)
+        ]
+        assert spans.count("serve.preempt") == len(victim.preemptions)
+        tiers = stats.summary()["tiers"]
+        assert set(tiers) == {TIER_BEST_EFFORT, TIER_CRITICAL}
+        assert tiers[TIER_BEST_EFFORT]["preemptions"] == len(victim.preemptions)
+        # generous targets met; attainment is scored per tier
+        assert tiers[TIER_BEST_EFFORT]["slo_attainment"] == 1.0
+        assert tiers[TIER_CRITICAL]["slo_attainment"] in (0.0, 1.0)
+    finally:
+        tracing.STORE.clear()
+
+
+def test_critical_admits_ahead_of_best_effort(setup):
+    """Two requests arrive while the pool is busy: the critical one
+    admits first even though the best-effort one arrived earlier."""
+    cfg, params = setup
+    reqs = [
+        Request(rid=0, prompt=tuple(range(4, 12)), max_new=12, arrival=0.0,
+                tier=TIER_CRITICAL),
+        Request(rid=1, prompt=tuple(range(12, 18)), max_new=4, arrival=1.0,
+                tier=TIER_BEST_EFFORT),
+        Request(rid=2, prompt=tuple(range(30, 36)), max_new=4, arrival=2.0,
+                tier=TIER_CRITICAL),
+    ]
+    eng = _paged(params, cfg, slots=1, total_pages=10, radix=False)
+    stats = eng.run(reqs)
+    assert_parity(reqs, stats, params, cfg)
+    by_rid = {r.rid: r for r in stats.results}
+    assert by_rid[2].admit_tick < by_rid[1].admit_tick
+
+
+def test_last_resort_preemption_unwedges_critical_deadlock(setup):
+    """Two critical requests on a minimum pool (one max_len row of
+    pages): when both stall page-starved, the zero-progress fallback
+    preempts the YOUNGER so the older finishes — then the younger —
+    with tokens still bit-identical."""
+    cfg, params = setup
+    reqs = [
+        Request(rid=0, prompt=tuple(range(5, 13)), max_new=16, arrival=0.0,
+                tier=TIER_CRITICAL),
+        Request(rid=1, prompt=tuple(range(20, 28)), max_new=16, arrival=1.0,
+                tier=TIER_CRITICAL),
+    ]
+    eng = _paged(params, cfg, total_pages=pages_for(32, 4), radix=False)
+    stats = eng.run(reqs)
+    assert_parity(reqs, stats, params, cfg)
+    assert stats.engine_cache["preemptions"] > 0
+    young = [r for r in stats.results if r.rid == 1][0]
+    assert young.preemptions  # the younger critical paid
+
+
+def test_int8_kv_pages_match_solo_int8(setup):
+    """Quantized KV pages (int8 values + f32 scales, both paged): parity
+    against solo int8-cache generation, radix sharing included."""
+    cfg, params = setup
+    reqs = shared_prefix_trace(
+        6, seed=9, rate=0.3, vocab=cfg.vocab, prefixes=(1, 8),
+        tail_lens=(1, 4), max_new=[2, 6],
+    )
+    eng = _paged(params, cfg, slots=3, total_pages=30, kv_dtype="int8")
+    stats = eng.run(reqs)
+    assert_parity(reqs, stats, params, cfg, kv_dtype="int8")
+    assert stats.engine_cache["prefix_hit_requests"] > 0
+
+
+def test_engine_rejects_bad_geometry(setup):
+    cfg, params = setup
+    with pytest.raises(ValueError, match="multiple of"):
+        PagedSlotEngine(params, cfg, slots=2, max_len=32, total_pages=16,
+                        page_size=3, prefill_chunk=4, eos_id=EOS)
+    with pytest.raises(ValueError, match="cannot cover one"):
+        PagedSlotEngine(params, cfg, slots=2, max_len=32, total_pages=4,
+                        page_size=4, prefill_chunk=4, eos_id=EOS)
+
+
+def test_admission_validation_unchanged(setup):
+    """Slice-aware up-front rejection carries over: a request that could
+    not fit a contiguous row cannot fit its pages either."""
+    cfg, params = setup
+    eng = _paged(params, cfg)
+    with pytest.raises(ValueError, match="exceeding"):
+        eng.run([Request(rid=0, prompt=tuple(range(4, 30)), max_new=20)])
+
+
+def test_metrics_published_on_run(setup):
+    """The /metrics satellite: occupancy gauges, prefix-hit ratio,
+    preemption counter, and the prefix-hit histogram (with a trace
+    exemplar) all land in the registry under the pod label."""
+    from gpushare_device_plugin_tpu.utils import tracing
+    from gpushare_device_plugin_tpu.utils.metrics import REGISTRY
+
+    cfg, params = setup
+    tracing.TRACER.configure(sample_ratio=1.0)
+    try:
+        reqs = shared_prefix_trace(
+            6, seed=5, rate=0.4, vocab=cfg.vocab, prefixes=(1, 8),
+            tail_lens=(1, 4), max_new=[2, 4],
+        )
+        eng = _paged(params, cfg, slots=3, total_pages=30,
+                     metrics_pod="ns/serve-0")
+        eng.run(reqs)
+        text = REGISTRY.render()
+        assert 'tpushare_engine_kv_pages_total{pod="ns/serve-0"} 30' in text
+        assert 'tpushare_engine_prefix_hit_ratio{pod="ns/serve-0"}' in text
+        assert 'tpushare_engine_preemptions{pod="ns/serve-0"} 0' in text
+        count, total = REGISTRY.histogram_stats(
+            "tpushare_engine_prefix_hit_tokens"
+        )
+        assert count >= 1 and total >= 4
+        assert REGISTRY.exemplar("tpushare_engine_prefix_hit_tokens")
+    finally:
+        tracing.STORE.clear()
+
+
+@pytest.mark.parametrize("tp", [2, 4])
+def test_tp_paged_engine_tokens_identical(tp):
+    """Tensor-parallel paged engine over a gang mesh: page tables shard
+    nothing (tiny int32 data) while the paged K/V buffers shard their
+    kv-heads axis; tokens BIT-IDENTICAL to the single-chip paged engine
+    with zero retraces."""
+    from gpushare_device_plugin_tpu.parallel.podenv import PodTpuEnv, gang_mesh
+
+    cfg = _cfg(n_kv_heads=4)
+    params = init_params(jax.random.key(1), cfg)
+    reqs = shared_prefix_trace(
+        8, seed=7, rate=0.3, vocab=cfg.vocab, prefixes=(1, 8),
+        tail_lens=(1, 6), max_new=[3, 4, 12],
+    )
+    kw = dict(slots=3, max_len=48, total_pages=40, page_size=8,
+              prefill_chunk=8, eos_id=EOS)
+    solo = PagedSlotEngine(params, cfg, **kw)
+    solo.warmup()
+    s = solo.run(reqs)
+    env = PodTpuEnv.from_env({
+        "TPU_VISIBLE_CHIPS": ",".join(str(i) for i in range(tp)),
+        "ALIYUN_COM_TPU_GANG_CHIPS": ",".join(str(i) for i in range(tp)),
+        "ALIYUN_COM_TPU_GANG_SHAPE": f"{tp}x1x1",
+        "ALIYUN_COM_TPU_GANG_PER_CHIP": "1",
+        "ALIYUN_COM_TPU_MEM_CONTAINER": str(tp),
+        "ALIYUN_COM_TPU_MEM_DEV": "16",
+    })
+    mesh = gang_mesh(env, devices=jax.devices()[:tp])
+    eng = PagedSlotEngine(params, cfg, mesh=mesh, **kw)
+    eng.warmup()
+    warm = dict(eng.trace_counts)
+    t = eng.run(reqs)
+    assert sum(eng.trace_counts[k] - warm[k] for k in warm) == 0
+    assert {r.rid: r.tokens for r in t.results} == {
+        r.rid: r.tokens for r in s.results
+    }
+    # the sharded run still hits the radix cache
+    assert t.engine_cache["prefix_hit_requests"] > 0

@@ -1,0 +1,372 @@
+"""Paged-KV host layer: page allocator, radix prefix cache, and the
+exact-budget sizing math (serving/pages.py + serving/radix.py).
+
+The accounting contract under test is the slice-safety satellite: a
+fully-admitted paged pool (KV pages incl. the scratch page + page
+tables + free-list/refcount bookkeeping + weights) can NEVER exceed the
+injected ``aliyun.com/tpu-mem`` byte budget at the chosen headroom.
+"""
+
+import jax.numpy as jnp
+import pytest
+
+from gpushare_device_plugin_tpu.const import MemoryUnit
+from gpushare_device_plugin_tpu.parallel.podenv import PodTpuEnv
+from gpushare_device_plugin_tpu.serving import (
+    PageAllocator,
+    RadixCache,
+    kv_slot_bytes,
+    paged_plan_for_slice,
+    paged_plan_from_pod_env,
+    pages_for,
+)
+from gpushare_device_plugin_tpu.serving.pages import FREELIST_BYTES_PER_PAGE
+from gpushare_device_plugin_tpu.workloads.transformer import TransformerConfig
+
+
+def _cfg(**kw):
+    base = dict(
+        vocab=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2, d_ff=64,
+        max_seq=64, compute_dtype=jnp.float32,
+    )
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+# ---------------------------------------------------------------------------
+# PageAllocator
+# ---------------------------------------------------------------------------
+
+
+class TestPageAllocator:
+    def test_alloc_release_roundtrip(self):
+        a = PageAllocator(4)
+        got = a.alloc(3)
+        assert sorted(got) == [1, 2, 3] and a.free_pages == 1
+        a.release(got)
+        assert a.free_pages == 4 and a.used_pages == 0
+
+    def test_alloc_is_all_or_nothing(self):
+        a = PageAllocator(3)
+        assert a.alloc(2) is not None
+        # 1 page left; asking for 2 must grant NOTHING, not a partial
+        assert a.alloc(2) is None
+        assert a.free_pages == 1
+
+    def test_scratch_page_never_handed_out(self):
+        a = PageAllocator(5)
+        got = a.alloc(5)
+        assert 0 not in got  # pages.SCRATCH stays a write sink
+
+    def test_refcount_share_release(self):
+        a = PageAllocator(2)
+        (p,) = a.alloc(1)
+        a.share([p])
+        assert a.refcount(p) == 2
+        a.release([p])
+        assert a.refcount(p) == 1 and a.used_pages == 1  # still held
+        a.release([p])
+        assert a.refcount(p) == 0 and a.free_pages == 2
+
+    def test_share_or_release_of_unallocated_raises(self):
+        a = PageAllocator(2)
+        with pytest.raises(ValueError, match="share of unallocated"):
+            a.share([1])
+        with pytest.raises(ValueError, match="release of unallocated"):
+            a.release([1])
+
+    def test_occupancy_counters_and_high_water(self):
+        a = PageAllocator(4)
+        first = a.alloc(3)
+        a.release(first[:2])
+        a.alloc(1)
+        assert a.high_water == 3
+        assert a.alloc_count == 4
+        assert a.free_count_total == 2
+        a.reset_stats()
+        assert a.alloc_count == 0 and a.high_water == a.used_pages
+
+    def test_publish_exports_gauges(self):
+        from gpushare_device_plugin_tpu.utils.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        a = PageAllocator(8)
+        a.alloc(3)
+        a.publish(reg, pod="ns/pod-a")
+        text = reg.render()
+        assert 'tpushare_engine_kv_pages_total{pod="ns/pod-a"} 8' in text
+        assert 'tpushare_engine_kv_pages_used{pod="ns/pod-a"} 3' in text
+        assert 'tpushare_engine_kv_pages_free{pod="ns/pod-a"} 5' in text
+
+
+# ---------------------------------------------------------------------------
+# RadixCache
+# ---------------------------------------------------------------------------
+
+
+class TestRadixCache:
+    def _mk(self, pages=16, ps=4):
+        a = PageAllocator(pages)
+        return a, RadixCache(ps, a)
+
+    def test_insert_then_match_shares_pages(self):
+        a, r = self._mk()
+        pages = a.alloc(2)
+        toks = tuple(range(10, 18))  # 2 full pages of 4
+        assert r.insert(toks, pages) == 2
+        assert a.refcount(pages[0]) == 2  # engine ref + tree ref
+        matched, got = r.match(toks + (99,))
+        assert matched == 8 and got == pages
+        assert a.refcount(pages[0]) == 3  # + the new requester's ref
+
+    def test_match_leaves_at_least_one_token_to_prefill(self):
+        """A full-prompt match is capped at plen-1: the engine needs the
+        last position's logits to sample the first generated token."""
+        a, r = self._mk()
+        pages = a.alloc(2)
+        toks = tuple(range(8))
+        r.insert(toks, pages)
+        matched, got = r.match(toks)  # same 8 tokens, nothing appended
+        assert matched == 4 and got == pages[:1]
+        a.release(got)  # drop the requester ref again
+
+    def test_partial_prefix_match(self):
+        a, r = self._mk()
+        pages = a.alloc(3)
+        toks = tuple(range(12))
+        r.insert(toks, pages)
+        # agrees on the first page only
+        matched, got = r.match(toks[:4] + (60, 61, 62, 63, 1))
+        assert matched == 4 and got == pages[:1]
+
+    def test_single_token_prompt_never_matches(self):
+        a, r = self._mk()
+        pages = a.alloc(1)
+        r.insert(tuple(range(4)), pages)
+        matched, got = r.match((0,))
+        assert matched == 0 and got == []
+
+    def test_insert_existing_node_keeps_first_page(self):
+        a, r = self._mk()
+        first = a.alloc(1)
+        toks = tuple(range(4))
+        r.insert(toks, first)
+        dup = a.alloc(1)
+        assert r.insert(toks, dup) == 0  # refreshed, not adopted
+        assert a.refcount(dup[0]) == 1  # newcomer keeps only engine ref
+        matched, got = r.match(toks + (1,))
+        assert got == first
+
+    def test_lru_leaf_eviction_preserves_prefix_property(self):
+        a, r = self._mk()
+        p = a.alloc(3)
+        r.insert(tuple(range(12)), p)  # chain of 3 nodes
+        a.release(p)  # tree holds the only refs now
+        # parent nodes are not evictable while children exist
+        assert r.evict(1) == 1
+        assert r.cached_pages == 2
+        assert a.refcount(p[2]) == 0  # deepest leaf went first
+        assert a.refcount(p[0]) == 1 and a.refcount(p[1]) == 1
+
+    def test_eviction_during_use_is_safe(self):
+        """Evicting a page a live request still reads only drops the
+        TREE's reference; the allocator recycles it when the reader
+        retires."""
+        a, r = self._mk()
+        p = a.alloc(1)
+        toks = tuple(range(4))
+        r.insert(toks, p)
+        a.release(p)  # engine's original ref gone; tree holds it
+        matched, got = r.match(toks + (9,))  # a reader takes a ref
+        assert r.evict(1) == 1
+        assert a.refcount(got[0]) == 1  # reader keeps the page alive
+        a.release(got)
+        assert a.free_pages == 16
+
+    def test_hit_ratio_telemetry(self):
+        a, r = self._mk()
+        p = a.alloc(2)
+        toks = tuple(range(8))
+        r.insert(toks, p)
+        assert r.hit_ratio() == 0.0
+        matched, got = r.match(toks + (1, 2, 3))  # 8 of 11 tokens hit
+        assert r.hit_requests == 1 and r.lookup_requests == 1
+        assert r.hit_ratio() == pytest.approx(8 / 11)
+        r.reset_stats()
+        assert r.hit_ratio() == 0.0 and r.lookup_requests == 0
+
+    def test_clear_releases_everything(self):
+        a, r = self._mk()
+        p = a.alloc(3)
+        r.insert(tuple(range(12)), p)
+        a.release(p)
+        assert r.clear() == 3
+        assert a.free_pages == 16 and r.cached_pages == 0
+
+
+# ---------------------------------------------------------------------------
+# exact-budget accounting (the sizing satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestPagedPlanBudget:
+    def test_exact_budget_accounting_sweep(self):
+        """THE slice-safety invariant: across a budget sweep, weights +
+        everything the paged pool pins (pages incl. scratch, int32 page
+        tables + per-row len, free-list bookkeeping) never exceed the
+        slice at the chosen headroom — a fully-admitted pool cannot blow
+        the ``aliyun.com/tpu-mem`` grant."""
+        cfg = _cfg()
+        row_b = kv_slot_bytes(cfg, 64)
+        w = 3 * row_b
+        for budget in range(int(0.5 * row_b), 40 * row_b, row_b // 3):
+            for headroom in (1.0, 0.9):
+                plan = paged_plan_for_slice(
+                    budget, cfg, 64, page_size=8, prefill_chunk=8,
+                    weight_bytes=w, headroom=headroom,
+                )
+                if plan.total_pages == 0:
+                    continue
+                assert plan.pool_bytes == (
+                    plan.kv_bytes + plan.table_bytes + plan.freelist_bytes
+                )
+                assert w + plan.pool_bytes <= int(budget * headroom), (
+                    budget, headroom, plan,
+                )
+                # and the components are what the engine really allocates
+                assert plan.kv_bytes == (plan.total_pages + 1) * plan.page_bytes
+                span = -(-64 // 8) * 8
+                assert plan.table_bytes == plan.slots * (
+                    pages_for(span, 8) * 4 + 4
+                )
+                assert plan.freelist_bytes == (
+                    plan.total_pages * FREELIST_BYTES_PER_PAGE
+                )
+
+    def test_paged_pool_admits_more_rows_than_contiguous(self):
+        """The tentpole's capacity claim at the sizing layer: on the same
+        byte budget the paged plan's dispatch rows are >= 2x the
+        contiguous slot count (short requests stop paying for max_len)."""
+        from gpushare_device_plugin_tpu.serving import slots_for_slice
+
+        cfg = _cfg()
+        row_b = kv_slot_bytes(cfg, 64)
+        w = 2 * row_b
+        budget = int((w + 2.5 * row_b) / 0.9)
+        contiguous = slots_for_slice(budget, cfg, 64, weight_bytes=w)
+        plan = paged_plan_for_slice(
+            budget, cfg, 64, page_size=8, prefill_chunk=8, weight_bytes=w,
+        )
+        assert contiguous == 2
+        assert plan.slots >= 2 * contiguous
+
+    def test_chunk_rounding_grows_the_table(self):
+        """max_len not a chunk multiple: the table must span the chunk-
+        rounded row (pad-tail scatter targets), and the budget accounting
+        must charge for those extra entries."""
+        cfg = _cfg()
+        w = 0
+        budget = 64 * kv_slot_bytes(cfg, 8)
+        narrow = paged_plan_for_slice(
+            budget, cfg, 60, page_size=4, prefill_chunk=1, weight_bytes=w,
+            slots=4,
+        )
+        wide = paged_plan_for_slice(
+            budget, cfg, 60, page_size=4, prefill_chunk=8, weight_bytes=w,
+            slots=4,
+        )
+        assert narrow.table_bytes == 4 * (pages_for(60, 4) * 4 + 4)
+        assert wide.table_bytes == 4 * (pages_for(64, 4) * 4 + 4)
+        assert wide.table_bytes > narrow.table_bytes
+
+    def test_int8_pages_cost_less(self):
+        cfg = _cfg()
+        row_b = kv_slot_bytes(cfg, 64)
+        budget = 32 * row_b
+        f32 = paged_plan_for_slice(
+            budget, cfg, 64, page_size=8, weight_bytes=0,
+        )
+        q8 = paged_plan_for_slice(
+            budget, cfg, 64, page_size=8, weight_bytes=0, kv_dtype="int8",
+        )
+        assert q8.page_bytes < f32.page_bytes
+        assert q8.total_pages > f32.total_pages
+
+    def test_zero_when_slice_too_small(self):
+        cfg = _cfg()
+        plan = paged_plan_for_slice(
+            10, cfg, 64, page_size=8, weight_bytes=0,
+        )
+        assert plan.total_pages == 0 and plan.slots == 0
+
+    def test_rejects_bad_geometry(self):
+        cfg = _cfg()
+        with pytest.raises(ValueError, match="page_size"):
+            paged_plan_for_slice(1 << 20, cfg, 64, page_size=0, weight_bytes=0)
+        with pytest.raises(ValueError, match="max_len"):
+            paged_plan_for_slice(1 << 20, cfg, 4, page_size=8, weight_bytes=0)
+        with pytest.raises(ValueError, match="headroom"):
+            paged_plan_for_slice(
+                1 << 20, cfg, 64, page_size=8, weight_bytes=0, headroom=0.0
+            )
+        with pytest.raises(ValueError, match="prefill_chunk"):
+            paged_plan_for_slice(
+                1 << 20, cfg, 64, page_size=8, weight_bytes=0, prefill_chunk=0
+            )
+
+    def test_pod_env_paged_mode_reads_slice(self):
+        """paged_plan_from_pod_env closes the plugin loop for the paged
+        pool: slice bytes come from the injected env, and a too-small
+        slice fails loudly at startup."""
+        cfg = _cfg()
+        row_b = kv_slot_bytes(cfg, 64)
+        w = row_b
+        env = PodTpuEnv.from_env({
+            "ALIYUN_COM_TPU_MEM_CONTAINER": "1",
+            "ALIYUN_COM_TPU_MEM_DEV": "16",
+        })
+        plan = paged_plan_from_pod_env(
+            cfg, 64, weight_bytes=w, page_size=8, prefill_chunk=8, env=env,
+        )
+        budget = env.mem_bytes(MemoryUnit.GiB)
+        assert plan.total_pages >= pages_for(64, 8)
+        assert w + plan.pool_bytes <= int(budget * 0.90)
+        tiny = PodTpuEnv.from_env({
+            "ALIYUN_COM_TPU_MEM_CONTAINER": "1",  # 1 MiB under --memory-unit=MiB
+            "ALIYUN_COM_TPU_MEM_DEV": "16",
+        })
+        with pytest.raises(ValueError, match="cannot hold"):
+            # weights alone fill the slice: no room for one row of pages
+            paged_plan_from_pod_env(
+                cfg, 64, weight_bytes=tiny.mem_bytes(MemoryUnit.MiB),
+                page_size=8, env=tiny, unit=MemoryUnit.MiB,
+            )
+
+    def test_pod_env_gang_sizes_per_chip_share(self):
+        """A 4-chip gang's paged pool sizes over the PER-CHIP share with
+        kv-heads sharding: the same per-chip slice buys ~4x the pages of
+        a single chip (mirror of slots_for_gang)."""
+        cfg = _cfg(n_kv_heads=4)
+        row_b = kv_slot_bytes(cfg, 64)
+        w = 4 * row_b
+        gang = PodTpuEnv.from_env({
+            "TPU_VISIBLE_CHIPS": "0,1,2,3",
+            "ALIYUN_COM_TPU_GANG_CHIPS": "0,1,2,3",
+            "ALIYUN_COM_TPU_GANG_SHAPE": "4x1x1",
+            "ALIYUN_COM_TPU_GANG_PER_CHIP": "1",
+            "ALIYUN_COM_TPU_MEM_CONTAINER": "4",
+            "ALIYUN_COM_TPU_MEM_DEV": "16",
+        })
+        single = PodTpuEnv.from_env({
+            "ALIYUN_COM_TPU_MEM_CONTAINER": "1",
+            "ALIYUN_COM_TPU_MEM_DEV": "16",
+        })
+        p1 = paged_plan_from_pod_env(
+            cfg, 64, weight_bytes=w, page_size=8, env=single, headroom=1.0,
+        )
+        p4 = paged_plan_from_pod_env(
+            cfg, 64, weight_bytes=w, page_size=8, env=gang, headroom=1.0,
+        )
+        assert p4.total_pages >= 3 * p1.total_pages
+        # per-chip budget holds the per-chip shares of everything
+        assert -(-w // 4) + p4.pool_bytes <= gang.gang_container_per_chip_bytes()
