@@ -1,7 +1,7 @@
 PY ?= python
 PROTOC ?= protoc
 
-.PHONY: proto native test test-fast test-slow test-stress chaos chaos-restart lint lint-strict typecheck bench bench-smoke bench-serve-smoke bench-multichip-smoke bench-paged-smoke bench-wal bench-trace trace-smoke e2e-kind
+.PHONY: proto native test test-fast test-slow test-stress chaos chaos-restart chaos-move lint lint-strict typecheck bench bench-smoke bench-serve-smoke bench-multichip-smoke bench-paged-smoke bench-defrag-smoke bench-wal bench-trace trace-smoke e2e-kind
 
 # Regenerate protobuf message classes (gRPC bindings are hand-written in
 # gpushare_device_plugin_tpu/plugin/api/api_grpc.py; grpc_tools is not
@@ -58,6 +58,17 @@ chaos:
 chaos-restart:
 	$(PY) -m pytest tests/test_restart_recovery.py tests/test_checkpoint.py \
 	  tests/test_reconciler.py tests/test_wal_groupcommit.py -x -q
+
+# Defrag move-protocol chaos (docs/robustness.md): the daemon is
+# SIGKILLed at every move-journal step (defrag.plan/drain/copy/switch/
+# resume plus the checkpoint begin/resolve sites), in BOTH --wal-fsync
+# modes, and the restarted reconciler must converge — no double-booked
+# chip, no orphaned reservation, no pending move entry, and every
+# drained serving request's greedy tokens bit-identical to an unmoved
+# run. All of it runs inside tier-1 ('not slow'); this target runs the
+# suite alone with the lock-order witness on.
+chaos-move:
+	TPUSHARE_LOCK_WITNESS=1 $(PY) -m pytest tests/test_defrag.py -x -q
 
 # kind end-to-end: deploy the manifests with mock discovery on a local kind
 # cluster and assert the demo pod admits with TPU_VISIBLE_CHIPS injected
@@ -122,6 +133,16 @@ bench-multichip-smoke:
 # tests/test_bench_paged_smoke.py. See docs/serving.md.
 bench-paged-smoke:
 	$(PY) bench_mfu.py --paged-smoke
+
+# Defrag churn smoke (seconds): ONLY the slice-defragmentation section —
+# a seeded churn trace fragments a node, the planner+mover repack it
+# through the real WAL + ledger, and the correctness gates stay HARD
+# even in smoke: stranded-HBM% strictly reduced, binpack density not
+# regressed, zero double-booked chips, journal and ledger fully drained.
+# Tier-1 runs it via tests/test_bench_defrag_smoke.py. See
+# docs/robustness.md.
+bench-defrag-smoke:
+	$(PY) bench.py --defrag-smoke
 
 # Group-commit WAL A/B: the 16-way admission storm with the journal in
 # per-record-fsync ('always') then group-commit ('batch') mode. Reports

@@ -706,6 +706,205 @@ def run_extender_bench(
     }
 
 
+def run_defrag_bench(
+    rounds: int = 6,
+    seed: int = 20260803,
+    defrag_passes: int = 4,
+    churn_frac: float = 0.45,
+) -> dict:
+    """Churn-trace defragmentation bench (``allocator/defrag.py``).
+
+    ``rounds`` of first-fit admissions followed by a seeded random
+    ~``churn_frac`` of pods finishing leave the node's chips holding
+    free-HBM slivers no pending pod fits — the stranded-HBM state
+    long-running clusters converge to (ROADMAP open item 5). The bench
+    then runs :class:`~gpushare_device_plugin_tpu.allocator.defrag.DefragLoop`
+    passes (planner scan + journaled moves through the real WAL + ledger
+    + fake apiserver) until the plan drains, and reports stranded-HBM%
+    and binpack packing density (used units over occupied-chip capacity)
+    before/after.
+
+    Correctness is gated here, not just measured (``_defrag_gates``):
+    stranded-HBM% must STRICTLY improve and packing density must not
+    drop, no chip may end over capacity, and the journal/ledger must
+    drain — a defragmenter that "finishes" with a pending move entry or
+    an orphaned reservation has lost the crash-safety story the move
+    protocol exists for."""
+    from gpushare_device_plugin_tpu.allocator import defrag as D
+    from gpushare_device_plugin_tpu.allocator.assume import AssumeCache
+    from gpushare_device_plugin_tpu.allocator.checkpoint import AllocationCheckpoint
+    from gpushare_device_plugin_tpu.cluster import pods as P
+    from gpushare_device_plugin_tpu.cluster.apiserver import ApiServerClient
+    from gpushare_device_plugin_tpu.cluster.podsource import ApiServerPodSource
+
+    from fake_apiserver import FakeApiServer
+    from k8s_fixtures import assigned_running_pod
+
+    import random
+
+    chip_units = HBM_GIB
+    capacity = {i: chip_units for i in range(CHIPS)}
+    rng = random.Random(seed)
+    tmp = tempfile.mkdtemp(prefix="tpushare-dbench-")
+    api = FakeApiServer()
+    api.add_node(NODE)
+    api.start()
+    try:
+        client = ApiServerClient(api.url)
+        source = ApiServerPodSource(client, NODE)
+        used = {i: 0 for i in range(CHIPS)}
+        alive: dict[str, tuple[int, int]] = {}
+        pod_seq = 0
+        sizes = [12, 8, 6, 4, 2]  # mixed fractional classes, like POD_SIZES
+
+        def admit(units: int) -> bool:
+            nonlocal pod_seq
+            for idx in range(CHIPS):  # first-fit, the allocator's order
+                if capacity[idx] - used[idx] >= units:
+                    name = f"churn-{pod_seq}"
+                    pod_seq += 1
+                    api.add_pod(
+                        assigned_running_pod(name, units, chip_idx=idx, node=NODE)
+                    )
+                    used[idx] += units
+                    alive[name] = (idx, units)
+                    return True
+            return False
+
+        for _ in range(rounds):
+            while admit(rng.choice(sizes)):
+                pass  # fill runs the node to refusal
+            for name in rng.sample(
+                sorted(alive), k=max(1, int(churn_frac * len(alive)))
+            ):
+                idx, units = alive.pop(name)
+                used[idx] -= units
+                api.delete_pod("default", name)
+
+        def binpack_pct(quantum: int) -> float:
+            """Binpack utilization: the fraction of node capacity the
+            allocator can actually deliver — units in use plus free
+            units REACHABLE by quantum-sized requests (first-fit per
+            chip: ``free // quantum`` whole requests). Stranded slivers
+            are the gap between this and 100%; consolidating them is
+            exactly what raises it."""
+            placements = D.movable_placements(list(source.labeled_pods()))
+            by_chip: dict[int, int] = {}
+            for _key, (idx, units) in placements.items():
+                by_chip[idx] = by_chip.get(idx, 0) + units
+            total_cap = sum(capacity.values())
+            in_use = sum(by_chip.values())
+            admissible = sum(
+                ((cap - by_chip.get(idx, 0)) // quantum) * quantum
+                for idx, cap in capacity.items()
+            ) if quantum > 0 else total_cap - in_use
+            return 100.0 * (in_use + admissible) / total_cap
+
+        planner = D.DefragPlanner(lambda: dict(capacity), source)
+        ckpt = AllocationCheckpoint(os.path.join(tmp, "wal.ckpt"))
+        assume = AssumeCache()
+        mover = D.SliceMover(
+            client, source, assume, ckpt, NODE, lambda: dict(capacity)
+        )
+        loop = D.DefragLoop(planner, mover, client, NODE, interval_s=3600.0)
+
+        pre = planner.scan()
+        binpack_before = binpack_pct(pre.quantum)
+        t0 = time.perf_counter()
+        reports = [loop.run_once()]
+        while reports[-1].moves and len(reports) < defrag_passes:
+            reports.append(loop.run_once())
+        wall_ms = (time.perf_counter() - t0) * 1e3
+        before, after = reports[0], planner.scan()
+        # same quantum on both sides: the utilization comparison must be
+        # like-for-like even if churn deletions shifted the auto-derived
+        # largest-pod threshold mid-bench
+        binpack_after = binpack_pct(pre.quantum)
+        stats = mover.stats()
+
+        # post-conditions the gates read: per-chip capacity + clean state
+        double_booked = 0
+        final_used: dict[int, int] = {}
+        for pod in source.labeled_pods():
+            if not P.is_active(pod) or not P.is_assigned(pod):
+                continue
+            idx = P.chip_idx_from_annotation(pod)
+            final_used[idx] = final_used.get(idx, 0) + P.mem_units_of_pod(pod)
+        for idx, n in final_used.items():
+            if n > capacity.get(idx, 0):
+                double_booked += 1
+        claims, mem_res, core_res = assume.snapshot()
+        journal_pending = len(ckpt.pending())
+        ckpt.close()
+    finally:
+        api.stop()
+
+    _assert_lock_order_clean("defrag churn bench")
+    return {
+        "rounds": rounds,
+        "seed": seed,
+        "churn_pods": pod_seq,
+        "live_pods": len(alive),
+        "quantum": before.quantum,
+        "stranded_before_units": sum(before.stranded_by_chip.values()),
+        "stranded_after_units": sum(after.stranded_by_chip.values()),
+        "stranded_before_pct": round(before.stranded_pct, 2),
+        "stranded_after_pct": round(after.stranded_pct, 2),
+        "binpack_before_pct": round(binpack_before, 1),
+        "binpack_after_pct": round(binpack_after, 1),
+        "moves_completed": stats.completed,
+        "moves_failed": stats.failed,
+        "last_move_ms": stats.last_move_ms,
+        "defrag_passes": len(reports),
+        "defrag_wall_ms": round(wall_ms, 1),
+        "double_booked_chips": double_booked,
+        "journal_pending": journal_pending,
+        "orphaned_reservations": len(claims) + len(mem_res) + len(core_res),
+    }
+
+
+def _defrag_gates(defrag: dict) -> list[str]:
+    """Correctness gates on one ``run_defrag_bench`` result — shared by
+    the full bench and ``--defrag-smoke`` so the acceptance bar cannot
+    drift between the two entry points."""
+    msgs: list[str] = []
+    if defrag["stranded_before_pct"] <= 0:
+        msgs.append(
+            "DEFRAG BENCH BROKEN: the churn trace produced no stranded "
+            "HBM — nothing to defragment means nothing was measured"
+        )
+    elif defrag["stranded_after_pct"] >= defrag["stranded_before_pct"]:
+        msgs.append(
+            f"DEFRAG FAILED: stranded-HBM% not strictly reduced "
+            f"({defrag['stranded_before_pct']}% -> "
+            f"{defrag['stranded_after_pct']}%)"
+        )
+    if defrag["binpack_after_pct"] < defrag["binpack_before_pct"]:
+        msgs.append(
+            f"DEFRAG FAILED: binpack density dropped "
+            f"({defrag['binpack_before_pct']}% -> "
+            f"{defrag['binpack_after_pct']}%)"
+        )
+    if defrag["moves_completed"] <= 0:
+        msgs.append("DEFRAG FAILED: no move completed over the churn trace")
+    if defrag["double_booked_chips"]:
+        msgs.append(
+            f"DEFRAG FAILED: {defrag['double_booked_chips']} chip(s) over "
+            "capacity after the moves — double-booking"
+        )
+    if defrag["orphaned_reservations"]:
+        msgs.append(
+            f"DEFRAG FAILED: {defrag['orphaned_reservations']} ledger "
+            "entries survived the moves — orphaned reservations"
+        )
+    if defrag["journal_pending"]:
+        msgs.append(
+            f"DEFRAG FAILED: {defrag['journal_pending']} move entries "
+            "still pending in the WAL after the loop drained"
+        )
+    return msgs
+
+
 def _iter_json_objects(text: str):
     """Top-level JSON objects from a possibly-concatenated stream (the
     driver appends one record per bench invocation to the same file)."""
@@ -902,6 +1101,31 @@ def prefix_hit_guard(ratio: float | None, repo: Path) -> str | None:
     )
 
 
+def defrag_stranded_guard(pct: float | None, repo: Path) -> str | None:
+    """Failure message when the post-defrag stranded-HBM% on the churn
+    trace grew >P99_GUARD_PCT over the newest committed record carrying
+    it; None when within budget or no history. The absolute
+    before->after improvement is hard-gated per run (``_defrag_gates``);
+    this guards the trend — a planner change that still "improves" but
+    leaves more HBM stranded than it used to is a regression."""
+    return _pct_trend_guard(
+        pct, repo, field="defrag_stranded_after_pct",
+        label="defrag stranded-HBM%", fmt=".2f", unit="%",
+    )
+
+
+def defrag_binpack_guard(pct: float | None, repo: Path) -> str | None:
+    """Same budget for the post-defrag binpack packing density
+    (``defrag_binpack_after_pct``, higher is better): the repack
+    objective's other face — fewer stranded slivers must keep showing up
+    as denser occupied chips."""
+    return _pct_trend_guard(
+        pct, repo, field="defrag_binpack_after_pct",
+        label="defrag binpack density", fmt=".1f", unit="%",
+        lower_is_worse=True,
+    )
+
+
 def run_compute_bench(repo: Path, backend_init_timeout: float = 60.0) -> dict:
     """bench_mfu.py in a subprocess; {} on any failure (never fatal here).
 
@@ -983,6 +1207,14 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="bound (seconds) on bench_mfu's subprocess "
                    "backend-init probe — a wedged TPU tunnel costs this "
                    "much, recorded in the report, instead of 300 s")
+    p.add_argument("--defrag-smoke", action="store_true",
+                   help="run ONLY the defrag churn section with a short "
+                   "trace and emit its record — the correctness gates "
+                   "(stranded-HBM strictly reduced, no double-booking, "
+                   "journal/ledger drained) stay HARD even in smoke "
+                   "(make bench-defrag-smoke)")
+    p.add_argument("--no-defrag", action="store_true",
+                   help="skip the defrag churn section")
     p.add_argument("--wal-window-ms", type=float, default=8.0,
                    help="group-commit gather window for the storm's WAL "
                    "(the --wal-batch-window-ms daemon tunable). The storm "
@@ -1116,6 +1348,22 @@ def main(argv=None) -> int:
         TRACER.configure(sample_ratio=0.0)
     if args.trace_bench:
         return run_trace_bench(max(1, args.workers))
+    if args.defrag_smoke:
+        defrag = run_defrag_bench(rounds=3)
+        print(json.dumps({"metric": "defrag_churn", **defrag}))
+        print(
+            f"defrag churn (smoke): stranded "
+            f"{defrag['stranded_before_pct']}% -> "
+            f"{defrag['stranded_after_pct']}% "
+            f"binpack {defrag['binpack_before_pct']}% -> "
+            f"{defrag['binpack_after_pct']}% "
+            f"moves={defrag['moves_completed']}",
+            file=sys.stderr,
+        )
+        failed = _defrag_gates(defrag)
+        for m in failed:
+            print(m, file=sys.stderr)
+        return 1 if failed else 0
     if args.wal_bench:
         return run_wal_bench(
             max(1, args.workers), wal_window_s=args.wal_window_ms / 1000.0
@@ -1210,6 +1458,30 @@ def main(argv=None) -> int:
             )
             return 1
 
+    defrag = {}
+    if not args.no_defrag:
+        defrag = run_defrag_bench(rounds=3 if args.smoke else 6)
+        print(
+            f"defrag churn ({defrag['churn_pods']} pods, "
+            f"{defrag['rounds']} rounds): stranded "
+            f"{defrag['stranded_before_pct']}% -> "
+            f"{defrag['stranded_after_pct']}% "
+            f"binpack {defrag['binpack_before_pct']}% -> "
+            f"{defrag['binpack_after_pct']}% "
+            f"moves={defrag['moves_completed']} "
+            f"({defrag['defrag_wall_ms']}ms)",
+            file=sys.stderr,
+        )
+        defrag_failed = _defrag_gates(defrag)
+        if defrag_failed:
+            # correctness, not performance — like the gang storm's
+            # partial-grant gate, a non-improving or state-leaking
+            # defrag pass fails the bench outright, smoke included
+            print(json.dumps({"metric": "defrag_churn", **defrag}))
+            for m in defrag_failed:
+                print(m, file=sys.stderr)
+            return 1
+
     extender = {}
     if not args.no_extender:
         extender = run_extender_bench(
@@ -1272,8 +1544,15 @@ def main(argv=None) -> int:
         "gang_throughput_gangs_s": gang.get("throughput_gangs_s"),
         "gang_partial_grants": gang.get("partial_grants"),
         "gang_double_assignments": gang.get("double_assignments"),
+        # Defrag churn numbers, hoisted for the trend guards: what the
+        # churn trace still strands after the loop drains, and the
+        # packing density it achieves. The strict before->after
+        # improvement already hard-gated above.
+        "defrag_stranded_after_pct": defrag.get("stranded_after_pct"),
+        "defrag_binpack_after_pct": defrag.get("binpack_after_pct"),
         "concurrent": concurrent,
         "gang": gang,
+        "defrag": defrag,
         "extender": extender,
         "compute": compute,
     }
@@ -1294,6 +1573,8 @@ def main(argv=None) -> int:
         ))
         msgs.append(prefix_hit_guard(record["serve_prefix_hit_ratio"], repo))
         msgs.append(gang_storm_guard(record["gang_throughput_gangs_s"], repo))
+        msgs.append(defrag_stranded_guard(record["defrag_stranded_after_pct"], repo))
+        msgs.append(defrag_binpack_guard(record["defrag_binpack_after_pct"], repo))
     if not args.no_util_guard:
         msgs.append(utilization_guard(record["binpack_utilization_pct"], repo))
     failed = [m for m in msgs if m is not None]

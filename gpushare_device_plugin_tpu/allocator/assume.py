@@ -124,6 +124,19 @@ class AssumeCache:
             self._claimed[key] = now
             return True
 
+    def renew(self, key: PodKey) -> bool:
+        """Re-stamp a held claim's TTL clock; False when the claim is
+        gone (expired and reaped, or never taken). A long-running
+        protocol (a defrag move whose drain outlasts the TTL) renews
+        before its commit point — an expired claim reaps the key's
+        reservations with it, dropping the protocol's capacity
+        protection mid-flight."""
+        with self._lock:
+            if key in self._claimed:
+                self._claimed[key] = self._clock()
+                return True
+            return False
+
     def is_claimed(self, key: PodKey) -> bool:
         with self._lock:
             stamp = self._claimed.get(key)

@@ -52,6 +52,13 @@ Durability modes (``fsync=``, the daemon's ``--wal-fsync`` flag):
   which the torn-tail-tolerant loader already replays correctly: the
   caller never acted on it, so nothing was lost.
 
+Record kinds: ``"mem"`` / ``"core"`` / ``"gang"`` journal one admission's
+chip decision; ``"move"`` journals a live-defragmentation move
+(``allocator/defrag.py``) — the same key carries a fresh ``begin`` per
+protocol phase (``plan -> drain -> copy -> switch -> resume``, the loader
+keeps the newest record), replays as a destination-chip reservation, and
+resolves by phase (roll forward past ``switch``, roll back before it).
+
 Fault points ``checkpoint.begin|commit|abort`` fire immediately *after*
 each record is durable, giving the restart-recovery suite its
 ``crash_after:<site>`` boundaries (see utils/faults.py). Two more sit at
@@ -703,6 +710,19 @@ def replay_checkpoint(ckpt: AllocationCheckpoint, assume: AssumeCache) -> int:
                 assume.reserve_gang(key, members)
             except (KeyError, TypeError, ValueError):
                 log.warning("checkpoint replay: malformed gang entry for %s", key)
+                continue
+        elif kind == "move":
+            # a defragmentation move died mid-protocol: protect the
+            # DESTINATION chip until the reconciler rolls the move forward
+            # or back (allocator/defrag.py). The source stays protected by
+            # the moving pod's own annotation — before the switch PATCH it
+            # still names the source chip; after it, counting the
+            # destination twice is conservative over-reservation, never a
+            # double-booking.
+            try:
+                assume.reserve_mem(key, int(data["dst"]), int(data["units"]))
+            except (KeyError, TypeError, ValueError):
+                log.warning("checkpoint replay: malformed move entry for %s", key)
                 continue
         else:
             log.warning("checkpoint replay: unknown entry kind %r for %s", kind, key)

@@ -124,6 +124,20 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--drain-timeout", type=float, default=5.0,
                    help="graceful-shutdown budget for in-flight Allocate "
                    "calls before the gRPC sockets close")
+    p.add_argument("--defrag-interval", type=float, default=0.0,
+                   help="seconds between live slice-defragmentation "
+                   "passes (journaled move protocol, allocator/defrag.py)"
+                   "; 0 disables (the default — repacking moves running "
+                   "workloads and is an explicit operator opt-in)")
+    p.add_argument("--defrag-quantum", type=int, default=0,
+                   help="stranded-sliver threshold in memory units: free "
+                   "HBM on a partially-used chip below this cannot host "
+                   "a request and counts as stranded; 0 auto-derives it "
+                   "from the largest fractional pod on the node")
+    p.add_argument("--defrag-max-moves", type=int, default=8,
+                   help="upper bound on repacking moves planned per "
+                   "defrag pass — each move drains and restores a "
+                   "running workload, so passes stay small by default")
     p.add_argument("-v", "--verbosity", type=int, default=0)
     return p
 
@@ -185,6 +199,9 @@ def main(argv=None) -> int:
         reconcile_interval_s=args.reconcile_interval,
         drain_timeout_s=args.drain_timeout,
         flightrecord_dir=flightrecord_dir,
+        defrag_interval_s=args.defrag_interval,
+        defrag_quantum=args.defrag_quantum,
+        defrag_max_moves=args.defrag_max_moves,
     )
 
     api_client = None

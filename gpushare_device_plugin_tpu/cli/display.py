@@ -22,19 +22,51 @@ def _table(rows: list[list[str]]) -> str:
     return "\n".join(out)
 
 
+def _chip_cell(info: NodeInfo, d, held: set[int]) -> str:
+    """One chip's ``used/total`` summary, marking exclusive holds and —
+    when the node publishes defrag status — stranded slivers (free HBM
+    below the defragmenter's quantum)."""
+    if d.index in held:
+        body = "exclusive"
+    else:
+        body = f"{d.used_units}/{d.total_units}"
+        stranded = info.stranded_by_chip.get(d.index, 0)
+        if stranded:
+            body += f" ({stranded} stranded)"
+    return f"chip{d.index}: {body}"
+
+
+def _moves_cell(status: dict | None) -> str:
+    """The MOVES column: planned/active/completed move counters plus the
+    last move's duration, from the defrag-status node annotation."""
+    if not status:
+        return "-"
+    cell = (
+        f"{int(status.get('planned', 0))} planned · "
+        f"{int(status.get('active', 0))} active · "
+        f"{int(status.get('completed', 0))} done"
+    )
+    last = status.get("last_move_ms")
+    if last:
+        cell += f" · last {float(last):.1f}ms"
+    return cell
+
+
 def render_summary(infos: list[NodeInfo]) -> str:
     unit = infer_unit(infos)
     buf = StringIO()
     any_core = any(i.core_holds for i in infos)
+    any_defrag = any(i.defrag is not None for i in infos)
     header = ["NAME", "IPADDRESS", f"TPU Memory ({unit})"]
     if any_core:
         header.append("EXCLUSIVE CHIPS (tpu-core)")
+    if any_defrag:
+        header.append("MOVES (defrag)")
     rows = [header]
     for info in infos:
         held = set(info.core_held_chips)
         chips = ", ".join(
-            f"chip{d.index}: "
-            + ("exclusive" if d.index in held else f"{d.used_units}/{d.total_units}")
+            _chip_cell(info, d, held)
             for d in sorted(info.devices.values(), key=lambda d: d.index)
         )
         row = [info.name, info.address, chips]
@@ -44,6 +76,8 @@ def render_summary(infos: list[NodeInfo]) -> str:
             if pending_holds:
                 cell += f" (+{pending_holds} pending)"
             row.append(cell)
+        if any_defrag:
+            row.append(_moves_cell(info.defrag))
         rows.append(row)
     buf.write(_table(rows))
     buf.write("\n")
@@ -62,6 +96,11 @@ def render_summary(infos: list[NodeInfo]) -> str:
         n_pods = sum(len(i.core_holds) for i in infos)
         buf.write(
             f"Exclusively held TPU chips (tpu-core): {n_held} across {n_pods} pod(s)\n"
+        )
+    if any_defrag:
+        stranded = sum(sum(i.stranded_by_chip.values()) for i in infos)
+        buf.write(
+            f"Stranded (sub-quantum sliver) TPU Memory ({unit}): {stranded}\n"
         )
     return buf.getvalue()
 
@@ -267,6 +306,17 @@ def render_details(
             f"Allocated : {info.used_units} ({(100.0 * info.used_units / info.total_units) if info.total_units else 0:.0f}%)\n"
         )
         buf.write(f"Total     : {info.total_units}\n")
+        if info.defrag is not None:
+            slivers = " ".join(
+                f"chip{i}:{u}"
+                for i, u in sorted(info.stranded_by_chip.items())
+            ) or "none"
+            buf.write(
+                f"Stranded  : {sum(info.stranded_by_chip.values())} "
+                f"({unit}, sub-quantum slivers: {slivers}, "
+                f"quantum {int(info.defrag.get('quantum') or 0)})\n"
+            )
+            buf.write(f"Moves     : {_moves_cell(info.defrag)}\n")
         buf.write("\n")
     buf.write(render_summary(infos))
     return buf.getvalue()

@@ -286,12 +286,33 @@ def render_json(
             "total_units": n.total_units,
             "used_units": n.used_units,
             "pending_units": n.pending_units,
+            # defrag-status annotation + per-chip stranded slivers, when
+            # the node's daemon runs the defragmenter (the MOVES column's
+            # machine-readable form)
+            **(
+                {
+                    "defrag": {
+                        **n.defrag,
+                        "stranded_by_chip": {
+                            str(i): u
+                            for i, u in sorted(n.stranded_by_chip.items())
+                        },
+                    }
+                }
+                if n.defrag is not None
+                else {}
+            ),
             "chips": [
                 {
                     "index": d.index,
                     "total_units": d.total_units,
                     "used_units": d.used_units,
                     "core_held": d.index in held,
+                    **(
+                        {"stranded_units": n.stranded_by_chip.get(d.index, 0)}
+                        if n.defrag is not None
+                        else {}
+                    ),
                 }
                 for d in sorted(n.devices.values(), key=lambda d: d.index)
             ],

@@ -71,6 +71,14 @@ class NodeInfo:
     # count) — lets the report print gang member COORDINATES, not bare
     # indices
     topology: ChipTopology | None = None
+    # the daemon's defrag-status annotation (allocator/defrag.py
+    # DefragLoop.publish_status): move counters + stranded totals; None
+    # when the node runs no defragmenter (columns stay hidden, keeping
+    # the reference layout)
+    defrag: dict | None = None
+    # per-chip stranded-HBM units, recomputed from this report's own
+    # usage attribution at the annotation's quantum
+    stranded_by_chip: dict[int, int] = dataclasses.field(default_factory=dict)
 
     @property
     def total_units(self) -> int:
@@ -182,6 +190,20 @@ def build_node_info(
                 chips=P.core_hold_chips(pod) if P.is_assigned(pod) else [],
                 requested=P.core_chips_of_pod(pod),
             )
+        )
+    # Defrag status (when the node's daemon publishes it): the MOVES
+    # column straight from the annotation, per-chip stranded slivers
+    # recomputed from THIS report's usage attribution at the published
+    # quantum — so the table's chips and its stranded markers can never
+    # disagree with each other.
+    from ..allocator.defrag import status_from_node, stranded_units
+
+    info.defrag = status_from_node(node)
+    if info.defrag is not None:
+        info.stranded_by_chip = stranded_units(
+            {i: d.total_units for i, d in info.devices.items()},
+            {i: d.used_units for i, d in info.devices.items()},
+            int(info.defrag.get("quantum") or 0),
         )
     return info
 

@@ -20,6 +20,10 @@ One pass (``reconcile_once``):
    won); pod gone or unassigned -> retro-abort (nothing persisted).
    Either way its ledger reservation is released. Entries whose pod key
    is currently *claimed* belong to a live admission and are skipped.
+   ``"move"`` entries (live defragmentation, ``allocator/defrag.py``)
+   resolve by protocol phase instead: roll forward past ``switch``
+   (re-issue the PATCH, restore the drained engine snapshot on the
+   destination), roll back before it.
 4. **ledger orphans** — unclaimed reservations whose pod is authoritatively
    gone (deleted mid-allocation) or already counted by annotations
    (redundant) are released.
@@ -79,12 +83,15 @@ class DriftReconciler:
         kubelet_grants_fn: Callable[[], dict[PodKey, list[str]]] | None = None,
         interval_s: float = DEFAULT_INTERVAL_S,
         on_fenced: Callable[[], None] | None = None,
+        move_restore_fn: Callable[[PodKey, dict | None], None] | None = None,
     ) -> None:
         """``kubelet_grants_fn() -> dict[PodKey, list[str]]`` supplies
         kubelet's granted device IDs per pod when a feed exists (the fake
         kubelet in tests; the podresources socket in production); None
         skips that diff. ``on_fenced()`` fires once when this instance
-        discovers it was superseded."""
+        discovers it was superseded. ``move_restore_fn(pod_key, snapshot)``
+        re-admits a drained engine snapshot on the destination slice when
+        a defragmentation move is rolled forward (allocator/defrag.py)."""
         self._api = api
         self._pods = pod_source
         self._assume = assume
@@ -94,6 +101,7 @@ class DriftReconciler:
         self._grants_fn = kubelet_grants_fn
         self._interval = interval_s
         self._on_fenced = on_fenced
+        self._move_restore = move_restore_fn
         self._fenced_notified = False
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -230,6 +238,22 @@ class DriftReconciler:
         for key, data in self._ckpt.pending().items():
             if self._assume.is_claimed(key):
                 continue  # a live admission owns this entry
+            if data.get("kind") == "move":
+                # a defragmentation move found mid-protocol: resolved by
+                # phase — roll forward past "switch" (re-issue the PATCH,
+                # restore the drained snapshot on the destination), roll
+                # back before it (allocator/defrag.py owns the rules)
+                if self._api is None:
+                    continue  # no authoritative read: stay protective
+                from ..allocator import defrag
+
+                outcome = defrag.resolve_move(
+                    self._ckpt, self._assume, self._api, key, data,
+                    restore_fn=self._move_restore,
+                )
+                if outcome is not None:
+                    drift(f"move_{outcome}", repaired=True)
+                continue
             pod, authoritative = self._fetch_pod(key)
             if not authoritative:
                 continue  # resolve next pass, reservation stays protective

@@ -101,6 +101,15 @@ LABEL_DISABLE_ISOLATION = "ctpu.disable.isolation"
 # by design; test_tracing pins the two strings agree).
 ANN_TRACE_ID = "tpushare.aliyun.com/trace-id"
 
+# --- Live defragmentation (allocator/defrag.py) ----------------------------
+# Node annotation carrying the daemon's defragmenter status as JSON:
+# {"planned", "active", "completed", "failed", "last_move_ms", "quantum",
+#  "stranded_units", "stranded_pct"} — written best-effort after every
+# defrag pass so kubectl-inspect-tpushare can render per-node MOVES and
+# stranded-HBM columns with no extra endpoint ("apiserver is the
+# database", as ever).
+ANN_DEFRAG_STATUS = "tpushare.aliyun.com/defrag-status"
+
 # --- Scheduler-extender annotation (reference: cmd/inspect/main.go:23) -----
 # JSON map[containerName]map[chipIdx]memUnits written by the extender at bind
 # time; the inspect CLI prefers it for per-chip attribution.

@@ -68,6 +68,13 @@ class ManagerConfig:
     # exit, and injected-crash postmortems land here. Empty disables (the
     # daemon defaults it to the coredump dir).
     flightrecord_dir: str = ""
+    # Live slice defragmentation (allocator/defrag.py): scan cadence in
+    # seconds, <= 0 disables (the default — repacking moves workloads and
+    # should be an explicit operator opt-in). quantum=0 auto-derives the
+    # stranded-sliver threshold from the node's own pod sizes.
+    defrag_interval_s: float = 0.0
+    defrag_quantum: int = 0
+    defrag_max_moves: int = 8
 
 
 class TpuShareManager:
@@ -127,9 +134,47 @@ class TpuShareManager:
 
             self._patch_pipeline = PodPatchPipeline(api_client)
         self._reconciler = None
+        # Live defragmentation (allocator/defrag.py): the loop itself, and
+        # the engine hand-off hooks a serving integration registers via
+        # set_move_hooks() — None means moves skip the drain/restore
+        # phases (workloads that checkpoint themselves).
+        self._defrag = None
+        self._move_drain_fn = None
+        self._move_restore_fn = None
         self._restart = threading.Event()
         self._stop = threading.Event()
         self._park = threading.Event()
+
+    def set_move_hooks(self, drain_fn=None, restore_fn=None) -> None:
+        """Register the defragmenter's engine hand-off: ``drain_fn(pod_key)
+        -> snapshot dict | None`` quiesces the pod's serving engine
+        (``PagedSlotEngine.drain_snapshot``), ``restore_fn(pod_key,
+        snapshot)`` re-admits it on the destination slice. Takes effect
+        immediately: the reconciler and mover dispatch through the
+        manager and read the current hooks at call time — registering
+        after the build (the natural order; the engine exists only once
+        a pod is served) still covers in-flight move resolution."""
+        self._move_drain_fn = drain_fn
+        self._move_restore_fn = restore_fn
+
+    def _move_drain_dispatch(self, pod_key):
+        fn = self._move_drain_fn
+        return None if fn is None else fn(pod_key)
+
+    def _move_restore_dispatch(self, pod_key, snapshot) -> None:
+        fn = self._move_restore_fn
+        if fn is None:
+            if snapshot:
+                # A drained snapshot with no registered restore hook must
+                # NOT be dropped: raising maps to retry-next-pass in both
+                # resolve_move and SliceMover, so the journaled requests
+                # survive until the serving integration (re)registers.
+                raise RuntimeError(
+                    "drained engine snapshot present but no restore hook "
+                    "registered (set_move_hooks)"
+                )
+            return
+        fn(pod_key, snapshot)
 
     # ------------------------------------------------------------------
 
@@ -424,9 +469,66 @@ class TpuShareManager:
                 node_name=self._cfg.node_name,
                 inventory=inventory,
                 interval_s=self._cfg.reconcile_interval_s,
+                move_restore_fn=self._move_restore_dispatch,
+            ).start()
+        # Live defragmentation rides the same substrate: planner over the
+        # pod source, mover through the shared ledger + WAL + patch
+        # pipeline. Starts one full interval in — the reconciler's first
+        # pass resolves any move the previous incarnation died holding
+        # before this one plans new work.
+        if (
+            self._api is not None
+            and self._pod_source is not None
+            and not self._cfg.standalone
+            and self._cfg.defrag_interval_s > 0
+            and self._cfg.node_name
+        ):
+            from ..allocator.defrag import DefragLoop, DefragPlanner, SliceMover
+
+            # the mem plugin's live health view: unhealthy chips are
+            # excluded from planning (never drained, never filled) just
+            # as the admission allocator refuses to place on them
+            unhealthy_fns = [
+                p.unhealthy_chip_indices
+                for p in self._plugins
+                if p.resource_name == const.RESOURCE_MEM
+            ]
+
+            def _excluded() -> set[int]:
+                return {i for fn in unhealthy_fns for i in fn()}
+
+            planner = DefragPlanner(
+                inventory.units_by_index,
+                self._pod_source,
+                quantum=self._cfg.defrag_quantum,
+                excluded_fn=_excluded,
+                max_moves=self._cfg.defrag_max_moves,
+            )
+            mover = SliceMover(
+                self._api,
+                self._pod_source,
+                self._alloc_assume,
+                self._ckpt,
+                self._cfg.node_name,
+                inventory.units_by_index,
+                drain_fn=self._move_drain_dispatch,
+                restore_fn=self._move_restore_dispatch,
+                patch_fn=(
+                    self._patch_pipeline.patch_pod
+                    if self._patch_pipeline is not None else None
+                ),
+            )
+            self._defrag = DefragLoop(
+                planner, mover, self._api, self._cfg.node_name,
+                interval_s=self._cfg.defrag_interval_s,
             ).start()
 
     def _stop_all(self) -> None:
+        if self._defrag is not None:
+            # before the reconciler: a mid-shutdown move must not lose its
+            # resolver while still journaling phases
+            self._defrag.stop()
+            self._defrag = None
         if self._reconciler is not None:
             self._reconciler.stop()
             self._reconciler = None

@@ -44,6 +44,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import threading
 import time
 from collections import deque
 from typing import Sequence
@@ -54,6 +55,8 @@ import numpy as np
 
 from ..const import MemoryUnit
 from ..parallel.podenv import PodTpuEnv
+from ..utils.lockrank import make_lock
+from ..utils.log import get_logger
 from ..utils.metrics import REGISTRY
 from ..utils.tracing import TRACER
 from ..workloads import generate as G
@@ -67,6 +70,8 @@ from .pages import (
     row_span_for,
 )
 from .radix import RadixCache
+
+log = get_logger("serving.engine")
 
 # SLO tiers (the Tally-style priority split, PAPERS.md 2410.07381):
 # latency-critical requests admit first and may preempt best-effort
@@ -293,6 +298,7 @@ class SlotEngine:
         self.max_len = max_len
         self.chunk = prefill_chunk
         self.eos_id = eos_id
+        self.kv_dtype = kv_dtype
         self.cache = self._make_cache(kv_dtype)
         # Tensor-parallel serving across a granted gang: with a mesh (from
         # ``parallel.podenv.gang_mesh`` inside a multi-chip grant), the
@@ -706,6 +712,26 @@ class PagedSlotEngine(SlotEngine):
         self.allocator = PageAllocator(total_pages)
         self.radix = RadixCache(page_size, self.allocator) if radix else None
         self.preemptions = 0
+        # Live-defragmentation drain (allocator/defrag.py move protocol):
+        # request_drain() quiesces the current run() at its next iteration
+        # boundary — in-flight requests are captured into a JSON-safe
+        # snapshot, their pages freed — and restore_snapshot() re-admits
+        # them on another engine (the destination slice) bit-identically.
+        self._drain_evt = threading.Event()
+        self._drained_evt = threading.Event()  # set when run() quiesces
+        # serializes the arm/capture/consume transitions of the drain
+        # handshake (near-leaf: held around Event/dict flips only, a few
+        # times per run — never per tick, never over another lock)
+        self._drain_lock = make_lock("serving.drain")
+        self._drained: dict | None = None
+        self._restore_tokens: dict[int, tuple[int, ...]] = {}
+        # snapshot_ids this instance already restored: the move
+        # protocol's restore delivery is at-least-once across the
+        # resume/commit crash window, so the receiver deduplicates. Keyed
+        # on the mover-stamped identity, NOT content — two independent
+        # moves of a deterministic workload can legitimately carry
+        # byte-identical snapshots, and both must serve.
+        self._restored_ids: deque[str] = deque(maxlen=16)
 
     def _make_cache(self, kv_dtype: str | None):
         # +1: physical page 0 is the scratch write sink (pages.SCRATCH)
@@ -799,6 +825,169 @@ class PagedSlotEngine(SlotEngine):
             )
         return out
 
+    # --- drain/restore: the defrag move protocol's engine hand-off --------
+
+    def request_drain(self) -> None:
+        """Ask the in-progress :meth:`run` to quiesce at its next
+        iteration boundary: admission stops, every unfinished request is
+        captured into :meth:`drain_snapshot`, and its pages are freed.
+        Thread-safe — the defragmenter's ``drain_fn`` calls this from the
+        move protocol's ``drain`` phase while the serving thread loops; a
+        drain requested while the engine is idle captures the next run's
+        whole queue immediately. A cross-thread caller must then
+        :meth:`wait_drained` — reading :meth:`drain_snapshot` before the
+        serving thread reaches the boundary returns stale/None and the
+        eventual snapshot would never be collected."""
+        # Reset the quiesce state from any PRIOR run before arming: a
+        # completed run leaves _drained_evt set (and possibly an old
+        # collected snapshot behind) — without this, a drain requested
+        # between runs returns that stale answer immediately and the
+        # NEXT run's capture is never collected (lost requests). Only
+        # this re-arm (and the everything-retired answer) may discard a
+        # capture: runs never do, so a snapshot survives until its
+        # waiter reads it, however late that thread is scheduled.
+        with self._drain_lock:
+            self._drained_evt.clear()
+            self._drained = None
+            self._drain_evt.set()
+
+    def wait_drained(self, timeout: float | None = None) -> dict | None:
+        """Block until the serving thread quiesced after
+        :meth:`request_drain` — either it captured a drain snapshot or
+        its :meth:`run` completed with nothing left in flight — then
+        return :meth:`drain_snapshot` (None in the ran-to-completion
+        case: every request retired, nothing to move). Raises
+        ``TimeoutError`` when ``timeout`` (seconds) expires with no run
+        reaching a boundary — the mover treats a ``drain_fn`` exception
+        as a failed move, and the not-quiesced case MUST be
+        distinguishable from the clean nothing-in-flight None: a mover
+        that read None from a wedged engine would flip the pod's
+        accounting while the source is still actively serving.
+
+        A timed-out wait DISARMS the drain before raising: the move is
+        dead, and an engine left armed would quiesce its next unrelated
+        run immediately — every request captured into a snapshot nobody
+        collects (lost). If the serving thread reached the boundary in
+        the instant between the wait expiring and the disarm, that
+        capture is taken instead of raised away."""
+        if not self._drained_evt.wait(timeout):
+            with self._drain_lock:
+                if not self._drained_evt.is_set():
+                    self._drain_evt.clear()
+                    raise TimeoutError(
+                        "engine did not quiesce after request_drain()"
+                        + (f" within {timeout}s" if timeout is not None else "")
+                    )
+        return self.drain_snapshot()
+
+    def drain_snapshot(self) -> dict | None:
+        """The JSON-safe in-flight snapshot captured by the last drained
+        :meth:`run` (None when the last quiesce ended with everything
+        retired; an uncollected capture survives back-to-back runs until
+        the next :meth:`request_drain` re-arms the cycle): engine
+        geometry plus one row per unfinished request — prompt, tokens
+        generated so far, tier/SLO targets, queue state. Everything the
+        destination engine needs to continue the request with greedy
+        tokens bit-identical to an unmoved run. KV bytes are deliberately
+        NOT carried: restore re-prefills prompt + generated tokens (the
+        preemption re-admission math), and radix-shared prefixes
+        re-resolve against the destination engine's own cache."""
+        return self._drained
+
+    def _drain_row(
+        self, req: Request, res: RequestResult | None, state: str
+    ) -> dict:
+        return {
+            "rid": req.rid,
+            "state": state,
+            "prompt": list(req.prompt),
+            "max_new": req.max_new,
+            "arrival": float(req.arrival),
+            "tier": req.tier,
+            "slo_ttft_ticks": req.slo_ttft_ticks,
+            "slo_tpot_ticks": req.slo_tpot_ticks,
+            "tokens": list(res.tokens) if res is not None else [],
+        }
+
+    def restore_snapshot(self, snapshot: dict | None) -> ServeStats:
+        """Re-admit a drained snapshot on THIS engine (the move's
+        destination slice) and serve it to completion. Each restored
+        request re-prefills its prompt plus its pre-drain tokens — greedy
+        decoding is deterministic, so the continuation (and therefore the
+        combined token list in the returned results) is bit-identical to
+        a run that was never drained. Raises on an eos/kv-dtype mismatch
+        with the snapshot's source engine: those change WHAT tokens come
+        out, and a silent divergence is exactly what the move protocol's
+        bit-identity contract forbids (pool geometry — slots, pages,
+        max_len — may differ; that only changes WHERE bytes live —
+        but every snapshot request must still pass the destination's
+        :meth:`validate`: a destination whose ``max_len`` cannot hold a
+        request's prompt + budget raises, and the mover/reconciler keeps
+        the move pending rather than committing away the journal's only
+        copy — plan moves between same-geometry engines).
+
+        Idempotent per delivery: the move protocol's restore delivery is
+        AT-LEAST-ONCE — a daemon killed between the mover's restore and
+        its WAL commit rolls forward at restart and re-delivers the same
+        journaled snapshot to this (still running) engine. The mover
+        stamps each journaled snapshot with a ``snapshot_id`` unique to
+        the move attempt; an id this instance already restored is a
+        logged no-op, so the duplicate delivery can never serve the
+        drained requests twice. A snapshot WITHOUT an id (a source-side
+        supervisor re-serving its own drain after a rollback) is never
+        deduplicated — identity, not content, is the key: two
+        independent moves of a deterministic workload legitimately carry
+        byte-identical snapshots."""
+        if not snapshot or not snapshot.get("requests"):
+            return ServeStats(
+                results=[], ticks=0, wall_s=0.0,
+                trace_counts=dict(self.trace_counts),
+            )
+        snap_id = snapshot.get("snapshot_id")
+        if snap_id is not None and snap_id in self._restored_ids:
+            log.warning(
+                "restore_snapshot: snapshot %s already restored on this "
+                "engine; duplicate delivery ignored", snap_id,
+            )
+            return ServeStats(
+                results=[], ticks=0, wall_s=0.0,
+                trace_counts=dict(self.trace_counts),
+            )
+        eng = snapshot.get("engine") or {}
+        if eng.get("eos_id", self.eos_id) != self.eos_id or (
+            eng.get("kv_dtype", self.kv_dtype) != self.kv_dtype
+        ):
+            raise ValueError(
+                f"snapshot from engine {eng} cannot restore here "
+                f"(eos_id={self.eos_id}, kv_dtype={self.kv_dtype}) — "
+                "greedy tokens would silently diverge"
+            )
+        reqs: list[Request] = []
+        seeds: dict[int, tuple[int, ...]] = {}
+        for row in snapshot["requests"]:
+            req = Request(
+                rid=int(row["rid"]),
+                prompt=tuple(int(t) for t in row["prompt"]),
+                max_new=int(row["max_new"]),
+                arrival=0.0,  # every drained request has already arrived
+                tier=str(row.get("tier", TIER_CRITICAL)),
+                slo_ttft_ticks=row.get("slo_ttft_ticks"),
+                slo_tpot_ticks=row.get("slo_tpot_ticks"),
+            )
+            reqs.append(req)
+            seeds[req.rid] = tuple(int(t) for t in row.get("tokens") or ())
+        self._restore_tokens = seeds
+        try:
+            stats = self.run(reqs)
+        finally:
+            self._restore_tokens = {}
+        # recorded only after the run quiesced (served to completion or
+        # drained into a fresh snapshot): a restore that died mid-run
+        # stays re-deliverable
+        if snap_id is not None:
+            self._restored_ids.append(snap_id)
+        return stats
+
     # --- page bookkeeping -------------------------------------------------
 
     def _fresh_slot(self) -> _PagedSlot:
@@ -813,7 +1002,12 @@ class PagedSlotEngine(SlotEngine):
         s.pages.extend(got)
         s.table[base : base + len(got)] = got
 
-    def run(self, requests: Sequence[Request]) -> ServeStats:
+    def run(
+        self,
+        requests: Sequence[Request],
+        *,
+        drain_at_tick: int | None = None,
+    ) -> ServeStats:
         """Serve to completion with paged admission. Per iteration:
         (1) enqueue arrivals, (2) admit pending requests in (tier,
         arrival) order — radix-matching each prompt and allocating first
@@ -823,10 +1017,21 @@ class PagedSlotEngine(SlotEngine):
         decode step over rows whose next position is page-backed. A row
         that cannot get its next page stalls in place (its neighbors
         keep decoding) until pages free up or preemption policy frees
-        them."""
+        them.
+
+        ``drain_at_tick`` (or a concurrent :meth:`request_drain`) ends
+        the run at the next iteration boundary once the tick clock
+        reaches it: unfinished requests move into
+        :meth:`drain_snapshot`, their pages are freed, and only already-
+        retired results are returned — the engine half of a
+        defragmentation move (``allocator/defrag.py``)."""
         for r in requests:
             self.validate(r)
         self.ticks = 0
+        # deliberately NOT resetting the drain handshake here: an
+        # uncollected capture from a prior run must survive a
+        # back-to-back run() start until its waiter reads it — only
+        # request_drain() (re-arming a new cycle) may discard it
         incoming = sorted(requests, key=lambda r: (r.arrival, r.rid))
         slots = [self._fresh_slot() for _ in range(self.n_slots)]
         pending: list[Request] = []
@@ -953,10 +1158,60 @@ class PagedSlotEngine(SlotEngine):
         while i < len(incoming) or pending or any(
             s.state != "free" for s in slots
         ):
+            if self._drain_evt.is_set() or (
+                drain_at_tick is not None and self.ticks >= drain_at_tick
+            ):
+                # quiesce: capture every unfinished request (in-flight
+                # rows, the pending queue — a preempted-then-drained
+                # request sits here with its regenerated tokens — and
+                # arrivals this run never reached), free the pool, and
+                # stop. Retired results below are the only ones returned.
+                rows = []
+                for s in sorted(
+                    (s for s in slots if s.state != "free"),
+                    key=lambda s: (s.req.arrival, s.req.rid),
+                ):
+                    rows.append(self._drain_row(s.req, live[s.req.rid], "slot"))
+                    release_row(s)
+                for req in sorted(pending, key=tier_key):
+                    rows.append(self._drain_row(req, live[req.rid], "pending"))
+                for req in incoming[i:]:
+                    row = self._drain_row(req, None, "queued")
+                    # a restored-but-never-enqueued request keeps its
+                    # pre-drain tokens: until the arrival loop seeds
+                    # live[], the only copy is _restore_tokens — without
+                    # this a second move would regenerate from scratch
+                    # and break the bit-identity contract
+                    seed = self._restore_tokens.get(req.rid)
+                    if seed:
+                        row["tokens"] = list(seed)
+                    rows.append(row)
+                captured = {
+                    "version": 1,
+                    "drain_tick": self.ticks,
+                    "engine": {
+                        "slots": self.n_slots, "max_len": self.max_len,
+                        "page_size": self.page_size,
+                        "prefill_chunk": self.chunk,
+                        "total_pages": self.total_pages,
+                        "eos_id": self.eos_id, "kv_dtype": self.kv_dtype,
+                    },
+                    "requests": rows,
+                }
+                with self._drain_lock:
+                    self._drained = captured
+                    self._drain_evt.clear()
+                    self._drained_evt.set()  # wake cross-thread wait_drained
+                break
             while i < len(incoming) and incoming[i].arrival <= self.ticks:
                 req = incoming[i]
                 live[req.rid] = RequestResult(
-                    rid=req.rid, prompt_len=len(req.prompt), tokens=[],
+                    rid=req.rid, prompt_len=len(req.prompt),
+                    # restore path: pre-drain tokens seed the result, so
+                    # admission re-prefills prompt + tokens (the
+                    # preemption re-admission math) and the retired
+                    # token list is the COMBINED stream
+                    tokens=list(self._restore_tokens.get(req.rid, ())),
                     arrival_tick=req.arrival, arrival_s=now(),
                     tier=req.tier, slo_ttft_ticks=req.slo_ttft_ticks,
                     slo_tpot_ticks=req.slo_tpot_ticks,
@@ -1170,6 +1425,18 @@ class PagedSlotEngine(SlotEngine):
 
         self.publish_metrics()
         results.sort(key=lambda r: r.rid)
+        # quiesced either way: a drain requested after the last iteration
+        # boundary is CONSUMED by the everything-retired answer (evt set,
+        # snapshot None, drain disarmed — leaving it armed would make the
+        # next unrelated run quiesce into a snapshot nobody collects) —
+        # without the wake, a wait_drained racing the run's natural end
+        # would block forever. A pending uncollected capture from an
+        # earlier drained run (evt already set) is left for its waiter.
+        with self._drain_lock:
+            if not self._drained_evt.is_set():
+                self._drained = None
+                self._drain_evt.clear()
+                self._drained_evt.set()
         return ServeStats(
             results=results, ticks=self.ticks,
             wall_s=time.perf_counter() - t0,

@@ -28,13 +28,24 @@ Registered points (see docs/robustness.md for the failure-mode matrix):
                         caller of the batch dead)
 ``allocator.post_persist``  after the pod PATCH landed, before the WAL
                         commit record (the mid-window crash site)
+``defrag.plan``         after the move's "plan" phase record is durable,
+                        before the destination reservation
+``defrag.drain``        after the "drain" record is durable, before the
+                        engine quiesce/snapshot
+``defrag.copy``         after the "copy" record (snapshot included) is
+                        durable
+``defrag.switch``       after the "switch" record is durable, before the
+                        annotation PATCH (the roll-forward boundary)
+``defrag.resume``       after the "resume" record is durable, before the
+                        destination restore + move commit
 ==========================================================================
 
-The ``checkpoint.*`` / ``allocator.post_persist`` points sit immediately
-*after* each journal step takes durable effect, so arming them with the
-``crash`` mode is the ``crash_after:<site>`` primitive the restart-recovery
-suite drives: the process "dies" with the file/apiserver state exactly as
-a SIGKILL at that instruction would leave it.
+The ``checkpoint.*`` / ``allocator.post_persist`` / ``defrag.*`` points
+sit immediately *after* each journal step takes durable effect, so arming
+them with the ``crash`` mode is the ``crash_after:<site>`` primitive the
+restart-recovery and chaos-move suites drive: the process "dies" with the
+file/apiserver state exactly as a SIGKILL at that instruction would leave
+it (and, via the crash hook, dumps a flight record first).
 
 Modes:
 
@@ -89,6 +100,11 @@ POINTS = (
     "checkpoint.wal_queue",
     "checkpoint.batch_fsync",
     "allocator.post_persist",
+    "defrag.plan",
+    "defrag.drain",
+    "defrag.copy",
+    "defrag.switch",
+    "defrag.resume",
 )
 
 

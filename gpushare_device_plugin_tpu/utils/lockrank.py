@@ -96,6 +96,19 @@ RANKS: dict[str, LockRank] = dict(
             "close-the-bind-window exception, so I/O is allowed.",
         ),
         _r(
+            "defrag.planner", 24, "lock", False,
+            "DefragPlanner's cached last-scan report: the defrag loop "
+            "writes it, the CLI/status publisher reads it. In-memory "
+            "only; the scan's pod reads run before the lock is taken.",
+        ),
+        _r(
+            "defrag.moves", 26, "lock", False,
+            "SliceMover's move-state counters (planned/active/completed/"
+            "last duration). Never held across a journal fsync or the "
+            "switch PATCH — the move protocol's I/O runs between, not "
+            "under, counter updates.",
+        ),
+        _r(
             "allocator.ledger", 30, "rlock", False,
             "AssumeCache's claim/reservation ledger: one atomic "
             "snapshot-overlay-decide-reserve step. Pure memory; the "
@@ -179,6 +192,14 @@ RANKS: dict[str, LockRank] = dict(
             "circuit.breaker", 88, "lock", False,
             "CircuitBreaker state counters; the guarded call runs with "
             "the lock released.",
+        ),
+        _r(
+            "serving.drain", 89, "lock", False,
+            "PagedSlotEngine's drain-handshake state (arm / capture / "
+            "consume transitions of the _drain/_drained events and the "
+            "captured snapshot). Near-leaf: held around Event/dict "
+            "flips a few times per run — never per tick, never over "
+            "another lock.",
         ),
         _r(
             "faults.registry", 90, "lock", False,

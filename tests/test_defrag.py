@@ -1,0 +1,951 @@
+"""Live slice defragmentation (``allocator/defrag.py``): planner
+correctness plus the crash-safe move protocol — the ``make chaos-move``
+suite.
+
+The acceptance discipline mirrors ``test_restart_recovery.py``: a
+"crash" is a ``SimulatedCrash`` injected at a ``defrag.*`` fault point
+(every boundary the move journal defines, in both WAL fsync modes), the
+"restart" reconstructs a second daemon from the persisted artifacts only
+(checkpoint reload, ``replay_checkpoint``, one ``DriftReconciler`` pass),
+and the criteria are: no double-booked chip, no orphaned reservation, the
+moving pod assigned exactly once (rolled forward past ``switch``, rolled
+back before it), and — in the engine-level test — every drained request's
+greedy tokens bit-identical to a run that was never moved.
+"""
+
+import pytest
+
+from gpushare_device_plugin_tpu import const
+from gpushare_device_plugin_tpu.allocator import defrag as D
+from gpushare_device_plugin_tpu.allocator.assume import AssumeCache
+from gpushare_device_plugin_tpu.allocator.checkpoint import (
+    AllocationCheckpoint,
+    StaleDaemonError,
+    replay_checkpoint,
+)
+from gpushare_device_plugin_tpu.cluster import pods as P
+from gpushare_device_plugin_tpu.cluster.apiserver import ApiServerClient
+from gpushare_device_plugin_tpu.cluster.podsource import ApiServerPodSource
+from gpushare_device_plugin_tpu.cluster.reconciler import DriftReconciler
+from gpushare_device_plugin_tpu.utils.faults import FAULTS, SimulatedCrash
+
+from fake_apiserver import FakeApiServer
+from k8s_fixtures import assigned_running_pod, make_pod
+
+NODE = "node-defrag"
+CAP = {0: 8, 1: 8}
+
+# Every boundary the move journal defines, in protocol order; None = the
+# uncrashed control run. ``switch`` is the roll-forward boundary.
+MOVE_SITES = [
+    None,
+    "defrag.plan",    # plan record durable, destination not yet reserved
+    "defrag.drain",   # drain record durable, engine never quiesced
+    "defrag.copy",    # snapshot durable inside the copy record
+    "defrag.switch",  # switch record durable, PATCH never on the wire
+    "defrag.resume",  # PATCH landed, restore + commit never ran
+]
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    FAULTS.clear()
+    yield
+    FAULTS.clear()
+
+
+@pytest.fixture
+def api():
+    srv = FakeApiServer()
+    srv.add_node(NODE)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def audit_no_overcommit(api, capacity):
+    used = {}
+    for _key, pod in api.pods.items():
+        if not P.is_active(pod) or not P.is_assigned(pod):
+            continue
+        idx = P.chip_idx_from_annotation(pod)
+        assert idx >= 0, "assigned pod with garbled chip index"
+        used[idx] = used.get(idx, 0) + P.mem_units_of_pod(pod)
+    for idx, n in used.items():
+        assert n <= capacity[idx], (
+            f"chip {idx} double-booked: {n} > {capacity[idx]} units"
+        )
+
+
+# ---------------------------------------------------------------------------
+# stranded accounting + planner
+# ---------------------------------------------------------------------------
+
+
+def test_stranded_units_accounting():
+    cap = {0: 8, 1: 8, 2: 8}
+    used = {0: 6, 1: 8, 2: 0}
+    # chip0: 2 free < quantum -> stranded; chip1 full; chip2 wholly free
+    assert D.stranded_units(cap, used, 4) == {0: 2}
+    assert D.stranded_units(cap, used, 2) == {}  # a 2-unit pod still fits
+    assert D.stranded_units(cap, used, 0) == {}  # no quantum, no slivers
+    assert D.stranded_pct(cap, used, 4) == pytest.approx(100.0 * 2 / 24)
+    assert D.stranded_pct({}, {}, 4) == 0.0
+
+
+def test_plan_moves_strictly_improves():
+    cap = {0: 8, 1: 8, 2: 8}
+    placements = {("d", "a"): (0, 6), ("d", "b"): (1, 2)}
+    # quantum 4: chip0's 2-unit sliver is stranded, chip1's 6 free is not
+    moves = D.plan_moves(cap, placements, 4)
+    assert moves == [D.MovePlan(pod=("d", "b"), src=1, dst=0, units=2)]
+    # applying the plan heals the node completely
+    used = {0: 8}
+    assert D.stranded_units(cap, used, 4) == {}
+
+
+def test_plan_moves_never_regresses_or_loops():
+    cap = {0: 8, 1: 8, 2: 8}
+    # nothing fits anywhere better: no move strictly improves -> empty plan
+    placements = {("d", "a"): (0, 6), ("d", "b"): (1, 6), ("d", "c"): (2, 6)}
+    assert D.plan_moves(cap, placements, 4) == []
+    # max_moves bounds the plan even when improvement remains
+    many = {("d", f"p{i}"): (i % 3, 5) for i in range(3)}
+    assert len(D.plan_moves(cap, many, 4, max_moves=1)) <= 1
+
+
+def test_plan_moves_respects_excluded_chips():
+    cap = {0: 8, 1: 8, 2: 8}
+    placements = {("d", "a"): (0, 6), ("d", "b"): (1, 2)}
+    # the healing destination (chip0) is excluded (core-held/unhealthy/
+    # mid-move): no move may fill or drain it
+    moves = D.plan_moves(cap, placements, 4, excluded={0})
+    assert all(m.src != 0 and m.dst != 0 for m in moves)
+
+
+def test_planner_counts_gang_usage_on_chips(api):
+    """Gang members are not movable, but their HBM usage is real: the
+    planner must count it — both in the stranded gauges and as occupancy
+    no move can displace — instead of seeing gang chips as free and
+    planning moves the execute-time capacity check can only abort,
+    forever, on every pass."""
+    client = ApiServerClient(api.url)
+    source = ApiServerPodSource(client, NODE)
+    api.add_pod(assigned_running_pod("big", 6, chip_idx=0, node=NODE))
+    api.add_pod(assigned_running_pod(
+        "gang", 12, chip_idx=1, node=NODE,
+        annotations={
+            const.ENV_GANG_CHIPS: "1,2",
+            const.ENV_GANG_SHAPE: "2x1x1",
+            const.ENV_GANG_PER_CHIP: "6",
+        },
+    ))
+    cap = {0: 8, 1: 8, 2: 8}
+    planner = D.DefragPlanner(lambda: dict(cap), source)
+    report = planner.scan()
+    assert report.quantum == 6
+    # every chip is partially used with a sub-quantum sliver — the gang
+    # chips included, not invisible
+    assert report.stranded_by_chip == {0: 2, 1: 2, 2: 2}
+    # and no destination can host "big": nothing to plan, rather than a
+    # doomed move onto a chip the gang already fills
+    assert report.moves == ()
+
+
+def test_movable_placements_keeps_gangs_whole():
+    single = assigned_running_pod("solo", 2, chip_idx=0, node=NODE)
+    gang = assigned_running_pod(
+        "gang", 8, chip_idx=0, node=NODE,
+        annotations={
+            const.ENV_GANG_CHIPS: "0,1",
+            const.ENV_GANG_SHAPE: "2x1x1",
+            const.ENV_GANG_PER_CHIP: "4",
+        },
+    )
+    unassigned = make_pod("pending", 4, node=NODE)
+    out = D.movable_placements([single, gang, unassigned])
+    assert out == {("default", "solo"): (0, 2)}
+
+
+def test_planner_scan_auto_quantum_and_report(api):
+    client = ApiServerClient(api.url)
+    source = ApiServerPodSource(client, NODE)
+    api.add_pod(assigned_running_pod("big", 6, chip_idx=0, node=NODE))
+    api.add_pod(assigned_running_pod("small", 2, chip_idx=1, node=NODE))
+    cap = {0: 8, 1: 8, 2: 8}
+    planner = D.DefragPlanner(lambda: dict(cap), source)
+    report = planner.scan()
+    # auto quantum = largest fractional pod (6): chip0's 2-unit sliver is
+    # stranded, chip1's 6 free can still host a "big"
+    assert report.quantum == 6
+    assert report.stranded_by_chip == {0: 2}
+    assert report.stranded_pct == pytest.approx(100.0 * 2 / 24)
+    assert report.moves == (
+        D.MovePlan(pod=("default", "small"), src=1, dst=0, units=2),
+    )
+    assert planner.last_report() == report
+
+
+def test_planner_outage_keeps_last_stranded_gauges(api):
+    """An apiserver outage makes a scan compute stranded=0 from an EMPTY
+    pod list; publishing that would paint a fragmented node as healed for
+    the outage's duration. The gauge must keep the last honest value —
+    the documented detection signal is "the gauge stops updating"."""
+    from gpushare_device_plugin_tpu.utils.metrics import REGISTRY
+
+    client = ApiServerClient(api.url)
+    inner = ApiServerPodSource(client, NODE)
+
+    class Flaky:
+        fail = False
+
+        def labeled_pods(self):
+            if self.fail:
+                raise RuntimeError("apiserver down")
+            return inner.labeled_pods()
+
+        def chip_state(self):
+            if self.fail:
+                raise RuntimeError("apiserver down")
+            return inner.chip_state()
+
+    api.add_pod(assigned_running_pod("big", 6, chip_idx=0, node=NODE))
+    api.add_pod(assigned_running_pod("small", 2, chip_idx=1, node=NODE))
+    src = Flaky()
+    planner = D.DefragPlanner(lambda: {0: 8, 1: 8, 2: 8}, src)
+    planner.scan()
+
+    def gauge(name):
+        return REGISTRY._gauges.get((name, ()))
+
+    assert gauge(D.STRANDED_GAUGE) == 2.0
+    src.fail = True
+    report = planner.scan()
+    assert report.moves == () and report.stranded_by_chip == {}
+    assert gauge(D.STRANDED_GAUGE) == 2.0, "outage pass zeroed the gauge"
+
+
+# ---------------------------------------------------------------------------
+# the journaled move protocol
+# ---------------------------------------------------------------------------
+
+
+SNAP = {"requests": [{"rid": 7, "prompt": [1, 2], "tokens": [5]}]}
+
+
+def assert_delivered(restores, pod_key):
+    """Exactly one restore delivery: the drained snapshot, with the
+    mover-stamped ``snapshot_id`` (the destination engine's
+    duplicate-delivery dedup key, unique per move attempt) riding along."""
+    (k, snap), = restores
+    assert k == pod_key
+    body = dict(snap)
+    sid = body.pop("snapshot_id")
+    assert sid.startswith(f"{NODE}/")
+    assert body == SNAP
+
+
+def mk_world(api, path, mode="always", drain=None, restore=None):
+    client = ApiServerClient(api.url)
+    source = ApiServerPodSource(client, NODE)
+    ckpt = AllocationCheckpoint(str(path), fsync=mode)
+    assume = AssumeCache()
+    mover = D.SliceMover(
+        client, source, assume, ckpt, NODE, lambda: dict(CAP),
+        drain_fn=drain, restore_fn=restore,
+    )
+    return client, source, ckpt, assume, mover
+
+
+def test_move_completes_end_to_end(api, tmp_path):
+    api.add_pod(assigned_running_pod("mv", 2, chip_idx=0, node=NODE))
+    restores = []
+    client, _src, ckpt, assume, mover = mk_world(
+        api, tmp_path / "wal.ckpt",
+        drain=lambda key: dict(SNAP), restore=lambda k, s: restores.append((k, s)),
+    )
+    plan = D.MovePlan(pod=("default", "mv"), src=0, dst=1, units=2)
+    assert mover.execute(plan) is True
+    pod = client.get_pod("default", "mv")
+    assert P.chip_idx_from_annotation(pod) == 1
+    assert P.annotations(pod)[const.ENV_MEM_DEV] == "8"
+    assert P.is_assigned(pod)
+    assert_delivered(restores, ("default", "mv"))
+    from gpushare_device_plugin_tpu.utils.metrics import REGISTRY
+    assert REGISTRY.counter_value(D.MOVES_METRIC, outcome="completed") >= 1
+    # protocol fully resolved: journal empty, ledger drained
+    assert ckpt.pending() == {}
+    claims, mem, core = assume.snapshot()
+    assert claims == {} and mem == {} and core == {}
+    stats = mover.stats()
+    assert (stats.planned, stats.completed, stats.failed) == (1, 1, 0)
+    assert stats.last_move_ms > 0
+    audit_no_overcommit(api, CAP)
+
+
+def test_move_aborts_cleanly_when_plan_raced_reality(api, tmp_path):
+    from gpushare_device_plugin_tpu.utils.metrics import REGISTRY
+
+    # pod sits on chip1 already: the plan is stale, nothing must change
+    api.add_pod(assigned_running_pod("mv", 2, chip_idx=1, node=NODE))
+    client, _src, ckpt, assume, mover = mk_world(api, tmp_path / "wal.ckpt")
+    plan = D.MovePlan(pod=("default", "mv"), src=0, dst=1, units=2)
+    before = REGISTRY.counter_value(D.MOVES_METRIC, outcome="aborted")
+    assert mover.execute(plan) is False
+    assert ckpt.pending() == {}
+    assert assume.snapshot()[1] == {}
+    assert mover.stats().failed == 1
+    # live aborts must be visible on /metrics, not only in the node
+    # annotation's failed counter
+    assert REGISTRY.counter_value(D.MOVES_METRIC, outcome="aborted") == before + 1
+
+
+def test_move_rolls_back_when_pod_deleted_mid_move(api, tmp_path):
+    api.add_pod(assigned_running_pod("mv", 2, chip_idx=0, node=NODE))
+    client, _src, ckpt, assume, mover = mk_world(api, tmp_path / "wal.ckpt")
+    # delete the pod between planning and the switch PATCH: the drain
+    # hook is the protocol's mid-move window
+    _, _, ckpt, assume, mover = mk_world(
+        api, tmp_path / "wal2.ckpt",
+        drain=lambda key: api.delete_pod("default", "mv"),
+    )
+    plan = D.MovePlan(pod=("default", "mv"), src=0, dst=1, units=2)
+    assert mover.execute(plan) is False
+    assert ckpt.pending() == {}
+    claims, mem, _core = assume.snapshot()
+    assert claims == {} and mem == {}
+
+
+@pytest.mark.parametrize("mode", ["always", "batch"])
+@pytest.mark.parametrize("site", MOVE_SITES)
+def test_kill_at_every_move_step(site, mode, api, tmp_path):
+    """The chaos-move acceptance: SIGKILL the daemon at each journal
+    boundary (both WAL fsync modes), restart from the persisted artifacts
+    only, and prove the reconciler converges — roll forward at/past
+    ``switch``, roll back before it, zero double-booking, zero orphaned
+    reservations, the drained snapshot delivered exactly when the move
+    completed."""
+    path = tmp_path / "wal.ckpt"
+    api.add_pod(assigned_running_pod("mv", 2, chip_idx=0, node=NODE))
+    api.add_pod(assigned_running_pod("anchor", 6, chip_idx=1, node=NODE))
+    client1, _s1, ckpt1, assume1, mover1 = mk_world(
+        api, path, mode=mode, drain=lambda key: dict(SNAP),
+    )
+    plan = D.MovePlan(pod=("default", "mv"), src=0, dst=1, units=2)
+
+    # --- incarnation 1: dies (or not) mid-move ----------------------------
+    if site is None:
+        assert mover1.execute(plan) is True
+    else:
+        with FAULTS.injected(site, "crash", times=1):
+            with pytest.raises(SimulatedCrash):
+                mover1.execute(plan)
+        ckpt1.abandon()  # SIGKILL-faithful: no flush, no close
+
+    # --- incarnation 2: restart from the persisted artifacts only ---------
+    client2 = ApiServerClient(api.url)
+    source2 = ApiServerPodSource(client2, NODE)
+    ckpt2 = AllocationCheckpoint(str(path), fsync=mode)
+    assume2 = AssumeCache()
+    n = replay_checkpoint(ckpt2, assume2)
+    key = D.move_key(plan.pod)
+    if site is None:
+        assert n == 0
+    else:
+        # the replayed move entry protects the DESTINATION before any
+        # reconcile pass: a concurrent admission overlaying the ledger
+        # sees chip1 at 6 (anchor) + 2 (reservation) = full
+        assert n == 1
+        assert assume2.snapshot()[1] == {key: (plan.dst, plan.units)}
+
+    restores = []
+    rec = DriftReconciler(
+        api=client2,
+        pod_source=source2,
+        assume=assume2,
+        checkpoint=ckpt2,
+        node_name=NODE,
+        move_restore_fn=lambda k, s: restores.append((k, s)),
+    )
+    drift = rec.reconcile_once()
+
+    rolled_forward = site in (None, "defrag.switch", "defrag.resume")
+    pod = client2.get_pod("default", "mv")
+    if site is None:
+        assert drift == {}
+    elif rolled_forward:
+        assert drift.get("move_rollforward") == 1
+        # the drained snapshot reached the destination: zero lost requests
+        assert_delivered(restores, plan.pod)
+    else:
+        assert drift.get("move_rollback") == 1
+        # before the commit point nothing changed and nothing restores
+        # (the workload never left the source)
+        assert restores == []
+    expected_chip = plan.dst if rolled_forward else plan.src
+    assert P.chip_idx_from_annotation(pod) == expected_chip
+    assert P.mem_units_of_pod(pod) == plan.units
+
+    # convergence: journal empty, ledger drained, no chip over capacity,
+    # and a second pass finds nothing left to repair
+    assert ckpt2.pending() == {}
+    claims, mem, core = assume2.snapshot()
+    assert claims == {} and mem == {} and core == {}
+    audit_no_overcommit(api, CAP)
+    assert rec.reconcile_once() == {}
+
+
+@pytest.mark.parametrize("site", ["defrag.switch", "defrag.resume"])
+def test_move_for_deleted_pod_rolls_back_in_any_phase(site, api, tmp_path):
+    path = tmp_path / "wal.ckpt"
+    api.add_pod(assigned_running_pod("mv", 2, chip_idx=0, node=NODE))
+    _c1, _s1, ckpt1, _a1, mover1 = mk_world(api, path)
+    plan = D.MovePlan(pod=("default", "mv"), src=0, dst=1, units=2)
+    with FAULTS.injected(site, "crash", times=1):
+        with pytest.raises(SimulatedCrash):
+            mover1.execute(plan)
+    ckpt1.abandon()
+    api.delete_pod("default", "mv")
+
+    client2 = ApiServerClient(api.url)
+    source2 = ApiServerPodSource(client2, NODE)
+    ckpt2 = AllocationCheckpoint(str(path))
+    assume2 = AssumeCache()
+    assert replay_checkpoint(ckpt2, assume2) == 1
+    restores = []
+    rec = DriftReconciler(
+        api=client2, pod_source=source2, assume=assume2, checkpoint=ckpt2,
+        node_name=NODE, move_restore_fn=lambda k, s: restores.append(s),
+    )
+    drift = rec.reconcile_once()
+    # deleted pod: both the synthetic destination reservation and the
+    # journal entry end released, nothing restored anywhere
+    assert drift.get("move_rollback") == 1
+    assert restores == []
+    assert ckpt2.pending() == {}
+    assert assume2.snapshot()[1] == {}
+
+
+def test_stale_daemon_cannot_finish_anothers_move(api, tmp_path):
+    """Fencing rides the WAL: a daemon superseded mid-move gets
+    ``StaleDaemonError`` from its next phase journal, drops only its
+    in-memory reservation, and leaves the journal entry for the owning
+    incarnation's reconciler."""
+    api.add_pod(assigned_running_pod("mv", 2, chip_idx=0, node=NODE))
+    client = ApiServerClient(api.url)
+    path = tmp_path / "wal.ckpt"
+    _c, _s, ckpt1, assume1, _m = mk_world(api, path)
+    ckpt1.acquire_fence(client, NODE)
+
+    def drain_and_supersede(key):
+        # a newer daemon takes the node while we are mid-move
+        newer = AllocationCheckpoint(str(tmp_path / "wal-new.ckpt"))
+        newer.acquire_fence(client, NODE)
+        assert not ckpt1.verify_fence(client, NODE)  # latches fenced
+        newer.close()
+        return dict(SNAP)
+
+    source = ApiServerPodSource(client, NODE)
+    mover = D.SliceMover(
+        client, source, assume1, ckpt1, NODE, lambda: dict(CAP),
+        drain_fn=drain_and_supersede,
+    )
+    plan = D.MovePlan(pod=("default", "mv"), src=0, dst=1, units=2)
+    with pytest.raises(StaleDaemonError):
+        mover.execute(plan)
+    # the pod never moved, our reservation is gone, and the entry stays
+    # pending for the owner (its replay re-creates the protection there)
+    pod = client.get_pod("default", "mv")
+    assert P.chip_idx_from_annotation(pod) == 0
+    assert assume1.snapshot()[1] == {}
+    entry = ckpt1.pending()[D.move_key(plan.pod)]
+    assert entry["kind"] == "move" and entry["phase"] == "drain"
+    assert mover.stats().failed == 1
+
+
+def test_live_move_is_claimed_against_concurrent_reconcile(api, tmp_path):
+    """The mover claims the move key for the whole protocol, exactly as
+    an admission claims its pod key: a reconcile pass racing a live move
+    (fired here from inside the drain hook, with the entry pending in
+    phase "drain") must skip the claimed entry — resolving it would
+    release the destination reservation out from under the running move
+    and restore the drained snapshot twice."""
+    api.add_pod(assigned_running_pod("mv", 2, chip_idx=0, node=NODE))
+    client = ApiServerClient(api.url)
+    source = ApiServerPodSource(client, NODE)
+    ckpt = AllocationCheckpoint(str(tmp_path / "wal.ckpt"))
+    assume = AssumeCache()
+    plan = D.MovePlan(pod=("default", "mv"), src=0, dst=1, units=2)
+    key = D.move_key(plan.pod)
+    passes = []
+    restores = []
+    rec = DriftReconciler(
+        api=client, pod_source=source, assume=assume, checkpoint=ckpt,
+        node_name=NODE, move_restore_fn=lambda k, s: restores.append((k, s)),
+    )
+
+    def drain_and_reconcile(pod_key):
+        passes.append(rec.reconcile_once())
+        # the racing pass left the in-flight move untouched
+        assert ckpt.pending()[key]["phase"] == "drain"
+        assert assume.snapshot()[1] == {key: (plan.dst, plan.units)}
+        return dict(SNAP)
+
+    mover = D.SliceMover(
+        client, source, assume, ckpt, NODE, lambda: dict(CAP),
+        drain_fn=drain_and_reconcile,
+    )
+    assert mover.execute(plan) is True
+    assert passes == [{}]  # the racing pass resolved nothing
+    assert restores == []  # and never delivered the snapshot
+    pod = client.get_pod("default", "mv")
+    assert P.chip_idx_from_annotation(pod) == 1
+    assert ckpt.pending() == {}
+    claims, mem, core = assume.snapshot()
+    assert claims == {} and mem == {} and core == {}
+    audit_no_overcommit(api, CAP)
+
+
+def test_move_aborts_when_destination_filled_since_planning(api, tmp_path):
+    """Execute-time destination re-validation: a plan is computed against
+    a scan snapshot, and a concurrent admission can land on the
+    destination in between. The mover must abort the stale move instead
+    of over-booking the chip through the switch PATCH."""
+    api.add_pod(assigned_running_pod("mv", 2, chip_idx=0, node=NODE))
+    api.add_pod(assigned_running_pod("anchor", 6, chip_idx=1, node=NODE))
+    client, _src, ckpt, assume, mover = mk_world(api, tmp_path / "wal.ckpt")
+    # the plan was made when chip1 had 2 free; an admission fills it
+    api.add_pod(assigned_running_pod("late", 2, chip_idx=1, node=NODE))
+    plan = D.MovePlan(pod=("default", "mv"), src=0, dst=1, units=2)
+    assert mover.execute(plan) is False
+    # nothing flipped, nothing leaked
+    pod = client.get_pod("default", "mv")
+    assert P.chip_idx_from_annotation(pod) == 0
+    assert ckpt.pending() == {}
+    claims, mem, core = assume.snapshot()
+    assert claims == {} and mem == {} and core == {}
+    assert mover.stats().failed == 1
+    audit_no_overcommit(api, CAP)
+
+
+def test_resolve_move_restore_failure_leaves_entry_pending(api, tmp_path):
+    """A roll-forward whose engine restore fails must NOT commit: the
+    journal record is the only copy of the drained snapshot, and
+    committing would silently lose every request it carries. The entry
+    (and its protective destination reservation) stays for the next
+    pass — which delivers the snapshot once the restore path works."""
+    path = tmp_path / "wal.ckpt"
+    api.add_pod(assigned_running_pod("mv", 2, chip_idx=0, node=NODE))
+    _c1, _s1, ckpt1, _a1, mover1 = mk_world(
+        api, path, drain=lambda key: dict(SNAP),
+    )
+    plan = D.MovePlan(pod=("default", "mv"), src=0, dst=1, units=2)
+    # die at "resume": the switch PATCH landed, restore + commit never ran
+    with FAULTS.injected("defrag.resume", "crash", times=1):
+        with pytest.raises(SimulatedCrash):
+            mover1.execute(plan)
+    ckpt1.abandon()
+
+    client2 = ApiServerClient(api.url)
+    source2 = ApiServerPodSource(client2, NODE)
+    ckpt2 = AllocationCheckpoint(str(path))
+    assume2 = AssumeCache()
+    assert replay_checkpoint(ckpt2, assume2) == 1
+    key = D.move_key(plan.pod)
+
+    def broken(k, s):
+        raise RuntimeError("destination engine not rebuilt yet")
+
+    rec_broken = DriftReconciler(
+        api=client2, pod_source=source2, assume=assume2, checkpoint=ckpt2,
+        node_name=NODE, move_restore_fn=broken,
+    )
+    drift = rec_broken.reconcile_once()
+    assert "move_rollforward" not in drift and "move_rollback" not in drift
+    assert key in ckpt2.pending()
+    assert assume2.snapshot()[1] == {key: (plan.dst, plan.units)}
+
+    # no hook registered at all (restart before the serving integration
+    # re-registers): same outcome — the snapshot-carrying entry pends,
+    # never commits
+    rec_none = DriftReconciler(
+        api=client2, pod_source=source2, assume=assume2, checkpoint=ckpt2,
+        node_name=NODE,
+    )
+    drift = rec_none.reconcile_once()
+    assert "move_rollforward" not in drift
+    assert key in ckpt2.pending()
+
+    restores = []
+    rec_ok = DriftReconciler(
+        api=client2, pod_source=source2, assume=assume2, checkpoint=ckpt2,
+        node_name=NODE, move_restore_fn=lambda k, s: restores.append((k, s)),
+    )
+    drift = rec_ok.reconcile_once()
+    assert drift.get("move_rollforward") == 1
+    assert_delivered(restores, plan.pod)
+    assert ckpt2.pending() == {}
+    claims, mem, core = assume2.snapshot()
+    assert claims == {} and mem == {} and core == {}
+    audit_no_overcommit(api, CAP)
+
+
+def test_status_from_node_coerces_garbled_numerics():
+    """A half-garbled defrag-status annotation (a null counter, a
+    stringly duration) must degrade to zeros, not crash every CLI
+    invocation against that node."""
+    node = {"metadata": {"annotations": {const.ANN_DEFRAG_STATUS: (
+        '{"planned": null, "active": "x", "completed": 3, '
+        '"last_move_ms": "bogus", "quantum": 2.0, "note": "free-form"}'
+    )}}}
+    status = D.status_from_node(node)
+    assert status == {
+        "planned": 0, "active": 0, "completed": 3,
+        "last_move_ms": 0.0, "quantum": 2, "note": "free-form",
+    }
+    # fully-non-JSON and non-dict annotations still read as absent
+    assert D.status_from_node({"metadata": {"annotations": {
+        const.ANN_DEFRAG_STATUS: "not json"}}}) is None
+    assert D.status_from_node({"metadata": {"annotations": {
+        const.ANN_DEFRAG_STATUS: "[1, 2]"}}}) is None
+
+
+# ---------------------------------------------------------------------------
+# the loop: scan -> move -> publish
+# ---------------------------------------------------------------------------
+
+
+def test_defrag_loop_heals_stranded_and_publishes_status(api, tmp_path):
+    cap = {0: 8, 1: 8, 2: 8}
+    api.add_pod(assigned_running_pod("big", 6, chip_idx=0, node=NODE))
+    api.add_pod(assigned_running_pod("small", 2, chip_idx=1, node=NODE))
+    client = ApiServerClient(api.url)
+    source = ApiServerPodSource(client, NODE)
+    ckpt = AllocationCheckpoint(str(tmp_path / "wal.ckpt"))
+    assume = AssumeCache()
+    planner = D.DefragPlanner(lambda: dict(cap), source)
+    mover = D.SliceMover(
+        client, source, assume, ckpt, NODE, lambda: dict(cap),
+    )
+    loop = D.DefragLoop(planner, mover, client, NODE, interval_s=3600.0)
+
+    report = loop.run_once()
+    assert report.stranded_pct > 0 and len(report.moves) == 1
+    # the move landed: "small" now fills chip0's sliver
+    pod = client.get_pod("default", "small")
+    assert P.chip_idx_from_annotation(pod) == 0
+    # stranded-HBM strictly improved, journal and ledger clean
+    after = planner.scan()
+    assert after.stranded_pct < report.stranded_pct
+    assert after.stranded_pct == 0.0
+    assert ckpt.pending() == {} and assume.snapshot()[1] == {}
+    audit_no_overcommit(api, cap)
+
+    # the status annotation is the CLI's feed
+    status = D.status_from_node(client.get_node(NODE))
+    assert status is not None
+    assert status["planned"] == 1 and status["completed"] == 1
+    assert status["active"] == 0 and status["failed"] == 0
+    assert status["last_move_ms"] > 0
+    assert status["quantum"] == 6
+    # stranded figures describe the PRE-move scan that planned the pass
+    assert status["stranded_units"] == 2
+    assert status["stranded_pct"] == pytest.approx(100.0 * 2 / 24, abs=0.01)
+
+
+def test_defrag_loop_excludes_core_held_chips(api, tmp_path):
+    cap = {0: 8, 1: 8, 2: 8}
+    api.add_pod(assigned_running_pod("big", 6, chip_idx=0, node=NODE))
+    api.add_pod(assigned_running_pod("small", 2, chip_idx=1, node=NODE))
+    # chip0 (the natural destination) is exclusively held by a core pod:
+    # the planner must not touch it
+    api.add_pod(make_pod(
+        "exclusive", 0, node=NODE, phase="Running", tpu_core=1,
+        annotations={
+            const.ENV_CORE_IDS: "0",
+            const.ENV_ASSIGNED_FLAG: "true",
+        },
+        labels={const.LABEL_RESOURCE_KEY: const.LABEL_RESOURCE_VALUE},
+    ))
+    client = ApiServerClient(api.url)
+    source = ApiServerPodSource(client, NODE)
+    planner = D.DefragPlanner(lambda: dict(cap), source)
+    report = planner.scan()
+    assert all(m.src != 0 and m.dst != 0 for m in report.moves)
+
+
+def test_move_aborts_when_destination_core_held_since_scan(api, tmp_path):
+    """A tpu-core pod takes an exclusive hold on the planned destination
+    between the scan and the move's execute: an exclusively held chip
+    has mem_used 0, so the capacity check alone would happily flip a
+    fractional pod onto it. The execute-time re-validation must honor
+    the hold — same skip the mem admission path applies."""
+    api.add_pod(assigned_running_pod("mv", 2, chip_idx=0, node=NODE))
+    # the core pod admitted after the (hypothetical) scan, before execute
+    api.add_pod(make_pod(
+        "exclusive", 0, node=NODE, phase="Running", tpu_core=1,
+        annotations={
+            const.ENV_CORE_IDS: "1",
+            const.ENV_ASSIGNED_FLAG: "true",
+        },
+        labels={const.LABEL_RESOURCE_KEY: const.LABEL_RESOURCE_VALUE},
+    ))
+    client, _src, ckpt, assume, mover = mk_world(api, tmp_path / "wal.ckpt")
+    plan = D.MovePlan(pod=("default", "mv"), src=0, dst=1, units=2)
+    assert mover.execute(plan) is False
+    # aborted before anything flipped: pod still on src, protocol clean
+    pod = client.get_pod("default", "mv")
+    assert P.chip_idx_from_annotation(pod) == 0
+    assert ckpt.pending() == {} and assume.snapshot()[1] == {}
+
+
+def test_move_aborts_when_reservation_expired_and_dst_filled_mid_drain(
+    api, tmp_path
+):
+    """A drain that outlasts the ledger TTL loses its protective
+    destination reservation; a concurrent admission can then book dst to
+    capacity unseen. The pre-switch re-stamp + re-verify must abort the
+    move instead of flipping the pod onto an over-booked chip."""
+    api.add_pod(assigned_running_pod("mv", 2, chip_idx=0, node=NODE))
+    client = ApiServerClient(api.url)
+    source = ApiServerPodSource(client, NODE)
+    ckpt = AllocationCheckpoint(str(tmp_path / "wal.ckpt"))
+    now = [0.0]
+    assume = AssumeCache(ttl_s=1.0, clock=lambda: now[0])
+
+    def slow_drain(key):
+        now[0] += 10.0  # the drain outlasts the TTL: reservation expires
+        # a concurrent admission books the destination to capacity
+        assume.reserve_mem(("default", "hog"), 1, CAP[1])
+        return dict(SNAP)
+
+    mover = D.SliceMover(
+        client, source, assume, ckpt, NODE, lambda: dict(CAP),
+        drain_fn=slow_drain,
+    )
+    plan = D.MovePlan(pod=("default", "mv"), src=0, dst=1, units=2)
+    assert mover.execute(plan) is False
+    pod = client.get_pod("default", "mv")
+    assert P.chip_idx_from_annotation(pod) == 0, "switch PATCH went out"
+    assert ckpt.pending() == {}
+    assert D.move_key(plan.pod) not in assume.snapshot()[1]
+
+
+def test_pre_switch_gate_renews_a_live_claim(api, tmp_path):
+    """A drain that eats MOST of the TTL leaves a near-expiry claim; the
+    gate must re-stamp it (not just observe it alive), or it expires in
+    the switch window and the reap drops the destination reservation —
+    capacity protection lost exactly when the PATCH is in flight."""
+    api.add_pod(assigned_running_pod("mv", 2, chip_idx=0, node=NODE))
+    client = ApiServerClient(api.url)
+    source = ApiServerPodSource(client, NODE)
+    ckpt = AllocationCheckpoint(str(tmp_path / "wal.ckpt"))
+    now = [0.0]
+    assume = AssumeCache(ttl_s=10.0, clock=lambda: now[0])
+    key = D.move_key(("default", "mv"))
+    stamps = {}
+
+    def slow_drain(k):
+        now[0] += 9.0  # claim (stamped at ~0) is one second from expiry
+        return dict(SNAP)
+
+    def spy_restore(k, s):
+        # resume phase runs after the gate: the claim must carry a
+        # fresh stamp, not the protocol-start one
+        stamps["claim"] = assume.snapshot()[0].get(key)
+
+    mover = D.SliceMover(
+        client, source, assume, ckpt, NODE, lambda: dict(CAP),
+        drain_fn=slow_drain, restore_fn=spy_restore,
+    )
+    plan = D.MovePlan(pod=("default", "mv"), src=0, dst=1, units=2)
+    assert mover.execute(plan) is True
+    assert stamps["claim"] == 9.0, "gate did not renew the live claim"
+
+
+def test_switch_rewrites_extender_allocation_map(api, tmp_path):
+    """An extender-bound pod carries the per-container allocation map,
+    and the inspect CLI PREFERS it for per-chip attribution: the switch
+    PATCH must move it to dst too, or the CLI pins the pod to src
+    forever and the post-move stranded gauges report the node as still
+    fragmented after a successful repack."""
+    import json as _json
+
+    api.add_pod(assigned_running_pod(
+        "mv", 2, chip_idx=0, node=NODE,
+        annotations={
+            const.ANN_EXTENDER_ALLOCATION: _json.dumps({"c0": {"0": 2}}),
+        },
+    ))
+    client, _src, ckpt, assume, mover = mk_world(api, tmp_path / "wal.ckpt")
+    plan = D.MovePlan(pod=("default", "mv"), src=0, dst=1, units=2)
+    assert mover.execute(plan) is True
+    pod = client.get_pod("default", "mv")
+    assert P.chip_idx_from_annotation(pod) == 1
+    moved = _json.loads(P.annotations(pod)[const.ANN_EXTENDER_ALLOCATION])
+    assert moved == {"c0": {"1": 2}}
+
+
+def test_run_once_counts_propagating_failure_in_status(api, tmp_path):
+    """A move that dies with a propagating exception (not a clean abort)
+    must show up in the published annotation's failed counter AND the
+    outcome=failed metric — not just one of them."""
+    from gpushare_device_plugin_tpu.utils.metrics import REGISTRY
+
+    cap = {0: 8, 1: 8, 2: 8}
+    api.add_pod(assigned_running_pod("big", 6, chip_idx=0, node=NODE))
+    api.add_pod(assigned_running_pod("small", 2, chip_idx=1, node=NODE))
+    client = ApiServerClient(api.url)
+    source = ApiServerPodSource(client, NODE)
+    ckpt = AllocationCheckpoint(str(tmp_path / "wal.ckpt"))
+    assume = AssumeCache()
+
+    def broken_drain(key):
+        raise RuntimeError("engine hook wedged")
+
+    planner = D.DefragPlanner(lambda: dict(cap), source)
+    mover = D.SliceMover(
+        client, source, assume, ckpt, NODE, lambda: dict(cap),
+        drain_fn=broken_drain,
+    )
+    loop = D.DefragLoop(planner, mover, client, NODE, interval_s=3600.0)
+    before = REGISTRY.counter_value(D.MOVES_METRIC, outcome="failed")
+    loop.run_once()  # the failure is swallowed; entry pends for reconcile
+    status = D.status_from_node(client.get_node(NODE))
+    assert status is not None and status["failed"] == 1
+    assert REGISTRY.counter_value(D.MOVES_METRIC, outcome="failed") == before + 1
+
+
+def test_fenced_pass_publishes_no_status(api, tmp_path):
+    """A daemon that just learned it was fenced mid-move must not PATCH
+    the defrag-status node annotation on its way out: the node PATCH is
+    unfenced, and the superseded incarnation's stale counters would
+    overwrite the owning daemon's published picture."""
+    cap = {0: 8, 1: 8, 2: 8}
+    api.add_pod(assigned_running_pod("big", 6, chip_idx=0, node=NODE))
+    api.add_pod(assigned_running_pod("small", 2, chip_idx=1, node=NODE))
+    client = ApiServerClient(api.url)
+    source = ApiServerPodSource(client, NODE)
+    ckpt1 = AllocationCheckpoint(str(tmp_path / "wal.ckpt"))
+    ckpt1.acquire_fence(client, NODE)
+    assume = AssumeCache()
+
+    def drain_and_supersede(key):
+        newer = AllocationCheckpoint(str(tmp_path / "wal-new.ckpt"))
+        newer.acquire_fence(client, NODE)
+        assert not ckpt1.verify_fence(client, NODE)  # latches fenced
+        newer.close()
+        return dict(SNAP)
+
+    planner = D.DefragPlanner(lambda: dict(cap), source)
+    mover = D.SliceMover(
+        client, source, assume, ckpt1, NODE, lambda: dict(cap),
+        drain_fn=drain_and_supersede,
+    )
+    loop = D.DefragLoop(planner, mover, client, NODE, interval_s=3600.0)
+    with pytest.raises(StaleDaemonError):
+        loop.run_once()
+    assert D.status_from_node(client.get_node(NODE)) is None, (
+        "fenced daemon published status"
+    )
+
+
+@pytest.mark.slow
+def test_chaos_move_engine_snapshot_bit_identical(api, tmp_path):
+    """The full acceptance loop: a real ``PagedSlotEngine`` drains
+    mid-run, its snapshot rides the move journal, the daemon is killed at
+    every protocol boundary, and after recovery EVERY request's combined
+    greedy tokens (pre-drain + post-restore) are bit-identical to a run
+    that was never moved — whether the move rolled forward (destination
+    engine restores the journaled snapshot, JSON round-trip included) or
+    rolled back (the source-side supervisor re-serves its own snapshot).
+    Zero lost requests either way."""
+    import jax
+    import jax.numpy as jnp
+
+    from gpushare_device_plugin_tpu.serving import (
+        PagedSlotEngine,
+        poisson_trace,
+    )
+    from gpushare_device_plugin_tpu.workloads.transformer import (
+        TransformerConfig,
+        init_params,
+    )
+
+    EOS = 3
+    cfg = TransformerConfig(
+        vocab=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2, d_ff=64,
+        max_seq=64, compute_dtype=jnp.float32,
+    )
+    params = init_params(jax.random.key(0), cfg)
+
+    def engine():
+        return PagedSlotEngine(
+            params, cfg, slots=2, max_len=32, total_pages=24, page_size=4,
+            prefill_chunk=4, eos_id=EOS,
+        )
+
+    reqs = poisson_trace(
+        6, seed=11, rate=0.3, vocab=cfg.vocab, prompt_lens=(1, 9),
+        max_new=(2, 10),
+    )
+    ref_tokens = {r.rid: r.tokens for r in engine().run(reqs).results}
+    src = engine()  # reused across sites: run() resets per call
+    dst = engine()  # destination; its radix cache warms across moves,
+    #                 which stresses "prefixes re-resolve on restore"
+
+    for i, site in enumerate(MOVE_SITES):
+        pod_name = f"mv-{i}"
+        api.add_pod(assigned_running_pod(pod_name, 2, chip_idx=0, node=NODE))
+        part = src.run(reqs, drain_at_tick=4)
+        pre = {r.rid: r.tokens for r in part.results}
+        snap = src.drain_snapshot()
+        assert snap is not None and snap["requests"], site
+
+        path = tmp_path / f"wal-{i}.ckpt"
+        restored = []
+        client, _source, ckpt, _assume, mover = mk_world(
+            api, path, drain=lambda key, s=snap: s,
+            restore=lambda k, s: restored.append(s),
+        )
+        plan = D.MovePlan(pod=("default", pod_name), src=0, dst=1, units=2)
+        if site is None:
+            assert mover.execute(plan) is True
+        else:
+            with FAULTS.injected(site, "crash", times=1):
+                with pytest.raises(SimulatedCrash):
+                    mover.execute(plan)
+            ckpt.abandon()
+            client2 = ApiServerClient(api.url)
+            ckpt2 = AllocationCheckpoint(str(path))
+            assume2 = AssumeCache()
+            replay_checkpoint(ckpt2, assume2)
+            rec = DriftReconciler(
+                api=client2,
+                pod_source=ApiServerPodSource(client2, NODE),
+                assume=assume2,
+                checkpoint=ckpt2,
+                node_name=NODE,
+                move_restore_fn=lambda k, s: restored.append(s),
+            )
+            rec.reconcile_once()
+            assert ckpt2.pending() == {}
+
+        if restored:
+            # rolled forward: the destination serves the JOURNALED copy
+            rest = dst.restore_snapshot(restored[-1])
+            # at-least-once: a daemon killed between the restore and its
+            # WAL commit re-delivers the same journaled snapshot after
+            # restart — the destination dedups on the mover-stamped id,
+            # so the drained requests can never serve twice
+            assert dst.restore_snapshot(restored[-1]).results == []
+        else:
+            # rolled back: the workload never left the source; its own
+            # supervisor re-serves the snapshot it drained
+            rest = dst.restore_snapshot(snap)
+        combined = dict(pre)
+        for r in rest.results:
+            combined[r.rid] = r.tokens
+        assert combined == ref_tokens, (
+            f"site {site}: tokens diverged or requests lost"
+        )

@@ -319,3 +319,112 @@ def test_cli_no_metrics_url_keeps_reference_layout(api, capsys, monkeypatch):
     monkeypatch.setattr(inspect_cli, "_client", lambda: ApiServerClient(api.url))
     assert inspect_cli.main(["-d"]) == 0
     assert "SERVING CACHE" not in capsys.readouterr().out
+
+
+# --- defrag status: stranded-HBM + MOVES (allocator/defrag.py) -------------
+
+
+def _defrag_node(name="node-a", **status):
+    """A shared node whose daemon published a defrag-status annotation."""
+    doc = {
+        "planned": 3, "active": 1, "completed": 2, "failed": 0,
+        "last_move_ms": 12.5, "quantum": 16, "stranded_units": 8,
+        "stranded_pct": 6.2,
+    }
+    doc.update(status)
+    node = shared_node(name)
+    node["metadata"]["annotations"] = {
+        const.ANN_DEFRAG_STATUS: json.dumps(doc)
+    }
+    return node
+
+
+def test_cli_summary_moves_column_and_stranded_markers(api, capsys, monkeypatch):
+    """A node with defrag status grows the MOVES column and marks each
+    chip whose free sliver is below the published quantum."""
+    api.nodes["node-a"] = _defrag_node()
+    # chip0: 24/32 used -> 8 free < quantum 16 -> stranded; chip1 free
+    api.add_pod(assigned_running_pod("r1", 24, chip_idx=0, node="node-a"))
+    monkeypatch.setattr(inspect_cli, "_client", lambda: ApiServerClient(api.url))
+
+    assert inspect_cli.main([]) == 0
+    out = capsys.readouterr().out
+    assert "MOVES (defrag)" in out
+    assert "3 planned · 1 active · 2 done · last 12.5ms" in out
+    assert "chip0: 24/32 (8 stranded)" in out
+    assert "chip1: 0/32," in out  # wholly-free chips are never stranded
+    assert "Stranded (sub-quantum sliver) TPU Memory (GiB): 8" in out
+
+
+def test_cli_details_stranded_and_moves_lines(api, capsys, monkeypatch):
+    api.nodes["node-a"] = _defrag_node()
+    api.add_pod(assigned_running_pod("r1", 24, chip_idx=0, node="node-a"))
+    api.add_pod(assigned_running_pod("r2", 30, chip_idx=2, node="node-a"))
+    monkeypatch.setattr(inspect_cli, "_client", lambda: ApiServerClient(api.url))
+
+    assert inspect_cli.main(["-d"]) == 0
+    out = capsys.readouterr().out
+    assert "Stranded  : 10 (GiB, sub-quantum slivers: chip0:8 chip2:2, quantum 16)" in out
+    assert "Moves     : 3 planned · 1 active · 2 done · last 12.5ms" in out
+
+
+def test_cli_json_defrag_doc(api, capsys, monkeypatch):
+    api.nodes["node-a"] = _defrag_node()
+    api.add_pod(assigned_running_pod("r1", 24, chip_idx=0, node="node-a"))
+    monkeypatch.setattr(inspect_cli, "_client", lambda: ApiServerClient(api.url))
+
+    assert inspect_cli.main(["-o", "json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    node = doc["nodes"][0]
+    assert node["defrag"]["planned"] == 3
+    assert node["defrag"]["completed"] == 2
+    assert node["defrag"]["last_move_ms"] == 12.5
+    assert node["defrag"]["quantum"] == 16
+    assert node["defrag"]["stranded_by_chip"] == {"0": 8}
+    chips = {c["index"]: c for c in node["chips"]}
+    assert chips[0]["stranded_units"] == 8
+    assert chips[1]["stranded_units"] == 0
+
+
+def test_cli_no_defrag_keeps_reference_layout(api, capsys, monkeypatch):
+    """Nodes without the annotation keep the reference layout: no MOVES
+    header, no stranded markers, no defrag JSON doc."""
+    api.nodes["node-a"] = shared_node("node-a")
+    api.add_pod(assigned_running_pod("r1", 24, chip_idx=0, node="node-a"))
+    monkeypatch.setattr(inspect_cli, "_client", lambda: ApiServerClient(api.url))
+
+    assert inspect_cli.main(["-d"]) == 0
+    out = capsys.readouterr().out
+    assert "MOVES" not in out and "Stranded" not in out and "stranded" not in out
+
+    assert inspect_cli.main(["-o", "json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert "defrag" not in doc["nodes"][0]
+    assert "stranded_units" not in doc["nodes"][0]["chips"][0]
+
+
+def test_cli_garbled_defrag_annotation_ignored(api, capsys, monkeypatch):
+    node = shared_node("node-a")
+    node["metadata"]["annotations"] = {const.ANN_DEFRAG_STATUS: "not-json"}
+    api.nodes["node-a"] = node
+    api.add_pod(assigned_running_pod("r1", 24, chip_idx=0, node="node-a"))
+    monkeypatch.setattr(inspect_cli, "_client", lambda: ApiServerClient(api.url))
+
+    assert inspect_cli.main([]) == 0
+    assert "MOVES" not in capsys.readouterr().out
+
+
+def test_cli_partially_garbled_defrag_annotation_degrades(api, capsys, monkeypatch):
+    """Valid JSON with garbled field values (null counter, stringly
+    duration) must render as zeros, not crash the CLI — the annotation is
+    operator-writable like any other."""
+    api.nodes["node-a"] = _defrag_node(
+        planned=None, active="x", last_move_ms="bogus",
+    )
+    api.add_pod(assigned_running_pod("r1", 24, chip_idx=0, node="node-a"))
+    monkeypatch.setattr(inspect_cli, "_client", lambda: ApiServerClient(api.url))
+
+    assert inspect_cli.main(["-d"]) == 0
+    out = capsys.readouterr().out
+    assert "MOVES (defrag)" in out
+    assert "0 planned · 0 active · 2 done" in out

@@ -431,3 +431,330 @@ def test_tp_paged_engine_tokens_identical(tp):
     }
     # the sharded run still hits the radix cache
     assert t.engine_cache["prefix_hit_requests"] > 0
+
+
+# ---------------------------------------------------------------------------
+# drain/restore: the defrag move protocol's engine hand-off
+# ---------------------------------------------------------------------------
+
+
+def _combined(part, rest):
+    out = {r.rid: r.tokens for r in part.results}
+    for r in rest.results:
+        out[r.rid] = r.tokens
+    return out
+
+
+def test_drain_restore_mid_prefill_request(setup):
+    """Drain while a multi-chunk prompt is mid-prefill: the snapshot row
+    carries no tokens (nothing was emitted), the pool is fully freed, and
+    the destination's fresh prefill is bit-identical."""
+    cfg, params = setup
+    reqs = [
+        Request(rid=0, prompt=tuple(range(1, 13)), max_new=6, arrival=0.0),
+        Request(rid=1, prompt=(7, 8), max_new=8, arrival=0.0),
+    ]
+    ref = {r.rid: r.tokens for r in _paged(params, cfg).run(reqs).results}
+    src = _paged(params, cfg)
+    part = src.run(reqs, drain_at_tick=1)  # one chunk of rid0's 12 tokens
+    snap = src.drain_snapshot()
+    assert part.results == []  # nothing retired yet
+    rows = {r["rid"]: r for r in snap["requests"]}
+    assert rows[0]["state"] == "slot" and rows[0]["tokens"] == []
+    # the drained pool holds nothing (no retirement -> no radix refs)
+    assert src.allocator.free_pages == src.total_pages
+    rest = _paged(params, cfg).restore_snapshot(snap)
+    assert _combined(part, rest) == ref
+
+
+def test_drain_restore_twice_keeps_generated_tokens(setup):
+    """A pod moved twice in quick succession: the second drain fires
+    before the restored run's first iteration boundary (request_drain
+    while idle), so every request is still 'queued' when captured — the
+    snapshot must carry the pre-drain generated tokens forward, or the
+    third engine re-prefills the prompt alone and regenerates from
+    scratch, breaking the bit-identity contract."""
+    cfg, params = setup
+    reqs = [
+        Request(rid=0, prompt=(1, 2, 3), max_new=8, arrival=0.0),
+        Request(rid=1, prompt=(7, 8), max_new=8, arrival=0.0),
+    ]
+    ref = {r.rid: r.tokens for r in _paged(params, cfg).run(reqs).results}
+    src = _paged(params, cfg)
+    part = src.run(reqs, drain_at_tick=3)  # mid-decode: tokens in flight
+    snap1 = src.drain_snapshot()
+    rows1 = {r["rid"]: r for r in snap1["requests"]}
+    assert rows1 and any(r["tokens"] for r in rows1.values())
+    mid = _paged(params, cfg)
+    mid.request_drain()  # the second move lands before this run starts
+    part2 = mid.restore_snapshot(snap1)
+    assert part2.results == []
+    snap2 = mid.drain_snapshot()
+    rows2 = {r["rid"]: r for r in snap2["requests"]}
+    assert rows2.keys() == rows1.keys()
+    for rid, row in rows1.items():
+        assert rows2[rid]["tokens"] == row["tokens"], "seed tokens lost"
+    rest = _paged(params, cfg).restore_snapshot(snap2)
+    out = {r.rid: r.tokens for r in part.results}
+    for r in rest.results:
+        out[r.rid] = r.tokens
+    assert out == ref
+
+
+def test_drain_restore_radix_prefix_evicted_between(setup):
+    """A drained request whose prompt was served from shared radix pages
+    restores bit-identically even when those pages no longer exist at the
+    destination (evicted between drain and restore — modeled as a
+    radix-less destination), and equally when the destination's cache is
+    already warm (prefixes re-resolve, hits included)."""
+    cfg, params = setup
+    reqs = shared_prefix_trace(
+        6, seed=3, rate=0.4, vocab=cfg.vocab, prefixes=(1, 8),
+        tail_lens=(1, 4), max_new=[4, 9],
+    )
+    ref = {r.rid: r.tokens for r in _paged(params, cfg).run(reqs).results}
+    src = _paged(params, cfg)
+    part = src.run(reqs, drain_at_tick=8)
+    snap = src.drain_snapshot()
+    assert snap["requests"], "nothing left in flight to drain"
+    # destination 1: the shared pages are gone -> full re-prefill
+    cold = _paged(params, cfg, radix=False).restore_snapshot(snap)
+    assert _combined(part, cold) == ref
+    # destination 2: warm cache -> prefix hits, same tokens
+    dst = _paged(params, cfg)
+    dst.run(reqs)  # warms the destination's radix with the prefix
+    warm = dst.restore_snapshot(snap)
+    assert _combined(part, warm) == ref
+    assert any(r.prefix_tokens > 0 for r in warm.results)
+
+
+def test_drain_restore_preempted_best_effort_request(setup):
+    """A best-effort request preempted pre-drain (re-queued with its
+    regenerated tokens) drains from the pending queue and restores
+    bit-identically — the preempted-then-drained compound case."""
+    cfg, params = setup
+    reqs = [
+        Request(rid=0, prompt=tuple(range(5, 21)), max_new=16, arrival=0.0,
+                tier=TIER_BEST_EFFORT),
+        Request(rid=1, prompt=tuple(range(20, 34)), max_new=16, arrival=4.0,
+                tier=TIER_CRITICAL),
+    ]
+    geo = dict(total_pages=8, radix=False)
+    ref = {r.rid: r.tokens for r in _paged(params, cfg, **geo).run(reqs).results}
+    src = _paged(params, cfg, **geo)
+    part = src.run(reqs, drain_at_tick=12)
+    assert src.preemptions >= 1, "the victim was never preempted pre-drain"
+    snap = src.drain_snapshot()
+    rows = {r["rid"]: r for r in snap["requests"]}
+    assert 0 in rows and rows[0]["tier"] == TIER_BEST_EFFORT
+    assert rows[0]["state"] == "pending", "victim should drain re-queued"
+    rest = _paged(params, cfg, **geo).restore_snapshot(snap)
+    assert _combined(part, rest) == ref
+
+
+def test_drain_restore_int8_kv(setup):
+    """Quantized KV across a move: int8 source snapshot restores on an
+    int8 destination bit-identically; a dtype-mismatched destination
+    refuses (the tokens would silently diverge)."""
+    cfg, params = setup
+    reqs = poisson_trace(
+        6, seed=5, rate=0.3, vocab=cfg.vocab, prompt_lens=(1, 9),
+        max_new=(2, 10),
+    )
+    geo = dict(slots=3, total_pages=30, kv_dtype="int8")
+    ref = {r.rid: r.tokens for r in _paged(params, cfg, **geo).run(reqs).results}
+    src = _paged(params, cfg, **geo)
+    part = src.run(reqs, drain_at_tick=5)
+    snap = src.drain_snapshot()
+    rest = _paged(params, cfg, **geo).restore_snapshot(snap)
+    assert _combined(part, rest) == ref
+    with pytest.raises(ValueError, match="diverge"):
+        _paged(params, cfg).restore_snapshot(snap)  # float dest, int8 snap
+
+
+def test_drain_restore_across_tp2_destination():
+    """A single-chip engine drains and the snapshot restores on a
+    TENSOR-PARALLEL destination (the move landed on a gang slice):
+    sharding is a layout property, tokens stay bit-identical."""
+    from gpushare_device_plugin_tpu.parallel.podenv import PodTpuEnv, gang_mesh
+
+    cfg = _cfg(n_kv_heads=4)
+    params = init_params(jax.random.key(1), cfg)
+    reqs = shared_prefix_trace(
+        8, seed=7, rate=0.3, vocab=cfg.vocab, prefixes=(1, 8),
+        tail_lens=(1, 6), max_new=[3, 4, 12],
+    )
+    kw = dict(slots=3, max_len=48, total_pages=40, page_size=8,
+              prefill_chunk=8, eos_id=EOS)
+    ref = {
+        r.rid: r.tokens
+        for r in PagedSlotEngine(params, cfg, **kw).run(reqs).results
+    }
+    src = PagedSlotEngine(params, cfg, **kw)
+    part = src.run(reqs, drain_at_tick=6)
+    snap = src.drain_snapshot()
+    assert snap["requests"]
+    env = PodTpuEnv.from_env({
+        "TPU_VISIBLE_CHIPS": "0,1",
+        "ALIYUN_COM_TPU_GANG_CHIPS": "0,1",
+        "ALIYUN_COM_TPU_GANG_SHAPE": "2x1x1",
+        "ALIYUN_COM_TPU_GANG_PER_CHIP": "1",
+        "ALIYUN_COM_TPU_MEM_CONTAINER": "2",
+        "ALIYUN_COM_TPU_MEM_DEV": "16",
+    })
+    mesh = gang_mesh(env, devices=jax.devices()[:2])
+    dst = PagedSlotEngine(params, cfg, mesh=mesh, **kw)
+    rest = dst.restore_snapshot(snap)
+    assert _combined(part, rest) == ref
+
+
+def test_restore_empty_snapshot_is_a_noop(setup):
+    cfg, params = setup
+    eng = _paged(params, cfg)
+    assert eng.restore_snapshot(None).results == []
+    assert eng.restore_snapshot({"requests": []}).results == []
+    # a completed (undrained) run leaves no snapshot behind
+    eng2 = _paged(params, cfg)
+    eng2.run([Request(rid=0, prompt=(1, 2), max_new=2, arrival=0.0)])
+    assert eng2.drain_snapshot() is None
+
+
+def test_restore_duplicate_delivery_deduped_by_snapshot_id(setup):
+    """The move protocol's restore delivery is at-least-once (a daemon
+    killed between the mover's restore and its WAL commit re-delivers the
+    journaled snapshot after restart): a ``snapshot_id`` this engine
+    already restored is a no-op, so the drained requests never serve
+    twice. The key is IDENTITY, not content — the same bytes without an
+    id (a source-side rollback re-serve) or under a different id (an
+    independent move of a deterministic workload) must both serve."""
+    cfg, params = setup
+    reqs = [
+        Request(rid=0, prompt=(1, 2, 3), max_new=8, arrival=0.0),
+        Request(rid=1, prompt=(7, 8), max_new=8, arrival=0.0),
+    ]
+    ref = {r.rid: r.tokens for r in _paged(params, cfg).run(reqs).results}
+    src = _paged(params, cfg)
+    part = src.run(reqs, drain_at_tick=3)
+    snap = src.drain_snapshot()
+    assert snap["requests"]
+    stamped = {**snap, "snapshot_id": "node-a/default.mv#7"}
+    dst = _paged(params, cfg)
+    first = dst.restore_snapshot(stamped)
+    assert _combined(part, first) == ref
+    # duplicate delivery of the SAME move attempt: logged no-op
+    assert dst.restore_snapshot(stamped).results == []
+    # identical content, no id: never deduplicated
+    replay = dst.restore_snapshot(snap)
+    assert _combined(part, replay) == ref
+    # identical content, different attempt id: an independent move
+    other = dst.restore_snapshot({**snap, "snapshot_id": "node-a/default.mv#9"})
+    assert _combined(part, other) == ref
+
+
+def test_wait_drained_cross_thread_handshake(setup):
+    """``request_drain`` only marks the next iteration boundary; a
+    cross-thread mover must ``wait_drained()`` for the serving thread to
+    actually quiesce before collecting the snapshot. Natural completion
+    quiesces too (returns None — everything retired, nothing to move),
+    so a waiter racing the run's end never hangs."""
+    import threading
+
+    cfg, params = setup
+    reqs = [
+        Request(rid=0, prompt=(1, 2, 3), max_new=8, arrival=0.0),
+        Request(rid=1, prompt=(7, 8), max_new=8, arrival=0.0),
+    ]
+    ref = {r.rid: r.tokens for r in _paged(params, cfg).run(reqs).results}
+    src = _paged(params, cfg)
+    out = {}
+    t = threading.Thread(
+        target=lambda: out.setdefault("part", src.run(reqs, drain_at_tick=3))
+    )
+    t.start()
+    snap = src.wait_drained(timeout=60.0)
+    t.join()
+    assert snap is not None and snap["requests"]
+    rest = _paged(params, cfg).restore_snapshot(snap)
+    assert _combined(out["part"], rest) == ref
+    # no drain requested: the run completes and the waiter gets None
+    eng = _paged(params, cfg)
+    t2 = threading.Thread(target=lambda: eng.run(reqs))
+    t2.start()
+    assert eng.wait_drained(timeout=60.0) is None
+    t2.join()
+
+
+def test_drain_between_runs_captures_next_run_not_stale(setup):
+    """A natural run completion leaves the quiesce event set; a drain
+    requested while the engine is idle must arm for the NEXT run's
+    capture, not return the stale everything-retired answer — otherwise
+    that next run drains its whole queue into a snapshot nobody ever
+    collects (lost requests)."""
+    import threading
+
+    cfg, params = setup
+    reqs = [
+        Request(rid=0, prompt=(1, 2, 3), max_new=6, arrival=0.0),
+        Request(rid=1, prompt=(7, 8), max_new=6, arrival=0.0),
+    ]
+    ref = {r.rid: r.tokens for r in _paged(params, cfg).run(reqs).results}
+    eng = _paged(params, cfg)
+    eng.run(reqs)  # completes naturally: quiesce state left behind
+    eng.request_drain()  # between runs — armed for the next one
+    out = {}
+    t = threading.Thread(target=lambda: out.setdefault("p", eng.run(reqs)))
+    t.start()
+    snap = eng.wait_drained(timeout=60.0)
+    t.join()
+    assert snap is not None and snap["requests"], "next run's capture lost"
+    assert out["p"].results == []  # whole queue drained, nothing retired
+    rest = _paged(params, cfg).restore_snapshot(snap)
+    assert {r.rid: r.tokens for r in rest.results} == ref
+
+
+def test_uncollected_capture_survives_back_to_back_run(setup):
+    """A drained run's snapshot must survive the supervisor starting the
+    next run before the (late-scheduled) mover thread reads it: runs
+    never discard a capture — only request_drain's re-arm does. The
+    back-to-back run itself serves normally (capture disarmed the
+    drain), and the late collection still restores bit-identically."""
+    cfg, params = setup
+    reqs = [
+        Request(rid=0, prompt=(1, 2, 3), max_new=8, arrival=0.0),
+        Request(rid=1, prompt=(7, 8), max_new=8, arrival=0.0),
+    ]
+    ref = {r.rid: r.tokens for r in _paged(params, cfg).run(reqs).results}
+    src = _paged(params, cfg)
+    part = src.run(reqs, drain_at_tick=3)
+    # the supervisor loops straight into the next run, mover not yet
+    # scheduled — this run must not wipe the pending capture
+    other = [Request(rid=9, prompt=(4, 5), max_new=4, arrival=0.0)]
+    stats2 = src.run(other)
+    assert [r.rid for r in stats2.results] == [9], "drain leaked into run 2"
+    snap = src.drain_snapshot()  # the late mover finally collects
+    assert snap is not None and snap["requests"], "capture was destroyed"
+    rest = _paged(params, cfg).restore_snapshot(snap)
+    assert _combined(part, rest) == ref
+
+
+def test_wait_drained_timeout_disarms_the_dead_drain(setup):
+    """A timed-out wait raises (a wedged engine must be distinguishable
+    from a clean empty drain — a mover reading None would flip the pod's
+    accounting while the source still serves) AND disarms the drain: the
+    move is dead, so the next unrelated run must serve normally instead
+    of quiescing its whole queue into a snapshot nobody collects."""
+    cfg, params = setup
+    reqs = [
+        Request(rid=0, prompt=(1, 2, 3), max_new=6, arrival=0.0),
+        Request(rid=1, prompt=(7, 8), max_new=6, arrival=0.0),
+    ]
+    ref = {r.rid: r.tokens for r in _paged(params, cfg).run(reqs).results}
+    eng = _paged(params, cfg)
+    eng.request_drain()
+    with pytest.raises(TimeoutError):
+        eng.wait_drained(timeout=0.2)  # no run ever reached a boundary
+    stats = eng.run(reqs)
+    assert {r.rid: r.tokens for r in stats.results} == ref, (
+        "abandoned drain swallowed the next run"
+    )
+    assert eng.drain_snapshot() is None

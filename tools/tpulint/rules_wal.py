@@ -23,7 +23,11 @@ The invariant (docs/analysis.md, "WAL begin/commit protocol"): a
 
 Recognized begin/resolve forms: calls through a checkpoint-hinted
 receiver (``self._ckpt.begin(...)``, ``ckpt.abort(...)``) and the
-allocator's module helpers ``_journal_begin`` / ``_journal_resolve``.
+thin module delegation helpers — ``_journal_begin``/``_journal_resolve``
+on the admission path and ``_journal_phase``/``_journal_resolve`` on the
+defragmentation move path (record kind ``"move"``: each protocol phase
+journals a fresh begin for the move key, so every ``_journal_phase``
+call site carries the same domination obligation a plain ``begin`` does).
 """
 
 from __future__ import annotations
@@ -33,7 +37,7 @@ import ast
 from .engine import Finding, Module
 
 CKPT_RECEIVERS = ("_ckpt", "ckpt", "checkpoint", "_checkpoint")
-BEGIN_HELPERS = ("_journal_begin",)
+BEGIN_HELPERS = ("_journal_begin", "_journal_phase")
 RESOLVE_HELPERS = ("_journal_resolve",)
 RESOLVE_METHODS = ("commit", "abort")
 PERSIST_CALLS = (
