@@ -1,7 +1,7 @@
 PY ?= python
 PROTOC ?= protoc
 
-.PHONY: proto native test test-fast test-slow test-stress chaos chaos-restart chaos-move lint lint-strict typecheck bench bench-smoke bench-serve-smoke bench-multichip-smoke bench-paged-smoke bench-defrag-smoke bench-wal bench-trace trace-smoke e2e-kind
+.PHONY: proto native test test-fast test-slow test-stress chaos chaos-restart chaos-move lint lint-strict typecheck bench bench-smoke bench-serve-smoke bench-multichip-smoke bench-paged-smoke bench-defrag-smoke bench-interference-smoke bench-wal bench-trace trace-smoke e2e-kind
 
 # Regenerate protobuf message classes (gRPC bindings are hand-written in
 # gpushare_device_plugin_tpu/plugin/api/api_grpc.py; grpc_tools is not
@@ -143,6 +143,16 @@ bench-paged-smoke:
 # docs/robustness.md.
 bench-defrag-smoke:
 	$(PY) bench.py --defrag-smoke
+
+# Interference smoke (CPU, ~30s): ONLY the serve_interference section —
+# critical-tier decode-step p99 with a best-effort co-tenant sharing the
+# backend, governor OFF vs ON. Hard gates: OFF shows >=25% p99 inflation
+# (else the scenario is vacuous), the SLO budget burns to page severity,
+# governor ON lands within 15% of solo, profiler overhead <=5%, zero
+# retraces, bit-identical tokens. Tier-1 runs it via
+# tests/test_bench_interference_smoke.py. See docs/observability.md.
+bench-interference-smoke:
+	$(PY) bench_mfu.py --interference-smoke
 
 # Group-commit WAL A/B: the 16-way admission storm with the journal in
 # per-record-fsync ('always') then group-commit ('batch') mode. Reports

@@ -1101,6 +1101,21 @@ def prefix_hit_guard(ratio: float | None, repo: Path) -> str | None:
     )
 
 
+def interference_guard(pct: float | None, repo: Path) -> str | None:
+    """Failure message when the interference bench's governor-OFF p99
+    inflation (``interference_p99_inflation_pct``) DROPPED >25% vs the
+    newest committed record carrying it; None when within budget or no
+    history. Lower is worse here: the OFF episode is the scenario's
+    signal source — a co-tenant that no longer measurably interferes
+    means the whole governor acceptance run went vacuous (the >=25%
+    absolute floor already hard-gated inside bench_mfu)."""
+    return _pct_trend_guard(
+        pct, repo, field="interference_p99_inflation_pct",
+        label="interference OFF-phase p99 inflation", fmt=".1f", unit="%",
+        lower_is_worse=True,
+    )
+
+
 def defrag_stranded_guard(pct: float | None, repo: Path) -> str | None:
     """Failure message when the post-defrag stranded-HBM% on the churn
     trace grew >P99_GUARD_PCT over the newest committed record carrying
@@ -1539,6 +1554,16 @@ def main(argv=None) -> int:
         .get("paged", {}).get("goodput_tokens_per_s"),
         "serve_prefix_hit_ratio": compute.get("serve_paged", {})
         .get("prefix_hit_ratio"),
+        # Interference bench numbers (serve_interference section),
+        # hoisted for the trend guard: the governor-OFF inflation is the
+        # scenario's signal strength (the governed/overhead bounds hard-
+        # gate inside bench_mfu itself).
+        "interference_p99_inflation_pct": compute.get(
+            "serve_interference", {}
+        ).get("interference_p99_inflation_pct"),
+        "interference_governed_pct": compute.get(
+            "serve_interference", {}
+        ).get("governed_p99_inflation_pct"),
         # Gang-admission storm numbers, hoisted like the WAL fields; the
         # zero-partial/zero-double invariants already hard-failed above.
         "gang_throughput_gangs_s": gang.get("throughput_gangs_s"),
@@ -1572,6 +1597,9 @@ def main(argv=None) -> int:
             record["serve_paged_goodput_tokens_per_s"], repo
         ))
         msgs.append(prefix_hit_guard(record["serve_prefix_hit_ratio"], repo))
+        msgs.append(interference_guard(
+            record["interference_p99_inflation_pct"], repo
+        ))
         msgs.append(gang_storm_guard(record["gang_throughput_gangs_s"], repo))
         msgs.append(defrag_stranded_guard(record["defrag_stranded_after_pct"], repo))
         msgs.append(defrag_binpack_guard(record["defrag_binpack_after_pct"], repo))

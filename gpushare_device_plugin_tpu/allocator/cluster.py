@@ -288,6 +288,8 @@ class ClusterAllocator:
             with _serial_guard(self._pods, self._assume):
                 placement, pod = self._admit(pod_units)
             asp.set_attribute("pod", f"{P.namespace(pod)}/{P.name(pod)}")
+            workload_class = P.workload_class(pod)
+            asp.set_attribute("workload_class", workload_class)
             with TRACER.span("allocator.env", child_only=True):
                 if isinstance(placement, GangPlacement):
                     asp.set_attribute("chips", list(placement.chips))
@@ -310,6 +312,7 @@ class ClusterAllocator:
                             pod_units=pod_units,
                             container_units=n,
                             disable_isolation=self._disable_isolation,
+                            workload_class=workload_class,
                         )
                         for n in container_units
                     ]
@@ -328,6 +331,7 @@ class ClusterAllocator:
                         pod_units=pod_units,
                         container_units=n,
                         disable_isolation=self._disable_isolation,
+                        workload_class=workload_class,
                     )
                     for n in container_units
                 ]
@@ -516,6 +520,11 @@ class ClusterAllocator:
                 }
             self._assume.reserve_mem(_pod_key(pod), idx, pod_units)
         annotations[const.ENV_ASSUME_TIME] = str(time.time_ns())
+        # Persist the NORMALIZED workload class with the decision: every
+        # downstream reader (informer indexes, interference detector,
+        # inspect CLI) then sees one canonical value even when the pod
+        # declared nothing or garbage.
+        annotations[const.ANN_WORKLOAD_CLASS] = P.workload_class(pod)
         return idx, annotations
 
     def _place_gang(self, pod, pod_units: int) -> tuple[GangPlacement, dict[str, str]]:
@@ -593,6 +602,7 @@ class ClusterAllocator:
                 _pod_key(pod), [(i, per_chip) for i in placement.chips]
             )
         annotations[const.ENV_ASSUME_TIME] = str(time.time_ns())
+        annotations[const.ANN_WORKLOAD_CLASS] = P.workload_class(pod)
         return placement, annotations
 
     def _assumed_gang(

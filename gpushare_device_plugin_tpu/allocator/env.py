@@ -53,8 +53,14 @@ def build_mem_allocation(
     pod_units: int,
     container_units: int,
     disable_isolation: bool = False,
+    workload_class: str = "",
 ) -> ContainerAllocation:
-    """Payload for a fractional-HBM container pinned to one chip."""
+    """Payload for a fractional-HBM container pinned to one chip.
+
+    ``workload_class`` (the pod's normalized QoS class) is mirrored into
+    the container env so the workload inside — the serving engine's
+    governor, a training loop deciding to self-pace — knows which side
+    of the interference plane it is on."""
     envs = {
         const.ENV_TPU_VISIBLE_CHIPS: str(chip.index),
         # one process, one chip: the standard TPU-VM carve-out
@@ -65,6 +71,8 @@ def build_mem_allocation(
         const.ENV_MEM_CONTAINER: str(container_units),
         const.ENV_MEM_DEV: str(chip_total_units),
     }
+    if workload_class:
+        envs[const.ENV_WORKLOAD_CLASS] = workload_class
     if disable_isolation:
         envs["CTPU_DISABLE"] = "true"
     elif chip_total_units > 0:
@@ -113,6 +121,7 @@ def build_gang_allocation(
     pod_units: int,
     container_units: int,
     disable_isolation: bool = False,
+    workload_class: str = "",
 ) -> ContainerAllocation:
     """Payload for a topology-aware multi-chip gang container: every
     member chip visible, the granted slice shape as the single-process
@@ -122,6 +131,8 @@ def build_gang_allocation(
     ``container_units`` is this container's share of the pod's TOTAL
     (cross-chip) request; its per-chip fraction scales accordingly so a
     two-container gang pod cannot double-claim a chip's slice.
+    ``workload_class`` mirrors the pod's QoS class into the env (see
+    :func:`build_mem_allocation`).
     """
     from ..topology import format_shape, pad3
 
@@ -139,6 +150,8 @@ def build_gang_allocation(
         const.ENV_MEM_CONTAINER: str(container_units),
         const.ENV_MEM_DEV: str(chip_total_units),
     }
+    if workload_class:
+        envs[const.ENV_WORKLOAD_CLASS] = workload_class
     if disable_isolation:
         envs["CTPU_DISABLE"] = "true"
     elif chip_total_units > 0 and chips:

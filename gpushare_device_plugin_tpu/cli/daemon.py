@@ -87,11 +87,41 @@ def build_parser() -> argparse.ArgumentParser:
                    "Run the extender at the SAME ratio — each process "
                    "samples its own half, so mismatched ratios produce "
                    "partial traces")
+    p.add_argument("--trace-sample-critical", type=float, default=None,
+                   help="per-tier override of --trace-sample for "
+                   "critical-tier serving traces (serve.request roots); "
+                   "default inherits --trace-sample")
+    p.add_argument("--trace-sample-besteffort", type=float, default=None,
+                   help="per-tier override of --trace-sample for "
+                   "best-effort serving traces, so best-effort churn can "
+                   "be down-sampled without losing critical-tier traces; "
+                   "default inherits --trace-sample")
     p.add_argument("--flightrecord-dir", default="",
                    help="crash/postmortem flight-recorder directory "
                    "(last N admission traces + recent log ring, dumped "
                    "on SIGUSR1, fatal exit, and injected crash sites); "
                    "default is the coredump dir, 'none' disables")
+    p.add_argument("--flightrecord-keep", type=int, default=16,
+                   help="keep only the newest K flight-record dump files "
+                   "in --flightrecord-dir (repeated SIGUSR1/crash dumps "
+                   "rotate instead of growing unbounded; 0 = unbounded)")
+    p.add_argument("--interference-interval", type=float, default=0.0,
+                   help="seconds between interference-detector passes "
+                   "(cluster/interference.py: per-chip co-residency vs "
+                   "decode-step p99 inflation, published as the "
+                   "tpushare_interference_ratio gauge + the node "
+                   "interference annotation); 0 disables")
+    p.add_argument("--interference-threshold", type=float, default=1.25,
+                   help="step-p99 inflation ratio (current / solo "
+                   "baseline) at which a co-residency verdict is flagged")
+    p.add_argument("--interference-scrape-url", action="append", default=[],
+                   metavar="URL",
+                   help="a serving pod /metrics endpoint to scrape for "
+                   "its engine's step-p99 gauge (repeatable). Without "
+                   "any, the detector reads the daemon's own in-process "
+                   "registry, which only sees engines co-located in "
+                   "this process — per-pod engines need their "
+                   "endpoints listed here")
     # degraded-mode knobs (docs/robustness.md)
     p.add_argument("--breaker-threshold", type=int, default=5,
                    help="consecutive apiserver failures before the circuit "
@@ -165,7 +195,15 @@ def main(argv=None) -> int:
 
     from ..utils.tracing import TRACER
 
-    TRACER.configure(sample_ratio=args.trace_sample)
+    tier_ratios = {}
+    if args.trace_sample_critical is not None:
+        tier_ratios[const.SLO_TIER_CRITICAL] = args.trace_sample_critical
+    if args.trace_sample_besteffort is not None:
+        tier_ratios[const.SLO_TIER_BEST_EFFORT] = args.trace_sample_besteffort
+    TRACER.configure(
+        sample_ratio=args.trace_sample,
+        tier_ratios=tier_ratios or None,
+    )
     flightrecord_dir = args.flightrecord_dir
     if flightrecord_dir == "none":
         flightrecord_dir = ""
@@ -199,9 +237,13 @@ def main(argv=None) -> int:
         reconcile_interval_s=args.reconcile_interval,
         drain_timeout_s=args.drain_timeout,
         flightrecord_dir=flightrecord_dir,
+        flightrecord_keep=args.flightrecord_keep,
         defrag_interval_s=args.defrag_interval,
         defrag_quantum=args.defrag_quantum,
         defrag_max_moves=args.defrag_max_moves,
+        interference_interval_s=args.interference_interval,
+        interference_threshold=args.interference_threshold,
+        interference_scrape_urls=tuple(args.interference_scrape_url),
     )
 
     api_client = None

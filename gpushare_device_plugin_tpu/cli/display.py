@@ -9,6 +9,7 @@ from __future__ import annotations
 
 from io import StringIO
 
+from .. import const
 from .nodeinfo import PENDING_IDX, NodeInfo, infer_unit
 
 
@@ -154,6 +155,137 @@ def engine_row_for(pod, engine: dict[str, dict[str, float]] | None):
     return engine.get(f"{pod.namespace}/{pod.name}") or engine.get(pod.name)
 
 
+def _interference_lines(doc: dict, indent: str = "") -> str:
+    """Per-chip interference verdicts (the parsed
+    ``tpushare.aliyun.com/interference`` annotation), one line per chip:
+    victim, ratio vs its solo baseline, aggressors, FLAGGED marker."""
+    def _chip_key(kv):
+        # numeric order like every other per-chip listing (chip10 must
+        # not sort before chip2); non-numeric keys sort after, by name
+        try:
+            return (0, int(kv[0]), "")
+        except (TypeError, ValueError):
+            return (1, 0, str(kv[0]))
+
+    out = []
+    for chip, row in sorted((doc.get("chips") or {}).items(), key=_chip_key):
+        aggs = ", ".join(row.get("aggressors") or []) or "?"
+        flag = "  FLAGGED" if row.get("flagged") else ""
+        out.append(
+            f"{indent}chip{chip}: {row.get('victim', '?')} "
+            f"{row.get('ratio', 0.0):.2f}x vs solo "
+            f"(aggressors: {aggs}){flag}\n"
+        )
+    return "".join(out)
+
+
+def _fmt_step(row: dict[str, float] | None) -> str:
+    """A pod's rolling step p50/p99 cell from its scraped
+    ``tpushare_engine_step_p{50,99}_seconds`` gauges; "-" when the pod
+    exports no step profile."""
+    if not row:
+        return "-"
+    p50 = row.get("step_p50_seconds")
+    p99 = row.get("step_p99_seconds")
+    if p50 is None and p99 is None:
+        return "-"
+
+    def ms(v):
+        return f"{v * 1e3:.1f}ms" if v is not None else "?"
+
+    return f"{ms(p50)}/{ms(p99)}"
+
+
+def render_top(
+    infos: list[NodeInfo],
+    obs: dict | None = None,
+    *,
+    now_label: str = "",
+) -> str:
+    """One refresh of the ``kubectl-inspect-tpushare top`` live view:
+    per-chip co-residency (with workload classes), each resident's
+    rolling step p50/p99, the chip's interference verdict, and the
+    scraped SLO burn-rate + governor state. Deterministic for a given
+    input set (golden-tested like ``render_trace``).
+
+    ``obs`` is ``inspect.fetch_observability_metrics`` output:
+    ``{"engine": {pod: {...}}, "slo": {tier: {...}}, "governor":
+    {pod: {...}}}`` — any part may be missing (partial scrape)."""
+    engine = (obs or {}).get("engine") or {}
+    slo = (obs or {}).get("slo") or {}
+    governor = (obs or {}).get("governor") or {}
+    buf = StringIO()
+    title = "tpushare top"
+    if now_label:
+        title += f" — {now_label}"
+    buf.write(title + "\n")
+    rows = [["NODE", "CHIP", "RESIDENTS (class)", "STEP p50/p99",
+             "INTERFERENCE"]]
+    for info in infos:
+        held = set(info.core_held_chips)
+        idoc = (info.interference or {}).get("chips") or {}
+        for d in sorted(info.devices.values(), key=lambda d: d.index):
+            residents = [
+                p for p in info.pods if d.index in p.units_by_chip
+            ]
+            if d.index in held:
+                res_cell = "exclusive (tpu-core)"
+            elif residents:
+                res_cell = " ".join(
+                    f"{p.namespace}/{p.name}"
+                    + ("(BE)" if p.workload_class
+                       == const.WORKLOAD_BEST_EFFORT else "(LC)")
+                    for p in sorted(
+                        residents, key=lambda p: (p.namespace, p.name)
+                    )
+                )
+            else:
+                res_cell = "-"
+            step_cells = []
+            for p in sorted(residents, key=lambda p: (p.namespace, p.name)):
+                cell = _fmt_step(engine_row_for(p, engine))
+                if cell != "-":
+                    step_cells.append(cell)
+            irow = idoc.get(str(d.index))
+            if irow:
+                icell = (
+                    f"{irow.get('ratio', 0.0):.2f}x {irow.get('victim', '?')}"
+                    + (" FLAGGED" if irow.get("flagged") else "")
+                )
+            else:
+                icell = "-"
+            rows.append([
+                info.name, f"chip{d.index}", res_cell,
+                " ".join(step_cells) or "-", icell,
+            ])
+    buf.write(_table(rows))
+    buf.write("\n")
+    if slo:
+        buf.write("SLO BURN\n")
+        sev_names = {0.0: "ok", 1.0: "warn", 2.0: "page"}
+        for tier, row in sorted(slo.items()):
+            sev = sev_names.get(row.get("severity", 0.0), "?")
+            line = (
+                f"  {tier:<12} 5m={row.get('burn_5m', 0.0):.2f} "
+                f"1h={row.get('burn_1h', 0.0):.2f} "
+                f"6h={row.get('burn_6h', 0.0):.2f}"
+            )
+            remaining = row.get("error_budget_remaining")
+            if remaining is not None:
+                line += f" budget={remaining * 100:.1f}%"
+            buf.write(f"{line} [{sev}]\n")
+    if governor:
+        buf.write("GOVERNOR\n")
+        for pod, row in sorted(governor.items()):
+            engaged = "ENGAGED" if row.get("engaged") else "released"
+            buf.write(
+                f"  {pod or '(unlabeled)':<20} {engaged} "
+                f"engagements={int(row.get('engagements_total', 0))} "
+                f"throttled={int(row.get('throttled_steps_total', 0))}\n"
+            )
+    return buf.getvalue()
+
+
 def render_trace(spans: list[dict]) -> str:
     """Render one admission/serving trace as an offset/duration tree.
 
@@ -271,7 +403,16 @@ def render_details(
         any_engine = engine is not None and any(
             engine_row_for(p, engine) for p in info.pods
         )
+        # the CLASS column appears only when a non-default class is
+        # present, preserving the reference layout for fleets that never
+        # declare workload classes
+        any_class = any(
+            p.workload_class != const.WORKLOAD_LATENCY_CRITICAL
+            for p in info.pods
+        )
         header = ["NAMESPACE", "NAME", f"TPU MEMORY ({unit})", "CHIPS"]
+        if any_class:
+            header.append("CLASS")
         if any_gang:
             header.append("GANG (shape @ coords)")
         if any_engine:
@@ -283,6 +424,8 @@ def render_details(
                 for idx, units in sorted(pod.units_by_chip.items())
             )
             row = [pod.namespace, pod.name, str(pod.total_units), chips]
+            if any_class:
+                row.append(pod.workload_class)
             if any_gang:
                 row.append(_gang_cell(pod, info, unit) if pod.is_gang else "-")
             if any_engine:
@@ -317,6 +460,11 @@ def render_details(
                 f"quantum {int(info.defrag.get('quantum') or 0)})\n"
             )
             buf.write(f"Moves     : {_moves_cell(info.defrag)}\n")
+        if info.interference and info.interference.get("chips"):
+            buf.write(
+                "Interference:\n"
+                + _interference_lines(info.interference, indent="  ")
+            )
         buf.write("\n")
     buf.write(render_summary(infos))
     return buf.getvalue()

@@ -93,16 +93,95 @@ def parse_engine_metrics(text: str) -> dict[str, dict[str, float]]:
         except ValueError:
             continue
         pod = ""
+        name = metric
         if "{" in metric:
-            name, labels = metric.split("{", 1)
-            labels = labels.rstrip("}")
-            for part in labels.split(","):
-                if part.startswith("pod="):
-                    pod = part[4:].strip('"').replace('\\"', '"')
-        else:
-            name = metric
+            name, raw = metric.split("{", 1)
+            pod = _parse_prom_labels(raw.rstrip("}")).get("pod", "")
         short = name[len("tpushare_engine_"):]
         out.setdefault(pod, {})[short] = val
+    return out
+
+
+def _parse_prom_labels(raw: str) -> dict[str, str]:
+    """Minimal label-block parse ('k="v",k2="v2"'); same tolerance as
+    ``parse_engine_metrics`` (label values containing commas are beyond
+    this CLI's needs)."""
+    out: dict[str, str] = {}
+    for part in raw.split(","):
+        if "=" not in part:
+            continue
+        k, v = part.split("=", 1)
+        out[k.strip()] = v.strip().strip('"').replace('\\"', '"')
+    return out
+
+
+def parse_observability_metrics(text: str) -> dict:
+    """Pull the interference plane's families out of a ``/metrics``
+    exposition for the ``top`` view:
+
+    - ``engine``: :func:`parse_engine_metrics` rows (now including the
+      ``step_p50_seconds``/``step_p99_seconds`` profiler gauges), keyed
+      by ``pod`` label;
+    - ``slo``: per-tier burn rates / budget remaining / severity from
+      the ``tpushare_slo_*`` gauges;
+    - ``governor``: per-pod engage state + counters from the
+      ``tpushare_governor_*`` families.
+    """
+    out: dict = {"engine": parse_engine_metrics(text), "slo": {}, "governor": {}}
+    for line in text.splitlines():
+        if line.startswith("#"):
+            continue
+        if not line.startswith(("tpushare_slo_", "tpushare_governor_")):
+            continue
+        try:
+            metric, value = line.rsplit(None, 1)
+            val = float(value)
+        except ValueError:
+            continue
+        labels: dict[str, str] = {}
+        name = metric
+        if "{" in metric:
+            name, raw = metric.split("{", 1)
+            labels = _parse_prom_labels(raw.rstrip("}"))
+        if name.startswith("tpushare_slo_"):
+            tier = labels.get("tier", "")
+            if not tier:
+                continue
+            row = out["slo"].setdefault(tier, {})
+            short = name[len("tpushare_slo_"):]
+            if short == "burn_rate":
+                row[f"burn_{labels.get('window', '?')}"] = val
+            else:
+                row[short] = val
+        else:
+            pod = labels.get("pod", "")
+            row = out["governor"].setdefault(pod, {})
+            row[name[len("tpushare_governor_"):]] = val
+    return out
+
+
+def fetch_observability_metrics(urls: list[str]) -> dict:
+    """Scrape + merge the ``top`` view's telemetry from every
+    ``/metrics`` endpoint given (same partial-scrape policy as
+    :func:`fetch_engine_metrics`)."""
+    import requests
+
+    out: dict = {"engine": {}, "slo": {}, "governor": {}}
+    for url in urls:
+        full = url.rstrip("/")
+        if not full.endswith("/metrics"):
+            full += "/metrics"
+        try:
+            resp = requests.get(full, timeout=10)
+            resp.raise_for_status()
+            text = resp.text
+        except Exception as e:  # noqa: BLE001 — partial scrape by design
+            print(f"warning: {full} unreachable: {e}", file=sys.stderr)
+            continue
+        parsed = parse_observability_metrics(text)
+        for section in ("engine", "slo", "governor"):
+            for key, row in parsed[section].items():
+                out[section].setdefault(key, {}).update(row)
     return out
 
 
@@ -183,6 +262,64 @@ def trace_main(argv: list[str]) -> int:
     return 0
 
 
+def top_main(argv: list[str]) -> int:
+    """``kubectl-inspect-tpushare top``: periodically refreshed live view
+    of per-chip co-residency, step p50/p99, interference verdicts, and
+    SLO burn-rate / governor state (docs/observability.md)."""
+    import time as _time
+
+    from .display import render_top
+
+    p = argparse.ArgumentParser(
+        prog="kubectl-inspect-tpushare top",
+        description="Live per-chip co-residency / interference view",
+    )
+    p.add_argument("node", nargs="?", default="", help="restrict to one node")
+    p.add_argument("--metrics-url", action="append", default=[],
+                   metavar="URL",
+                   help="a /metrics endpoint to scrape for step-profile, "
+                   "SLO burn-rate, and governor telemetry (repeatable)")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="seconds between refreshes")
+    p.add_argument("--iterations", type=int, default=0,
+                   help="number of refreshes then exit (0 = until ^C)")
+    args = p.parse_args(argv)
+    try:
+        client = _client()
+    except Exception as e:  # config errors
+        print(f"error: cannot reach the cluster: {e}", file=sys.stderr)
+        return 1
+    i = 0
+    try:
+        while True:
+            i += 1
+            try:
+                nodes, pods = gather(client, args.node)
+            except SystemExit:
+                raise
+            except Exception as e:  # config errors / exhausted retries
+                print(f"error: cannot reach the cluster: {e}", file=sys.stderr)
+                return 1
+            infos = build_all_node_infos(nodes, pods)
+            obs = (
+                fetch_observability_metrics(args.metrics_url)
+                if args.metrics_url else None
+            )
+            out = render_top(
+                infos, obs,
+                now_label=_time.strftime("%H:%M:%S"),
+            )
+            if sys.stdout.isatty():
+                sys.stdout.write("\x1b[2J\x1b[H")
+            sys.stdout.write(out)
+            sys.stdout.flush()
+            if args.iterations and i >= args.iterations:
+                return 0
+            _time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
 def flightrecord_main(argv: list[str]) -> int:
     p = argparse.ArgumentParser(
         prog="kubectl-inspect-tpushare flightrecord",
@@ -221,6 +358,8 @@ def main(argv=None) -> int:
         return trace_main(argv[1:])
     if argv and argv[0] == "flightrecord":
         return flightrecord_main(argv[1:])
+    if argv and argv[0] == "top":
+        return top_main(argv[1:])
     p = argparse.ArgumentParser(
         prog="kubectl-inspect-tpushare",
         description="Display TPU-share HBM utilization across the cluster",
@@ -302,6 +441,13 @@ def render_json(
                 if n.defrag is not None
                 else {}
             ),
+            # interference verdicts (when the node's daemon runs the
+            # detector): the parsed node annotation, per chip
+            **(
+                {"interference": n.interference}
+                if n.interference is not None
+                else {}
+            ),
             "chips": [
                 {
                     "index": d.index,
@@ -321,6 +467,7 @@ def render_json(
                     "namespace": p.namespace,
                     "name": p.name,
                     "units_by_chip": {str(k): v for k, v in p.units_by_chip.items()},
+                    "workload_class": p.workload_class,
                     **(
                         {
                             "gang_shape": p.gang_shape,

@@ -31,6 +31,10 @@ class PodUsage:
     # chips' grid coordinates instead of a single device column
     gang_shape: str = ""
     gang_per_chip: int = 0
+    # normalized QoS class (tpushare.aliyun.com/workload-class): the
+    # interference plane's victim/aggressor split, rendered as a CLASS
+    # column when any pod on the node is best-effort
+    workload_class: str = const.WORKLOAD_LATENCY_CRITICAL
 
     @property
     def total_units(self) -> int:
@@ -79,6 +83,10 @@ class NodeInfo:
     # per-chip stranded-HBM units, recomputed from this report's own
     # usage attribution at the annotation's quantum
     stranded_by_chip: dict[int, int] = dataclasses.field(default_factory=dict)
+    # the interference detector's node annotation (cluster/interference.py
+    # interference_from_node): per-chip victim/aggressor/ratio verdicts;
+    # None when the node runs no detector (rendering stays hidden)
+    interference: dict | None = None
 
     @property
     def total_units(self) -> int:
@@ -170,6 +178,7 @@ def build_node_info(
                 units_by_chip=usage,
                 gang_shape=P.annotations(pod).get(const.ENV_GANG_SHAPE, ""),
                 gang_per_chip=P.gang_per_chip_units(pod),
+                workload_class=P.workload_class(pod),
             )
         )
         for idx, units in usage.items():
@@ -205,6 +214,11 @@ def build_node_info(
             {i: d.used_units for i, d in info.devices.items()},
             int(info.defrag.get("quantum") or 0),
         )
+    # Interference verdicts (when the node's daemon runs the detector):
+    # per-chip victim/aggressor/ratio straight from the annotation.
+    from ..cluster.interference import interference_from_node
+
+    info.interference = interference_from_node(node)
     return info
 
 

@@ -126,3 +126,18 @@ class LabeledPodIndex(_BucketedPodIndex):
         if value is None:
             return ()
         return (value,)
+
+
+class WorkloadClassIndex(_BucketedPodIndex):
+    """Active share pods bucketed by their declared workload class
+    (``tpushare.aliyun.com/workload-class``, normalized — absent reads
+    as latency-critical). The interference plane's class lookup: the
+    detector and the inspect CLI ask "which best-effort pods are live on
+    this node" without rescanning the cache."""
+
+    def _buckets_of(self, pod: dict) -> tuple[str, ...]:
+        if not P.is_active(pod):
+            return ()
+        if P.labels(pod).get(const.LABEL_RESOURCE_KEY) != const.LABEL_RESOURCE_VALUE:
+            return ()
+        return (P.workload_class(pod),)

@@ -128,7 +128,7 @@ class PodInformer:
         use); empty means cluster-wide (the scheduler extender's use —
         placement accounting needs every node's pods, including assumed
         pods that carry annotations but no label yet)."""
-        from .indexes import LabeledPodIndex, PendingPodIndex
+        from .indexes import LabeledPodIndex, PendingPodIndex, WorkloadClassIndex
         from .usage import NodeChipUsage
 
         self._c = client
@@ -156,7 +156,8 @@ class PodInformer:
         self._usage = NodeChipUsage() if node_name else None
         self._pending = PendingPodIndex()
         self._labeled = LabeledPodIndex()
-        self._indexes: list = [self._pending, self._labeled]
+        self._classes = WorkloadClassIndex()
+        self._indexes: list = [self._pending, self._labeled, self._classes]
         if self._usage:
             self._indexes.append(self._usage)
         # monotonic timestamp of the last successful apiserver contact;
@@ -509,6 +510,21 @@ class PodInformer:
         """All pods bearing the tpu/resource label (mem or core) — one
         snapshot for cross-resource accounting on the Allocate path."""
         return self._labeled.pods()
+
+    def share_pods_by_class(self, workload_class: str) -> list[dict]:
+        """Active share pods of one declared workload class (normalized;
+        ``cluster.indexes.WorkloadClassIndex``) — the interference
+        plane's class lookup, O(answer)."""
+        return self._classes.pods(workload_class)
+
+    def chip_residency(self) -> dict[int, dict[str, str]]:
+        """Per-chip resident share pods + workload classes (the
+        interference detector's co-residency input), maintained
+        incrementally; {} on a cluster-wide cache (residency is a
+        node-scoped notion, like ``chip_state``)."""
+        if self._usage is None:
+            return {}
+        return self._usage.residency()
 
     def all_pods(self) -> list[dict]:
         """Every cached pod (the extender's placement accounting reads

@@ -212,6 +212,24 @@ def gang_usage_by_chip(pod: Pod) -> dict[int, int]:
     return {idx: per for idx in chips}
 
 
+def workload_class(pod: Pod) -> str:
+    """The pod's declared QoS class (``ANN_WORKLOAD_CLASS``), normalized.
+
+    Absent or garbled values read as ``latency-critical`` — the safe
+    default is to protect a tenant, never to throttle one that forgot to
+    label itself. One helper so admission, the informer indexes, the
+    interference detector, and the inspect CLI can never disagree about
+    a pod's class."""
+    v = str(annotations(pod).get(const.ANN_WORKLOAD_CLASS, "") or "").strip()
+    if v in const.WORKLOAD_CLASSES:
+        return v
+    return const.WORKLOAD_LATENCY_CRITICAL
+
+
+def is_best_effort(pod: Pod) -> bool:
+    return workload_class(pod) == const.WORKLOAD_BEST_EFFORT
+
+
 def assume_time_from_annotation(pod: Pod) -> int:
     v = annotations(pod).get(const.ENV_ASSUME_TIME)
     try:

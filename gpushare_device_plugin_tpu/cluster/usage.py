@@ -72,6 +72,10 @@ class NodeChipUsage:
         self._lock = make_lock("cluster.usage")
         self._mem_used: dict[int, int] = {}
         self._core_refs: dict[int, int] = {}
+        # per-chip resident share pods and their workload classes — the
+        # interference detector's co-residency input (a gang pod resides
+        # on every member chip). Keyed (namespace, name) -> class.
+        self._residents: dict[int, dict[tuple[str, str], str]] = {}
 
     # --- informer index protocol -----------------------------------------
 
@@ -79,6 +83,7 @@ class NodeChipUsage:
         with self._lock:
             self._mem_used.clear()
             self._core_refs.clear()
+            self._residents.clear()
             for pod in pods:
                 self._add(pod)
 
@@ -92,18 +97,27 @@ class NodeChipUsage:
     # --- internals (lock held) -------------------------------------------
 
     def _add(self, pod: dict) -> None:
+        key = (P.namespace(pod), P.name(pod))
+        cls = P.workload_class(pod)
         for idx, units in _mem_contributions(pod):
             self._mem_used[idx] = self._mem_used.get(idx, 0) + units
+            self._residents.setdefault(idx, {})[key] = cls
         for idx in _core_contribution(pod):
             self._core_refs[idx] = self._core_refs.get(idx, 0) + 1
 
     def _remove(self, pod: dict) -> None:
+        key = (P.namespace(pod), P.name(pod))
         for idx, units in _mem_contributions(pod):
             left = self._mem_used.get(idx, 0) - units
             if left > 0:
                 self._mem_used[idx] = left
             else:
                 self._mem_used.pop(idx, None)
+            members = self._residents.get(idx)
+            if members is not None:
+                members.pop(key, None)
+                if not members:
+                    self._residents.pop(idx, None)
         for idx in _core_contribution(pod):
             left = self._core_refs.get(idx, 0) - 1
             if left > 0:
@@ -117,3 +131,14 @@ class NodeChipUsage:
         """-> (mem units used per chip, exclusively-held chips)."""
         with self._lock:
             return dict(self._mem_used), set(self._core_refs)
+
+    def residency(self) -> dict[int, dict[str, str]]:
+        """Per-chip resident share pods and their workload classes:
+        chip -> {"ns/name": class} — the interference detector's
+        co-residency input (``cluster/interference.py``), maintained
+        incrementally like the unit aggregates."""
+        with self._lock:
+            return {
+                idx: {f"{ns}/{name}": cls for (ns, name), cls in members.items()}
+                for idx, members in self._residents.items()
+            }

@@ -101,6 +101,34 @@ LABEL_DISABLE_ISOLATION = "ctpu.disable.isolation"
 # by design; test_tracing pins the two strings agree).
 ANN_TRACE_ID = "tpushare.aliyun.com/trace-id"
 
+# --- Workload classes (interference observability, cluster/interference.py)
+# A pod declares its QoS class on its spec; admission normalizes and
+# re-persists it with the decision PATCH (and mirrors it into the
+# container env) so every downstream consumer — informer indexes, the
+# interference detector, the inspect CLI, the serving engine's governor —
+# reads one canonical value. Unknown/absent values normalize to
+# latency-critical: the safe default is to protect, never to throttle.
+ANN_WORKLOAD_CLASS = "tpushare.aliyun.com/workload-class"
+WORKLOAD_LATENCY_CRITICAL = "latency-critical"
+WORKLOAD_BEST_EFFORT = "best-effort"
+WORKLOAD_CLASSES = (WORKLOAD_LATENCY_CRITICAL, WORKLOAD_BEST_EFFORT)
+ENV_WORKLOAD_CLASS = "ALIYUN_COM_TPU_WORKLOAD_CLASS"
+
+# The serving engine's SLO tier names (serving/engine.py aliases these —
+# they live here so jax-free control-plane code, e.g. the daemon's
+# per-tier trace-sampling flags, can name a tier without importing the
+# engine). The workload-class -> tier mapping is 1:1:
+# latency-critical -> critical, best-effort -> best_effort.
+SLO_TIER_CRITICAL = "critical"
+SLO_TIER_BEST_EFFORT = "best_effort"
+
+# Node annotation carrying the interference detector's latest verdicts as
+# JSON ({"chips": {chip: {"victim", "aggressors", "ratio"}}, "time_unix"})
+# — written best-effort each detector pass so kubectl-inspect-tpushare
+# (and its `top` view) can render co-tenant interference with no extra
+# endpoint ("apiserver is the database", as ever).
+ANN_INTERFERENCE = "tpushare.aliyun.com/interference"
+
 # --- Live defragmentation (allocator/defrag.py) ----------------------------
 # Node annotation carrying the daemon's defragmenter status as JSON:
 # {"planned", "active", "completed", "failed", "last_move_ms", "quantum",

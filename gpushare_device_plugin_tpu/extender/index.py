@@ -94,7 +94,7 @@ class ClusterUsageIndex:
     def _agg(self, node: str) -> dict:
         agg = self._nodes.get(node)
         if agg is None:
-            agg = self._nodes[node] = {"frac": {}, "core": {}}
+            agg = self._nodes[node] = {"frac": {}, "core": {}, "classes": {}}
         return agg
 
     def _add(self, pod: dict) -> None:
@@ -104,9 +104,13 @@ class ClusterUsageIndex:
         node = P.node_name(pod)
         self._gen[node] = self._gen.get(node, 0) + 1
         agg = self._agg(node)
+        cls = P.workload_class(pod)
         for resource, idx, units in frac:
             used = agg["frac"].setdefault(resource, {})
             used[idx] = used.get(idx, 0) + units
+            if resource == const.RESOURCE_MEM:
+                per_chip = agg["classes"].setdefault(idx, {})
+                per_chip[cls] = per_chip.get(cls, 0) + 1
         for idx in cores:
             agg["core"][idx] = agg["core"].get(idx, 0) + 1
 
@@ -119,6 +123,7 @@ class ClusterUsageIndex:
         agg = self._nodes.get(node)
         if agg is None:
             return
+        cls = P.workload_class(pod)
         for resource, idx, units in frac:
             used = agg["frac"].get(resource, {})
             left = used.get(idx, 0) - units
@@ -126,6 +131,15 @@ class ClusterUsageIndex:
                 used[idx] = left
             else:
                 used.pop(idx, None)
+            if resource == const.RESOURCE_MEM:
+                per_chip = agg["classes"].get(idx, {})
+                refs = per_chip.get(cls, 0) - 1
+                if refs > 0:
+                    per_chip[cls] = refs
+                else:
+                    per_chip.pop(cls, None)
+                    if not per_chip:
+                        agg["classes"].pop(idx, None)
         for idx in cores:
             left = agg["core"].get(idx, 0) - 1
             if left > 0:
@@ -153,3 +167,17 @@ class ClusterUsageIndex:
             if agg is None:
                 return {}, set()
             return dict(agg["frac"].get(resource, {})), set(agg["core"])
+
+    def chip_classes(self, node: str) -> dict[int, dict[str, int]]:
+        """Per-chip workload-class residency counts for ``node``'s share
+        pods (chip -> {class: pods}) — the class index the interference
+        plane's future class-aware placement reads; maintained under the
+        same generation tokens as the unit aggregates. Copies, safe to
+        mutate."""
+        with self._lock:
+            agg = self._nodes.get(node)
+            if agg is None:
+                return {}
+            return {
+                idx: dict(per) for idx, per in agg["classes"].items()
+            }

@@ -66,8 +66,22 @@ class ManagerConfig:
     drain_timeout_s: float = 5.0
     # Flight-recorder dump directory (utils/flightrec.py): SIGUSR1, fatal
     # exit, and injected-crash postmortems land here. Empty disables (the
-    # daemon defaults it to the coredump dir).
+    # daemon defaults it to the coredump dir). flightrecord_keep bounds
+    # the directory to the newest K dump files (0 = unbounded).
     flightrecord_dir: str = ""
+    flightrecord_keep: int = 16
+    # Interference detector cadence (cluster/interference.py): every
+    # interval the daemon correlates per-chip co-residency with step-p99
+    # inflation and publishes the interference node annotation + ratio
+    # gauges; <= 0 disables (pure observability, but opt-in like defrag
+    # so fleets without serving engines pay nothing).
+    interference_interval_s: float = 0.0
+    interference_threshold: float = 1.25
+    # Serving pods' /metrics endpoints to scrape for the engines' step
+    # p99 gauges. Empty: the loop reads the shared in-process registry —
+    # which only works when the engines feed it (bench/test/co-located
+    # integrations); real per-pod engines need their endpoints listed.
+    interference_scrape_urls: tuple[str, ...] = ()
     # Live slice defragmentation (allocator/defrag.py): scan cadence in
     # seconds, <= 0 disables (the default — repacking moves workloads and
     # should be an explicit operator opt-in). quantum=0 auto-derives the
@@ -139,6 +153,7 @@ class TpuShareManager:
         # set_move_hooks() — None means moves skip the drain/restore
         # phases (workloads that checkpoint themselves).
         self._defrag = None
+        self._interference = None  # InterferenceLoop (cluster/interference.py)
         self._move_drain_fn = None
         self._move_restore_fn = None
         self._restart = threading.Event()
@@ -522,8 +537,37 @@ class TpuShareManager:
                 planner, mover, self._api, self._cfg.node_name,
                 interval_s=self._cfg.defrag_interval_s,
             ).start()
+        # Interference observability plane (cluster/interference.py):
+        # residency from the pod source, step-p99 signal from the shared
+        # metrics registry, verdicts onto the interference node
+        # annotation for the inspect CLI's `top` view.
+        if (
+            self._api is not None
+            and self._pod_source is not None
+            and not self._cfg.standalone
+            and self._cfg.interference_interval_s > 0
+            and self._cfg.node_name
+        ):
+            from ..cluster.interference import (
+                InterferenceDetector,
+                InterferenceLoop,
+            )
+
+            self._interference = InterferenceLoop(
+                InterferenceDetector(
+                    threshold=self._cfg.interference_threshold
+                ),
+                self._api,
+                self._cfg.node_name,
+                self._pod_source,
+                interval_s=self._cfg.interference_interval_s,
+                scrape_urls=self._cfg.interference_scrape_urls,
+            ).start()
 
     def _stop_all(self) -> None:
+        if self._interference is not None:
+            self._interference.stop()
+            self._interference = None
         if self._defrag is not None:
             # before the reconciler: a mid-shutdown move must not lose its
             # resolver while still journaling phases
@@ -610,7 +654,10 @@ class TpuShareManager:
         if self._cfg.flightrecord_dir:
             from ..utils.flightrec import FLIGHT
 
-            FLIGHT.install(self._cfg.flightrecord_dir)
+            FLIGHT.install(
+                self._cfg.flightrecord_dir,
+                max_dumps=self._cfg.flightrecord_keep,
+            )
         if self._build_inventory() is None:
             # No TPUs here: park forever instead of crash-looping, so the
             # DaemonSet stays green on heterogenous fleets

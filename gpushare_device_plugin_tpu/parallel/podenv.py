@@ -53,6 +53,14 @@ class PodTpuEnv:
     gang_shape: tuple[int, ...] = ()
     gang_per_chip: int = 0
     mem_units_pod: int = 0  # the whole pod's HBM units (MEM_POD), 0 unset
+    # QoS class the admission PATCH normalized and mirrored into the env
+    # (ALIYUN_COM_TPU_WORKLOAD_CLASS): latency-critical | best-effort.
+    # The serving side attaches a step governor to best-effort engines.
+    workload_class: str = const.WORKLOAD_LATENCY_CRITICAL
+
+    @property
+    def is_best_effort(self) -> bool:
+        return self.workload_class == const.WORKLOAD_BEST_EFFORT
 
     @property
     def exclusive(self) -> bool:
@@ -145,6 +153,9 @@ class PodTpuEnv:
             fraction = min(explicit, derived) if explicit is not None else derived
         else:
             fraction = explicit if explicit is not None else 1.0
+        wl = str(e.get(const.ENV_WORKLOAD_CLASS, "") or "").strip()
+        if wl not in const.WORKLOAD_CLASSES:
+            wl = const.WORKLOAD_LATENCY_CRITICAL
         return cls(
             visible_chips=visible,
             chip_index=_int(const.ENV_MEM_IDX, -1),
@@ -159,6 +170,7 @@ class PodTpuEnv:
             gang_shape=gang_shape,
             gang_per_chip=gang_per_chip,
             mem_units_pod=_int(const.ENV_MEM_POD, 0),
+            workload_class=wl,
         )
 
 

@@ -8,7 +8,10 @@ for the slot-cache primitives it composes. ``pages`` + ``radix`` +
 :class:`~.engine.PagedSlotEngine` replace the per-request ``max_len``
 row with reference-counted fixed-size KV pages, a shared-prefix radix
 cache, and SLO-tiered admission with best-effort preemption
-(``docs/serving.md``, paged KV section).
+(``docs/serving.md``, paged KV section). ``profiler`` + ``governor``
+are the serving half of the interference observability plane: per-slice
+decode-step profiling and the Tally-style best-effort step throttle
+(``docs/observability.md``, interference plane).
 """
 
 from .engine import (  # noqa: F401
@@ -28,10 +31,12 @@ from .engine import (  # noqa: F401
     slots_for_slice,
     slots_from_pod_env,
 )
+from .governor import StepGovernor  # noqa: F401
 from .pages import (  # noqa: F401
     PageAllocator,
     PagedPlan,
     paged_plan_for_slice,
     pages_for,
 )
+from .profiler import StepProfiler  # noqa: F401
 from .radix import RadixCache  # noqa: F401

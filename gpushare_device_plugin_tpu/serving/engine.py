@@ -53,7 +53,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..const import MemoryUnit
+from ..const import SLO_TIER_BEST_EFFORT, SLO_TIER_CRITICAL, MemoryUnit
 from ..parallel.podenv import PodTpuEnv
 from ..utils.lockrank import make_lock
 from ..utils.log import get_logger
@@ -69,15 +69,18 @@ from .pages import (
     pages_for,
     row_span_for,
 )
+from .profiler import StepProfiler, ceil_rank_quantile
 from .radix import RadixCache
 
 log = get_logger("serving.engine")
 
 # SLO tiers (the Tally-style priority split, PAPERS.md 2410.07381):
 # latency-critical requests admit first and may preempt best-effort
-# victims' pages; best-effort requests absorb the queueing.
-TIER_CRITICAL = "critical"
-TIER_BEST_EFFORT = "best_effort"
+# victims' pages; best-effort requests absorb the queueing. The names
+# live in const so jax-free control-plane code (the daemon's per-tier
+# trace-sampling flags) can refer to a tier without importing jax.
+TIER_CRITICAL = SLO_TIER_CRITICAL
+TIER_BEST_EFFORT = SLO_TIER_BEST_EFFORT
 _TIERS = (TIER_CRITICAL, TIER_BEST_EFFORT)
 
 
@@ -186,10 +189,7 @@ class ServeStats:
 
     @staticmethod
     def _quantile(vals: list[float], q: float) -> float:
-        if not vals:
-            return float("nan")
-        s = sorted(vals)
-        return s[min(len(s) - 1, int(math.ceil(q * len(s))) - 1)]
+        return ceil_rank_quantile(vals, q)
 
     def tier_summary(self) -> dict:
         """Per-SLO-tier latency + attainment rows (tick clock: the
@@ -277,6 +277,10 @@ class SlotEngine:
         eos_id: int | None = None,
         kv_dtype: str | None = None,
         mesh=None,
+        metrics_pod: str = "",
+        slo_budget=None,
+        governor=None,
+        profiler_capacity: int = 1024,
     ):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
@@ -320,6 +324,16 @@ class SlotEngine:
         # TRACE time, so steady-state slot churn must leave these frozen
         # (the no-retrace guard the tests and serve bench assert).
         self.trace_counts = {"prefill": 0, "extend": 0, "decode": 0}
+        # Interference observability plane (docs/observability.md):
+        # per-decode-step wall-time profiler (always on — one ring write
+        # per pool-wide step), an optional SLO error budget fed at retire
+        # (utils/slo.py), and an optional best-effort step governor
+        # consulted before each decode dispatch (serving/governor.py).
+        self.metrics_pod = metrics_pod
+        self.profiler = StepProfiler(capacity=profiler_capacity)
+        self.slo_budget = slo_budget
+        self.governor = governor
+        self._warming = False
         self._build_fns()
 
     def _make_cache(self, kv_dtype: str | None):
@@ -404,9 +418,17 @@ class SlotEngine:
         plen = self.chunk + 1
         if max(2 * self.chunk, plen + 2) > self.max_len:
             plen = min(self.chunk, self.max_len - 2)
-        self.run([Request(rid=-1, prompt=tuple(range(1, plen + 1)),
-                          max_new=2, arrival=0.0)])
+        self._warming = True
+        try:
+            self.run([Request(rid=-1, prompt=tuple(range(1, plen + 1)),
+                              max_new=2, arrival=0.0)])
+        finally:
+            self._warming = False
         self.ticks = 0
+        # compile-time decode steps must not pollute the steady-state
+        # step-profile window (or the exported histogram — _warming above
+        # suppressed the flush)
+        self.profiler.reset()
 
     def validate(self, req: Request) -> None:
         # Every prefill write is a FULL chunk (static width; the pad tail
@@ -430,6 +452,23 @@ class SlotEngine:
         buf = np.zeros((self.chunk,), np.int32)
         buf[: len(real)] = real
         return jnp.asarray(buf), len(real)
+
+    def _note_slo(self, res: RequestResult) -> None:
+        """Feed the retired request's SLO verdict into the attached error
+        budget (``utils/slo.py``); requests without targets (and the
+        warmup synthetic) record nothing."""
+        if self.slo_budget is None or res.rid < 0:
+            return
+        ok = res.meets_slo()
+        if ok is not None:
+            self.slo_budget.record(res.tier, ok)
+
+    def _flush_step_profile(self) -> None:
+        """Batch-export the step profile (histogram + rolling p50/p99
+        gauges) — once per run, never per step; suppressed during warmup
+        so compile-time steps never reach ``/metrics``."""
+        if not self._warming:
+            self.profiler.flush(REGISTRY, pod=self.metrics_pod)
 
     def _record_request_trace(self, res: RequestResult, base_ns: int) -> None:
         """Emit the request's span timeline (queue wait -> prefill chunks
@@ -456,9 +495,12 @@ class SlotEngine:
         }
         if res.prefix_tokens:
             attrs["prefix_tokens"] = res.prefix_tokens
+        # per-tier root sampling (--trace-sample-critical /
+        # --trace-sample-besteffort): best-effort churn can be
+        # down-sampled without losing critical-tier traces
         ctx = TRACER.record_span(
             "serve.request", at(res.arrival_s), at(res.finish_s),
-            attributes=attrs,
+            attributes=attrs, tier=res.tier,
         )
         if ctx is None:
             return
@@ -528,6 +570,7 @@ class SlotEngine:
             s.result.finish_s = now()
             results.append(s.result)
             self._record_request_trace(s.result, base_ns)
+            self._note_slo(s.result)
             slots[idx] = _Slot()
 
         while i < len(incoming) or pending or any(
@@ -563,6 +606,11 @@ class SlotEngine:
                 idx = min(pre, key=lambda j: slots[j].result.arrival_tick)
                 s = slots[idx]
                 tokens, n_real = self._chunk_arrays(s.req, s.done)
+                if self.governor is not None:
+                    # prefill chunks are model dispatches too: an
+                    # ungoverned prefill burst would leak the very
+                    # contention the decode throttle exists to stop
+                    self.governor.before_step()
                 fn = self._prefill if s.done == 0 else self._extend
                 tok, self.cache = fn(
                     self.params, tokens, self.cache,
@@ -590,12 +638,19 @@ class SlotEngine:
                 for idx in dec:
                     toks[idx] = slots[idx].last
                     active[idx] = True
+                if self.governor is not None:
+                    # best-effort pacing (Tally-style): may sleep, never
+                    # skips or reorders the dispatch — outside the timed
+                    # step so throttling isn't misread as contention
+                    self.governor.before_step()
+                _step_t0 = time.perf_counter()
                 nxt, self.cache = self._decode(
                     self.params, jnp.asarray(toks), self.cache,
                     jnp.asarray(active),
                 )
                 self.ticks += 1
-                nxt = np.asarray(nxt)
+                nxt = np.asarray(nxt)  # forces the step's device work
+                self.profiler.record(time.perf_counter() - _step_t0)
                 for idx in dec:
                     s = slots[idx]
                     t = int(nxt[idx])
@@ -607,6 +662,7 @@ class SlotEngine:
                         retire(idx)
 
         results.sort(key=lambda r: r.rid)
+        self._flush_step_profile()
         return ServeStats(
             results=results, ticks=self.ticks,
             wall_s=time.perf_counter() - t0,
@@ -679,6 +735,9 @@ class PagedSlotEngine(SlotEngine):
         mesh=None,
         radix: bool = True,
         metrics_pod: str = "",
+        slo_budget=None,
+        governor=None,
+        profiler_capacity: int = 1024,
     ):
         if page_size < 1:
             raise ValueError(f"page_size must be >= 1, got {page_size}")
@@ -703,11 +762,11 @@ class PagedSlotEngine(SlotEngine):
         # JAX's index clamping fold those writes into the last REAL page.
         # row_span_for keeps this width and the sizing math's in lockstep.
         self.row_pages = row_span_for(max_len, prefill_chunk) // page_size
-        self.metrics_pod = metrics_pod
         super().__init__(
             params, cfg, slots=slots, max_len=max_len,
             prefill_chunk=prefill_chunk, eos_id=eos_id, kv_dtype=kv_dtype,
-            mesh=mesh,
+            mesh=mesh, metrics_pod=metrics_pod, slo_budget=slo_budget,
+            governor=governor, profiler_capacity=profiler_capacity,
         )
         self.allocator = PageAllocator(total_pages)
         self.radix = RadixCache(page_size, self.allocator) if radix else None
@@ -789,6 +848,7 @@ class PagedSlotEngine(SlotEngine):
         next to the gang/slice columns)."""
         labels = {"pod": self.metrics_pod} if self.metrics_pod else {}
         self.allocator.publish(REGISTRY, pod=self.metrics_pod)
+        self._flush_step_profile()
         if self.radix is not None:
             REGISTRY.gauge_set(
                 "tpushare_engine_prefix_hit_ratio", self.radix.hit_ratio(),
@@ -823,6 +883,8 @@ class PagedSlotEngine(SlotEngine):
                 prefix_cached_pages=self.radix.cached_pages,
                 prefix_evicted_pages=self.radix.evicted_pages,
             )
+        if self.governor is not None:
+            out["governor"] = self.governor.stats()
         return out
 
     # --- drain/restore: the defrag move protocol's engine hand-off --------
@@ -1141,6 +1203,7 @@ class PagedSlotEngine(SlotEngine):
             res.finish_s = now()
             results.append(res)
             self._record_request_trace(res, base_ns)
+            self._note_slo(res)
             # Adopt the ORIGINAL prompt's full pages into the radix tree
             # (they hold exactly those tokens' KV; pages past the prompt
             # mix in generated content and are simply freed). The tree
@@ -1316,6 +1379,11 @@ class PagedSlotEngine(SlotEngine):
                 # iteration (the decode pool below still dispatches)
                 if got is not None:
                     self._grow(s, got)
+                    if self.governor is not None:
+                        # prefill dispatches are paced like decode steps
+                        # (see SlotEngine.run): a best-effort engine must
+                        # not leak contention through its prompt chunks
+                        self.governor.before_step()
                     buf = np.zeros((self.chunk,), np.int32)
                     buf[:n_real] = real
                     table = jnp.asarray(s.table)
@@ -1382,6 +1450,11 @@ class PagedSlotEngine(SlotEngine):
                 for idx in active_rows:
                     toks[idx] = slots[idx].last
                     active[idx] = True
+                if self.governor is not None:
+                    # Tally-style best-effort pacing: a sleep before the
+                    # dispatch, never a skip — tokens stay bit-identical
+                    self.governor.before_step()
+                _step_t0 = time.perf_counter()
                 nxt, self.cache = self._decode(
                     self.params, jnp.asarray(toks), self.cache,
                     jnp.asarray(tables), jnp.asarray(active),
@@ -1389,6 +1462,7 @@ class PagedSlotEngine(SlotEngine):
                 self.ticks += 1
                 dispatched = True
                 nxt = np.asarray(nxt)
+                self.profiler.record(time.perf_counter() - _step_t0)
                 for idx in active_rows:
                     s = slots[idx]
                     s.pos += 1

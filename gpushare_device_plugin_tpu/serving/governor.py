@@ -1,0 +1,252 @@
+"""Best-effort step-rate governor: the reaction half of the
+interference plane.
+
+Tally (PAPERS.md 2410.07381) shows a best-effort tenant can share an
+accelerator with a latency-critical one *non-intrusively*: never touch
+the critical tenant, only pace the best-effort one's kernel launches
+when the critical tenant is in danger. This module is that idea applied
+to our serving engine's deterministic host loop: a **token bucket on
+decode iterations**. The best-effort engine consults
+:meth:`StepGovernor.before_step` once per decode dispatch; while the
+governor is *engaged*, iterations drain tokens refilled at
+``throttled_steps_per_s`` and a dry bucket sleeps the host loop until
+the next token accrues. While *released*, ``before_step`` is two loads
+and a compare — the engine runs at full rate.
+
+Engage/release policy (driven by the SLO burn-rate signal,
+``utils/slo.py``):
+
+- **engage** the moment ``burn_fn()`` reports page severity for the
+  co-resident latency-critical tier (one poll per
+  ``poll_interval_steps`` iterations — the signal source holds a lock,
+  so it must be off the per-step path);
+- **release hysteretically**: only after ``release_after`` consecutive
+  clean polls — a budget that flaps around the page threshold must not
+  turn the throttle into an oscillator.
+
+Every transition is observable: a ``governor.engage``/``governor.release``
+span (with the triggering severity and the engaged duration), the
+``tpushare_governor_engagements_total`` counter, the
+``tpushare_governor_engaged{pod}`` gauge, and
+``tpushare_governor_throttle_seconds_total`` accumulating the imposed
+sleep — the reaction itself shows up in ``/metrics`` and ``/traces``,
+not just its effect.
+
+Correctness bar: the governor only ever *delays* dispatches, never
+reorders, drops, or alters them — greedy tokens stay bit-identical and
+the 3-compiled-programs invariant is untouched (gated hard in
+``bench_mfu.py --interference-smoke``). State is engine-thread-only by
+design (no lock): ``burn_fn`` crosses threads, the governor does not.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+from ..utils.log import get_logger
+from ..utils.metrics import MetricsRegistry, REGISTRY
+from ..utils.tracing import TRACER
+
+log = get_logger("serving.governor")
+
+ENGAGED_GAUGE = "tpushare_governor_engaged"
+ENGAGEMENTS_TOTAL = "tpushare_governor_engagements_total"
+THROTTLED_STEPS_TOTAL = "tpushare_governor_throttled_steps_total"
+THROTTLE_SECONDS_TOTAL = "tpushare_governor_throttle_seconds_total"
+
+
+class StepGovernor:
+    """Token-bucket throttle on a best-effort engine's decode iterations.
+
+    ``burn_fn() -> str | None`` returns the co-resident critical tier's
+    current burn severity (``utils.slo.SloBudget.severity``, or any
+    callable — the interference detector's verdict works too); ``"page"``
+    engages. ``clock``/``sleep`` are injectable so tests and the
+    deterministic bench can drive the bucket without real waiting.
+    """
+
+    def __init__(
+        self,
+        burn_fn: Callable[[], str | None],
+        *,
+        throttled_steps_per_s: float = 20.0,
+        burst: float = 2.0,
+        poll_interval_steps: int = 8,
+        release_after: int = 3,
+        engage_on: str = "page",
+        pod: str = "",
+        registry: MetricsRegistry | None = None,
+        clock: Callable[[], float] = time.monotonic,
+        sleep: Callable[[float], None] = time.sleep,
+    ) -> None:
+        if throttled_steps_per_s <= 0:
+            raise ValueError(
+                f"throttled_steps_per_s must be > 0, got {throttled_steps_per_s}"
+            )
+        if burst <= 0:
+            raise ValueError(f"burst must be > 0, got {burst}")
+        if poll_interval_steps < 1:
+            raise ValueError(
+                f"poll_interval_steps must be >= 1, got {poll_interval_steps}"
+            )
+        if release_after < 1:
+            raise ValueError(f"release_after must be >= 1, got {release_after}")
+        self._burn_fn = burn_fn
+        self._rate = throttled_steps_per_s
+        # burst is the bucket CAP, not a minimum: a cap below 1.0 means
+        # the bucket can never hold a full token, so an engaged engine
+        # pays a wait before EVERY dispatch — idle gaps (a drained run,
+        # a quiet queue) cannot accrue a "free" dispatch that lands as
+        # a contention spike the moment work resumes
+        self._burst = float(burst)
+        self._poll_every = poll_interval_steps
+        self._release_after = release_after
+        # "page" engages on page only; "warn" engages on warn OR page
+        self._engage_on = engage_on
+        self._pod = pod
+        self._reg = registry if registry is not None else REGISTRY
+        self._clock = clock
+        self._sleep = sleep
+        self.engaged = False
+        self.engagements = 0
+        self.throttled_steps = 0
+        self.throttle_seconds = 0.0
+        self._steps_since_poll = 0
+        self._clean_polls = 0
+        self._tokens = self._burst
+        self._last_refill = clock()
+        self._engaged_at = 0.0
+        self._last_severity: str | None = None
+
+    # --- policy -----------------------------------------------------------
+
+    def _severity_engages(self, severity: str | None) -> bool:
+        if severity is None:
+            return False
+        if self._engage_on == "warn":
+            return severity in ("warn", "page")
+        return severity == "page"
+
+    def _labels(self) -> dict[str, str]:
+        return {"pod": self._pod} if self._pod else {}
+
+    def _engage(self, severity: str) -> None:
+        self.engaged = True
+        self.engagements += 1
+        self._clean_polls = 0
+        # the bucket starts EMPTY: the victim is burning right now, so a
+        # freshly-engaged governor pauses immediately instead of
+        # spending a burst of free dispatches into the contention
+        self._tokens = 0.0
+        self._last_refill = self._clock()
+        self._engaged_at = self._last_refill
+        labels = self._labels()
+        self._reg.counter_inc(
+            ENGAGEMENTS_TOTAL,
+            "Times the best-effort governor engaged its step throttle",
+            **labels,
+        )
+        self._reg.gauge_set(
+            ENGAGED_GAUGE, 1.0,
+            "Whether the best-effort step throttle is currently engaged",
+            **labels,
+        )
+        with TRACER.span(
+            "governor.engage",
+            attributes={
+                "severity": severity, "pod": self._pod,
+                "throttled_steps_per_s": self._rate,
+            },
+        ):
+            pass
+        log.info(
+            "governor engaged (severity=%s): best-effort decode throttled "
+            "to %.1f steps/s", severity, self._rate,
+        )
+
+    def _release(self) -> None:
+        engaged_s = self._clock() - self._engaged_at
+        self.engaged = False
+        self._reg.gauge_set(
+            ENGAGED_GAUGE, 0.0,
+            "Whether the best-effort step throttle is currently engaged",
+            **self._labels(),
+        )
+        with TRACER.span(
+            "governor.release",
+            attributes={"pod": self._pod, "engaged_s": round(engaged_s, 3)},
+        ):
+            pass
+        log.info(
+            "governor released after %.2fs (%d clean polls)",
+            engaged_s, self._release_after,
+        )
+
+    def poll(self) -> None:
+        """Re-read the burn signal and update the engage state (also
+        called internally every ``poll_interval_steps`` iterations)."""
+        severity = self._burn_fn()
+        self._last_severity = severity
+        if self._severity_engages(severity):
+            self._clean_polls = 0
+            if not self.engaged:
+                self._engage(severity or "")
+        elif self.engaged:
+            self._clean_polls += 1
+            if self._clean_polls >= self._release_after:
+                self._release()
+
+    # --- the hot-path hook --------------------------------------------------
+
+    def before_step(self) -> float:
+        """Called by the engine once per decode iteration. Returns the
+        seconds slept (0.0 on the unthrottled fast path). Never raises,
+        never skips the step — it only delays it."""
+        self._steps_since_poll += 1
+        if self._steps_since_poll >= self._poll_every:
+            self._steps_since_poll = 0
+            self.poll()
+        if not self.engaged:
+            return 0.0
+        now = self._clock()
+        self._tokens = min(
+            self._burst, self._tokens + (now - self._last_refill) * self._rate
+        )
+        self._last_refill = now
+        slept = 0.0
+        if self._tokens < 1.0:
+            wait = (1.0 - self._tokens) / self._rate
+            self._sleep(wait)
+            slept = wait
+            now = self._clock()
+            self._tokens = min(
+                self._burst,
+                self._tokens + (now - self._last_refill) * self._rate,
+            )
+            self._last_refill = now
+            self.throttled_steps += 1
+            self.throttle_seconds += slept
+            labels = self._labels()
+            self._reg.counter_inc(
+                THROTTLED_STEPS_TOTAL,
+                "Decode iterations delayed by the best-effort governor",
+                **labels,
+            )
+            self._reg.counter_inc(
+                THROTTLE_SECONDS_TOTAL,
+                "Cumulative seconds of governor-imposed decode delay",
+                value=slept, **labels,
+            )
+        self._tokens = max(0.0, self._tokens - 1.0)
+        return slept
+
+    def stats(self) -> dict[str, float | int | bool | None]:
+        """Telemetry snapshot (bench/report row)."""
+        return {
+            "engaged": self.engaged,
+            "engagements": self.engagements,
+            "throttled_steps": self.throttled_steps,
+            "throttle_seconds": round(self.throttle_seconds, 4),
+            "last_severity": self._last_severity,
+        }

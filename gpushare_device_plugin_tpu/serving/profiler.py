@@ -1,0 +1,174 @@
+"""Per-slice decode-step profiler: the raw signal of the interference
+observability plane.
+
+Two pods sharing one chip only partition HBM — compute contention is
+invisible until it shows up as *slower decode steps* on the
+latency-critical tenant. This module measures exactly that, at the only
+place it can be measured honestly: around each pool-wide decode dispatch
+in the serving engine's host loop.
+
+Design constraints (the same bar the PR 8 tracing layer set):
+
+- **Zero per-token allocation.** One :meth:`StepProfiler.record` call
+  per decode *step* (not per token — a step advances every occupied
+  slot), writing one float into a preallocated ring under a near-leaf
+  lock (``serving.profiler``, rank 91). No list growth, no dict churn,
+  no id generation on the hot path.
+- **Retire-time style export.** The raw samples stay in the ring;
+  :meth:`StepProfiler.flush` batch-converts everything recorded since
+  the last flush into ``tpushare_engine_step_seconds`` histogram
+  observations (with a trace-id exemplar via a short ``serve.step_flush``
+  span) and publishes the rolling p50/p99 gauges — the engine calls it
+  once per :meth:`~.engine.SlotEngine.run`, never per step.
+- **Rolling quantiles.** p50/p99 over the ring's window (newest
+  ``capacity`` steps) — what the interference detector compares against
+  each engine's solo baseline window (``cluster/interference.py``).
+
+The profiler's overhead is gated by ``bench_mfu.py --interference-smoke``
+(same traced-vs-untraced methodology as ``make bench-trace``): p99 step
+time on an uncontended engine inflates <= 5% with profiling on.
+"""
+
+from __future__ import annotations
+
+import math
+
+from ..utils.lockrank import make_lock
+from ..utils.metrics import MetricsRegistry, REGISTRY
+from ..utils.tracing import TRACER
+
+STEP_METRIC = "tpushare_engine_step_seconds"
+STEP_HELP = (
+    "Wall seconds per pool-wide decode step (one model dispatch advancing "
+    "every occupied slot)"
+)
+# Decode steps span ~100us (real TPU) to ~100ms (CPU smoke); log-spaced so
+# both regimes land in resolving buckets.
+STEP_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0,
+)
+
+P50_GAUGE = "tpushare_engine_step_p50_seconds"
+P99_GAUGE = "tpushare_engine_step_p99_seconds"
+
+
+def ceil_rank_quantile(vals: list[float], q: float) -> float:
+    """Ceil-rank quantile over an unsorted sample list (nan when empty)
+    — THE quantile convention this repo's serving stats, profiler, and
+    benches all share (one implementation, no drift)."""
+    s = sorted(vals)
+    if not s:
+        return float("nan")
+    return s[min(len(s) - 1, max(0, int(math.ceil(q * len(s))) - 1))]
+
+
+class StepProfiler:
+    """Bounded ring of per-decode-step wall times with rolling quantiles.
+
+    Single writer (the engine's host loop), concurrent readers (the
+    /metrics publisher, the interference detector). ``capacity`` bounds
+    both memory and the rolling window the quantiles answer over.
+    """
+
+    def __init__(self, capacity: int = 1024) -> None:
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self._lock = make_lock("serving.profiler")
+        self._ring: list[float] = [0.0] * capacity
+        self._cap = capacity
+        self._count = 0  # total steps ever recorded
+        self._flushed = 0  # steps already exported to the histogram
+
+    def record(self, seconds: float) -> None:
+        """One decode step's wall time. O(1): a ring write and a counter
+        bump under the near-leaf lock — no allocation."""
+        with self._lock:
+            self._ring[self._count % self._cap] = seconds
+            self._count += 1
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    def window(self) -> list[float]:
+        """The rolling window's samples (newest ``capacity`` steps),
+        unordered — quantile input for readers that want their own math."""
+        with self._lock:
+            n = min(self._count, self._cap)
+            return self._ring[:n]
+
+    def quantile(self, q: float) -> float:
+        """Rolling quantile over the window; nan with no samples (same
+        ceil-rank convention as ``ServeStats``)."""
+        return ceil_rank_quantile(self.window(), q)
+
+    def p50(self) -> float:
+        return self.quantile(0.50)
+
+    def p99(self) -> float:
+        return self.quantile(0.99)
+
+    def reset(self) -> None:
+        """Forget all samples (engine warmup: compile-time steps must not
+        pollute the steady-state window)."""
+        with self._lock:
+            self._count = 0
+            self._flushed = 0
+
+    def flush(
+        self, registry: MetricsRegistry | None = None, pod: str = ""
+    ) -> int:
+        """Batch-export everything recorded since the last flush into the
+        ``tpushare_engine_step_seconds`` histogram plus the rolling
+        p50/p99 gauges; returns the number of samples exported.
+
+        Runs inside a short ``serve.step_flush`` span so the histogram
+        buckets carry a trace-id exemplar linking ``/metrics`` to
+        ``/traces`` (the per-step ring itself records no trace state —
+        zero hot-path cost). Samples that fell off the ring between
+        flushes are skipped and counted in the span's ``dropped``
+        attribute; the engine flushes once per run, so in practice the
+        window covers everything.
+
+        Without a ``pod`` label nothing is exported (returns 0, samples
+        consumed): every ``tpushare_engine_*`` series carries the pod
+        label, and an unlabeled flush would merge every label-less
+        engine in the process into one shared series the interference
+        detector cannot attribute. The rolling quantiles stay available
+        programmatically either way."""
+        reg = registry if registry is not None else REGISTRY
+        with self._lock:
+            count = self._count
+            start = max(self._flushed, count - self._cap)
+            dropped = start - self._flushed
+            samples = [self._ring[i % self._cap] for i in range(start, count)]
+            self._flushed = count
+        if not pod:
+            return 0
+        labels = {"pod": pod}
+        if samples:
+            with TRACER.span(
+                "serve.step_flush",
+                attributes={"steps": len(samples), "dropped": dropped},
+            ):
+                for s in samples:
+                    reg.observe(
+                        STEP_METRIC, s, STEP_HELP, buckets=STEP_BUCKETS,
+                        **labels,
+                    )
+        p50, p99 = self.p50(), self.p99()
+        if p50 == p50:  # not nan
+            reg.gauge_set(
+                P50_GAUGE, p50,
+                "Rolling p50 decode-step wall seconds (profiler window)",
+                **labels,
+            )
+        if p99 == p99:
+            reg.gauge_set(
+                P99_GAUGE, p99,
+                "Rolling p99 decode-step wall seconds (profiler window)",
+                **labels,
+            )
+        return len(samples)

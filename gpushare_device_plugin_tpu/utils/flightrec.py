@@ -43,6 +43,12 @@ from . import tracing
 from .lockrank import make_lock
 
 DEFAULT_MAX_LOGS = 512
+# Rotation bound: only the newest K dump files are kept in the dump
+# directory. Repeated SIGUSR1 postmortems / chaos-suite crash loops used
+# to grow the directory without bound — the flight recorder is a ring in
+# memory, so its disk footprint is a ring too. 0 disables.
+DEFAULT_MAX_DUMPS = 16
+_DUMP_PREFIX = "tpushare-flightrec-"
 
 
 class _RingHandler(logging.Handler):
@@ -80,6 +86,7 @@ class FlightRecorder:
         self,
         store: tracing.TraceStore | None = None,
         max_logs: int = DEFAULT_MAX_LOGS,
+        max_dumps: int = DEFAULT_MAX_DUMPS,
     ) -> None:
         self._store = store if store is not None else tracing.STORE
         self._lock = make_lock("flightrec.ring")
@@ -87,15 +94,25 @@ class FlightRecorder:
         self._dir = ""
         self._installed = False
         self._dumps = 0
+        self._keep = max_dumps
         self._handler: _RingHandler | None = None
 
     # --- wiring -----------------------------------------------------------
 
-    def install(self, directory: str, logger: logging.Logger | None = None) -> None:
+    def install(
+        self,
+        directory: str,
+        logger: logging.Logger | None = None,
+        max_dumps: int | None = None,
+    ) -> None:
         """Attach the log ring to ``logger`` (root by default) and
         register the fatal-exit and injected-crash dump hooks.
-        Idempotent; re-install just updates the directory."""
+        Idempotent; re-install just updates the directory (and the
+        rotation bound, when given — ``max_dumps`` keeps the newest K
+        dump files on disk, 0 disables rotation)."""
         self._dir = directory
+        if max_dumps is not None:
+            self._keep = max_dumps
         if self._installed:
             return
         self._installed = True
@@ -185,7 +202,7 @@ class FlightRecorder:
         doc = self.snapshot(reason)
         slug = "".join(c if c.isalnum() else "-" for c in reason)[:48]
         path = os.path.join(
-            self._dir, f"tpushare-flightrec-{int(time.time())}-{slug}.json"
+            self._dir, f"{_DUMP_PREFIX}{int(time.time())}-{slug}.json"
         )
         try:
             os.makedirs(self._dir, exist_ok=True)
@@ -199,12 +216,51 @@ class FlightRecorder:
                 "flight-record dump failed: %s", e
             )
             return ""
+        self._rotate(keep_path=path)
         with self._lock:  # dumps can come from the signal-spawned thread
             self._dumps += 1
         logging.getLogger("utils.flightrec").info(
             "flight record (%s): %s", reason, path
         )
         return path
+
+    def _rotate(self, keep_path: str = "") -> None:
+        """Prune the dump directory to the newest ``max_dumps`` files
+        (the one just written always survives, whatever its timestamp —
+        a skewed clock must not make a fresh postmortem the 'oldest').
+        Best-effort: a sick dump disk must not hurt the dumper."""
+        if self._keep <= 0 or not self._dir:
+            return
+        try:
+            entries = []
+            with os.scandir(self._dir) as it:
+                for entry in it:
+                    if not entry.name.startswith(_DUMP_PREFIX):
+                        continue
+                    if not entry.name.endswith(".json"):
+                        continue
+                    try:
+                        entries.append((entry.stat().st_mtime, entry.path))
+                    except OSError:
+                        continue
+            entries.sort()  # oldest first
+            excess = len(entries) - self._keep
+            for _mtime, victim in entries:
+                if excess <= 0:
+                    break
+                if keep_path and os.path.abspath(victim) == os.path.abspath(
+                    keep_path
+                ):
+                    continue
+                try:
+                    os.unlink(victim)
+                    excess -= 1
+                except OSError:
+                    continue
+        except OSError as e:
+            logging.getLogger("utils.flightrec").warning(
+                "flight-record rotation failed: %s", e
+            )
 
 
 def load_dump(path: str) -> dict[str, Any]:

@@ -142,6 +142,20 @@ RANKS: dict[str, LockRank] = dict(
             "maintained under informer.cache, read under extender.core.",
         ),
         _r(
+            "cluster.interference", 63, "lock", False,
+            "InterferenceDetector's baseline/report state: per-victim "
+            "solo-window step-p99 baselines and the last pass's verdicts. "
+            "Inputs (chip residency, step p99s) are gathered BEFORE the "
+            "lock is taken; gauges publish after it is dropped.",
+        ),
+        _r(
+            "slo.budget", 64, "lock", False,
+            "SloBudget's time-bucketed good/bad event counters and "
+            "burn-rate state. record() runs at engine retire (no other "
+            "lock held); evaluate() snapshots under it and fires the "
+            "page hook (flight-recorder dump) outside.",
+        ),
+        _r(
             "wal.batcher", 70, "condition", False,
             "GroupBatcher's queue condition: submit() runs under "
             "checkpoint.journal; the flush itself happens with the "
@@ -205,6 +219,15 @@ RANKS: dict[str, LockRank] = dict(
             "faults.registry", 90, "lock", False,
             "Fault-injection rule table; fire() sites run everywhere, "
             "so this must be a near-leaf.",
+        ),
+        _r(
+            "serving.profiler", 91, "lock", False,
+            "StepProfiler's preallocated per-decode-step ring + "
+            "counters: the engine's host loop writes one float per "
+            "decode dispatch, the /metrics publisher and the "
+            "interference detector read rolling quantiles. Near-leaf "
+            "pure memory; flush() snapshots under it and feeds the "
+            "metrics registry (rank 95) outside.",
         ),
         _r(
             "tracing.admissions", 92, "lock", False,

@@ -126,6 +126,25 @@ class MetricsRegistry:
         with self._lock:
             return self._counters.get(key, 0.0)
 
+    def gauge_value(self, name: str, **labels: str) -> float | None:
+        """One labeled gauge's current value; None when never set."""
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            return self._gauges.get(key)
+
+    def gauge_series(
+        self, name: str
+    ) -> dict[tuple[tuple[str, str], ...], float]:
+        """Every labeled series of one gauge family: sorted label tuple
+        -> value (the interference detector enumerates the per-pod step
+        gauges through this)."""
+        with self._lock:
+            return {
+                labels: val
+                for (n, labels), val in self._gauges.items()
+                if n == name
+            }
+
     def histogram_stats(self, name: str, **labels: str) -> tuple[int, float]:
         """(observation count, sum) for one labeled histogram series;
         (0, 0.0) when it has never been observed."""

@@ -398,6 +398,11 @@ class Tracer:
     ) -> None:
         self._store = store if store is not None else TraceStore()
         self._ratio = float(sample_ratio)
+        # Per-tier overrides of the root sampling ratio (the daemon's
+        # --trace-sample-critical / --trace-sample-besteffort flags):
+        # best-effort churn can be down-sampled without losing
+        # critical-tier traces. Tiers not listed inherit the default.
+        self._tier_ratios: dict[str, float] = {}
         self.service = service
         self._tls = threading.local()
 
@@ -411,11 +416,30 @@ class Tracer:
     def sample_ratio(self) -> float:
         return self._ratio
 
-    def configure(self, sample_ratio: float | None = None) -> None:
+    def tier_sample_ratio(self, tier: str | None) -> float:
+        """The effective root-sampling ratio for ``tier`` (the default
+        ratio when the tier has no override or is None)."""
+        if tier is None:
+            return self._ratio
+        return self._tier_ratios.get(tier, self._ratio)
+
+    def configure(
+        self,
+        sample_ratio: float | None = None,
+        tier_ratios: dict[str, float] | None = None,
+    ) -> None:
         """Runtime reconfiguration (the daemon's ``--trace-sample`` flag,
-        the bench's ``--no-trace``)."""
+        the bench's ``--no-trace``). ``tier_ratios`` REPLACES the
+        per-tier override table when given (pass ``{}`` to clear); the
+        default ratio still governs tiers without an entry — and every
+        root created without a tier — so the no-override configuration
+        behaves exactly as before the flags existed."""
         if sample_ratio is not None:
             self._ratio = float(sample_ratio)
+        if tier_ratios is not None:
+            self._tier_ratios = {
+                str(t): float(r) for t, r in tier_ratios.items()
+            }
 
     # --- span stack -------------------------------------------------------
 
@@ -440,12 +464,13 @@ class Tracer:
             return None
         return span.context()
 
-    def _sampled_root(self) -> bool:
-        if self._ratio >= 1.0:
+    def _sampled_root(self, tier: str | None = None) -> bool:
+        ratio = self.tier_sample_ratio(tier)
+        if ratio >= 1.0:
             return True
-        if self._ratio <= 0.0:
+        if ratio <= 0.0:
             return False
-        return random.random() < self._ratio
+        return random.random() < ratio
 
     # --- span creation ----------------------------------------------------
 
@@ -543,13 +568,16 @@ class Tracer:
         attributes: dict[str, Any] | None = None,
         status: str = STATUS_OK,
         events: list[tuple[str, int, dict[str, Any]]] | None = None,
+        tier: str | None = None,
     ) -> SpanContext | None:
         """Create an already-finished span from explicit timestamps (the
         serving engine reconstructs each request's timeline at retire
         time — zero tracing work on the per-token hot loop). Returns the
-        span's context for building children, or None when unsampled."""
+        span's context for building children, or None when unsampled.
+        ``tier`` selects a per-tier root sampling override when this
+        span starts a new trace (``configure(tier_ratios=...)``)."""
         if parent is None:
-            if not self._sampled_root():
+            if not self._sampled_root(tier):
                 return None
             trace_id = _new_trace_id()
             parent_id = ""

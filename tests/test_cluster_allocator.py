@@ -259,3 +259,35 @@ def test_deleted_pod_404_with_no_live_candidate_fails(api):
         assert informer.pending_pods() == []
     finally:
         informer.stop()
+
+
+def test_workload_class_persisted_and_injected(api):
+    """Admission normalizes the declared workload class, persists it with
+    the decision PATCH, and mirrors it into the container env — every
+    downstream consumer (indexes, detector, CLI, governor) reads one
+    canonical value (interference plane, docs/observability.md)."""
+    api.add_pod(make_pod(
+        "lora", 4, node=NODE,
+        annotations={
+            const.ANN_WORKLOAD_CLASS: const.WORKLOAD_BEST_EFFORT
+        },
+    ))
+    alloc, client = make_allocator(api)
+    res = alloc.allocate(granted(4))
+    assert res[0].envs[const.ENV_WORKLOAD_CLASS] == const.WORKLOAD_BEST_EFFORT
+    ann = client.get_pod("default", "lora")["metadata"]["annotations"]
+    assert ann[const.ANN_WORKLOAD_CLASS] == const.WORKLOAD_BEST_EFFORT
+
+
+def test_workload_class_garbled_normalizes_to_critical(api):
+    api.add_pod(make_pod(
+        "weird", 4, node=NODE,
+        annotations={const.ANN_WORKLOAD_CLASS: "ultra-speed"},
+    ))
+    alloc, client = make_allocator(api)
+    res = alloc.allocate(granted(4))
+    assert res[0].envs[const.ENV_WORKLOAD_CLASS] == (
+        const.WORKLOAD_LATENCY_CRITICAL
+    )
+    ann = client.get_pod("default", "weird")["metadata"]["annotations"]
+    assert ann[const.ANN_WORKLOAD_CLASS] == const.WORKLOAD_LATENCY_CRITICAL

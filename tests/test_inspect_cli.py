@@ -428,3 +428,186 @@ def test_cli_partially_garbled_defrag_annotation_degrades(api, capsys, monkeypat
     out = capsys.readouterr().out
     assert "MOVES (defrag)" in out
     assert "0 planned · 0 active · 2 done" in out
+
+
+# --------------------------------------------------------------------------
+# workload classes + interference plane (docs/observability.md)
+# --------------------------------------------------------------------------
+
+
+def _interference_node(name="node-a", **kw):
+    node = shared_node(name, **kw)
+    node["metadata"]["annotations"] = {
+        const.ANN_INTERFERENCE: json.dumps({
+            "time_unix": 123.0,
+            "threshold": 1.25,
+            "chips": {"0": {
+                "victim": "default/svc", "aggressors": ["default/lora"],
+                "ratio": 2.104, "flagged": True,
+            }},
+        })
+    }
+    return node
+
+
+def _class_pods():
+    return [
+        assigned_running_pod("svc", 8, chip_idx=0, node="node-a"),
+        assigned_running_pod(
+            "lora", 4, chip_idx=0, node="node-a",
+            annotations={
+                const.ANN_WORKLOAD_CLASS: const.WORKLOAD_BEST_EFFORT
+            },
+        ),
+    ]
+
+
+def test_cli_details_class_column_and_interference(api, capsys, monkeypatch):
+    api.nodes["node-a"] = _interference_node()
+    for pod in _class_pods():
+        api.add_pod(pod)
+    monkeypatch.setattr(inspect_cli, "_client", lambda: ApiServerClient(api.url))
+
+    rc = inspect_cli.main(["-d"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "CLASS" in out
+    assert "best-effort" in out and "latency-critical" in out
+    assert "Interference:" in out
+    assert (
+        "chip0: default/svc 2.10x vs solo (aggressors: default/lora)  FLAGGED"
+        in out
+    )
+
+
+def test_cli_no_class_keeps_reference_layout(api, capsys, monkeypatch):
+    api.nodes["node-a"] = shared_node("node-a")
+    api.add_pod(assigned_running_pod("r1", 4, chip_idx=0, node="node-a"))
+    monkeypatch.setattr(inspect_cli, "_client", lambda: ApiServerClient(api.url))
+    rc = inspect_cli.main(["-d"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "CLASS" not in out
+    assert "Interference:" not in out
+
+
+def test_cli_json_class_and_interference(api, capsys, monkeypatch):
+    api.nodes["node-a"] = _interference_node()
+    for pod in _class_pods():
+        api.add_pod(pod)
+    monkeypatch.setattr(inspect_cli, "_client", lambda: ApiServerClient(api.url))
+    rc = inspect_cli.main(["-o", "json"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    doc = json.loads(out)
+    node = doc["nodes"][0]
+    classes = {p["name"]: p["workload_class"] for p in node["pods"]}
+    assert classes == {
+        "svc": const.WORKLOAD_LATENCY_CRITICAL,
+        "lora": const.WORKLOAD_BEST_EFFORT,
+    }
+    assert node["interference"]["chips"]["0"]["victim"] == "default/svc"
+    assert node["interference"]["chips"]["0"]["ratio"] == 2.104
+
+
+def test_cli_garbled_interference_annotation_ignored(api, capsys, monkeypatch):
+    node = shared_node("node-a")
+    node["metadata"]["annotations"] = {const.ANN_INTERFERENCE: "not-json"}
+    api.nodes["node-a"] = node
+    api.add_pod(assigned_running_pod("r1", 4, chip_idx=0, node="node-a"))
+    monkeypatch.setattr(inspect_cli, "_client", lambda: ApiServerClient(api.url))
+    rc = inspect_cli.main(["-d"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "Interference:" not in out
+
+
+def test_parse_observability_metrics_real_exposition():
+    """End to end against a REAL registry exposition: the profiler, SLO
+    budget, and governor families all land in the top view's parse."""
+    from gpushare_device_plugin_tpu.serving.governor import StepGovernor
+    from gpushare_device_plugin_tpu.serving.profiler import StepProfiler
+    from gpushare_device_plugin_tpu.utils.metrics import MetricsRegistry
+    from gpushare_device_plugin_tpu.utils.slo import SloBudget, SloObjective
+
+    reg = MetricsRegistry()
+    prof = StepProfiler()
+    prof.record(0.002)
+    prof.flush(reg, pod="default/svc")
+    t = [0.0]
+    budget = SloBudget(
+        {"critical": SloObjective(tier="critical", goal=0.99)},
+        clock=lambda: t[0],
+    )
+    for _ in range(10):
+        budget.record("critical", False)
+    budget.publish(reg)
+    gov = StepGovernor(
+        lambda: "page", poll_interval_steps=1, pod="default/lora",
+        registry=reg, clock=lambda: t[0],
+        sleep=lambda s: t.__setitem__(0, t[0] + s),
+    )
+    gov.before_step()
+
+    obs = inspect_cli.parse_observability_metrics(reg.render())
+    assert obs["engine"]["default/svc"]["step_p99_seconds"] == 0.002
+    assert obs["slo"]["critical"]["burn_5m"] == 100.0
+    assert obs["slo"]["critical"]["severity"] == 2.0
+    assert obs["slo"]["critical"]["error_budget_remaining"] == 0.0
+    assert obs["governor"]["default/lora"]["engaged"] == 1.0
+    assert obs["governor"]["default/lora"]["engagements_total"] == 1.0
+
+
+def test_render_top_golden():
+    """The top view renders deterministically for a fixed input set
+    (golden-tested like render_trace / render_flightrecord)."""
+    from gpushare_device_plugin_tpu.cli.display import render_top
+
+    nodes = [_interference_node()]
+    infos = build_all_node_infos(nodes, _class_pods())
+    obs = {
+        "engine": {
+            "default/svc": {
+                "step_p50_seconds": 0.0012, "step_p99_seconds": 0.0034,
+            },
+        },
+        "slo": {
+            "critical": {
+                "burn_5m": 18.2, "burn_1h": 15.0, "burn_6h": 3.1,
+                "error_budget_remaining": 0.42, "severity": 2.0,
+            },
+        },
+        "governor": {
+            "default/lora": {
+                "engaged": 1.0, "engagements_total": 2.0,
+                "throttled_steps_total": 17.0,
+            },
+        },
+    }
+    out = render_top(infos, obs, now_label="12:00:00")
+    expected = (
+        "tpushare top — 12:00:00\n"
+        "NODE    CHIP   RESIDENTS (class)                 STEP p50/p99  INTERFERENCE\n"
+        "node-a  chip0  default/lora(BE) default/svc(LC)  1.2ms/3.4ms   2.10x default/svc FLAGGED\n"
+        "node-a  chip1  -                                 -             -\n"
+        "node-a  chip2  -                                 -             -\n"
+        "node-a  chip3  -                                 -             -\n"
+        "SLO BURN\n"
+        "  critical     5m=18.20 1h=15.00 6h=3.10 budget=42.0% [page]\n"
+        "GOVERNOR\n"
+        "  default/lora         ENGAGED engagements=2 throttled=17\n"
+    )
+    assert out == expected, "\n" + out
+
+
+def test_cli_top_end_to_end(api, capsys, monkeypatch):
+    api.nodes["node-a"] = _interference_node()
+    for pod in _class_pods():
+        api.add_pod(pod)
+    monkeypatch.setattr(inspect_cli, "_client", lambda: ApiServerClient(api.url))
+    rc = inspect_cli.main(["top", "--iterations", "1"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "tpushare top" in out
+    assert "default/lora(BE) default/svc(LC)" in out
+    assert "2.10x default/svc FLAGGED" in out

@@ -1,0 +1,958 @@
+"""Interference observability plane: workload classes, step profiler,
+SLO error budgets, best-effort governor, and the co-residency detector.
+
+Covers the class plumbing (pods -> indexes -> env), the measurement path
+(StepProfiler ring + histogram export), the alerting path (SloBudget
+multi-window burn rates + page hook), the reaction path (StepGovernor
+token bucket + hysteresis), and the attribution path
+(InterferenceDetector baselines/ratios/annotation + InterferenceLoop).
+The end-to-end contention scenario with real engines is gated by
+``make bench-interference-smoke`` (tests/test_bench_interference_smoke).
+"""
+
+import json
+import logging
+
+import pytest
+
+from gpushare_device_plugin_tpu import const
+from gpushare_device_plugin_tpu.allocator.env import (
+    build_gang_allocation,
+    build_mem_allocation,
+)
+from gpushare_device_plugin_tpu.cluster import pods as P
+from gpushare_device_plugin_tpu.cluster.indexes import WorkloadClassIndex
+from gpushare_device_plugin_tpu.cluster.interference import (
+    InterferenceDetector,
+    InterferenceLoop,
+    interference_from_node,
+    residency_from_pods,
+)
+from gpushare_device_plugin_tpu.cluster.usage import NodeChipUsage
+from gpushare_device_plugin_tpu.discovery.base import TpuChip
+from gpushare_device_plugin_tpu.extender.index import ClusterUsageIndex
+from gpushare_device_plugin_tpu.parallel.podenv import PodTpuEnv
+from gpushare_device_plugin_tpu.serving.governor import StepGovernor
+from gpushare_device_plugin_tpu.serving.profiler import (
+    P50_GAUGE,
+    P99_GAUGE,
+    STEP_METRIC,
+    StepProfiler,
+)
+from gpushare_device_plugin_tpu.utils.flightrec import FlightRecorder
+from gpushare_device_plugin_tpu.utils.metrics import MetricsRegistry
+from gpushare_device_plugin_tpu.utils.slo import (
+    SEVERITY_PAGE,
+    SEVERITY_WARN,
+    SloBudget,
+    SloObjective,
+)
+from gpushare_device_plugin_tpu.utils.tracing import TraceStore, Tracer
+
+from k8s_fixtures import assigned_running_pod, make_pod
+
+LC = const.WORKLOAD_LATENCY_CRITICAL
+BE = const.WORKLOAD_BEST_EFFORT
+
+
+# --------------------------------------------------------------------------
+# workload classes: pod helper, indexes, env plumbing
+# --------------------------------------------------------------------------
+
+
+def test_workload_class_normalization():
+    assert P.workload_class(make_pod("p", 4)) == LC
+    pod = make_pod("p", 4, annotations={const.ANN_WORKLOAD_CLASS: BE})
+    assert P.workload_class(pod) == BE
+    assert P.is_best_effort(pod)
+    garbled = make_pod(
+        "p", 4, annotations={const.ANN_WORKLOAD_CLASS: "turbo-mode"}
+    )
+    assert P.workload_class(garbled) == LC  # protect by default
+    padded = make_pod(
+        "p", 4, annotations={const.ANN_WORKLOAD_CLASS: f"  {BE}  "}
+    )
+    assert P.workload_class(padded) == BE
+
+
+def test_node_chip_usage_residency_incremental():
+    usage = NodeChipUsage()
+    crit = assigned_running_pod("svc", 8, chip_idx=0)
+    beff = assigned_running_pod(
+        "lora", 4, chip_idx=0, annotations={const.ANN_WORKLOAD_CLASS: BE}
+    )
+    other = assigned_running_pod("solo", 4, chip_idx=1)
+    usage.rebuild([crit, beff, other])
+    res = usage.residency()
+    assert res[0] == {"default/svc": LC, "default/lora": BE}
+    assert res[1] == {"default/solo": LC}
+    # removal keeps the survivor
+    usage.on_change(beff, None)
+    res = usage.residency()
+    assert res[0] == {"default/svc": LC}
+    usage.on_change(crit, None)
+    assert 0 not in usage.residency()
+
+
+def test_node_chip_usage_residency_gang_spreads():
+    gang = assigned_running_pod(
+        "gang", 8, chip_idx=-1,
+        annotations={
+            const.ENV_GANG_CHIPS: "1,2", const.ENV_GANG_SHAPE: "2x1x1",
+            const.ANN_WORKLOAD_CLASS: BE,
+        },
+    )
+    del gang["metadata"]["annotations"][const.ENV_MEM_IDX]
+    usage = NodeChipUsage()
+    usage.rebuild([gang])
+    res = usage.residency()
+    assert res[1] == {"default/gang": BE}
+    assert res[2] == {"default/gang": BE}
+
+
+def test_workload_class_index_buckets():
+    idx = WorkloadClassIndex()
+    crit = assigned_running_pod("svc", 8, chip_idx=0)
+    beff = assigned_running_pod(
+        "lora", 4, chip_idx=1, annotations={const.ANN_WORKLOAD_CLASS: BE}
+    )
+    done = assigned_running_pod(
+        "done", 4, chip_idx=2, annotations={const.ANN_WORKLOAD_CLASS: BE}
+    )
+    done["status"]["phase"] = "Succeeded"
+    unlabeled = make_pod("plain", 4)
+    idx.rebuild([crit, beff, done, unlabeled])
+    assert [P.name(p) for p in idx.pods(LC)] == ["svc"]
+    assert [P.name(p) for p in idx.pods(BE)] == ["lora"]
+    idx.on_change(beff, None)
+    assert idx.pods(BE) == []
+
+
+def test_cluster_usage_index_chip_classes():
+    idx = ClusterUsageIndex()
+    crit = assigned_running_pod("svc", 8, chip_idx=0, node="n1")
+    beff = assigned_running_pod(
+        "lora", 4, chip_idx=0, node="n1",
+        annotations={const.ANN_WORKLOAD_CLASS: BE},
+    )
+    idx.rebuild([crit, beff])
+    assert idx.chip_classes("n1") == {0: {LC: 1, BE: 1}}
+    idx.on_change(beff, None)
+    assert idx.chip_classes("n1") == {0: {LC: 1}}
+    idx.on_change(crit, None)
+    assert idx.chip_classes("n1") == {}
+
+
+def test_residency_from_pods_matches_index():
+    pods = [
+        assigned_running_pod("svc", 8, chip_idx=0),
+        assigned_running_pod(
+            "lora", 4, chip_idx=0, annotations={const.ANN_WORKLOAD_CLASS: BE}
+        ),
+        make_pod("pending", 4),  # unassigned: not resident
+    ]
+    assert residency_from_pods(pods) == {
+        0: {"default/svc": LC, "default/lora": BE}
+    }
+
+
+def test_env_builders_inject_workload_class():
+    chip = TpuChip(id="chip-0", index=0, device_path="", hbm_bytes=16 << 30)
+    alloc = build_mem_allocation(
+        chip=chip, chip_total_units=16, pod_units=4, container_units=4,
+        workload_class=BE,
+    )
+    assert alloc.envs[const.ENV_WORKLOAD_CLASS] == BE
+    none = build_mem_allocation(
+        chip=chip, chip_total_units=16, pod_units=4, container_units=4,
+    )
+    assert const.ENV_WORKLOAD_CLASS not in none.envs
+    chip1 = TpuChip(id="chip-1", index=1, device_path="", hbm_bytes=16 << 30)
+    gang = build_gang_allocation(
+        chips=[chip, chip1],
+        shape=(2, 1, 1), per_chip_units=2, chip_total_units=16,
+        pod_units=4, container_units=4, workload_class=LC,
+    )
+    assert gang.envs[const.ENV_WORKLOAD_CLASS] == LC
+
+
+def test_pod_env_reads_workload_class():
+    env = {const.ENV_WORKLOAD_CLASS: BE}
+    pod = PodTpuEnv.from_env(env)
+    assert pod.workload_class == BE
+    assert pod.is_best_effort
+    assert PodTpuEnv.from_env({}).workload_class == LC
+    assert PodTpuEnv.from_env(
+        {const.ENV_WORKLOAD_CLASS: "garbage"}
+    ).workload_class == LC
+
+
+# --------------------------------------------------------------------------
+# step profiler
+# --------------------------------------------------------------------------
+
+
+def test_profiler_rolling_quantiles_and_ring_bound():
+    prof = StepProfiler(capacity=8)
+    assert prof.p99() != prof.p99()  # nan while empty
+    for ms in range(1, 7):
+        prof.record(ms / 1000.0)
+    assert prof.count == 6
+    assert prof.p50() == pytest.approx(0.003)
+    assert prof.p99() == pytest.approx(0.006)
+    # overflow: only the newest `capacity` samples answer
+    for _ in range(10):
+        prof.record(0.010)
+    assert prof.count == 16
+    assert len(prof.window()) == 8
+    assert prof.p50() == pytest.approx(0.010)
+    prof.reset()
+    assert prof.count == 0 and prof.window() == []
+
+
+def test_profiler_flush_exports_histogram_and_gauges():
+    reg = MetricsRegistry()
+    prof = StepProfiler(capacity=64)
+    for _ in range(10):
+        prof.record(0.002)
+    exported = prof.flush(reg, pod="ns/svc")
+    assert exported == 10
+    count, total = reg.histogram_stats(STEP_METRIC, pod="ns/svc")
+    assert count == 10
+    assert total == pytest.approx(0.020)
+    assert reg.gauge_value(P50_GAUGE, pod="ns/svc") == pytest.approx(0.002)
+    assert reg.gauge_value(P99_GAUGE, pod="ns/svc") == pytest.approx(0.002)
+    # second flush exports only the delta
+    prof.record(0.004)
+    assert prof.flush(reg, pod="ns/svc") == 1
+    count, _ = reg.histogram_stats(STEP_METRIC, pod="ns/svc")
+    assert count == 11
+
+
+def test_profiler_flush_skips_samples_lost_to_the_ring():
+    reg = MetricsRegistry()
+    prof = StepProfiler(capacity=4)
+    for _ in range(10):
+        prof.record(0.001)
+    # 6 of the 10 fell off the 4-slot ring between flushes
+    assert prof.flush(reg, pod="ns/x") == 4
+    count, _ = reg.histogram_stats(STEP_METRIC, pod="ns/x")
+    assert count == 4
+
+
+def test_profiler_flush_without_pod_label_exports_nothing():
+    """Every tpushare_engine_* series carries the pod label; an
+    unlabeled flush would merge label-less engines into one shared
+    series the detector cannot attribute — so it exports nothing (the
+    rolling quantiles stay available programmatically)."""
+    reg = MetricsRegistry()
+    prof = StepProfiler(capacity=8)
+    prof.record(0.002)
+    assert prof.flush(reg) == 0
+    count, _ = reg.histogram_stats(STEP_METRIC)
+    assert count == 0
+    assert reg.gauge_value(P99_GAUGE) is None
+    assert prof.p99() == pytest.approx(0.002)  # ring unaffected
+    # the samples were consumed: a later labeled flush exports only
+    # what arrived after
+    prof.record(0.004)
+    assert prof.flush(reg, pod="ns/y") == 1
+
+
+# --------------------------------------------------------------------------
+# SLO error budgets
+# --------------------------------------------------------------------------
+
+
+def _budget(goal=0.99, on_page=None, t=None):
+    clock = (lambda: t[0]) if t is not None else None
+    kwargs = {} if clock is None else {"clock": clock}
+    return SloBudget(
+        {"critical": SloObjective(tier="critical", goal=goal)},
+        on_page=on_page, **kwargs,
+    )
+
+
+def test_slo_budget_clean_traffic_no_severity():
+    t = [0.0]
+    b = _budget(t=t)
+    for _ in range(100):
+        b.record("critical", True)
+    v = b.evaluate()["critical"]
+    assert v.severity is None
+    assert v.burn_5m == 0.0
+    assert v.budget_remaining == 1.0
+
+
+def test_slo_budget_page_and_hook_once_per_episode():
+    t = [0.0]
+    fired = []
+    b = _budget(on_page=lambda tier, v: fired.append(tier), t=t)
+    # 20% misses over a 1% budget: burn 20 in every window -> page
+    for i in range(100):
+        b.record("critical", i % 5 != 0)
+    v = b.evaluate()["critical"]
+    assert v.severity == SEVERITY_PAGE
+    assert v.burn_5m == pytest.approx(20.0)
+    assert v.budget_remaining == 0.0
+    assert fired == ["critical"]
+    b.evaluate()
+    assert fired == ["critical"]  # still paging: no re-fire
+    # recovery, then a second episode re-fires the hook
+    t[0] += 400.0  # past the 5m window: fast burn clears
+    for _ in range(50):
+        b.record("critical", True)
+    assert b.evaluate()["critical"].severity != SEVERITY_PAGE
+    t[0] += 30000.0  # everything expires
+    for i in range(100):
+        b.record("critical", i % 5 != 0)
+    assert b.evaluate()["critical"].severity == SEVERITY_PAGE
+    assert fired == ["critical", "critical"]
+
+
+def test_slo_budget_warn_between_thresholds():
+    t = [0.0]
+    # exactly 8% misses over a 1% budget: burn 8 — above warn (6),
+    # below page (14.4)
+    b = _budget(t=t)
+    for i in range(100):
+        b.record("critical", i >= 8)
+    v = b.evaluate()["critical"]
+    assert v.burn_6h == pytest.approx(8.0)
+    assert v.severity == SEVERITY_WARN
+
+
+def test_slo_budget_windows_expire():
+    t = [0.0]
+    b = _budget(t=t)
+    for _ in range(50):
+        b.record("critical", False)
+    assert b.evaluate()["critical"].severity == SEVERITY_PAGE
+    t[0] = 400.0  # bads leave the 5m window -> page condition breaks
+    v = b.evaluate()["critical"]
+    assert v.burn_5m == 0.0
+    assert v.severity == SEVERITY_WARN  # 1h + 6h still burning
+    t[0] = 4000.0  # past 1h: warn needs BOTH 6h and 1h
+    assert b.evaluate()["critical"].severity is None
+    t[0] = 30000.0  # past 6h: everything forgotten
+    v = b.evaluate()["critical"]
+    assert v.requests_6h == 0 and v.budget_remaining == 1.0
+
+
+def test_slo_budget_publish_gauges():
+    t = [0.0]
+    b = _budget(t=t)
+    reg = MetricsRegistry()
+    for _ in range(10):
+        b.record("critical", False)
+    b.publish(reg)
+    assert reg.gauge_value(
+        "tpushare_slo_burn_rate", tier="critical", window="5m"
+    ) == pytest.approx(100.0)
+    assert reg.gauge_value(
+        "tpushare_slo_severity", tier="critical"
+    ) == 2.0
+    assert reg.gauge_value(
+        "tpushare_slo_error_budget_remaining", tier="critical"
+    ) == 0.0
+
+
+def test_slo_objective_rejects_degenerate_goal():
+    with pytest.raises(ValueError):
+        SloObjective(tier="t", goal=1.0)
+    with pytest.raises(ValueError):
+        SloBudget(bucket_s=0.0)
+
+
+# --------------------------------------------------------------------------
+# best-effort governor
+# --------------------------------------------------------------------------
+
+
+class _FakeTime:
+    def __init__(self):
+        self.t = 0.0
+        self.sleeps = []
+
+    def clock(self):
+        return self.t
+
+    def sleep(self, s):
+        self.sleeps.append(s)
+        self.t += s
+
+
+def test_governor_engages_on_page_and_throttles():
+    ft = _FakeTime()
+    severity = ["page"]
+    reg = MetricsRegistry()
+    gov = StepGovernor(
+        lambda: severity[0], throttled_steps_per_s=10.0, burst=1.0,
+        poll_interval_steps=1, release_after=2, pod="ns/be",
+        registry=reg, clock=ft.clock, sleep=ft.sleep,
+    )
+    # the engaging step already pays: engage starts the bucket EMPTY
+    # (the victim is burning right now), so even the first dispatch
+    # waits a full refill period
+    assert gov.before_step() == pytest.approx(0.1)
+    assert gov.engaged and gov.engagements == 1
+    slept = gov.before_step()
+    assert slept == pytest.approx(0.1)
+    assert gov.throttled_steps == 2
+    assert reg.gauge_value("tpushare_governor_engaged", pod="ns/be") == 1.0
+    assert reg.counter_value(
+        "tpushare_governor_engagements_total", pod="ns/be"
+    ) == 1.0
+    assert reg.counter_value(
+        "tpushare_governor_throttled_steps_total", pod="ns/be"
+    ) == 2.0
+
+
+def test_governor_sustained_rate_converges():
+    # sustained throttled dispatch rate converges to
+    # throttled_steps_per_s (one refill period per step)
+    ft = _FakeTime()
+    gov = StepGovernor(
+        lambda: "page", throttled_steps_per_s=2.0, burst=1.0,
+        poll_interval_steps=1, release_after=10,
+        registry=MetricsRegistry(), clock=ft.clock, sleep=ft.sleep,
+    )
+    for _ in range(10):
+        gov.before_step()
+    # 10 steps at 2 steps/s ~= 4.5-5s of imposed delay
+    assert 4.0 <= ft.t <= 5.5
+
+
+def test_governor_hysteretic_release():
+    ft = _FakeTime()
+    severity = ["page"]
+    gov = StepGovernor(
+        lambda: severity[0], throttled_steps_per_s=100.0,
+        poll_interval_steps=1, release_after=3,
+        registry=MetricsRegistry(), clock=ft.clock, sleep=ft.sleep,
+    )
+    gov.before_step()
+    assert gov.engaged
+    severity[0] = None
+    gov.poll()
+    gov.poll()
+    assert gov.engaged  # two clean polls: not yet
+    gov.poll()
+    assert not gov.engaged  # third clean poll releases
+    # a fresh page re-engages (second engagement counted)
+    severity[0] = "page"
+    gov.poll()
+    assert gov.engaged and gov.engagements == 2
+    # flapping resets the clean streak
+    severity[0] = None
+    gov.poll()
+    severity[0] = "page"
+    gov.poll()
+    severity[0] = None
+    gov.poll()
+    gov.poll()
+    assert gov.engaged  # streak broken at 2; needs 3 consecutive
+    gov.poll()
+    assert not gov.engaged
+
+
+def test_governor_warn_does_not_engage_by_default():
+    ft = _FakeTime()
+    gov = StepGovernor(
+        lambda: "warn", poll_interval_steps=1,
+        registry=MetricsRegistry(), clock=ft.clock, sleep=ft.sleep,
+    )
+    for _ in range(5):
+        assert gov.before_step() == 0.0
+    assert not gov.engaged
+    eager = StepGovernor(
+        lambda: "warn", poll_interval_steps=1, engage_on="warn",
+        registry=MetricsRegistry(), clock=ft.clock, sleep=ft.sleep,
+    )
+    eager.before_step()
+    assert eager.engaged
+
+
+def test_governor_released_fast_path_costs_nothing():
+    ft = _FakeTime()
+    polls = [0]
+
+    def burn():
+        polls[0] += 1
+        return None
+
+    gov = StepGovernor(
+        burn, poll_interval_steps=4,
+        registry=MetricsRegistry(), clock=ft.clock, sleep=ft.sleep,
+    )
+    for _ in range(16):
+        assert gov.before_step() == 0.0
+    assert polls[0] == 4  # one poll per interval, not per step
+    assert ft.sleeps == []
+
+
+# --------------------------------------------------------------------------
+# interference detector + loop
+# --------------------------------------------------------------------------
+
+
+def test_detector_baseline_then_ratio_and_flag():
+    reg = MetricsRegistry()
+    det = InterferenceDetector(threshold=1.25, registry=reg)
+    # solo passes build the baseline (the cooldown needs two in a row
+    # before it trusts a seed — the rolling p99 window lags residency)
+    assert det.observe({0: {"ns/svc": LC}}, {"ns/svc": 0.002}) == []
+    assert det.baseline("ns/svc") is None  # first solo pass: cooling down
+    det.observe({0: {"ns/svc": LC}}, {"ns/svc": 0.002})
+    assert det.baseline("ns/svc") == pytest.approx(0.002)
+    # co-tenant lands; p99 doubles
+    reports = det.observe(
+        {0: {"ns/svc": LC, "ns/lora": BE}},
+        {"ns/svc": 0.004, "ns/lora": 0.050},
+    )
+    assert len(reports) == 1
+    r = reports[0]
+    assert r.victim == "ns/svc" and r.aggressors == ("ns/lora",)
+    assert r.ratio == pytest.approx(2.0)
+    assert r.flagged
+    assert reg.gauge_value(
+        "tpushare_interference_ratio",
+        chip="0", victim="ns/svc", aggressor="ns/lora",
+    ) == pytest.approx(2.0)
+    # co-residency ends: the pair's gauge zeroes, baseline survives
+    det.observe({0: {"ns/svc": LC}}, {"ns/svc": 0.002})
+    assert reg.gauge_value(
+        "tpushare_interference_ratio",
+        chip="0", victim="ns/svc", aggressor="ns/lora",
+    ) == 0.0
+    assert det.baseline("ns/svc") is not None
+
+
+def test_detector_best_effort_victim_not_reported():
+    det = InterferenceDetector(registry=MetricsRegistry())
+    det.observe({0: {"ns/lora": BE}}, {"ns/lora": 0.002})
+    reports = det.observe(
+        {0: {"ns/lora": BE, "ns/other": BE}},
+        {"ns/lora": 0.010, "ns/other": 0.010},
+    )
+    assert reports == []  # only latency-critical pods are victims
+
+
+def test_detector_gang_victim_solo_only_when_every_chip_exclusive():
+    det = InterferenceDetector(registry=MetricsRegistry())
+    # pod spans chips 0+1; chip 1 shared -> NOT solo, no baseline
+    det.observe(
+        {0: {"ns/gang": LC}, 1: {"ns/gang": LC, "ns/x": BE}},
+        {"ns/gang": 0.002},
+    )
+    assert det.baseline("ns/gang") is None
+    det.observe(
+        {0: {"ns/gang": LC}, 1: {"ns/gang": LC}}, {"ns/gang": 0.002}
+    )
+    det.observe(
+        {0: {"ns/gang": LC}, 1: {"ns/gang": LC}}, {"ns/gang": 0.002}
+    )
+    assert det.baseline("ns/gang") == pytest.approx(0.002)
+
+
+def test_detector_bare_pod_name_fallback():
+    det = InterferenceDetector(
+        registry=MetricsRegistry(), baseline_cooldown_passes=1
+    )
+    det.observe({0: {"ns/svc": LC}}, {"svc": 0.002})  # bare-name gauge
+    assert det.baseline("ns/svc") == pytest.approx(0.002)
+
+
+def test_interference_annotation_roundtrip_and_garbling():
+    det = InterferenceDetector(
+        registry=MetricsRegistry(), baseline_cooldown_passes=1
+    )
+    det.observe({0: {"ns/svc": LC}}, {"ns/svc": 0.002})
+    det.observe(
+        {0: {"ns/svc": LC, "ns/lora": BE}}, {"ns/svc": 0.006}
+    )
+    doc = det.annotation_doc(now_unix=123.0)
+    node = {
+        "metadata": {
+            "annotations": {const.ANN_INTERFERENCE: json.dumps(doc)}
+        }
+    }
+    parsed = interference_from_node(node)
+    assert parsed["chips"]["0"]["victim"] == "ns/svc"
+    assert parsed["chips"]["0"]["ratio"] == pytest.approx(3.0)
+    assert parsed["chips"]["0"]["flagged"] is True
+    assert parsed["chips"]["0"]["aggressors"] == ["ns/lora"]
+    # tolerance: absent, garbled JSON, half-garbled rows
+    assert interference_from_node(None) is None
+    assert interference_from_node({"metadata": {}}) is None
+    assert interference_from_node(
+        {"metadata": {"annotations": {const.ANN_INTERFERENCE: "not-json"}}}
+    ) is None
+    half = {"chips": {"0": {"victim": "v", "ratio": "NaNope"}}}
+    parsed = interference_from_node(
+        {"metadata": {"annotations": {
+            const.ANN_INTERFERENCE: json.dumps(half)
+        }}}
+    )
+    assert parsed["chips"]["0"]["ratio"] == 0.0
+
+
+class _FakePodSource:
+    def __init__(self, pods):
+        self._pods = pods
+
+    def labeled_pods(self):
+        return list(self._pods)
+
+
+class _FakeApi:
+    def __init__(self):
+        self.patches = []
+
+    def patch_node(self, name, patch):
+        self.patches.append((name, patch))
+        return {}
+
+
+def test_interference_loop_run_once_publishes_annotation():
+    reg = MetricsRegistry()
+    det = InterferenceDetector(threshold=1.25, registry=reg)
+    api = _FakeApi()
+    crit = assigned_running_pod("svc", 8, chip_idx=0)
+    beff = assigned_running_pod(
+        "lora", 4, chip_idx=0, annotations={const.ANN_WORKLOAD_CLASS: BE}
+    )
+    # the default signal source reads the engines' step gauges back off
+    # the registry
+    reg.gauge_set("tpushare_engine_step_p99_seconds", 0.002, pod="default/svc")
+    solo = InterferenceLoop(
+        det, api, "node-a", _FakePodSource([crit]), registry=reg
+    )
+    solo.run_once()
+    solo.run_once()  # cooldown: two consecutive solo passes seed
+    assert det.baseline("default/svc") == pytest.approx(0.002)
+    reg.gauge_set("tpushare_engine_step_p99_seconds", 0.008, pod="default/svc")
+    loop = InterferenceLoop(
+        det, api, "node-a", _FakePodSource([crit, beff]), registry=reg
+    )
+    reports = loop.run_once()
+    assert len(reports) == 1 and reports[0].flagged
+    name, patch = api.patches[-1]
+    assert name == "node-a"
+    doc = json.loads(
+        patch["metadata"]["annotations"][const.ANN_INTERFERENCE]
+    )
+    assert doc["chips"]["0"]["victim"] == "default/svc"
+    assert doc["chips"]["0"]["ratio"] == pytest.approx(4.0)
+
+
+def test_interference_loop_publish_failure_is_swallowed():
+    class _SickApi:
+        def patch_node(self, name, patch):
+            raise OSError("apiserver down")
+
+    det = InterferenceDetector(registry=MetricsRegistry())
+    loop = InterferenceLoop(
+        det, _SickApi(), "node-a", _FakePodSource([]),
+        registry=MetricsRegistry(),
+    )
+    loop.run_once()  # must not raise: status is observability
+
+
+# --------------------------------------------------------------------------
+# per-tier trace sampling + flight-recorder rotation (satellites)
+# --------------------------------------------------------------------------
+
+
+def test_tracer_per_tier_sampling_overrides():
+    tracer = Tracer(store=TraceStore())
+    tracer.configure(tier_ratios={"best_effort": 0.0})
+    assert tracer.record_span("serve.request", 0, 1, tier="best_effort") is None
+    assert tracer.record_span("serve.request", 0, 1, tier="critical") is not None
+    assert tracer.record_span("serve.request", 0, 1) is not None  # no tier
+    assert tracer.tier_sample_ratio("best_effort") == 0.0
+    assert tracer.tier_sample_ratio("critical") == 1.0
+    # clearing restores the default-only behavior
+    tracer.configure(tier_ratios={})
+    assert tracer.record_span("serve.request", 0, 1, tier="best_effort") is not None
+    # and the default ratio still governs everything
+    tracer.configure(sample_ratio=0.0, tier_ratios={"critical": 1.0})
+    assert tracer.record_span("x", 0, 1, tier="best_effort") is None
+    assert tracer.record_span("x", 0, 1, tier="critical") is not None
+
+
+def test_flightrec_rotation_keeps_newest(tmp_path):
+    logger = logging.getLogger("flightrec-rotation-test")
+    fr = FlightRecorder(store=TraceStore(), max_logs=8)
+    fr.install(str(tmp_path), logger=logger, max_dumps=3)
+    try:
+        paths = [fr.dump(f"test-{i}") for i in range(5)]
+    finally:
+        fr.uninstall(logger=logger)
+    assert all(paths)
+    left = sorted(p.name for p in tmp_path.glob("tpushare-flightrec-*.json"))
+    assert len(left) == 3
+    # the newest three dumps survived (filenames carry the reason slug)
+    for i in (2, 3, 4):
+        assert any(f"test-{i}" in n for n in left)
+
+
+def test_flightrec_rotation_never_deletes_the_fresh_dump(tmp_path):
+    logger = logging.getLogger("flightrec-rotation-test2")
+    fr = FlightRecorder(store=TraceStore(), max_logs=8)
+    fr.install(str(tmp_path), logger=logger, max_dumps=1)
+    try:
+        fr.dump("first")
+        newest = fr.dump("second")
+    finally:
+        fr.uninstall(logger=logger)
+    left = list(tmp_path.glob("tpushare-flightrec-*.json"))
+    assert [str(p) for p in left] == [newest]
+
+
+def test_flightrec_rotation_disabled_with_zero(tmp_path):
+    logger = logging.getLogger("flightrec-rotation-test3")
+    fr = FlightRecorder(store=TraceStore(), max_logs=8)
+    fr.install(str(tmp_path), logger=logger, max_dumps=0)
+    try:
+        for i in range(4):
+            fr.dump(f"keepall-{i}")
+    finally:
+        fr.uninstall(logger=logger)
+    assert len(list(tmp_path.glob("tpushare-flightrec-*.json"))) == 4
+
+
+# --------------------------------------------------------------------------
+# review-hardening: baseline cooldown, undeclared tiers, severity cache
+# --------------------------------------------------------------------------
+
+
+def test_detector_cooldown_rejects_post_episode_inflated_baseline():
+    """The exported step p99 is a ROLLING window that lags residency: the
+    first solo pass after a co-residency episode still carries the
+    contended tail, and absorbing it would inflate the baseline and mask
+    the next episode."""
+    det = InterferenceDetector(threshold=1.25, registry=MetricsRegistry())
+    det.observe({0: {"ns/svc": LC}}, {"ns/svc": 0.002})
+    det.observe({0: {"ns/svc": LC}}, {"ns/svc": 0.002})
+    assert det.baseline("ns/svc") == pytest.approx(0.002)
+    # episode: co-resident, p99 doubles
+    det.observe({0: {"ns/svc": LC, "ns/x": BE}}, {"ns/svc": 0.004})
+    # aggressor leaves; the stale gauge still reads inflated — the
+    # first solo pass must NOT raise the baseline
+    det.observe({0: {"ns/svc": LC}}, {"ns/svc": 0.004})
+    assert det.baseline("ns/svc") == pytest.approx(0.002)
+    # by the second consecutive solo pass the window has drained; an
+    # upward (genuine regime) change is absorbed again
+    det.observe({0: {"ns/svc": LC}}, {"ns/svc": 0.003})
+    assert det.baseline("ns/svc") > 0.002
+    # and a LOWER p99 is always safe to absorb, cooldown or not
+    det2 = InterferenceDetector(threshold=1.25, registry=MetricsRegistry())
+    det2.observe({0: {"ns/svc": LC}}, {"ns/svc": 0.004})
+    det2.observe({0: {"ns/svc": LC}}, {"ns/svc": 0.004})
+    det2.observe({0: {"ns/svc": LC, "ns/x": BE}}, {"ns/svc": 0.008})
+    det2.observe({0: {"ns/svc": LC}}, {"ns/svc": 0.002})  # first solo pass
+    assert det2.baseline("ns/svc") < 0.004
+
+
+def test_interference_loop_prefers_maintained_residency_index():
+    class _IndexedSource:
+        def __init__(self):
+            self.labeled_calls = 0
+
+        def chip_residency(self):
+            return {0: {"default/svc": LC, "default/lora": BE}}
+
+        def labeled_pods(self):
+            self.labeled_calls += 1
+            return []
+
+    reg = MetricsRegistry()
+    det = InterferenceDetector(
+        threshold=1.25, registry=reg, baseline_cooldown_passes=1
+    )
+    det.observe({0: {"default/svc": LC}}, {"default/svc": 0.002})
+    reg.gauge_set(
+        "tpushare_engine_step_p99_seconds", 0.008, pod="default/svc"
+    )
+    src = _IndexedSource()
+    loop = InterferenceLoop(det, _FakeApi(), "node-a", src, registry=reg)
+    reports = loop.run_once()
+    assert src.labeled_calls == 0  # the maintained index was used
+    assert len(reports) == 1 and reports[0].flagged
+
+
+def test_interference_parse_keeps_time_unix():
+    doc = {"time_unix": 1234.5, "threshold": 1.25, "chips": {}}
+    parsed = interference_from_node(
+        {"metadata": {"annotations": {
+            const.ANN_INTERFERENCE: json.dumps(doc)
+        }}}
+    )
+    assert parsed["time_unix"] == 1234.5
+    garbled = dict(doc, time_unix="yesterday")
+    parsed = interference_from_node(
+        {"metadata": {"annotations": {
+            const.ANN_INTERFERENCE: json.dumps(garbled)
+        }}}
+    )
+    assert parsed["time_unix"] == 0.0
+
+
+def test_slo_budget_drops_undeclared_tiers_when_configured():
+    t = [0.0]
+    b = SloBudget(
+        {"critical": SloObjective(tier="critical", goal=0.95)},
+        clock=lambda: t[0],
+    )
+    for _ in range(50):
+        b.record("best_effort", False)  # never declared
+    v = b.evaluate()
+    assert "best_effort" not in v  # no invented objective, no paging
+    assert b.severity("best_effort") is None
+    # the zero-config convenience mode still tracks every tier it sees
+    auto = SloBudget(clock=lambda: t[0])
+    auto.record("anything", False)
+    assert auto.evaluate()["anything"].requests_6h == 1
+
+
+def test_slo_severity_single_tier_cached_and_fresh():
+    t = [0.0]
+    fired = []
+    b = SloBudget(
+        {"critical": SloObjective(tier="critical", goal=0.99)},
+        clock=lambda: t[0], on_page=lambda tier, v: fired.append(tier),
+    )
+    assert b.severity("critical") is None
+    # new records invalidate the cache immediately (same bucket)
+    for _ in range(20):
+        b.record("critical", False)
+    assert b.severity("critical") == SEVERITY_PAGE
+    # the page hook fires through the severity() path too (that is the
+    # governor's path), once per episode
+    assert fired == ["critical"]
+    assert b.severity("critical") == SEVERITY_PAGE
+    assert fired == ["critical"]
+    # bucket rollover invalidates the cache without new records
+    t[0] = 400.0  # fast window clears -> page condition breaks
+    assert b.severity("critical") == SEVERITY_WARN
+
+
+def test_detector_prunes_departed_pods_after_grace():
+    det = InterferenceDetector(
+        registry=MetricsRegistry(), baseline_cooldown_passes=1
+    )
+    det.observe({0: {"ns/svc": LC}}, {"ns/svc": 0.002})
+    assert det.baseline("ns/svc") == pytest.approx(0.002)
+    # a brief absence (informer flap) keeps the baseline ...
+    det.observe({}, {})
+    det.observe({}, {})
+    assert det.baseline("ns/svc") is not None
+    # ... but a sustained one prunes it: a recreated same-name pod (a
+    # possibly very different model) must not inherit a dead baseline
+    det.observe({}, {})
+    assert det.baseline("ns/svc") is None
+    # and reappearing within the grace resets the absence clock
+    det.observe({0: {"ns/x": LC}}, {"ns/x": 0.001})
+    det.observe({}, {})
+    det.observe({0: {"ns/x": LC}}, {"ns/x": 0.001})
+    det.observe({}, {})
+    det.observe({}, {})
+    assert det.baseline("ns/x") is not None
+
+
+def test_governor_sub_unit_burst_never_banks_a_free_dispatch():
+    """burst < 1 caps the bucket below one token: however long the
+    engaged engine idles (drained run, empty queue), the next dispatch
+    still waits — an accrued 'free' dispatch would land as a contention
+    spike the moment work resumes."""
+    ft = _FakeTime()
+    gov = StepGovernor(
+        lambda: "page", throttled_steps_per_s=2.0, burst=0.5,
+        poll_interval_steps=1, release_after=10,
+        registry=MetricsRegistry(), clock=ft.clock, sleep=ft.sleep,
+    )
+    gov.before_step()  # engages (empty bucket) and waits
+    ft.t += 100.0  # long idle: the bucket caps at 0.5 tokens
+    slept = gov.before_step()
+    assert slept == pytest.approx((1.0 - 0.5) / 2.0)
+    with pytest.raises(ValueError):
+        StepGovernor(lambda: None, burst=0.0)
+
+
+def test_step_p99s_from_urls_scrapes_live_endpoint():
+    """The daemon-side scrape source (--interference-scrape-url): engine
+    step gauges on a real /metrics endpoint reach the detector even when
+    the engines do not share the daemon's registry."""
+    from gpushare_device_plugin_tpu.cluster.interference import (
+        step_p99s_from_urls,
+    )
+    from gpushare_device_plugin_tpu.serving.profiler import StepProfiler
+    from gpushare_device_plugin_tpu.utils.metrics import MetricsServer
+
+    reg = MetricsRegistry()
+    prof = StepProfiler()
+    prof.record(0.0042)
+    prof.flush(reg, pod="default/svc")
+    srv = MetricsServer(reg, host="127.0.0.1", port=0).start()
+    try:
+        url = f"http://127.0.0.1:{srv.port}"
+        out = step_p99s_from_urls([url])
+        assert out == {"default/svc": pytest.approx(0.0042)}
+        # unreachable endpoints are skipped, partial beats none
+        out = step_p99s_from_urls(["http://127.0.0.1:1/", url])
+        assert out == {"default/svc": pytest.approx(0.0042)}
+    finally:
+        srv.stop()
+
+
+def test_interference_loop_scrape_urls_beat_registry():
+    from gpushare_device_plugin_tpu.serving.profiler import StepProfiler
+    from gpushare_device_plugin_tpu.utils.metrics import MetricsServer
+
+    engine_reg = MetricsRegistry()  # the "remote pod's" registry
+    prof = StepProfiler()
+    prof.record(0.008)
+    prof.flush(engine_reg, pod="default/svc")
+    srv = MetricsServer(engine_reg, host="127.0.0.1", port=0).start()
+    daemon_reg = MetricsRegistry()  # the daemon's own (empty) registry
+    det = InterferenceDetector(
+        registry=daemon_reg, baseline_cooldown_passes=1
+    )
+    crit = assigned_running_pod("svc", 8, chip_idx=0)
+    try:
+        loop = InterferenceLoop(
+            det, _FakeApi(), "node-a", _FakePodSource([crit]),
+            registry=daemon_reg,
+            scrape_urls=[f"http://127.0.0.1:{srv.port}"],
+        )
+        loop.run_once()
+        assert det.baseline("default/svc") == pytest.approx(0.008)
+    finally:
+        srv.stop()
+
+
+def test_detector_signal_loss_keeps_last_ratio_until_pair_departs():
+    """A co-resident pair whose step-p99 signal goes missing (scrape
+    miss, engine restart) keeps its last exported ratio — zeroing is
+    reserved for pairs actually gone from residency ('resolved')."""
+    reg = MetricsRegistry()
+    det = InterferenceDetector(
+        threshold=1.25, registry=reg, baseline_cooldown_passes=1
+    )
+    det.observe({0: {"ns/svc": LC}}, {"ns/svc": 0.002})
+    det.observe(
+        {0: {"ns/svc": LC, "ns/lora": BE}}, {"ns/svc": 0.004}
+    )
+    pair = dict(chip="0", victim="ns/svc", aggressor="ns/lora")
+    assert reg.gauge_value("tpushare_interference_ratio", **pair) == (
+        pytest.approx(2.0)
+    )
+    # same residency, signal lost: the gauge must NOT flap to 0
+    det.observe({0: {"ns/svc": LC, "ns/lora": BE}}, {})
+    assert reg.gauge_value("tpushare_interference_ratio", **pair) == (
+        pytest.approx(2.0)
+    )
+    # pair actually departs: NOW it zeroes ("resolved")
+    det.observe({0: {"ns/svc": LC}}, {"ns/svc": 0.002})
+    assert reg.gauge_value("tpushare_interference_ratio", **pair) == 0.0

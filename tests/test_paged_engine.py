@@ -758,3 +758,79 @@ def test_wait_drained_timeout_disarms_the_dead_drain(setup):
         "abandoned drain swallowed the next run"
     )
     assert eng.drain_snapshot() is None
+
+
+def test_slo_budget_fed_at_retire(setup):
+    """Each retired request's SLO verdict (tick-clock targets) lands in
+    the attached error budget under its tier — the signal the burn-rate
+    alerts and the governor consume (utils/slo.py)."""
+    from gpushare_device_plugin_tpu.utils.slo import SloBudget, SloObjective
+
+    cfg, params = setup
+    t = [0.0]
+    budget = SloBudget(
+        {
+            TIER_CRITICAL: SloObjective(tier=TIER_CRITICAL, goal=0.99),
+            TIER_BEST_EFFORT: SloObjective(tier=TIER_BEST_EFFORT, goal=0.99),
+        },
+        clock=lambda: t[0],
+    )
+    eng = PagedSlotEngine(
+        params, cfg, slots=2, max_len=32, total_pages=16, page_size=4,
+        prefill_chunk=4, eos_id=EOS, slo_budget=budget,
+    )
+    eng.warmup()
+    reqs = [
+        # generous targets: meets
+        Request(rid=0, prompt=(5, 6, 7), max_new=4, arrival=0.0,
+                tier=TIER_CRITICAL, slo_ttft_ticks=1000.0,
+                slo_tpot_ticks=1000.0),
+        # impossible TTFT: misses
+        Request(rid=1, prompt=(8, 9), max_new=4, arrival=0.0,
+                tier=TIER_BEST_EFFORT, slo_ttft_ticks=0.0),
+        # no targets: not recorded
+        Request(rid=2, prompt=(10, 11), max_new=3, arrival=0.0,
+                tier=TIER_CRITICAL),
+    ]
+    eng.run(reqs)
+    v = budget.evaluate()
+    assert v[TIER_CRITICAL].requests_6h == 1  # rid 2 had no targets
+    assert v[TIER_CRITICAL].burn_6h == 0.0
+    assert v[TIER_BEST_EFFORT].requests_6h == 1
+    assert v[TIER_BEST_EFFORT].burn_6h == pytest.approx(100.0)
+
+
+def test_paged_governor_bit_identity_and_drain(setup):
+    """A governed paged engine under page severity: tokens bit-identical,
+    zero retraces, and a drain mid-throttle still captures cleanly."""
+    from gpushare_device_plugin_tpu.serving import StepGovernor
+    from gpushare_device_plugin_tpu.utils.metrics import MetricsRegistry
+
+    cfg, params = setup
+    reqs = poisson_trace(
+        6, seed=5, rate=1.0, vocab=cfg.vocab, prompt_lens=(2, 6),
+        max_new=(3, 6),
+    )
+    plain = PagedSlotEngine(
+        params, cfg, slots=2, max_len=32, total_pages=16, page_size=4,
+        prefill_chunk=4, eos_id=EOS,
+    )
+    plain.warmup()
+    reference = {r.rid: r.tokens for r in plain.run(reqs).results}
+
+    t = [0.0]
+    gov = StepGovernor(
+        lambda: "page", throttled_steps_per_s=100.0, poll_interval_steps=1,
+        registry=MetricsRegistry(), clock=lambda: t[0],
+        sleep=lambda s: t.__setitem__(0, t[0] + s),
+    )
+    governed = PagedSlotEngine(
+        params, cfg, slots=2, max_len=32, total_pages=16, page_size=4,
+        prefill_chunk=4, eos_id=EOS, governor=gov,
+    )
+    governed.warmup()
+    warm = dict(governed.trace_counts)
+    stats = governed.run(reqs)
+    assert {r.rid: r.tokens for r in stats.results} == reference
+    assert sum(governed.trace_counts[k] - warm[k] for k in warm) == 0
+    assert gov.engaged and gov.throttled_steps > 0

@@ -531,3 +531,77 @@ def test_engine_emits_request_spans(setup):
     finally:
         tracing.TRACER.configure(sample_ratio=1.0)
         tracing.STORE.clear()
+
+
+def test_step_profiler_records_and_publishes(setup):
+    """Every pool-wide decode dispatch lands one sample in the step
+    profiler; the run's end flushes the tpushare_engine_step_seconds
+    histogram + rolling p50/p99 gauges under the engine's pod label
+    (interference observability plane, docs/observability.md)."""
+    from gpushare_device_plugin_tpu.serving.profiler import (
+        P99_GAUGE,
+        STEP_METRIC,
+    )
+    from gpushare_device_plugin_tpu.utils.metrics import REGISTRY
+
+    cfg, params = setup
+    eng = SlotEngine(
+        params, cfg, slots=2, max_len=32, prefill_chunk=4, eos_id=EOS,
+        metrics_pod="t/profiled",
+    )
+    eng.warmup()
+    # warmup's compile-time steps must not leak into the window or the
+    # exported histogram
+    assert eng.profiler.count == 0
+    before, _ = REGISTRY.histogram_stats(STEP_METRIC, pod="t/profiled")
+    assert before == 0
+    reqs = [
+        Request(rid=0, prompt=(5, 6, 7), max_new=6, arrival=0.0),
+        Request(rid=1, prompt=(8, 9), max_new=5, arrival=0.0),
+    ]
+    stats = eng.run(reqs)
+    assert_parity(reqs, stats, params, cfg)
+    assert eng.profiler.count > 0
+    p99 = eng.profiler.p99()
+    assert p99 > 0
+    count, _ = REGISTRY.histogram_stats(STEP_METRIC, pod="t/profiled")
+    assert count == eng.profiler.count
+    assert REGISTRY.gauge_value(P99_GAUGE, pod="t/profiled") == p99
+
+
+def test_governor_delays_but_never_alters_tokens(setup):
+    """A governed engine under page severity emits BIT-IDENTICAL tokens
+    with zero retraces — the governor may only insert waits (fake clock:
+    no real sleeping in the suite)."""
+    from gpushare_device_plugin_tpu.serving import StepGovernor
+    from gpushare_device_plugin_tpu.utils.metrics import MetricsRegistry
+
+    cfg, params = setup
+    reqs = [
+        Request(rid=0, prompt=(5, 6, 7, 8), max_new=6, arrival=0.0),
+        Request(rid=1, prompt=(9, 10), max_new=4, arrival=1.0),
+    ]
+    plain = SlotEngine(params, cfg, slots=2, max_len=32, prefill_chunk=4,
+                       eos_id=EOS)
+    plain.warmup()
+    reference = {r.rid: r.tokens for r in plain.run(reqs).results}
+
+    t = [0.0]
+
+    def sleep(s):
+        t[0] += s
+
+    gov = StepGovernor(
+        lambda: "page", throttled_steps_per_s=50.0, poll_interval_steps=1,
+        registry=MetricsRegistry(), clock=lambda: t[0], sleep=sleep,
+    )
+    governed = SlotEngine(
+        params, cfg, slots=2, max_len=32, prefill_chunk=4, eos_id=EOS,
+        governor=gov,
+    )
+    governed.warmup()
+    warm = dict(governed.trace_counts)
+    stats = governed.run(reqs)
+    assert {r.rid: r.tokens for r in stats.results} == reference
+    assert sum(governed.trace_counts[k] - warm[k] for k in warm) == 0
+    assert gov.engaged and gov.throttled_steps > 0

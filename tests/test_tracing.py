@@ -220,3 +220,119 @@ def test_unsampled_hot_path_allocates_no_ids():
     for sp in spans:
         sp.end()
     assert t.store.trace_ids() == []
+
+
+# --------------------------------------------------------------------------
+# ring-bound / TTL-expiry under concurrent writers (PR 8's bounds were
+# only exercised single-threaded)
+# --------------------------------------------------------------------------
+
+
+def test_trace_store_bounds_under_concurrent_writers():
+    import threading
+
+    from gpushare_device_plugin_tpu.utils.tracing import Span, TraceStore
+
+    store = TraceStore(max_traces=32, max_spans_per_trace=8)
+    n_threads, traces_per_thread, spans_per_trace = 8, 40, 12
+    errors = []
+    stop_readers = threading.Event()
+
+    def writer(tid):
+        try:
+            for t in range(traces_per_thread):
+                trace_id = f"{tid:02d}{t:030d}"
+                for s in range(spans_per_trace):
+                    store.add(Span(
+                        f"op{s}", trace_id=trace_id,
+                        span_id=f"{tid:02d}{t:06d}{s:08d}",
+                    ))
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errors.append(e)
+
+    def reader():
+        try:
+            while not stop_readers.is_set():
+                store.trace_ids()
+                store.snapshot()
+                store.dropped()
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    writers = [
+        threading.Thread(target=writer, args=(i,)) for i in range(n_threads)
+    ]
+    readers = [threading.Thread(target=reader) for _ in range(2)]
+    for th in writers + readers:
+        th.start()
+    for th in writers:
+        th.join(timeout=30)
+    stop_readers.set()
+    for th in readers:
+        th.join(timeout=10)
+    assert errors == []
+    # ring bound held throughout: at most max_traces retained, each
+    # trace capped at max_spans_per_trace, evictions counted exactly
+    ids = store.trace_ids()
+    assert len(ids) <= 32
+    total = n_threads * traces_per_thread
+    assert store.dropped() == total - len(ids)
+    for spans in store.snapshot().values():
+        assert len(spans) <= 8
+
+
+def test_admission_traces_bounds_under_concurrent_writers():
+    import threading
+    import time as _time
+
+    from gpushare_device_plugin_tpu.utils.tracing import (
+        AdmissionTraces,
+        TraceStore,
+        Tracer,
+    )
+
+    store = TraceStore(max_traces=4096)
+    tracer = Tracer(store=store)
+    adm = AdmissionTraces(tracer, max_pods=16, ttl_s=0.05)
+    errors = []
+
+    def worker(wid):
+        try:
+            for i in range(60):
+                name = f"pod-{wid}-{i % 24}"
+                ctx = adm.root("ns", name)
+                assert ctx is not None
+                if i % 3 == 0:
+                    adm.finish("ns", name)
+                if i % 10 == 0:
+                    _time.sleep(0.01)  # let some roots cross the TTL
+                # TTL-expired re-touch: a stale root must be replaced,
+                # not resurrected
+                if i % 7 == 0:
+                    adm.root("ns", name)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(w,)) for w in range(8)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=30)
+    assert errors == []
+    # the registry bound held: never more than max_pods roots open
+    assert adm.open_count() <= 16
+    # every evicted/stale root was ENDED (status unfinished) — nothing
+    # leaks an open span
+    ended = [
+        s
+        for spans in store.snapshot().values()
+        for s in spans
+        if s.name == "admission"
+    ]
+    assert ended  # evictions definitely happened at these rates
+    for span in ended:
+        assert span.end_ns > 0
+    # TTL expiry still works after the storm
+    key_ctx = adm.root("ns", "ttl-probe")
+    _time.sleep(0.06)
+    assert adm.root("ns", "ttl-probe").trace_id != key_ctx.trace_id
