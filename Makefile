@@ -1,7 +1,7 @@
 PY ?= python
 PROTOC ?= protoc
 
-.PHONY: proto native test test-fast test-slow test-stress chaos chaos-restart chaos-move lint lint-strict typecheck bench bench-smoke bench-serve-smoke bench-multichip-smoke bench-paged-smoke bench-defrag-smoke bench-interference-smoke bench-wal bench-trace trace-smoke e2e-kind
+.PHONY: proto native test test-fast test-slow test-stress chaos chaos-restart chaos-move lint lint-strict typecheck bench bench-smoke bench-serve-smoke bench-multichip-smoke bench-paged-smoke bench-defrag-smoke bench-interference-smoke bench-wal bench-trace bench-decisions trace-smoke decisions-smoke e2e-kind
 
 # Regenerate protobuf message classes (gRPC bindings are hand-written in
 # gpushare_device_plugin_tpu/plugin/api/api_grpc.py; grpc_tools is not
@@ -174,6 +174,22 @@ bench-trace:
 # and `inspect trace` renders the timeline. See docs/observability.md.
 trace-smoke:
 	$(PY) -m pytest tests/test_trace_pipeline.py -x -q
+
+# Decision-provenance overhead A/B: the concurrent admission storm with
+# every verb's "why" record emitted vs --no-decisions, best-of-3 per
+# mode; HARD-FAILS when the decisions-on p99 inflates >5% over off.
+# See docs/observability.md.
+bench-decisions:
+	$(PY) bench.py --decisions-bench --workers 8
+
+# Decision-provenance smoke (seconds, in tier-1 via tests/): one
+# admission through the real extender + plugin gRPC path produces
+# queryable decision records end-to-end — mem AND gang paths — whose
+# trace id matches the stitched admission trace; /decisions serves them;
+# `inspect why` renders the tree; the decision and timeline rings stay
+# hard-bounded under a storm. See docs/observability.md.
+decisions-smoke:
+	$(PY) -m pytest tests/test_decisions_smoke.py -x -q
 
 # Full on-chip compute capture: decode/train/flash/serve plus the step-
 # time ablation and the flash block-size sweep (real TPU required; off

@@ -82,6 +82,9 @@ CONCURRENT_POD_UNITS = 2
 # Tracing-overhead hard gate (--trace-bench): the traced storm's p99 may
 # inflate at most this much over the --no-trace storm. docs/observability.md.
 TRACE_OVERHEAD_PCT = 5.0
+# Decision-provenance hard gate (--decisions-bench): the decisions-on
+# storm's admission p99 may inflate at most this much over decisions-off.
+DECISIONS_OVERHEAD_PCT = 5.0
 
 
 def run_allocate_trial(
@@ -1218,6 +1221,13 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="run ONLY the concurrent storm, traced vs "
                    "--no-trace, and HARD-FAIL if tracing inflates the "
                    "admission p99 more than 5% (make bench-trace)")
+    p.add_argument("--no-decisions", action="store_true",
+                   help="disable decision-provenance emission for this "
+                   "run (the baseline half of the --decisions-bench A/B)")
+    p.add_argument("--decisions-bench", action="store_true",
+                   help="run ONLY the concurrent storm, decisions-on vs "
+                   "decisions-off, and HARD-FAIL if provenance inflates "
+                   "the admission p99 more than 5% (make bench-decisions)")
     p.add_argument("--backend-init-timeout", type=float, default=60.0,
                    help="bound (seconds) on bench_mfu's subprocess "
                    "backend-init probe — a wedged TPU tunnel costs this "
@@ -1272,39 +1282,50 @@ def run_wal_bench(
     return 0
 
 
-def run_trace_bench(
-    workers: int, rounds: int = CONCURRENT_ROUNDS, trials: int = 3
+def _run_overhead_ab(
+    workers: int,
+    rounds: int,
+    trials: int,
+    *,
+    metric: str,
+    label: str,
+    off_label: str,
+    on_label: str,
+    set_mode,
+    restore,
+    gate_pct: float,
+    mode_extra=None,
+    record_extra=None,
 ) -> int:
-    """A/B the tracing layer under the concurrent-admission storm: the
-    same storm with every admission traced (sample ratio 1.0, the daemon
-    default) and with tracing off (``--no-trace``). HARD GATE: the
-    traced p99 may not inflate more than ``TRACE_OVERHEAD_PCT`` over
-    untraced — tracing that taxes the admission tail is a regression,
-    not a feature (``make bench-trace``).
+    """Shared A/B overhead harness for feature-on vs feature-off under
+    the concurrent-admission storm (tracing, decision provenance, ...).
 
-    Methodology: the storm runs WAL-off — the group-commit fsync waits
-    dominate the journaled storm's tail with stalls that have nothing to
-    do with tracing, and a QUIETER baseline makes the gate STRICTER (a
-    fixed per-span tax is a larger fraction of a smaller p99). Modes
-    alternate per trial (untraced, traced, untraced, ...) so box drift
-    cannot masquerade as overhead, and each mode's figure is its
-    BEST-of-N p99 — the bench's convention for noisy wall numbers (cf.
-    best-of-3 walls in bench_mfu): a systematic tax shifts the minimum
-    too, while GC/loopback noise only inflates it."""
-    from gpushare_device_plugin_tpu.utils.tracing import STORE, TRACER
+    Methodology (one implementation, so a fix here covers every A/B):
+    the storm runs WAL-off — the group-commit fsync waits dominate the
+    journaled storm's tail with stalls that have nothing to do with the
+    feature, and a QUIETER baseline makes the gate STRICTER (a fixed
+    per-admission tax is a larger fraction of a smaller p99). Modes
+    alternate per trial (off, on, off, ...) so box drift cannot
+    masquerade as overhead, and each mode's figure is its BEST-of-N p99
+    — the bench's convention for noisy wall numbers: a systematic tax
+    shifts the minimum too, while GC/loopback noise only inflates it.
+    HARD GATE: the on-mode p99 may not inflate more than ``gate_pct``
+    over off.
 
-    record: dict = {
-        "metric": "trace_overhead", "workers": workers, "trials": trials,
-    }
+    ``set_mode(enabled)`` flips the feature; ``restore()`` reinstates
+    the production default; ``mode_extra(enabled) -> dict`` adds
+    per-mode record fields; ``record_extra(record)`` adds run-level
+    fields before the JSON line."""
+    record: dict = {"metric": metric, "workers": workers, "trials": trials}
     results: dict = {
-        "untraced": {"p50": [], "p99": []},
-        "traced": {"p50": [], "p99": []},
+        off_label: {"p50": [], "p99": []},
+        on_label: {"p50": [], "p99": []},
     }
     try:
         run_concurrent_trial(workers, rounds=rounds, wal_mode="off")  # warmup
         for _ in range(trials):
-            for mode, ratio in (("untraced", 0.0), ("traced", 1.0)):
-                TRACER.configure(sample_ratio=ratio)
+            for mode, enabled in ((off_label, False), (on_label, True)):
+                set_mode(enabled)
                 trial = run_concurrent_trial(
                     workers, rounds=rounds, wal_mode="off"
                 )
@@ -1313,45 +1334,101 @@ def run_trace_bench(
                 if trial["p99_ms"] is not None:
                     results[mode]["p99"].append(trial["p99_ms"])
     finally:
-        TRACER.configure(sample_ratio=1.0)
+        restore()
     p99 = {}
-    for mode in ("untraced", "traced"):
+    for mode, enabled in ((off_label, False), (on_label, True)):
         p50s, p99s = results[mode]["p50"], results[mode]["p99"]
         record[mode] = {
-            "sample_ratio": 0.0 if mode == "untraced" else 1.0,
+            **(mode_extra(enabled) if mode_extra else {}),
             "p50_ms": round(min(p50s), 3) if p50s else None,
             "p99_ms": round(min(p99s), 3) if p99s else None,
             "p99_ms_trials": p99s,
         }
         p99[mode] = record[mode]["p99_ms"]
         print(
-            f"trace={mode}: p50={record[mode]['p50_ms']}ms "
+            f"{label}={mode}: p50={record[mode]['p50_ms']}ms "
             f"p99={record[mode]['p99_ms']}ms (trials {p99s})",
             file=sys.stderr,
         )
-    record["traced_store_traces"] = len(STORE.trace_ids())
-    if p99.get("untraced") and p99.get("traced") is not None:
-        overhead = 100.0 * (p99["traced"] - p99["untraced"]) / p99["untraced"]
+    if record_extra:
+        record_extra(record)
+    if p99.get(off_label) and p99.get(on_label) is not None:
+        overhead = (
+            100.0 * (p99[on_label] - p99[off_label]) / p99[off_label]
+        )
         record["p99_overhead_pct"] = round(overhead, 1)
-    record["gate_pct"] = TRACE_OVERHEAD_PCT
+    record["gate_pct"] = gate_pct
     print(json.dumps(record))
     overhead = record.get("p99_overhead_pct")
     if overhead is None:
-        print("TRACE BENCH: not enough samples for p99", file=sys.stderr)
-        return 1
-    if overhead > TRACE_OVERHEAD_PCT:
         print(
-            f"TRACE OVERHEAD GUARD FAILED: traced p99 "
-            f"{p99['traced']:.3f}ms is {overhead:+.1f}% vs untraced "
-            f"{p99['untraced']:.3f}ms (gate {TRACE_OVERHEAD_PCT:.0f}%)",
+            f"{label.upper()} BENCH: not enough samples for p99",
+            file=sys.stderr,
+        )
+        return 1
+    if overhead > gate_pct:
+        print(
+            f"{label.upper()} OVERHEAD GUARD FAILED: {on_label} p99 "
+            f"{p99[on_label]:.3f}ms is {overhead:+.1f}% vs {off_label} "
+            f"{p99[off_label]:.3f}ms (gate {gate_pct:.0f}%)",
             file=sys.stderr,
         )
         return 1
     print(
-        f"trace overhead: p99 {overhead:+.1f}% (gate {TRACE_OVERHEAD_PCT:.0f}%)",
+        f"{label} overhead: p99 {overhead:+.1f}% (gate {gate_pct:.0f}%)",
         file=sys.stderr,
     )
     return 0
+
+
+def run_trace_bench(
+    workers: int, rounds: int = CONCURRENT_ROUNDS, trials: int = 3
+) -> int:
+    """A/B the tracing layer under the concurrent-admission storm: the
+    same storm with every admission traced (sample ratio 1.0, the daemon
+    default) and with tracing off (``--no-trace``); methodology and the
+    5% hard gate live in :func:`_run_overhead_ab` (``make
+    bench-trace``)."""
+    from gpushare_device_plugin_tpu.utils.tracing import STORE, TRACER
+
+    return _run_overhead_ab(
+        workers, rounds, trials,
+        metric="trace_overhead", label="trace",
+        off_label="untraced", on_label="traced",
+        set_mode=lambda on: TRACER.configure(sample_ratio=1.0 if on else 0.0),
+        restore=lambda: TRACER.configure(sample_ratio=1.0),
+        gate_pct=TRACE_OVERHEAD_PCT,
+        mode_extra=lambda on: {"sample_ratio": 1.0 if on else 0.0},
+        record_extra=lambda record: record.update(
+            traced_store_traces=len(STORE.trace_ids())
+        ),
+    )
+
+
+def run_decisions_bench(
+    workers: int, rounds: int = CONCURRENT_ROUNDS, trials: int = 3
+) -> int:
+    """A/B the decision-provenance layer under the concurrent-admission
+    storm: the same storm with every admission's "why" recorded
+    (``DECISIONS`` enabled, the daemon default) and with emission off
+    (``--no-decisions``); methodology and the 5% hard gate live in
+    :func:`_run_overhead_ab` (``make bench-decisions``). Tracing stays
+    ON in both modes — the production configuration records both, and
+    the A/B isolates the decisions delta."""
+    from gpushare_device_plugin_tpu.utils.decisions import DECISIONS
+
+    return _run_overhead_ab(
+        workers, rounds, trials,
+        metric="decisions_overhead", label="decisions",
+        off_label="off", on_label="on",
+        set_mode=lambda on: DECISIONS.configure(enabled=on),
+        restore=lambda: DECISIONS.configure(enabled=True),
+        gate_pct=DECISIONS_OVERHEAD_PCT,
+        mode_extra=lambda on: {"enabled": on},
+        record_extra=lambda record: record.update(
+            ring_records=DECISIONS.size(), ring_dropped=DECISIONS.dropped()
+        ),
+    )
 
 
 def main(argv=None) -> int:
@@ -1361,8 +1438,14 @@ def main(argv=None) -> int:
         from gpushare_device_plugin_tpu.utils.tracing import TRACER
 
         TRACER.configure(sample_ratio=0.0)
+    if args.no_decisions:
+        from gpushare_device_plugin_tpu.utils.decisions import DECISIONS
+
+        DECISIONS.configure(enabled=False)
     if args.trace_bench:
         return run_trace_bench(max(1, args.workers))
+    if args.decisions_bench:
+        return run_decisions_bench(max(1, args.workers))
     if args.defrag_smoke:
         defrag = run_defrag_bench(rounds=3)
         print(json.dumps({"metric": "defrag_churn", **defrag}))

@@ -55,6 +55,7 @@ from ..cluster.podsource import PodSource
 from ..cluster.usage import pod_counts_toward_usage
 from ..device.fanout import DeviceInventory
 from ..topology import ChipTopology, format_shape, pad3, parse_shape, shape_size
+from ..utils.decisions import DECISIONS, chip_breakdown
 from ..utils.faults import FAULTS
 from ..utils.log import get_logger
 from ..utils.metrics import timed_acquire
@@ -91,6 +92,14 @@ def _adopt_pod_trace(pod) -> None:
     TRACER.adopt_current_trace(
         parse_context(P.annotations(pod).get(const.ANN_TRACE_ID))
     )
+
+
+def _current_trace_id() -> str:
+    """The stitched admission trace id for decision records (after
+    ``_adopt_pod_trace`` this is the SAME trace the extender's bind
+    record carries — the join key between the two processes' "why"s)."""
+    ctx = TRACER.current_context()
+    return ctx.trace_id if ctx is not None else ""
 
 
 def _counted_by_source(pod_source, key: PodKey) -> bool:
@@ -485,7 +494,24 @@ class ClusterAllocator:
         return None
 
     def _place(self, pod, pod_units: int) -> tuple[int, dict[str, str]]:
-        """Decide the chip and the annotations to persist for one pod.
+        """Decide the chip (or gang slice) and the annotations to persist
+        for one pod — dispatch only; the emitting verbs are
+        :meth:`_place_mem` and :meth:`_place_gang`, each of which records
+        a decision-provenance "why" on every outcome path."""
+        if P.gang_shape_request(pod):
+            return self._place_gang(pod, pod_units)
+        return self._place_mem(pod, pod_units)
+
+    def _dual_resource_guard(self, pod) -> None:
+        if P.core_chips_of_pod(pod) > 0:
+            raise AllocationFailure(
+                f"pod {P.name(pod)} requests both {const.RESOURCE_MEM} and "
+                f"{const.RESOURCE_CORE}; dual-resource pods are unsupported "
+                "(the two allocators would race each other's assigned flag)"
+            )
+
+    def _place_mem(self, pod, pod_units: int) -> tuple[int, dict[str, str]]:
+        """Single-chip placement.
 
         One ``chip_state()`` read serves both the usage accounting and the
         core-hold exclusion — O(chips) per placement with the informer's
@@ -494,37 +520,59 @@ class ClusterAllocator:
         in-flight reservations, decision, and this pod's own reservation
         are one ledger transaction, so the chip is protected the moment it
         is chosen — before the PATCH leaves the building."""
-        if P.core_chips_of_pod(pod) > 0:
-            raise AllocationFailure(
-                f"pod {P.name(pod)} requests both {const.RESOURCE_MEM} and "
-                f"{const.RESOURCE_CORE}; dual-resource pods are unsupported "
-                "(the two allocators would race each other's assigned flag)"
+        pod_key = f"{P.namespace(pod)}/{P.name(pod)}"
+        try:
+            self._dual_resource_guard(pod)
+            with self._assume.transaction():
+                mem_used, core_held = self._assume.overlaid_state(
+                    self._pods.chip_state,
+                    visible_fn=lambda key: _counted_by_source(self._pods, key),
+                )
+                if P.is_assumed(pod) and not P.is_assigned(pod):
+                    idx = self._assumed_chip(pod, core_held)
+                    annotations = {const.ENV_ASSIGNED_FLAG: "true"}
+                    assumed = True
+                else:
+                    idx = self._binpack_chip(pod_units, mem_used, core_held)
+                    annotations = {
+                        const.ENV_MEM_IDX: str(idx),
+                        const.ENV_MEM_POD: str(pod_units),
+                        const.ENV_MEM_DEV: str(self._chip_total(idx)),
+                        const.ENV_ASSIGNED_FLAG: "true",
+                    }
+                    assumed = False
+                self._assume.reserve_mem(_pod_key(pod), idx, pod_units)
+        except AllocationFailure as e:
+            # a refused admission deserves a "why" as much as a grant
+            DECISIONS.emit(
+                pod_key, "allocate", outcome="error",
+                node=self._node, reason=str(e),
+                trace_id=_current_trace_id(),
             )
-        if P.gang_shape_request(pod):
-            return self._place_gang(pod, pod_units)
-        with self._assume.transaction():
-            mem_used, core_held = self._assume.overlaid_state(
-                self._pods.chip_state,
-                visible_fn=lambda key: _counted_by_source(self._pods, key),
-            )
-            if P.is_assumed(pod) and not P.is_assigned(pod):
-                idx = self._assumed_chip(pod, core_held)
-                annotations = {const.ENV_ASSIGNED_FLAG: "true"}
-            else:
-                idx = self._binpack_chip(pod_units, mem_used, core_held)
-                annotations = {
-                    const.ENV_MEM_IDX: str(idx),
-                    const.ENV_MEM_POD: str(pod_units),
-                    const.ENV_MEM_DEV: str(self._chip_total(idx)),
-                    const.ENV_ASSIGNED_FLAG: "true",
-                }
-            self._assume.reserve_mem(_pod_key(pod), idx, pod_units)
+            raise
         annotations[const.ENV_ASSUME_TIME] = str(time.time_ns())
         # Persist the NORMALIZED workload class with the decision: every
         # downstream reader (informer indexes, interference detector,
         # inspect CLI) then sees one canonical value even when the pod
         # declared nothing or garbage.
         annotations[const.ANN_WORKLOAD_CLASS] = P.workload_class(pod)
+        # Decision provenance: built from values the placement already
+        # computed (the ledger snapshot and the chosen chip) — the
+        # breakdown re-derives one chip's slack from numbers in hand.
+        total = self._chip_total(idx)
+        DECISIONS.emit(
+            pod_key, "allocate",
+            node=self._node,
+            scores={f"chip{idx}": chip_breakdown(
+                total - mem_used.get(idx, 0), total, idx, pod_units,
+                self._policy,
+            )},
+            placement={
+                "chip": idx, "units": pod_units,
+                "source": "extender-assumed" if assumed else "binpack",
+            },
+            trace_id=_current_trace_id(),
+        )
         return idx, annotations
 
     def _place_gang(self, pod, pod_units: int) -> tuple[GangPlacement, dict[str, str]]:
@@ -539,70 +587,114 @@ class ClusterAllocator:
         as one gang entry inside one transaction: a concurrent placement
         sees all member chips claimed or none, never a partial gang.
         """
-        shape_raw = P.gang_shape_request(pod)
+        pod_key = f"{P.namespace(pod)}/{P.name(pod)}"
+        slice_score = None
+        free = {}
         try:
-            size = shape_size(shape_raw)
-        except ValueError as e:
-            raise AllocationFailure(
-                f"pod {P.name(pod)} has invalid gang shape "
-                f"{shape_raw!r}: {e}"
-            ) from e
-        if size < 1 or pod_units % size != 0:
-            raise AllocationFailure(
-                f"pod {P.name(pod)}: {pod_units} {const.RESOURCE_MEM} units "
-                f"do not divide evenly over gang shape {shape_raw!r} "
-                f"({size} chips)"
-            )
-        per_chip = pod_units // size
-        units_by_index = self._inv.units_by_index()
-        with self._assume.transaction():
-            mem_used, core_held = self._assume.overlaid_state(
-                self._pods.chip_state,
-                visible_fn=lambda key: _counted_by_source(self._pods, key),
-            )
-            excluded = set(self._unhealthy_fn()) | core_held
-            assumed_chips = (
-                P.gang_chips_from_annotation(pod)
-                if P.is_assumed(pod) and not P.is_assigned(pod)
-                else []
-            )
-            if assumed_chips:
-                placement = self._assumed_gang(
-                    pod, assumed_chips, per_chip, units_by_index,
-                    mem_used, excluded,
+            self._dual_resource_guard(pod)
+            shape_raw = P.gang_shape_request(pod)
+            try:
+                size = shape_size(shape_raw)
+            except ValueError as e:
+                raise AllocationFailure(
+                    f"pod {P.name(pod)} has invalid gang shape "
+                    f"{shape_raw!r}: {e}"
+                ) from e
+            if size < 1 or pod_units % size != 0:
+                raise AllocationFailure(
+                    f"pod {P.name(pod)}: {pod_units} {const.RESOURCE_MEM} units "
+                    f"do not divide evenly over gang shape {shape_raw!r} "
+                    f"({size} chips)"
                 )
-                annotations = {const.ENV_ASSIGNED_FLAG: "true"}
-            else:
-                free = {
-                    i: cap - mem_used.get(i, 0)
-                    for i, cap in units_by_index.items()
-                }
-                cand = self._chip_topo.best_slice(
-                    shape_raw, free, per_chip,
-                    capacity=units_by_index, excluded=excluded,
+            per_chip = pod_units // size
+            units_by_index = self._inv.units_by_index()
+            with self._assume.transaction():
+                mem_used, core_held = self._assume.overlaid_state(
+                    self._pods.chip_state,
+                    visible_fn=lambda key: _counted_by_source(self._pods, key),
                 )
-                if cand is None:
-                    raise AllocationFailure(
-                        f"no {shape_raw} sub-slice with {per_chip} free "
-                        f"units per chip on {self._node} "
-                        f"(free: {free}, excluded: {sorted(excluded)})"
+                excluded = set(self._unhealthy_fn()) | core_held
+                assumed_chips = (
+                    P.gang_chips_from_annotation(pod)
+                    if P.is_assumed(pod) and not P.is_assigned(pod)
+                    else []
+                )
+                if assumed_chips:
+                    placement = self._assumed_gang(
+                        pod, assumed_chips, per_chip, units_by_index,
+                        mem_used, excluded,
                     )
-                placement = GangPlacement(
-                    chips=cand.chips, shape=cand.shape, per_chip=per_chip
+                    annotations = {const.ENV_ASSIGNED_FLAG: "true"}
+                else:
+                    free = {
+                        i: cap - mem_used.get(i, 0)
+                        for i, cap in units_by_index.items()
+                    }
+                    scored = self._chip_topo.best_slice_scored(
+                        shape_raw, free, per_chip,
+                        capacity=units_by_index, excluded=excluded,
+                    )
+                    if scored is None:
+                        raise AllocationFailure(
+                            f"no {shape_raw} sub-slice with {per_chip} free "
+                            f"units per chip on {self._node} "
+                            f"(free: {free}, excluded: {sorted(excluded)})"
+                        )
+                    cand, slice_score = scored
+                    placement = GangPlacement(
+                        chips=cand.chips, shape=cand.shape, per_chip=per_chip
+                    )
+                    annotations = {
+                        const.ENV_GANG_CHIPS: ",".join(str(i) for i in cand.chips),
+                        const.ENV_GANG_SHAPE: format_shape(cand.shape),
+                        const.ENV_GANG_PER_CHIP: str(per_chip),
+                        const.ENV_MEM_POD: str(pod_units),
+                        const.ENV_MEM_DEV: str(self._chip_total(cand.chips[0])),
+                        const.ENV_ASSIGNED_FLAG: "true",
+                    }
+                self._assume.reserve_gang(
+                    _pod_key(pod), [(i, per_chip) for i in placement.chips]
                 )
-                annotations = {
-                    const.ENV_GANG_CHIPS: ",".join(str(i) for i in cand.chips),
-                    const.ENV_GANG_SHAPE: format_shape(cand.shape),
-                    const.ENV_GANG_PER_CHIP: str(per_chip),
-                    const.ENV_MEM_POD: str(pod_units),
-                    const.ENV_MEM_DEV: str(self._chip_total(cand.chips[0])),
-                    const.ENV_ASSIGNED_FLAG: "true",
-                }
-            self._assume.reserve_gang(
-                _pod_key(pod), [(i, per_chip) for i in placement.chips]
+        except AllocationFailure as e:
+            DECISIONS.emit(
+                pod_key, "allocate_gang", outcome="error",
+                node=self._node, reason=str(e),
+                trace_id=_current_trace_id(),
             )
+            raise
         annotations[const.ENV_ASSUME_TIME] = str(time.time_ns())
         annotations[const.ANN_WORKLOAD_CLASS] = P.workload_class(pod)
+        # Decision provenance: branch B carries the winning slice's full
+        # multi-objective breakdown (ICI hops, stranded slivers, broken
+        # chips); branch A honors the extender's persisted decision, so
+        # the slice score lives in the extender's own bind record.
+        scores = {}
+        if slice_score is not None:
+            base = chip_breakdown(
+                min(free[i] for i in placement.chips),
+                max(units_by_index.values(), default=0),
+                placement.chips[0], per_chip, "topology",
+            )
+            scores["slice"] = dataclasses.replace(
+                base,
+                ici_hops=slice_score.hops,
+                stranded=slice_score.stranded,
+                broken=slice_score.broken,
+                tie_break=slice_score.tie_break,
+            )
+        DECISIONS.emit(
+            pod_key, "allocate_gang",
+            node=self._node,
+            scores=scores,
+            placement={
+                "chips": list(placement.chips),
+                "shape": format_shape(placement.shape),
+                "per_chip": placement.per_chip,
+                "source": "binpack" if slice_score is not None
+                else "extender-assumed",
+            },
+            trace_id=_current_trace_id(),
+        )
         return placement, annotations
 
     def _assumed_gang(

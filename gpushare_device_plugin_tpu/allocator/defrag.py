@@ -55,6 +55,7 @@ from typing import Any, Callable, Mapping
 
 from .. import const
 from ..cluster import pods as P
+from ..utils.decisions import DECISIONS
 from ..utils.faults import FAULTS
 from ..utils.lockrank import make_lock
 from ..utils.log import get_logger
@@ -284,12 +285,14 @@ class DefragPlanner:
         quantum: int = 0,
         excluded_fn: Callable[[], set[int]] | None = None,
         max_moves: int = 8,  # matches ManagerConfig.defrag_max_moves
+        node: str = "",  # decision-record attribution only
     ) -> None:
         self._units_by_index = units_by_index
         self._pods = pod_source
         self._quantum = quantum
         self._excluded_fn = excluded_fn or (lambda: set())
         self._max_moves = max_moves
+        self._node = node
         # guards the cached last-scan report (read by the CLI/status
         # publisher while the loop thread scans)
         self._lock = make_lock("defrag.planner")
@@ -357,6 +360,30 @@ class DefragPlanner:
             REGISTRY.gauge_set(STRANDED_PCT_GAUGE, pct, STRANDED_PCT_GAUGE_HELP)
         with self._lock:
             self._last = report
+        # Decision provenance: one record per planning pass — what the
+        # planner saw (stranded picture) and what it decided to move,
+        # queryable by any affected pod (``inspect why`` matches records
+        # whose moves touch the pod). Values all computed above.
+        DECISIONS.emit(
+            "", "defrag_plan",
+            outcome="ok" if pods_readable else "error",
+            node=self._node,
+            reason="" if pods_readable else "pod source unreadable; planned nothing",
+            candidates=len(capacity),
+            placement={
+                "quantum": quantum,
+                "stranded_units": sum(by_chip.values()),
+                "stranded_pct": round(pct, 2),
+                "planned_moves": [
+                    {
+                        "pod": f"{m.pod[0]}/{m.pod[1]}",
+                        "src": m.src, "dst": m.dst, "units": m.units,
+                    }
+                    for m in moves
+                ],
+            },
+            moves=[f"{m.pod[0]}/{m.pod[1]}" for m in moves],
+        )
         return report
 
     def last_report(self) -> DefragReport | None:

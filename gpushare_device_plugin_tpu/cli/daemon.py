@@ -168,6 +168,20 @@ def build_parser() -> argparse.ArgumentParser:
                    help="upper bound on repacking moves planned per "
                    "defrag pass — each move drains and restores a "
                    "running workload, so passes stay small by default")
+    p.add_argument("--timeline-interval", type=float, default=10.0,
+                   help="seconds between cluster-state timeline samples "
+                   "(utilization / stranded%% / pending depth / SLO "
+                   "burn into the bounded /timeline ring, embedded in "
+                   "flight-recorder dumps); 0 disables")
+    p.add_argument("--decisions-ring", type=int, default=512,
+                   help="in-memory decision-provenance ring size (per-"
+                   "verb 'why' records for every admission, served on "
+                   "/decisions and rendered by inspect why; 0 disables "
+                   "emission)")
+    p.add_argument("--decisions-log", default="",
+                   help="optional on-disk decision segment log (JSON "
+                   "lines, fsync-free, size-rotated — provenance, not "
+                   "durability; the WAL owns that); empty disables")
     p.add_argument("-v", "--verbosity", type=int, default=0)
     return p
 
@@ -244,6 +258,9 @@ def main(argv=None) -> int:
         interference_interval_s=args.interference_interval,
         interference_threshold=args.interference_threshold,
         interference_scrape_urls=tuple(args.interference_scrape_url),
+        timeline_interval_s=args.timeline_interval,
+        decisions_ring=args.decisions_ring,
+        decisions_log_path=args.decisions_log,
     )
 
     api_client = None
@@ -279,17 +296,23 @@ def main(argv=None) -> int:
         else:
             pod_source = apisrc
 
+    manager = TpuShareManager(
+        backend, cfg, api_client=api_client, pod_source=pod_source
+    )
     metrics_server = None
     if args.metrics_port:
-        from ..utils.metrics import MetricsServer
+        from ..utils.metrics import MetricsServer, publish_build_info
 
-        metrics_server = MetricsServer(port=args.metrics_port).start()
+        publish_build_info(component="daemon")
+        # /readyz gates on kubelet plugin registration — the DaemonSet's
+        # readiness probe (a daemon whose plugins never registered serves
+        # no pods, whatever its process state).
+        metrics_server = MetricsServer(
+            port=args.metrics_port, ready_fn=manager.ready
+        ).start()
         log.info("metrics on :%d/metrics", metrics_server.port)
 
     try:
-        manager = TpuShareManager(
-            backend, cfg, api_client=api_client, pod_source=pod_source
-        )
         manager.install_signal_handlers()
         log.info(
             "tpushare-device-plugin starting: discovery=%s policy=%s standalone=%s",

@@ -214,11 +214,14 @@ def render_top(
     engine = (obs or {}).get("engine") or {}
     slo = (obs or {}).get("slo") or {}
     governor = (obs or {}).get("governor") or {}
+    build = (obs or {}).get("build") or {}
     buf = StringIO()
     title = "tpushare top"
     if now_label:
         title += f" — {now_label}"
     buf.write(title + "\n")
+    if build:
+        buf.write(f"build: {_fmt_build(build)}\n")
     rows = [["NODE", "CHIP", "RESIDENTS (class)", "STEP p50/p99",
              "INTERFERENCE"]]
     for info in infos:
@@ -283,6 +286,174 @@ def render_top(
                 f"engagements={int(row.get('engagements_total', 0))} "
                 f"throttled={int(row.get('throttled_steps_total', 0))}\n"
             )
+    return buf.getvalue()
+
+
+def _fmt_build(build: dict | None) -> str:
+    """One-line build identity from the scraped ``tpushare_build_info``
+    labels: ``daemon v0.1.0 (rev abc123, py 3.10, jax 0.4.37)`` per
+    component, joined by ``·``."""
+    if not build:
+        return ""
+    parts = []
+    for component, labels in sorted(build.items()):
+        parts.append(
+            f"{component} v{labels.get('version', '?')} "
+            f"(rev {labels.get('git_rev', '?')}, "
+            f"py {labels.get('python', '?')}, "
+            f"jax {labels.get('jax', '?')})"
+        )
+    return " · ".join(parts)
+
+
+def _score_cell(sv: dict) -> str:
+    """One ScoreVector dict as a compact breakdown cell: the raw
+    full-resolution score, its 0-10 wire projection, and the terms
+    behind it (gang records add the topology objective components)."""
+    parts = [
+        f"raw={sv.get('raw', 0.0):.4f}",
+        f"wire={sv.get('projected', 0)}/10",
+        f"free={int(sv.get('free_units', 0))}",
+        f"req={int(sv.get('request_units', 0))}",
+        f"binpack={sv.get('binpack', 0.0):.3f}",
+    ]
+    for key in ("ici_hops", "stranded", "broken", "tie_break"):
+        if key in sv:
+            parts.append(f"{key}={sv[key]}")
+    return " ".join(parts)
+
+
+def _placement_cell(placement: dict) -> str:
+    parts = []
+    if "chip" in placement:
+        parts.append(f"chip {placement['chip']}")
+    if "chips" in placement:
+        parts.append(
+            "chips " + ",".join(str(i) for i in placement["chips"])
+        )
+    if placement.get("shape"):
+        parts.append(f"shape {placement['shape']}")
+    if "units" in placement:
+        parts.append(f"{placement['units']} units")
+    if "per_chip" in placement:
+        parts.append(f"{placement['per_chip']} units/chip")
+    if placement.get("source"):
+        parts.append(f"[{placement['source']}]")
+    return " · ".join(parts) or "-"
+
+
+def render_why(pod: str, records: list[dict]) -> str:
+    """Render one pod's decision tree (``kubectl-inspect-tpushare why``):
+    every verb's record in order — each rejected node with its reason,
+    the score breakdowns ranked best-first by the RAW fractional score
+    (winner vs runner-up with the margin the 0-10 wire scale cannot
+    resolve), the chosen placement, and the WAL seq / trace id that tie
+    the record to the journal and the PR 8 admission trace.
+    Deterministic for a given record set (golden-tested like
+    ``render_trace``)."""
+    buf = StringIO()
+    buf.write(f"pod {pod} — {len(records)} decision record(s)\n")
+    if not records:
+        buf.write(
+            "(no decision records: admitted before provenance, emission "
+            "disabled, or the ring already evicted it)\n"
+        )
+        return buf.getvalue()
+    for rec in records:
+        verb = rec.get("verb", "?")
+        head = f"[#{rec.get('id', '?')}] {verb}"
+        if rec.get("node"):
+            head += f" -> {rec['node']}"
+        if rec.get("outcome", "ok") != "ok":
+            head += "  FAILED"
+        buf.write(head + "\n")
+        if rec.get("reason"):
+            buf.write(f"   reason: {rec['reason']}\n")
+        if rec.get("candidates"):
+            line = f"   candidates: {rec['candidates']}"
+            if rec.get("rejected"):
+                line += f" ({len(rec['rejected'])} rejected)"
+            buf.write(line + "\n")
+        for node, why in sorted((rec.get("rejected") or {}).items()):
+            buf.write(f"   x {node}: {why}\n")
+        scores = rec.get("scores") or {}
+        ranked = sorted(
+            scores.items(),
+            key=lambda kv: (-float(kv[1].get("raw", 0.0)), kv[0]),
+        )
+        for i, (name, sv) in enumerate(ranked):
+            tag = "> " if i == 0 else "  "
+            buf.write(f"   {tag}{name}  {_score_cell(sv)}\n")
+        if len(ranked) >= 2:
+            margin = float(ranked[0][1].get("raw", 0.0)) - float(
+                ranked[1][1].get("raw", 0.0)
+            )
+            buf.write(
+                f"   margin: {ranked[0][0]} leads {ranked[1][0]} by "
+                f"{margin:.4f} raw\n"
+            )
+        if rec.get("placement"):
+            buf.write(f"   placement: {_placement_cell(rec['placement'])}\n")
+        moves = rec.get("moves") or []
+        if moves:
+            buf.write(f"   moves: {', '.join(moves)}\n")
+        tail = []
+        if rec.get("seq") is not None:
+            tail.append(f"wal seq {rec['seq']}")
+        if rec.get("trace_id"):
+            tail.append(f"trace {rec['trace_id']}")
+        if tail:
+            buf.write(f"   {' · '.join(tail)}\n")
+    return buf.getvalue()
+
+
+SPARK_LEVELS = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(values: list[float], width: int = 48) -> str:
+    """A unicode sparkline over the LAST ``width`` values, scaled to the
+    rendered window's min/max (a flat series renders mid-level)."""
+    if not values:
+        return ""
+    window = values[-width:]
+    lo, hi = min(window), max(window)
+    if hi - lo < 1e-12:
+        return SPARK_LEVELS[3] * len(window)
+    out = []
+    for v in window:
+        i = int((v - lo) / (hi - lo) * (len(SPARK_LEVELS) - 1))
+        out.append(SPARK_LEVELS[max(0, min(len(SPARK_LEVELS) - 1, i))])
+    return "".join(out)
+
+
+def render_timeline(doc: dict, width: int = 48) -> str:
+    """Render a ``/timeline`` document (``utils.timeline.to_doc``) as
+    per-field sparklines with last/min/max annotations. Deterministic
+    for a given document (golden-tested)."""
+    series = (doc or {}).get("series") or {}
+    buf = StringIO()
+    buf.write(
+        f"cluster timeline — bucket {doc.get('bucket_s', '?')}s, "
+        f"span {doc.get('span_s', '?')}s\n"
+    )
+    populated = {
+        # stats cover the SAME trailing window the sparkline renders —
+        # a spike older than `width` buckets must not print a max= the
+        # glyphs never show
+        name: [float(v) for _t, v in points][-width:]
+        for name, points in sorted(series.items())
+        if points
+    }
+    if not populated:
+        buf.write("(no samples yet)\n")
+        return buf.getvalue()
+    name_w = max(len(n) for n in populated)
+    for name, values in populated.items():
+        buf.write(
+            f"{name.ljust(name_w)}  {sparkline(values, width=width)}  "
+            f"last={values[-1]:g} min={min(values):g} "
+            f"max={max(values):g} n={len(values)}\n"
+        )
     return buf.getvalue()
 
 
@@ -364,6 +535,17 @@ def render_flightrecord(doc: dict, max_traces: int = 5, max_logs: int = 20) -> s
         f"traces       : {doc.get('trace_count', 0)} retained, "
         f"{doc.get('dropped_traces', 0)} older evicted\n"
     )
+    timeline = doc.get("timeline") or {}
+    tl_series = {
+        k: v for k, v in (timeline.get("series") or {}).items() if v
+    }
+    if tl_series:
+        buf.write(
+            f"timeline     : {len(tl_series)} series over the last "
+            f"{timeline.get('span_s', '?')}s (render with "
+            "`inspect timeline`)\n"
+        )
+        buf.write(render_timeline(timeline))
     spans = spans_from_otlp(doc.get("traces") or {})
     by_trace: dict[str, list[dict]] = {}
     for s in spans:
@@ -394,9 +576,12 @@ def render_flightrecord(doc: dict, max_traces: int = 5, max_logs: int = 20) -> s
 def render_details(
     infos: list[NodeInfo],
     engine: dict[str, dict[str, float]] | None = None,
+    build: dict | None = None,
 ) -> str:
     unit = infer_unit(infos)
     buf = StringIO()
+    if build:
+        buf.write(f"build: {_fmt_build(build)}\n")
     for info in infos:
         buf.write(f"NAME: {info.name} ({info.address})\n")
         any_gang = any(p.is_gang for p in info.pods)

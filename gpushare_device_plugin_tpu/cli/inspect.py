@@ -12,6 +12,14 @@ Observability subcommands (docs/observability.md):
   the pod's ``tpushare.aliyun.com/trace-id`` annotation, fetch the trace
   from each given ``/traces`` endpoint (the extender's and the node
   daemon's metrics ports), merge, and render the admission timeline.
+- ``inspect why [ns/]pod --decisions-url http://node:PORT [...]`` —
+  fetch the pod's decision-provenance records from each ``/decisions``
+  endpoint, merge, and render the decision tree: every rejected node
+  with its reason, winner-vs-runner-up score breakdowns, the chosen
+  placement, WAL seq, and the stitched trace id.
+- ``inspect timeline --timeline-url http://node:PORT [...]`` — render
+  the cluster-state timeline ring (utilization / stranded % / queue
+  depth / SLO burn) as sparklines.
 - ``inspect flightrecord <file>`` — summarize a flight-recorder dump.
 """
 
@@ -50,27 +58,35 @@ def gather(client: ApiServerClient, node_name: str = "") -> tuple[list, list]:
     return nodes, pods
 
 
+def _fetch_json_docs(urls: list[str], suffix: str, params=None):
+    """Yield one parsed JSON document per reachable endpoint — THE
+    fetch-and-merge boilerplate (URL suffix normalization, 10 s timeout,
+    warn-on-stderr partial-merge policy) shared by every JSON endpoint
+    reader (``/traces``, ``/decisions``, ``/timeline``); a partial
+    answer beats none."""
+    import requests
+
+    for url in urls:
+        full = url.rstrip("/")
+        if not full.endswith(suffix):
+            full += suffix
+        try:
+            resp = requests.get(full, params=params, timeout=10)
+            resp.raise_for_status()
+            yield resp.json()
+        except Exception as e:  # noqa: BLE001 — partial merge by design
+            print(f"warning: {full} unreachable: {e}", file=sys.stderr)
+
+
 def fetch_trace_spans(urls: list[str], trace_id: str) -> list[dict]:
     """Fetch + merge one trace from every ``/traces`` endpoint given
     (extender and node daemon each hold their process's half; spans are
     deduped by span id). Unreachable endpoints are reported but do not
     fail the merge — a partial timeline beats none."""
-    import requests
-
     from ..utils.tracing import spans_from_otlp
 
     spans: dict[str, dict] = {}
-    for url in urls:
-        full = url.rstrip("/")
-        if not full.endswith("/traces"):
-            full += "/traces"
-        try:
-            resp = requests.get(full, params={"trace_id": trace_id}, timeout=10)
-            resp.raise_for_status()
-            doc = resp.json()
-        except Exception as e:  # noqa: BLE001 — partial merge by design
-            print(f"warning: {full} unreachable: {e}", file=sys.stderr)
-            continue
+    for doc in _fetch_json_docs(urls, "/traces", {"trace_id": trace_id}):
         for span in spans_from_otlp(doc):
             spans.setdefault(span["span_id"], span)
     return sorted(spans.values(), key=lambda s: (s["start_ns"], s["name"]))
@@ -125,13 +141,20 @@ def parse_observability_metrics(text: str) -> dict:
     - ``slo``: per-tier burn rates / budget remaining / severity from
       the ``tpushare_slo_*`` gauges;
     - ``governor``: per-pod engage state + counters from the
-      ``tpushare_governor_*`` families.
+      ``tpushare_governor_*`` families;
+    - ``build``: per-component version labels from
+      ``tpushare_build_info`` (the inspect header line).
     """
-    out: dict = {"engine": parse_engine_metrics(text), "slo": {}, "governor": {}}
+    out: dict = {
+        "engine": parse_engine_metrics(text), "slo": {}, "governor": {},
+        "build": {},
+    }
     for line in text.splitlines():
         if line.startswith("#"):
             continue
-        if not line.startswith(("tpushare_slo_", "tpushare_governor_")):
+        if not line.startswith(
+            ("tpushare_slo_", "tpushare_governor_", "tpushare_build_info")
+        ):
             continue
         try:
             metric, value = line.rsplit(None, 1)
@@ -143,7 +166,10 @@ def parse_observability_metrics(text: str) -> dict:
         if "{" in metric:
             name, raw = metric.split("{", 1)
             labels = _parse_prom_labels(raw.rstrip("}"))
-        if name.startswith("tpushare_slo_"):
+        if name == "tpushare_build_info":
+            component = labels.pop("component", "") or "?"
+            out["build"][component] = labels
+        elif name.startswith("tpushare_slo_"):
             tier = labels.get("tier", "")
             if not tier:
                 continue
@@ -166,7 +192,7 @@ def fetch_observability_metrics(urls: list[str]) -> dict:
     :func:`fetch_engine_metrics`)."""
     import requests
 
-    out: dict = {"engine": {}, "slo": {}, "governor": {}}
+    out: dict = {"engine": {}, "slo": {}, "governor": {}, "build": {}}
     for url in urls:
         full = url.rstrip("/")
         if not full.endswith("/metrics"):
@@ -179,7 +205,7 @@ def fetch_observability_metrics(urls: list[str]) -> dict:
             print(f"warning: {full} unreachable: {e}", file=sys.stderr)
             continue
         parsed = parse_observability_metrics(text)
-        for section in ("engine", "slo", "governor"):
+        for section in ("engine", "slo", "governor", "build"):
             for key, row in parsed[section].items():
                 out[section].setdefault(key, {}).update(row)
     return out
@@ -207,6 +233,127 @@ def fetch_engine_metrics(urls: list[str]) -> dict[str, dict[str, float]]:
         for pod, row in parse_engine_metrics(text).items():
             out.setdefault(pod, {}).update(row)
     return out
+
+
+def fetch_decisions(urls: list[str], pod: str) -> list[dict]:
+    """Fetch + merge one pod's decision records from every
+    ``/decisions`` endpoint given (the extender's and the node daemon's
+    metrics ports each hold their process's half of the admission
+    story). Records are deduped by (verb, id, time) — ids are
+    per-process — and ordered by emission time. Unreachable endpoints
+    warn but do not fail: a partial "why" beats none (same policy as
+    :func:`fetch_trace_spans`)."""
+    merged: dict[tuple, dict] = {}
+    for doc in _fetch_json_docs(urls, "/decisions", {"pod": pod}):
+        for rec in doc.get("records") or []:
+            key = (rec.get("verb"), rec.get("id"), rec.get("time_unix"))
+            merged.setdefault(key, rec)
+    return sorted(
+        merged.values(),
+        key=lambda r: (r.get("time_unix", 0.0), r.get("id", 0)),
+    )
+
+
+def why_main(argv: list[str]) -> int:
+    """``kubectl-inspect-tpushare why [ns/]pod``: render the pod's full
+    admission decision tree — every rejected node with its reason, the
+    score breakdowns (winner vs runner-up at raw resolution), the chosen
+    placement, WAL seq, and the stitched trace id
+    (docs/observability.md)."""
+    from .display import render_why
+
+    p = argparse.ArgumentParser(
+        prog="kubectl-inspect-tpushare why",
+        description="Explain one pod's admission decisions",
+    )
+    p.add_argument("pod", help="[namespace/]name of a share pod")
+    p.add_argument("--decisions-url", action="append", default=[],
+                   metavar="URL",
+                   help="a /decisions endpoint to fetch records from "
+                   "(the extender's and/or node daemon's --metrics-"
+                   "port); repeatable — records from all endpoints are "
+                   "merged into one story")
+    p.add_argument("-o", "--output", default="tree", choices=["tree", "json"])
+    args = p.parse_args(argv)
+    ns, _, name = args.pod.rpartition("/")
+    pod_key = f"{ns or 'default'}/{name}"
+    if not args.decisions_url:
+        print(
+            "error: no --decisions-url given — point me at the "
+            "extender's and/or node daemon's metrics port (e.g. "
+            "--decisions-url http://node:9114)",
+            file=sys.stderr,
+        )
+        return 1
+    records = fetch_decisions(args.decisions_url, pod_key)
+    if args.output == "json":
+        json.dump(records, sys.stdout, indent=2)
+        print()
+        return 0
+    if not records:
+        print(
+            f"error: no decision records for {pod_key} (admitted before "
+            "provenance, emission disabled, or the ring already evicted "
+            "it)",
+            file=sys.stderr,
+        )
+        return 1
+    sys.stdout.write(render_why(pod_key, records))
+    return 0
+
+
+def fetch_timeline(urls: list[str]) -> dict:
+    """Fetch + merge ``/timeline`` documents (per-field union; the same
+    field from several endpoints merges by bucket time, later endpoints
+    winning ties). Unreachable endpoints warn but do not fail."""
+    merged: dict = {"bucket_s": None, "span_s": None, "series": {}}
+    for doc in _fetch_json_docs(urls, "/timeline"):
+        if merged["bucket_s"] is None:
+            merged["bucket_s"] = doc.get("bucket_s")
+            merged["span_s"] = doc.get("span_s")
+        for field, points in (doc.get("series") or {}).items():
+            byt = {t: v for t, v in merged["series"].get(field, [])}
+            byt.update({t: v for t, v in points})
+            merged["series"][field] = [
+                [t, byt[t]] for t in sorted(byt)
+            ]
+    return merged
+
+
+def timeline_main(argv: list[str]) -> int:
+    """``kubectl-inspect-tpushare timeline``: sparkline view of the
+    cluster-state timeline ring (utilization, stranded %, pending/gang
+    queue depth, SLO burn) served on ``/timeline``."""
+    from .display import render_timeline
+
+    p = argparse.ArgumentParser(
+        prog="kubectl-inspect-tpushare timeline",
+        description="Cluster-state timeline sparklines",
+    )
+    p.add_argument("--timeline-url", action="append", default=[],
+                   metavar="URL",
+                   help="a /timeline endpoint (a daemon's --metrics-"
+                   "port); repeatable — series are merged")
+    p.add_argument("--width", type=int, default=48,
+                   help="sparkline width in buckets")
+    p.add_argument("-o", "--output", default="spark",
+                   choices=["spark", "json"])
+    args = p.parse_args(argv)
+    if not args.timeline_url:
+        print(
+            "error: no --timeline-url given — point me at a node "
+            "daemon's metrics port (e.g. --timeline-url "
+            "http://node:9114)",
+            file=sys.stderr,
+        )
+        return 1
+    doc = fetch_timeline(args.timeline_url)
+    if args.output == "json":
+        json.dump(doc, sys.stdout, indent=2)
+        print()
+        return 0
+    sys.stdout.write(render_timeline(doc, width=args.width))
+    return 0
 
 
 def trace_main(argv: list[str]) -> int:
@@ -360,6 +507,10 @@ def main(argv=None) -> int:
         return flightrecord_main(argv[1:])
     if argv and argv[0] == "top":
         return top_main(argv[1:])
+    if argv and argv[0] == "why":
+        return why_main(argv[1:])
+    if argv and argv[0] == "timeline":
+        return timeline_main(argv[1:])
     p = argparse.ArgumentParser(
         prog="kubectl-inspect-tpushare",
         description="Display TPU-share HBM utilization across the cluster",
@@ -386,7 +537,12 @@ def main(argv=None) -> int:
         print(f"error: cannot reach the cluster: {e}", file=sys.stderr)
         return 1
     infos = build_all_node_infos(nodes, pods)
-    engine = fetch_engine_metrics(args.metrics_url) if args.metrics_url else None
+    obs = (
+        fetch_observability_metrics(args.metrics_url)
+        if args.metrics_url else None
+    )
+    engine = obs["engine"] if obs is not None else None
+    build = (obs or {}).get("build") or None
     if args.output == "json":
         sys.stdout.write(render_json(infos, engine))
         return 0
@@ -394,7 +550,7 @@ def main(argv=None) -> int:
         print("no shared-TPU nodes found (allocatable aliyun.com/tpu-mem is 0 everywhere)")
         return 0
     out = (
-        render_details(infos, engine)
+        render_details(infos, engine, build=build)
         if args.details or engine is not None
         else render_summary(infos)
     )

@@ -25,6 +25,7 @@ from ..allocator.binpack import AssignmentError, assign_chip
 from ..cluster import pods as P
 from ..cluster.noderes import chip_capacity_vector
 from ..topology import ChipTopology, shape_size
+from ..utils.decisions import ScoreVector, chip_breakdown
 
 # resource name -> annotation/label vocabulary
 RESOURCE_FAMILIES = {
@@ -167,45 +168,69 @@ def pod_gang_shape(pod: dict, resource: str) -> str:
     return P.gang_shape_request(pod)
 
 
+def _zero_score(policy: str, request_units: int) -> ScoreVector:
+    return ScoreVector(
+        policy=policy, raw=0.0, free_units=0,
+        request_units=request_units, binpack=0.0,
+    )
+
+
 def _gang_eval(
     view: NodeView, shape_raw: str, request_units: int, policy: str
-) -> tuple["object | None", int, str, int]:
+) -> tuple["object | None", int, str, ScoreVector]:
     """One node's gang answer: -> (best candidate or None, per-chip
-    units, failure reason, 0-10 score). The score reuses the single-chip
-    policy semantics at per-chip granularity over the winning slice's
-    members, so gang and single-chip node ranking stay comparable."""
+    units, failure reason, :class:`ScoreVector`). The score reuses the
+    single-chip policy semantics at per-chip granularity over the
+    winning slice's members — so gang and single-chip node ranking stay
+    comparable — and carries the slice's multi-objective components
+    (ICI hops, stranded slivers, broken chips, tie-break) from
+    ``best_slice_scored`` for decision provenance."""
     try:
         size = shape_size(shape_raw)
     except ValueError as e:
-        return None, 0, f"invalid gang shape {shape_raw!r}: {e}", 0
+        return (
+            None, 0, f"invalid gang shape {shape_raw!r}: {e}",
+            _zero_score(policy, request_units),
+        )
     if size < 1 or request_units <= 0 or request_units % size:
         return (
             None, 0,
             f"{request_units} units of {view.resource} do not divide "
             f"evenly over gang shape {shape_raw!r} ({size} chips)",
-            0,
+            _zero_score(policy, request_units),
         )
     per_chip = request_units // size
     topo = view.topology or node_topology({}, view.capacity)
     if topo is None:
-        return None, 0, f"node does not advertise {view.resource}", 0
+        return (
+            None, 0, f"node does not advertise {view.resource}",
+            _zero_score(policy, request_units),
+        )
     free = view.free()
-    cand = topo.best_slice(
+    scored = topo.best_slice_scored(
         shape_raw, free, per_chip,
         capacity=view.capacity, excluded=view.core_held,
     )
-    if cand is None:
+    if scored is None:
         return (
             None, per_chip,
             f"no {shape_raw} sub-slice with {per_chip} free units of "
             f"{view.resource} per chip (free: {free})",
-            0,
+            _zero_score(policy, per_chip),
         )
-    score = _score_free(
+    cand, slice_score = scored
+    base = _score_free(
         [free[i] for i in cand.chips],
         max(view.capacity.values(), default=0),
         per_chip,
         policy,
+    )
+    score = dataclasses.replace(
+        base,
+        ici_hops=slice_score.hops,
+        stranded=slice_score.stranded,
+        broken=slice_score.broken,
+        tie_break=slice_score.tie_break,
     )
     return cand, per_chip, "", score
 
@@ -278,18 +303,29 @@ def filter_nodes(
 
 def _score_free(
     free_values, cap: int, request_units: int, policy: str
-) -> int:
+) -> ScoreVector:
+    """The policy score over a free vector as a structured
+    :class:`ScoreVector`: the raw fractional 0-10 score (full
+    resolution — the deterministic tie-break the integer projection
+    cannot provide at fleet scale), the decisive chip's free units, and
+    the binpack slack term. Chip selection (tightest feasible for
+    packing, roomiest for spread) lives here; the scoring formula
+    itself is ``chip_breakdown`` — ONE implementation shared with the
+    allocator's provenance records. The webhook wire format projects
+    ``.projected`` (round + clamp — bit-identical to the old bare-int
+    return, pinned by the existing verb tests)."""
     feasible = [f for f in free_values if f >= request_units]
     if not feasible or cap <= 0:
-        return 0
-    if policy == "spread":
-        return round(10 * (max(feasible) - request_units) / cap)
-    best = min(feasible)
-    return round(10 * (1 - (best - request_units) / cap))
+        return _zero_score(policy, request_units)
+    decisive = max(feasible) if policy == "spread" else min(feasible)
+    return chip_breakdown(decisive, cap, None, request_units, policy)
 
 
-def score_node(view: NodeView, request_units: int, policy: str = "best-fit") -> int:
-    """Node score 0-10, consistent with the chip-level policy.
+def score_node_vector(
+    view: NodeView, request_units: int, policy: str = "best-fit"
+) -> ScoreVector:
+    """Node score as a structured :class:`ScoreVector`, consistent with
+    the chip-level policy.
 
     Packing policies (first-fit/best-fit) prefer the node whose tightest
     feasible chip leaves the least slack (consolidates fragments, keeps
@@ -304,19 +340,44 @@ def score_node(view: NodeView, request_units: int, policy: str = "best-fit") -> 
     )
 
 
+def score_node(view: NodeView, request_units: int, policy: str = "best-fit") -> int:
+    """Node score 0-10 (the webhook wire projection of
+    :func:`score_node_vector`)."""
+    return score_node_vector(view, request_units, policy).projected
+
+
+def chip_score_vector(
+    view: NodeView, idx: int, request_units: int, policy: str = "best-fit"
+) -> ScoreVector:
+    """The breakdown for one CHOSEN chip (bind-time provenance): the
+    chip's pre-claim free units and its slack term, with the chip index
+    as the tie-break. Unlike :func:`score_node_vector` this scores the
+    concrete decision, not the node's best case."""
+    return chip_breakdown(
+        view.free().get(idx, 0),
+        max(view.capacity.values(), default=0),
+        idx,
+        request_units,
+        policy,
+    )
+
+
 def evaluate_filter_and_scores(
     request_units: int,
     views: list[NodeView],
     policy: str = "best-fit",
     gang_shape: str = "",
-) -> tuple[list[str], dict[str, str], dict[str, int]]:
-    """One pass over prebuilt views -> (fits, failed reasons, scores for
-    the fitting nodes). The batched filter+prioritize: each view's free
-    vector is computed once and serves both the fit check and the score,
-    where the two-verb protocol recomputes it per verb."""
+) -> tuple[list[str], dict[str, str], dict[str, ScoreVector]]:
+    """One pass over prebuilt views -> (fits, failed reasons, score
+    breakdowns for the fitting nodes). The batched filter+prioritize:
+    each view's free vector is computed once and serves both the fit
+    check and the score, where the two-verb protocol recomputes it per
+    verb. Scores are full :class:`ScoreVector` breakdowns — the webhook
+    response projects ``.projected``; the decision record keeps the
+    whole vector."""
     fits: list[str] = []
     failed: dict[str, str] = {}
-    scores: dict[str, int] = {}
+    scores: dict[str, ScoreVector] = {}
     for view in views:
         if not view.capacity:
             failed[view.name] = f"node does not advertise {view.resource}"
@@ -348,18 +409,35 @@ def evaluate_filter_and_scores(
     return fits, failed, scores
 
 
+def evaluate_score_vectors(
+    request_units: int,
+    views: list[NodeView],
+    policy: str = "best-fit",
+    gang_shape: str = "",
+) -> dict[str, ScoreVector]:
+    if gang_shape:
+        return {
+            v.name: _gang_eval(v, gang_shape, request_units, policy)[3]
+            for v in views
+        }
+    return {
+        v.name: score_node_vector(v, request_units, policy) for v in views
+    }
+
+
 def evaluate_scores(
     request_units: int,
     views: list[NodeView],
     policy: str = "best-fit",
     gang_shape: str = "",
 ) -> dict[str, int]:
-    if gang_shape:
-        return {
-            v.name: _gang_eval(v, gang_shape, request_units, policy)[3]
-            for v in views
-        }
-    return {v.name: score_node(v, request_units, policy) for v in views}
+    """The 0-10 wire projection of :func:`evaluate_score_vectors`."""
+    return {
+        name: sv.projected
+        for name, sv in evaluate_score_vectors(
+            request_units, views, policy, gang_shape
+        ).items()
+    }
 
 
 def prioritize_with_views(
@@ -367,12 +445,18 @@ def prioritize_with_views(
     nodes: list[dict],
     views_fn: Callable[[str, list[dict]], list["NodeView"]],
     policy: str = "best-fit",
-) -> dict[str, int]:
+) -> dict[str, ScoreVector]:
+    """Per-node score breakdowns for the prioritize verb. The webhook
+    projects each vector to its pinned 0-10 integer; the decision
+    record keeps the full-resolution breakdown."""
     resource = pod_resource(pod)
     if resource is None:
-        return {n.get("metadata", {}).get("name", ""): 0 for n in nodes}
+        return {
+            n.get("metadata", {}).get("name", ""): _zero_score(policy, 0)
+            for n in nodes
+        }
     request = P.mem_units_of_pod(pod, resource=resource)
-    return evaluate_scores(
+    return evaluate_score_vectors(
         request, views_fn(resource, nodes), policy,
         gang_shape=pod_gang_shape(pod, resource),
     )
@@ -381,7 +465,12 @@ def prioritize_with_views(
 def prioritize_nodes(
     pod: dict, nodes: list[dict], pods: list[dict], policy: str = "best-fit"
 ) -> dict[str, int]:
-    return prioritize_with_views(pod, nodes, views_from_pods(pods), policy)
+    return {
+        name: sv.projected
+        for name, sv in prioritize_with_views(
+            pod, nodes, views_from_pods(pods), policy
+        ).items()
+    }
 
 
 def choose_chip(
@@ -403,16 +492,30 @@ def choose_gang_from_view(
     pod: dict, view: NodeView, policy: str = "best-fit"
 ) -> tuple[str, tuple[int, ...], int, dict[str, str]]:
     """Bind-time gang decision over a prebuilt view: -> (resource, member
-    chips, per-chip units, annotations to write). The annotations are the
-    whole gang in ONE write — member chips, normalized shape, per-chip
-    share, assigned=false — so the claim lands all-or-nothing and the
-    device plugin's branch A can re-validate and honor it atomically.
+    chips, per-chip units, annotations to write). The score-less form of
+    :func:`choose_gang_scored`."""
+    resource, chips, per_chip, annotations, _score = choose_gang_scored(
+        pod, view, policy=policy
+    )
+    return resource, chips, per_chip, annotations
+
+
+def choose_gang_scored(
+    pod: dict, view: NodeView, policy: str = "best-fit"
+) -> tuple[str, tuple[int, ...], int, dict[str, str], ScoreVector]:
+    """Bind-time gang decision over a prebuilt view: -> (resource, member
+    chips, per-chip units, annotations to write, score breakdown). The
+    annotations are the whole gang in ONE write — member chips,
+    normalized shape, per-chip share, assigned=false — so the claim
+    lands all-or-nothing and the device plugin's branch A can
+    re-validate and honor it atomically. The :class:`ScoreVector` is the
+    winning slice's breakdown, surfaced for the bind decision record.
     Raises ``AssignmentError`` when no feasible sub-slice remains."""
     resource = view.resource
     family = RESOURCE_FAMILIES[resource]
     shape_raw = pod_gang_shape(pod, resource)
     request = P.mem_units_of_pod(pod, resource=resource)
-    cand, per_chip, reason, _score = _gang_eval(
+    cand, per_chip, reason, score = _gang_eval(
         view, shape_raw, request, policy
     )
     if cand is None:
@@ -437,13 +540,26 @@ def choose_gang_from_view(
         family["assume"]: str(time.time_ns()),
         const.ANN_EXTENDER_ALLOCATION: json.dumps(alloc_map),
     }
-    return resource, cand.chips, per_chip, annotations
+    return resource, cand.chips, per_chip, annotations, score
 
 
 def choose_chip_from_view(
     pod: dict, view: NodeView, policy: str = "best-fit"
 ) -> tuple[str, int, dict[str, str]]:
-    """``choose_chip`` over a prebuilt view (the index-backed path)."""
+    """``choose_chip`` over a prebuilt view (the index-backed path); the
+    score-less form of :func:`choose_chip_scored`."""
+    resource, idx, annotations, _score = choose_chip_scored(
+        pod, view, policy=policy
+    )
+    return resource, idx, annotations
+
+
+def choose_chip_scored(
+    pod: dict, view: NodeView, policy: str = "best-fit"
+) -> tuple[str, int, dict[str, str], ScoreVector]:
+    """``choose_chip`` over a prebuilt view, plus the chosen chip's
+    score breakdown (pre-claim free units, binpack slack) for the bind
+    decision record."""
     resource = view.resource
     family = RESOURCE_FAMILIES[resource]
     request = P.mem_units_of_pod(pod, resource=resource)
@@ -454,6 +570,7 @@ def choose_chip_from_view(
         unhealthy=sorted(view.core_held),
         policy=policy,
     )
+    score = chip_score_vector(view, idx, request, policy)
     containers = pod.get("spec", {}).get("containers", [])
     alloc_map = {
         c.get("name", f"c{i}"): {str(idx): P.mem_units_of_container(c, resource)}
@@ -468,4 +585,4 @@ def choose_chip_from_view(
         family["assume"]: str(time.time_ns()),
         const.ANN_EXTENDER_ALLOCATION: json.dumps(alloc_map),
     }
-    return resource, idx, annotations
+    return resource, idx, annotations, score

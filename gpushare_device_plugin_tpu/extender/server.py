@@ -29,6 +29,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from ..allocator.binpack import AssignmentError
 from ..cluster import pods as P
 from ..cluster.apiserver import ApiError, ApiServerClient
+from ..utils.decisions import DECISIONS, rank_scores
 from ..utils.log import get_logger
 from ..utils import log as logutil
 from ..utils.tracing import ADMISSIONS, TRACER, SpanContext
@@ -160,6 +161,16 @@ class ExtenderCore:
             )
 
     # --- helpers ----------------------------------------------------------
+
+    def ready(self) -> bool:
+        """Readiness for the metrics server's ``/readyz``: the informer
+        has synced (decisions serve from the incremental index instead
+        of cold LISTs) — serve-from-checkpoint warmup already completed
+        in the constructor, so a constructed core has replayed its WAL.
+        List-mode cores (no informer) are ready immediately."""
+        if self._informer is None:
+            return True
+        return bool(self._informer.synced)
 
     def _use_index(self) -> bool:
         """The index serves reads only once the informer has synced: before
@@ -394,6 +405,14 @@ class ExtenderCore:
             return None
         return ADMISSIONS.root(meta.get("namespace", "default"), name)
 
+    @staticmethod
+    def _pod_key_of(pod: dict) -> str:
+        meta = pod.get("metadata", {}) if pod else {}
+        name = meta.get("name", "")
+        if not name:
+            return ""
+        return f"{meta.get('namespace', 'default')}/{name}"
+
     def filter(self, args: dict) -> dict:
         pod = args.get("pod") or {}
         nodes = self._nodes_from_args(args)
@@ -412,6 +431,13 @@ class ExtenderCore:
             self._drain_expired_aborts()
         log.v(4, "filter %s: fits=%s failed=%s",
               pod.get("metadata", {}).get("name"), fits, list(failed))
+        # Decision provenance: every rejected node with its reason, built
+        # from the dicts the verb already computed (no copies).
+        DECISIONS.emit(
+            self._pod_key_of(pod), "filter",
+            candidates=len(nodes), rejected=failed,
+            trace_id=ctx.trace_id if ctx is not None else "",
+        )
         fit_set = set(fits)
         return {
             "nodes": {"items": [n for n in nodes
@@ -436,7 +462,17 @@ class ExtenderCore:
                 sp.set_attribute("scored", len(scores))
         finally:
             self._drain_expired_aborts()
-        return [{"host": host, "score": score} for host, score in scores.items()]
+        DECISIONS.emit(
+            self._pod_key_of(pod), "prioritize",
+            candidates=len(nodes), scores=scores,
+            trace_id=ctx.trace_id if ctx is not None else "",
+        )
+        # The wire format stays the pinned 0-10 integer projection; the
+        # decision record above keeps the full-resolution breakdown.
+        return [
+            {"host": host, "score": sv.projected}
+            for host, sv in scores.items()
+        ]
 
     def batch(self, args: dict) -> dict:
         """Batched filter + prioritize in one verb: one view build and one
@@ -450,6 +486,11 @@ class ExtenderCore:
         resource = logic.pod_resource(pod)
         if resource is None:
             names = [n.get("metadata", {}).get("name", "") for n in nodes]
+            DECISIONS.emit(
+                self._pod_key_of(pod), "batch",
+                candidates=len(nodes),
+                reason="pod requests no share resource (all nodes pass)",
+            )
             return {
                 "nodes": {"items": nodes},
                 "nodenames": names,
@@ -472,14 +513,24 @@ class ExtenderCore:
                 sp.set_attribute("fits", len(fits))
         finally:
             self._drain_expired_aborts()
+        DECISIONS.emit(
+            self._pod_key_of(pod), "batch",
+            candidates=len(nodes), rejected=failed, scores=scores,
+            trace_id=ctx.trace_id if ctx is not None else "",
+        )
         fit_set = set(fits)
         return {
             "nodes": {"items": [n for n in nodes
                                 if n.get("metadata", {}).get("name") in fit_set]},
             "nodenames": fits,
             "failedNodes": failed,
+            # 0-10 wire projection, ordered best-first by the RAW
+            # fractional score (deterministic tie-break — the integer
+            # scale ties most nodes at fleet scale; the wire VALUES are
+            # unchanged, only the list order is pinned).
             "hostPriorityList": [
-                {"host": name, "score": scores[name]} for name in fits
+                {"host": name, "score": scores[name].projected}
+                for name in rank_scores(scores)
             ],
             "error": "",
         }
@@ -562,15 +613,15 @@ class ExtenderCore:
                         # chip, reserved whole in the in-flight overlay
                         # before any network write — all-or-nothing from
                         # the first moment
-                        _, chips, per_chip, annotations = (
-                            logic.choose_gang_from_view(
+                        _, chips, per_chip, annotations, score = (
+                            logic.choose_gang_scored(
                                 pod, view, policy=self._policy
                             )
                         )
                         idx, units = chips[0], per_chip
                     else:
                         chips = ()
-                        _, idx, annotations = logic.choose_chip_from_view(
+                        _, idx, annotations, score = logic.choose_chip_scored(
                             pod, view, policy=self._policy
                         )
                         units = P.mem_units_of_pod(pod, resource=resource)
@@ -643,14 +694,32 @@ class ExtenderCore:
                 component="tpushare-scheduler-extender",
                 host=node_name,
             )
+            # a rejected bind deserves a "why" as much as a granted one
+            DECISIONS.emit(
+                f"{ns}/{name}", "bind", outcome="error",
+                node=node_name, reason=str(e),
+                trace_id=bsp.trace_id if bsp.recording else "",
+            )
             return {"error": str(e)}
         if chips:
+            placement = {
+                "chips": list(chips),
+                "per_chip": units,
+                "shape": annotations.get(logic.const.ENV_GANG_SHAPE, ""),
+            }
             log.info(
                 "bound gang %s/%s -> %s chips %s (%d units/chip)",
                 ns, name, node_name, list(chips), units,
             )
         else:
+            placement = {"chip": idx, "units": units}
             log.info("bound %s/%s -> %s chip %d", ns, name, node_name, idx)
+        DECISIONS.emit(
+            f"{ns}/{name}", "bind",
+            node=node_name, scores={node_name: score}, placement=placement,
+            trace_id=bsp.trace_id if bsp.recording else "",
+            seq=seq,
+        )
         return {"error": ""}
 
 
@@ -774,15 +843,39 @@ def main(argv: list[str] | None = None) -> int:
                    "pod's filter->bind trace is kept with this "
                    "probability (0 disables tracing; unsampled "
                    "admissions pay O(ns))")
+    p.add_argument("--decisions-ring", type=int, default=512,
+                   help="in-memory decision-provenance ring size (per-"
+                   "verb 'why' records served on /decisions; 0 disables "
+                   "emission)")
+    p.add_argument("--decisions-log", default="",
+                   help="optional on-disk decision segment log (JSON "
+                   "lines, fsync-free, size-rotated); empty disables")
     p.add_argument("-v", "--verbosity", type=int, default=0)
     args = p.parse_args(argv)
     logutil.setup(args.verbosity)
     TRACER.configure(sample_ratio=args.trace_sample)
+    DECISIONS.configure(
+        enabled=args.decisions_ring > 0,
+        max_records=max(1, args.decisions_ring),
+        segment_path=args.decisions_log,
+    )
+    # The metrics server (and its /healthz — the liveness probe) comes up
+    # FIRST: informer sync, WAL load, and the core's serve-from-
+    # checkpoint warmup can take long after a crash storm, and a
+    # liveness probe that cannot reach /healthz during replay would
+    # kubelet-kill the container into an eternal replay loop. /readyz is
+    # late-bound: 503 until the core exists AND reports ready (informer
+    # synced + warmup done in its constructor).
+    core_ref: list[ExtenderCore] = []
     metrics_server = None
     if args.metrics_port:
-        from ..utils.metrics import MetricsServer
+        from ..utils.metrics import MetricsServer, publish_build_info
 
-        metrics_server = MetricsServer(port=args.metrics_port).start()
+        publish_build_info(component="extender")
+        metrics_server = MetricsServer(
+            port=args.metrics_port,
+            ready_fn=lambda: bool(core_ref) and core_ref[0].ready(),
+        ).start()
         log.info("metrics on :%d/metrics", metrics_server.port)
     try:
         api = ApiServerClient.from_env(timeout_s=args.timeout)
@@ -805,12 +898,11 @@ def main(argv: list[str] | None = None) -> int:
             )
         except OSError as e:
             log.warning("bind checkpoint unavailable (%s); running without", e)
-    server = ExtenderHTTPServer(
-        ExtenderCore(
-            api, policy=args.policy, informer=informer, checkpoint=checkpoint
-        ),
-        host=args.host, port=args.port,
+    core = ExtenderCore(
+        api, policy=args.policy, informer=informer, checkpoint=checkpoint
     )
+    core_ref.append(core)
+    server = ExtenderHTTPServer(core, host=args.host, port=args.port)
     server.start()
     try:
         threading.Event().wait()

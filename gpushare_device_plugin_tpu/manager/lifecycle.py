@@ -89,6 +89,16 @@ class ManagerConfig:
     defrag_interval_s: float = 0.0
     defrag_quantum: int = 0
     defrag_max_moves: int = 8
+    # Cluster-state timeline recorder (utils/timeline.py): every interval
+    # the daemon folds utilization / stranded% / pending depth / SLO burn
+    # into the bounded timeline ring (served on /timeline, embedded in
+    # flight-recorder dumps). Cheap (one chip_state read per tick), so
+    # on by default; <= 0 disables.
+    timeline_interval_s: float = 10.0
+    # Decision-provenance ring (utils/decisions.py): per-verb "why"
+    # records served on /decisions. 0 disables emission.
+    decisions_ring: int = 512
+    decisions_log_path: str = ""
 
 
 class TpuShareManager:
@@ -154,11 +164,33 @@ class TpuShareManager:
         # phases (workloads that checkpoint themselves).
         self._defrag = None
         self._interference = None  # InterferenceLoop (cluster/interference.py)
+        self._timeline_loop = None  # TimelineLoop (utils/timeline.py)
+        # Decision-provenance configuration applies process-wide (the
+        # allocators emit through the module singleton).
+        from ..utils.decisions import DECISIONS
+
+        DECISIONS.configure(
+            enabled=config.decisions_ring > 0,
+            max_records=max(1, config.decisions_ring),
+            segment_path=config.decisions_log_path,
+        )
         self._move_drain_fn = None
         self._move_restore_fn = None
         self._restart = threading.Event()
         self._stop = threading.Event()
         self._park = threading.Event()
+        self._parked = False  # no-TPU node: healthy, serving nothing
+
+    def ready(self) -> bool:
+        """Daemon readiness for the metrics server's ``/readyz``: every
+        served plugin has completed kubelet registration (an unregistered
+        plugin serves no pods, whatever its socket says). A parked daemon
+        (no TPUs on the node) reads ready — it is healthy and
+        intentionally serving nothing."""
+        if self._parked:
+            return True
+        plugins = list(self._plugins)
+        return bool(plugins) and all(p.registered for p in plugins)
 
     def set_move_hooks(self, drain_fn=None, restore_fn=None) -> None:
         """Register the defragmenter's engine hand-off: ``drain_fn(pod_key)
@@ -518,6 +550,7 @@ class TpuShareManager:
                 quantum=self._cfg.defrag_quantum,
                 excluded_fn=_excluded,
                 max_moves=self._cfg.defrag_max_moves,
+                node=self._cfg.node_name,
             )
             mover = SliceMover(
                 self._api,
@@ -563,8 +596,66 @@ class TpuShareManager:
                 interval_s=self._cfg.interference_interval_s,
                 scrape_urls=self._cfg.interference_scrape_urls,
             ).start()
+        # Cluster-state timeline recorder (utils/timeline.py): fold
+        # utilization / fragmentation / queue depth / SLO burn into the
+        # bounded ring every tick — read-only sources, each best-effort.
+        if (
+            self._pod_source is not None
+            and not self._cfg.standalone
+            and self._cfg.timeline_interval_s > 0
+        ):
+            from ..allocator.defrag import STRANDED_PCT_GAUGE
+            from ..cluster import pods as PODS
+            from ..utils.metrics import REGISTRY
+            from ..utils.timeline import TIMELINE, TimelineLoop
+
+            total_units = sum(inventory.units_by_index().values())
+            pod_source = self._pod_source
+
+            def _util_pct():
+                if not total_units:
+                    return None
+                mem_used, _held = pod_source.chip_state()
+                return 100.0 * sum(mem_used.values()) / total_units
+
+            def _queue_depth():
+                # ONE pending-pod read feeds both series (a second LIST
+                # per tick would double the control-plane read load on
+                # list-backed sources, from two different snapshots)
+                pending = pod_source.pending_share_pods(const.RESOURCE_MEM)
+                return {
+                    "pending_pods": float(len(pending)),
+                    "pending_gangs": float(sum(
+                        1 for p in pending if PODS.gang_shape_request(p)
+                    )),
+                }
+
+            def _stranded_pct():
+                return REGISTRY.gauge_value(STRANDED_PCT_GAUGE)
+
+            def _slo_burn_5m():
+                series = REGISTRY.gauge_series("tpushare_slo_burn_rate")
+                vals = [
+                    v for labels, v in series.items()
+                    if dict(labels).get("window") == "5m"
+                ]
+                return max(vals) if vals else None
+
+            self._timeline_loop = TimelineLoop(
+                TIMELINE,
+                {
+                    "util_pct": _util_pct,
+                    "queue_depth": _queue_depth,
+                    "stranded_pct": _stranded_pct,
+                    "slo_burn_5m": _slo_burn_5m,
+                },
+                interval_s=self._cfg.timeline_interval_s,
+            ).start()
 
     def _stop_all(self) -> None:
+        if self._timeline_loop is not None:
+            self._timeline_loop.stop()
+            self._timeline_loop = None
         if self._interference is not None:
             self._interference.stop()
             self._interference = None
@@ -663,6 +754,7 @@ class TpuShareManager:
             # DaemonSet stays green on heterogenous fleets
             # (gpumanager.go:36-47 semantics).
             log.info("no TPU chips found on this node; parking")
+            self._parked = True
             self._park.wait()
             return
         # Restart detection across the whole device-plugin dir: kubelet.sock

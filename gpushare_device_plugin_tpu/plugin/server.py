@@ -294,6 +294,7 @@ class TpuSharePlugin(DevicePluginServicer):
             os.unlink(path)
         os.makedirs(self._cfg.plugin_dir, exist_ok=True)
         self._stopping = False
+        self._registered = False
         server = grpc.server(
             futures.ThreadPoolExecutor(
                 max_workers=self._cfg.grpc_workers,
@@ -326,7 +327,15 @@ class TpuSharePlugin(DevicePluginServicer):
                 ),
                 timeout=timeout,
             )
+        self._registered = True
         log.v(1, "registered %s with kubelet", self._cfg.resource_name)
+
+    @property
+    def registered(self) -> bool:
+        """True once this plugin announced itself to kubelet (the
+        daemon's ``/readyz`` gate: an unregistered plugin serves no
+        pods, whatever its socket says)."""
+        return getattr(self, "_registered", False)
 
     def serve(self) -> None:
         self.start()

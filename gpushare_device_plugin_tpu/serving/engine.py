@@ -334,6 +334,11 @@ class SlotEngine:
         self.slo_budget = slo_budget
         self.governor = governor
         self._warming = False
+        # Build identity on the engine's /metrics too (idempotent gauge;
+        # the inspect header reads it off any scraped endpoint).
+        from ..utils.metrics import publish_build_info
+
+        publish_build_info(component="engine")
         self._build_fns()
 
     def _make_cache(self, kv_dtype: str | None):

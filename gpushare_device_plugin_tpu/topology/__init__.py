@@ -1,6 +1,7 @@
 from .model import (
     ChipTopology,
     SliceCandidate,
+    SliceScore,
     format_shape,
     pad3,
     parse_shape,
@@ -10,6 +11,7 @@ from .model import (
 __all__ = [
     "ChipTopology",
     "SliceCandidate",
+    "SliceScore",
     "format_shape",
     "pad3",
     "parse_shape",

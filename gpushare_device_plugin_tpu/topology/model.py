@@ -102,6 +102,30 @@ class SliceCandidate:
     hops: int
 
 
+@dataclasses.dataclass(frozen=True)
+class SliceScore:
+    """The winning slice's multi-objective score components, in the
+    lexicographic order :meth:`ChipTopology.best_slice` minimizes them:
+    ICI hops, stranded slivers, broken whole chips, lowest-chip
+    tie-break. Surfaced (rather than computed and discarded) so the
+    decision-provenance layer can record *by what margin* a slice won —
+    the policy-introspection seam pluggable placement policies
+    implement."""
+
+    hops: int
+    stranded: int
+    broken: int
+    tie_break: int
+
+    def to_dict(self) -> dict[str, int]:
+        return {
+            "ici_hops": self.hops,
+            "stranded": self.stranded,
+            "broken": self.broken,
+            "tie_break": self.tie_break,
+        }
+
+
 class ChipTopology:
     """One node's chip grid. Chip index is row-major with x fastest:
     ``index = x + X*(y + Y*z)`` — matching the order discovery enumerates
@@ -258,7 +282,8 @@ class ChipTopology:
         excluded: Iterable[int] = (),
     ) -> SliceCandidate | None:
         """The best feasible sub-slice for ``shape_raw`` at ``per_chip``
-        units per member chip, or None when nothing fits.
+        units per member chip, or None when nothing fits (the score-less
+        convenience form of :meth:`best_slice_scored`).
 
         Feasible: every member chip has >= ``per_chip`` free units and is
         not in ``excluded`` (unhealthy / core-held chips). ``capacity``
@@ -266,6 +291,23 @@ class ChipTopology:
         omitted, a chip whose free equals the max observed free is treated
         as whole.
         """
+        scored = self.best_slice_scored(
+            shape_raw, free, per_chip, capacity=capacity, excluded=excluded
+        )
+        return None if scored is None else scored[0]
+
+    def best_slice_scored(
+        self,
+        shape_raw: str,
+        free: Mapping[int, int],
+        per_chip: int,
+        *,
+        capacity: Mapping[int, int] | None = None,
+        excluded: Iterable[int] = (),
+    ) -> tuple[SliceCandidate, SliceScore] | None:
+        """:meth:`best_slice` plus the winner's :class:`SliceScore` —
+        the objective components the ranking minimized, surfaced for
+        decision provenance instead of discarded."""
         if per_chip < 0:
             raise ValueError(f"per_chip must be >= 0, got {per_chip}")
         banned = set(excluded)
@@ -285,4 +327,8 @@ class ChipTopology:
             key = (cand.hops, stranded, broken, cand.chips[0])
             if best is None or key < best:
                 best, best_cand = key, cand
-        return best_cand
+        if best_cand is None or best is None:
+            return None
+        return best_cand, SliceScore(
+            hops=best[0], stranded=best[1], broken=best[2], tie_break=best[3]
+        )

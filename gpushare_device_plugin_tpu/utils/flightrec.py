@@ -180,7 +180,13 @@ class FlightRecorder:
     # --- dump -------------------------------------------------------------
 
     def snapshot(self, reason: str) -> dict[str, Any]:
-        """The dump document, built from snapshots (no I/O under locks)."""
+        """The dump document, built from snapshots (no I/O under locks).
+        Includes the cluster-state timeline ring (utils/timeline.py) so
+        the postmortem carries the minutes of utilization/fragmentation/
+        queue-depth/SLO-burn history *before* the crash, not just the
+        instant of death."""
+        from .timeline import TIMELINE
+
         trace_ids = self._store.trace_ids()
         return {
             "reason": reason,
@@ -191,6 +197,7 @@ class FlightRecorder:
             "dropped_traces": self._store.dropped(),
             "traces": self._store.to_otlp(),
             "logs": self.recent_logs(),
+            "timeline": TIMELINE.to_doc(),
         }
 
     def dump(self, reason: str) -> str:

@@ -156,6 +156,29 @@ RANKS: dict[str, LockRank] = dict(
             "page hook (flight-recorder dump) outside.",
         ),
         _r(
+            "decisions.ring", 65, "lock", False,
+            "DecisionLog's bounded ring of admission decision records: "
+            "verbs append AFTER their locked decision sections (no other "
+            "lock held), the /decisions endpoint snapshots under it and "
+            "serializes outside. Pure memory — the segment write runs "
+            "under decisions.segment, never here.",
+        ),
+        _r(
+            "decisions.segment", 66, "lock", True,
+            "DecisionLog's on-disk segment appender: one JSON line per "
+            "record, flushed to the OS buffer but never fsynced "
+            "(provenance is observability, not durability — the WAL owns "
+            "that). I/O by definition; taken only after decisions.ring "
+            "is released.",
+        ),
+        _r(
+            "timeline.ring", 67, "lock", False,
+            "ClusterTimeline's time-bucketed sample ring: the sampler "
+            "loop writes one bucket per tick, /timeline and the flight "
+            "recorder snapshot under it and serialize outside. Pure "
+            "memory, fixed-size by construction.",
+        ),
+        _r(
             "wal.batcher", 70, "condition", False,
             "GroupBatcher's queue condition: submit() runs under "
             "checkpoint.journal; the flush itself happens with the "

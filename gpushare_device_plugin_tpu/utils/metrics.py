@@ -17,7 +17,7 @@ from __future__ import annotations
 import contextlib
 import threading
 import time
-from typing import Any, Iterator
+from typing import Any, Callable, Iterator
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from . import tracing
@@ -261,6 +261,52 @@ class MetricsRegistry:
 # Process-wide default registry (the daemon's single plugin process).
 REGISTRY = MetricsRegistry()
 
+BUILD_INFO_GAUGE = "tpushare_build_info"
+_BUILD_FACTS: dict[str, str] | None = None  # computed once per process
+
+
+def _build_facts() -> dict[str, str]:
+    global _BUILD_FACTS
+    if _BUILD_FACTS is None:
+        import os
+        import platform
+
+        from .. import __version__
+
+        try:
+            from importlib.metadata import version as _pkg_version
+
+            jax_version = _pkg_version("jax")
+        except Exception:  # noqa: BLE001 — images without jax
+            jax_version = "none"
+        _BUILD_FACTS = {
+            "version": __version__,
+            "git_rev": os.environ.get("TPUSHARE_GIT_REV", "unknown"),
+            "python": platform.python_version(),
+            "jax": jax_version,
+        }
+    return _BUILD_FACTS
+
+
+def publish_build_info(
+    component: str, registry: MetricsRegistry | None = None
+) -> dict[str, str]:
+    """Export the ``tpushare_build_info`` gauge (value 1; the facts ride
+    the labels, Prometheus convention) for one component: package
+    version, git revision (baked into the image as ``TPUSHARE_GIT_REV``;
+    containers have no .git), python and jax versions. Returns the label
+    set so CLIs can render the same header. jax's version is read from
+    package metadata, NOT by importing jax — the control-plane processes
+    stay jax-free; the facts are computed once per process."""
+    labels = {"component": component, **_build_facts()}
+    (registry or REGISTRY).gauge_set(
+        BUILD_INFO_GAUGE, 1.0,
+        "Build/runtime identity (value is always 1; the labels carry "
+        "version, git revision, python and jax versions)",
+        **labels,
+    )
+    return labels
+
 
 @contextlib.contextmanager
 def timed_acquire(
@@ -286,23 +332,45 @@ def timed_acquire(
 
 
 class MetricsServer:
-    """Minimal /metrics + /traces + /healthz HTTP endpoint (off by
-    default; the daemon enables it with --metrics-port).
+    """Minimal /metrics + /traces + /decisions + /timeline + /healthz +
+    /readyz HTTP endpoint (off by default; the daemon enables it with
+    --metrics-port).
 
     ``/metrics`` negotiates the exposition: classic text format 0.0.4 by
     default, OpenMetrics (with histogram exemplars linking latency
     buckets to trace ids) when the scraper's Accept header names
     ``application/openmetrics-text``. ``/traces`` serves the in-process
     trace store as OTLP-JSON (``?trace_id=<id>`` narrows to one trace —
-    what ``kubectl-inspect-tpushare trace`` fetches)."""
+    what ``kubectl-inspect-tpushare trace`` fetches). ``/decisions``
+    serves the decision-provenance ring as JSON (``?pod=ns/name`` /
+    ``?verb=`` narrow — what ``inspect why`` fetches); ``/timeline``
+    serves the cluster-state timeline ring (``inspect timeline``).
+    ``/healthz`` is liveness (200 while the server thread runs);
+    ``/readyz`` consults ``ready_fn`` — 200 when it returns truthy, 503
+    otherwise (deploy probes gate on informer sync + WAL replay for the
+    extender, plugin registration for the daemon)."""
 
     def __init__(self, registry: MetricsRegistry = REGISTRY,
                  host: str = "0.0.0.0", port: int = 0,
-                 trace_store: "tracing.TraceStore | None" = None) -> None:
+                 trace_store: "tracing.TraceStore | None" = None,
+                 decisions: Any = None,
+                 timeline: Any = None,
+                 ready_fn: Callable[[], bool] | None = None) -> None:
         self._registry = registry
         self._host = host
         self._port = port
         self._store = trace_store if trace_store is not None else tracing.STORE
+        if decisions is None:
+            from .decisions import DECISIONS
+
+            decisions = DECISIONS
+        self._decisions = decisions
+        if timeline is None:
+            from .timeline import TIMELINE
+
+            timeline = TIMELINE
+        self._timeline = timeline
+        self._ready_fn = ready_fn
         self._server: ThreadingHTTPServer | None = None
 
     @property
@@ -313,6 +381,9 @@ class MetricsServer:
     def start(self) -> "MetricsServer":
         registry = self._registry
         store = self._store
+        decisions = self._decisions
+        timeline = self._timeline
+        ready_fn = self._ready_fn
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
@@ -340,9 +411,32 @@ class MetricsServer:
                     tid = (q.get("trace_id") or [None])[0]
                     body = _json.dumps(store.to_otlp(trace_id=tid)).encode()
                     ctype = "application/json"
+                elif url.path == "/decisions":
+                    q = parse_qs(url.query)
+                    doc = decisions.to_doc(
+                        pod=(q.get("pod") or [None])[0],
+                        verb=(q.get("verb") or [None])[0],
+                    )
+                    body = _json.dumps(doc).encode()
+                    ctype = "application/json"
+                elif url.path == "/timeline":
+                    body = _json.dumps(timeline.to_doc()).encode()
+                    ctype = "application/json"
                 elif url.path == "/healthz":
                     body = b"ok\n"
                     ctype = "text/plain"
+                elif url.path == "/readyz":
+                    try:
+                        ready = ready_fn is None or bool(ready_fn())
+                    except Exception:  # noqa: BLE001 — not ready, not dead
+                        ready = False
+                    body = b"ok\n" if ready else b"not ready\n"
+                    self.send_response(200 if ready else 503)
+                    self.send_header("Content-Type", "text/plain")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 else:
                     body = b"not found\n"
                     self.send_response(404)

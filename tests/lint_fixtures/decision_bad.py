@@ -1,0 +1,36 @@
+"""Fixture: decision emission NOT dominated on all outcome paths —
+every function here must be flagged by the ``decision-outcome`` rule."""
+
+
+class _Log:
+    def emit(self, *a, **k):
+        pass
+
+
+DECISIONS = _Log()
+
+
+def bad_return_without_emit(x):
+    """A rejection branch returns before any emit: the refused pod has
+    no 'why' record."""
+    if x < 0:
+        return None
+    DECISIONS.emit("ns/p", "verb")
+    return x
+
+
+def bad_fallthrough(x):
+    """Only one branch emits; the other completes normally silent."""
+    if x:
+        DECISIONS.emit("ns/p", "verb")
+
+
+def bad_swallowing_handler(x):
+    """The handler swallows the failure and returns without an
+    error-outcome emit."""
+    try:
+        y = int(x)
+    except ValueError:
+        return None
+    DECISIONS.emit("ns/p", "verb")
+    return y

@@ -1,0 +1,47 @@
+"""Fixture: the canonical decision-emission shapes — none of these may
+be flagged by the ``decision-outcome`` rule."""
+
+
+class _Log:
+    def emit(self, *a, **k):
+        pass
+
+
+class _Failure(RuntimeError):
+    pass
+
+
+DECISIONS = _Log()
+
+
+def _decide(x):
+    if x < 0:
+        raise _Failure("no fit")
+    return x
+
+
+def ok_emit_then_return(x):
+    """The simple linear verb: decide, emit, return."""
+    y = _decide(x)
+    DECISIONS.emit("ns/p", "verb")
+    return y
+
+
+def ok_error_emit_and_reraise(x):
+    """The canonical failure shape: emit outcome=error, then propagate
+    (propagation itself is legal, as in wal-protocol)."""
+    try:
+        y = _decide(x)
+    except _Failure as e:
+        DECISIONS.emit("ns/p", "verb", outcome="error", reason=str(e))
+        raise
+    DECISIONS.emit("ns/p", "verb")
+    return y
+
+
+def ok_branches_both_emit(x):
+    if x:
+        DECISIONS.emit("ns/p", "verb", outcome="error")
+        return None
+    DECISIONS.emit("ns/p", "verb")
+    return x

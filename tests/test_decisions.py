@@ -1,0 +1,520 @@
+"""Decision provenance + cluster timeline units: ScoreVector math, the
+DecisionLog ring/segment, ClusterTimeline/TimelineLoop, the /decisions
+and /timeline endpoints, /readyz, build info, and the CLI renders."""
+
+from __future__ import annotations
+
+import json
+import threading
+
+import pytest
+import requests
+
+from gpushare_device_plugin_tpu.cli.display import (
+    render_timeline,
+    render_why,
+    sparkline,
+)
+from gpushare_device_plugin_tpu.extender import logic
+from gpushare_device_plugin_tpu.topology import ChipTopology, SliceScore
+from gpushare_device_plugin_tpu.utils.decisions import (
+    DecisionLog,
+    ScoreVector,
+    chip_breakdown,
+    rank_scores,
+)
+from gpushare_device_plugin_tpu.utils.metrics import (
+    BUILD_INFO_GAUGE,
+    MetricsRegistry,
+    MetricsServer,
+    publish_build_info,
+)
+from gpushare_device_plugin_tpu.utils.timeline import (
+    MAX_FIELDS,
+    ClusterTimeline,
+    TimelineLoop,
+)
+
+
+# --- ScoreVector ------------------------------------------------------------
+
+
+def _view(capacity, used, policy_resource="aliyun.com/tpu-mem"):
+    return logic.NodeView(
+        name="n", resource=policy_resource, capacity=capacity, used=used
+    )
+
+
+def test_projection_matches_legacy_integer_scale():
+    """The 0-10 wire projection must be bit-identical to the old bare
+    round() return for both policies."""
+    view = _view({0: 32, 1: 32}, {0: 30})
+    for policy in ("best-fit", "first-fit", "spread"):
+        sv = logic.score_node_vector(view, 4, policy)
+        legacy = (
+            round(10 * (max(32 - 0, 0) - 4) / 32)
+            if policy == "spread"
+            else round(10 * (1 - (32 - 4) / 32))
+        )
+        assert sv.projected == legacy == logic.score_node(view, 4, policy)
+
+
+def test_raw_score_breaks_integer_ties():
+    """Two nodes that tie at the 0-10 scale differ at raw resolution —
+    the fleet-scale tie-break the projection cannot provide."""
+    tight = _view({0: 64}, {0: 30})   # free 34
+    tighter = _view({0: 64}, {0: 31})  # free 33
+    a = logic.score_node_vector(tight, 4, "best-fit")
+    b = logic.score_node_vector(tighter, 4, "best-fit")
+    assert a.projected == b.projected  # tied on the wire
+    assert b.raw > a.raw  # but not at full resolution
+    assert rank_scores({"tight": a, "tighter": b}) == ["tighter", "tight"]
+
+
+def test_rank_scores_equal_raw_orders_by_name():
+    sv = ScoreVector(
+        policy="best-fit", raw=5.0, free_units=8, request_units=4,
+        binpack=0.5,
+    )
+    assert rank_scores({"b": sv, "a": sv}) == ["a", "b"]
+
+
+def test_chip_breakdown_terms():
+    sv = chip_breakdown(12, 32, 2, 4, "best-fit")
+    assert sv.free_units == 12
+    assert sv.tie_break == 2
+    assert sv.binpack == pytest.approx(8 / 32)
+    assert sv.raw == pytest.approx(10 * (1 - 8 / 32))
+    assert sv.projected == round(sv.raw)
+    # infeasible chip degrades to the zero vector, never raises
+    assert chip_breakdown(2, 32, 0, 4, "best-fit").raw == 0.0
+
+
+def test_gang_eval_carries_slice_objective():
+    view = logic.NodeView(
+        name="g", resource="aliyun.com/tpu-mem",
+        capacity={i: 32 for i in range(4)}, used={},
+        topology=logic.node_topology({}, {i: 32 for i in range(4)}),
+    )
+    cand, per_chip, reason, sv = logic._gang_eval(view, "2x1", 16, "best-fit")
+    assert cand is not None and reason == ""
+    assert per_chip == 8
+    assert sv.ici_hops == 1  # adjacent pair
+    assert sv.stranded == (32 - 8) * 2
+    assert sv.tie_break == cand.chips[0]
+    assert sv.to_dict()["ici_hops"] == 1
+
+
+def test_best_slice_scored_matches_best_slice():
+    topo = ChipTopology((2, 2, 1))
+    free = {0: 16, 1: 16, 2: 4, 3: 16}
+    scored = topo.best_slice_scored("2x1", free, 8, capacity={i: 16 for i in range(4)})
+    assert scored is not None
+    cand, score = scored
+    assert cand == topo.best_slice("2x1", free, 8, capacity={i: 16 for i in range(4)})
+    assert isinstance(score, SliceScore)
+    assert score.tie_break == cand.chips[0]
+    assert topo.best_slice_scored("2x2", {i: 4 for i in range(4)}, 8) is None
+
+
+# --- DecisionLog ------------------------------------------------------------
+
+
+def test_ring_is_hard_bounded_and_counts_drops():
+    log = DecisionLog(max_records=8)
+    for i in range(50):
+        log.emit(f"default/p{i}", "filter")
+    assert log.size() == 8
+    assert log.dropped() == 42
+    # newest survive
+    assert [r.pod for r in log.records()] == [
+        f"default/p{i}" for i in range(42, 50)
+    ]
+
+
+def test_records_filter_by_pod_verb_and_moves():
+    log = DecisionLog()
+    log.emit("default/a", "filter")
+    log.emit("default/a", "bind", node="n1")
+    log.emit("default/b", "bind")
+    log.emit("", "defrag_plan", moves=["default/a"])
+    assert [r.verb for r in log.records(pod="default/a")] == [
+        "filter", "bind", "defrag_plan",
+    ]
+    assert [r.pod for r in log.records(verb="bind")] == [
+        "default/a", "default/b",
+    ]
+    assert len(log.records(pod="default/a", verb="bind", limit=1)) == 1
+
+
+def test_disabled_log_emits_nothing():
+    log = DecisionLog()
+    log.configure(enabled=False)
+    assert log.emit("default/p", "filter") is None
+    assert log.size() == 0
+    log.configure(enabled=True)
+    assert log.emit("default/p", "filter") is not None
+
+
+def test_record_doc_round_trips_scores():
+    log = DecisionLog()
+    sv = chip_breakdown(12, 32, 1, 4, "best-fit")
+    log.emit(
+        "default/p", "bind", node="n1", scores={"n1": sv},
+        placement={"chip": 1, "units": 4}, trace_id="t" * 32, seq=7,
+    )
+    doc = log.to_doc(pod="default/p")
+    rec = doc["records"][-1]
+    assert rec["scores"]["n1"]["free_units"] == 12
+    assert rec["scores"]["n1"]["projected"] == sv.projected
+    assert rec["seq"] == 7
+    assert rec["trace_id"] == "t" * 32
+    json.dumps(doc)  # the endpoint body must be serializable
+
+
+def test_segment_log_writes_json_lines_and_rotates(tmp_path):
+    path = tmp_path / "decisions.log"
+    log = DecisionLog(segment_path=str(path), segment_max_bytes=400)
+    for i in range(20):
+        log.emit(f"default/p{i}", "filter", candidates=3)
+    log.close()
+    lines = [
+        json.loads(line)
+        for line in path.read_text().splitlines()
+    ]
+    assert lines, "active segment is empty"
+    rotated = path.with_name(path.name + ".1")
+    assert rotated.exists(), "no rotation happened under the size bound"
+    assert path.stat().st_size <= 400 + 200  # one record of slack
+    # rotation keeps exactly one predecessor — a disk ring, not a leak
+    assert not path.with_name(path.name + ".2").exists()
+
+
+def test_segment_log_survives_unwritable_path(tmp_path):
+    blocker = tmp_path / "not-a-dir"
+    blocker.write_text("")
+    # the segment "directory" is a file: every open attempt fails
+    log = DecisionLog(segment_path=str(blocker / "x.log"))
+    # must not raise: provenance is best-effort, admission never fails
+    # because the dump disk is sick — the ring still has the record
+    log.emit("default/p", "filter")
+    log.emit("default/p2", "filter")
+    assert log.size() == 2
+
+
+def test_emit_under_concurrent_writers_stays_bounded():
+    log = DecisionLog(max_records=64)
+
+    def storm(i):
+        for j in range(200):
+            log.emit(f"default/w{i}-{j}", "filter")
+
+    threads = [threading.Thread(target=storm, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert log.size() == 64
+    assert log.dropped() == 8 * 200 - 64
+
+
+# --- ClusterTimeline --------------------------------------------------------
+
+
+def test_timeline_folds_samples_into_buckets():
+    clock = [100.0]
+    tl = ClusterTimeline(bucket_s=10.0, buckets=6, clock=lambda: clock[0])
+    tl.sample(util_pct=10.0)
+    tl.sample(util_pct=20.0)  # same bucket: last write wins
+    clock[0] = 115.0
+    tl.sample(util_pct=30.0, pending=2.0)
+    assert tl.series("util_pct") == [(100.0, 20.0), (110.0, 30.0)]
+    assert tl.series("pending") == [(110.0, 2.0)]
+    doc = tl.to_doc()
+    assert doc["bucket_s"] == 10.0
+    assert doc["series"]["util_pct"] == [[100.0, 20.0], [110.0, 30.0]]
+
+
+def test_timeline_gaps_render_as_missing_not_flat():
+    clock = [100.0]
+    tl = ClusterTimeline(bucket_s=10.0, buckets=8, clock=lambda: clock[0])
+    tl.sample(v=1.0)
+    clock[0] = 150.0  # 4 empty buckets pass
+    tl.sample(v=2.0)
+    assert tl.series("v") == [(100.0, 1.0), (150.0, 2.0)]
+
+
+def test_timeline_ring_is_hard_bounded():
+    clock = [0.0]
+    tl = ClusterTimeline(bucket_s=1.0, buckets=5, clock=lambda: clock[0])
+    for i in range(1000):
+        clock[0] = float(i)
+        tl.sample(v=float(i))
+    assert len(tl.series("v")) == 5
+    assert tl.series("v")[-1] == (999.0, 999.0)
+
+
+def test_timeline_field_table_is_capped():
+    tl = ClusterTimeline(bucket_s=1.0, buckets=4, clock=lambda: 0.0)
+    for i in range(MAX_FIELDS + 20):
+        tl.sample(**{f"f{i}": 1.0})
+    assert len(tl.fields()) == MAX_FIELDS
+
+
+def test_timeline_loop_multi_field_source():
+    """One underlying read can feed several series (the manager's
+    queue-depth source derives pending_pods AND pending_gangs from a
+    single pending-pod list — never two LISTs per tick)."""
+    tl = ClusterTimeline(bucket_s=1.0, buckets=4, clock=lambda: 0.0)
+    calls = {"n": 0}
+
+    def queue_depth():
+        calls["n"] += 1
+        return {"pending_pods": 5.0, "pending_gangs": 2.0}
+
+    loop = TimelineLoop(tl, {"queue_depth": queue_depth}, interval_s=0.01)
+    fields = loop.run_once()
+    assert calls["n"] == 1
+    assert fields == {"pending_pods": 5.0, "pending_gangs": 2.0}
+    assert tl.series("pending_gangs") == [(0.0, 2.0)]
+    assert tl.series("queue_depth") == []  # the label is not a series
+
+
+def test_timeline_loop_sources_are_best_effort():
+    tl = ClusterTimeline(bucket_s=1.0, buckets=4, clock=lambda: 0.0)
+    loop = TimelineLoop(
+        tl,
+        {
+            "good": lambda: 42.0,
+            "none": lambda: None,
+            "boom": lambda: 1 / 0,
+            "garbled": lambda: "not-a-number",
+        },
+        interval_s=0.01,
+    )
+    fields = loop.run_once()
+    assert fields == {"good": 42.0}
+    assert tl.series("good") == [(0.0, 42.0)]
+    assert tl.series("boom") == []
+
+
+def test_flight_recorder_embeds_timeline():
+    from gpushare_device_plugin_tpu.utils import flightrec
+    from gpushare_device_plugin_tpu.utils.timeline import TIMELINE
+
+    TIMELINE.clear()
+    try:
+        TIMELINE.sample(util_pct=50.0)
+        doc = flightrec.FlightRecorder().snapshot("unit")
+        assert "util_pct" in doc["timeline"]["series"]
+        assert doc["timeline"]["series"]["util_pct"][-1][1] == 50.0
+    finally:
+        TIMELINE.clear()
+
+
+# --- endpoints --------------------------------------------------------------
+
+
+@pytest.fixture
+def server_bits():
+    registry = MetricsRegistry()
+    log = DecisionLog()
+    tl = ClusterTimeline(bucket_s=10.0, buckets=8, clock=lambda: 100.0)
+    ready = {"ok": False}
+    srv = MetricsServer(
+        registry=registry, host="127.0.0.1", port=0,
+        decisions=log, timeline=tl, ready_fn=lambda: ready["ok"],
+    ).start()
+    yield srv, registry, log, tl, ready
+    srv.stop()
+
+
+def test_decisions_endpoint_serves_and_filters(server_bits):
+    srv, _reg, log, _tl, _ready = server_bits
+    log.emit("default/a", "filter", candidates=2)
+    log.emit("default/b", "bind", node="n1")
+    url = f"http://127.0.0.1:{srv.port}/decisions"
+    doc = requests.get(url).json()
+    assert len(doc["records"]) == 2
+    doc = requests.get(url, params={"pod": "default/b"}).json()
+    assert [r["verb"] for r in doc["records"]] == ["bind"]
+    doc = requests.get(url, params={"verb": "filter"}).json()
+    assert [r["pod"] for r in doc["records"]] == ["default/a"]
+
+
+def test_timeline_endpoint_serves_doc(server_bits):
+    srv, _reg, _log, tl, _ready = server_bits
+    tl.sample(util_pct=12.5)
+    doc = requests.get(f"http://127.0.0.1:{srv.port}/timeline").json()
+    assert doc["series"]["util_pct"][-1][1] == 12.5
+
+
+def test_readyz_gates_on_ready_fn(server_bits):
+    srv, _reg, _log, _tl, ready = server_bits
+    base = f"http://127.0.0.1:{srv.port}"
+    assert requests.get(f"{base}/healthz").status_code == 200
+    assert requests.get(f"{base}/readyz").status_code == 503
+    ready["ok"] = True
+    assert requests.get(f"{base}/readyz").status_code == 200
+
+
+def test_readyz_without_ready_fn_is_ready():
+    srv = MetricsServer(
+        registry=MetricsRegistry(), host="127.0.0.1", port=0,
+        decisions=DecisionLog(), timeline=ClusterTimeline(),
+    ).start()
+    try:
+        assert (
+            requests.get(f"http://127.0.0.1:{srv.port}/readyz").status_code
+            == 200
+        )
+    finally:
+        srv.stop()
+
+
+def test_build_info_gauge_and_parse():
+    from gpushare_device_plugin_tpu import __version__
+    from gpushare_device_plugin_tpu.cli.inspect import (
+        parse_observability_metrics,
+    )
+
+    registry = MetricsRegistry()
+    labels = publish_build_info("daemon", registry=registry)
+    assert labels["version"] == __version__
+    text = registry.render()
+    assert BUILD_INFO_GAUGE in text
+    parsed = parse_observability_metrics(text)
+    assert parsed["build"]["daemon"]["version"] == __version__
+    assert "python" in parsed["build"]["daemon"]
+
+
+# --- renders ----------------------------------------------------------------
+
+
+WHY_RECORDS = [
+    {
+        "id": 3, "time_unix": 1.0, "pod": "default/p1", "verb": "filter",
+        "outcome": "ok", "candidates": 3,
+        "rejected": {"node-b": "no single chip with 4 free units"},
+        "trace_id": "ab" * 16,
+    },
+    {
+        "id": 4, "time_unix": 2.0, "pod": "default/p1", "verb": "batch",
+        "outcome": "ok", "candidates": 3,
+        "scores": {
+            "node-a": {
+                "policy": "best-fit", "raw": 8.75, "projected": 9,
+                "free_units": 8, "request_units": 4, "binpack": 0.125,
+            },
+            "node-c": {
+                "policy": "best-fit", "raw": 8.125, "projected": 8,
+                "free_units": 10, "request_units": 4, "binpack": 0.1875,
+            },
+        },
+    },
+    {
+        "id": 5, "time_unix": 3.0, "pod": "default/p1", "verb": "bind",
+        "outcome": "ok", "node": "node-a",
+        "scores": {
+            "node-a": {
+                "policy": "best-fit", "raw": 8.75, "projected": 9,
+                "free_units": 8, "request_units": 4, "binpack": 0.125,
+                "tie_break": 2,
+            },
+        },
+        "placement": {"chip": 2, "units": 4},
+        "seq": 7, "trace_id": "ab" * 16,
+    },
+]
+
+WHY_GOLDEN = """\
+pod default/p1 — 3 decision record(s)
+[#3] filter
+   candidates: 3 (1 rejected)
+   x node-b: no single chip with 4 free units
+   trace abababababababababababababababab
+[#4] batch
+   candidates: 3
+   > node-a  raw=8.7500 wire=9/10 free=8 req=4 binpack=0.125
+     node-c  raw=8.1250 wire=8/10 free=10 req=4 binpack=0.188
+   margin: node-a leads node-c by 0.6250 raw
+[#5] bind -> node-a
+   > node-a  raw=8.7500 wire=9/10 free=8 req=4 binpack=0.125 tie_break=2
+   placement: chip 2 · 4 units
+   wal seq 7 · trace abababababababababababababababab
+"""
+
+
+def test_render_why_golden():
+    assert render_why("default/p1", WHY_RECORDS) == WHY_GOLDEN
+
+
+def test_render_why_error_and_empty():
+    out = render_why("default/p2", [
+        {
+            "id": 9, "verb": "bind", "outcome": "error", "node": "n1",
+            "reason": "no fit",
+        },
+    ])
+    assert "FAILED" in out
+    assert "reason: no fit" in out
+    empty = render_why("default/p3", [])
+    assert "no decision records" in empty
+
+
+def test_render_why_gang_breakdown():
+    out = render_why("default/g1", [
+        {
+            "id": 2, "verb": "allocate_gang", "outcome": "ok", "node": "n",
+            "scores": {
+                "slice": {
+                    "policy": "topology", "raw": 7.5, "projected": 8,
+                    "free_units": 32, "request_units": 8, "binpack": 0.75,
+                    "ici_hops": 1, "stranded": 48, "broken": 2,
+                    "tie_break": 0,
+                },
+            },
+            "placement": {
+                "chips": [0, 1], "shape": "2x1x1", "per_chip": 8,
+                "source": "binpack",
+            },
+        },
+    ])
+    assert "ici_hops=1" in out
+    assert "stranded=48" in out
+    assert "chips 0,1" in out
+    assert "shape 2x1x1" in out
+    assert "[binpack]" in out
+
+
+TIMELINE_DOC = {
+    "bucket_s": 10.0,
+    "span_s": 3600.0,
+    "series": {
+        "util_pct": [[0.0, 0.0], [10.0, 50.0], [20.0, 100.0]],
+        "pending_pods": [[0.0, 3.0], [10.0, 3.0], [20.0, 3.0]],
+        "empty": [],
+    },
+}
+
+TIMELINE_GOLDEN = """\
+cluster timeline — bucket 10.0s, span 3600.0s
+pending_pods  ▄▄▄  last=3 min=3 max=3 n=3
+util_pct      ▁▄█  last=100 min=0 max=100 n=3
+"""
+
+
+def test_render_timeline_golden():
+    assert render_timeline(TIMELINE_DOC) == TIMELINE_GOLDEN
+
+
+def test_render_timeline_empty():
+    assert "(no samples yet)" in render_timeline({"series": {}})
+
+
+def test_sparkline_scales_and_windows():
+    assert sparkline([]) == ""
+    assert sparkline([1.0, 1.0, 1.0]) == "▄▄▄"
+    line = sparkline(list(range(100)), width=10)
+    assert len(line) == 10
+    assert line[0] == "▁" and line[-1] == "█"

@@ -1,0 +1,258 @@
+"""Decision-provenance end-to-end smoke (``make decisions-smoke``): one
+admission through the real extender webhook verbs + the real plugin gRPC
+path leaves a complete, queryable "why" — for BOTH the single-chip and
+the gang path — whose trace id matches the stitched PR 8 admission
+trace; /decisions serves it; ``kubectl-inspect-tpushare why`` renders
+the decision tree; and the decision ring stays hard-bounded under a
+verb storm."""
+
+from __future__ import annotations
+
+import json
+import time
+
+import pytest
+import requests
+
+from gpushare_device_plugin_tpu import const
+from gpushare_device_plugin_tpu.allocator.cluster import ClusterAllocator
+from gpushare_device_plugin_tpu.cli import inspect as inspect_cli
+from gpushare_device_plugin_tpu.cluster.apiserver import ApiServerClient
+from gpushare_device_plugin_tpu.cluster.informer import PodInformer
+from gpushare_device_plugin_tpu.device import DeviceInventory
+from gpushare_device_plugin_tpu.discovery import MockBackend
+from gpushare_device_plugin_tpu.extender.server import ExtenderCore
+from gpushare_device_plugin_tpu.plugin import PluginConfig, TpuSharePlugin
+from gpushare_device_plugin_tpu.utils import tracing
+from gpushare_device_plugin_tpu.utils.decisions import DECISIONS
+from gpushare_device_plugin_tpu.utils.metrics import MetricsServer
+
+from fake_apiserver import FakeApiServer
+from fake_kubelet import FakeKubelet
+from k8s_fixtures import make_pod
+
+NODE = "why-node"
+SMALL = "why-small"  # 1 chip x 2 units: rejects any real request
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state():
+    tracing.STORE.clear()
+    tracing.TRACER.configure(sample_ratio=1.0)
+    DECISIONS.clear()
+    DECISIONS.configure(enabled=True, max_records=512)
+    yield
+    tracing.STORE.clear()
+    DECISIONS.clear()
+    DECISIONS.configure(enabled=True, max_records=512)
+
+
+@pytest.fixture
+def cluster():
+    api = FakeApiServer()
+    api.add_node(
+        NODE,
+        capacity={const.RESOURCE_MEM: "128", const.RESOURCE_COUNT: "4"},
+    )
+    api.add_node(
+        SMALL,
+        capacity={const.RESOURCE_MEM: "2", const.RESOURCE_COUNT: "1"},
+    )
+    api.start()
+    client = ApiServerClient(api.url)
+    informer = PodInformer(client, NODE).start()
+    yield api, client, informer
+    informer.stop()
+    api.stop()
+
+
+def _admit(api, client, informer, tmp_path, name, units, annotations=None):
+    """One full admission: extender filter (against BOTH nodes, so the
+    small one contributes a rejection reason) + bind, then a REAL gRPC
+    Allocate. Returns the pod's trace-id annotation value."""
+    api.add_pod(make_pod(name, units, node="", annotations=annotations or {}))
+    core = ExtenderCore(client)
+    nodes = [client.get_node(NODE), client.get_node(SMALL)]
+    result = core.filter({
+        "pod": client.get_pod("default", name), "nodes": {"items": nodes},
+    })
+    assert result["nodenames"] == [NODE]
+    assert SMALL in result["failedNodes"]
+    r = core.bind({"podName": name, "podNamespace": "default", "node": NODE})
+    assert r["error"] == "", r
+    ann = client.get_pod("default", name)["metadata"]["annotations"]
+    raw = ann[const.ANN_TRACE_ID]
+    deadline = time.monotonic() + 5
+    marker = (
+        const.ENV_GANG_CHIPS
+        if (annotations or {}).get(const.ANN_GANG_SHAPE)
+        else const.ENV_MEM_IDX
+    )
+    while time.monotonic() < deadline:
+        cached = informer.get_pod("default", name)
+        if cached is not None and marker in (
+            cached["metadata"].get("annotations") or {}
+        ):
+            break
+        time.sleep(0.01)
+    inv = DeviceInventory(
+        MockBackend(num_chips=4, hbm_bytes=32 << 30).chips()
+    )
+    kubelet = FakeKubelet(str(tmp_path))
+    kubelet.start()
+    allocator = ClusterAllocator(inv, client, informer, NODE)
+    plugin = TpuSharePlugin(
+        inv,
+        allocate_fn=allocator.allocate,
+        config=PluginConfig(plugin_dir=str(tmp_path)),
+    )
+    plugin.serve()
+    try:
+        assert plugin.registered  # the daemon /readyz gate's signal
+        reg = kubelet.wait_for_registration()
+        resp = kubelet.allocate(
+            reg.endpoint, [[f"g{i}" for i in range(units)]]
+        )
+        assert resp.container_responses[0].envs[const.ENV_TPU_VISIBLE_CHIPS]
+    finally:
+        plugin.stop()
+        kubelet.stop()
+    return raw
+
+
+def _records(pod_key):
+    return {r.verb: r for r in DECISIONS.records(pod=pod_key)}
+
+
+def test_mem_admission_leaves_complete_queryable_why(cluster, tmp_path):
+    api, client, informer = cluster
+    raw = _admit(api, client, informer, tmp_path, "p1", 4)
+    trace_id = raw.split(":", 1)[0]
+    by_verb = _records("default/p1")
+    # filter: every rejected node carries a reason
+    assert "filter" in by_verb
+    filt = by_verb["filter"]
+    assert filt.candidates == 2
+    assert "no single chip with 4 free units" in filt.rejected[SMALL]
+    assert filt.trace_id == trace_id
+    # bind: the chosen placement carries a full score breakdown + seq slot
+    bind = by_verb["bind"]
+    assert bind.node == NODE
+    assert bind.placement["chip"] == 0
+    assert bind.placement["units"] == 4
+    sv = bind.scores[NODE]
+    assert sv.free_units == 32
+    assert sv.request_units == 4
+    assert 0.0 <= sv.raw <= 10.0
+    assert sv.projected == round(sv.raw)
+    # the record's trace id matches the stitched PR 8 trace annotation
+    assert bind.trace_id == trace_id
+    # the device plugin's allocate verb stitched into the SAME trace
+    alloc = by_verb["allocate"]
+    assert alloc.trace_id == trace_id
+    assert alloc.node == NODE
+    assert alloc.placement["source"] == "extender-assumed"
+    assert alloc.placement["chip"] == 0
+    # and that trace really exists in the PR 8 store
+    span_names = {s.name for s in tracing.STORE.trace(trace_id)}
+    assert "extender.bind" in span_names
+    assert "allocator.admit" in span_names
+
+
+def test_gang_admission_leaves_complete_queryable_why(cluster, tmp_path):
+    api, client, informer = cluster
+    raw = _admit(
+        api, client, informer, tmp_path, "g1", 16,
+        annotations={const.ANN_GANG_SHAPE: "2x1"},
+    )
+    trace_id = raw.split(":", 1)[0]
+    by_verb = _records("default/g1")
+    # filter rejected the small node with a gang-specific reason
+    assert "sub-slice" in by_verb["filter"].rejected[SMALL]
+    # bind: gang placement with the slice's multi-objective breakdown
+    bind = by_verb["bind"]
+    assert bind.placement["chips"] == [0, 1]
+    assert bind.placement["per_chip"] == 8
+    assert bind.placement["shape"] == "2x1x1"
+    sv = bind.scores[NODE]
+    assert sv.ici_hops == 1
+    assert sv.stranded == (32 - 8) * 2
+    assert sv.broken is not None and sv.tie_break == 0
+    assert bind.trace_id == trace_id
+    # allocate_gang honored the extender's decision, same trace
+    alloc = by_verb["allocate_gang"]
+    assert alloc.trace_id == trace_id
+    assert alloc.placement["chips"] == [0, 1]
+    assert alloc.placement["source"] == "extender-assumed"
+
+
+def test_decisions_endpoint_and_inspect_why_render(cluster, tmp_path, capsys):
+    api, client, informer = cluster
+    _admit(api, client, informer, tmp_path, "p1", 4)
+    srv = MetricsServer(host="127.0.0.1", port=0).start()
+    try:
+        url = f"http://127.0.0.1:{srv.port}"
+        doc = requests.get(
+            f"{url}/decisions", params={"pod": "default/p1"}
+        ).json()
+        verbs = [r["verb"] for r in doc["records"]]
+        assert "filter" in verbs and "bind" in verbs and "allocate" in verbs
+        # the CLI renders the full decision tree from the same endpoint
+        rc = inspect_cli.main([
+            "why", "default/p1", "--decisions-url", url,
+        ])
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        assert "pod default/p1" in out
+        assert f"x {SMALL}:" in out          # rejected node with reason
+        assert "no single chip" in out
+        assert f"bind -> {NODE}" in out
+        assert "raw=" in out and "wire=" in out and "binpack=" in out
+        assert "placement: chip 0" in out
+        assert "trace " in out
+        # json mode emits the merged flat record list
+        rc = inspect_cli.main([
+            "why", "default/p1", "--decisions-url", url, "-o", "json",
+        ])
+        assert rc == 0
+        records = json.loads(capsys.readouterr().out)
+        assert any(r["verb"] == "allocate" for r in records)
+    finally:
+        srv.stop()
+
+
+def test_inspect_why_errors(capsys):
+    assert inspect_cli.main(["why", "default/p1"]) == 1
+    assert "--decisions-url" in capsys.readouterr().err
+
+
+def test_decision_ring_hard_bounded_under_verb_storm(cluster, tmp_path):
+    """A storm of webhook verbs can only evict old records, never grow
+    the ring — the acceptance bound, driven through the real verb."""
+    api, client, informer = cluster
+    DECISIONS.configure(max_records=64)
+    core = ExtenderCore(client)
+    nodes = [client.get_node(NODE)]
+    api.add_pod(make_pod("storm", 4, node=""))
+    pod = client.get_pod("default", "storm")
+    for _ in range(150):
+        core.filter({"pod": pod, "nodes": {"items": nodes}})
+    assert DECISIONS.size() == 64
+    assert DECISIONS.dropped() >= 150 - 64
+
+
+def test_rejected_bind_emits_error_why(cluster, tmp_path):
+    """A refused admission leaves an outcome=error record with the
+    reason — the 'why was my pod rejected' half of provenance."""
+    api, client, informer = cluster
+    api.add_pod(make_pod("big", 64, node=""))
+    core = ExtenderCore(client)
+    r = core.bind({
+        "podName": "big", "podNamespace": "default", "node": SMALL,
+    })
+    assert r["error"]
+    by_verb = _records("default/big")
+    bind = by_verb["bind"]
+    assert bind.outcome == "error"
+    assert bind.node == SMALL
+    assert bind.reason

@@ -32,6 +32,47 @@ def test_daemonset_mounts_device_plugin_dir():
     assert spec["containers"][0]["command"][0] == "tpushare-device-plugin"
 
 
+def _probe_paths(container):
+    return (
+        container["livenessProbe"]["httpGet"]["path"],
+        container["readinessProbe"]["httpGet"]["path"],
+    )
+
+
+def test_daemonset_has_health_and_readiness_probes():
+    """The daemon exposes /healthz + /readyz on its metrics port;
+    readiness gates on kubelet plugin registration, so the probes must
+    target the same port the --metrics-port flag opens."""
+    docs = list(yaml.safe_load_all((ROOT / "deploy/device-plugin-ds.yaml").read_text()))
+    ds = next(d for d in docs if d and d["kind"] == "DaemonSet")
+    c = ds["spec"]["template"]["spec"]["containers"][0]
+    port = next(
+        arg.split("=", 1)[1] for arg in c["command"]
+        if arg.startswith("--metrics-port=")
+    )
+    live, ready = _probe_paths(c)
+    assert live == "/healthz" and ready == "/readyz"
+    assert c["livenessProbe"]["httpGet"]["port"] == int(port)
+    assert c["readinessProbe"]["httpGet"]["port"] == int(port)
+
+
+def test_extender_has_health_and_readiness_probes():
+    """Extender readiness gates on informer sync + bind-WAL warmup —
+    a not-ready extender must not receive webhook traffic."""
+    docs = list(yaml.safe_load_all((ROOT / "deploy/scheduler-extender.yaml").read_text()))
+    dep = next(d for d in docs if d and d["kind"] == "Deployment")
+    c = dep["spec"]["template"]["spec"]["containers"][0]
+    port = next(
+        arg.split("=", 1)[1] for arg in c["command"]
+        if arg.startswith("--metrics-port=")
+    )
+    live, ready = _probe_paths(c)
+    assert live == "/healthz" and ready == "/readyz"
+    assert c["livenessProbe"]["httpGet"]["port"] == int(port)
+    assert c["readinessProbe"]["httpGet"]["port"] == int(port)
+    assert {p["containerPort"] for p in c["ports"]} >= {32766, int(port)}
+
+
 def iter_demo_pod_specs():
     """Yield (path, pod spec) for every demo workload's pod template."""
     for path in sorted((ROOT / "demo").glob("**/*.yaml")):

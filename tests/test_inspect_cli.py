@@ -259,8 +259,8 @@ def test_cli_details_serving_cache_column(api, capsys, monkeypatch):
     api.add_pod(assigned_running_pod("batch-1", 4, chip_idx=1, node="node-a"))
     monkeypatch.setattr(inspect_cli, "_client", lambda: ApiServerClient(api.url))
     monkeypatch.setattr(
-        inspect_cli, "fetch_engine_metrics",
-        lambda urls: inspect_cli.parse_engine_metrics(
+        inspect_cli, "fetch_observability_metrics",
+        lambda urls: inspect_cli.parse_observability_metrics(
             _engine_exposition("default/serve-1")
         ),
     )
@@ -281,8 +281,8 @@ def test_cli_serving_cache_matches_bare_pod_name(api, capsys, monkeypatch):
     api.add_pod(assigned_running_pod("serve-1", 16, chip_idx=0, node="node-a"))
     monkeypatch.setattr(inspect_cli, "_client", lambda: ApiServerClient(api.url))
     monkeypatch.setattr(
-        inspect_cli, "fetch_engine_metrics",
-        lambda urls: inspect_cli.parse_engine_metrics(
+        inspect_cli, "fetch_observability_metrics",
+        lambda urls: inspect_cli.parse_observability_metrics(
             _engine_exposition("serve-1")
         ),
     )
@@ -297,8 +297,8 @@ def test_cli_json_serving_cache(api, capsys, monkeypatch):
     api.add_pod(assigned_running_pod("batch-1", 4, chip_idx=1, node="node-a"))
     monkeypatch.setattr(inspect_cli, "_client", lambda: ApiServerClient(api.url))
     monkeypatch.setattr(
-        inspect_cli, "fetch_engine_metrics",
-        lambda urls: inspect_cli.parse_engine_metrics(
+        inspect_cli, "fetch_observability_metrics",
+        lambda urls: inspect_cli.parse_observability_metrics(
             _engine_exposition("default/serve-1")
         ),
     )

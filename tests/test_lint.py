@@ -155,6 +155,38 @@ def test_span_leak_accepts_canonical_shapes():
     assert _rules([mod], "span-leak") == []
 
 
+def test_decision_rule_flags_all_bad_shapes():
+    mod = _fixture("decision_bad.py", PKG + "decision_bad.py")
+    found = _rules([mod], "decision-outcome")
+    flagged = {f.message.split("(")[0] for f in found}
+    assert len(found) == 3, found
+    names = " | ".join(f.message for f in found)
+    assert "bad_return_without_emit" in names
+    assert "bad_fallthrough" in names
+    assert "bad_swallowing_handler" in names
+    assert flagged  # every finding names its function
+
+
+def test_decision_rule_accepts_canonical_shapes():
+    mod = _fixture("decision_ok.py", PKG + "decision_ok.py")
+    assert _rules([mod], "decision-outcome") == []
+
+
+def test_decision_rule_exempts_decisions_module():
+    """The decision log's own emit() primitive must not be held to the
+    verb discipline."""
+    src = (
+        "class DecisionLog:\n"
+        "    def passthrough(self, decisions):\n"
+        "        if decisions:\n"
+        "            decisions.emit('p', 'v')\n"
+    )
+    mod = Module(
+        "gpushare_device_plugin_tpu/utils/decisions.py", src, ast.parse(src)
+    )
+    assert _rules([mod], "decision-outcome") == []
+
+
 def test_span_leak_exempts_tracing_module():
     """utils/tracing.py holds per-pod admission roots open across webhook
     verbs by design (bounded + TTL'd in AdmissionTraces) — the rule must

@@ -117,6 +117,88 @@ def test_exemplar_recorded_per_bucket():
     assert r2.exemplar("h_seconds") == {}
 
 
+def test_quantile_of_empty_and_unknown_histogram_is_none():
+    r = MetricsRegistry()
+    assert r.histogram_quantile("never_observed", 0.99) is None
+    # a DIFFERENT label set on a known family is still "no observations"
+    r.observe("lat_seconds", 0.01, buckets=(0.001, 1.0), verb="bind")
+    assert r.histogram_quantile("lat_seconds", 0.5, verb="filter") is None
+
+
+def test_quantile_single_bucket_edges():
+    r = MetricsRegistry()
+    # every observation lands in the ONE finite bucket: the quantile
+    # interpolates inside [0, bound] and never exceeds the bound
+    for _ in range(10):
+        r.observe("one_seconds", 0.0005, buckets=(0.001,))
+    q50 = r.histogram_quantile("one_seconds", 0.5)
+    q99 = r.histogram_quantile("one_seconds", 0.99)
+    assert 0.0 < q50 <= 0.001
+    assert q50 <= q99 <= 0.001
+    # beyond the last finite bucket: clamp to it, like PromQL
+    r.observe("over_seconds", 5.0, buckets=(0.001,))
+    assert r.histogram_quantile("over_seconds", 0.99) == 0.001
+
+
+def test_quantile_skips_empty_leading_buckets():
+    r = MetricsRegistry()
+    for _ in range(4):
+        r.observe("tail_seconds", 0.5, buckets=(0.001, 0.01, 1.0))
+    q = r.histogram_quantile("tail_seconds", 0.5)
+    assert 0.01 <= q <= 1.0
+
+
+def test_registry_under_concurrent_writers_and_readers():
+    """render / gauge_series / histogram_quantile race a storm of
+    writers: no exception, no lost increments, every series visible."""
+    import threading
+
+    r = MetricsRegistry()
+    n_writers, per_writer = 8, 300
+    stop = threading.Event()
+    reader_errors = []
+
+    def writer(wi):
+        for j in range(per_writer):
+            r.counter_inc("storm_total", worker=str(wi))
+            r.gauge_set("storm_gauge", float(j), worker=str(wi))
+            r.observe(
+                "storm_seconds", 0.001 * (j % 7),
+                buckets=(0.001, 0.01, 1.0), worker=str(wi),
+            )
+
+    def reader():
+        while not stop.is_set():
+            try:
+                r.render()
+                r.render(openmetrics=True)
+                r.gauge_series("storm_gauge")
+                r.histogram_quantile("storm_seconds", 0.99, worker="0")
+            except Exception as e:  # noqa: BLE001 — the assertion
+                reader_errors.append(repr(e))
+                return
+
+    writers = [
+        threading.Thread(target=writer, args=(i,)) for i in range(n_writers)
+    ]
+    readers = [threading.Thread(target=reader) for _ in range(2)]
+    for t in readers + writers:
+        t.start()
+    for t in writers:
+        t.join()
+    stop.set()
+    for t in readers:
+        t.join()
+    assert reader_errors == []
+    for wi in range(n_writers):
+        assert r.counter_value("storm_total", worker=str(wi)) == per_writer
+        count, _total = r.histogram_stats("storm_seconds", worker=str(wi))
+        assert count == per_writer
+    series = r.gauge_series("storm_gauge")
+    assert len(series) == n_writers
+    assert all(v == per_writer - 1 for v in series.values())
+
+
 def test_metrics_server_endpoint():
     r = MetricsRegistry()
     r.counter_inc("served_total", "hits")

@@ -21,6 +21,11 @@ here are *project-specific theorems*, not generic style checks:
   included — nothing replays a leaked span); discarded start_span
   results are findings outright. ``with TRACER.span(...)`` is the
   structurally-safe form. Same CFG-outcome machinery as wal-protocol.
+- ``decision-outcome`` (rules_decisions): a function emitting
+  decision-provenance records (``DECISIONS.emit``) reaches an emit on
+  every normal completion and every return — a verb outcome with no
+  "why" record is a provenance hole. Branch-precise; propagation is
+  legal. Same CFG-outcome machinery.
 - ``ledger-encapsulation`` (rules_encapsulation): the AssumeCache /
   ClusterUsageIndex / NodeChipUsage internals are mutated only inside
   their own modules — the exact class of bug PR 6's gang storms caught.
@@ -143,6 +148,7 @@ RuleFn = Callable[[list[Module]], list[Finding]]
 def _registry() -> dict[str, RuleFn]:
     from . import (
         rules_annotations,
+        rules_decisions,
         rules_encapsulation,
         rules_hygiene,
         rules_locks,
@@ -157,6 +163,7 @@ def _registry() -> dict[str, RuleFn]:
         "lock-unranked": rules_locks.check_unranked_locks,
         "wal-protocol": rules_wal.check_wal_protocol,
         "span-leak": rules_spans.check_span_leak,
+        "decision-outcome": rules_decisions.check_decision_outcomes,
         "ledger-encapsulation": rules_encapsulation.check_encapsulation,
         "hygiene": rules_hygiene.check_hygiene,
         "unused-import": rules_pyflakes_lite.check_unused_imports,

@@ -1,0 +1,101 @@
+"""``decision-outcome`` rule: decision-provenance emission is dominated
+on every handled outcome path.
+
+The decision log (``utils/decisions.py``) exists so every admission verb
+leaves a queryable "why" — a verb that emits a record on its success
+path but silently returns (or falls through) on a rejection branch
+produces a provenance hole that "works" in every test that only checks
+behavior: the pod was refused and nothing says why. This rule makes the
+hole a lint finding, mirroring the WAL rule's discipline:
+
+- a function that calls ``DECISIONS.emit(...)`` anywhere must reach an
+  emit on **every normal completion path** (fallthrough) and **every
+  return**;
+- an exception that *propagates out of the function* is legal, exactly
+  as in ``wal-protocol``: propagation is a crash path the HTTP layer /
+  gRPC error machinery records on its own, and the canonical shape
+  ``except AllocationFailure: emit(outcome="error"); raise`` emits
+  before re-raising anyway;
+- functions with no emit call are out of scope — the rule pins the
+  discipline of emitting functions, it does not decide which functions
+  should emit (that is a design-review question, not a static one).
+
+Receiver hints: ``DECISIONS`` / ``decisions`` / ``_decisions``, the
+same curated-name approach as the lock, WAL, and span rules. The
+decision log's own module is exempt (its ``emit`` is the primitive).
+
+Shares the CFG-outcome machinery (R/T/F/RET lattice over
+try/except/finally/loops) with ``rules_wal`` via an emit-specific
+resolve predicate.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .engine import Finding, Module
+from .rules_wal import F, RET, eval_outcomes
+
+DECISION_RECEIVERS = ("DECISIONS", "decisions", "_decisions")
+EXEMPT = ("gpushare_device_plugin_tpu/utils/decisions.py",)
+
+
+def _is_emit_call(node: ast.Call) -> bool:
+    fn = node.func
+    if not (isinstance(fn, ast.Attribute) and fn.attr == "emit"):
+        return False
+    recv = fn.value
+    name = None
+    if isinstance(recv, ast.Name):
+        name = recv.id
+    elif isinstance(recv, ast.Attribute):
+        name = recv.attr
+    return name in DECISION_RECEIVERS
+
+
+def _is_emit(stmt: ast.stmt) -> bool:
+    # Compound statements never match directly — the outcome evaluator
+    # recurses into their blocks instead, so an emit on ONE branch of an
+    # if/try does not absolve the other branches (stricter than the WAL
+    # predicate, deliberately: nothing replays a missing "why").
+    if isinstance(stmt, (ast.If, ast.Try, ast.For, ast.While, ast.With)):
+        return False
+    for n in ast.walk(stmt):
+        if isinstance(n, ast.Call) and _is_emit_call(n):
+            return True
+    return False
+
+
+def check_decision_outcomes(modules: list[Module]) -> list[Finding]:
+    findings: list[Finding] = []
+    for mod in modules:
+        if not mod.in_package or mod.path in EXEMPT:
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.FunctionDef):
+                continue
+            if not any(
+                isinstance(n, ast.Call) and _is_emit_call(n)
+                for n in ast.walk(node)
+            ):
+                continue
+            outcomes = eval_outcomes(node.body, _is_emit)
+            if F in outcomes:
+                findings.append(
+                    Finding(
+                        mod.path, node.lineno, "decision-outcome",
+                        f"{node.name}() emits decision records but can "
+                        "complete normally without emitting — a verb "
+                        "outcome with no 'why' record",
+                    )
+                )
+            if RET in outcomes:
+                findings.append(
+                    Finding(
+                        mod.path, node.lineno, "decision-outcome",
+                        f"{node.name}() emits decision records but can "
+                        "return without emitting — a verb outcome with "
+                        "no 'why' record",
+                    )
+                )
+    return findings
