@@ -1,7 +1,7 @@
 PY ?= python
 PROTOC ?= protoc
 
-.PHONY: proto native test test-fast test-slow test-stress chaos chaos-restart chaos-move lint lint-strict typecheck bench bench-smoke bench-serve-smoke bench-multichip-smoke bench-paged-smoke bench-defrag-smoke bench-interference-smoke bench-wal bench-trace bench-decisions trace-smoke decisions-smoke e2e-kind
+.PHONY: proto native test test-fast test-slow test-stress chaos chaos-restart chaos-move chaos-shard lint lint-strict typecheck bench bench-smoke bench-serve-smoke bench-multichip-smoke bench-paged-smoke bench-defrag-smoke bench-interference-smoke bench-scale bench-scale-smoke bench-wal bench-trace bench-decisions trace-smoke decisions-smoke e2e-kind
 
 # Regenerate protobuf message classes (gRPC bindings are hand-written in
 # gpushare_device_plugin_tpu/plugin/api/api_grpc.py; grpc_tools is not
@@ -69,6 +69,32 @@ chaos-restart:
 # suite alone with the lock-order witness on.
 chaos-move:
 	TPUSHARE_LOCK_WITNESS=1 $(PY) -m pytest tests/test_defrag.py -x -q
+
+# Sharded-extender 2PC chaos (docs/robustness.md): SIGKILL (simulated
+# crash) at every "gang2pc" journal step — prepare, reserve, decide,
+# member PATCH, member commit, decision resolve — plus the leader fenced
+# mid-commit and one shard partitioned during prepare. After each kill a
+# rebuilt shard set runs resolve_gang2pc and the invariants must hold:
+# no partial gang visible, no orphaned cross-shard reservation, every
+# pending gang2pc entry drained. All of it runs inside tier-1
+# ('not slow'); this target runs the suite alone with the lock-order
+# witness on.
+chaos-shard:
+	TPUSHARE_LOCK_WITNESS=1 $(PY) -m pytest tests/test_shards.py -x -q
+
+# Sharded-extender scale bench, full size: admission throughput + p99
+# over the 32/256/1000-node x 1/8-shard matrix plus the 1k-node
+# 100k-pod churn storm with cross-shard gang groups (zero
+# double-bookings / zero partial gangs audited; >=3x 8-shard speedup
+# HARD-gated). Tens of minutes on a small box. See docs/perf.md.
+bench-scale:
+	$(PY) bench.py --scale-bench
+
+# Seconds-sized scale pass: tiny node/shard/event counts through the
+# same router + 2PC path, correctness gates HARD, speedup reported but
+# not gated. Tier-1 runs it via tests/test_bench_scale_smoke.py.
+bench-scale-smoke:
+	$(PY) bench.py --scale-smoke
 
 # kind end-to-end: deploy the manifests with mock discovery on a local kind
 # cluster and assert the demo pod admits with TPU_VISIBLE_CHIPS injected

@@ -56,6 +56,7 @@ import argparse
 import json
 import os
 import re
+import shutil
 import statistics
 import subprocess
 import sys
@@ -85,6 +86,13 @@ TRACE_OVERHEAD_PCT = 5.0
 # Decision-provenance hard gate (--decisions-bench): the decisions-on
 # storm's admission p99 may inflate at most this much over decisions-off.
 DECISIONS_OVERHEAD_PCT = 5.0
+# Sharded-extender scale bench (--scale-bench): the 8-shard router must
+# clear this much admission throughput over the single-shard baseline at
+# the largest node count — the work-reduction the sharding exists for.
+SCALE_SPEEDUP_MIN = 3.0
+SCALE_NODE_COUNTS = [32, 256, 1000]
+SCALE_SHARD_COUNTS = [1, 8]
+SCALE_STORM_EVENTS = 100_000
 
 
 def run_allocate_trial(
@@ -866,6 +874,226 @@ def run_defrag_bench(
     }
 
 
+def _scale_config(
+    n_nodes: int,
+    n_shards: int,
+    events: int,
+    workers: int = 8,
+    fanout: int = 2,
+    seed: int = 20260804,
+    gang_every: int = 0,
+    settle_s: float = 1.0,
+) -> dict:
+    """One sharded-cluster churn configuration, end to end: synthesize
+    ``n_nodes`` heterogeneous nodes in a fake apiserver, stand up
+    ``n_shards`` :class:`ShardExtender` instances (each with its own
+    per-shard group-commit bind WAL and its own informer usage index)
+    behind a :class:`ShardRouter`, and drive ``events`` Poisson churn
+    events through ``router.admit`` (and, with ``gang_every``, cross-
+    shard gang groups through the two-phase reserve). Returns the churn
+    stats plus the post-run correctness audit: per-chip overcommit
+    (cross-shard double-bookings), partial gang grants, and undrained
+    gang2pc journal entries after a reconciler pass."""
+    import tempfile as _tempfile
+
+    from gpushare_device_plugin_tpu.allocator.checkpoint import (
+        AllocationCheckpoint,
+    )
+    from gpushare_device_plugin_tpu.cluster.apiserver import ApiServerClient
+    from gpushare_device_plugin_tpu.cluster.informer import PodInformer
+    from gpushare_device_plugin_tpu.extender import simcluster as S
+    from gpushare_device_plugin_tpu.extender.shards import (
+        LeaderLease, ShardExtender, ShardRouter, resolve_gang2pc,
+    )
+
+    from fake_apiserver import FakeApiServer
+
+    api = FakeApiServer(chaos=False)
+    nodes = S.make_cluster(n_nodes, seed=seed)
+    for n in nodes:
+        api.nodes[n["metadata"]["name"]] = n
+    api.start()
+    tmp = _tempfile.mkdtemp(prefix="tpushare-scale-")
+    client = ApiServerClient(api.url)
+    informer = PodInformer(client).start(sync_timeout_s=60)
+    try:
+        shards = [
+            ShardExtender(
+                f"shard-{i}", client, informer=informer,
+                checkpoint=AllocationCheckpoint(
+                    os.path.join(tmp, f"shard-{i}.wal")
+                ),
+            )
+            for i in range(n_shards)
+        ]
+        lease = LeaderLease()
+        router = ShardRouter(shards, fanout=fanout, lease=lease)
+        router.set_nodes(nodes)
+        driver = S.ChurnDriver(
+            create_pod_fn=api.add_pod,
+            delete_pod_fn=api.delete_pod,
+            admit_fn=router.admit,
+            admit_gang_fn=router.admit_gang_group,
+            seed=seed, gang_every=gang_every, workers=workers,
+        )
+        stats = driver.run(events)
+        time.sleep(settle_s)  # let the watch catch up before auditing
+        pods = client.list_pods()
+        violations = S.audit_cluster(nodes, pods)
+        resolve_counts = resolve_gang2pc(shards, client, lease=lease)
+        twopc_left = sum(len(s.twopc_pending()) for s in shards)
+        _assert_lock_order_clean(
+            f"scale config nodes={n_nodes} shards={n_shards}"
+        )
+        return {
+            "nodes": n_nodes,
+            "shards": n_shards,
+            "fanout": fanout,
+            "workers": workers,
+            "events": events,
+            **S.summarize(stats),
+            "violations": violations,
+            "gang2pc_resolve": resolve_counts,
+            "gang2pc_pending_after": twopc_left,
+        }
+    finally:
+        informer.stop()
+        api.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _scale_gates(record: dict, *, speedup_gate: bool) -> list[str]:
+    """Correctness (always) + speedup (full mode) gates for the scale
+    bench. Zero cross-shard double-bookings and zero partial gangs are
+    HARD in every mode, smoke included."""
+    failed = []
+    for cfg in record.get("configs", []) + (
+        [record["storm"]] if record.get("storm") else []
+    ):
+        tag = f"nodes={cfg['nodes']} shards={cfg['shards']}"
+        if cfg["violations"]:
+            failed.append(
+                f"SCALE BENCH FAILED ({tag}): {len(cfg['violations'])} "
+                f"audit violation(s), first: {cfg['violations'][0]}"
+            )
+        if cfg["gang2pc_pending_after"]:
+            failed.append(
+                f"SCALE BENCH FAILED ({tag}): "
+                f"{cfg['gang2pc_pending_after']} undrained gang2pc "
+                "journal entr(ies) after the reconciler pass"
+            )
+        if cfg["admitted"] <= 0:
+            failed.append(
+                f"SCALE BENCH FAILED ({tag}): zero admissions — every "
+                "other gate is vacuous over an empty run"
+            )
+    if speedup_gate:
+        speedup = record.get("speedup_max_nodes")
+        if speedup is None:
+            # a missing ratio is a FAILED gate, not a skipped one: a
+            # baseline that admitted nothing must not exit 0
+            failed.append(
+                "SCALE BENCH FAILED: speedup unmeasurable (single-shard "
+                "baseline recorded no throughput)"
+            )
+        elif speedup < SCALE_SPEEDUP_MIN:
+            failed.append(
+                f"SCALE BENCH FAILED: {max(record['node_counts'])}-node "
+                f"8-shard speedup x{speedup} below "
+                f"the x{SCALE_SPEEDUP_MIN} gate"
+            )
+    return failed
+
+
+def run_scale_bench(
+    node_counts: list[int],
+    shard_counts: list[int],
+    events_per_config: int,
+    storm_events: int = 0,
+    workers: int = 8,
+    fanout: int = 2,
+    gang_every_storm: int = 40,
+) -> dict:
+    """Admission throughput + p99 versus node count and shard count
+    (ROADMAP item 2's scale story), plus — with ``storm_events`` — the
+    big churn storm: the largest node count under the largest shard
+    count with gang-group bursts riding the cross-shard two-phase
+    reserve, audited for zero double-bookings and zero partial gangs.
+
+    Throughput configs run WITHOUT gang bursts (single-pod admission
+    throughput is the headline; the storm covers the 2PC path), with
+    the same worker count, fanout, and seed across the whole matrix so
+    the only variable is the sharding."""
+    configs = []
+    tput: dict[tuple[int, int], float] = {}
+    for n_nodes in node_counts:
+        for n_shards in shard_counts:
+            cfg = _scale_config(
+                n_nodes, n_shards, events_per_config,
+                workers=workers, fanout=fanout,
+            )
+            configs.append(cfg)
+            tput[(n_nodes, n_shards)] = cfg["admissions_per_s"]
+            print(
+                f"scale: nodes={n_nodes} shards={n_shards} "
+                f"-> {cfg['admissions_per_s']:.1f} adm/s "
+                f"p99={cfg['admit_p99_ms']:.1f}ms "
+                f"violations={len(cfg['violations'])}",
+                file=sys.stderr,
+            )
+    max_nodes = max(node_counts)
+    lo, hi = min(shard_counts), max(shard_counts)
+    speedup = None
+    if lo != hi and tput.get((max_nodes, lo)):
+        speedup = round(tput[(max_nodes, hi)] / tput[(max_nodes, lo)], 2)
+    storm = None
+    if storm_events:
+        storm = _scale_config(
+            max_nodes, hi, storm_events, workers=workers, fanout=fanout,
+            gang_every=gang_every_storm, settle_s=2.0,
+        )
+        print(
+            f"scale storm: nodes={max_nodes} shards={hi} "
+            f"events={storm_events} -> admitted={storm['admitted']} "
+            f"gangs={storm['gang_groups']} "
+            f"violations={len(storm['violations'])} "
+            f"gang2pc_left={storm['gang2pc_pending_after']}",
+            file=sys.stderr,
+        )
+    best = configs and max(
+        (c for c in configs if c["shards"] == hi and c["nodes"] == max_nodes),
+        key=lambda c: c["admissions_per_s"],
+    )
+    return {
+        "node_counts": node_counts,
+        "shard_counts": shard_counts,
+        "events_per_config": events_per_config,
+        "configs": configs,
+        "storm": storm,
+        "speedup_max_nodes": speedup,
+        "admissions_per_s": best["admissions_per_s"] if best else None,
+        "admission_p99_ms": best["admit_p99_ms"] if best else None,
+    }
+
+
+def scale_throughput_guard(adm_s: float | None, repo: Path) -> str | None:
+    """Failure message when sharded admission throughput dropped
+    >P99_GUARD_PCT below the newest committed record carrying it."""
+    return _pct_trend_guard(
+        adm_s, repo, field="scale_admissions_per_s",
+        label="scale admission throughput", fmt=".1f", unit=" adm/s",
+        lower_is_worse=True,
+    )
+
+
+def scale_p99_guard(p99_ms: float | None, repo: Path) -> str | None:
+    """Same budget for the sharded admission latency tail."""
+    return _pct_trend_guard(
+        p99_ms, repo, field="scale_admission_p99_ms",
+        label="scale admission p99", unit="ms",
+    )
+
+
 def _defrag_gates(defrag: dict) -> list[str]:
     """Correctness gates on one ``run_defrag_bench`` result — shared by
     the full bench and ``--defrag-smoke`` so the acceptance bar cannot
@@ -1240,6 +1468,27 @@ def parse_args(argv=None) -> argparse.Namespace:
                    "(make bench-defrag-smoke)")
     p.add_argument("--no-defrag", action="store_true",
                    help="skip the defrag churn section")
+    p.add_argument("--scale-bench", action="store_true",
+                   help="run ONLY the sharded-extender scale bench, full "
+                   "size: admission throughput + p99 over the "
+                   "32/256/1000-node x 1/8-shard matrix, the 1k-node "
+                   "100k-pod churn storm with cross-shard gang groups, "
+                   "and the HARD >=3x 8-shard speedup gate. Long — "
+                   "tens of minutes on a small box (make bench-scale "
+                   "for the matrix alone via --scale-storm-events)")
+    p.add_argument("--scale-smoke", action="store_true",
+                   help="run ONLY a seconds-sized scale-bench pass (tiny "
+                   "node/shard/event counts). The correctness gates — "
+                   "zero cross-shard double-bookings, zero partial "
+                   "gangs, gang2pc journal drained — stay HARD; the "
+                   "speedup gate is full-size-only "
+                   "(make bench-scale-smoke)")
+    p.add_argument("--no-scale", action="store_true",
+                   help="skip the scale section of the full bench")
+    p.add_argument("--scale-storm-events", type=int,
+                   default=SCALE_STORM_EVENTS,
+                   help="churn events in the --scale-bench storm phase "
+                   "(0 skips the storm and runs the matrix alone)")
     p.add_argument("--wal-window-ms", type=float, default=8.0,
                    help="group-commit gather window for the storm's WAL "
                    "(the --wal-batch-window-ms daemon tunable). The storm "
@@ -1462,6 +1711,34 @@ def main(argv=None) -> int:
         for m in failed:
             print(m, file=sys.stderr)
         return 1 if failed else 0
+    if args.scale_bench or args.scale_smoke:
+        if args.scale_smoke:
+            scale = run_scale_bench(
+                node_counts=[16], shard_counts=[1, 2],
+                events_per_config=80, storm_events=160,
+                workers=4, gang_every_storm=12,
+            )
+        else:
+            scale = run_scale_bench(
+                node_counts=SCALE_NODE_COUNTS,
+                shard_counts=SCALE_SHARD_COUNTS,
+                events_per_config=600,
+                storm_events=args.scale_storm_events,
+                workers=max(1, args.workers),
+            )
+        print(json.dumps({
+            "metric": "scale_bench",
+            "smoke": args.scale_smoke,
+            "scale_admissions_per_s": scale["admissions_per_s"],
+            "scale_admission_p99_ms": scale["admission_p99_ms"],
+            "scale_speedup": scale["speedup_max_nodes"],
+            **{k: scale[k] for k in
+               ("node_counts", "shard_counts", "configs", "storm")},
+        }))
+        failed = _scale_gates(scale, speedup_gate=not args.scale_smoke)
+        for m in failed:
+            print(m, file=sys.stderr)
+        return 1 if failed else 0
     if args.wal_bench:
         return run_wal_bench(
             max(1, args.workers), wal_window_s=args.wal_window_ms / 1000.0
@@ -1594,6 +1871,39 @@ def main(argv=None) -> int:
             file=sys.stderr,
         )
 
+    scale = {}
+    if not args.no_scale:
+        # Bounded mid-size config for the trend-guard series (the full
+        # 1k-node matrix + 100k-pod storm live behind --scale-bench):
+        # one node count, 1 vs 8 shards, plus a short gang-burst storm
+        # so every committed record exercises the two-phase reserve.
+        scale = run_scale_bench(
+            node_counts=[32] if args.smoke else [256],
+            shard_counts=[1, 2] if args.smoke else [1, 8],
+            events_per_config=60 if args.smoke else 400,
+            storm_events=120 if args.smoke else 800,
+            workers=4 if args.smoke else max(1, args.workers),
+            gang_every_storm=12 if args.smoke else 40,
+        )
+        scale_failed = _scale_gates(scale, speedup_gate=False)
+        if scale_failed:
+            # correctness, not performance: a double-booked chip or an
+            # undrained 2PC entry fails the bench outright, smoke included
+            print(json.dumps({"metric": "scale_bench", **{
+                k: scale[k] for k in ("configs", "storm")
+            }}))
+            for m in scale_failed:
+                print(m, file=sys.stderr)
+            return 1
+        print(
+            f"scale (nodes={scale['node_counts']}, "
+            f"shards={scale['shard_counts']}): "
+            f"sharded={scale['admissions_per_s']} adm/s "
+            f"p99={scale['admission_p99_ms']}ms "
+            f"speedup=x{scale['speedup_max_nodes']}",
+            file=sys.stderr,
+        )
+
     compute = {} if args.no_mfu else run_compute_bench(
         repo, backend_init_timeout=args.backend_init_timeout
     )
@@ -1658,10 +1968,18 @@ def main(argv=None) -> int:
         # improvement already hard-gated above.
         "defrag_stranded_after_pct": defrag.get("stranded_after_pct"),
         "defrag_binpack_after_pct": defrag.get("binpack_after_pct"),
+        # Sharded-extender scale numbers, hoisted for the trend guards:
+        # the 8-shard router's admission throughput and p99 on the
+        # mid-size matrix config (the full 1k-node story is
+        # --scale-bench). The audit/drain invariants hard-failed above.
+        "scale_admissions_per_s": scale.get("admissions_per_s"),
+        "scale_admission_p99_ms": scale.get("admission_p99_ms"),
+        "scale_speedup": scale.get("speedup_max_nodes"),
         "concurrent": concurrent,
         "gang": gang,
         "defrag": defrag,
         "extender": extender,
+        "scale": scale,
         "compute": compute,
     }
     print(json.dumps(record))
@@ -1686,6 +2004,8 @@ def main(argv=None) -> int:
         msgs.append(gang_storm_guard(record["gang_throughput_gangs_s"], repo))
         msgs.append(defrag_stranded_guard(record["defrag_stranded_after_pct"], repo))
         msgs.append(defrag_binpack_guard(record["defrag_binpack_after_pct"], repo))
+        msgs.append(scale_throughput_guard(record["scale_admissions_per_s"], repo))
+        msgs.append(scale_p99_guard(record["scale_admission_p99_ms"], repo))
     if not args.no_util_guard:
         msgs.append(utilization_guard(record["binpack_utilization_pct"], repo))
     failed = [m for m in msgs if m is not None]

@@ -222,6 +222,14 @@ class AllocationCheckpoint:
         with self._lock:
             return self._fenced
 
+    @property
+    def last_seq(self) -> int:
+        """The newest begin's sequence stamp (0 before any begin). The
+        shard-map CLI reports it per shard as the cheapest 'how far has
+        this WAL advanced' signal."""
+        with self._lock:
+            return self._seq
+
     def pending(self) -> dict[PodKey, dict]:
         """Begun-but-unresolved entries (the replay set)."""
         with self._lock:

@@ -362,6 +362,8 @@ def render_why(pod: str, records: list[dict]) -> str:
     for rec in records:
         verb = rec.get("verb", "?")
         head = f"[#{rec.get('id', '?')}] {verb}"
+        if rec.get("shard"):
+            head += f" @{rec['shard']}"
         if rec.get("node"):
             head += f" -> {rec['node']}"
         if rec.get("outcome", "ok") != "ok":
@@ -369,6 +371,14 @@ def render_why(pod: str, records: list[dict]) -> str:
         buf.write(head + "\n")
         if rec.get("reason"):
             buf.write(f"   reason: {rec['reason']}\n")
+        degraded = rec.get("degraded_shards") or []
+        if degraded:
+            # "not consulted" is a different fact than "rejected": these
+            # shards' nodes were never scored at all
+            buf.write(
+                f"   ! not consulted (degraded shards): "
+                f"{', '.join(degraded)}\n"
+            )
         if rec.get("candidates"):
             line = f"   candidates: {rec['candidates']}"
             if rec.get("rejected"):
@@ -454,6 +464,54 @@ def render_timeline(doc: dict, width: int = 48) -> str:
             f"last={values[-1]:g} min={min(values):g} "
             f"max={max(values):g} n={len(values)}\n"
         )
+    return buf.getvalue()
+
+
+def render_shards(doc: dict) -> str:
+    """Render a ``/shards`` document (``ShardRouter.shards_doc``): the
+    hash-ring ownership spread, one row per shard with its node count,
+    WAL seq, journal queue depth, and in-flight 2PC gangs, then the
+    pending gang2pc entries. Deterministic for a given document
+    (golden-tested like ``render_why``/``render_top``)."""
+    buf = StringIO()
+    ring = (doc or {}).get("ring") or {}
+    rows = (doc or {}).get("shards") or []
+    buf.write(
+        f"shard map — {ring.get('shards', len(rows))} shard(s), "
+        f"{ring.get('vnodes', '?')} vnodes/shard, "
+        f"fanout {doc.get('fanout', '?')}\n"
+    )
+    if not rows:
+        buf.write("(no shards)\n")
+        return buf.getvalue()
+    per = ring.get("nodes_per_shard") or {}
+    name_w = max(len(str(r.get("shard", "?"))) for r in rows)
+    header = (
+        f"{'SHARD'.ljust(name_w)}  NODES  WAL-SEQ  QUEUE  2PC  STATE"
+    )
+    buf.write(header + "\n")
+    for r in rows:
+        sid = str(r.get("shard", "?"))
+        nodes = r.get("nodes", per.get(sid, 0))
+        state = "PARTITIONED" if r.get("partitioned") else "ok"
+        buf.write(
+            f"{sid.ljust(name_w)}  {str(nodes).rjust(5)}  "
+            f"{str(r.get('wal_seq', 0)).rjust(7)}  "
+            f"{str(r.get('wal_pending', 0)).rjust(5)}  "
+            f"{str(r.get('gangs_inflight', 0)).rjust(3)}  {state}\n"
+        )
+    gangs = (doc or {}).get("gangs_2pc") or []
+    if gangs:
+        buf.write("gang 2PC in flight:\n")
+        for g in sorted(
+            gangs, key=lambda g: (g.get("group", ""), g.get("pod", ""))
+        ):
+            buf.write(
+                f"   {g.get('group', '?')} [{g.get('phase', '?')}] "
+                f"pod={g.get('pod', '') or '-'} "
+                f"node={g.get('node', '') or '-'} "
+                f"shard={g.get('shard', '?')}\n"
+            )
     return buf.getvalue()
 
 

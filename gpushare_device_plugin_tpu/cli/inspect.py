@@ -356,6 +356,67 @@ def timeline_main(argv: list[str]) -> int:
     return 0
 
 
+def fetch_shards(urls: list[str]) -> dict:
+    """Fetch + merge ``/shards`` documents (several router replicas
+    serve the same ring; rows merge by shard id, later endpoints
+    winning ties). Unreachable endpoints warn but do not fail."""
+    merged: dict = {"ring": None, "fanout": None, "shards": {}, "gangs": {}}
+    for doc in _fetch_json_docs(urls, "/shards"):
+        if merged["ring"] is None:
+            merged["ring"] = doc.get("ring")
+            merged["fanout"] = doc.get("fanout")
+        for row in doc.get("shards") or []:
+            merged["shards"][row.get("shard", "?")] = row
+        for g in doc.get("gangs_2pc") or []:
+            # replicas fronting the same shards report the same gangs —
+            # dedupe like the shard rows, not extend
+            key = (g.get("group"), g.get("pod"), g.get("shard"),
+                   g.get("phase"))
+            merged["gangs"].setdefault(key, g)
+    return {
+        "ring": merged["ring"] or {},
+        "fanout": merged["fanout"],
+        "shards": [merged["shards"][k] for k in sorted(merged["shards"])],
+        "gangs_2pc": list(merged["gangs"].values()),
+    }
+
+
+def shards_main(argv: list[str]) -> int:
+    """``kubectl-inspect-tpushare shards``: render the sharded
+    extender's shard map — hash-ring ownership, per-shard WAL seq and
+    journal queue depth, and cross-shard 2PC gangs in flight
+    (docs/scheduling.md)."""
+    from .display import render_shards
+
+    p = argparse.ArgumentParser(
+        prog="kubectl-inspect-tpushare shards",
+        description="Sharded-extender shard map",
+    )
+    p.add_argument("--shards-url", action="append", default=[],
+                   metavar="URL",
+                   help="a /shards endpoint (the shard router's "
+                   "--metrics-port); repeatable — rows are merged by "
+                   "shard id")
+    p.add_argument("-o", "--output", default="table",
+                   choices=["table", "json"])
+    args = p.parse_args(argv)
+    if not args.shards_url:
+        print(
+            "error: no --shards-url given — point me at the shard "
+            "router's metrics port (e.g. --shards-url "
+            "http://router:9114)",
+            file=sys.stderr,
+        )
+        return 1
+    doc = fetch_shards(args.shards_url)
+    if args.output == "json":
+        json.dump(doc, sys.stdout, indent=2)
+        print()
+        return 0
+    sys.stdout.write(render_shards(doc))
+    return 0
+
+
 def trace_main(argv: list[str]) -> int:
     p = argparse.ArgumentParser(
         prog="kubectl-inspect-tpushare trace",
@@ -511,6 +572,8 @@ def main(argv=None) -> int:
         return why_main(argv[1:])
     if argv and argv[0] == "timeline":
         return timeline_main(argv[1:])
+    if argv and argv[0] == "shards":
+        return shards_main(argv[1:])
     p = argparse.ArgumentParser(
         prog="kubectl-inspect-tpushare",
         description="Display TPU-share HBM utilization across the cluster",

@@ -161,6 +161,14 @@ def is_gang_pod(pod: Pod) -> bool:
     return bool(gang_shape_request(pod)) and mem_units_of_pod(pod) > 0
 
 
+def gang_group(pod: Pod) -> str:
+    """The pod's gang-GROUP id (``ANN_GANG_GROUP``), "" for pods that
+    are not members of a cross-node group. Members of one group are
+    admitted all-or-nothing through the sharded extender's two-phase
+    reserve (extender/shards.py)."""
+    return str(annotations(pod).get(const.ANN_GANG_GROUP, "") or "")
+
+
 def gang_chips_from_annotation(pod: Pod) -> list[int]:
     """Member chip indices of a GRANTED gang (``ENV_GANG_CHIPS``), [] when
     absent/garbled — same tolerance as ``core_ids_from_annotation``."""

@@ -56,6 +56,14 @@ ENV_CORE_POD = "ALIYUN_COM_TPU_CORE_POD"  # this pod's tpu-core request
 # bare chip count "4" (any arrangement). Its aliyun.com/tpu-mem limit is
 # the TOTAL across the gang; per-chip share = total / shape size.
 ANN_GANG_SHAPE = "tpushare.aliyun.com/gang-shape"
+# A pod may additionally name a gang GROUP: pods sharing the group id
+# are one distributed job whose members land on (possibly) different
+# nodes and must be admitted all-or-nothing. Group admission runs the
+# sharded extender's cross-shard two-phase reserve (extender/shards.py):
+# every member shard books its chips as a journaled "gang2pc"
+# reservation before any member binds, and a leader decision commits or
+# aborts the whole group.
+ANN_GANG_GROUP = "tpushare.aliyun.com/gang-group"
 # Persisted gang decision (annotations on the pod, mirrored into env):
 # comma-separated member chip indices, the normalized shape, and the HBM
 # units claimed on EACH member chip. A gang is only ever persisted whole

@@ -25,7 +25,15 @@ from ..allocator.binpack import AssignmentError, assign_chip
 from ..cluster import pods as P
 from ..cluster.noderes import chip_capacity_vector
 from ..topology import ChipTopology, shape_size
-from ..utils.decisions import ScoreVector, chip_breakdown
+from ..utils.decisions import ScoreVector
+from .policy import PlacementPolicy, PolicyView
+from . import policy as policy_mod
+
+# Every scoring entry point accepts either a legacy chip-policy name
+# ("best-fit"/"first-fit"/"spread" — resolved through the policy
+# registry to the bit-identical binpack scorer) or an already-
+# constructed PlacementPolicy ("greedy-binpack"/"multi-objective"/
+# "learned"/anything registered). Resolution happens once per verb.
 
 # resource name -> annotation/label vocabulary
 RESOURCE_FAMILIES = {
@@ -168,15 +176,18 @@ def pod_gang_shape(pod: dict, resource: str) -> str:
     return P.gang_shape_request(pod)
 
 
-def _zero_score(policy: str, request_units: int) -> ScoreVector:
+def _zero_score(pol: PlacementPolicy, request_units: int) -> ScoreVector:
     return ScoreVector(
-        policy=policy, raw=0.0, free_units=0,
+        policy=pol.name, raw=0.0, free_units=0,
         request_units=request_units, binpack=0.0,
     )
 
 
 def _gang_eval(
-    view: NodeView, shape_raw: str, request_units: int, policy: str
+    view: NodeView,
+    shape_raw: str,
+    request_units: int,
+    policy: "str | PlacementPolicy",
 ) -> tuple["object | None", int, str, ScoreVector]:
     """One node's gang answer: -> (best candidate or None, per-chip
     units, failure reason, :class:`ScoreVector`). The score reuses the
@@ -184,27 +195,31 @@ def _gang_eval(
     winning slice's members — so gang and single-chip node ranking stay
     comparable — and carries the slice's multi-objective components
     (ICI hops, stranded slivers, broken chips, tie-break) from
-    ``best_slice_scored`` for decision provenance."""
+    ``best_slice_scored`` for decision provenance. A non-legacy
+    :class:`PlacementPolicy` sees those components in its
+    :class:`PolicyView` and may let them move the raw score (the
+    multi-objective / learned policies do)."""
+    pol = policy_mod.resolve(policy)
     try:
         size = shape_size(shape_raw)
     except ValueError as e:
         return (
             None, 0, f"invalid gang shape {shape_raw!r}: {e}",
-            _zero_score(policy, request_units),
+            _zero_score(pol, request_units),
         )
     if size < 1 or request_units <= 0 or request_units % size:
         return (
             None, 0,
             f"{request_units} units of {view.resource} do not divide "
             f"evenly over gang shape {shape_raw!r} ({size} chips)",
-            _zero_score(policy, request_units),
+            _zero_score(pol, request_units),
         )
     per_chip = request_units // size
     topo = view.topology or node_topology({}, view.capacity)
     if topo is None:
         return (
             None, 0, f"node does not advertise {view.resource}",
-            _zero_score(policy, request_units),
+            _zero_score(pol, request_units),
         )
     free = view.free()
     scored = topo.best_slice_scored(
@@ -216,23 +231,38 @@ def _gang_eval(
             None, per_chip,
             f"no {shape_raw} sub-slice with {per_chip} free units of "
             f"{view.resource} per chip (free: {free})",
-            _zero_score(policy, per_chip),
+            _zero_score(pol, per_chip),
         )
     cand, slice_score = scored
-    base = _score_free(
-        [free[i] for i in cand.chips],
-        max(view.capacity.values(), default=0),
-        per_chip,
-        policy,
-    )
-    score = dataclasses.replace(
-        base,
-        ici_hops=slice_score.hops,
-        stranded=slice_score.stranded,
-        broken=slice_score.broken,
-        tie_break=slice_score.tie_break,
-    )
+    member_free = [free[i] for i in cand.chips]
+    feasible = [f for f in member_free if f >= per_chip]
+    cap = max(view.capacity.values(), default=0)
+    if not feasible or cap <= 0:
+        score = _zero_score(pol, per_chip)
+    else:
+        decisive = (
+            max(feasible) if pol.chip_policy == "spread" else min(feasible)
+        )
+        score = pol.score(PolicyView(
+            free_units=decisive, capacity=cap, request_units=per_chip,
+            free_vector=tuple(feasible),
+            ici_hops=slice_score.hops, stranded=slice_score.stranded,
+            broken=slice_score.broken, tie_break=slice_score.tie_break,
+        ))
     return cand, per_chip, "", score
+
+
+def gang_candidate(
+    view: NodeView,
+    shape_raw: str,
+    request_units: int,
+    policy: "str | PlacementPolicy" = "best-fit",
+) -> tuple["object | None", int, str, ScoreVector]:
+    """Public form of the per-node gang evaluation (``_gang_eval``) for
+    planners — the shard router's cross-node gang-group placement picks
+    each member's (slice, per-chip units) through this: -> (candidate
+    slice or None, per-chip units, failure reason, score)."""
+    return _gang_eval(view, shape_raw, request_units, policy)
 
 
 def evaluate_filter(
@@ -240,12 +270,13 @@ def evaluate_filter(
 ) -> tuple[list[str], dict[str, str]]:
     """Fit check over prebuilt views -> (fitting names, name -> reason)."""
     fits, failed = [], {}
+    pol = policy_mod.resolve("best-fit")
     for view in views:
         if not view.capacity:
             failed[view.name] = f"node does not advertise {view.resource}"
         elif gang_shape:
             cand, _per, reason, _s = _gang_eval(
-                view, gang_shape, request_units, "best-fit"
+                view, gang_shape, request_units, pol
             )
             if cand is None:
                 failed[view.name] = reason
@@ -302,27 +333,34 @@ def filter_nodes(
 
 
 def _score_free(
-    free_values, cap: int, request_units: int, policy: str
+    free_values, cap: int, request_units: int,
+    policy: "str | PlacementPolicy",
 ) -> ScoreVector:
     """The policy score over a free vector as a structured
     :class:`ScoreVector`: the raw fractional 0-10 score (full
     resolution — the deterministic tie-break the integer projection
     cannot provide at fleet scale), the decisive chip's free units, and
     the binpack slack term. Chip selection (tightest feasible for
-    packing, roomiest for spread) lives here; the scoring formula
-    itself is ``chip_breakdown`` — ONE implementation shared with the
-    allocator's provenance records. The webhook wire format projects
-    ``.projected`` (round + clamp — bit-identical to the old bare-int
-    return, pinned by the existing verb tests)."""
+    packing, roomiest for spread — ``PlacementPolicy.chip_policy``)
+    lives here; the scoring formula is the policy's ``score`` over a
+    :class:`PolicyView` (legacy names resolve to the ``chip_breakdown``
+    scorer — ONE implementation shared with the allocator's provenance
+    records, bit-identical to the pre-registry behavior, pinned by the
+    existing verb tests)."""
+    pol = policy_mod.resolve(policy)
     feasible = [f for f in free_values if f >= request_units]
     if not feasible or cap <= 0:
-        return _zero_score(policy, request_units)
-    decisive = max(feasible) if policy == "spread" else min(feasible)
-    return chip_breakdown(decisive, cap, None, request_units, policy)
+        return _zero_score(pol, request_units)
+    decisive = max(feasible) if pol.chip_policy == "spread" else min(feasible)
+    return pol.score(PolicyView(
+        free_units=decisive, capacity=cap, request_units=request_units,
+        free_vector=tuple(feasible),
+    ))
 
 
 def score_node_vector(
-    view: NodeView, request_units: int, policy: str = "best-fit"
+    view: NodeView, request_units: int,
+    policy: "str | PlacementPolicy" = "best-fit",
 ) -> ScoreVector:
     """Node score as a structured :class:`ScoreVector`, consistent with
     the chip-level policy.
@@ -340,32 +378,38 @@ def score_node_vector(
     )
 
 
-def score_node(view: NodeView, request_units: int, policy: str = "best-fit") -> int:
+def score_node(
+    view: NodeView, request_units: int,
+    policy: "str | PlacementPolicy" = "best-fit",
+) -> int:
     """Node score 0-10 (the webhook wire projection of
     :func:`score_node_vector`)."""
     return score_node_vector(view, request_units, policy).projected
 
 
 def chip_score_vector(
-    view: NodeView, idx: int, request_units: int, policy: str = "best-fit"
+    view: NodeView, idx: int, request_units: int,
+    policy: "str | PlacementPolicy" = "best-fit",
 ) -> ScoreVector:
     """The breakdown for one CHOSEN chip (bind-time provenance): the
     chip's pre-claim free units and its slack term, with the chip index
     as the tie-break. Unlike :func:`score_node_vector` this scores the
     concrete decision, not the node's best case."""
-    return chip_breakdown(
-        view.free().get(idx, 0),
-        max(view.capacity.values(), default=0),
-        idx,
-        request_units,
-        policy,
-    )
+    pol = policy_mod.resolve(policy)
+    free = view.free()
+    return pol.score(PolicyView(
+        free_units=free.get(idx, 0),
+        capacity=max(view.capacity.values(), default=0),
+        request_units=request_units,
+        free_vector=tuple(f for f in free.values() if f >= request_units),
+        chip=idx,
+    ))
 
 
 def evaluate_filter_and_scores(
     request_units: int,
     views: list[NodeView],
-    policy: str = "best-fit",
+    policy: "str | PlacementPolicy" = "best-fit",
     gang_shape: str = "",
 ) -> tuple[list[str], dict[str, str], dict[str, ScoreVector]]:
     """One pass over prebuilt views -> (fits, failed reasons, score
@@ -378,13 +422,14 @@ def evaluate_filter_and_scores(
     fits: list[str] = []
     failed: dict[str, str] = {}
     scores: dict[str, ScoreVector] = {}
+    pol = policy_mod.resolve(policy)
     for view in views:
         if not view.capacity:
             failed[view.name] = f"node does not advertise {view.resource}"
             continue
         if gang_shape:
             cand, _per, reason, score = _gang_eval(
-                view, gang_shape, request_units, policy
+                view, gang_shape, request_units, pol
             )
             if cand is None:
                 failed[view.name] = reason
@@ -404,7 +449,7 @@ def evaluate_filter_and_scores(
             free.values(),
             max(view.capacity.values(), default=0),
             request_units,
-            policy,
+            pol,
         )
     return fits, failed, scores
 
@@ -412,23 +457,24 @@ def evaluate_filter_and_scores(
 def evaluate_score_vectors(
     request_units: int,
     views: list[NodeView],
-    policy: str = "best-fit",
+    policy: "str | PlacementPolicy" = "best-fit",
     gang_shape: str = "",
 ) -> dict[str, ScoreVector]:
+    pol = policy_mod.resolve(policy)
     if gang_shape:
         return {
-            v.name: _gang_eval(v, gang_shape, request_units, policy)[3]
+            v.name: _gang_eval(v, gang_shape, request_units, pol)[3]
             for v in views
         }
     return {
-        v.name: score_node_vector(v, request_units, policy) for v in views
+        v.name: score_node_vector(v, request_units, pol) for v in views
     }
 
 
 def evaluate_scores(
     request_units: int,
     views: list[NodeView],
-    policy: str = "best-fit",
+    policy: "str | PlacementPolicy" = "best-fit",
     gang_shape: str = "",
 ) -> dict[str, int]:
     """The 0-10 wire projection of :func:`evaluate_score_vectors`."""
@@ -444,7 +490,7 @@ def prioritize_with_views(
     pod: dict,
     nodes: list[dict],
     views_fn: Callable[[str, list[dict]], list["NodeView"]],
-    policy: str = "best-fit",
+    policy: "str | PlacementPolicy" = "best-fit",
 ) -> dict[str, ScoreVector]:
     """Per-node score breakdowns for the prioritize verb. The webhook
     projects each vector to its pinned 0-10 integer; the decision
@@ -463,7 +509,7 @@ def prioritize_with_views(
 
 
 def prioritize_nodes(
-    pod: dict, nodes: list[dict], pods: list[dict], policy: str = "best-fit"
+    pod: dict, nodes: list[dict], pods: list[dict], policy: "str | PlacementPolicy" = "best-fit"
 ) -> dict[str, int]:
     return {
         name: sv.projected
@@ -474,7 +520,7 @@ def prioritize_nodes(
 
 
 def choose_chip(
-    pod: dict, node: dict, pods: list[dict], policy: str = "best-fit"
+    pod: dict, node: dict, pods: list[dict], policy: "str | PlacementPolicy" = "best-fit"
 ) -> tuple[str, int, dict[str, str]]:
     """Bind-time decision: -> (resource, chip index, annotations to write).
 
@@ -489,7 +535,7 @@ def choose_chip(
 
 
 def choose_gang_from_view(
-    pod: dict, view: NodeView, policy: str = "best-fit"
+    pod: dict, view: NodeView, policy: "str | PlacementPolicy" = "best-fit"
 ) -> tuple[str, tuple[int, ...], int, dict[str, str]]:
     """Bind-time gang decision over a prebuilt view: -> (resource, member
     chips, per-chip units, annotations to write). The score-less form of
@@ -501,7 +547,7 @@ def choose_gang_from_view(
 
 
 def choose_gang_scored(
-    pod: dict, view: NodeView, policy: str = "best-fit"
+    pod: dict, view: NodeView, policy: "str | PlacementPolicy" = "best-fit"
 ) -> tuple[str, tuple[int, ...], int, dict[str, str], ScoreVector]:
     """Bind-time gang decision over a prebuilt view: -> (resource, member
     chips, per-chip units, annotations to write, score breakdown). The
@@ -544,7 +590,7 @@ def choose_gang_scored(
 
 
 def choose_chip_from_view(
-    pod: dict, view: NodeView, policy: str = "best-fit"
+    pod: dict, view: NodeView, policy: "str | PlacementPolicy" = "best-fit"
 ) -> tuple[str, int, dict[str, str]]:
     """``choose_chip`` over a prebuilt view (the index-backed path); the
     score-less form of :func:`choose_chip_scored`."""
@@ -555,22 +601,23 @@ def choose_chip_from_view(
 
 
 def choose_chip_scored(
-    pod: dict, view: NodeView, policy: str = "best-fit"
+    pod: dict, view: NodeView, policy: "str | PlacementPolicy" = "best-fit"
 ) -> tuple[str, int, dict[str, str], ScoreVector]:
     """``choose_chip`` over a prebuilt view, plus the chosen chip's
     score breakdown (pre-claim free units, binpack slack) for the bind
     decision record."""
     resource = view.resource
     family = RESOURCE_FAMILIES[resource]
+    pol = policy_mod.resolve(policy)
     request = P.mem_units_of_pod(pod, resource=resource)
     idx = assign_chip(
         request,
         view.capacity,
         view.used,
         unhealthy=sorted(view.core_held),
-        policy=policy,
+        policy=pol.chip_policy,
     )
-    score = chip_score_vector(view, idx, request, policy)
+    score = chip_score_vector(view, idx, request, pol)
     containers = pod.get("spec", {}).get("containers", [])
     alloc_map = {
         c.get("name", f"c{i}"): {str(idx): P.mem_units_of_container(c, resource)}

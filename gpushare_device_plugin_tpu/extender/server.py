@@ -68,9 +68,11 @@ class ExtenderCore:
     def __init__(
         self,
         api: ApiServerClient,
-        policy: str = "best-fit",
+        policy: "str | logic.PlacementPolicy" = "best-fit",
         informer: Any = None,
         checkpoint: Any = None,
+        shard: str = "",
+        usage_overlay_fn: Any = None,
     ) -> None:
         """``informer``: an optional cluster-wide ``PodInformer`` (no node
         field-selector). With it, filter/prioritize/bind read incremental
@@ -86,11 +88,21 @@ class ExtenderCore:
         may have landed but are not yet visible on the watch, instead of
         double-booking those chips during its cold-start window. Entries
         age out of the overlay on the normal in-flight TTL, by which time
-        the watch has either confirmed them or they never happened."""
+        the watch has either confirmed them or they never happened.
+
+        ``shard``: this core's shard id in a horizontally sharded
+        deployment ("" when unsharded) — stamped on every decision
+        record so a placement is attributable to the shard that made it.
+        ``usage_overlay_fn(node, resource) -> {chip: units}``: extra
+        in-flight usage folded into every node view (the shard layer's
+        cross-shard gang2pc reservations, which the core's own in-flight
+        overlay cannot know about)."""
         self._api = api
         self._policy = policy
         self._informer = informer
         self._ckpt = checkpoint
+        self._shard = shard
+        self._usage_overlay_fn = usage_overlay_fn
         self._index: ClusterUsageIndex | None = None
         if informer is not None:
             self._index = ClusterUsageIndex()
@@ -118,7 +130,10 @@ class ExtenderCore:
         # exactly the touched node.
         self._view_cache: dict[
             tuple[str, str],
-            tuple[str, tuple, dict[int, int], dict[int, int], set[int]],
+            tuple[
+                str, tuple, dict[int, int], dict[int, int], set[int],
+                "logic.ChipTopology | None",
+            ],
         ] = {}
         self._view_cache_max = 8192
         if checkpoint is not None:
@@ -129,6 +144,14 @@ class ExtenderCore:
         wall = time.time()
         seeded = 0
         for key, data in self._ckpt.pending().items():
+            # Cross-shard two-phase gang records ride the same per-shard
+            # WAL but are NOT bind decisions: their resolution belongs to
+            # the shard reconciler (roll forward on a durable commit
+            # decision, roll back otherwise — extender/shards.py). The
+            # warmup must neither replay them as phantom single-chip
+            # capacity nor abort them as malformed.
+            if data.get("kind") == "gang2pc":
+                continue
             # Entries older than the in-flight TTL are stale survivors of
             # an earlier crash cycle: by now the watch has either shown
             # their bind or it never landed — resolve them at load instead
@@ -243,16 +266,27 @@ class ExtenderCore:
         with self._lock:
             entry = self._view_cache.get(key)
             if entry is not None and rv is not None and entry[0] == rv and entry[1] == gen:
-                _rv, _gen, capacity, used, core_held = entry
+                _rv, _gen, capacity, used, core_held, topo = entry
                 outcome = "hit"
         if outcome == "rebuild":
             capacity = logic.node_capacity(node, resource)
             used, core_held = self._index.node_state(name, resource)
+            # The topology grid is a pure function of node labels +
+            # capacity (both covered by the resourceVersion key), and
+            # rebuilding it was the single hottest line of a 1k-node
+            # scoring pass — cache it with the rest of the view.
+            topo = (
+                logic.node_topology(node, capacity)
+                if resource == logic.const.RESOURCE_MEM
+                else None
+            )
             if rv is not None:
                 with self._lock:
                     if len(self._view_cache) >= self._view_cache_max:
                         self._view_cache.clear()  # crude, but bounds memory
-                    self._view_cache[key] = (rv, gen, capacity, used, core_held)
+                    self._view_cache[key] = (
+                        rv, gen, capacity, used, core_held, topo
+                    )
         REGISTRY.counter_inc(
             "tpushare_extender_view_total",
             "NodeView constructions by outcome (hit = served from the "
@@ -267,11 +301,7 @@ class ExtenderCore:
             core_held=(
                 set(core_held) if resource == logic.const.RESOURCE_MEM else set()
             ),
-            topology=(
-                logic.node_topology(node, capacity)
-                if resource == logic.const.RESOURCE_MEM
-                else None
-            ),
+            topology=topo,
         )
 
     def _node_views(
@@ -335,6 +365,7 @@ class ExtenderCore:
             # the overlay mirror of the all-or-nothing ledger entry.
             for member in entry.chips or (entry.idx,):
                 view.used[member] = view.used.get(member, 0) + entry.units
+        self._apply_usage_overlay(views, resource)
         return views
 
     def _views_from_pods(
@@ -344,7 +375,25 @@ class ExtenderCore:
         build, pure memory (safe under the decision lock)."""
         pods = self._overlay_pods(raw_pods)
         by_node = logic.group_pods_by_node(pods)
-        return [logic.build_node_view(n, by_node, resource) for n in nodes]
+        views = [logic.build_node_view(n, by_node, resource) for n in nodes]
+        self._apply_usage_overlay(views, resource)
+        return views
+
+    def _apply_usage_overlay(
+        self, views: list[logic.NodeView], resource: str
+    ) -> None:
+        """Fold the shard layer's extra in-flight usage (cross-shard
+        gang2pc reservations) into the views — pure memory, both the
+        index and the list path run it so a prepared-but-undecided gang
+        member is invisible to NO scoring read."""
+        if self._usage_overlay_fn is None:
+            return
+        for view in views:
+            extra = self._usage_overlay_fn(view.name, resource)
+            if not extra:
+                continue
+            for idx, units in extra.items():
+                view.used[idx] = view.used.get(idx, 0) + units
 
     def _fetch_cluster_pods(self) -> list[dict]:
         """The list-fallback's raw pod set: the synced cache, else one
@@ -378,6 +427,27 @@ class ExtenderCore:
                 pod.setdefault("spec", {}).setdefault("nodeName", entry.node)
                 out[i] = pod
         return out
+
+    def node_views(
+        self, nodes: list[dict], resource: str
+    ) -> list[logic.NodeView]:
+        """CURRENT placement views with every overlay applied (in-flight
+        binds, shard gang2pc reservations) — the public read the shard
+        layer re-validates 2PC prepares, plans gang members, and builds
+        routing summaries against. ONE in-flight overlay pass covers the
+        whole node list (per-node calls would pay O(in-flight) each).
+        Network I/O (the list-fallback LIST) runs before the decision
+        lock, mirroring ``bind``."""
+        resource = resource or logic.const.RESOURCE_MEM
+        raw_pods = None if self._use_index() else self._fetch_cluster_pods()
+        with self._lock:
+            if raw_pods is None:
+                return self._views_from_index(resource, nodes)
+            return self._views_from_pods(resource, nodes, raw_pods)
+
+    def node_view(self, node: dict, resource: str) -> logic.NodeView:
+        """One node's :meth:`node_views`."""
+        return self.node_views([node], resource)[0]
 
     def _nodes_from_args(self, args: dict) -> list[dict]:
         if args.get("nodes") and args["nodes"].get("items"):
@@ -437,6 +507,7 @@ class ExtenderCore:
             self._pod_key_of(pod), "filter",
             candidates=len(nodes), rejected=failed,
             trace_id=ctx.trace_id if ctx is not None else "",
+            shard=self._shard,
         )
         fit_set = set(fits)
         return {
@@ -466,6 +537,7 @@ class ExtenderCore:
             self._pod_key_of(pod), "prioritize",
             candidates=len(nodes), scores=scores,
             trace_id=ctx.trace_id if ctx is not None else "",
+            shard=self._shard,
         )
         # The wire format stays the pinned 0-10 integer projection; the
         # decision record above keeps the full-resolution breakdown.
@@ -474,13 +546,15 @@ class ExtenderCore:
             for host, sv in scores.items()
         ]
 
-    def batch(self, args: dict) -> dict:
-        """Batched filter + prioritize in one verb: one view build and one
-        free-vector computation per node serve both answers (the two-verb
-        protocol builds views twice per scheduling cycle). Same args as
-        filter; the response adds ``hostPriorityList`` for the fitting
-        nodes. Not part of the upstream extender protocol — callers are
-        our own tooling (bench, tests) and schedulers taught the route."""
+    def batch_scored(self, args: dict) -> dict:
+        """The batch verb's rich (in-process) form: one view build per
+        node serves both the fit check and the score, and the answer
+        keeps the full-resolution :class:`ScoreVector` per fitting node
+        — ``{"fits", "failed", "scores", "resource"}``. The shard router
+        merges THESE across shards (projecting only at its own wire
+        edge); :meth:`batch` is the wire projection for direct webhook
+        callers. Emits this core's decision record (shard-tagged when
+        the core is a shard)."""
         pod = args.get("pod") or {}
         nodes = self._nodes_from_args(args)
         resource = logic.pod_resource(pod)
@@ -490,13 +564,11 @@ class ExtenderCore:
                 self._pod_key_of(pod), "batch",
                 candidates=len(nodes),
                 reason="pod requests no share resource (all nodes pass)",
+                shard=self._shard,
             )
             return {
-                "nodes": {"items": nodes},
-                "nodenames": names,
-                "failedNodes": {},
-                "hostPriorityList": [{"host": n, "score": 0} for n in names],
-                "error": "",
+                "fits": names, "failed": {}, "scores": {},
+                "resource": None, "nodes": nodes,
             }
         request = P.mem_units_of_pod(pod, resource=resource)
         ctx = self._admission_ctx(pod)
@@ -517,23 +589,21 @@ class ExtenderCore:
             self._pod_key_of(pod), "batch",
             candidates=len(nodes), rejected=failed, scores=scores,
             trace_id=ctx.trace_id if ctx is not None else "",
+            shard=self._shard,
         )
-        fit_set = set(fits)
         return {
-            "nodes": {"items": [n for n in nodes
-                                if n.get("metadata", {}).get("name") in fit_set]},
-            "nodenames": fits,
-            "failedNodes": failed,
-            # 0-10 wire projection, ordered best-first by the RAW
-            # fractional score (deterministic tie-break — the integer
-            # scale ties most nodes at fleet scale; the wire VALUES are
-            # unchanged, only the list order is pinned).
-            "hostPriorityList": [
-                {"host": name, "score": scores[name].projected}
-                for name in rank_scores(scores)
-            ],
-            "error": "",
+            "fits": fits, "failed": failed, "scores": scores,
+            "resource": resource, "nodes": nodes,
         }
+
+    def batch(self, args: dict) -> dict:
+        """Batched filter + prioritize in one verb: one view build and one
+        free-vector computation per node serve both answers (the two-verb
+        protocol builds views twice per scheduling cycle). Same args as
+        filter; the response adds ``hostPriorityList`` for the fitting
+        nodes. Not part of the upstream extender protocol — callers are
+        our own tooling (bench, tests) and schedulers taught the route."""
+        return batch_wire(self.batch_scored(args))
 
     def bind(self, args: dict) -> dict:
         """Persist the chip decision and create the v1 Binding.
@@ -587,8 +657,11 @@ class ExtenderCore:
         self, args: dict, ns: str, name: str, node_name: str, bsp: Any
     ) -> dict:
         try:
-            pod = self._api.get_pod(ns, name)
-            node = self._api.get_node(node_name)
+            # Callers that already hold the objects (the shard router,
+            # schedulers speaking the full ExtenderArgs shape) pass them
+            # along; the GETs are the fallback for name-only callers.
+            pod = args.get("podObject") or self._api.get_pod(ns, name)
+            node = args.get("nodeObject") or self._api.get_node(node_name)
             resource = logic.pod_resource(pod)
             if resource is None:
                 raise AssignmentError("pod requests no share resource")
@@ -699,6 +772,7 @@ class ExtenderCore:
                 f"{ns}/{name}", "bind", outcome="error",
                 node=node_name, reason=str(e),
                 trace_id=bsp.trace_id if bsp.recording else "",
+                shard=self._shard,
             )
             return {"error": str(e)}
         if chips:
@@ -718,9 +792,43 @@ class ExtenderCore:
             f"{ns}/{name}", "bind",
             node=node_name, scores={node_name: score}, placement=placement,
             trace_id=bsp.trace_id if bsp.recording else "",
-            seq=seq,
+            seq=seq, shard=self._shard,
         )
         return {"error": ""}
+
+
+def batch_wire(rich: dict) -> dict:
+    """THE rich->wire projection for the batch verb, shared by the
+    single core and the shard router so the two deployments' response
+    shapes can never drift. ``rich`` is a ``batch_scored`` result (or
+    the router's cross-shard merge of several): 0-10 projected scores,
+    hostPriorityList ordered best-first by the RAW fractional score
+    (deterministic tie-break — the integer scale ties most nodes at
+    fleet scale; the wire VALUES are the pinned projection, only the
+    list order is added)."""
+    nodes = rich["nodes"]
+    if rich["resource"] is None:
+        names = rich["fits"]
+        return {
+            "nodes": {"items": nodes},
+            "nodenames": names,
+            "failedNodes": {},
+            "hostPriorityList": [{"host": n, "score": 0} for n in names],
+            "error": "",
+        }
+    fits, failed, scores = rich["fits"], rich["failed"], rich["scores"]
+    fit_set = set(fits)
+    return {
+        "nodes": {"items": [n for n in nodes
+                            if n.get("metadata", {}).get("name") in fit_set]},
+        "nodenames": fits,
+        "failedNodes": failed,
+        "hostPriorityList": [
+            {"host": name, "score": scores[name].projected}
+            for name in rank_scores(scores)
+        ],
+        "error": "",
+    }
 
 
 class ExtenderHTTPServer:
@@ -818,6 +926,11 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--port", type=int, default=32766)
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--policy", default="best-fit", choices=["first-fit", "best-fit", "spread"])
+    p.add_argument("--placement-policy", default="",
+                   help="pluggable placement policy from the registry "
+                   "(greedy-binpack | multi-objective | learned | "
+                   "anything register_policy()'d); overrides --policy. "
+                   "Empty keeps the legacy chip-policy scorer")
     p.add_argument("--pod-source", default="informer", choices=["informer", "list"],
                    help="watch-backed cluster pod cache (default) or a full "
                    "LIST per webhook call")
@@ -898,8 +1011,13 @@ def main(argv: list[str] | None = None) -> int:
             )
         except OSError as e:
             log.warning("bind checkpoint unavailable (%s); running without", e)
+    policy: "str | logic.PlacementPolicy" = args.policy
+    if args.placement_policy:
+        from .policy import get_policy
+
+        policy = get_policy(args.placement_policy)
     core = ExtenderCore(
-        api, policy=args.policy, informer=informer, checkpoint=checkpoint
+        api, policy=policy, informer=informer, checkpoint=checkpoint
     )
     core_ref.append(core)
     server = ExtenderHTTPServer(core, host=args.host, port=args.port)

@@ -173,6 +173,13 @@ class DecisionRecord:
     moves: tuple[str, ...] = ()  # defrag plans: affected pod keys
     trace_id: str = ""
     seq: int | None = None
+    # Sharded extender provenance: which shard made this decision, and —
+    # for router-merged batch verbs — which shards were NEVER consulted
+    # (unreachable / partitioned), so "rejected" and "not consulted" are
+    # distinguishable in `inspect why`. A node owned by a degraded shard
+    # was not scored at all; its absence from `rejected` is not a pass.
+    shard: str = ""
+    degraded_shards: tuple[str, ...] = ()
 
     def to_dict(self) -> dict[str, Any]:
         doc: dict[str, Any] = {
@@ -203,6 +210,10 @@ class DecisionRecord:
             doc["trace_id"] = self.trace_id
         if self.seq is not None:
             doc["seq"] = self.seq
+        if self.shard:
+            doc["shard"] = self.shard
+        if self.degraded_shards:
+            doc["degraded_shards"] = list(self.degraded_shards)
         return doc
 
 
@@ -285,6 +296,8 @@ class DecisionLog:
         moves: Iterable[str] = (),
         trace_id: str = "",
         seq: int | None = None,
+        shard: str = "",
+        degraded_shards: Iterable[str] = (),
     ) -> DecisionRecord | None:
         """Record one decision; returns the stamped record (None when the
         log is disabled). The dict arguments are stored by reference —
@@ -311,6 +324,8 @@ class DecisionLog:
                 moves=tuple(moves),
                 trace_id=trace_id,
                 seq=seq,
+                shard=shard,
+                degraded_shards=tuple(degraded_shards),
             )
             if len(self._ring) == self._ring.maxlen:
                 self._dropped += 1

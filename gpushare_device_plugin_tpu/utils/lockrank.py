@@ -82,11 +82,39 @@ RANKS: dict[str, LockRank] = dict(
             "allowed to cover the full I/O flow.",
         ),
         _r(
+            "extender.lease", 16, "lock", False,
+            "LeaderLease's per-gang-group coordinator epochs (the 2PC "
+            "fencing tokens). Pure memory, acquired before any shard "
+            "verb runs — outermost of the shard-layer locks.",
+        ),
+        _r(
+            "extender.router", 17, "lock", False,
+            "ShardRouter's cached shard summaries + degraded-shard "
+            "bookkeeping. Never held across a shard verb call (those "
+            "acquire extender.core and the ledger further down-rank).",
+        ),
+        _r(
+            "extender.simchurn", 19, "lock", False,
+            "ChurnDriver's stats/death-heap guard (the scale bench's "
+            "simulated-cluster worker pool). Held around counter and "
+            "heap flips only — admissions, apiserver calls, and shard "
+            "verbs all run with it released.",
+        ),
+        _r(
             "extender.core", 20, "rlock", False,
             "ExtenderCore's decision lock: guards the in-flight overlay "
             "and the view cache while a bind decision is made. In-memory "
             "only by design — a network or fsync wait here serializes "
             "every bind in the cluster behind one I/O.",
+        ),
+        _r(
+            "extender.twopc", 21, "lock", False,
+            "ShardExtender's 2PC side-state (gang2pc reservation key -> "
+            "node map, seen coordinator epochs). Read by the shard's "
+            "usage-overlay hook while the core's decision lock (rank 20) "
+            "is held, so it sits just above extender.core; the journal "
+            "write and the ledger reserve run outside it, strictly "
+            "up-rank.",
         ),
         _r(
             "allocator.match", 22, "lock", True,

@@ -345,7 +345,11 @@ class MetricsServer:
     serves the decision-provenance ring as JSON (``?pod=ns/name`` /
     ``?verb=`` narrow — what ``inspect why`` fetches); ``/timeline``
     serves the cluster-state timeline ring (``inspect timeline``).
-    ``/healthz`` is liveness (200 while the server thread runs);
+    ``/shards`` serves the shard router's shard map (ring ownership,
+    per-shard WAL seq + queue depth, 2PC gangs in flight — what
+    ``inspect shards`` fetches) when ``shards_doc_fn`` is wired, 404
+    otherwise. ``/healthz`` is liveness (200 while the server thread
+    runs);
     ``/readyz`` consults ``ready_fn`` — 200 when it returns truthy, 503
     otherwise (deploy probes gate on informer sync + WAL replay for the
     extender, plugin registration for the daemon)."""
@@ -355,7 +359,8 @@ class MetricsServer:
                  trace_store: "tracing.TraceStore | None" = None,
                  decisions: Any = None,
                  timeline: Any = None,
-                 ready_fn: Callable[[], bool] | None = None) -> None:
+                 ready_fn: Callable[[], bool] | None = None,
+                 shards_doc_fn: Callable[[], dict] | None = None) -> None:
         self._registry = registry
         self._host = host
         self._port = port
@@ -371,6 +376,7 @@ class MetricsServer:
             timeline = TIMELINE
         self._timeline = timeline
         self._ready_fn = ready_fn
+        self._shards_doc_fn = shards_doc_fn
         self._server: ThreadingHTTPServer | None = None
 
     @property
@@ -384,6 +390,7 @@ class MetricsServer:
         decisions = self._decisions
         timeline = self._timeline
         ready_fn = self._ready_fn
+        shards_doc_fn = self._shards_doc_fn
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
@@ -421,6 +428,9 @@ class MetricsServer:
                     ctype = "application/json"
                 elif url.path == "/timeline":
                     body = _json.dumps(timeline.to_doc()).encode()
+                    ctype = "application/json"
+                elif url.path == "/shards" and shards_doc_fn is not None:
+                    body = _json.dumps(shards_doc_fn()).encode()
                     ctype = "application/json"
                 elif url.path == "/healthz":
                     body = b"ok\n"

@@ -1,0 +1,24 @@
+"""FIXTURE (never imported): shard code reaching past the 2PC reserve
+API into the AssumeCache's other surfaces — each marked line must be
+flagged by the ledger-encapsulation rule when this file is loaded under
+a path ending in shards.py."""
+
+
+class BadShard:
+    def __init__(self, ledger):
+        self._ledger = ledger
+
+    def sneaky_single_chip(self, key):
+        # single-chip reservation family: bypasses the all-or-nothing
+        # gang entry — a crash here strands a partial cross-shard gang
+        self._ledger.reserve_mem(key, 0, 4)  # FLAG
+
+    def sneaky_snapshot(self):
+        return self._ledger.snapshot()  # FLAG
+
+    def sneaky_transaction(self, key):
+        with self._ledger.transaction():  # FLAG
+            self._ledger.reserve_core(key, [0, 1])  # FLAG
+
+    def sneaky_reconciler_surface(self, key):
+        return self._ledger.release_if_unclaimed(key)  # FLAG

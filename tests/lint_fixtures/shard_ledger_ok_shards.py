@@ -1,0 +1,23 @@
+"""FIXTURE (never imported): shard code staying inside the 2PC reserve
+API — zero findings expected under a shards.py path."""
+
+
+class OkShard:
+    def __init__(self, ledger):
+        self._ledger = ledger
+
+    def prepare(self, key, members):
+        if not self._ledger.claim(key):
+            return False
+        self._ledger.reserve_gang(key, members)
+        return True
+
+    def refresh(self, key):
+        return self._ledger.renew(key) and self._ledger.is_claimed(key)
+
+    def rollback(self, key):
+        self._ledger.release(key)
+
+    def inventory(self):
+        self._ledger.expire_stale()
+        return self._ledger.gang_snapshot()

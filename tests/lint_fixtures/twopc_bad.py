@@ -1,0 +1,17 @@
+"""FIXTURE (never imported): a gang2pc journal begin whose returned
+(key, seq) handle is discarded — flagged by the wal-protocol rule (the
+seq is the only handle a later commit/abort can seq-guard with)."""
+
+
+class BadTwoPhase:
+    def __init__(self, ckpt):
+        self._ckpt = ckpt
+
+    def _journal_2pc(self, key, data):
+        data = dict(data)
+        data["kind"] = "gang2pc"
+        return self._ckpt.begin(key, data)
+
+    def prepare(self, key):
+        self._journal_2pc(key, {"phase": "prepare"})  # FLAG: seq discarded
+        return True

@@ -1,0 +1,26 @@
+"""FIXTURE (never imported): gang2pc begins whose seqs are kept —
+assigned for a later seq-guarded resolve, or returned to the caller.
+Zero wal-protocol findings expected (a 2PC prepare legitimately leaves
+its entry pending across the process boundary)."""
+
+
+class OkTwoPhase:
+    def __init__(self, ckpt):
+        self._ckpt = ckpt
+        self._seqs = {}
+
+    def _journal_2pc(self, key, data):
+        data = dict(data)
+        data["kind"] = "gang2pc"
+        return self._ckpt.begin(key, data)
+
+    def prepare(self, key):
+        seq = self._journal_2pc(key, {"phase": "prepare"})
+        self._seqs[key] = seq
+        return True
+
+    def decide(self, key):
+        return self._journal_2pc(key, {"phase": "decision"})
+
+    def commit(self, key):
+        self._ckpt.commit(key, seq=self._seqs.pop(key, None))

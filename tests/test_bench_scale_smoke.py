@@ -1,0 +1,61 @@
+"""Tier-1 smoke run of the sharded-extender scale bench (ISSUE 14).
+
+``bench.py --scale-smoke`` (``make bench-scale-smoke``) is the only
+place the whole horizontal-sharding stack — the consistent-hash ring,
+per-shard ``ExtenderCore`` instances with their own informer indexes and
+per-shard group-commit bind WALs, the pruned-fanout router, AND the
+cross-shard gang-group two-phase reserve — runs end-to-end as one
+pipeline against the fake apiserver under Poisson churn. The
+correctness gates stay HARD in smoke mode: zero cross-shard
+double-bookings (per-chip overcommit audit), zero partial gang grants,
+and every "gang2pc" journal entry drained after the reconciler pass.
+The >=3x speedup gate is full-size-only (``--scale-bench``) — two
+shards on sixteen nodes prove plumbing, not scaling.
+
+Subprocess on purpose: the benchmark must work as shipped (argv
+handling, sys.path bootstrap, the JSON contract the driver parses), not
+merely as importable functions.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def test_bench_scale_smoke_runs_and_gates_hold():
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--scale-smoke"],
+        capture_output=True,
+        text=True,
+        timeout=300,
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, (
+        f"bench.py --scale-smoke failed rc={proc.returncode}\n"
+        f"stdout tail: {proc.stdout[-2000:]}\n"
+        f"stderr tail: {proc.stderr[-2000:]}"
+    )
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
+    record = json.loads(lines[-1])
+    assert record["metric"] == "scale_bench"
+    assert record["smoke"] is True
+    # one throughput config per (nodes, shards) pair
+    assert len(record["configs"]) == len(record["node_counts"]) * len(
+        record["shard_counts"]
+    )
+    # the gates already enforced these inside the subprocess (exit 1 on
+    # violation); re-assert the invariant shape the driver reads
+    for cfg in record["configs"] + [record["storm"]]:
+        assert cfg["violations"] == [], cfg
+        assert cfg["gang2pc_pending_after"] == 0, cfg
+        assert cfg["admitted"] > 0, cfg
+    # the storm exercised the cross-shard two-phase reserve
+    assert record["storm"]["gang_groups"] > 0
+    # headline fields the trend guards hoist
+    assert record["scale_admissions_per_s"] > 0
+    assert record["scale_admission_p99_ms"] > 0

@@ -518,3 +518,39 @@ def test_sparkline_scales_and_windows():
     line = sparkline(list(range(100)), width=10)
     assert len(line) == 10
     assert line[0] == "▁" and line[-1] == "█"
+
+
+def test_render_why_shard_and_degraded_shards():
+    """Sharded provenance: the record head names the deciding shard, and
+    a router-merged batch verb distinguishes "not consulted" (degraded
+    shards) from "rejected"."""
+    out = render_why("default/sp1", [
+        {
+            "id": 11, "verb": "batch", "outcome": "ok",
+            "shard": "router", "candidates": 6,
+            "rejected": {"node-x": "no single chip with 8 free units"},
+            "degraded_shards": ["shard-2"],
+        },
+        {
+            "id": 12, "verb": "bind", "outcome": "ok", "node": "node-a",
+            "shard": "shard-0", "placement": {"chip": 1, "units": 8},
+        },
+    ])
+    assert "[#11] batch @router" in out
+    assert "! not consulted (degraded shards): shard-2" in out
+    assert "x node-x: no single chip" in out
+    assert "[#12] bind @shard-0 -> node-a" in out
+
+
+def test_decision_record_shard_fields_roundtrip():
+    log = DecisionLog(max_records=4)
+    rec = log.emit(
+        "default/sp2", "batch", candidates=3,
+        shard="router", degraded_shards=["shard-1", "shard-3"],
+    )
+    doc = rec.to_dict()
+    assert doc["shard"] == "router"
+    assert doc["degraded_shards"] == ["shard-1", "shard-3"]
+    # absent fields stay off the wire (reference layouts unchanged)
+    bare = log.emit("default/sp3", "filter").to_dict()
+    assert "shard" not in bare and "degraded_shards" not in bare

@@ -611,3 +611,92 @@ def test_cli_top_end_to_end(api, capsys, monkeypatch):
     assert "tpushare top" in out
     assert "default/lora(BE) default/svc(LC)" in out
     assert "2.10x default/svc FLAGGED" in out
+
+
+# --- shard map (`inspect shards`) -------------------------------------------
+
+
+SHARDS_DOC = {
+    "ring": {
+        "shards": 2, "vnodes": 128,
+        "nodes_per_shard": {"shard-0": 3, "shard-1": 2},
+    },
+    "fanout": 2,
+    "shards": [
+        {"shard": "shard-0", "nodes": 3, "partitioned": False,
+         "wal_seq": 17, "wal_pending": 1, "gangs_inflight": 1},
+        {"shard": "shard-1", "nodes": 2, "partitioned": True,
+         "wal_seq": 4, "wal_pending": 0, "gangs_inflight": 0},
+    ],
+    "gangs_2pc": [
+        {"group": "g7", "phase": "prepare", "shard": "shard-0",
+         "node": "n1", "pod": "g7-m0"},
+    ],
+}
+
+SHARDS_GOLDEN = (
+    "shard map — 2 shard(s), 128 vnodes/shard, fanout 2\n"
+    "SHARD    NODES  WAL-SEQ  QUEUE  2PC  STATE\n"
+    "shard-0      3       17      1    1  ok\n"
+    "shard-1      2        4      0    0  PARTITIONED\n"
+    "gang 2PC in flight:\n"
+    "   g7 [prepare] pod=g7-m0 node=n1 shard=shard-0\n"
+)
+
+
+def test_render_shards_golden():
+    from gpushare_device_plugin_tpu.cli.display import render_shards
+
+    assert render_shards(SHARDS_DOC) == SHARDS_GOLDEN
+
+
+def test_render_shards_empty():
+    from gpushare_device_plugin_tpu.cli.display import render_shards
+
+    out = render_shards({"ring": {}, "shards": []})
+    assert "(no shards)" in out
+
+
+def test_cli_shards_end_to_end(capsys):
+    """`inspect shards --shards-url` against a real MetricsServer with a
+    live ShardRouter's shards_doc wired in."""
+    from gpushare_device_plugin_tpu.utils.metrics import MetricsServer
+
+    server = MetricsServer(
+        host="127.0.0.1", shards_doc_fn=lambda: SHARDS_DOC
+    ).start()
+    try:
+        url = f"http://127.0.0.1:{server.port}"
+        rc = inspect_cli.main(["shards", "--shards-url", url])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert out == SHARDS_GOLDEN
+        rc = inspect_cli.main(["shards", "--shards-url", url, "-o", "json"])
+        doc = json.loads(capsys.readouterr().out)
+        assert [r["shard"] for r in doc["shards"]] == ["shard-0", "shard-1"]
+        assert doc["gangs_2pc"][0]["group"] == "g7"
+    finally:
+        server.stop()
+
+
+def test_cli_shards_requires_url(capsys):
+    rc = inspect_cli.main(["shards"])
+    assert rc == 1
+    assert "--shards-url" in capsys.readouterr().err
+
+
+def test_fetch_shards_dedupes_replica_gangs():
+    """Two router replicas fronting the same shards report the same
+    in-flight gangs — the merge dedupes them like the shard rows."""
+    from gpushare_device_plugin_tpu.utils.metrics import MetricsServer
+
+    server = MetricsServer(
+        host="127.0.0.1", shards_doc_fn=lambda: SHARDS_DOC
+    ).start()
+    try:
+        url = f"http://127.0.0.1:{server.port}"
+        doc = inspect_cli.fetch_shards([url, url])
+        assert len(doc["gangs_2pc"]) == 1
+        assert len(doc["shards"]) == 2
+    finally:
+        server.stop()

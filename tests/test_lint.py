@@ -307,3 +307,49 @@ def test_cli_exit_codes(capsys):
     out = capsys.readouterr().out
     assert "clean" in out
     assert engine.main(["--root", REPO_ROOT, "--list"]) == 0
+
+
+# --- sharded-extender rules (ISSUE 14) --------------------------------------
+
+
+def test_shard_ledger_rule_flags_non_2pc_surface():
+    """Shard code (any path ending shards.py) touching the AssumeCache
+    outside the 2PC reserve API is flagged — single-chip reservation
+    families, snapshots, transactions, the reconciler surface."""
+    mod = _fixture(
+        "shard_ledger_bad_shards.py", PKG + "extender/shards.py"
+    )
+    found = _rules([mod], "ledger-encapsulation")
+    assert len(found) == 5, found
+    messages = " | ".join(f.message for f in found)
+    for method in ("reserve_mem", "snapshot", "transaction",
+                   "reserve_core", "release_if_unclaimed"):
+        assert method in messages
+
+
+def test_shard_ledger_rule_accepts_2pc_api():
+    mod = _fixture(
+        "shard_ledger_ok_shards.py", PKG + "extender/shards.py"
+    )
+    assert _rules([mod], "ledger-encapsulation") == []
+
+
+def test_shard_ledger_rule_scoped_to_shard_modules():
+    """The same calls OUTSIDE a shards.py module are not the shard
+    rule's business (other rules still police protected internals)."""
+    mod = _fixture(
+        "shard_ledger_bad_shards.py", PKG + "allocator/elsewhere.py"
+    )
+    assert _rules([mod], "ledger-encapsulation") == []
+
+
+def test_twopc_rule_flags_discarded_seq():
+    mod = _fixture("twopc_bad.py", PKG + "extender/shards.py")
+    found = _rules([mod], "wal-protocol")
+    assert len(found) == 1, found
+    assert "discarded" in found[0].message
+
+
+def test_twopc_rule_accepts_kept_seq():
+    mod = _fixture("twopc_ok.py", PKG + "extender/shards.py")
+    assert _rules([mod], "wal-protocol") == []

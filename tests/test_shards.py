@@ -1,0 +1,836 @@
+"""Horizontally sharded extender: ring, router fan-out, cross-shard
+two-phase gang placement, and the kill-at-every-step chaos suite
+(``make chaos-shard``).
+
+The 2PC invariants under test are the move-protocol discipline applied
+across shards: every "gang2pc" journal record is written durably BEFORE
+its side effect, a durable commit decision rolls forward, an undecided
+prepare rolls back, and after any single crash + reconciler pass there
+is NO partial gang visible in the apiserver, NO orphaned cross-shard
+reservation in any shard's ledger, and NO pending gang2pc journal
+entry.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from gpushare_device_plugin_tpu import const
+from gpushare_device_plugin_tpu.allocator.checkpoint import (
+    AllocationCheckpoint,
+)
+from gpushare_device_plugin_tpu.cluster import pods as P
+from gpushare_device_plugin_tpu.cluster.apiserver import ApiServerClient
+from gpushare_device_plugin_tpu.cluster.informer import PodInformer
+from gpushare_device_plugin_tpu.extender import simcluster as S
+from gpushare_device_plugin_tpu.extender.server import ExtenderCore
+from gpushare_device_plugin_tpu.extender.shards import (
+    GANG2PC_NS,
+    HashRing,
+    LeaderLease,
+    ShardExtender,
+    ShardRouter,
+    ShardUnavailable,
+    StaleCoordinator,
+    resolve_gang2pc,
+)
+from gpushare_device_plugin_tpu.utils.decisions import DECISIONS
+from gpushare_device_plugin_tpu.utils.faults import FAULTS, SimulatedCrash
+
+from fake_apiserver import FakeApiServer
+from k8s_fixtures import make_pod
+
+
+# --- helpers ----------------------------------------------------------------
+
+
+def share_pod(name: str, units: int) -> dict:
+    return make_pod(name, units, node="")
+
+
+def group_pod(name: str, group: str, total: int, shape: str) -> dict:
+    return make_pod(
+        name, total, node="",
+        annotations={
+            const.ANN_GANG_SHAPE: shape,
+            const.ANN_GANG_GROUP: group,
+        },
+    )
+
+
+def nodes_one_per_shard(
+    shard_ids: list[str], shape: str = "2x1", chips: int = 2,
+    chip_units: int = 32,
+) -> list[dict]:
+    """One node per shard, names CHOSEN so the ring assigns exactly one
+    to each shard — the construction that makes a multi-member gang
+    group provably cross-shard."""
+    ring = HashRing(shard_ids)
+    got: dict[str, dict] = {}
+    i = 0
+    while len(got) < len(shard_ids):
+        name = f"xsn-{i:04d}"
+        i += 1
+        sid = ring.owner(name)
+        if sid not in got:
+            got[sid] = S.synth_node(name, shape, chips, chip_units)
+    return [got[sid] for sid in shard_ids]
+
+
+@contextlib.contextmanager
+def sharded_env(
+    tmp_path, n_shards: int = 3, nodes: list[dict] | None = None,
+    n_nodes: int = 6, fanout: int = 2, wal: bool = True, seed: int = 1,
+):
+    api = FakeApiServer(chaos=False)
+    if nodes is None:
+        nodes = S.make_cluster(n_nodes, seed=seed)
+    for n in nodes:
+        api.nodes[n["metadata"]["name"]] = n
+    api.start()
+    client = ApiServerClient(api.url)
+    informer = PodInformer(client).start(sync_timeout_s=30)
+    env = SimpleNamespace(
+        api=api, client=client, informer=informer, nodes=nodes,
+        tmp=tmp_path, n_shards=n_shards, fanout=fanout, wal=wal,
+        lease=LeaderLease(),
+    )
+    _build_shards(env)
+    try:
+        yield env
+    finally:
+        informer.stop()
+        api.stop()
+
+
+def _build_shards(env) -> None:
+    env.ckpts = [
+        AllocationCheckpoint(str(env.tmp / f"shard-{i}.wal"))
+        if env.wal else None
+        for i in range(env.n_shards)
+    ]
+    env.shards = [
+        ShardExtender(
+            f"shard-{i}", env.client, informer=env.informer,
+            checkpoint=env.ckpts[i],
+        )
+        for i in range(env.n_shards)
+    ]
+    env.router = ShardRouter(env.shards, fanout=env.fanout, lease=env.lease)
+    env.router.set_nodes(env.nodes)
+
+
+def restart_shards(env) -> None:
+    """Simulate whole-deployment SIGKILL + restart: every checkpoint is
+    abandoned (queued bytes lost, handles dropped, nothing resolved) and
+    a fresh shard set is rebuilt over the same WAL files."""
+    for ck in env.ckpts:
+        if ck is not None:
+            ck.abandon()
+    _build_shards(env)
+
+
+def wait_until(pred, timeout: float = 8.0, interval: float = 0.03):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def group_states(client: ApiServerClient, group: str) -> list[bool]:
+    """Per-member bound/unbound for every pod in ``group``."""
+    return [
+        bool(P.gang_chips_from_annotation(p))
+        for p in client.list_pods()
+        if P.gang_group(p) == group
+    ]
+
+
+def assert_2pc_drained(env) -> None:
+    """No pending gang2pc journal entry anywhere, and every ledger
+    reservation drains once the watch shows the committed pods (the
+    overlay's visibility release — poked explicitly here, since it runs
+    lazily on scoring reads)."""
+    for shard in env.shards:
+        assert shard.twopc_pending() == [], (
+            f"{shard.shard_id} still holds gang2pc journal entries"
+        )
+
+    def ledgers_drained() -> bool:
+        for shard in env.shards:
+            for node in shard.owned_nodes():
+                shard._twopc_overlay(
+                    node["metadata"]["name"], const.RESOURCE_MEM
+                )
+        return all(
+            s._ledger.gang_snapshot() == {} for s in env.shards
+        )
+
+    assert wait_until(ledgers_drained), {
+        s.shard_id: s._ledger.gang_snapshot() for s in env.shards
+    }
+
+
+# --- hash ring --------------------------------------------------------------
+
+
+def test_ring_ownership_deterministic_and_total():
+    ring = HashRing(["a", "b", "c"])
+    names = [f"n{i}" for i in range(300)]
+    part = ring.partition(names)
+    assert sorted(sum(part.values(), [])) == sorted(names)
+    ring2 = HashRing(["a", "b", "c"])
+    assert all(ring.owner(n) == ring2.owner(n) for n in names)
+
+
+def test_ring_balance_and_minimal_remap():
+    ring = HashRing([f"s{i}" for i in range(8)])
+    names = [f"node-{i:04d}" for i in range(1000)]
+    counts = [len(v) for v in ring.partition(names).values()]
+    assert max(counts) <= 2.0 * (1000 / 8), counts
+    bigger = HashRing([f"s{i}" for i in range(9)])
+    moved = sum(1 for n in names if ring.owner(n) != bigger.owner(n))
+    # ideal is 1/9 ≈ 111; consistent hashing should stay well under a
+    # naive mod-N reshuffle (~8/9 of all nodes)
+    assert moved < 300, moved
+
+
+def test_ring_doc_counts_every_node():
+    ring = HashRing(["s0", "s1"])
+    doc = ring.doc([f"n{i}" for i in range(40)])
+    assert sum(doc["nodes_per_shard"].values()) == 40
+    assert doc["shards"] == 2
+
+
+# --- router verbs -----------------------------------------------------------
+
+
+def test_sharded_batch_matches_unsharded(tmp_path):
+    nodes = S.make_cluster(8, seed=3)
+    with sharded_env(tmp_path, n_shards=3, nodes=nodes, wal=False) as env:
+        solo = ExtenderCore(env.client, informer=env.informer)
+        pod = share_pod("parity-pod", 8)
+        args = {"pod": pod, "nodes": {"items": nodes}}
+        merged = env.router.batch(args)
+        single = solo.batch(args)
+        assert set(merged["nodenames"]) == set(single["nodenames"])
+        assert merged["failedNodes"] == single["failedNodes"]
+        m_scores = {e["host"]: e["score"] for e in merged["hostPriorityList"]}
+        s_scores = {e["host"]: e["score"] for e in single["hostPriorityList"]}
+        for host in s_scores:
+            assert m_scores[host] == s_scores[host]
+        assert merged["degraded_shards"] == []
+
+
+def test_degraded_shard_not_consulted_and_recorded(tmp_path):
+    nodes = S.make_cluster(9, seed=4)
+    with sharded_env(tmp_path, n_shards=3, nodes=nodes, wal=False) as env:
+        victim = env.shards[1]
+        victim.partitioned = True
+        owned = {n["metadata"]["name"] for n in victim.owned_nodes()}
+        assert owned, "test needs the victim to own at least one node"
+        pod = share_pod("degraded-pod", 4)
+        result = env.router.batch({"pod": pod, "nodes": {"items": nodes}})
+        assert result["degraded_shards"] == ["shard-1"]
+        consulted = set(result["nodenames"]) | set(result["failedNodes"])
+        assert not owned & consulted, (
+            "a partitioned shard's nodes must be NOT CONSULTED — neither "
+            "fitting nor rejected"
+        )
+        records = DECISIONS.records(pod="default/degraded-pod", verb="batch")
+        router_recs = [r for r in records if r.shard == "router"]
+        assert router_recs and router_recs[-1].degraded_shards == ("shard-1",)
+        # shard-tagged records exist for the consulted shards
+        shard_tags = {r.shard for r in records} - {"router"}
+        assert shard_tags and "shard-1" not in shard_tags
+
+
+def test_admit_places_and_audits_clean(tmp_path):
+    with sharded_env(tmp_path, n_shards=2, n_nodes=6) as env:
+        for i in range(12):
+            pod = share_pod(f"admit-{i}", 4)
+            env.api.add_pod(pod)
+            result = env.router.admit(pod)
+            assert result["error"] == "", result
+            assert result["shard"] in {"shard-0", "shard-1"}
+        assert wait_until(
+            lambda: len([
+                p for p in env.client.list_pods()
+                if P.annotations(p).get(const.ENV_MEM_IDX)
+            ]) == 12
+        )
+        assert S.audit_cluster(env.nodes, env.client.list_pods()) == []
+
+
+def test_admit_falls_back_past_pruned_fanout(tmp_path):
+    """A pod only one shard can host must admit even when STALE summary
+    caches rank other shards first: the full fan-out fallback is the
+    correctness half of the pruning bargain."""
+    shard_ids = ["shard-0", "shard-1", "shard-2"]
+    nodes = nodes_one_per_shard(shard_ids, shape="2x1", chips=2)
+    # shard-0's node is the only one with a big chip
+    big = nodes[0]["metadata"]["name"]
+    for n in nodes:
+        cap = 64 if n["metadata"]["name"] == big else 8
+        n["status"]["capacity"][const.RESOURCE_MEM] = str(cap * 2)
+        n["status"]["allocatable"][const.RESOURCE_MEM] = str(cap * 2)
+    with sharded_env(tmp_path, n_shards=3, nodes=nodes, fanout=1) as env:
+        # poison the routing caches: stale summaries claim the OTHER
+        # shards hold huge free chips, so fanout=1 consults a shard with
+        # nothing feasible first
+        now = time.monotonic()
+        for shard in env.shards:
+            fake = 0 if shard.shard_id == "shard-0" else 9999
+            shard._summary_cache = (now + 60.0, {
+                "shard": shard.shard_id, "nodes": 1,
+                "free_units": fake, "max_free_chip": fake,
+            })
+        pod = share_pod("fallback-pod", 48)
+        env.api.add_pod(pod)
+        result = env.router.admit(pod)
+        assert result["error"] == ""
+        assert result["node"] == big
+        # the pruned first attempt cannot have answered: more than one
+        # shard was consulted on the way to the fallback
+        assert result["consulted"] >= 2, result
+
+
+def test_bind_routes_to_owner_shard(tmp_path):
+    with sharded_env(tmp_path, n_shards=3, n_nodes=6) as env:
+        pod = share_pod("routed-bind", 4)
+        env.api.add_pod(pod)
+        node = env.nodes[0]["metadata"]["name"]
+        owner = env.router.ring.owner(node)
+        result = env.router.bind({
+            "podNamespace": "default", "podName": "routed-bind",
+            "node": node,
+        })
+        assert result["error"] == ""
+        records = DECISIONS.records(pod="default/routed-bind", verb="bind")
+        assert records and records[-1].shard == owner
+
+
+# --- per-shard WAL ----------------------------------------------------------
+
+
+def test_per_shard_wal_isolated_and_seq_advances(tmp_path):
+    with sharded_env(tmp_path, n_shards=2, n_nodes=4) as env:
+        for i in range(6):
+            pod = share_pod(f"walpod-{i}", 4)
+            env.api.add_pod(pod)
+            assert env.router.admit(pod)["error"] == ""
+        seqs = [ck.last_seq for ck in env.ckpts]
+        assert sum(seqs) >= 6, seqs
+        # both shards journaled their own binds (the ring spreads 4
+        # nodes over 2 shards; each bind lands in its owner's WAL only)
+        docs = env.router.shards_doc()["shards"]
+        assert [d["wal_seq"] for d in docs] == seqs
+
+
+def test_warmup_skips_gang2pc_entries(tmp_path):
+    ck = AllocationCheckpoint(str(tmp_path / "w.wal"))
+    ck.begin((GANG2PC_NS, "g1/default/p1"), {
+        "kind": "gang2pc", "phase": "prepare", "group": "g1",
+        "node": "n1", "chips": [0, 1], "units": 8, "epoch": 1,
+        "pod_ns": "default", "pod_name": "p1", "shape": "2x1",
+    })
+    ck.abandon()
+    api = FakeApiServer(chaos=False)
+    api.start()
+    try:
+        client = ApiServerClient(api.url)
+        ck2 = AllocationCheckpoint(str(tmp_path / "w.wal"))
+        core = ExtenderCore(client, checkpoint=ck2)
+        # the bind warmup neither replayed it as phantom capacity nor
+        # aborted it as malformed: it stays pending for the reconciler
+        assert (GANG2PC_NS, "g1/default/p1") in ck2.pending()
+        assert core._inflight == {}
+    finally:
+        api.stop()
+
+
+# --- cross-shard gang groups (two-phase reserve) ----------------------------
+
+
+def cross_shard_group_env(tmp_path, n_members: int = 2):
+    """Environment where an ``n_members`` gang group MUST span shards:
+    one 2-chip node per shard, each member's "2x1" slice consumes a
+    whole node."""
+    shard_ids = [f"shard-{i}" for i in range(3)]
+    nodes = nodes_one_per_shard(shard_ids, shape="2x1", chips=2)
+    return sharded_env(tmp_path, n_shards=3, nodes=nodes, fanout=3)
+
+
+def make_group(env, group: str, n_members: int = 2, per_chip: int = 32):
+    """A gang group whose members each request per_chip units on every
+    chip of a "2x1" slice. The default 32 fills a synth node's chips
+    COMPLETELY, so each member consumes a whole node and an n-member
+    group provably spans n nodes (and, with one node per shard, n
+    shards)."""
+    pods = [
+        group_pod(f"{group}-m{m}", group, per_chip * 2, "2x1")
+        for m in range(n_members)
+    ]
+    for pod in pods:
+        env.api.add_pod(pod)
+    return pods
+
+
+def test_gang_group_commits_across_shards(tmp_path):
+    with cross_shard_group_env(tmp_path) as env:
+        pods = make_group(env, "xg1", n_members=2)
+        result = env.router.admit_gang_group(pods)
+        assert result["error"] == "", result
+        assert result["pending_rollforward"] == []
+        states = group_states(env.client, "xg1")
+        assert states and all(states), states
+        # the two members landed on DIFFERENT nodes (whole-node slices)
+        placed = {
+            P.node_name(p) or p.get("spec", {}).get("nodeName", "")
+            for p in env.client.list_pods()
+            if P.gang_group(p) == "xg1"
+        }
+        assert len(placed) == 2, placed
+        assert S.audit_cluster(env.nodes, env.client.list_pods()) == []
+        # overlay visibility release: once the informer shows the
+        # annotated members, the 2PC reservations drain
+        assert_2pc_drained(env)
+
+
+def test_gang_group_aborts_whole_when_one_member_cannot_fit(tmp_path):
+    with cross_shard_group_env(tmp_path) as env:
+        # four members, only three single-node slots in the cluster
+        pods = make_group(env, "xg-toobig", n_members=4)
+        result = env.router.admit_gang_group(pods)
+        assert result["error"] != ""
+        assert not any(group_states(env.client, "xg-toobig"))
+        assert_2pc_drained(env)
+
+
+def test_shard_partitioned_during_prepare_aborts_cleanly(tmp_path):
+    """The partition begins AFTER the router planned (a plan-time
+    partition is just routed around): the victim's prepare raises, the
+    coordinator presumed-aborts the prepared prefix, and nothing — no
+    annotation, no reservation, no journal entry — survives. Healing
+    the partition lets the same group admit whole."""
+    with cross_shard_group_env(tmp_path) as env:
+        pods = make_group(env, "xg-part", n_members=2)
+        plan, err = env.router._plan_group(pods)
+        assert err == ""
+        victim_id = plan[1]["shard"]
+        victim = env.router.shard(victim_id)
+        orig_prepare = victim.prepare_gang
+
+        def partitioned_prepare(*a, **kw):
+            raise ShardUnavailable(f"{victim_id} partitioned mid-prepare")
+
+        victim.prepare_gang = partitioned_prepare
+        try:
+            result = env.router.admit_gang_group(pods)
+        finally:
+            victim.prepare_gang = orig_prepare
+        assert "unreachable" in result["error"], result
+        assert not any(group_states(env.client, "xg-part"))
+        for shard in env.shards:
+            assert shard.twopc_pending() == []
+            assert shard._ledger.gang_snapshot() == {}
+        # heal and retry: the group admits whole
+        result = env.router.admit_gang_group(pods)
+        assert result["error"] == "", result
+        assert all(group_states(env.client, "xg-part"))
+        assert S.audit_cluster(env.nodes, env.client.list_pods()) == []
+
+
+GANG2PC_SITES = [
+    "gang2pc.prepare",   # after the member's prepare record is durable
+    "gang2pc.reserve",   # after the ledger booking + side-state store
+    "gang2pc.decide",    # after the coordinator's commit decision is durable
+    "gang2pc.patch",     # after a member's annotations + Binding persisted
+    "gang2pc.commit",    # after a member's journal entry resolved
+    "gang2pc.done",      # after all members, before the decision resolves
+]
+
+
+@pytest.mark.parametrize("site", GANG2PC_SITES)
+def test_kill_at_every_2pc_step(tmp_path, site):
+    """SIGKILL (simulated) at every gang2pc journal step: after restart
+    + one reconciler pass there is no partial gang, no orphaned
+    reservation, and no pending gang2pc entry — commit decisions roll
+    FORWARD, undecided prepares roll BACK."""
+    with cross_shard_group_env(tmp_path) as env:
+        pods = make_group(env, "xg-kill", n_members=2)
+        with FAULTS.injected(site, "crash", times=1):
+            with pytest.raises(SimulatedCrash):
+                env.router.admit_gang_group(pods)
+        restart_shards(env)
+        resolve_gang2pc(env.shards, env.client, lease=env.lease)
+        states = group_states(env.client, "xg-kill")
+        assert all(states) or not any(states), (
+            f"partial gang after crash at {site}: {states}"
+        )
+        decided = site in (
+            "gang2pc.decide", "gang2pc.patch", "gang2pc.commit",
+            "gang2pc.done",
+        )
+        if decided:
+            # the commit decision was durable before the crash: the
+            # whole group must roll FORWARD
+            assert states and all(states), (
+                f"durable decision did not roll forward at {site}"
+            )
+        else:
+            assert not any(states), (
+                f"undecided prepare rolled forward at {site}"
+            )
+        assert_2pc_drained(env)
+        assert S.audit_cluster(env.nodes, env.client.list_pods()) == []
+
+
+def test_leader_fenced_mid_commit(tmp_path):
+    """The old leader journals its commit decision, commits member 0,
+    then loses its lease. Its remaining commit is rejected by epoch
+    fencing; the NEW leader's reconciler pass completes the group —
+    fencing stops the stale driver, never the decided transaction."""
+    with cross_shard_group_env(tmp_path) as env:
+        pods = make_group(env, "xg-fence", n_members=2)
+        plan, err = env.router._plan_group(pods)
+        assert err == ""
+        group = "xg-fence"
+        coordinator_id = env.router.ring.owner(f"gang-group:{group}")
+        old_epoch = env.lease.acquire(group, coordinator_id)
+        for member in plan:
+            shard = env.router.shard(member["shard"])
+            ok, reason = shard.prepare_gang(
+                group, member["ns"], member["name"], member["node"],
+                member["chips"], member["units"], member["shape"],
+                old_epoch, coordinator_id,
+            )
+            assert ok, reason
+        coordinator = env.router.shard(coordinator_id)
+        decision_key = (GANG2PC_NS, f"{group}/decision")
+        coordinator._journal_2pc(decision_key, {
+            "phase": "decision", "outcome": "commit", "group": group,
+            "epoch": old_epoch,
+            "members": [
+                {"ns": m["ns"], "name": m["name"], "node": m["node"],
+                 "shard": m["shard"], "chips": list(m["chips"]),
+                 "units": m["units"], "shape": m["shape"],
+                 "request": m["request"]}
+                for m in plan
+            ],
+        })
+        # old leader commits member 0, then is fenced
+        first = plan[0]
+        ok, reason = env.router.shard(first["shard"]).commit_gang(
+            group, first["ns"], first["name"], old_epoch,
+            total_request=first["request"],
+        )
+        assert ok, reason
+        # the new leader takes over and re-drives (its pass stamps the
+        # higher epoch on every participant)
+        resolve_gang2pc(env.shards, env.client, lease=env.lease)
+        # the fenced old leader keeps trying: rejected, not honored
+        second = plan[1]
+        with pytest.raises(StaleCoordinator):
+            env.router.shard(second["shard"]).commit_gang(
+                group, second["ns"], second["name"], old_epoch,
+                total_request=second["request"],
+            )
+        states = group_states(env.client, group)
+        assert states and all(states), states
+        assert_2pc_drained(env)
+        assert S.audit_cluster(env.nodes, env.client.list_pods()) == []
+
+
+def test_member_pod_deleted_mid_protocol_rolls_back_member(tmp_path):
+    """A member whose pod vanished between prepare and commit resolves
+    as rolled back (nothing to persist to); surviving members of a
+    decided group still roll forward."""
+    with cross_shard_group_env(tmp_path) as env:
+        pods = make_group(env, "xg-gone", n_members=2)
+        with FAULTS.injected("gang2pc.decide", "crash", times=1):
+            with pytest.raises(SimulatedCrash):
+                env.router.admit_gang_group(pods)
+        # the second member's pod is deleted while everything is down
+        env.api.delete_pod("default", "xg-gone-m1")
+        restart_shards(env)
+        counts = resolve_gang2pc(env.shards, env.client, lease=env.lease)
+        assert counts["member_gone"] == 1
+        assert counts["rolled_forward"] == 1
+        assert_2pc_drained(env)
+        assert S.audit_cluster(env.nodes, env.client.list_pods()) == []
+
+
+# --- storm ------------------------------------------------------------------
+
+
+def test_concurrent_churn_storm_with_gangs(tmp_path):
+    """Concurrent single-pod churn + gang bursts through the router:
+    zero overcommit, zero partial gangs, journal + ledger drained, lock
+    ranking clean (the witness is on under make chaos-shard)."""
+    from gpushare_device_plugin_tpu.utils import lockrank
+
+    nodes = S.make_cluster(10, seed=9)
+    with sharded_env(tmp_path, n_shards=3, nodes=nodes) as env:
+        driver = S.ChurnDriver(
+            create_pod_fn=env.api.add_pod,
+            delete_pod_fn=env.api.delete_pod,
+            admit_fn=env.router.admit,
+            admit_gang_fn=env.router.admit_gang_group,
+            seed=11, gang_every=9, workers=6,
+        )
+        stats = driver.run(150)
+        assert stats.admitted > 0
+        assert stats.gang_groups > 0
+        # the audit reads the apiserver directly — every PATCH/Binding
+        # was synchronous, so the state is current the moment run() ends
+        assert S.audit_cluster(env.nodes, env.client.list_pods()) == []
+        resolve_gang2pc(env.shards, env.client, lease=env.lease)
+        assert_2pc_drained(env)
+    violations = lockrank.violations()
+    assert not violations, violations[0].describe() if violations else ""
+
+
+# --- shard map / introspection ---------------------------------------------
+
+
+def test_shards_doc_shape_and_inflight_gang(tmp_path):
+    with cross_shard_group_env(tmp_path) as env:
+        pods = make_group(env, "xg-doc", n_members=2)
+        plan, err = env.router._plan_group(pods)
+        assert err == ""
+        epoch = env.lease.acquire("xg-doc", "shard-0")
+        member = plan[0]
+        shard = env.router.shard(member["shard"])
+        ok, reason = shard.prepare_gang(
+            "xg-doc", member["ns"], member["name"], member["node"],
+            member["chips"], member["units"], member["shape"],
+            epoch, "shard-0",
+        )
+        assert ok, reason
+        doc = env.router.shards_doc()
+        assert doc["ring"]["shards"] == 3
+        assert sum(doc["ring"]["nodes_per_shard"].values()) == len(env.nodes)
+        rows = {r["shard"]: r for r in doc["shards"]}
+        assert rows[member["shard"]]["gangs_inflight"] == 1
+        assert all("wal_seq" in r and "wal_pending" in r for r in rows.values())
+        gangs = [g for g in doc["gangs_2pc"] if g["group"] == "xg-doc"]
+        assert gangs and gangs[0]["phase"] == "prepare"
+        # clean up the deliberate half-open 2PC
+        shard.abort_gang("xg-doc", member["ns"], member["name"], epoch)
+        assert_2pc_drained(env)
+
+
+def test_router_behind_webhook_http_server(tmp_path):
+    """The router speaks the same four verbs as ExtenderCore, so the
+    sharded deployment serves the unchanged webhook protocol through
+    ExtenderHTTPServer (the `tpushare-sharded-extender` entrypoint)."""
+    import json as _json
+    import urllib.request
+
+    from gpushare_device_plugin_tpu.extender.server import (
+        ExtenderHTTPServer,
+    )
+
+    with sharded_env(tmp_path, n_shards=2, n_nodes=4, wal=False) as env:
+        server = ExtenderHTTPServer(env.router, host="127.0.0.1", port=0)
+        server.start()
+        try:
+            pod = share_pod("http-pod", 4)
+            env.api.add_pod(pod)
+            body = _json.dumps({
+                "pod": pod, "nodes": {"items": env.nodes},
+            }).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/scheduler/batch",
+                data=body, headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                result = _json.loads(resp.read())
+            assert result["nodenames"], result
+            assert result["degraded_shards"] == []
+            for entry in result["hostPriorityList"]:
+                assert 0 <= entry["score"] <= 10
+            bind_body = _json.dumps({
+                "podNamespace": "default", "podName": "http-pod",
+                "node": result["nodenames"][0],
+            }).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/scheduler/bind",
+                data=bind_body,
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                assert _json.loads(resp.read())["error"] == ""
+            assert S.audit_cluster(env.nodes, env.client.list_pods()) == []
+        finally:
+            server.stop()
+
+
+# --- review-hardening regressions -------------------------------------------
+
+
+def test_non_share_pod_passes_all_nodes_through_router(tmp_path):
+    """A pod with no share resource must come back all-pass with score 0
+    from the router, like the single extender — a scoreless merge would
+    rank it unschedulable."""
+    nodes = S.make_cluster(5, seed=6)
+    with sharded_env(tmp_path, n_shards=2, nodes=nodes, wal=False) as env:
+        pod = make_pod("plain-pod", 0, node="")
+        result = env.router.batch({"pod": pod, "nodes": {"items": nodes}})
+        names = {n["metadata"]["name"] for n in nodes}
+        assert set(result["nodenames"]) == names
+        assert result["failedNodes"] == {}
+        assert {e["host"] for e in result["hostPriorityList"]} == names
+        assert all(e["score"] == 0 for e in result["hostPriorityList"])
+        admit = env.router.admit(pod)
+        assert "no share resource" in admit["error"]
+
+
+def test_reprepare_of_claimed_member_does_not_clobber_journal(tmp_path):
+    """A retrying coordinator racing a live (or crashed-but-journaled)
+    prepare must fail the claim WITHOUT writing: journaling first would
+    overwrite the pending entry and the failure abort would pop it,
+    orphaning the reservation journal-less."""
+    with cross_shard_group_env(tmp_path) as env:
+        pods = make_group(env, "xg-re", n_members=2)
+        plan, err = env.router._plan_group(pods)
+        assert err == ""
+        member = plan[0]
+        shard = env.router.shard(member["shard"])
+        epoch = env.lease.acquire("xg-re", "shard-0")
+        ok, reason = shard.prepare_gang(
+            "xg-re", member["ns"], member["name"], member["node"],
+            member["chips"], member["units"], member["shape"],
+            epoch, "shard-0",
+        )
+        assert ok, reason
+        key = ShardExtender.twopc_key("xg-re", member["ns"], member["name"])
+        before = {
+            tuple(e.get("key") or ()): e.get("_seq")
+            for e in shard.twopc_pending()
+        }
+        assert key in before
+        ok2, reason2 = shard.prepare_gang(
+            "xg-re", member["ns"], member["name"], member["node"],
+            member["chips"], member["units"], member["shape"],
+            env.lease.acquire("xg-re", "shard-0"), "shard-0",
+        )
+        assert not ok2 and "already mid-2PC" in reason2
+        after = {
+            tuple(e.get("key") or ()): e.get("_seq")
+            for e in shard.twopc_pending()
+        }
+        # the live attempt's entry survives, same seq, reservation intact
+        assert after == before
+        assert key[1] in {
+            k[1] for k in shard._ledger.gang_snapshot()
+        } or shard._ledger.gang_snapshot()
+        shard.abort_gang("xg-re", member["ns"], member["name"],
+                         env.lease.acquire("xg-re", "shard-0"))
+        assert_2pc_drained(env)
+
+
+def test_epoch_table_pruned_after_group_finishes(tmp_path):
+    """Fencing epochs exist to protect an in-flight protocol; a finished
+    group's epoch must not accumulate forever (the storm mints a fresh
+    group id per burst)."""
+    with cross_shard_group_env(tmp_path) as env:
+        pods = make_group(env, "xg-prune", n_members=2)
+        result = env.router.admit_gang_group(pods)
+        assert result["error"] == "", result
+        assert_2pc_drained(env)  # drives the visibility release
+        for shard in env.shards:
+            with shard._twopc_lock:
+                assert "xg-prune" not in shard._epochs
+
+
+def test_shard_unreachable_mid_commit_defers_to_reconciler(tmp_path):
+    """Once the commit decision is durable, a member shard dropping out
+    mid-commit must land in pending_rollforward (not raise), and the
+    reconciler completes the group."""
+    with cross_shard_group_env(tmp_path) as env:
+        pods = make_group(env, "xg-mid", n_members=2)
+        plan, err = env.router._plan_group(pods)
+        assert err == ""
+        victim = env.router.shard(plan[1]["shard"])
+        orig = victim.commit_gang
+
+        def dying_commit(*a, **kw):
+            victim.commit_gang = orig  # fail exactly once
+            raise ShardUnavailable("partitioned mid-commit")
+
+        victim.commit_gang = dying_commit
+        result = env.router.admit_gang_group(pods)
+        assert result["error"] == "", result
+        assert result["pending_rollforward"], result
+        states = group_states(env.client, "xg-mid")
+        assert any(states) and not all(states)  # the documented transient
+        resolve_gang2pc(env.shards, env.client, lease=env.lease)
+        assert all(group_states(env.client, "xg-mid"))
+        assert_2pc_drained(env)
+        assert S.audit_cluster(env.nodes, env.client.list_pods()) == []
+
+
+def test_fenced_during_prepare_cleans_up_prefix(tmp_path):
+    """A coordinator fenced between two prepares presumed-aborts what it
+    already booked: abort accepts an epoch at or above each ENTRY's own
+    epoch, so the fenced driver leaves no orphaned reservation."""
+    with cross_shard_group_env(tmp_path) as env:
+        pods = make_group(env, "xg-fp", n_members=2)
+        plan, err = env.router._plan_group(pods)
+        assert err == ""
+        # a newer coordinator has already touched the SECOND member's
+        # shard with a higher epoch
+        env.router.shard(plan[1]["shard"])._note_epoch("xg-fp", 99)
+        result = env.router.admit_gang_group(pods)
+        assert "fenced during prepare" in result["error"], result
+        assert not any(group_states(env.client, "xg-fp"))
+        assert_2pc_drained(env)
+
+
+def test_router_filter_matches_core_and_skips_scoring(tmp_path):
+    nodes = S.make_cluster(6, seed=8)
+    with sharded_env(tmp_path, n_shards=2, nodes=nodes, wal=False) as env:
+        solo = ExtenderCore(env.client, informer=env.informer)
+        for pod in (share_pod("f-share", 8), make_pod("f-plain", 0, node="")):
+            args = {"pod": pod, "nodes": {"items": nodes}}
+            merged = env.router.filter(args)
+            single = solo.filter(args)
+            assert set(merged["nodenames"]) == set(single["nodenames"])
+            assert merged["failedNodes"] == single["failedNodes"]
+            assert merged["degraded_shards"] == []
+
+
+def test_gang_plan_scores_with_shard_policy(tmp_path):
+    """--placement-policy applies to gang-group planning too, not just
+    single-pod verbs."""
+    from gpushare_device_plugin_tpu.extender import logic
+    from gpushare_device_plugin_tpu.extender.policy import get_policy
+
+    shard_ids = [f"shard-{i}" for i in range(3)]
+    nodes = nodes_one_per_shard(shard_ids, shape="2x1", chips=2)
+    seen: list[str] = []
+    orig = logic.gang_candidate
+
+    def spy(view, shape, request, policy="best-fit"):
+        seen.append(getattr(policy, "name", str(policy)))
+        return orig(view, shape, request, policy)
+
+    with sharded_env(tmp_path, n_shards=3, nodes=nodes, fanout=3) as env:
+        for shard in env.shards:
+            shard.policy = get_policy("multi-objective")
+        pods = make_group(env, "xg-pol", n_members=2)
+        logic.gang_candidate = spy
+        try:
+            plan, err = env.router._plan_group(pods)
+        finally:
+            logic.gang_candidate = orig
+        assert err == ""
+        assert seen and set(seen) == {"multi-objective"}, set(seen)

@@ -42,6 +42,38 @@ _ATTR_TO_CLASS: dict[str, str] = {
     attr: cls for cls, (_mod, attrs) in PROTECTED.items() for attr in attrs
 }
 
+# Sharded-extender discipline (ISSUE 14, the PR 6 double-booking class
+# one layer up): shard code books cross-shard gang reservations in an
+# AssumeCache, and it may ONLY do so through the 2PC reserve API below.
+# The single-chip reservation families (reserve_mem/reserve_core), the
+# reconciler-only surface (release_if_unclaimed, snapshot), the
+# transaction scope, and the list-mode serial lock are all off limits —
+# a shard reaching for them bypasses the all-or-nothing gang entry that
+# makes a partial cross-shard booking structurally impossible.
+TWOPC_MODULE_SUFFIX = "shards.py"
+TWOPC_ALLOWED = frozenset({
+    "claim", "renew", "is_claimed", "release", "reserve_gang",
+    "gang_snapshot", "expire_stale",
+})
+TWOPC_FORBIDDEN = frozenset({
+    "reserve_mem", "reserve_core", "snapshot", "release_if_unclaimed",
+    "transaction", "overlaid_state", "serial_lock",
+})
+_LEDGER_RECEIVER_HINTS = ("ledger", "assume")
+
+
+def _ledger_receiver(node: ast.expr) -> bool:
+    """Curated receiver-name hints, rules_locks style: `self._ledger`,
+    `shard._ledger`, `assume`, ..."""
+    if isinstance(node, ast.Name):
+        name = node.id
+    elif isinstance(node, ast.Attribute):
+        name = node.attr
+    else:
+        return False
+    name = name.lstrip("_").lower()
+    return any(h in name for h in _LEDGER_RECEIVER_HINTS)
+
 
 def check_encapsulation(modules: list[Module]) -> list[Finding]:
     findings: list[Finding] = []
@@ -52,8 +84,25 @@ def check_encapsulation(modules: list[Module]) -> list[Finding]:
             cls for cls, (suffix, _a) in PROTECTED.items()
             if mod.path.endswith(suffix)
         }
+        shard_module = mod.path.endswith(TWOPC_MODULE_SUFFIX)
         for node in ast.walk(mod.tree):
             if not isinstance(node, ast.Attribute):
+                continue
+            if (
+                shard_module
+                and node.attr in TWOPC_FORBIDDEN
+                and _ledger_receiver(node.value)
+            ):
+                findings.append(
+                    Finding(
+                        mod.path, node.lineno, "ledger-encapsulation",
+                        f"shard code calls AssumeCache.{node.attr} — the "
+                        "sharded extender may touch the ledger only "
+                        "through the 2PC reserve API "
+                        f"({'/'.join(sorted(TWOPC_ALLOWED))}); anything "
+                        "else can book a partial cross-shard gang",
+                    )
+                )
                 continue
             cls = _ATTR_TO_CLASS.get(node.attr)
             if cls is None or cls in exempt:

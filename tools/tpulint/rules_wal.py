@@ -39,6 +39,15 @@ from .engine import Finding, Module
 CKPT_RECEIVERS = ("_ckpt", "ckpt", "checkpoint", "_checkpoint")
 BEGIN_HELPERS = ("_journal_begin", "_journal_phase")
 RESOLVE_HELPERS = ("_journal_resolve",)
+# Cross-shard two-phase "gang2pc" records (extender/shards.py) have a
+# DIFFERENT obligation than ordinary begins: a prepare legitimately
+# leaves the journal entry pending across the process boundary (the
+# coordinator's decision or the reconciler resolves it later), so the
+# same-function domination rule does not apply. What IS checkable: the
+# helper returns the begin's seq, and the seq is the ONLY handle a later
+# commit/abort can seq-guard with — a call whose result is discarded
+# creates an entry nobody can ever safely resolve.
+TWOPC_HELPERS = ("_journal_2pc",)
 RESOLVE_METHODS = ("commit", "abort")
 PERSIST_CALLS = (
     "patch_pod", "bind_pod", "persist_pod_assignment", "_persist",
@@ -55,6 +64,15 @@ def _is_ckpt_call(node: ast.Call, methods: tuple[str, ...]) -> bool:
         elif isinstance(recv, ast.Attribute):
             name = recv.attr
         return name in CKPT_RECEIVERS
+    return False
+
+
+def _is_twopc_call(call: ast.Call) -> bool:
+    fn = call.func
+    if isinstance(fn, ast.Name):
+        return fn.id in TWOPC_HELPERS
+    if isinstance(fn, ast.Attribute):
+        return fn.attr in TWOPC_HELPERS
     return False
 
 
@@ -306,8 +324,24 @@ def check_wal_protocol(modules: list[Module]) -> list[Finding]:
         for node in ast.walk(mod.tree):
             if not isinstance(node, ast.FunctionDef):
                 continue
-            if node.name in BEGIN_HELPERS + RESOLVE_HELPERS:
+            if node.name in BEGIN_HELPERS + RESOLVE_HELPERS + TWOPC_HELPERS:
                 continue  # the thin delegation helpers themselves
+            # gang2pc begins: flag DISCARDED results (an Expr statement
+            # whose value is a bare _journal_2pc call) — the returned
+            # seq is the resolution handle and must be kept
+            for stmt in ast.walk(node):
+                if (
+                    isinstance(stmt, ast.Expr)
+                    and isinstance(stmt.value, ast.Call)
+                    and _is_twopc_call(stmt.value)
+                ):
+                    findings.append(Finding(
+                        mod.path, stmt.lineno, "wal-protocol",
+                        "gang2pc journal begin's (key, seq) result is "
+                        "discarded — without the seq no commit/abort can "
+                        "ever seq-guard-resolve this entry; assign or "
+                        "return it",
+                    ))
             begin_stmts = [s for s in ast.walk(node)
                            if isinstance(s, ast.stmt) and _is_begin(s)
                            and not any(_is_begin(c) for c in _sub_stmts(s))]
