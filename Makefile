@@ -1,7 +1,7 @@
 PY ?= python
 PROTOC ?= protoc
 
-.PHONY: proto native test test-fast test-slow test-stress chaos chaos-restart chaos-move chaos-shard lint lint-strict typecheck bench bench-smoke bench-serve-smoke bench-multichip-smoke bench-paged-smoke bench-defrag-smoke bench-interference-smoke bench-scale bench-scale-smoke bench-wal bench-trace bench-decisions trace-smoke decisions-smoke e2e-kind
+.PHONY: proto native test test-fast test-slow test-stress chaos chaos-restart chaos-move chaos-shard mc mc-smoke lint lint-strict typecheck bench bench-smoke bench-serve-smoke bench-multichip-smoke bench-paged-smoke bench-defrag-smoke bench-interference-smoke bench-scale bench-scale-smoke bench-wal bench-trace bench-decisions trace-smoke decisions-smoke e2e-kind
 
 # Regenerate protobuf message classes (gRPC bindings are hand-written in
 # gpushare_device_plugin_tpu/plugin/api/api_grpc.py; grpc_tools is not
@@ -81,6 +81,24 @@ chaos-move:
 # witness on.
 chaos-shard:
 	TPUSHARE_LOCK_WITNESS=1 $(PY) -m pytest tests/test_shards.py -x -q
+
+# Model checker, full bounded exploration (nightly-sized): every
+# schedule of the journaled-protocol small models up to the per-model
+# preemption bound (docs/analysis.md) — the drain handshake exhaustively,
+# gang-2PC at k=2, the move protocol at k=3 (with and without a
+# concurrent reconciler). Where chaos kills at every journal step on ONE
+# OS-chosen interleaving, tpumc enumerates the interleavings themselves;
+# a violation prints a schedule id that `python -m tools.tpumc replay
+# <id>` re-executes deterministically under the tracer+flight recorder.
+mc:
+	$(PY) -m tools.tpumc run --suite full
+
+# Seconds-sized exploration: the same three protocol harnesses at smoke
+# bounds (>1,000 schedules combined, zero violations required). Tier-1
+# runs it in-process via tests/test_mc_smoke.py; this target runs it
+# alone.
+mc-smoke:
+	$(PY) -m tools.tpumc run --suite smoke
 
 # Sharded-extender scale bench, full size: admission throughput + p99
 # over the 32/256/1000-node x 1/8-shard matrix plus the 1k-node

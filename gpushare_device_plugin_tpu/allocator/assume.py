@@ -44,17 +44,19 @@ from typing import Callable, Iterator, Sequence
 
 from ..utils.metrics import REGISTRY, timed_acquire
 from ..utils.lockrank import make_rlock
+from ..utils.metric_catalog import (
+    ALLOCATOR_LOCK_WAIT_SECONDS as LOCK_WAIT_METRIC,
+    ASSUME_EXPIRED_TOTAL as EXPIRED_METRIC,
+)
 
 PodKey = tuple[str, str]  # (namespace, name)
 
-LOCK_WAIT_METRIC = "tpushare_allocator_lock_wait_seconds"
 LOCK_WAIT_HELP = (
     "Time Allocate workers spend waiting for allocator locks "
     "(match stripes and the reservation ledger); mass above ~1ms means "
     "I/O crept back under a lock"
 )
 
-EXPIRED_METRIC = "tpushare_assume_expired_total"
 EXPIRED_HELP = (
     "Claims/reservations released by TTL expiry — an owner (a hung PATCH, "
     "a crashed worker) held them past the deadline; capacity was unstranded"

@@ -84,27 +84,30 @@ from ..utils.metrics import REGISTRY
 from ..utils.tracing import TRACER
 from .assume import AssumeCache, PodKey
 from ..utils.lockrank import make_lock, make_rlock
+from ..utils.metric_catalog import CHECKPOINT_REPLAYED_TOTAL
+from ..utils.metric_catalog import (
+    CHECKPOINT_APPENDS_TOTAL as JOURNAL_APPENDS,
+    CHECKPOINT_ERRORS_TOTAL as JOURNAL_ERRORS,
+    CHECKPOINT_FENCED as FENCE_GAUGE,
+    CHECKPOINT_FSYNC_SECONDS as FSYNC_SECONDS,
+    CHECKPOINT_WAL_BATCH_RECORDS as BATCH_RECORDS,
+)
 
 log = get_logger("allocator.checkpoint")
 
-JOURNAL_APPENDS = "tpushare_checkpoint_appends_total"
 JOURNAL_APPENDS_HELP = "Checkpoint journal records appended, by op"
-JOURNAL_ERRORS = "tpushare_checkpoint_errors_total"
 JOURNAL_ERRORS_HELP = (
     "Checkpoint journal I/O failures (the daemon degrades to unjournaled "
     "operation rather than refusing admissions on a sick disk)"
 )
-FENCE_GAUGE = "tpushare_checkpoint_fenced"
 FENCE_GAUGE_HELP = (
     "1 when this daemon observed a newer generation on the node and "
     "refuses journal writes (a stale duplicate instance)"
 )
-FSYNC_SECONDS = "tpushare_checkpoint_fsync_seconds"
 FSYNC_SECONDS_HELP = (
     "WAL flush+fsync latency; the count is the fsync count — divide by "
     "admissions for fsyncs-per-admission (group commit drives it below 1)"
 )
-BATCH_RECORDS = "tpushare_checkpoint_wal_batch_records"
 BATCH_RECORDS_HELP = (
     "Journal records made durable per fsync (group-commit batch-size "
     "distribution; always-mode fsyncs observe 1)"
@@ -739,7 +742,7 @@ def replay_checkpoint(ckpt: AllocationCheckpoint, assume: AssumeCache) -> int:
         log.info("replayed in-flight %s reservation for %s/%s", kind, *key)
     if n:
         REGISTRY.counter_inc(
-            "tpushare_checkpoint_replayed_total",
+            CHECKPOINT_REPLAYED_TOTAL,
             "In-flight journal entries re-installed as ledger reservations "
             "at daemon (re)start",
             value=float(n),

@@ -63,6 +63,12 @@ from ..utils.metrics import REGISTRY
 from ..utils.tracing import TRACER
 from .assume import AssumeCache, PodKey
 from .checkpoint import AllocationCheckpoint, StaleDaemonError
+from ..utils.metric_catalog import (
+    DEFRAG_MOVES_TOTAL as MOVES_METRIC,
+    DEFRAG_MOVE_SECONDS as MOVE_SECONDS,
+    DEFRAG_STRANDED_PCT as STRANDED_PCT_GAUGE,
+    DEFRAG_STRANDED_UNITS as STRANDED_GAUGE,
+)
 
 log = get_logger("allocator.defrag")
 
@@ -77,16 +83,12 @@ MOVE_KIND = "move"
 # keeps counting the source until the switch PATCH lands).
 DEFRAG_NS = "tpushare-defrag"
 
-MOVES_METRIC = "tpushare_defrag_moves_total"
 MOVES_HELP = "Defragmentation moves by outcome (completed/aborted/failed)"
-MOVE_SECONDS = "tpushare_defrag_move_seconds"
 MOVE_SECONDS_HELP = "Wall time of one completed slice move, all phases"
-STRANDED_GAUGE = "tpushare_defrag_stranded_units"
 STRANDED_GAUGE_HELP = (
     "HBM units stranded on partially-used chips (free slivers smaller "
     "than the defrag quantum) at the last planner scan"
 )
-STRANDED_PCT_GAUGE = "tpushare_defrag_stranded_pct"
 STRANDED_PCT_GAUGE_HELP = "Stranded HBM as a percentage of node capacity"
 
 

@@ -31,6 +31,12 @@ import sys
 
 from .. import const
 from ..cluster.apiserver import ApiServerClient
+from ..utils.metric_catalog import (
+    BUILD_INFO,
+    PREFIX_ENGINE,
+    PREFIX_GOVERNOR,
+    PREFIX_SLO,
+)
 from ..utils.retry import retry
 from .display import (
     render_details,
@@ -101,7 +107,7 @@ def parse_engine_metrics(text: str) -> dict[str, dict[str, float]]:
     ``preemptions_total`` counter."""
     out: dict[str, dict[str, float]] = {}
     for line in text.splitlines():
-        if not line.startswith("tpushare_engine_") or line.startswith("#"):
+        if not line.startswith(PREFIX_ENGINE) or line.startswith("#"):
             continue
         try:
             metric, value = line.rsplit(None, 1)
@@ -113,7 +119,7 @@ def parse_engine_metrics(text: str) -> dict[str, dict[str, float]]:
         if "{" in metric:
             name, raw = metric.split("{", 1)
             pod = _parse_prom_labels(raw.rstrip("}")).get("pod", "")
-        short = name[len("tpushare_engine_"):]
+        short = name[len(PREFIX_ENGINE):]
         out.setdefault(pod, {})[short] = val
     return out
 
@@ -153,7 +159,7 @@ def parse_observability_metrics(text: str) -> dict:
         if line.startswith("#"):
             continue
         if not line.startswith(
-            ("tpushare_slo_", "tpushare_governor_", "tpushare_build_info")
+            (PREFIX_SLO, PREFIX_GOVERNOR, BUILD_INFO)
         ):
             continue
         try:
@@ -166,15 +172,15 @@ def parse_observability_metrics(text: str) -> dict:
         if "{" in metric:
             name, raw = metric.split("{", 1)
             labels = _parse_prom_labels(raw.rstrip("}"))
-        if name == "tpushare_build_info":
+        if name == BUILD_INFO:
             component = labels.pop("component", "") or "?"
             out["build"][component] = labels
-        elif name.startswith("tpushare_slo_"):
+        elif name.startswith(PREFIX_SLO):
             tier = labels.get("tier", "")
             if not tier:
                 continue
             row = out["slo"].setdefault(tier, {})
-            short = name[len("tpushare_slo_"):]
+            short = name[len(PREFIX_SLO):]
             if short == "burn_rate":
                 row[f"burn_{labels.get('window', '?')}"] = val
             else:
@@ -182,7 +188,7 @@ def parse_observability_metrics(text: str) -> dict:
         else:
             pod = labels.get("pod", "")
             row = out["governor"].setdefault(pod, {})
-            row[name[len("tpushare_governor_"):]] = val
+            row[name[len(PREFIX_GOVERNOR):]] = val
     return out
 
 

@@ -31,6 +31,11 @@ from ..utils.circuit import CircuitBreaker
 from ..utils.faults import FAULTS
 from ..utils.log import get_logger
 from ..utils.lockrank import make_lock
+from ..utils.metric_catalog import (
+    PATCH_BATCH_RECORDS,
+    PATCH_COALESCED_TOTAL as PATCH_COALESCED,
+    PATCH_REQUESTS_TOTAL as PATCH_REQUESTS,
+)
 
 log = get_logger("cluster.apiserver")
 
@@ -536,18 +541,15 @@ class ApiServerClient:
 
 # --- PATCH coalescing -------------------------------------------------------
 
-PATCH_BATCH_RECORDS = "tpushare_patch_batch_records"
 PATCH_BATCH_RECORDS_HELP = (
     "PATCHes dispatched per coalescer flush (group-commit batch-size "
     "distribution for apiserver writes)"
 )
 PATCH_BATCH_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0)
-PATCH_COALESCED = "tpushare_patch_coalesced_total"
 PATCH_COALESCED_HELP = (
     "apiserver PATCH requests saved by coalescing: same-node metadata "
     "updates merged into one request (kind=node)"
 )
-PATCH_REQUESTS = "tpushare_patch_requests_total"
 PATCH_REQUESTS_HELP = (
     "Pod PATCH requests by transport: pipelined (batched on a shared "
     "keep-alive connection) vs sequential (single-item flush or fallback "

@@ -140,10 +140,11 @@ class NodeEventEmitter:
         return self
 
     def _count_drop(self, why: str) -> None:
+        from ..utils.metric_catalog import NODE_EVENTS_DROPPED_TOTAL
         from ..utils.metrics import REGISTRY
 
         REGISTRY.counter_inc(
-            "tpushare_node_events_dropped_total",
+            NODE_EVENTS_DROPPED_TOTAL,
             "Node events dropped (full queue or failed send)",
             reason=why,
         )

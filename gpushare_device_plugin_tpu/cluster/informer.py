@@ -36,6 +36,11 @@ from ..utils.retry import Backoff
 from . import pods as P
 from .apiserver import ApiError, ApiServerClient
 from ..utils.lockrank import make_lock
+from ..utils.metric_catalog import (
+    INFORMER_APPLY_BATCH_EVENTS as APPLY_BATCH,
+    INFORMER_INDEX_REBUILDS_TOTAL as INDEX_REBUILDS,
+    INFORMER_STALENESS_SECONDS as STALENESS_GAUGE,
+)
 
 log = get_logger("cluster.informer")
 
@@ -52,7 +57,6 @@ REFRESH_DELAY_S = 0.25
 REFRESH_ATTEMPT_TIMEOUT_S = 1.0
 REFRESH_DEADLINE_S = 3.0
 
-STALENESS_GAUGE = "tpushare_informer_staleness_seconds"
 STALENESS_HELP = (
     "Seconds since the cache last heard from the apiserver (LIST or "
     "watch event); rises during an outage while reads serve last-good data"
@@ -70,13 +74,11 @@ TOMBSTONE_MAX = 1024
 TOMBSTONE_MAX_AGE_S = 600.0
 TOMBSTONE_SWEEP_EVERY_S = 60.0
 
-INDEX_REBUILDS = "tpushare_informer_index_rebuilds_total"
 INDEX_REBUILDS_HELP = (
     "Full index rebuilds (registration + post-relist revalidation); "
     "everything else is incremental on_change maintenance"
 )
 
-APPLY_BATCH = "tpushare_informer_apply_batch_events"
 APPLY_BATCH_HELP = (
     "Watch events applied per cache-lock acquisition (one transport read "
     "= one batch; a PATCH burst coalesces instead of paying N lock "

@@ -50,10 +50,13 @@ from . import pods as P
 from ..utils.lockrank import make_lock
 from ..utils.log import get_logger
 from ..utils.metrics import MetricsRegistry, REGISTRY
+from ..utils.metric_catalog import (
+    ENGINE_STEP_P99_SECONDS as STEP_P99_GAUGE,
+    INTERFERENCE_RATIO as RATIO_GAUGE,
+)
 
 log = get_logger("cluster.interference")
 
-RATIO_GAUGE = "tpushare_interference_ratio"
 RATIO_HELP = (
     "Victim decode-step p99 over its solo-window baseline while sharing "
     "its chip with the aggressor (1.0 = no inflation; 0 = pair no longer "
@@ -62,7 +65,6 @@ RATIO_HELP = (
 
 # Step-p99 gauge the serving engines export (serving/profiler.py); the
 # detector's default signal source reads it back off the registry.
-STEP_P99_GAUGE = "tpushare_engine_step_p99_seconds"
 
 # Passes a known pod may be absent from residency before its baseline is
 # pruned: tolerates a brief informer flap without forgetting solo state,

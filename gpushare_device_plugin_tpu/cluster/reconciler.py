@@ -53,19 +53,21 @@ from ..allocator.checkpoint import AllocationCheckpoint
 from ..utils.log import get_logger
 from ..utils.metrics import REGISTRY
 from . import pods as P
+from ..utils.metric_catalog import (
+    RECONCILE_DRIFT_TOTAL as DRIFT_METRIC,
+    RECONCILE_REPAIRS_TOTAL as REPAIR_METRIC,
+    RECONCILE_RUNS_TOTAL as RUNS_METRIC,
+    RECONCILE_SECONDS as DURATION_METRIC,
+)
 
 log = get_logger("cluster.reconciler")
 
-DRIFT_METRIC = "tpushare_reconcile_drift_total"
 DRIFT_HELP = (
     "State divergences observed between annotations, the reservation "
     "ledger, the checkpoint, and kubelet grants, by kind"
 )
-REPAIR_METRIC = "tpushare_reconcile_repairs_total"
 REPAIR_HELP = "Divergences repaired (released/resolved), by kind"
-RUNS_METRIC = "tpushare_reconcile_runs_total"
 RUNS_HELP = "Reconcile passes by outcome"
-DURATION_METRIC = "tpushare_reconcile_seconds"
 DURATION_HELP = "Wall time of one reconcile pass"
 
 DEFAULT_INTERVAL_S = 30.0

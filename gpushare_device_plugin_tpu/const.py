@@ -21,6 +21,15 @@ RESOURCE_COUNT = "aliyun.com/tpu-count"
 # GPU names kept for the mixed-fleet scheduler-extender path (BASELINE cfg 5).
 RESOURCE_GPU_MEM = "aliyun.com/gpu-mem"
 RESOURCE_GPU_COUNT = "aliyun.com/gpu-count"
+# The GPU family's annotation/env keys (the reference repo's originals),
+# used by the extender's mixed-fleet vocabulary (extender/logic.py
+# RESOURCE_FAMILIES). Declared here like the TPU family below — tpulint's
+# string-consts rule forbids inline ALIYUN_COM_* literals anywhere else.
+ENV_GPU_MEM_IDX = "ALIYUN_COM_GPU_MEM_IDX"
+ENV_GPU_MEM_POD = "ALIYUN_COM_GPU_MEM_POD"
+ENV_GPU_MEM_DEV = "ALIYUN_COM_GPU_MEM_DEV"
+ENV_GPU_MEM_ASSIGNED = "ALIYUN_COM_GPU_MEM_ASSIGNED"
+ENV_GPU_MEM_ASSUME_TIME = "ALIYUN_COM_GPU_MEM_ASSUME_TIME"
 
 # --- Device-plugin sockets (reference: const.go:13) ------------------------
 DEVICE_PLUGIN_PATH = "/var/lib/kubelet/device-plugins/"
@@ -81,6 +90,11 @@ LABEL_NODE_TOPOLOGY = "tpushare.aliyun.com/topology"
 ENV_TPU_VISIBLE_CHIPS = "TPU_VISIBLE_CHIPS"
 ENV_TPU_PROCESS_BOUNDS = "TPU_PROCESS_BOUNDS"
 ENV_TPU_CHIPS_PER_PROCESS_BOUNDS = "TPU_CHIPS_PER_PROCESS_BOUNDS"
+# TPU-VM metadata envs the tpuvm discovery backend probes (set by the GCE
+# runtime on real TPU hosts; discovery/tpuvm.py also accepts the
+# unprefixed legacy spellings, which carry no TPU_ prefix and live there).
+ENV_TPU_ACCELERATOR_TYPE = "TPU_ACCELERATOR_TYPE"
+ENV_TPU_WORKER_ID = "TPU_WORKER_ID"
 
 # --- Multi-host slice bootstrap (BASELINE cfg 4; no reference analog — the
 # reference has no comms backend, SURVEY.md section 2). One pod per host;

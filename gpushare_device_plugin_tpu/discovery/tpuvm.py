@@ -19,6 +19,7 @@ import re
 import time
 from typing import Callable, Iterator, Sequence
 
+from .. import const
 from .base import ChipHealth, HealthEvent, TpuChip, TpuTopology
 
 # Per-chip HBM by TPU generation (public Cloud TPU specs).
@@ -34,8 +35,10 @@ HBM_BY_GENERATION = {
 # Chips per host by generation (full-host TPU-VMs).
 CHIPS_PER_HOST = {"v2": 4, "v3": 4, "v4": 4, "v5e": 8, "v5litepod": 8, "v5p": 4, "v6e": 8}
 
-ENV_ACCEL_TYPE = ("TPU_ACCELERATOR_TYPE", "ACCELERATOR_TYPE")
-ENV_WORKER_ID = ("TPU_WORKER_ID", "WORKER_ID")
+# The TPU_-prefixed spellings live in const.py (string-consts rule);
+# the unprefixed legacy fallbacks are tpuvm-local.
+ENV_ACCEL_TYPE = (const.ENV_TPU_ACCELERATOR_TYPE, "ACCELERATOR_TYPE")
+ENV_WORKER_ID = (const.ENV_TPU_WORKER_ID, "WORKER_ID")
 ENV_HBM_OVERRIDE = "TPUSHARE_HBM_GIB"
 ENV_SYSFS_ROOT = "TPUINFO_SYSFS_ROOT"
 

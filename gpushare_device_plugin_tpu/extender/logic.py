@@ -47,11 +47,11 @@ RESOURCE_FAMILIES = {
     },
     const.RESOURCE_GPU_MEM: {
         "count": const.RESOURCE_GPU_COUNT,
-        "idx": "ALIYUN_COM_GPU_MEM_IDX",
-        "pod": "ALIYUN_COM_GPU_MEM_POD",
-        "dev": "ALIYUN_COM_GPU_MEM_DEV",
-        "assigned": "ALIYUN_COM_GPU_MEM_ASSIGNED",
-        "assume": "ALIYUN_COM_GPU_MEM_ASSUME_TIME",
+        "idx": const.ENV_GPU_MEM_IDX,
+        "pod": const.ENV_GPU_MEM_POD,
+        "dev": const.ENV_GPU_MEM_DEV,
+        "assigned": const.ENV_GPU_MEM_ASSIGNED,
+        "assume": const.ENV_GPU_MEM_ASSUME_TIME,
     },
 }
 

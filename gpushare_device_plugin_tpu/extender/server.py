@@ -36,6 +36,11 @@ from ..utils.tracing import ADMISSIONS, TRACER, SpanContext
 from . import logic
 from .index import ClusterUsageIndex
 from ..utils.lockrank import make_rlock
+from ..utils.metric_catalog import (
+    EXTENDER_VERB_SECONDS,
+    EXTENDER_VERB_TOTAL,
+    EXTENDER_VIEW_TOTAL,
+)
 
 log = get_logger("extender")
 
@@ -288,7 +293,7 @@ class ExtenderCore:
                         rv, gen, capacity, used, core_held, topo
                     )
         REGISTRY.counter_inc(
-            "tpushare_extender_view_total",
+            EXTENDER_VIEW_TOTAL,
             "NodeView constructions by outcome (hit = served from the "
             "incremental cache; rebuild = capacity re-parsed / usage re-read)",
             outcome=outcome,
@@ -894,17 +899,17 @@ class ExtenderHTTPServer:
                 except Exception as e:  # keep the webhook alive
                     log.error("extender verb %s failed: %s", self.path, e)
                     REGISTRY.counter_inc(
-                        "tpushare_extender_verb_total",
+                        EXTENDER_VERB_TOTAL,
                         "Webhook verbs by outcome", verb=verb, outcome="error",
                     )
                     return self._send(200, {"error": str(e)})
                 REGISTRY.observe(
-                    "tpushare_extender_verb_seconds",
+                    EXTENDER_VERB_SECONDS,
                     time.perf_counter() - t0,
                     "Webhook verb latency", verb=verb,
                 )
                 REGISTRY.counter_inc(
-                    "tpushare_extender_verb_total",
+                    EXTENDER_VERB_TOTAL,
                     "Webhook verbs by outcome", verb=verb, outcome="ok",
                 )
                 return self._send(200, result)

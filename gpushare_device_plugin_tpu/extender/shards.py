@@ -66,6 +66,7 @@ from ..utils.log import get_logger
 from ..utils.metrics import REGISTRY
 from . import logic
 from .server import ExtenderCore
+from ..utils.metric_catalog import GANG2PC_TOTAL as TWOPC_METRIC
 
 # A committed 2PC reservation normally drains when the watch shows the
 # annotated pod on its node. Two paths never get that signal: the pod
@@ -76,6 +77,21 @@ from .server import ExtenderCore
 # only strands capacity.
 COMMIT_VISIBILITY_GRACE_S = 60.0
 
+# How long an undecided prepare belonging to a LIVE coordinator (its
+# lease epoch still held) is protected from the reconciler's presumed-
+# abort rollback. A live protocol finishes in milliseconds; a prepare
+# this old under a still-held lease means the coordinator wedged, and
+# the override then both rolls back AND FENCES: the resolver takes a
+# higher lease epoch and seeds it onto the member + coordinator shards,
+# so a late-waking driver hits StaleCoordinator at its (epoch-gated)
+# decision point instead of committing on top of re-booked chips.
+# Without the gate itself, the live resolve loop could roll back a
+# prepare the coordinator was about to decide on — releasing chips its
+# durable decision later rolls FORWARD onto, after a competing group
+# booked them: the gang double-booking one layer up, found by
+# tools/tpumc (model "gang2pc-resolve", pinned in tests/test_tpumc.py).
+LIVE_PREPARE_GRACE_S = 60.0
+
 log = get_logger("shards")
 
 # Synthetic namespace for cross-shard two-phase reservations in the
@@ -85,7 +101,6 @@ GANG2PC_NS = "tpushare-gang2pc"
 
 WAL_KIND_2PC = "gang2pc"
 
-TWOPC_METRIC = "tpushare_gang2pc_total"
 TWOPC_HELP = (
     "Cross-shard two-phase gang operations by phase and outcome "
     "(prepare/decide/commit/abort/rollforward/rollback)"
@@ -439,7 +454,13 @@ class ShardExtender:
         )
         return True, ""
 
-    def _rollback_member(self, key: PodKey, seq: int | None) -> None:
+    def _rollback_member(
+        self, key: PodKey, seq: int | None, drop_epoch: bool = True
+    ) -> None:
+        """``drop_epoch=False`` is the wedged-coordinator fencing path:
+        the resolver seeds a higher fencing epoch BEFORE this rollback,
+        and the normal finished-group pruning here would drop that fence
+        in the exact window the late-waking driver needs it."""
         self._ledger.release(key)
         with self._twopc_lock:
             entry = self._twopc.pop(key, None)
@@ -452,7 +473,10 @@ class ShardExtender:
             # entry for the reconciler, which resolves with the seq it
             # read from the journal itself
             self._resolve_2pc("abort", key, seq)
-        self._drop_finished_epoch(entry.get("group", "") if entry else "")
+        if drop_epoch:
+            self._drop_finished_epoch(
+                entry.get("group", "") if entry else ""
+            )
         REGISTRY.counter_inc(
             TWOPC_METRIC, TWOPC_HELP, phase="abort", outcome="ok",
         )
@@ -761,6 +785,16 @@ class ShardRouter:
     @property
     def ring(self) -> HashRing:
         return self._ring
+
+    @property
+    def lease(self) -> LeaderLease:
+        """The gang-group coordinator lease. A live reconciler pass MUST
+        resolve with this same lease (``resolve_gang2pc(..., lease=
+        router.lease)``) so it can tell a live coordinator's undecided
+        prepare from a dead one's — rolling back the former re-creates
+        the cross-shard double-booking (see :data:`LIVE_PREPARE_GRACE_S`
+        and the tpumc counterexample it cites)."""
+        return self._lease
 
     def set_nodes(self, nodes: Iterable[dict]) -> None:
         """Install the node catalog: partitions by ring owner and hands
@@ -1078,6 +1112,29 @@ class ShardRouter:
                 }
             prepared.append(member)
         decision_key = (GANG2PC_NS, f"{group}/decision")
+        try:
+            # The commit point is epoch-gated: a resolver that overrode
+            # this (wedged) coordinator past LIVE_PREPARE_GRACE_S has
+            # already rolled its prepares back and seeded a higher
+            # fencing epoch — journaling a decision now would roll the
+            # group forward onto chips a competing booking may own.
+            coordinator._note_epoch(group, epoch)
+        except StaleCoordinator as e:
+            for done in prepared:
+                try:
+                    self._shards[done["shard"]].abort_gang(
+                        group, done["ns"], done["name"], epoch
+                    )
+                except (ShardUnavailable, ApiError, OSError,
+                        StaleCoordinator):
+                    # the fencing resolver already rolled this member
+                    # back (or will, next pass)
+                    pass
+            self._lease.forget(group)
+            return {
+                "error": f"fenced at the decision point: {e}",
+                "members": [], "group": group,
+            }
         decision_seq = coordinator._journal_2pc(decision_key, {
             "phase": "decision",
             "outcome": "commit",
@@ -1119,6 +1176,7 @@ class ShardRouter:
             # are the reconciler's to roll forward — the entry stays
             # pending so resolve_gang2pc finds it
             self._lease.forget(group)
+            coordinator._drop_finished_epoch(group)
             return {
                 "error": "",
                 "group": group,
@@ -1128,6 +1186,11 @@ class ShardRouter:
         coordinator._resolve_2pc("commit", decision_key, decision_seq)
         FAULTS.fire("gang2pc.done")
         self._lease.forget(group)
+        # the decision-point epoch check noted the group on the
+        # coordinator shard; a memberless coordinator has no side-state
+        # whose release would prune it, so drop it here (no-op while
+        # any member side-state still references the group)
+        coordinator._drop_finished_epoch(group)
         return {
             "error": "", "group": group,
             "members": [m["name"] for m in plan],
@@ -1276,6 +1339,7 @@ def resolve_gang2pc(
     counts = {
         "rolled_forward": 0, "rolled_back": 0,
         "member_gone": 0, "decisions_resolved": 0,
+        "skipped_live": 0,
     }
     # roll forward every decided group
     for group, (coord, decision) in decisions.items():
@@ -1349,15 +1413,64 @@ def resolve_gang2pc(
         if lease is not None:
             lease.forget(group)
         counts["decisions_resolved"] += 1
-    # roll back every undecided prepare
+    # roll back every undecided prepare — UNLESS its coordinator is
+    # provably live: the group's lease epoch is still held AND the
+    # prepare is younger than LIVE_PREPARE_GRACE_S. A live coordinator
+    # is between its prepares and its decision; releasing its member's
+    # reservation here lets a competing group book the chips, and the
+    # coordinator's (imminent, durable) commit decision then rolls the
+    # member forward ON TOP of them — the double-booking tools/tpumc
+    # found when the live resolve loop ran lease-less (the pre-fix
+    # shards.main wiring; tests/test_tpumc.py replays the schedule).
+    # Callers with no lease (startup recovery — no coordinator can be
+    # live in a fresh process) roll back immediately, which the
+    # kill-at-every-step chaos suite depends on; a wedged live
+    # coordinator is overridden once its prepare ages past the grace.
+    now = time.time()
     for shard, entry in prepares:
         group = str(entry.get("group", ""))
         if group in decisions:
             continue  # handled (or deliberately left) above
+        fence_epoch = 0
+        if lease is not None:
+            _holder, held_epoch = lease.current(group)
+            age = now - float(entry.get("ts") or 0.0)
+            if held_epoch > 0:
+                if age < LIVE_PREPARE_GRACE_S:
+                    counts["skipped_live"] += 1
+                    continue
+                # Overriding a WEDGED coordinator (lease still held,
+                # prepare aged past the grace): take a higher epoch and
+                # seed it on the member AND coordinator shards BEFORE
+                # anything releases — presumed abort alone is not
+                # enough, because the wedged driver may wake later and
+                # journal its commit decision on top of whatever
+                # re-booked the freed chips; seeding first closes its
+                # epoch-gated decision point before the chips free up.
+                # The fence (the lease entry and the seeded epochs) is
+                # deliberately NEVER pruned on this path: a paused
+                # thread can wake arbitrarily late, and pruning would
+                # re-open the gate for its stale decision. One retained
+                # entry per wedge event — a logged anomaly, not a
+                # per-group cost.
+                fence_epoch = lease.acquire(group, "gang2pc-resolver")
+                shard._note_epoch(group, fence_epoch)
+                coord = by_id.get(str(entry.get("coordinator", "")))
+                if coord is not None and coord is not shard:
+                    coord._note_epoch(group, fence_epoch)
+                log.warning(
+                    "gang2pc: coordinator for group %s wedged past "
+                    "%.0fs with an undecided prepare; fenced at epoch "
+                    "%d and rolling the prepare back", group,
+                    LIVE_PREPARE_GRACE_S, fence_epoch,
+                )
         key = tuple(entry.get("key") or ())
         if len(key) != 2:
             continue
-        shard._rollback_member((key[0], key[1]), entry.get("_seq"))
+        shard._rollback_member(
+            (key[0], key[1]), entry.get("_seq"),
+            drop_epoch=not fence_epoch,
+        )
         counts["rolled_back"] += 1
         REGISTRY.counter_inc(
             TWOPC_METRIC, TWOPC_HELP, phase="rollback", outcome="ok",
@@ -1473,8 +1586,15 @@ def main(argv: "list[str] | None" = None) -> int:
             f"shard-{i}", api, informer=informer,
             checkpoint=checkpoint, policy=policy,
         ))
-    router = ShardRouter(shards, fanout=args.fanout)
-    resolve_gang2pc(shards, api)  # inherited 2PC state first
+    # ONE lease shared by the router and every resolve pass: the live
+    # resolve loop must see which groups a live coordinator is still
+    # driving (resolve_gang2pc's live-prepare gate) — a lease-less
+    # resolve racing admit_gang_group was the tpumc-found double-booking
+    lease = LeaderLease()
+    router = ShardRouter(shards, fanout=args.fanout, lease=lease)
+    # inherited 2PC state first: a fresh process has no live
+    # coordinators, so every undecided prepare legitimately rolls back
+    resolve_gang2pc(shards, api, lease)
 
     def refresh_nodes() -> None:
         while True:
@@ -1492,7 +1612,7 @@ def main(argv: "list[str] | None" = None) -> int:
         while True:
             time.sleep(args.gang2pc_resolve_interval)
             try:
-                resolve_gang2pc(shards, api)
+                resolve_gang2pc(shards, api, lease)
             except ApiError as e:
                 log.warning("gang2pc resolve pass failed: %s", e)
 

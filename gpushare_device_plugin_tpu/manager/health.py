@@ -18,6 +18,11 @@ from ..utils.faults import FAULTS
 from ..utils.log import get_logger
 from ..utils.retry import Backoff
 from ..utils.lockrank import make_lock
+from ..utils.metric_catalog import (
+    HEALTH_EVENTS_TOTAL,
+    HEALTH_WATCHER_RESTARTS_TOTAL,
+    UNHEALTHY_CHIPS,
+)
 
 log = get_logger("manager.health")
 
@@ -64,7 +69,7 @@ class HealthWatcher:
         from ..utils.metrics import REGISTRY
 
         REGISTRY.counter_inc(
-            "tpushare_health_events_total",
+            HEALTH_EVENTS_TOTAL,
             "Classified health transitions",
             severity=event.severity, health=event.health.value,
         )
@@ -90,7 +95,7 @@ class HealthWatcher:
             else:
                 self._unhealthy_ids.discard(event.chip_id)
         REGISTRY.gauge_set(
-            "tpushare_unhealthy_chips",
+            UNHEALTHY_CHIPS,
             len(self._unhealthy_ids),
             "Chips currently excluded from placement",
         )
@@ -129,7 +134,7 @@ class HealthWatcher:
                         return
                     self._restarts += 1
                     REGISTRY.counter_inc(
-                        "tpushare_health_watcher_restarts_total",
+                        HEALTH_WATCHER_RESTARTS_TOTAL,
                         "Health watch loop crashes revived by the supervisor",
                     )
                     delay = backoff.next()

@@ -20,6 +20,7 @@ import threading
 from typing import Sequence
 
 from .. import const
+from ..utils.metric_catalog import SLO_BURN_RATE
 from ..allocator.env import build_core_allocation
 from ..allocator.local import LocalAllocator
 from ..device.fanout import DeviceInventory
@@ -634,7 +635,7 @@ class TpuShareManager:
                 return REGISTRY.gauge_value(STRANDED_PCT_GAUGE)
 
             def _slo_burn_5m():
-                series = REGISTRY.gauge_series("tpushare_slo_burn_rate")
+                series = REGISTRY.gauge_series(SLO_BURN_RATE)
                 vals = [
                     v for labels, v in series.items()
                     if dict(labels).get("window") == "5m"

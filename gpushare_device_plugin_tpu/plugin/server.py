@@ -29,6 +29,7 @@ from ..device.fanout import DeviceInventory, FakeDevice
 from ..discovery.base import ChipHealth
 from ..utils.log import get_logger
 from ..utils.lockrank import make_condition
+from ..utils.metric_catalog import ALLOCATE_SECONDS, ALLOCATE_TOTAL
 from ..utils.tracing import TRACER
 from .api import (
     DevicePluginServicer,
@@ -248,19 +249,19 @@ class TpuSharePlugin(DevicePluginServicer):
             except Exception as e:  # business errors -> admission failure
                 log.warning("Allocate failed: %s", e)
                 REGISTRY.counter_inc(
-                    "tpushare_allocate_total",
+                    ALLOCATE_TOTAL,
                     "Allocate RPCs by outcome",
                     resource=self._cfg.resource_name, outcome="error",
                 )
                 context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
             REGISTRY.observe(
-                "tpushare_allocate_seconds",
+                ALLOCATE_SECONDS,
                 time.perf_counter() - t0,
                 "Allocate placement latency",
                 resource=self._cfg.resource_name,
             )
             REGISTRY.counter_inc(
-                "tpushare_allocate_total",
+                ALLOCATE_TOTAL,
                 "Allocate RPCs by outcome",
                 resource=self._cfg.resource_name, outcome="ok",
             )

@@ -1,0 +1,142 @@
+"""The engine drain handshake, as a standalone protocol object.
+
+This is the engine half of the live-defragmentation move protocol
+(``allocator/defrag.py``): a mover thread asks the serving thread to
+quiesce at its next iteration boundary, the serving thread captures its
+unfinished requests into a JSON-safe snapshot, and the mover collects it
+for restore on the destination slice. The state machine is three flips
+guarded by one near-leaf lock:
+
+- **arm** (:meth:`request`): reset any PRIOR cycle's answer, then raise
+  the request flag. Only arming (and the everything-retired answer) may
+  discard an uncollected capture — runs never do, so a snapshot survives
+  back-to-back runs until its waiter reads it, however late that thread
+  is scheduled.
+- **capture** (:meth:`publish`): the serving thread, at an iteration
+  boundary it observed :meth:`armed` at, stores the snapshot, lowers the
+  request flag, and wakes the waiter.
+- **consume** (:meth:`wait` / :meth:`snapshot`): the mover blocks for
+  the capture. A timed-out wait DISARMS the drain before raising — the
+  move is dead, and an engine left armed would quiesce its next
+  unrelated run into a snapshot nobody collects (lost requests).
+- **run end** (:meth:`finish_run`): a run that retired everything
+  answers a concurrent drain with None (nothing to move) and disarms;
+  an earlier cycle's uncollected capture is left for its waiter.
+
+The class lives outside ``engine.py`` on purpose: it is pure protocol —
+no jax, no pages — which lets ``tools/tpumc`` (the exhaustive-
+interleaving model checker) drive the REAL handshake code against a
+simulated serving loop and enumerate every arm/capture/consume/run-end
+ordering, including the stale-answer and natural-end races the comments
+below pin. Its lock and events are created through the ``lockrank``
+factory seam, so under the checker every flip is a yield point.
+``PagedSlotEngine`` composes it; the engine-facing methods
+(``request_drain``/``wait_drained``/``drain_snapshot``) delegate here.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..utils.lockrank import make_event, make_lock
+
+
+class DrainHandshake:
+    """Arm/capture/consume state machine between one serving thread and
+    one mover thread. Thread-safe; the lock is held around flag/dict
+    flips only, a few times per run — never per tick, never over another
+    lock (rank ``serving.drain``)."""
+
+    def __init__(self) -> None:
+        self._request_evt = make_event("serving.drain.request")
+        self._drained_evt = make_event("serving.drain.drained")
+        # serializes the arm/capture/consume transitions (near-leaf)
+        self._lock = make_lock("serving.drain")
+        self._snapshot: dict | None = None
+
+    # --- mover side -------------------------------------------------------
+
+    def request(self) -> None:
+        """Arm: ask the in-progress run to quiesce at its next iteration
+        boundary. Resets the quiesce state from any PRIOR run before
+        arming: a completed run leaves the drained flag set (and possibly
+        an old collected snapshot behind) — without this, a drain
+        requested between runs returns that stale answer immediately and
+        the NEXT run's capture is never collected (lost requests)."""
+        with self._lock:
+            self._drained_evt.clear()
+            self._snapshot = None
+            self._request_evt.set()
+
+    def wait(self, timeout: float | None = None) -> dict | None:
+        """Block until the serving thread quiesced after :meth:`request`
+        — either it captured a snapshot or its run completed with
+        nothing left in flight — then return :meth:`snapshot` (None in
+        the ran-to-completion case). Raises ``TimeoutError`` when
+        ``timeout`` (seconds) expires with no run reaching a boundary —
+        the not-quiesced case MUST be distinguishable from the clean
+        nothing-in-flight None: a mover that read None from a wedged
+        engine would flip the pod's accounting while the source is still
+        actively serving.
+
+        A timed-out wait disarms before raising; if the serving thread
+        reached the boundary in the instant between the wait expiring
+        and the disarm, that capture is taken instead of raised away."""
+        if not self._drained_evt.wait(timeout):
+            with self._lock:
+                if not self._drained_evt.is_set():
+                    self._request_evt.clear()
+                    raise TimeoutError(
+                        "engine did not quiesce after request_drain()"
+                        + (f" within {timeout}s" if timeout is not None else "")
+                    )
+        return self.snapshot()
+
+    def snapshot(self) -> dict | None:
+        """The snapshot captured by the last drained run (None when the
+        last quiesce ended with everything retired; an uncollected
+        capture survives back-to-back runs until the next
+        :meth:`request` re-arms the cycle)."""
+        return self._snapshot
+
+    # --- serving side -----------------------------------------------------
+
+    def armed(self) -> bool:
+        """Whether a drain is requested (the run's iteration-boundary
+        poll; cheap — one flag read, no lock)."""
+        return self._request_evt.is_set()
+
+    def publish(self, captured: dict) -> None:
+        """Capture: store the quiesced run's snapshot, disarm, and wake
+        the cross-thread :meth:`wait`."""
+        with self._lock:
+            self._snapshot = captured
+            self._request_evt.clear()
+            self._drained_evt.set()
+
+    def finish_run(self) -> None:
+        """Run completed naturally — quiesced either way: a drain
+        requested after the last iteration boundary is CONSUMED by the
+        everything-retired answer (flag set, snapshot None, drain
+        disarmed — leaving it armed would make the next unrelated run
+        quiesce into a snapshot nobody collects). Without the wake, a
+        :meth:`wait` racing the run's natural end would block forever. A
+        pending uncollected capture from an earlier drained run (flag
+        already set) is left for its waiter."""
+        with self._lock:
+            if not self._drained_evt.is_set():
+                self._snapshot = None
+                self._request_evt.clear()
+                self._drained_evt.set()
+
+    # --- introspection ----------------------------------------------------
+
+    def doc(self) -> dict[str, Any]:
+        """Flag/snapshot state for debugging and the model checker's
+        invariant checks."""
+        with self._lock:
+            return {
+                "armed": self._request_evt.is_set(),
+                "drained": self._drained_evt.is_set(),
+                "has_snapshot": self._snapshot is not None,
+            }

@@ -44,7 +44,6 @@ from __future__ import annotations
 
 import dataclasses
 import math
-import threading
 import time
 from collections import deque
 from typing import Sequence
@@ -55,8 +54,14 @@ import numpy as np
 
 from ..const import SLO_TIER_BEST_EFFORT, SLO_TIER_CRITICAL, MemoryUnit
 from ..parallel.podenv import PodTpuEnv
-from ..utils.lockrank import make_lock
 from ..utils.log import get_logger
+from ..utils.metric_catalog import (
+    ENGINE_PREEMPTIONS,
+    ENGINE_PREEMPTIONS_TOTAL,
+    ENGINE_PREFIX_CACHED_PAGES,
+    ENGINE_PREFIX_HIT_RATIO,
+    ENGINE_PREFIX_HIT_TOKENS,
+)
 from ..utils.metrics import REGISTRY
 from ..utils.tracing import TRACER
 from ..workloads import generate as G
@@ -69,6 +74,7 @@ from .pages import (
     pages_for,
     row_span_for,
 )
+from .drainproto import DrainHandshake
 from .profiler import StepProfiler, ceil_rank_quantile
 from .radix import RadixCache
 
@@ -781,13 +787,10 @@ class PagedSlotEngine(SlotEngine):
         # boundary — in-flight requests are captured into a JSON-safe
         # snapshot, their pages freed — and restore_snapshot() re-admits
         # them on another engine (the destination slice) bit-identically.
-        self._drain_evt = threading.Event()
-        self._drained_evt = threading.Event()  # set when run() quiesces
-        # serializes the arm/capture/consume transitions of the drain
-        # handshake (near-leaf: held around Event/dict flips only, a few
-        # times per run — never per tick, never over another lock)
-        self._drain_lock = make_lock("serving.drain")
-        self._drained: dict | None = None
+        # The arm/capture/consume state machine lives in
+        # serving/drainproto.py (jax-free, so tools/tpumc can enumerate
+        # every ordering of it against a simulated serving loop).
+        self._drain = DrainHandshake()
         self._restore_tokens: dict[int, tuple[int, ...]] = {}
         # snapshot_ids this instance already restored: the move
         # protocol's restore delivery is at-least-once across the
@@ -856,17 +859,17 @@ class PagedSlotEngine(SlotEngine):
         self._flush_step_profile()
         if self.radix is not None:
             REGISTRY.gauge_set(
-                "tpushare_engine_prefix_hit_ratio", self.radix.hit_ratio(),
+                ENGINE_PREFIX_HIT_RATIO, self.radix.hit_ratio(),
                 "Fraction of looked-up prompt tokens served from the "
                 "radix prefix cache", **labels,
             )
             REGISTRY.gauge_set(
-                "tpushare_engine_prefix_cached_pages",
+                ENGINE_PREFIX_CACHED_PAGES,
                 self.radix.cached_pages,
                 "KV pages held by the radix prefix cache", **labels,
             )
         REGISTRY.gauge_set(
-            "tpushare_engine_preemptions", self.preemptions,
+            ENGINE_PREEMPTIONS, self.preemptions,
             "Requests preempted by page eviction since engine start",
             **labels,
         )
@@ -904,19 +907,10 @@ class PagedSlotEngine(SlotEngine):
         whole queue immediately. A cross-thread caller must then
         :meth:`wait_drained` — reading :meth:`drain_snapshot` before the
         serving thread reaches the boundary returns stale/None and the
-        eventual snapshot would never be collected."""
-        # Reset the quiesce state from any PRIOR run before arming: a
-        # completed run leaves _drained_evt set (and possibly an old
-        # collected snapshot behind) — without this, a drain requested
-        # between runs returns that stale answer immediately and the
-        # NEXT run's capture is never collected (lost requests). Only
-        # this re-arm (and the everything-retired answer) may discard a
-        # capture: runs never do, so a snapshot survives until its
-        # waiter reads it, however late that thread is scheduled.
-        with self._drain_lock:
-            self._drained_evt.clear()
-            self._drained = None
-            self._drain_evt.set()
+        eventual snapshot would never be collected. (Arm semantics —
+        why only this re-arm may discard an uncollected capture — are
+        documented on :meth:`.drainproto.DrainHandshake.request`.)"""
+        self._drain.request()
 
     def wait_drained(self, timeout: float | None = None) -> dict | None:
         """Block until the serving thread quiesced after
@@ -937,15 +931,7 @@ class PagedSlotEngine(SlotEngine):
         collects (lost). If the serving thread reached the boundary in
         the instant between the wait expiring and the disarm, that
         capture is taken instead of raised away."""
-        if not self._drained_evt.wait(timeout):
-            with self._drain_lock:
-                if not self._drained_evt.is_set():
-                    self._drain_evt.clear()
-                    raise TimeoutError(
-                        "engine did not quiesce after request_drain()"
-                        + (f" within {timeout}s" if timeout is not None else "")
-                    )
-        return self.drain_snapshot()
+        return self._drain.wait(timeout)
 
     def drain_snapshot(self) -> dict | None:
         """The JSON-safe in-flight snapshot captured by the last drained
@@ -959,7 +945,7 @@ class PagedSlotEngine(SlotEngine):
         NOT carried: restore re-prefills prompt + generated tokens (the
         preemption re-admission math), and radix-shared prefixes
         re-resolve against the destination engine's own cache."""
-        return self._drained
+        return self._drain.snapshot()
 
     def _drain_row(
         self, req: Request, res: RequestResult | None, state: str
@@ -1152,7 +1138,7 @@ class PagedSlotEngine(SlotEngine):
                 {"pod": self.metrics_pod} if self.metrics_pod else {}
             )
             REGISTRY.counter_inc(
-                "tpushare_engine_preemptions_total",
+                ENGINE_PREEMPTIONS_TOTAL,
                 "Paged-engine preemptions (victim pages evicted for a "
                 "higher-priority request)", **labels,
             )
@@ -1226,7 +1212,7 @@ class PagedSlotEngine(SlotEngine):
         while i < len(incoming) or pending or any(
             s.state != "free" for s in slots
         ):
-            if self._drain_evt.is_set() or (
+            if self._drain.armed() or (
                 drain_at_tick is not None and self.ticks >= drain_at_tick
             ):
                 # quiesce: capture every unfinished request (in-flight
@@ -1266,10 +1252,7 @@ class PagedSlotEngine(SlotEngine):
                     },
                     "requests": rows,
                 }
-                with self._drain_lock:
-                    self._drained = captured
-                    self._drain_evt.clear()
-                    self._drained_evt.set()  # wake cross-thread wait_drained
+                self._drain.publish(captured)  # wake cross-thread wait_drained
                 break
             while i < len(incoming) and incoming[i].arrival <= self.ticks:
                 req = incoming[i]
@@ -1359,7 +1342,7 @@ class PagedSlotEngine(SlotEngine):
                         attributes={"rid": req.rid, "tokens": matched},
                     ):
                         REGISTRY.observe(
-                            "tpushare_engine_prefix_hit_tokens",
+                            ENGINE_PREFIX_HIT_TOKENS,
                             float(matched),
                             "Prompt tokens served from the radix prefix "
                             "cache per admission",
@@ -1504,18 +1487,9 @@ class PagedSlotEngine(SlotEngine):
 
         self.publish_metrics()
         results.sort(key=lambda r: r.rid)
-        # quiesced either way: a drain requested after the last iteration
-        # boundary is CONSUMED by the everything-retired answer (evt set,
-        # snapshot None, drain disarmed — leaving it armed would make the
-        # next unrelated run quiesce into a snapshot nobody collects) —
-        # without the wake, a wait_drained racing the run's natural end
-        # would block forever. A pending uncollected capture from an
-        # earlier drained run (evt already set) is left for its waiter.
-        with self._drain_lock:
-            if not self._drained_evt.is_set():
-                self._drained = None
-                self._drain_evt.clear()
-                self._drained_evt.set()
+        # quiesced either way — a drain racing the run's natural end gets
+        # the everything-retired answer (DrainHandshake.finish_run)
+        self._drain.finish_run()
         return ServeStats(
             results=results, ticks=self.ticks,
             wall_s=time.perf_counter() - t0,

@@ -47,13 +47,15 @@ from typing import Callable
 from ..utils.log import get_logger
 from ..utils.metrics import MetricsRegistry, REGISTRY
 from ..utils.tracing import TRACER
+from ..utils.metric_catalog import (
+    GOVERNOR_ENGAGED as ENGAGED_GAUGE,
+    GOVERNOR_ENGAGEMENTS_TOTAL as ENGAGEMENTS_TOTAL,
+    GOVERNOR_THROTTLED_STEPS_TOTAL as THROTTLED_STEPS_TOTAL,
+    GOVERNOR_THROTTLE_SECONDS_TOTAL as THROTTLE_SECONDS_TOTAL,
+)
 
 log = get_logger("serving.governor")
 
-ENGAGED_GAUGE = "tpushare_governor_engaged"
-ENGAGEMENTS_TOTAL = "tpushare_governor_engagements_total"
-THROTTLED_STEPS_TOTAL = "tpushare_governor_throttled_steps_total"
-THROTTLE_SECONDS_TOTAL = "tpushare_governor_throttle_seconds_total"
 
 
 class StepGovernor:

@@ -35,6 +35,11 @@ from __future__ import annotations
 import dataclasses
 
 from ..utils.lockrank import make_lock
+from ..utils.metric_catalog import (
+    ENGINE_KV_PAGES_FREE,
+    ENGINE_KV_PAGES_TOTAL,
+    ENGINE_KV_PAGES_USED,
+)
 from ..utils.metrics import REGISTRY, MetricsRegistry
 
 # Physical page id 0: the scratch page. Idle slot rows' page tables point
@@ -161,15 +166,15 @@ class PageAllocator:
             free = len(self._free)
         labels = {"pod": pod} if pod else {}
         registry.gauge_set(
-            "tpushare_engine_kv_pages_total", self.total,
+            ENGINE_KV_PAGES_TOTAL, self.total,
             "KV page-pool capacity (pages)", **labels,
         )
         registry.gauge_set(
-            "tpushare_engine_kv_pages_free", free,
+            ENGINE_KV_PAGES_FREE, free,
             "KV pages on the free list", **labels,
         )
         registry.gauge_set(
-            "tpushare_engine_kv_pages_used", self.total - free,
+            ENGINE_KV_PAGES_USED, self.total - free,
             "KV pages referenced by live requests or the prefix cache",
             **labels,
         )

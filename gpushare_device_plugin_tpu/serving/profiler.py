@@ -37,7 +37,11 @@ from ..utils.lockrank import make_lock
 from ..utils.metrics import MetricsRegistry, REGISTRY
 from ..utils.tracing import TRACER
 
-STEP_METRIC = "tpushare_engine_step_seconds"
+from ..utils.metric_catalog import ENGINE_STEP_SECONDS as STEP_METRIC
+from ..utils.metric_catalog import (
+    ENGINE_STEP_P50_SECONDS as P50_GAUGE,
+    ENGINE_STEP_P99_SECONDS as P99_GAUGE,
+)
 STEP_HELP = (
     "Wall seconds per pool-wide decode step (one model dispatch advancing "
     "every occupied slot)"
@@ -49,8 +53,6 @@ STEP_BUCKETS = (
     0.1, 0.25, 0.5, 1.0,
 )
 
-P50_GAUGE = "tpushare_engine_step_p50_seconds"
-P99_GAUGE = "tpushare_engine_step_p99_seconds"
 
 
 def ceil_rank_quantile(vals: list[float], q: float) -> float:

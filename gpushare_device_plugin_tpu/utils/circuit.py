@@ -27,6 +27,11 @@ import time
 from typing import Any, Callable
 
 from .log import get_logger
+from .metric_catalog import (
+    CIRCUIT_FASTFAIL_TOTAL,
+    CIRCUIT_STATE,
+    CIRCUIT_TRANSITIONS_TOTAL,
+)
 from .metrics import REGISTRY
 from .lockrank import make_lock
 
@@ -78,7 +83,7 @@ class CircuitBreaker:
 
     def _export(self) -> None:
         REGISTRY.gauge_set(
-            "tpushare_circuit_state",
+            CIRCUIT_STATE,
             _STATE_VALUE[self._state],
             "Breaker state: 0 closed, 1 half-open, 2 open",
             breaker=self.name,
@@ -91,7 +96,7 @@ class CircuitBreaker:
         log.warning("circuit '%s': %s -> %s", self.name, self._state, state)
         self._state = state
         REGISTRY.counter_inc(
-            "tpushare_circuit_transitions_total",
+            CIRCUIT_TRANSITIONS_TOTAL,
             "Breaker state transitions",
             breaker=self.name, to=state,
         )
@@ -124,7 +129,7 @@ class CircuitBreaker:
                 self._probe_in_flight = True  # this caller is the probe
                 return
             REGISTRY.counter_inc(
-                "tpushare_circuit_fastfail_total",
+                CIRCUIT_FASTFAIL_TOTAL,
                 "Calls rejected while the circuit was open",
                 breaker=self.name,
             )

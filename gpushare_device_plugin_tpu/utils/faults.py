@@ -191,10 +191,25 @@ class FaultRegistry:
         # mode fault raises SimulatedCrash — so injected kills leave the
         # same postmortem a production SIGKILL site would.
         self._crash_hook: Callable[[str], Any] | None = None
+        # Model-checker hook (tools/tpumc): every fire() site is a
+        # protocol decision point — the checkpoint.* points fire right
+        # after a journal record is durable, the defrag.*/gang2pc.*
+        # points right after each protocol phase — so the deterministic
+        # scheduler treats each one as a yield point and can interleave
+        # OTHER threads exactly at the boundaries the chaos suites kill
+        # at. Read unlocked on the fast path (one attribute load; None
+        # in production).
+        self._yield_hook: Callable[[str], Any] | None = None
 
     def set_crash_hook(self, hook: Callable[[str], Any] | None) -> None:
         with self._lock:
             self._crash_hook = hook
+
+    def set_yield_hook(self, hook: Callable[[str], Any] | None) -> None:
+        """Install (or clear) the model checker's yield hook, called with
+        the point name at the TOP of every :meth:`fire` — before the
+        armed-fault check, so an unarmed point still yields."""
+        self._yield_hook = hook
 
     def inject(
         self,
@@ -232,7 +247,11 @@ class FaultRegistry:
             return f.fired if f is not None else 0
 
     def fire(self, point: str) -> None:
-        """Called at the injection site. No-op unless the point is armed."""
+        """Called at the injection site. No-op unless the point is armed
+        (and, under the model checker, a scheduler yield point)."""
+        hook = self._yield_hook
+        if hook is not None:
+            hook(point)
         if not self._faults:  # fast path: nothing armed anywhere
             return
         crash: SimulatedCrash | None = None

@@ -34,6 +34,17 @@ This module makes the locking discipline *declared* instead of implied:
   actually deadlocks) into a deterministic detector (an inversion fails
   the moment either side of the bad ordering *runs*, on any schedule).
 
+- **The model-checker seam** (:func:`set_mc_factory`): the factory is
+  ALSO the instrumentation point for ``tools/tpumc``, the bounded
+  model checker for the journaled protocols. Under exploration
+  (``TPUSHARE_MC=1``, installed programmatically by the tpumc driver)
+  every ``make_lock``/``make_rlock``/``make_condition``/``make_event``
+  call returns a cooperative primitive whose acquire/release/wait/set
+  is a deterministic-scheduler yield point, so thread interleavings
+  become enumerable instead of whatever the OS happens to pick. The
+  factory still rank-validates first — the checker explores only lock
+  graphs the ranking admits.
+
 This module must stay import-light (stdlib only, no package imports):
 everything else in the package imports it to create locks.
 """
@@ -604,6 +615,35 @@ class _WitnessedLock:
         return f"<WitnessedLock {self._name} over {self._inner!r}>"
 
 
+# --- model-checker factory seam ---------------------------------------------
+
+# When installed (tools/tpumc), the factory functions below delegate
+# primitive construction here AFTER rank validation: the checker's
+# cooperative primitives replace threading's, and every acquire/release/
+# wait/set becomes a deterministic-scheduler yield point. None in
+# production — one module-global read on the construction path, nothing
+# on the acquire path.
+_mc_factory: Any | None = None
+
+
+def set_mc_factory(factory: Any | None) -> None:
+    """Install (or clear, with None) the model checker's primitive
+    factory. The object must expose ``lock(name)``, ``rlock(name)``,
+    ``condition(name)``, and ``event(name)``. Affects primitives created
+    from now on — the tpumc driver installs it before building a model's
+    harness, so every lock in the harness's object graph is cooperative,
+    while import-time singletons (metrics registry, fault table, trace
+    store) stay plain and therefore atomic to the explorer: near-leaf
+    telemetry chatter is not worth schedule-space."""
+    global _mc_factory
+    _mc_factory = factory
+
+
+def mc_active() -> bool:
+    """Whether primitives created now are model-checker cooperative."""
+    return _mc_factory is not None
+
+
 def make_lock(name: str) -> Any:
     """A non-reentrant mutex at the declared rank ``name``. The declared
     kind must match: handing out a plain Lock for a rank the static
@@ -614,6 +654,8 @@ def make_lock(name: str) -> Any:
         raise ValueError(
             f"{name} is declared {rank.kind}; use make_{rank.kind}"
         )
+    if _mc_factory is not None:
+        return _mc_factory.lock(name)
     if witness_enabled():
         return _WitnessedLock(name, threading.Lock(), reentrant=False)
     return threading.Lock()
@@ -627,6 +669,8 @@ def make_rlock(name: str) -> Any:
         raise ValueError(
             f"{name} is declared {rank.kind}; use make_{rank.kind}"
         )
+    if _mc_factory is not None:
+        return _mc_factory.rlock(name)
     if witness_enabled():
         return _WitnessedLock(name, threading.RLock(), reentrant=True)
     return threading.RLock()
@@ -640,11 +684,27 @@ def make_condition(name: str) -> threading.Condition:
         raise ValueError(
             f"{name} is declared {rank.kind}; use make_{rank.kind}"
         )
+    if _mc_factory is not None:
+        return _mc_factory.condition(name)
     if witness_enabled():
         return threading.Condition(
             _WitnessedLock(name, threading.RLock(), reentrant=True)
         )
     return threading.Condition()
+
+
+def make_event(name: str) -> Any:
+    """An event flag named for diagnostics. Events carry NO rank — they
+    are not mutual exclusion and impose no acquisition ordering, so the
+    witness has nothing to check — but they ARE scheduling: a ``wait``
+    parks a thread and a ``set`` releases it, which is exactly what the
+    model checker must control. The factory exists so protocol state
+    machines built on events (the serving engine's drain handshake)
+    construct them through the same seam as their locks and become fully
+    explorable under ``tools/tpumc``."""
+    if _mc_factory is not None:
+        return _mc_factory.event(name)
+    return threading.Event()
 
 
 def ordered(names: list[str]) -> Iterator[LockRank]:

@@ -22,6 +22,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from . import tracing
 from .lockrank import make_lock
+from .metric_catalog import BUILD_INFO as BUILD_INFO_GAUGE
 
 # Latency buckets (seconds): 0.5ms .. 10s, roughly log-spaced around the
 # observed allocate p50 of ~1.4ms.
@@ -261,7 +262,6 @@ class MetricsRegistry:
 # Process-wide default registry (the daemon's single plugin process).
 REGISTRY = MetricsRegistry()
 
-BUILD_INFO_GAUGE = "tpushare_build_info"
 _BUILD_FACTS: dict[str, str] | None = None  # computed once per process
 
 

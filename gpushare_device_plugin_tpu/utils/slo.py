@@ -46,6 +46,11 @@ import time
 from typing import Any, Callable
 
 from .lockrank import make_lock
+from .metric_catalog import (
+    SLO_BURN_RATE,
+    SLO_ERROR_BUDGET_REMAINING,
+    SLO_SEVERITY,
+)
 from .metrics import MetricsRegistry, REGISTRY
 
 SEVERITY_PAGE = "page"
@@ -337,18 +342,18 @@ class SloBudget:
                 ("5m", v.burn_5m), ("1h", v.burn_1h), ("6h", v.burn_6h)
             ):
                 reg.gauge_set(
-                    "tpushare_slo_burn_rate", burn,
+                    SLO_BURN_RATE, burn,
                     "Error-budget burn rate (miss fraction / allowed miss "
                     "fraction) over the trailing window",
                     tier=tier, window=window, **labels,
                 )
             reg.gauge_set(
-                "tpushare_slo_error_budget_remaining", v.budget_remaining,
+                SLO_ERROR_BUDGET_REMAINING, v.budget_remaining,
                 "Fraction of the 6h window's error budget still unspent",
                 tier=tier, **labels,
             )
             reg.gauge_set(
-                "tpushare_slo_severity",
+                SLO_SEVERITY,
                 2.0 if v.severity == SEVERITY_PAGE
                 else 1.0 if v.severity == SEVERITY_WARN else 0.0,
                 "Multi-window burn-rate severity (0 ok, 1 warn, 2 page)",

@@ -1,0 +1,19 @@
+"""metric-contract fixture: every shape the rule must flag."""
+
+from gpushare_device_plugin_tpu.utils.metric_catalog import (
+    CHECKPOINT_FENCED,
+    GANG2PC_TOTAL,
+)
+from gpushare_device_plugin_tpu.utils.metrics import REGISTRY
+
+# finding 1: a family name inlined outside the catalog module
+ROGUE = "tpushare_rogue_total"
+
+
+def emit_everything_wrong() -> None:
+    # finding 2: inline literal at the call site (and 3: undeclared family)
+    REGISTRY.counter_inc("tpushare_rogue_total", "help")
+    # finding 4: counter_inc on a family declared as a gauge
+    REGISTRY.counter_inc(CHECKPOINT_FENCED, "help")
+    # finding 5: label outside the declared set (phase/outcome)
+    REGISTRY.counter_inc(GANG2PC_TOTAL, "help", shard="shard-0")

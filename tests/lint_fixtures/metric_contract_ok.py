@@ -1,0 +1,20 @@
+"""metric-contract fixture: the canonical shapes the rule accepts."""
+
+from gpushare_device_plugin_tpu.utils.metric_catalog import (
+    ALLOCATE_SECONDS,
+    DEFRAG_STRANDED_PCT,
+    GANG2PC_TOTAL,
+)
+from gpushare_device_plugin_tpu.utils.metrics import REGISTRY
+
+
+def emit_by_the_book(pod_labels: dict) -> None:
+    REGISTRY.counter_inc(GANG2PC_TOTAL, "help", phase="prepare", outcome="ok")
+    REGISTRY.observe(ALLOCATE_SECONDS, 0.001, "help", resource="mem")
+    REGISTRY.gauge_set(DEFRAG_STRANDED_PCT, 1.0, "help")
+    # dynamic label pass-through is trusted (documented by the catalog)
+    REGISTRY.gauge_set(DEFRAG_STRANDED_PCT, 1.0, "help", **pod_labels)
+
+
+def read_by_the_book() -> float:
+    return REGISTRY.counter_value(GANG2PC_TOTAL, phase="prepare", outcome="ok")

@@ -1,0 +1,11 @@
+"""string-consts fixture: inline schema strings the rule must flag."""
+
+
+def read_gang(pod: dict) -> tuple[str, str]:
+    ann = pod.get("metadata", {}).get("annotations", {})
+    # finding: inline annotation key
+    shape = ann.get("tpushare.aliyun.com/gang-shape", "")
+    # finding: inline env-var names (both families)
+    idx = ann.get("ALIYUN_COM_TPU_MEM_IDX", "")
+    visible = "TPU_VISIBLE_CHIPS"
+    return shape, idx + visible

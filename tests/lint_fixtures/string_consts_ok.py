@@ -1,0 +1,15 @@
+"""string-consts fixture: schema strings referenced through const.py.
+
+A docstring may NAME a key like tpushare.aliyun.com/gang-shape in prose
+— docstrings are never findings (this one is the regression test).
+"""
+
+from gpushare_device_plugin_tpu import const
+
+
+def read_gang(pod: dict) -> tuple[str, str]:
+    """Reads ALIYUN_COM_TPU_MEM_IDX through the const, as required."""
+    ann = pod.get("metadata", {}).get("annotations", {})
+    shape = ann.get(const.ANN_GANG_SHAPE, "")
+    idx = ann.get(const.ENV_MEM_IDX, "")
+    return shape, idx

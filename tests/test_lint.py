@@ -172,6 +172,83 @@ def test_decision_rule_accepts_canonical_shapes():
     assert _rules([mod], "decision-outcome") == []
 
 
+# --- metric contract --------------------------------------------------------
+
+
+def test_metric_contract_flags_all_bad_shapes():
+    mod = _fixture("metric_contract_bad.py", PKG + "metric_contract_bad.py")
+    found = _fixture_findings(mod, "metric-contract")
+    messages = " | ".join(f.message for f in found)
+    assert len(found) == 5, found
+    assert "inline metric name literal" in messages
+    assert "not declared in" in messages
+    assert "declared a gauge" in messages
+    assert "outside its declared label set" in messages
+
+
+def test_metric_contract_accepts_canonical_shapes():
+    mod = _fixture("metric_contract_ok.py", PKG + "metric_contract_ok.py")
+    assert _fixture_findings(mod, "metric-contract") == []
+
+
+def test_metric_catalog_internally_consistent():
+    """Catalog sanity: names/types well-formed, counters follow the
+    ``_total`` convention, the CLI prefix consts actually prefix
+    declared families, and a declared-label emission round-trips a
+    scrape. (Exporter-vs-catalog agreement is the static rule's job —
+    tested above via test_tree_is_clean_under_every_rule.)"""
+    from gpushare_device_plugin_tpu.utils import metric_catalog as mc
+    from gpushare_device_plugin_tpu.utils.metrics import MetricsRegistry
+
+    assert mc.CATALOG, "catalog must not be empty"
+    for name, spec in mc.CATALOG.items():
+        assert spec.name == name
+        assert spec.type in ("counter", "gauge", "histogram"), spec
+        assert name.startswith("tpushare_")
+        if spec.type == "counter":
+            assert name.endswith("_total"), (
+                f"counter family {name} should end in _total"
+            )
+    # the prefix consts really are prefixes of declared families
+    for prefix in (mc.PREFIX_ENGINE, mc.PREFIX_SLO, mc.PREFIX_GOVERNOR):
+        assert any(n.startswith(prefix) for n in mc.CATALOG), prefix
+    # a labeled emission through the declared set round-trips a scrape
+    reg = MetricsRegistry()
+    reg.counter_inc(mc.GANG2PC_TOTAL, "help", phase="prepare", outcome="ok")
+    assert mc.GANG2PC_TOTAL in reg.render()
+
+
+# --- string consts ----------------------------------------------------------
+
+
+def test_string_consts_flags_inline_schema_strings():
+    mod = _fixture("string_consts_bad.py", PKG + "string_consts_bad.py")
+    found = _fixture_findings(mod, "string-consts")
+    assert len(found) == 3, found
+    messages = " | ".join(f.message for f in found)
+    assert "annotation key" in messages
+    assert "env-var name" in messages
+
+
+def test_string_consts_accepts_const_refs_and_docstrings():
+    mod = _fixture("string_consts_ok.py", PKG + "string_consts_ok.py")
+    assert _fixture_findings(mod, "string-consts") == []
+
+
+def test_string_consts_declared_twin_is_exempt_only_where_declared():
+    """The tracing module's import-light twin of ANN_TRACE_ID is
+    declared; the same literal in any other module is a finding."""
+    src = 'TRACE_ANNOTATION = "tpushare.aliyun.com/trace-id"\n'
+    twin = Module(
+        "gpushare_device_plugin_tpu/utils/tracing.py", src, ast.parse(src)
+    )
+    assert _rules([twin], "string-consts") == []
+    elsewhere = Module(
+        "gpushare_device_plugin_tpu/utils/elsewhere.py", src, ast.parse(src)
+    )
+    assert len(_rules([elsewhere], "string-consts")) == 1
+
+
 def test_decision_rule_exempts_decisions_module():
     """The decision log's own emit() primitive must not be held to the
     verb discipline."""

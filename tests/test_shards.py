@@ -126,11 +126,16 @@ def _build_shards(env) -> None:
 
 def restart_shards(env) -> None:
     """Simulate whole-deployment SIGKILL + restart: every checkpoint is
-    abandoned (queued bytes lost, handles dropped, nothing resolved) and
-    a fresh shard set is rebuilt over the same WAL files."""
+    abandoned (queued bytes lost, handles dropped, nothing resolved), the
+    in-memory coordinator lease dies with the process (so the restarted
+    reconciler sees no LIVE coordinators and rolls undecided prepares
+    back immediately — resolve_gang2pc's live-prepare gate only protects
+    a coordinator in THIS process's lease table), and a fresh shard set
+    is rebuilt over the same WAL files."""
     for ck in env.ckpts:
         if ck is not None:
             ck.abandon()
+    env.lease = LeaderLease()
     _build_shards(env)
 
 
@@ -544,6 +549,77 @@ def test_leader_fenced_mid_commit(tmp_path):
             )
         states = group_states(env.client, group)
         assert states and all(states), states
+        assert_2pc_drained(env)
+        assert S.audit_cluster(env.nodes, env.client.list_pods()) == []
+
+
+def _prepare_group_members(env, group: str):
+    """Drive the prepare phase by hand (the test's 'coordinator'):
+    returns (plan, coordinator_id, epoch) with every member prepared."""
+    pods = make_group(env, group, n_members=2)
+    plan, err = env.router._plan_group(pods)
+    assert err == ""
+    coordinator_id = env.router.ring.owner(f"gang-group:{group}")
+    epoch = env.lease.acquire(group, coordinator_id)
+    for member in plan:
+        shard = env.router.shard(member["shard"])
+        ok, reason = shard.prepare_gang(
+            group, member["ns"], member["name"], member["node"],
+            member["chips"], member["units"], member["shape"],
+            epoch, coordinator_id,
+        )
+        assert ok, reason
+    return plan, coordinator_id, epoch
+
+
+def test_resolve_skips_live_coordinators_young_prepare(tmp_path):
+    """A LIVE coordinator's young undecided prepare survives the
+    reconciler pass (the tpumc-found double-booking fix): its lease is
+    held and the record is younger than LIVE_PREPARE_GRACE_S, so the
+    resolver must neither release its reservations nor drain its
+    journal entries."""
+    with cross_shard_group_env(tmp_path) as env:
+        group = "xg-live"
+        plan, coordinator_id, epoch = _prepare_group_members(env, group)
+        counts = resolve_gang2pc(env.shards, env.client, lease=env.lease)
+        assert counts["skipped_live"] == 2, counts
+        assert counts["rolled_back"] == 0, counts
+        pending = sum(len(s.twopc_pending()) for s in env.shards)
+        assert pending == 2, "live prepares must stay journaled"
+        # the coordinator finishes its protocol normally (aborts here),
+        # forgets its lease, and the next pass drains everything
+        for member in plan:
+            env.router.shard(member["shard"]).abort_gang(
+                group, member["ns"], member["name"], epoch
+            )
+        env.lease.forget(group)
+        resolve_gang2pc(env.shards, env.client, lease=env.lease)
+        assert_2pc_drained(env)
+
+
+def test_wedged_coordinator_is_fenced_when_grace_expires(tmp_path, monkeypatch):
+    """A coordinator wedged past LIVE_PREPARE_GRACE_S between prepare
+    and decision is overridden AND fenced: the resolver rolls its
+    prepares back, seeds a higher epoch, and the late driver's
+    epoch-gated decision point raises StaleCoordinator — presumed abort
+    alone would let its durable decision roll forward onto chips a
+    competing group re-booked meanwhile."""
+    import gpushare_device_plugin_tpu.extender.shards as shards_mod
+
+    with cross_shard_group_env(tmp_path) as env:
+        group = "xg-wedge"
+        plan, coordinator_id, epoch = _prepare_group_members(env, group)
+        # the coordinator wedges: its prepare ages past the grace
+        monkeypatch.setattr(shards_mod, "LIVE_PREPARE_GRACE_S", 0.0)
+        counts = resolve_gang2pc(env.shards, env.client, lease=env.lease)
+        assert counts["rolled_back"] == 2, counts
+        assert counts["skipped_live"] == 0, counts
+        # the wedged driver wakes and reaches its decision point: the
+        # epoch gate (admit_gang_group runs the same check before
+        # journaling the decision) must fence it
+        coordinator = env.router.shard(coordinator_id)
+        with pytest.raises(StaleCoordinator):
+            coordinator._note_epoch(group, epoch)
         assert_2pc_drained(env)
         assert S.audit_cluster(env.nodes, env.client.list_pods()) == []
 

@@ -1,0 +1,292 @@
+"""The model checker's own correctness tests (tools/tpumc).
+
+The explorer is trusted CI infrastructure — `make mc-smoke` gates
+tier-1 — so its guarantees are pinned here:
+
+- **determinism/replay**: same schedule id ⇒ byte-identical transition
+  trace, across repeated replays and against the exploring run's own
+  trace;
+- **POR soundness**: sleep-set reduction never prunes a violation a
+  full enumeration flags (identical violation sets, strictly fewer
+  schedules);
+- **preemption-bound monotonicity**: every violating schedule found at
+  bound k is found again at k+1 (and the count never shrinks);
+- **bound semantics**: the classic read-modify-write race needs
+  exactly one preemption — invisible at k=0, found at k>=1;
+- **deadlock detection**: a lock-order inversion model terminates with
+  a deadlock violation instead of hanging;
+- **seeded-defect sensitivity**: the checker finds the known
+  lost-capture drain bug and a disabled move-protocol re-validation —
+  the harnesses are not vacuously green.
+"""
+
+from __future__ import annotations
+
+from types import SimpleNamespace
+from typing import Any, Callable
+from unittest import mock
+
+import pytest
+
+from tools.tpumc.explore import (
+    Explorer,
+    decode_schedule_id,
+    encode_schedule_id,
+    independent,
+)
+from tools.tpumc.models import DrainModel, RacyCounterModel, get_model
+from tools.tpumc.sched import InvariantViolation, mc_step
+
+
+class _Harness:
+    def __init__(self, tasks: list, check: Callable[[], None]) -> None:
+        self.tasks = tasks
+        self._check = check
+
+    def check(self) -> None:
+        self._check()
+
+
+class MiniIndepModel:
+    """One independent lock user + the racy pair: small enough that the
+    FULL (POR-off) exhaustive enumeration stays test-sized, while POR
+    still has independent chatter to prune."""
+
+    name = "mini-indep"
+
+    def build(self) -> _Harness:
+        from tools.tpumc.sched import active_scheduler
+
+        sched = active_scheduler()
+        assert sched is not None
+        lock = sched.factory().lock("model.solo")
+        cells = {"a": 0, "v": 0}
+
+        def indep() -> None:
+            with lock:
+                cells["a"] += 1
+
+        def racy() -> None:
+            mc_step("read")
+            tmp = cells["v"]
+            mc_step("write")
+            cells["v"] = tmp + 1
+
+        def check() -> None:
+            if cells["a"] != 1:
+                raise InvariantViolation(f"solo counter: {cells}")
+            if cells["v"] != 2:
+                raise InvariantViolation(f"lost update: v={cells['v']}")
+
+        return _Harness(
+            [("ia", indep), ("r1", racy), ("r2", racy)], check
+        )
+
+
+class DeadlockModel:
+    """Two threads acquiring two locks in opposite orders — the checker
+    must detect the cycle as a deadlock violation, not hang."""
+
+    name = "deadlock"
+
+    def build(self) -> _Harness:
+        from tools.tpumc.sched import active_scheduler
+
+        sched = active_scheduler()
+        assert sched is not None
+        factory = sched.factory()
+        la, lb = factory.lock("model.a"), factory.lock("model.b")
+
+        def ab() -> None:
+            with la:
+                with lb:
+                    pass
+
+        def ba() -> None:
+            with lb:
+                with la:
+                    pass
+
+        return _Harness([("ab", ab), ("ba", ba)], lambda: None)
+
+
+def _violation_traces(result: Any) -> set[str]:
+    return {v.trace for v in result.violations}
+
+
+# --- schedule ids -----------------------------------------------------------
+
+
+def test_schedule_id_roundtrip():
+    sid = encode_schedule_id("gang2pc", 2, [0, 1, 0, 35])
+    assert decode_schedule_id(sid) == ("gang2pc", 2, [0, 1, 0, 35])
+    sid_inf = encode_schedule_id("drain-handshake", None, [])
+    assert decode_schedule_id(sid_inf) == ("drain-handshake", None, [])
+    with pytest.raises(ValueError):
+        decode_schedule_id("not-a-schedule")
+
+
+def test_independence_relation_shape():
+    # sync ops on different objects commute; same object conflicts
+    assert independent(("acquire", "a"), ("acquire", "b"))
+    assert not independent(("acquire", "a"), ("acquire", "a"))
+    assert not independent(("evt_set", "e"), ("evt_wait", "e"))
+    # protocol fire points and model steps conflict with everything
+    assert not independent(("fire", "checkpoint.begin"), ("acquire", "a"))
+    assert not independent(("step", "x"), ("step", "y"))
+    # starting a thread has no effect
+    assert independent(("start", "t0"), ("fire", "defrag.plan"))
+
+
+# --- determinism / replay ---------------------------------------------------
+
+
+def test_same_schedule_id_replays_byte_identical_trace():
+    ex = Explorer(DrainModel(broken=True), k=1)
+    result = ex.explore()
+    assert result.violations, "the seeded drain bug must be found at k=1"
+    v = result.violations[0]
+    first = ex.replay(v.schedule_id)
+    second = ex.replay(v.schedule_id)
+    assert first.trace == second.trace == v.trace
+    assert first.violation is not None
+    assert first.violation.kind == "invariant"
+    assert "lost" in first.violation.message
+
+
+def test_clean_schedule_replays_clean():
+    ex = Explorer(RacyCounterModel(), k=0)
+    result = ex.explore()
+    assert not result.violations
+    # replay the non-preemptive spine: still clean, still deterministic
+    outcome = ex.run_one([], collect_trace=True)
+    replayed = ex.replay(outcome.schedule_id)
+    assert replayed.violation is None
+    assert replayed.trace == outcome.trace
+
+
+# --- preemption bound -------------------------------------------------------
+
+
+def test_racy_counter_needs_exactly_one_preemption():
+    assert not Explorer(RacyCounterModel(), k=0).explore().violations
+    r1 = Explorer(RacyCounterModel(), k=1).explore()
+    assert r1.violations
+    assert all(v.kind == "invariant" for v in r1.violations)
+
+
+def test_bound_monotonicity_violations_found_at_k_survive_k_plus_1():
+    """Every violating schedule (by transition trace) found at bound k
+    is found again at k+1, for both seeded-bug models."""
+    for model_fn in (
+        lambda: RacyCounterModel(),
+        lambda: DrainModel(broken=True),
+    ):
+        previous: set[str] = set()
+        for k in (0, 1, 2):
+            result = Explorer(model_fn(), k=k).explore()
+            traces = _violation_traces(result)
+            missing = previous - traces
+            assert not missing, (
+                f"k={k} lost {len(missing)} violating schedule(s) "
+                f"found at k={k - 1}"
+            )
+            previous = traces
+
+
+# --- partial-order reduction ------------------------------------------------
+
+
+def test_por_never_prunes_a_violation_full_enumeration_flags():
+    full = Explorer(MiniIndepModel(), k=None, por=False).explore()
+    por = Explorer(MiniIndepModel(), k=None, por=True).explore()
+    full_v = {(v.kind, v.message) for v in full.violations}
+    por_v = {(v.kind, v.message) for v in por.violations}
+    assert full_v, "the mini model must have a reachable violation"
+    assert por_v == full_v, (
+        f"POR changed the violation set: full={full_v} por={por_v}"
+    )
+    assert por.schedules < full.schedules, (
+        "POR explored no fewer schedules — the reduction is vacuous"
+    )
+
+
+def test_por_keeps_clean_models_clean():
+    for por in (False, True):
+        result = Explorer(DrainModel(), k=None, por=por).explore()
+        assert not result.violations, [v.brief() for v in result.violations]
+
+
+# --- deadlock detection -----------------------------------------------------
+
+
+def test_lock_cycle_reported_as_deadlock_not_hang():
+    result = Explorer(DeadlockModel(), k=2, por=False).explore()
+    kinds = {v.kind for v in result.violations}
+    assert "deadlock" in kinds, [v.brief() for v in result.violations]
+
+
+# --- seeded-defect sensitivity (the harnesses are not vacuous) --------------
+
+
+def test_checker_finds_seeded_drain_lost_capture_bug():
+    result = Explorer(DrainModel(broken=True), k=1).explore()
+    assert any(
+        v.kind == "invariant" and "lost" in v.message
+        for v in result.violations
+    ), [v.brief() for v in result.violations]
+
+
+def test_checker_finds_move_overcommit_when_revalidation_disabled():
+    from gpushare_device_plugin_tpu.allocator.defrag import SliceMover
+
+    with mock.patch.object(SliceMover, "_dst_fits", lambda self, plan: True):
+        result = Explorer(get_model("move"), k=1).explore()
+    assert any("overcommitted" in v.message for v in result.violations), [
+        v.brief() for v in result.violations
+    ]
+
+
+def test_live_resolve_rollback_defect_found_pinned_and_fixed():
+    """The real ordering defect tpumc found (and this PR fixed): the
+    live resolve loop used to run WITHOUT the coordinator lease
+    (pre-fix ``shards.main``), so it presumed-aborted a LIVE
+    coordinator's undecided prepare; a competing group booked the freed
+    chip, and the first group's durable decision rolled forward on top
+    — cross-shard double-booking through the reconciler.
+
+    Pinned three ways: the ungated wiring still reproduces the
+    violation (the model is not vacuous); the violating schedule
+    replays deterministically by id; and the fixed wiring (shared
+    lease + ``LIVE_PREPARE_GRACE_S`` gate in ``resolve_gang2pc``) is
+    clean at the same bound."""
+    ungated = Explorer(get_model("gang2pc-resolve-ungated"), k=1).explore()
+    over = [v for v in ungated.violations if "overcommitted" in v.message]
+    assert over, (
+        "the ungated model no longer reproduces the defect — if the "
+        "resolver's rollback became unconditionally safe, retire this "
+        "pin; otherwise the model lost the race"
+    )
+    replayed = Explorer(
+        get_model("gang2pc-resolve-ungated"), k=1
+    ).replay(over[0].schedule_id)
+    assert replayed.violation is not None
+    assert "overcommitted" in replayed.violation.message
+    assert replayed.trace == over[0].trace
+    gated = Explorer(get_model("gang2pc-resolve"), k=1).explore()
+    assert gated.violations == [], [v.brief() for v in gated.violations]
+
+
+def test_checker_finds_gang_overcommit_when_prepare_check_disabled():
+    from gpushare_device_plugin_tpu.extender.server import ExtenderCore
+
+    def blind_view(self: Any, node: Any, resource: Any) -> Any:
+        return SimpleNamespace(
+            core_held=set(), used={}, capacity={0: 10**6, 1: 10**6}
+        )
+
+    with mock.patch.object(ExtenderCore, "node_view", blind_view):
+        result = Explorer(get_model("gang2pc"), k=1).explore()
+    assert any("overcommitted" in v.message for v in result.violations), [
+        v.brief() for v in result.violations
+    ]

@@ -29,6 +29,14 @@ here are *project-specific theorems*, not generic style checks:
 - ``ledger-encapsulation`` (rules_encapsulation): the AssumeCache /
   ClusterUsageIndex / NodeChipUsage internals are mutated only inside
   their own modules — the exact class of bug PR 6's gang storms caught.
+- ``metric-contract`` (rules_metrics): every ``tpushare_*`` metric
+  family is declared once in ``utils/metric_catalog.py`` (name, type,
+  label set); exporters and the CLI parsers reference catalog consts,
+  call kinds match declared types, and call-site labels stay inside
+  the declared label set.
+- ``string-consts`` (rules_strconsts): ``tpushare.aliyun.com/*``
+  annotation keys and ``ALIYUN_COM_*``/``TPU_*`` env names are declared
+  in ``const.py`` only — inline schema strings drift silently.
 - ``hygiene`` (rules_hygiene): threaded-daemon hygiene — no broad
   except-pass swallows, no unbounded queues, no long blind sleeps in
   tests.
@@ -142,6 +150,23 @@ def load_modules(
     return modules
 
 
+def docstring_constants(tree: ast.Module) -> set[int]:
+    """ids of Constant nodes in docstring position — shared by rules
+    that scan string literals (docstrings are prose, never findings)."""
+    out: set[int] = set()
+    for node in ast.walk(tree):
+        body = getattr(node, "body", None)
+        if not isinstance(
+            node, (ast.Module, ast.FunctionDef, ast.AsyncFunctionDef,
+                   ast.ClassDef)
+        ) or not body:
+            continue
+        first = body[0]
+        if isinstance(first, ast.Expr) and isinstance(first.value, ast.Constant):
+            out.add(id(first.value))
+    return out
+
+
 RuleFn = Callable[[list[Module]], list[Finding]]
 
 
@@ -152,8 +177,10 @@ def _registry() -> dict[str, RuleFn]:
         rules_encapsulation,
         rules_hygiene,
         rules_locks,
+        rules_metrics,
         rules_pyflakes_lite,
         rules_spans,
+        rules_strconsts,
         rules_wal,
     )
 
@@ -165,6 +192,8 @@ def _registry() -> dict[str, RuleFn]:
         "span-leak": rules_spans.check_span_leak,
         "decision-outcome": rules_decisions.check_decision_outcomes,
         "ledger-encapsulation": rules_encapsulation.check_encapsulation,
+        "metric-contract": rules_metrics.check_metric_contract,
+        "string-consts": rules_strconsts.check_string_consts,
         "hygiene": rules_hygiene.check_hygiene,
         "unused-import": rules_pyflakes_lite.check_unused_imports,
         "unused-local": rules_pyflakes_lite.check_unused_locals,

@@ -1,0 +1,234 @@
+"""metric-contract rule: every ``tpushare_*`` family is declared once.
+
+The catalog (``gpushare_device_plugin_tpu/utils/metric_catalog.py``)
+declares each family's name, exposition type, and allowed label set.
+This rule closes the exporter/parser drift loop statically:
+
+1. a ``tpushare_*`` name literal anywhere in the package OUTSIDE the
+   catalog module is a finding — exporters and the CLI parsers must
+   reference catalog consts, so renames are one-line and lint-checked;
+2. a metric call (``counter_inc``/``gauge_set``/``observe``, the
+   programmatic readers, ``timed_acquire``) whose resolved family name
+   is not in the catalog is a finding (an undeclared family is
+   invisible to the contract);
+3. the call kind must agree with the declared type (``counter_inc`` on
+   a gauge family is the drift this rule exists for);
+4. explicit label keywords at the call site must be a subset of the
+   declared label set (``**labels`` pass-throughs are dynamic and
+   trusted — the declared set documents them).
+
+Name resolution follows assignments and ``from ... import`` chains, so
+``REGISTRY.gauge_value(STRANDED_PCT_GAUGE)`` where the const was
+imported from another module that imported it from the catalog still
+resolves. Tests and bench drivers are out of scope (they are consumers
+and synthetic emitters, not the exported contract); lint fixtures are
+excluded by the engine.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .engine import Finding, Module, docstring_constants
+
+CATALOG_PATH = "gpushare_device_plugin_tpu/utils/metric_catalog.py"
+
+RULE = "metric-contract"
+
+# Call attr -> required exposition type (None = any declared family).
+EMIT_KINDS = {
+    "counter_inc": "counter",
+    "gauge_set": "gauge",
+    "observe": "histogram",
+    "counter_value": "counter",
+    "gauge_value": "gauge",
+    "gauge_series": "gauge",
+    "histogram_stats": "histogram",
+    "histogram_quantile": "histogram",
+    "exemplar": None,
+}
+
+# Keywords on metric calls that are NOT labels.
+NON_LABEL_KW = frozenset({"help_text", "value", "buckets", "registry"})
+
+NAME_RE = re.compile(r"^tpushare_[a-z0-9_]+$")
+TYPE_NAMES = {"COUNTER": "counter", "GAUGE": "gauge", "HISTOGRAM": "histogram"}
+
+
+def _literal_assigns(tree: ast.Module) -> dict[str, str]:
+    """Module-level ``NAME = "tpushare_..."`` bindings."""
+    out: dict[str, str] = {}
+    for node in tree.body:
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and isinstance(node.value, ast.Constant)
+            and isinstance(node.value.value, str)
+            and node.value.value.startswith("tpushare")
+        ):
+            out[node.targets[0].id] = node.value.value
+    return out
+
+
+def _parse_catalog(
+    mod: Module,
+) -> tuple[dict[str, str], dict[str, tuple[str, frozenset[str]]]]:
+    """(const name -> family literal, family -> (type, labels))."""
+    consts = _literal_assigns(mod.tree)
+    specs: dict[str, tuple[str, frozenset[str]]] = {}
+    for node in ast.walk(mod.tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "_m"
+            and node.args
+        ):
+            continue
+        a0 = node.args[0]
+        if isinstance(a0, ast.Constant):
+            name = str(a0.value)
+        elif isinstance(a0, ast.Name):
+            name = consts.get(a0.id, "")
+        else:
+            continue
+        mtype = ""
+        if len(node.args) > 1 and isinstance(node.args[1], ast.Name):
+            mtype = TYPE_NAMES.get(node.args[1].id, "")
+        labels = frozenset(
+            str(a.value) for a in node.args[2:]
+            if isinstance(a, ast.Constant)
+        )
+        if name and mtype:
+            specs[name] = (mtype, labels)
+    return consts, specs
+
+
+def _resolve_bindings(
+    modules: list[Module], catalog_consts: dict[str, str]
+) -> dict[str, dict[str, str]]:
+    """Per-module name -> family-literal maps, following import chains
+    (three passes cover catalog -> exporter -> consumer re-exports)."""
+    bindings: dict[str, dict[str, str]] = {CATALOG_PATH: dict(catalog_consts)}
+    for mod in modules:
+        local = bindings.setdefault(mod.path, {})
+        local.update(_literal_assigns(mod.tree))
+    for _pass in range(3):
+        global_names: dict[str, str] = {}
+        for per_mod in bindings.values():
+            for name, lit in per_mod.items():
+                global_names.setdefault(name, lit)
+        for mod in modules:
+            local = bindings[mod.path]
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.ImportFrom):
+                    continue
+                for alias in node.names:
+                    lit = global_names.get(alias.name)
+                    if lit is not None:
+                        local.setdefault(alias.asname or alias.name, lit)
+    return bindings
+
+
+def _metric_name(
+    arg: ast.expr, local: dict[str, str]
+) -> str | None:
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value if arg.value.startswith("tpushare") else None
+    if isinstance(arg, ast.Name):
+        lit = local.get(arg.id)
+        return lit if lit and lit.startswith("tpushare") else None
+    return None
+
+
+def check_metric_contract(modules: list[Module]) -> list[Finding]:
+    findings: list[Finding] = []
+    catalog = next((m for m in modules if m.path == CATALOG_PATH), None)
+    if catalog is None:
+        return [Finding(
+            CATALOG_PATH, 0, RULE,
+            "metric catalog module missing — the metric contract has no "
+            "declaration point",
+        )]
+    consts, specs = _parse_catalog(catalog)
+    bindings = _resolve_bindings(modules, consts)
+    for mod in modules:
+        if not mod.in_package:
+            continue
+        local = bindings.get(mod.path, {})
+        docstrings = docstring_constants(mod.tree)
+        for node in ast.walk(mod.tree):
+            # 1) inline family literals outside the catalog
+            if (
+                isinstance(node, ast.Constant)
+                and isinstance(node.value, str)
+                and NAME_RE.match(node.value)
+                and id(node) not in docstrings
+                and mod.path != CATALOG_PATH
+            ):
+                findings.append(Finding(
+                    mod.path, node.lineno, RULE,
+                    f"inline metric name literal {node.value!r} — import "
+                    "the const from utils/metric_catalog.py (the single "
+                    "declaration point for every tpushare_* family)",
+                ))
+            if not isinstance(node, ast.Call):
+                continue
+            # 2-4) metric calls against the contract
+            name: str | None = None
+            required: str | None = None
+            label_kws: list[str] = []
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in EMIT_KINDS
+                and node.args
+            ):
+                name = _metric_name(node.args[0], local)
+                required = EMIT_KINDS[node.func.attr]
+                label_kws = [
+                    kw.arg for kw in node.keywords
+                    if kw.arg is not None and kw.arg not in NON_LABEL_KW
+                ]
+            elif (
+                (
+                    isinstance(node.func, ast.Name)
+                    and node.func.id == "timed_acquire"
+                )
+                or (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "timed_acquire"
+                )
+            ) and len(node.args) >= 2:
+                name = _metric_name(node.args[1], local)
+                required = "histogram"
+                label_kws = [
+                    kw.arg for kw in node.keywords
+                    if kw.arg is not None and kw.arg not in NON_LABEL_KW
+                ]
+            if name is None:
+                continue
+            spec = specs.get(name)
+            if spec is None:
+                findings.append(Finding(
+                    mod.path, node.lineno, RULE,
+                    f"metric family {name!r} is not declared in "
+                    "utils/metric_catalog.py (name, type, label set)",
+                ))
+                continue
+            mtype, allowed = spec
+            if required is not None and mtype != required:
+                findings.append(Finding(
+                    mod.path, node.lineno, RULE,
+                    f"{name!r} is declared a {mtype} but this call emits/"
+                    f"reads it as a {required}",
+                ))
+            extra = [kw for kw in label_kws if kw not in allowed]
+            if extra:
+                findings.append(Finding(
+                    mod.path, node.lineno, RULE,
+                    f"label(s) {sorted(extra)} on {name!r} are outside its "
+                    f"declared label set {sorted(allowed)} — scrapes and "
+                    "the CLI parsers key on the declared labels",
+                ))
+    return findings

@@ -1,0 +1,77 @@
+"""string-consts rule: the apiserver-facing vocabulary lives in const.py.
+
+"The apiserver is the database" makes annotation keys and injected env
+names the schema of this system: ``tpushare.aliyun.com/*`` annotation
+keys and the ``ALIYUN_COM_*``/``TPU_*`` env-var family are read back by
+the informer indexes, the reconciler, the inspect CLI, and the pod-side
+runtime. A key inlined at one of those sites can drift from the writer's
+spelling and the failure is silent — the annotation simply never
+matches. ``const.py`` is the declaration point; this rule flags any
+inline literal of those shapes elsewhere in the package.
+
+Exemptions, each with a reason the rule encodes rather than waives:
+
+- ``const.py`` itself (the declarations);
+- docstrings (prose, not keys);
+- declared twins in :data:`DECLARED_TWINS` — ``utils/tracing.py`` must
+  stay import-light (everything imports it to trace), so it carries a
+  duplicate of ``const.ANN_TRACE_ID`` that ``test_tracing`` pins equal;
+  the twin is *declared* here so a third copy is still a finding;
+- tests and fixtures (they construct adversarial/garbled keys on
+  purpose) — out of scope via the package filter.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .engine import Finding, Module, docstring_constants
+
+RULE = "string-consts"
+
+CONST_PATH = "gpushare_device_plugin_tpu/const.py"
+
+ANNOTATION_RE = re.compile(r"^tpushare\.aliyun\.com/[A-Za-z0-9._/-]+$")
+ENV_RE = re.compile(r"^(ALIYUN_COM|TPU)_[A-Z0-9_]+$")
+
+# (module path, literal) pairs that are deliberate, test-pinned twins.
+DECLARED_TWINS = frozenset({
+    # tracing must stay import-light (no package imports); test_tracing
+    # pins this equal to const.ANN_TRACE_ID
+    ("gpushare_device_plugin_tpu/utils/tracing.py",
+     "tpushare.aliyun.com/trace-id"),
+})
+
+
+def check_string_consts(modules: list[Module]) -> list[Finding]:
+    findings: list[Finding] = []
+    for mod in modules:
+        if not mod.in_package or mod.path == CONST_PATH:
+            continue
+        docstrings = docstring_constants(mod.tree)
+        for node in ast.walk(mod.tree):
+            if not (
+                isinstance(node, ast.Constant)
+                and isinstance(node.value, str)
+            ):
+                continue
+            value = node.value
+            if id(node) in docstrings:
+                continue
+            if not (ANNOTATION_RE.match(value) or ENV_RE.match(value)):
+                continue
+            if (mod.path, value) in DECLARED_TWINS:
+                continue
+            kind = (
+                "annotation key" if value.startswith("tpushare.")
+                else "env-var name"
+            )
+            findings.append(Finding(
+                mod.path, node.lineno, RULE,
+                f"inline {kind} literal {value!r} — declare it in "
+                "const.py and reference the const (inlined schema "
+                "strings drift silently; the reader just stops "
+                "matching)",
+            ))
+    return findings

@@ -1,0 +1,57 @@
+"""tpumc: exhaustive-interleaving model checker for the journaled protocols.
+
+The control plane's hardest bugs — the gang double-booking, the
+annotation-before-bind visibility race, the drain-handshake lost-snapshot
+cases — were all *ordering* bugs that chaos found one schedule at a
+time: ``make chaos-move``/``chaos-shard`` kill at every journal step but
+execute only the one thread interleaving the OS happens to pick. tpumc
+turns "chaos got lucky" into "all interleavings up to k preemptions are
+proven clean, and violations replay deterministically":
+
+- :mod:`.sched` — a deterministic cooperative scheduler. It hijacks the
+  ``utils/lockrank.py`` factory seam (every lock in the package is
+  already constructed through ``make_lock``/``make_rlock``/
+  ``make_condition``/``make_event``) and the ``utils/faults.py`` fire
+  hook, so under ``TPUSHARE_MC=1`` every acquire/release/wait, every
+  fault-injection crash site, and every ``checkpoint.begin/commit/
+  abort`` becomes a yield point, and exactly one model thread runs
+  between yield points.
+- :mod:`.explore` — CHESS/DPOR-style stateless DFS over schedules:
+  partial-order reduction by sleep sets over a conservative independence
+  relation (sound under the repo's locking discipline), and a
+  preemption bound (k=2 default; k=∞ exhausts the smoke-sized models).
+- :mod:`.models` — small-model harnesses for the three journaled
+  protocols: gang-2PC prepare/decide/resolve (``extender/shards.py``),
+  the defrag move protocol (``allocator/defrag.py``), and the engine
+  drain handshake (``serving/drainproto.py``), each with the repo's
+  standing invariants checked at every terminal state.
+- :mod:`.memwal` — an ``AllocationCheckpoint``-compatible in-memory WAL
+  so thousands of schedules re-run without touching a disk (the journal
+  fault points still fire, so WAL steps stay yield points).
+
+A violation dumps a replayable schedule id; ``python -m tools.tpumc
+replay <id>`` re-executes the exact interleaving under the tracer and
+flight recorder, so counterexamples are first-class artifacts instead of
+flaky CI logs. ``docs/analysis.md`` documents the yield-point taxonomy,
+the independence relation, the preemption-bound semantics, and the
+replay workflow; ``make mc`` / ``make mc-smoke`` are the CI entries.
+"""
+
+from .explore import ExploreResult, Explorer, SCHEDULE_ID_PREFIX, Violation
+from .sched import (
+    InvariantViolation,
+    MCScheduler,
+    mc_session,
+    mc_step,
+)
+
+__all__ = [
+    "ExploreResult",
+    "Explorer",
+    "InvariantViolation",
+    "MCScheduler",
+    "SCHEDULE_ID_PREFIX",
+    "Violation",
+    "mc_session",
+    "mc_step",
+]

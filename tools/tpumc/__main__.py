@@ -1,0 +1,130 @@
+"""``python -m tools.tpumc``: run the model checker / replay a schedule.
+
+Subcommands:
+
+- ``run [--model NAME | --suite smoke|full] [--k N|inf] [--por on|off]
+  [--max-schedules N]`` — explore and report: schedule counts, prunes,
+  violations (each with its replayable schedule id). Exit 1 on any
+  violation.
+- ``replay <schedule-id> [--dump PATH]`` — re-execute one exact
+  interleaving under the tracer + flight recorder: prints the full
+  transition trace, re-raises the violation verdict, and dumps a flight
+  record (logs + trace spans) next to it, so a counterexample is a
+  first-class artifact instead of a flaky CI log.
+- ``list`` — the model registry.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .explore import Explorer, decode_schedule_id
+from .models import FULL_SUITE, SMOKE_SUITE, get_model
+
+
+def _parse_k(raw: str) -> int | None:
+    return None if raw in ("inf", "none", "") else int(raw)
+
+
+def _run(args: argparse.Namespace) -> int:
+    if args.model:
+        suite = [(args.model, _parse_k(args.k))]
+    else:
+        suite = list(SMOKE_SUITE if args.suite == "smoke" else FULL_SUITE)
+        if args.k:
+            suite = [(name, _parse_k(args.k)) for name, _k in suite]
+    por = None if args.por == "auto" else (args.por == "on")
+    total = 0
+    failed = False
+    for name, k in suite:
+        model = get_model(name)
+        explorer = Explorer(
+            model, k=k, por=por, max_schedules=args.max_schedules,
+            stop_on_violation=args.stop_on_violation,
+        )
+        result = explorer.explore()
+        total += result.schedules
+        print(result.summary())
+        for v in result.violations:
+            failed = True
+            print(f"  VIOLATION {v.brief()}")
+            print(f"  replay with: python -m tools.tpumc replay {v.schedule_id}")
+        if result.truncated:
+            failed = True  # a truncated exploration proves nothing
+    print(f"tpumc: {total} schedule(s) explored across {len(suite)} model(s)")
+    return 1 if failed else 0
+
+
+def _replay(args: argparse.Namespace) -> int:
+    from gpushare_device_plugin_tpu.utils.flightrec import FlightRecorder
+    from gpushare_device_plugin_tpu.utils.tracing import TRACER
+
+    model_name, k, _choices = decode_schedule_id(args.schedule_id)
+    model = get_model(model_name)
+    # counterexamples replay under full observability: every span
+    # sampled, the flight recorder capturing logs from the replayed
+    # protocol code, one dump per replay
+    recorder = FlightRecorder()
+    recorder.install(directory=args.dump_dir)
+    TRACER.configure(sample_ratio=1.0)
+    explorer = Explorer(model, k=k)
+    try:
+        with TRACER.span("tpumc.replay", attributes={
+            "schedule_id": args.schedule_id, "model": model_name,
+        }):
+            outcome = explorer.replay(args.schedule_id)
+    finally:
+        dump_path = recorder.dump(f"tpumc replay {args.schedule_id}")
+        recorder.uninstall()
+    print(f"# replay {args.schedule_id}")
+    print(f"# model={model_name} k={'inf' if k is None else k} "
+          f"preemptions={outcome.preemptions}")
+    print(outcome.trace)
+    print(f"# flight record: {dump_path}")
+    if outcome.violation is not None:
+        print(f"VIOLATION [{outcome.violation.kind}] "
+              f"{outcome.violation.message}")
+        return 1
+    print("clean: no violation on this schedule")
+    return 0
+
+
+def _list_models(_args: argparse.Namespace) -> int:
+    from .models import MODELS
+
+    for name in sorted(MODELS):
+        print(name)
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="tpumc", description=__doc__)
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    run_p = sub.add_parser("run", help="explore a model or a suite")
+    run_p.add_argument("--model", default="", help="one model by name")
+    run_p.add_argument("--suite", default="smoke", choices=["smoke", "full"])
+    run_p.add_argument("--k", default="", help="preemption bound (int or 'inf')")
+    run_p.add_argument("--por", default="auto", choices=["auto", "on", "off"])
+    run_p.add_argument("--max-schedules", type=int, default=None)
+    run_p.add_argument("--stop-on-violation", action="store_true")
+    run_p.set_defaults(fn=_run)
+
+    replay_p = sub.add_parser("replay", help="re-execute one schedule id")
+    replay_p.add_argument("schedule_id")
+    replay_p.add_argument(
+        "--dump-dir", default="/tmp/tpumc",
+        help="directory for the replay's flight-record dump",
+    )
+    replay_p.set_defaults(fn=_replay)
+
+    list_p = sub.add_parser("list", help="list models")
+    list_p.set_defaults(fn=_list_models)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,480 @@
+"""Stateless DFS over schedules: CHESS-style preemption bounding plus
+sleep-set partial-order reduction.
+
+Exploration is *stateless*: every schedule re-runs the model's harness
+from scratch under a forced prefix of choices, then continues with the
+deterministic default policy (stay on the current thread while it is
+enabled — the non-preemptive spine — else lowest task id). Determinism
+is asserted, not assumed: a forced prefix must reproduce the exact
+enabled sets and pending operations of the run that created it, or the
+explorer aborts loudly (a model reading wall-clock control flow would
+corrupt the search silently otherwise).
+
+**Preemption bound** (``k``): switching away from a thread that is still
+enabled is a preemption; schedules may use at most ``k``. Bounding is
+CHESS's result — most concurrency bugs need very few preemptions, and
+the schedule count stays polynomial. ``k=None`` means unbounded
+(exhaustive), which the smoke-sized models use.
+
+**Sleep sets** (``por=True``): after exploring child ``t1`` of a state,
+its siblings need not re-explore schedules that begin with a transition
+independent of everything that distinguishes them — ``t1`` "sleeps"
+until a dependent operation executes. The independence relation is
+deliberately conservative: two operations commute only when BOTH are
+synchronization operations on DIFFERENT named objects; fault/protocol
+fire points and model steps conflict with everything. That is sound for
+this codebase because the locking discipline (tpulint + the runtime
+witness) keeps cross-thread state behind the instrumented locks — see
+docs/analysis.md for the argument, and the explorer self-tests for the
+empirical check (POR on vs off finds identical violation sets). Sleep
+sets compose safely with ``k=None``; with a finite bound the two
+prunings can interact (a trace's only ≤k representative may be slept),
+so bounded runs default POR **off** and exhaustive runs default it on.
+
+A schedule id encodes the model, the bound, and the base-36 task id
+chosen at every decision point — ``tpumc:<model>:<k>:<digits>`` — and
+:func:`Explorer.replay` re-executes it choice for choice.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable
+
+from .sched import (
+    DeadlockDetected,
+    InvariantViolation,
+    MCScheduler,
+    Op,
+    Task,
+    mc_session,
+)
+
+SCHEDULE_ID_PREFIX = "tpumc:"
+
+_B36 = "0123456789abcdefghijklmnopqrstuvwxyz"
+
+# Operation kinds that are pure synchronization on a named object; two of
+# these on DIFFERENT objects commute. Everything else (fire points =
+# journal/protocol steps, model steps, harness exceptions) conservatively
+# conflicts with everything.
+_SYNC_KINDS = frozenset({
+    "acquire", "reacquire", "release",
+    "evt_wait", "evt_wait_timed", "evt_set", "evt_clear",
+    "cond_wait", "cond_wait_timed", "cond_notify",
+})
+
+
+def independent(a: Op, b: Op) -> bool:
+    """Whether two transitions commute (the POR relation)."""
+    if a[0] == "start" or b[0] == "start":
+        return True  # starting a thread has no effect
+    if a[0] in _SYNC_KINDS and b[0] in _SYNC_KINDS:
+        return a[1] != b[1]
+    return False
+
+
+def encode_schedule_id(model: str, k: int | None, choices: list[int]) -> str:
+    kk = "inf" if k is None else str(k)
+    return SCHEDULE_ID_PREFIX + f"{model}:{kk}:" + "".join(
+        _B36[c] for c in choices
+    )
+
+
+def decode_schedule_id(schedule_id: str) -> tuple[str, int | None, list[int]]:
+    if not schedule_id.startswith(SCHEDULE_ID_PREFIX):
+        raise ValueError(f"not a tpumc schedule id: {schedule_id!r}")
+    body = schedule_id[len(SCHEDULE_ID_PREFIX):]
+    model, _, rest = body.partition(":")
+    kk, _, digits = rest.partition(":")
+    if not model or not kk:
+        raise ValueError(f"malformed schedule id: {schedule_id!r}")
+    k = None if kk == "inf" else int(kk)
+    return model, k, [_B36.index(c) for c in digits]
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    """One schedule that broke an invariant, deadlocked, or raised."""
+
+    schedule_id: str
+    kind: str  # "invariant" | "deadlock" | "exception"
+    message: str
+    trace: str
+
+    def brief(self) -> str:
+        return f"[{self.kind}] {self.schedule_id}: {self.message}"
+
+
+@dataclasses.dataclass
+class ExploreResult:
+    model: str
+    k: int | None
+    por: bool
+    schedules: int = 0
+    pruned: int = 0
+    choice_points: int = 0
+    max_depth: int = 0
+    wall_s: float = 0.0
+    truncated: bool = False
+    violations: list[Violation] = dataclasses.field(default_factory=list)
+
+    def summary(self) -> str:
+        kk = "inf" if self.k is None else str(self.k)
+        line = (
+            f"{self.model}: {self.schedules} schedule(s) explored "
+            f"(k={kk}, por={'on' if self.por else 'off'}, "
+            f"{self.pruned} sleep-pruned, max depth {self.max_depth}, "
+            f"{self.wall_s:.2f}s) — "
+            f"{len(self.violations)} violation(s)"
+        )
+        if self.truncated:
+            line += " [TRUNCATED at max-schedules: NOT exhaustive]"
+        return line
+
+
+class _ChoicePoint:
+    """One decision on the current DFS path."""
+
+    __slots__ = (
+        "enabled", "ops", "current_tid", "preemptions_before",
+        "sleep_entry", "explored", "chosen",
+    )
+
+    def __init__(
+        self,
+        enabled: list[int],
+        ops: dict[int, Op],
+        current_tid: int | None,
+        preemptions_before: int,
+        sleep_entry: dict[int, Op],
+        chosen: int,
+    ) -> None:
+        self.enabled = enabled
+        self.ops = ops
+        self.current_tid = current_tid
+        self.preemptions_before = preemptions_before
+        self.sleep_entry = sleep_entry
+        self.explored = [chosen]
+        self.chosen = chosen
+
+
+class ScheduleDivergence(RuntimeError):
+    """A forced prefix did not reproduce the recorded enabled set: the
+    model is not schedule-deterministic (wall-clock control flow,
+    ambient randomness) and the search would be silently wrong."""
+
+
+class _RunController:
+    """Drives one run: forced prefix, then the default policy; records
+    new choice points and maintains the live sleep set."""
+
+    def __init__(
+        self, stack: list[_ChoicePoint], por: bool, replay_only: bool
+    ) -> None:
+        self.stack = stack
+        self.por = por
+        self.replay_only = replay_only  # don't record new points
+        self.depth = 0
+        self.sleep: dict[int, Op] = {}
+        self.preemptions = 0
+        self.pruned = False
+        self.new_records: list[_ChoicePoint] = []
+        self.path: list[int] = []
+
+    # sched.on_op: every executed transition filters the sleep set
+    def on_op(self, task: Task, op: Op) -> None:
+        if not self.sleep:
+            return
+        for tid in list(self.sleep):
+            if not independent(self.sleep[tid], op):
+                del self.sleep[tid]
+
+    def choose(self, sched: MCScheduler, enabled: list[Task]) -> Task:
+        by_tid = {t.tid: t for t in enabled}
+        enabled_tids = sorted(by_tid)
+        ops = {t.tid: t.pending for t in enabled}
+        current = sched.current.tid if sched.current is not None else None
+        i = self.depth
+        self.depth += 1
+        if i < len(self.stack):
+            cp = self.stack[i]
+            if cp.enabled != enabled_tids or (
+                not self.replay_only and cp.ops != ops
+            ):
+                raise ScheduleDivergence(
+                    f"choice point {i}: recorded enabled={cp.enabled} "
+                    f"ops={cp.ops} but this run sees "
+                    f"enabled={enabled_tids} ops={ops}"
+                )
+            chosen_tid = cp.chosen
+            prior = [t for t in cp.explored if t != chosen_tid]
+        else:
+            if self.pruned or self.replay_only:
+                # beyond the recorded path of an abandoned (sleep-
+                # blocked) run, or replaying: default policy, unrecorded
+                chosen_tid = self._default(enabled_tids, current, ops)
+                prior = []
+                cp = None
+            else:
+                awake = [t for t in enabled_tids if t not in self.sleep]
+                if not awake:
+                    # sleep-blocked: every continuation from here is
+                    # covered by an already-explored trace — finish the
+                    # run silently, record nothing more
+                    self.pruned = True
+                    chosen_tid = self._default(enabled_tids, current, ops)
+                    prior = []
+                    cp = None
+                else:
+                    chosen_tid = self._default(awake, current, ops)
+                    cp = _ChoicePoint(
+                        enabled_tids, ops, current, self.preemptions,
+                        dict(self.sleep), chosen_tid,
+                    )
+                    self.new_records.append(cp)
+                    prior = []
+        if current is not None and chosen_tid != current and current in by_tid:
+            self.preemptions += 1
+        if cp is not None and self.por:
+            chosen_op = ops[chosen_tid]
+            merged = dict(cp.sleep_entry)
+            for tid in prior:
+                merged[tid] = cp.ops[tid]
+            self.sleep = {
+                tid: op for tid, op in merged.items()
+                if tid != chosen_tid and independent(op, chosen_op)
+            }
+        elif not self.por:
+            self.sleep = {}
+        self.path.append(chosen_tid)
+        return by_tid[chosen_tid]
+
+    @staticmethod
+    def _default(
+        candidates: list[int], current: int | None, ops: dict[int, Op]
+    ) -> int:
+        if current is not None and current in candidates:
+            return current
+        return min(candidates)
+
+
+class _ReplayController:
+    """Forces an exact choice sequence from a schedule id."""
+
+    def __init__(self, choices: list[int]) -> None:
+        self.choices = choices
+        self.depth = 0
+
+    def on_op(self, task: Task, op: Op) -> None:
+        pass
+
+    def choose(self, sched: MCScheduler, enabled: list[Task]) -> Task:
+        by_tid = {t.tid: t for t in enabled}
+        i = self.depth
+        self.depth += 1
+        if i >= len(self.choices):
+            raise ScheduleDivergence(
+                f"schedule id ends at choice {len(self.choices)} but the "
+                f"run reached choice point {i} — model changed since the "
+                "id was minted"
+            )
+        tid = self.choices[i]
+        if tid not in by_tid:
+            raise ScheduleDivergence(
+                f"choice point {i}: id names task {tid} but enabled set "
+                f"is {sorted(by_tid)} — model changed since the id was "
+                "minted"
+            )
+        return by_tid[tid]
+
+
+@dataclasses.dataclass
+class RunOutcome:
+    schedule_id: str
+    violation: Violation | None
+    trace: str
+    pruned: bool
+    depth: int
+    preemptions: int
+
+
+class Explorer:
+    """Bounded exhaustive exploration of one model.
+
+    ``model`` must expose ``name`` (str) and ``build() -> harness``
+    where the harness exposes ``tasks`` (list of ``(name, callable)``)
+    and ``check()`` (raises :class:`InvariantViolation` at a bad
+    terminal state). ``build`` is called once per schedule — everything
+    the threads share must be constructed inside it, under the session,
+    so its locks are cooperative.
+    """
+
+    def __init__(
+        self,
+        model: Any,
+        k: int | None = 2,
+        por: bool | None = None,
+        branch_on_release: bool = False,
+        max_schedules: int | None = None,
+        stop_on_violation: bool = False,
+        progress: Callable[[int], None] | None = None,
+    ) -> None:
+        self.model = model
+        self.k = k
+        # POR defaults on only for unbounded search: sleep sets compose
+        # with k=inf; under a finite bound the prunings can interact
+        # (module docstring), so bounded runs enumerate plainly.
+        self.por = (k is None) if por is None else por
+        self.branch_on_release = branch_on_release
+        self.max_schedules = max_schedules
+        self.stop_on_violation = stop_on_violation
+        self.progress = progress
+
+    # --- one schedule -----------------------------------------------------
+
+    def _execute(
+        self, controller: Any, collect_trace: bool
+    ) -> tuple[Violation | None, str, int]:
+        sched = MCScheduler(
+            controller.choose,
+            on_op=controller.on_op,
+            branch_on_release=self.branch_on_release,
+        )
+        violation_body: tuple[str, str] | None = None
+        with mc_session(sched):
+            harness = self.model.build()
+            for name, fn in harness.tasks:
+                sched.spawn(name, fn)
+            try:
+                sched.run()
+            except DeadlockDetected as e:
+                violation_body = ("deadlock", str(e))
+            except ScheduleDivergence:
+                raise
+            except InvariantViolation as e:
+                violation_body = ("invariant", str(e))
+            except Exception as e:  # noqa: BLE001 — any harness escape
+                # is a finding: protocol code raised where the real
+                # system would have no handler
+                violation_body = ("exception", f"{type(e).__name__}: {e}")
+            else:
+                try:
+                    harness.check()
+                except InvariantViolation as e:
+                    violation_body = ("invariant", str(e))
+        trace = sched.trace_text() if (collect_trace or violation_body) else ""
+        violation: Violation | None = None
+        if violation_body is not None:
+            violation = Violation(
+                schedule_id="",  # stamped by the caller (id needs the path)
+                kind=violation_body[0],
+                message=violation_body[1],
+                trace=trace,
+            )
+        return violation, trace, sched.preemptions
+
+    def run_one(
+        self, stack: list[_ChoicePoint], collect_trace: bool = False
+    ) -> RunOutcome:
+        ctrl = _RunController(stack, por=self.por, replay_only=False)
+        violation, trace, preemptions = self._execute(ctrl, collect_trace)
+        stack.extend(ctrl.new_records)
+        schedule_id = encode_schedule_id(self.model.name, self.k, ctrl.path)
+        if violation is not None:
+            violation = dataclasses.replace(violation, schedule_id=schedule_id)
+        return RunOutcome(
+            schedule_id=schedule_id,
+            violation=violation,
+            trace=trace,
+            pruned=ctrl.pruned,
+            depth=len(ctrl.path),
+            preemptions=preemptions,
+        )
+
+    # --- the search -------------------------------------------------------
+
+    def _candidates(self, cp: _ChoicePoint) -> list[int]:
+        """Unexplored, non-sleeping, bound-feasible alternatives at a
+        choice point, non-preemptive spine first."""
+        out = []
+        ordered = sorted(
+            cp.enabled,
+            key=lambda t: (0 if t == cp.current_tid else 1, t),
+        )
+        for tid in ordered:
+            if tid in cp.explored or tid in cp.sleep_entry:
+                continue
+            costs_preemption = (
+                cp.current_tid is not None
+                and tid != cp.current_tid
+                and cp.current_tid in cp.enabled
+            )
+            if (
+                costs_preemption
+                and self.k is not None
+                and cp.preemptions_before >= self.k
+            ):
+                continue
+            out.append(tid)
+        return out
+
+    def _backtrack(self, stack: list[_ChoicePoint]) -> bool:
+        while stack:
+            cp = stack[-1]
+            cands = self._candidates(cp)
+            if cands:
+                cp.explored.append(cands[0])
+                cp.chosen = cands[0]
+                return True
+            stack.pop()
+        return False
+
+    def explore(self) -> ExploreResult:
+        result = ExploreResult(model=self.model.name, k=self.k, por=self.por)
+        t0 = time.perf_counter()
+        stack: list[_ChoicePoint] = []
+        first = True
+        while first or self._backtrack(stack):
+            first = False
+            if (
+                self.max_schedules is not None
+                and result.schedules >= self.max_schedules
+            ):
+                # never a silent cap: the summary says NOT exhaustive
+                result.truncated = True
+                break
+            outcome = self.run_one(stack)
+            result.schedules += 1
+            result.max_depth = max(result.max_depth, outcome.depth)
+            if outcome.pruned:
+                result.pruned += 1
+            if outcome.violation is not None:
+                result.violations.append(outcome.violation)
+                if self.stop_on_violation:
+                    break
+            if self.progress is not None and result.schedules % 200 == 0:
+                self.progress(result.schedules)
+        result.choice_points = sum(len(cp.explored) for cp in stack)
+        result.wall_s = time.perf_counter() - t0
+        return result
+
+    # --- replay -----------------------------------------------------------
+
+    def replay(self, schedule_id: str) -> RunOutcome:
+        """Re-execute one schedule choice for choice; the returned
+        outcome carries the full transition trace."""
+        _model, _k, choices = decode_schedule_id(schedule_id)
+        ctrl = _ReplayController(choices)
+        violation, trace, preemptions = self._execute(ctrl, collect_trace=True)
+        if violation is not None:
+            violation = dataclasses.replace(violation, schedule_id=schedule_id)
+        return RunOutcome(
+            schedule_id=schedule_id,
+            violation=violation,
+            trace=trace,
+            pruned=False,
+            depth=len(choices),
+            preemptions=preemptions,
+        )

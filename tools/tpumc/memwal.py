@@ -1,0 +1,134 @@
+"""In-memory WAL: ``AllocationCheckpoint``'s journal surface without the
+disk.
+
+Thousands of schedules re-run the protocol harnesses from scratch;
+fsyncing a real file per journal record would make exploration I/O-bound
+and non-deterministic in wall time. This journal keeps the exact
+*semantic* surface the protocols program against —
+
+- ``begin`` stamps a monotonic ``_seq`` into the entry and returns it;
+  a same-key re-begin replaces the entry (the real loader keeps the
+  newest record per key);
+- ``commit``/``abort`` with ``seq`` resolve only the exact incarnation
+  the caller saw (the seq-guard that keeps a slow resolver from popping
+  a fresh same-key begin);
+- ``pending()`` is the begun-but-unresolved map, ``last_seq`` the
+  newest stamp;
+
+— and fires the same ``checkpoint.begin|commit|abort`` fault points in
+the same order (after the state change, where the durability boundary
+sits), so every WAL step remains a scheduler yield point exactly like
+the on-disk journal. State is mutated under the real ``checkpoint.
+journal`` rank through the lockrank factory, so journal mutations are
+bracketed by instrumented acquires — which is what makes the explorer's
+conservative independence relation sound for them too.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from gpushare_device_plugin_tpu.utils.faults import FAULTS
+from gpushare_device_plugin_tpu.utils.lockrank import make_rlock
+
+PodKey = tuple[str, str]
+
+
+class MemJournal:
+    """Drop-in for ``AllocationCheckpoint`` wherever the protocols only
+    need begin/commit/abort/pending/last_seq (the 2PC participant, the
+    move protocol, serve-from-checkpoint warmup)."""
+
+    def __init__(self) -> None:
+        self._lock = make_rlock("checkpoint.journal")
+        self._entries: dict[PodKey, dict] = {}
+        self._seq = 0
+        self._fenced = False
+        self.path = "<memwal>"
+
+    # --- introspection ----------------------------------------------------
+
+    @property
+    def last_seq(self) -> int:
+        with self._lock:
+            return self._seq
+
+    @property
+    def fenced(self) -> bool:
+        with self._lock:
+            return self._fenced
+
+    def pending(self) -> dict[PodKey, dict]:
+        with self._lock:
+            return {k: dict(v) for k, v in self._entries.items()}
+
+    # --- journal ops ------------------------------------------------------
+
+    def begin(self, key: PodKey, data: dict) -> int | None:
+        from gpushare_device_plugin_tpu.allocator.checkpoint import (
+            StaleDaemonError,
+        )
+
+        with self._lock:
+            if self._fenced:
+                raise StaleDaemonError("superseded (model fence)")
+            # seq stamp + entry install in ONE lock block: the real
+            # journal's loader keeps the newest record per key, so a
+            # same-key begin race must never let an older seq overwrite
+            # a newer entry (the fire stays outside — the durability
+            # boundary sits after the state change)
+            self._seq += 1
+            seq = self._seq
+            data = dict(data)
+            data["_seq"] = seq
+            self._entries[key] = data
+        FAULTS.fire("checkpoint.begin")
+        return seq
+
+    def commit(self, key: PodKey, seq: int | None = None) -> bool:
+        resolved = self._resolve(key, seq)
+        FAULTS.fire("checkpoint.commit")
+        return resolved
+
+    def abort(self, key: PodKey, seq: int | None = None) -> bool:
+        resolved = self._resolve(key, seq)
+        FAULTS.fire("checkpoint.abort")
+        return resolved
+
+    def _resolve(self, key: PodKey, seq: int | None) -> bool:
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                return False
+            if seq is not None and entry.get("_seq") != seq:
+                return False  # a newer begin owns this key now
+            self._entries.pop(key, None)
+            return True
+
+    # --- lifecycle noise the real journal has -----------------------------
+
+    def flush(self, timeout_s: float | None = None) -> bool:
+        return True
+
+    def compact(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+    def fence(self) -> None:
+        """Model hook: make the next begin raise StaleDaemonError."""
+        with self._lock:
+            self._fenced = True
+
+    def __repr__(self) -> str:  # pragma: no cover - debug only
+        return f"<MemJournal seq={self._seq} pending={len(self._entries)}>"
+
+
+def any_pending(journals: "list[MemJournal]") -> dict[Any, dict]:
+    """Union of pending entries across journals (invariant checks)."""
+    out: dict[Any, dict] = {}
+    for j in journals:
+        for key, data in j.pending().items():
+            out[(j, key)] = data
+    return out

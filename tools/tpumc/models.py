@@ -1,0 +1,837 @@
+"""Small-model harnesses for the three journaled protocols.
+
+Each model builds a FRESH harness per schedule (under the mc_session, so
+every lock in the object graph is cooperative) and checks the repo's
+standing invariants at the terminal state:
+
+- **gang2pc** — two cross-shard gang groups race the REAL two-phase
+  protocol (``ShardRouter.admit_gang_group`` with planning stubbed to a
+  fixed plan — scoring is not the protocol under test) over two
+  2-chip nodes whose capacity admits only one group per chip. After a
+  terminal :func:`resolve_gang2pc` pass: no per-chip overcommit, no
+  partial gang, no orphaned cross-shard reservation, no pending gang2pc
+  journal entry.
+- **move** — one :class:`SliceMover` executes the journaled
+  plan→drain→copy→switch→resume protocol while a concurrent admission
+  books capacity through the same :class:`AssumeCache`; the terminal
+  reconciler pass resolves whatever is pending. Invariants: no per-chip
+  overcommit, the moved pod lives on exactly one chip, the ledger fully
+  drained, no pending move entry after resolve.
+- **drain-handshake** — the REAL :class:`DrainHandshake` between a
+  simulated serving loop (retire-or-capture per iteration boundary,
+  exactly ``PagedSlotEngine.run``'s shape) and a mover
+  (request→wait→restore). Invariant: every submitted request is
+  delivered exactly once — at the source before capture or at the
+  destination after restore; never lost, never duplicated.
+  ``drain-broken`` seeds the pre-PR-13 bug (arming without resetting
+  the prior cycle's answer) and exists so the checker provably FINDS
+  the lost-capture schedule — the explorer self-tests pin it.
+- **racy-counter** / **indep-workers** — toy models for the explorer's
+  own tests: a classic read-modify-write race (found at k>=1), and a
+  mostly-independent workload where sleep-set POR must prune schedules
+  without losing the seeded violation.
+
+Models must be *schedule-deterministic*: control flow may not depend on
+wall-clock or ambient randomness (TTLs here are hundreds of seconds —
+never reached inside a run; timestamps ride record payloads only).
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Callable
+
+from gpushare_device_plugin_tpu import const
+from gpushare_device_plugin_tpu.allocator.assume import AssumeCache
+from gpushare_device_plugin_tpu.allocator.defrag import MovePlan, SliceMover, resolve_move
+from gpushare_device_plugin_tpu.cluster import pods as P
+from gpushare_device_plugin_tpu.cluster.apiserver import ApiError
+from gpushare_device_plugin_tpu.extender import simcluster as S
+from gpushare_device_plugin_tpu.extender.shards import (
+    GANG2PC_NS,
+    LeaderLease,
+    ShardExtender,
+    ShardRouter,
+    resolve_gang2pc,
+)
+from gpushare_device_plugin_tpu.serving.drainproto import DrainHandshake
+from gpushare_device_plugin_tpu.utils.faults import FAULTS
+
+from .memwal import MemJournal
+from .sched import InvariantViolation, mc_step
+
+
+class Harness:
+    """One schedule's world: tasks to run and the terminal check."""
+
+    def __init__(
+        self,
+        tasks: list[tuple[str, Callable[[], Any]]],
+        check: Callable[[], None],
+    ) -> None:
+        self.tasks = tasks
+        self._check = check
+
+    def check(self) -> None:
+        self._check()
+
+
+# ---------------------------------------------------------------------------
+# in-process apiserver stub
+# ---------------------------------------------------------------------------
+
+
+class ModelApi:
+    """Duck-typed ``ApiServerClient`` subset over plain dicts. Every verb
+    fires the ``apiserver.request`` fault point first, so each apiserver
+    round-trip is a scheduler yield point (and the mutation that follows
+    rides a conservatively-dependent transition — see explore.py)."""
+
+    def __init__(self) -> None:
+        self.pods: dict[tuple[str, str], dict] = {}
+        self.nodes: dict[str, dict] = {}
+
+    # setup-side (no fires; runs before the schedule starts)
+    def add_pod(self, pod: dict) -> None:
+        self.pods[(P.namespace(pod), P.name(pod))] = pod
+
+    def add_node(self, node: dict) -> None:
+        self.nodes[node["metadata"]["name"]] = node
+
+    # --- client verbs -----------------------------------------------------
+
+    def get_pod(self, ns: str, name: str) -> dict:
+        FAULTS.fire("apiserver.request")
+        pod = self.pods.get((ns, name))
+        if pod is None:
+            raise ApiError(404, f"pod {ns}/{name} not found")
+        return copy.deepcopy(pod)
+
+    def list_pods(self) -> list[dict]:
+        FAULTS.fire("apiserver.request")
+        return [copy.deepcopy(p) for p in self.pods.values()]
+
+    def get_node(self, name: str) -> dict:
+        FAULTS.fire("apiserver.request")
+        node = self.nodes.get(name)
+        if node is None:
+            raise ApiError(404, f"node {name} not found")
+        return copy.deepcopy(node)
+
+    def list_nodes(self) -> list[dict]:
+        FAULTS.fire("apiserver.request")
+        return [copy.deepcopy(n) for n in self.nodes.values()]
+
+    def patch_pod(self, ns: str, name: str, patch: dict) -> dict:
+        FAULTS.fire("apiserver.request")
+        pod = self.pods.get((ns, name))
+        if pod is None:
+            raise ApiError(404, f"pod {ns}/{name} not found")
+        ann = patch.get("metadata", {}).get("annotations") or {}
+        pod.setdefault("metadata", {}).setdefault("annotations", {}).update(
+            {k: str(v) for k, v in ann.items()}
+        )
+        return copy.deepcopy(pod)
+
+    def patch_node(self, name: str, patch: dict) -> dict:
+        FAULTS.fire("apiserver.request")
+        node = self.nodes.get(name)
+        if node is None:
+            raise ApiError(404, f"node {name} not found")
+        ann = patch.get("metadata", {}).get("annotations") or {}
+        node.setdefault("metadata", {}).setdefault("annotations", {}).update(
+            {k: str(v) for k, v in ann.items()}
+        )
+        return copy.deepcopy(node)
+
+    def bind_pod(self, ns: str, name: str, node: str) -> None:
+        FAULTS.fire("apiserver.request")
+        pod = self.pods.get((ns, name))
+        if pod is None:
+            raise ApiError(404, f"pod {ns}/{name} not found")
+        pod.setdefault("spec", {})["nodeName"] = node
+
+
+def _pod(
+    name: str,
+    units: int,
+    *,
+    ns: str = "default",
+    node: str = "",
+    phase: str = "Pending",
+    annotations: dict | None = None,
+    labels: dict | None = None,
+) -> dict:
+    return {
+        "metadata": {
+            "name": name,
+            "namespace": ns,
+            "uid": f"uid-{ns}-{name}",
+            "creationTimestamp": "2026-01-01T00:00:00Z",
+            "annotations": dict(annotations or {}),
+            "labels": dict(labels or {}),
+        },
+        "spec": {
+            "nodeName": node,
+            "containers": [{
+                "name": "c0",
+                "resources": {"limits": {const.RESOURCE_MEM: str(units)}},
+            }],
+        },
+        "status": {"phase": phase},
+    }
+
+
+# ---------------------------------------------------------------------------
+# toy models (explorer self-tests)
+# ---------------------------------------------------------------------------
+
+
+class RacyCounterModel:
+    """The classic lost update: read, yield, write. The invariant
+    (counter == workers) fails on any schedule that interleaves two
+    read-modify-write windows — reachable from the non-preemptive spine
+    only with >=1 preemption, which pins the bound's semantics."""
+
+    def __init__(self, workers: int = 2, steps: int = 1) -> None:
+        self.name = "racy-counter"
+        self.workers = workers
+        self.steps = steps
+
+    def build(self) -> Harness:
+        state = {"v": 0}
+
+        def worker() -> None:
+            for _ in range(self.steps):
+                mc_step("read")
+                tmp = state["v"]
+                mc_step("write")
+                state["v"] = tmp + 1
+
+        def check() -> None:
+            want = self.workers * self.steps
+            if state["v"] != want:
+                raise InvariantViolation(
+                    f"lost update: counter {state['v']} != {want}"
+                )
+
+        return Harness(
+            [(f"w{i}", worker) for i in range(self.workers)], check
+        )
+
+
+class IndepWorkersModel:
+    """Two workers on independent locks plus the racy pair: sleep-set
+    POR must prune the independent chatter WITHOUT losing the racy
+    violation (the POR-vs-full equivalence test runs this)."""
+
+    def __init__(self) -> None:
+        self.name = "indep-workers"
+
+    def build(self) -> Harness:
+        from .sched import active_scheduler
+
+        sched = active_scheduler()
+        assert sched is not None, "indep-workers only runs under tpumc"
+        factory = sched.factory()
+        lock_a = factory.lock("model.a")
+        lock_b = factory.lock("model.b")
+        cells = {"a": 0, "b": 0, "v": 0}
+
+        def indep(lock: Any, cell: str) -> Callable[[], None]:
+            def body() -> None:
+                with lock:
+                    cells[cell] += 1
+            return body
+
+        def racy() -> None:
+            mc_step("read")
+            tmp = cells["v"]
+            mc_step("write")
+            cells["v"] = tmp + 1
+
+        def check() -> None:
+            if cells["a"] != 1 or cells["b"] != 1:
+                raise InvariantViolation(f"independent counters: {cells}")
+            if cells["v"] != 2:
+                raise InvariantViolation(
+                    f"lost update: v={cells['v']} != 2"
+                )
+
+        return Harness(
+            [
+                ("ia", indep(lock_a, "a")),
+                ("ib", indep(lock_b, "b")),
+                ("r1", racy),
+                ("r2", racy),
+            ],
+            check,
+        )
+
+
+# ---------------------------------------------------------------------------
+# drain handshake
+# ---------------------------------------------------------------------------
+
+
+class _BrokenDrainHandshake(DrainHandshake):
+    """The seeded defect: arm WITHOUT resetting the prior cycle's
+    answer. A mover arming between runs then consumes the stale
+    everything-retired answer immediately, while the flag left up makes
+    the NEXT run quiesce into a capture nobody collects — lost
+    requests. The checker must find this at k>=1."""
+
+    def request(self) -> None:  # noqa: D102 — deliberately buggy
+        with self._lock:
+            self._request_evt.set()
+
+
+class DrainModel:
+    """The engine half of the move protocol: a serving loop racing a
+    mover through the real :class:`DrainHandshake`."""
+
+    def __init__(
+        self,
+        batches: tuple[tuple[str, ...], ...] = (
+            ("r1", "r2"), ("r3", "r4"), ("r5",),
+        ),
+        broken: bool = False,
+    ) -> None:
+        self.name = "drain-broken" if broken else "drain-handshake"
+        self.batches = batches
+        self.broken = broken
+
+    def build(self) -> Harness:
+        h: DrainHandshake = (
+            _BrokenDrainHandshake() if self.broken else DrainHandshake()
+        )
+        submitted: list[str] = []
+        delivered: list[str] = []
+        restored: list[str] = []
+        restored_ids: set[str] = set()
+
+        def source() -> None:
+            # back-to-back runs of one engine: each run serves a batch
+            # to completion unless a drain captures the remainder —
+            # after a capture the pod is moving, so no further run
+            # starts (PagedSlotEngine.run's shape, requests modeled as
+            # opaque ids)
+            for batch in self.batches:
+                submitted.extend(batch)
+                i = 0
+                while i < len(batch):
+                    mc_step("boundary")
+                    if h.armed():
+                        h.publish({
+                            "snapshot_id": "move#1",
+                            "requests": list(batch[i:]),
+                        })
+                        return
+                    delivered.append(batch[i])
+                    i += 1
+                mc_step("run-end")
+                h.finish_run()
+
+        def mover() -> None:
+            h.request()
+            try:
+                snap = h.wait(timeout=5.0)
+            except TimeoutError:
+                return  # move failed cleanly; the source kept serving
+            if snap is not None:
+                sid = snap.get("snapshot_id")
+                if sid is not None and sid in restored_ids:
+                    return  # duplicate delivery: deduped, never re-served
+                if sid is not None:
+                    restored_ids.add(sid)
+                restored.extend(snap["requests"])
+
+        def check() -> None:
+            got = sorted(delivered + restored)
+            want = sorted(submitted)
+            if got != want:
+                lost = [r for r in want if r not in got]
+                dup = [r for r in got if got.count(r) > 1]
+                raise InvariantViolation(
+                    "tokens-delivered-exactly-once broken: "
+                    f"delivered={delivered} restored={restored} "
+                    f"submitted={submitted} lost={sorted(set(lost))} "
+                    f"duplicated={sorted(set(dup))}"
+                )
+
+        return Harness([("serve", source), ("mover", mover)], check)
+
+
+# ---------------------------------------------------------------------------
+# gang-2PC
+# ---------------------------------------------------------------------------
+
+
+class _FixedPlanRouter(ShardRouter):
+    """The real 2PC driver with planning stubbed to a fixed placement:
+    scoring is not the protocol under test, and a fixed plan keeps the
+    schedule space on the prepare/decide/commit/resolve machinery."""
+
+    def __init__(self, *args: Any, plans: dict[str, list[dict]], **kw: Any):
+        super().__init__(*args, **kw)
+        self._plans = plans
+
+    def _plan_group(self, pods: Any) -> tuple[list[dict], str]:
+        group = P.gang_group(pods[0])
+        return [dict(m) for m in self._plans[group]], ""
+
+
+class Gang2pcModel:
+    """Two gang groups race admission over chips only one can hold."""
+
+    def __init__(self, per_chip: int = 48, chip_units: int = 64) -> None:
+        self.name = "gang2pc"
+        self.per_chip = per_chip
+        self.chip_units = chip_units
+
+    def build(self) -> Harness:
+        api = ModelApi()
+        nodes = {
+            "n0": S.synth_node("n0", "2", 2, self.chip_units),
+            "n1": S.synth_node("n1", "2", 2, self.chip_units),
+        }
+        for node in nodes.values():
+            api.add_node(node)
+        groups = {"ga": ("a1", "a2"), "gb": ("b1", "b2")}
+        plans: dict[str, list[dict]] = {}
+        for group, members in groups.items():
+            plan = []
+            for member, (sid, node) in zip(
+                members, (("shard-0", "n0"), ("shard-1", "n1"))
+            ):
+                api.add_pod(_pod(
+                    member, self.per_chip,
+                    annotations={
+                        const.ANN_GANG_SHAPE: "1",
+                        const.ANN_GANG_GROUP: group,
+                    },
+                    labels={
+                        const.LABEL_RESOURCE_KEY: const.LABEL_RESOURCE_VALUE,
+                    },
+                ))
+                plan.append({
+                    "ns": "default", "name": member, "shard": sid,
+                    "node": node, "chips": (0,), "units": self.per_chip,
+                    "shape": "1", "request": self.per_chip,
+                })
+            plans[group] = plan
+        shards = [
+            ShardExtender(sid, api, informer=None, checkpoint=MemJournal())
+            for sid in ("shard-0", "shard-1")
+        ]
+        shards[0].set_nodes([nodes["n0"]])
+        shards[1].set_nodes([nodes["n1"]])
+        lease = LeaderLease()
+        router = _FixedPlanRouter(shards, lease=lease, plans=plans)
+        pods_of = {
+            g: [api.pods[("default", m)] for m in members]
+            for g, members in groups.items()
+        }
+        outcomes: dict[str, dict] = {}
+
+        def drive(group: str) -> Callable[[], None]:
+            def body() -> None:
+                outcomes[group] = router.admit_gang_group(pods_of[group])
+            return body
+
+        def check() -> None:
+            resolve_gang2pc(shards, api, lease)
+            # 1. no pending gang2pc journal entry after resolve
+            for shard in shards:
+                left = shard.twopc_pending()
+                if left:
+                    raise InvariantViolation(
+                        f"{shard.shard_id} still holds gang2pc journal "
+                        f"entries after resolve: {left}"
+                    )
+            # 2. no partial gang visible in the apiserver
+            for group, members in groups.items():
+                bound = [
+                    bool(P.gang_chips_from_annotation(api.pods[("default", m)]))
+                    for m in members
+                ]
+                if any(bound) and not all(bound):
+                    raise InvariantViolation(
+                        f"partial gang {group}: member states {bound} "
+                        f"(outcomes: {outcomes})"
+                    )
+            # 3. no orphaned reservation: anything still in a ledger must
+            # protect a COMMITTED member pending watch visibility
+            annotated: dict[tuple[str, str], dict[int, int]] = {}
+            for (ns, name), pod in api.pods.items():
+                usage = P.gang_usage_by_chip(pod)
+                if usage:
+                    annotated[(ns, name)] = usage
+            reserved: dict[str, dict[int, int]] = {}
+            for shard in shards:
+                for key, members_r in shard._ledger.gang_snapshot().items():
+                    if key[0] != GANG2PC_NS:
+                        raise InvariantViolation(
+                            f"foreign ledger key {key} in {shard.shard_id}"
+                        )
+                    _group, _, podref = key[1].partition("/")
+                    ns, _, name = podref.partition("/")
+                    pod = api.pods.get((ns, name))
+                    if pod is None or not P.gang_chips_from_annotation(pod):
+                        raise InvariantViolation(
+                            f"orphaned gang reservation {key} on "
+                            f"{shard.shard_id}: pod not committed"
+                        )
+                    # committed & reserved: count ONCE (the reservation
+                    # protects exactly the annotated usage)
+                    node = P.node_name(pod)
+                    row = reserved.setdefault(node, {})
+                    for chip, units in members_r:
+                        row[chip] = max(row.get(chip, 0), units)
+            # 4. no per-chip overcommit: annotations are the persisted
+            # truth; a committed member's reservation duplicates its own
+            # annotation and must not double-count
+            for node_name in nodes:
+                cap = self.chip_units
+                usage: dict[int, int] = {}
+                for (ns, name), per_chip in annotated.items():
+                    pod = api.pods[(ns, name)]
+                    if P.node_name(pod) != node_name:
+                        continue
+                    for chip, units in per_chip.items():
+                        usage[chip] = usage.get(chip, 0) + units
+                for chip, units in usage.items():
+                    if units > cap:
+                        raise InvariantViolation(
+                            f"chip {node_name}/{chip} overcommitted: "
+                            f"{units} > {cap} (outcomes: {outcomes})"
+                        )
+
+        return Harness(
+            [("admit-ga", drive("ga")), ("admit-gb", drive("gb"))], check
+        )
+
+
+class Gang2pcResolveModel:
+    """A LIVE reconciler pass racing a live coordinator, with a second
+    group competing for one chip — the race that found a real defect.
+
+    Groups: A = (a1@n0/chip0, a2@n1/chip0), B = (b1@n0/chip0,
+    b2@n1/chip1): B conflicts with A only on n0/chip0. Threads: the two
+    coordinators plus a concurrent ``resolve_gang2pc`` pass.
+
+    ``gated=False`` reproduces the pre-fix ``shards.main`` wiring — the
+    resolve loop ran WITHOUT the coordinator lease, so it presumed-
+    aborted a live coordinator's undecided prepare; group B then booked
+    the freed chip and group A's durable decision rolled forward on top
+    of it (n0/chip0 at 96 > 64). ``gated=True`` is the fixed wiring
+    (one lease shared by router and resolver; the live-prepare grace in
+    ``resolve_gang2pc``) and must be clean — both pinned by
+    tests/test_tpumc.py."""
+
+    def __init__(self, gated: bool = True, per_chip: int = 48,
+                 chip_units: int = 64) -> None:
+        self.name = (
+            "gang2pc-resolve" if gated else "gang2pc-resolve-ungated"
+        )
+        self.gated = gated
+        self.per_chip = per_chip
+        self.chip_units = chip_units
+
+    def build(self) -> Harness:
+        api = ModelApi()
+        nodes = {
+            "n0": S.synth_node("n0", "2", 2, self.chip_units),
+            "n1": S.synth_node("n1", "2", 2, self.chip_units),
+        }
+        for node in nodes.values():
+            api.add_node(node)
+        members = {
+            "ga": (("a1", "shard-0", "n0", (0,)),
+                   ("a2", "shard-1", "n1", (0,))),
+            "gb": (("b1", "shard-0", "n0", (0,)),
+                   ("b2", "shard-1", "n1", (1,))),
+        }
+        plans: dict[str, list[dict]] = {}
+        for group, ms in members.items():
+            plan = []
+            for (member, sid, node, chips) in ms:
+                api.add_pod(_pod(
+                    member, self.per_chip,
+                    annotations={
+                        const.ANN_GANG_SHAPE: "1",
+                        const.ANN_GANG_GROUP: group,
+                    },
+                    labels={
+                        const.LABEL_RESOURCE_KEY: const.LABEL_RESOURCE_VALUE,
+                    },
+                ))
+                plan.append({
+                    "ns": "default", "name": member, "shard": sid,
+                    "node": node, "chips": chips, "units": self.per_chip,
+                    "shape": "1", "request": self.per_chip,
+                })
+            plans[group] = plan
+        shards = [
+            ShardExtender(sid, api, informer=None, checkpoint=MemJournal())
+            for sid in ("shard-0", "shard-1")
+        ]
+        shards[0].set_nodes([nodes["n0"]])
+        shards[1].set_nodes([nodes["n1"]])
+        lease = LeaderLease()
+        router = _FixedPlanRouter(shards, lease=lease, plans=plans)
+        pods_of = {
+            g: [api.pods[("default", m[0])] for m in ms]
+            for g, ms in members.items()
+        }
+
+        def drive(group: str) -> Callable[[], None]:
+            def body() -> None:
+                router.admit_gang_group(pods_of[group])
+            return body
+
+        def live_resolve() -> None:
+            # gated = the fixed shards.main wiring (shared lease);
+            # ungated = the pre-fix wiring (lease-less resolve loop)
+            resolve_gang2pc(shards, api, lease if self.gated else None)
+
+        def check() -> None:
+            resolve_gang2pc(shards, api, lease)
+            for shard in shards:
+                left = shard.twopc_pending()
+                if left:
+                    raise InvariantViolation(
+                        f"{shard.shard_id} pending after resolve: {left}"
+                    )
+            for group, ms in members.items():
+                bound = [
+                    bool(P.gang_chips_from_annotation(
+                        api.pods[("default", m[0])]
+                    ))
+                    for m in ms
+                ]
+                if any(bound) and not all(bound):
+                    raise InvariantViolation(
+                        f"partial gang {group}: {bound}"
+                    )
+            for node_name in nodes:
+                usage: dict[int, int] = {}
+                for pod in api.pods.values():
+                    if P.node_name(pod) != node_name:
+                        continue
+                    for chip, units in P.gang_usage_by_chip(pod).items():
+                        usage[chip] = usage.get(chip, 0) + units
+                for chip, units in usage.items():
+                    if units > self.chip_units:
+                        raise InvariantViolation(
+                            f"chip {node_name}/{chip} overcommitted: "
+                            f"{units} > {self.chip_units}"
+                        )
+
+        return Harness(
+            [
+                ("admit-ga", drive("ga")),
+                ("admit-gb", drive("gb")),
+                ("resolve", live_resolve),
+            ],
+            check,
+        )
+
+
+# ---------------------------------------------------------------------------
+# move protocol
+# ---------------------------------------------------------------------------
+
+
+class _ModelPodSource:
+    """The pod-source surface the mover consults: chip usage derived
+    straight from the apiserver stub's annotations."""
+
+    def __init__(self, api: ModelApi) -> None:
+        self._api = api
+
+    def chip_state(self) -> tuple[dict[int, int], set[int]]:
+        mem_used: dict[int, int] = {}
+        for pod in self._api.pods.values():
+            if not P.is_assigned(pod):
+                continue
+            if P.phase(pod) in ("Succeeded", "Failed"):
+                continue
+            idx = P.chip_idx_from_annotation(pod)
+            units = P.mem_units_of_pod(pod)
+            if idx >= 0 and units > 0:
+                mem_used[idx] = mem_used.get(idx, 0) + units
+        return mem_used, set()
+
+    def note_pod_update(self, pod: dict) -> None:
+        self._api.pods[(P.namespace(pod), P.name(pod))] = copy.deepcopy(pod)
+
+
+class MoveModel:
+    """The journaled move protocol racing a concurrent admission for the
+    destination chip's last capacity."""
+
+    def __init__(
+        self,
+        capacity: int = 64,
+        moved_units: int = 40,
+        admit_units: int = 40,
+        with_reconciler: bool = False,
+    ) -> None:
+        self.name = "move-reconciler" if with_reconciler else "move"
+        self.capacity = capacity
+        self.moved_units = moved_units
+        self.admit_units = admit_units
+        self.with_reconciler = with_reconciler
+
+    def build(self) -> Harness:
+        from gpushare_device_plugin_tpu.allocator.defrag import move_key
+
+        cap = {0: self.capacity, 1: self.capacity}
+        api = ModelApi()
+        api.add_pod(_pod(
+            "p0", self.moved_units, node="n0", phase="Running",
+            annotations={
+                const.ENV_MEM_IDX: "0",
+                const.ENV_MEM_POD: str(self.moved_units),
+                const.ENV_ASSIGNED_FLAG: "true",
+                const.ENV_ASSUME_TIME: "1",
+            },
+            labels={const.LABEL_RESOURCE_KEY: const.LABEL_RESOURCE_VALUE},
+        ))
+        api.add_pod(_pod("q", self.admit_units, node="n0"))
+        assume = AssumeCache()
+        ckpt = MemJournal()
+        source = _ModelPodSource(api)
+        mover = SliceMover(
+            api, source, assume, ckpt, "n0", lambda: dict(cap),
+        )
+        plan = MovePlan(
+            pod=("default", "p0"), src=0, dst=1, units=self.moved_units,
+        )
+
+        def run_move() -> None:
+            mover.execute(plan)
+
+        def admit() -> None:
+            key = ("default", "q")
+            if not assume.claim(key):
+                return
+            chip = None
+            with assume.transaction():
+                mem_used, core_held = assume.overlaid_state(source.chip_state)
+                for c in sorted(cap):
+                    if c in core_held:
+                        continue
+                    if cap[c] - mem_used.get(c, 0) >= self.admit_units:
+                        chip = c
+                        break
+                if chip is None:
+                    assume.release(key)
+                    return
+                assume.reserve_mem(key, chip, self.admit_units)
+            api.patch_pod("default", "q", {"metadata": {"annotations": {
+                const.ENV_MEM_IDX: str(chip),
+                const.ENV_MEM_POD: str(self.admit_units),
+                const.ENV_ASSIGNED_FLAG: "true",
+                const.ENV_ASSUME_TIME: "2",
+            }}})
+            assume.release(key)
+
+        def reconcile_pass() -> None:
+            for key, data in ckpt.pending().items():
+                if data.get("kind") != "move":
+                    continue
+                if assume.is_claimed(key):
+                    continue  # a live mover owns it (the real
+                    # reconciler's claim gate)
+                resolve_move(ckpt, assume, api, key, data)
+
+        def check() -> None:
+            reconcile_pass()
+            if ckpt.pending():
+                raise InvariantViolation(
+                    f"pending move entries after resolve: {ckpt.pending()}"
+                )
+            claims, mem, core = assume.snapshot()
+            gang = assume.gang_snapshot()
+            if claims or mem or core or gang:
+                raise InvariantViolation(
+                    "ledger not drained at terminal state: "
+                    f"claims={claims} mem={mem} core={core} gang={gang}"
+                )
+            if assume.is_claimed(move_key(plan.pod)):
+                raise InvariantViolation("move claim leaked")
+            usage: dict[int, int] = {}
+            p0 = api.pods[("default", "p0")]
+            idx = P.chip_idx_from_annotation(p0)
+            if idx not in (0, 1):
+                raise InvariantViolation(f"p0 on no valid chip: {idx}")
+            for pod in api.pods.values():
+                if not P.is_assigned(pod):
+                    continue
+                pidx = P.chip_idx_from_annotation(pod)
+                if pidx >= 0:
+                    usage[pidx] = usage.get(pidx, 0) + P.mem_units_of_pod(pod)
+            for chip, units in usage.items():
+                if units > cap[chip]:
+                    raise InvariantViolation(
+                        f"chip {chip} overcommitted: {units} > {cap[chip]} "
+                        f"(usage {usage})"
+                    )
+
+        tasks = [("mover", run_move), ("admit", admit)]
+        if self.with_reconciler:
+            tasks.append(("reconciler", reconcile_pass))
+        return Harness(tasks, check)
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+
+# name -> zero-arg maker; the ONE registry `run --model`, `list`, and
+# the suites resolve against (a model added here shows up everywhere).
+MODELS: dict[str, Callable[[], Any]] = {
+    "racy-counter": RacyCounterModel,
+    "indep-workers": IndepWorkersModel,
+    "drain-handshake": DrainModel,
+    "drain-broken": lambda: DrainModel(broken=True),
+    "gang2pc": Gang2pcModel,
+    "gang2pc-resolve": Gang2pcResolveModel,
+    "gang2pc-resolve-ungated": lambda: Gang2pcResolveModel(gated=False),
+    "move": MoveModel,
+    "move-reconciler": lambda: MoveModel(with_reconciler=True),
+}
+
+
+def get_model(name: str) -> Any:
+    """A fresh model instance by registry name."""
+    try:
+        return MODELS[name]()
+    except KeyError:
+        raise SystemExit(
+            f"tpumc: unknown model {name!r} (known: {', '.join(sorted(MODELS))})"
+        ) from None
+
+
+# (model name, k, por) per suite; k=None means exhaustive. The smoke
+# suite is the tier-1 gate (tests/test_mc_smoke.py): the drain model is
+# exhausted outright; the WAL-heavy protocol models are exhausted within
+# the preemption bound (every schedule with <=k preemptions).
+SMOKE_SUITE: tuple[tuple[str, int | None], ...] = (
+    ("drain-handshake", None),
+    ("gang2pc", 2),
+    ("gang2pc-resolve", 1),
+    ("move", 2),
+    ("move-reconciler", 1),
+)
+
+FULL_SUITE: tuple[tuple[str, int | None], ...] = (
+    ("drain-handshake", None),
+    ("gang2pc", 2),
+    ("gang2pc-resolve", 2),
+    ("move", 3),
+    ("move-reconciler", 2),
+)

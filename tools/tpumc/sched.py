@@ -1,0 +1,561 @@
+"""Deterministic cooperative scheduler: the model checker's runtime.
+
+One schedule = one run of a model harness in which exactly ONE logical
+thread executes at a time, and control changes hands only at *yield
+points*. Yield points are where the production code already talks to its
+concurrency substrate:
+
+- lock ``acquire`` (and, optionally, ``release``) through the
+  ``utils/lockrank.py`` factory seam;
+- event ``wait``/``set`` (``make_event`` — the drain handshake);
+- condition ``wait``/``notify``;
+- every ``FAULTS.fire(point)`` site (``utils/faults.py``) — which makes
+  each ``checkpoint.begin/commit/abort`` durability boundary and each
+  ``defrag.*``/``gang2pc.*`` protocol phase a scheduling decision, i.e.
+  exactly the boundaries the chaos suites kill at;
+- explicit model-level steps (:func:`mc_step`) for harness-local
+  actions (a simulated serving loop's iteration boundary).
+
+The segment of code between two yield points runs atomically with
+respect to other model threads. That is a *granularity choice*, and it
+is sound for this repo because the locking discipline (enforced by
+tpulint's lock rules and the runtime witness) keeps every cross-thread
+mutable structure behind a ranked lock — so any cross-thread conflict is
+bracketed by instrumented acquires. Lock ``release`` is a recorded but
+non-branching yield by default: the schedules it would add are
+reorderings of segments that only touch state still guarded by other
+instrumented operations; ``branch_on_release=True`` turns them into full
+decision points (the explorer's self-tests use it to validate the
+default on the small models).
+
+Blocking is modeled, not real: a thread whose pending operation is not
+*enabled* (acquire of a held lock, wait on an unset event) simply is not
+scheduled until the state changes. "No live thread enabled" is therefore
+a detected deadlock, reported like any other violation. Timed waits get
+quiesce semantics: the timeout branch is enabled only once every other
+thread has finished — real timeouts are seconds long, so a timeout while
+the system is still making progress is noise, and this keeps every model
+terminating.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Any, Callable, Iterator
+
+# Task states.
+NEW = "new"
+RUNNING = "running"
+PARKED = "parked"  # at a yield point, pending op recorded
+DONE = "done"
+
+# A hard cap on executed ops per run: a model that loops without
+# yielding progress is a harness bug, not a schedule to explore.
+MAX_OPS_PER_RUN = 200_000
+
+Op = tuple[str, str]  # (kind, object name / point name)
+
+
+class InvariantViolation(AssertionError):
+    """A model invariant failed at a terminal state."""
+
+
+class DeadlockDetected(RuntimeError):
+    """No live task is enabled: a real cyclic wait under this schedule."""
+
+
+class _MCAbort(BaseException):
+    """Unwinds a parked model thread when exploration abandons the run
+    (deadlock found, explorer shutdown). BaseException so no harness
+    ``except Exception`` swallows the teardown."""
+
+
+class Task:
+    """One logical model thread."""
+
+    __slots__ = (
+        "tid", "name", "fn", "thread", "gate", "state", "pending",
+        "wait_obj", "exc", "timed_out",
+    )
+
+    def __init__(self, tid: int, name: str, fn: Callable[[], Any]) -> None:
+        self.tid = tid
+        self.name = name
+        self.fn = fn
+        self.thread: threading.Thread | None = None
+        self.gate = threading.Event()  # scheduler -> task handoff
+        self.state = NEW
+        self.pending: Op | None = None
+        self.wait_obj: Any = None
+        self.exc: BaseException | None = None
+        self.timed_out = False  # result slot for timed waits
+
+    def __repr__(self) -> str:  # pragma: no cover - debug only
+        return f"<Task {self.tid}:{self.name} {self.state} {self.pending}>"
+
+
+class MCScheduler:
+    """Runs registered tasks one at a time, consulting ``controller``
+    at every point where more than one task is enabled.
+
+    ``controller`` is a callable ``(sched, enabled: list[Task]) ->
+    Task`` invoked only at real decision points; ``on_op`` (optional) is
+    called with ``(task, op)`` for every executed operation — the
+    explorer's sleep-set filter rides it.
+    """
+
+    def __init__(
+        self,
+        controller: Callable[["MCScheduler", list[Task]], Task],
+        on_op: Callable[[Task, Op], None] | None = None,
+        branch_on_release: bool = False,
+    ) -> None:
+        self.controller = controller
+        self.on_op = on_op
+        self.branch_on_release = branch_on_release
+        self.tasks: list[Task] = []
+        self.trace: list[tuple[int, str, str]] = []  # (tid, kind, name)
+        self.current: Task | None = None
+        self.preemptions = 0
+        self._sched_evt = threading.Event()
+        self._tls = threading.local()
+        self._aborting = False
+        self._ops = 0
+
+    # --- wiring -----------------------------------------------------------
+
+    def spawn(self, name: str, fn: Callable[[], Any]) -> Task:
+        if len(self.tasks) >= 36:
+            raise ValueError("schedule ids encode tids base-36; 36 tasks max")
+        task = Task(len(self.tasks), name, fn)
+        self.tasks.append(task)
+        return task
+
+    def factory(self) -> "_Factory":
+        """The lockrank ``set_mc_factory`` object bound to this run."""
+        return _Factory(self)
+
+    def current_task(self) -> Task | None:
+        """The managed task executing on THIS os thread (None for the
+        scheduler/driver thread and any unmanaged helper)."""
+        return getattr(self._tls, "task", None)
+
+    # --- task-side protocol ----------------------------------------------
+
+    def _thread_main(self, task: Task) -> None:
+        self._tls.task = task
+        try:
+            self._park(task, ("start", task.name), None)
+            task.fn()
+        except _MCAbort:
+            pass
+        except BaseException as e:  # noqa: BLE001 — recorded, re-raised
+            # by the driver as a violation
+            task.exc = e
+        finally:
+            task.state = DONE
+            task.pending = None
+            self._sched_evt.set()
+
+    def _park(self, task: Task, op: Op, wait_obj: Any) -> None:
+        """Hand control to the scheduler; return once scheduled again."""
+        task.pending = op
+        task.wait_obj = wait_obj
+        task.state = PARKED
+        self._sched_evt.set()
+        task.gate.wait()
+        task.gate.clear()
+        if self._aborting:
+            raise _MCAbort()
+        task.state = RUNNING
+        task.pending = None
+        task.wait_obj = None
+        self._record(task, op)
+
+    def perform(self, op: Op, wait_obj: Any = None) -> None:
+        """A branching yield point: park with ``op`` pending; the
+        scheduler resumes this task only when ``op`` is enabled. Called
+        from instrumented primitives and :func:`mc_step`. No-op when the
+        calling thread is not a managed task (harness setup, terminal
+        invariant checks)."""
+        task = self.current_task()
+        if task is None:
+            return
+        self._park(task, op, wait_obj)
+
+    def note(self, op: Op) -> None:
+        """A recorded, NON-branching operation (lock release, event
+        clear, reentrant re-acquire): applied inline, traced, and fed to
+        the sleep-set filter, but the thread keeps running."""
+        task = self.current_task()
+        if task is None:
+            return
+        self._record(task, op)
+
+    def _record(self, task: Task, op: Op) -> None:
+        self._ops += 1
+        if self._ops > MAX_OPS_PER_RUN:
+            raise RuntimeError(
+                f"model exceeded {MAX_OPS_PER_RUN} operations — a harness "
+                "loop without scheduler progress"
+            )
+        self.trace.append((task.tid, op[0], op[1]))
+        if self.on_op is not None:
+            self.on_op(task, op)
+
+    # --- enabledness ------------------------------------------------------
+
+    def _others_done(self, task: Task) -> bool:
+        return all(t is task or t.state == DONE for t in self.tasks)
+
+    def _enabled(self, task: Task) -> bool:
+        op = task.pending
+        if op is None:
+            return False
+        kind = op[0]
+        if kind == "acquire":
+            lock: MCLock = task.wait_obj
+            return lock.owner is None or (lock.reentrant and lock.owner is task)
+        if kind == "evt_wait":
+            evt: MCEvent = task.wait_obj
+            return evt.flag
+        if kind == "evt_wait_timed":
+            evt = task.wait_obj
+            return evt.flag or self._others_done(task)
+        if kind == "cond_wait":
+            cond: MCCondition = task.wait_obj
+            return task in cond.notified
+        if kind == "cond_wait_timed":
+            cond = task.wait_obj
+            return task in cond.notified or self._others_done(task)
+        return True  # start / fire / step / evt_set / cond_notify / ...
+
+    # --- the drive loop ---------------------------------------------------
+
+    def run(self) -> None:
+        """Execute every spawned task to completion under the
+        controller's schedule. Raises :class:`DeadlockDetected` when no
+        live task is enabled, and re-raises the first task exception
+        (models treat unexpected exceptions as violations)."""
+        for task in self.tasks:
+            task.thread = threading.Thread(
+                target=self._thread_main, args=(task,),
+                name=f"tpumc-{task.tid}-{task.name}", daemon=True,
+            )
+            task.thread.start()
+        try:
+            while True:
+                self._sched_evt.wait()
+                self._sched_evt.clear()
+                live = [t for t in self.tasks if t.state == PARKED]
+                starting = [t for t in self.tasks if t.state == NEW]
+                if starting:
+                    # a freshly spawned thread has not reached its start
+                    # yield yet; let it park before deciding (brief GIL
+                    # handoff, then re-check)
+                    time.sleep(0)
+                    self._sched_evt.set()
+                    continue
+                if not live:
+                    if all(t.state == DONE for t in self.tasks):
+                        break
+                    # a resumed task is still running; wait for its next
+                    # yield (its park/finish sets the event)
+                    continue
+                enabled = [t for t in live if self._enabled(t)]
+                if not enabled:
+                    if any(t.state != DONE and t.state != PARKED
+                           for t in self.tasks):
+                        continue  # someone still running
+                    raise DeadlockDetected(
+                        "no enabled task; pending: " + ", ".join(
+                            f"{t.name}:{t.pending}" for t in live
+                        )
+                    )
+                if len(enabled) == 1:
+                    chosen = enabled[0]
+                else:
+                    chosen = self.controller(self, enabled)
+                if (
+                    self.current is not None
+                    and chosen is not self.current
+                    and self.current in enabled
+                ):
+                    self.preemptions += 1
+                self._resume(chosen)
+        finally:
+            self._teardown()
+        for task in self.tasks:
+            if task.exc is not None:
+                raise task.exc
+
+    def _resume(self, task: Task) -> None:
+        self.current = task
+        task.gate.set()
+
+    def _teardown(self) -> None:
+        """Unwind any still-parked threads (deadlock, abandoned run)."""
+        self._aborting = True
+        for task in self.tasks:
+            if task.state != DONE:
+                task.gate.set()
+        for task in self.tasks:
+            if task.thread is not None:
+                task.thread.join(timeout=5.0)
+
+    # --- introspection ----------------------------------------------------
+
+    def trace_text(self) -> str:
+        """The executed transition trace, one op per line — the replay
+        byte-identity artifact."""
+        return "\n".join(
+            f"{tid} {kind} {name}" for tid, kind, name in self.trace
+        )
+
+
+# ---------------------------------------------------------------------------
+# cooperative primitives (handed out by the lockrank factory seam)
+# ---------------------------------------------------------------------------
+
+
+class MCLock:
+    """Cooperative mutex. Managed tasks park at ``acquire`` until the
+    scheduler picks them with the lock free; unmanaged threads (harness
+    setup, terminal checks — which never run concurrently with model
+    tasks) pass through on simple counters."""
+
+    def __init__(self, sched: MCScheduler, name: str, reentrant: bool) -> None:
+        self.sched = sched
+        self.name = name
+        self.reentrant = reentrant
+        self.owner: Task | None = None
+        self.count = 0
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        task = self.sched.current_task()
+        if task is None:
+            if self.owner is not None:
+                raise RuntimeError(
+                    f"unmanaged thread acquiring MC lock {self.name} held "
+                    f"by task {self.owner.name}"
+                )
+            self.count += 1
+            return True
+        if self.owner is task:
+            if not self.reentrant:
+                raise DeadlockDetected(
+                    f"self-deadlock: task {task.name} re-acquired "
+                    f"non-reentrant lock {self.name}"
+                )
+            self.count += 1
+            self.sched.note(("reacquire", self.name))
+            return True
+        self.sched.perform(("acquire", self.name), wait_obj=self)
+        # scheduled => enabled => free
+        self.owner = task
+        self.count = 1
+        return True
+
+    def release(self) -> None:
+        task = self.sched.current_task()
+        if task is None:
+            self.count -= 1
+            return
+        if self.owner is not task:
+            raise RuntimeError(
+                f"task {task.name} releasing lock {self.name} it does "
+                "not hold"
+            )
+        self.count -= 1
+        if self.count == 0:
+            self.owner = None
+        if self.sched.branch_on_release:
+            self.sched.perform(("release", self.name))
+        else:
+            self.sched.note(("release", self.name))
+
+    def __enter__(self) -> "MCLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        return self.owner is not None or self.count > 0
+
+    def _is_owned(self) -> bool:
+        task = self.sched.current_task()
+        if task is None:
+            return self.count > 0
+        return self.owner is task
+
+
+class MCEvent:
+    """Cooperative event flag: ``wait`` parks until ``set``; a timed
+    wait's timeout branch is enabled only once every other task is done
+    (quiesce semantics — see the module docstring)."""
+
+    def __init__(self, sched: MCScheduler, name: str) -> None:
+        self.sched = sched
+        self.name = name
+        self.flag = False
+
+    def is_set(self) -> bool:
+        return self.flag
+
+    def set(self) -> None:
+        self.sched.perform(("evt_set", self.name))
+        self.flag = True
+
+    def clear(self) -> None:
+        self.flag = False
+        self.sched.note(("evt_clear", self.name))
+
+    def wait(self, timeout: float | None = None) -> bool:
+        task = self.sched.current_task()
+        if task is None:
+            if not self.flag:
+                raise RuntimeError(
+                    f"unmanaged thread waiting on MC event {self.name}"
+                )
+            return True
+        if timeout is None:
+            self.sched.perform(("evt_wait", self.name), wait_obj=self)
+            return True
+        self.sched.perform(("evt_wait_timed", self.name), wait_obj=self)
+        # enabled either because the flag is up or the system quiesced:
+        # the flag distinguishes success from timeout, exactly like
+        # threading.Event.wait's return value
+        return self.flag
+
+
+class MCCondition:
+    """Cooperative condition variable over a reentrant MC lock (the
+    shape ``make_condition`` hands out). FIFO wakeups for determinism."""
+
+    def __init__(self, sched: MCScheduler, name: str) -> None:
+        self.sched = sched
+        self.name = name
+        self._lock = MCLock(sched, name, reentrant=True)
+        self.waiters: list[Task] = []
+        self.notified: set[Task] = set()
+
+    # lock protocol delegation
+    def acquire(self, *a: Any, **kw: Any) -> bool:
+        return self._lock.acquire(*a, **kw)
+
+    def release(self) -> None:
+        self._lock.release()
+
+    def __enter__(self) -> "MCCondition":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.release()
+
+    def wait(self, timeout: float | None = None) -> bool:
+        task = self.sched.current_task()
+        if task is None:
+            raise RuntimeError(
+                f"unmanaged thread waiting on MC condition {self.name}"
+            )
+        if self._lock.owner is not task:
+            raise RuntimeError("cond.wait() without the lock held")
+        depth, self._lock.count = self._lock.count, 0
+        self._lock.owner = None
+        self.waiters.append(task)
+        kind = "cond_wait" if timeout is None else "cond_wait_timed"
+        self.sched.perform((kind, self.name), wait_obj=self)
+        woke = task in self.notified
+        self.notified.discard(task)
+        if task in self.waiters:
+            self.waiters.remove(task)
+        # re-acquire at the saved depth
+        self.sched.perform(("acquire", self.name), wait_obj=self._lock)
+        self._lock.owner = task
+        self._lock.count = depth
+        return woke or timeout is None
+
+    def notify(self, n: int = 1) -> None:
+        self.sched.perform(("cond_notify", self.name))
+        for task in self.waiters[:n]:
+            self.notified.add(task)
+
+    def notify_all(self) -> None:
+        self.notify(len(self.waiters))
+
+
+class _Factory:
+    """The object handed to ``lockrank.set_mc_factory``."""
+
+    def __init__(self, sched: MCScheduler) -> None:
+        self._sched = sched
+
+    def lock(self, name: str) -> MCLock:
+        return MCLock(self._sched, name, reentrant=False)
+
+    def rlock(self, name: str) -> MCLock:
+        return MCLock(self._sched, name, reentrant=True)
+
+    def condition(self, name: str) -> MCCondition:
+        return MCCondition(self._sched, name)
+
+    def event(self, name: str) -> MCEvent:
+        return MCEvent(self._sched, name)
+
+
+# ---------------------------------------------------------------------------
+# ambient session
+# ---------------------------------------------------------------------------
+
+_ACTIVE: MCScheduler | None = None
+
+
+def active_scheduler() -> MCScheduler | None:
+    return _ACTIVE
+
+
+def mc_step(label: str) -> None:
+    """A model-level yield point (a harness loop's iteration boundary).
+    No-op outside an :func:`mc_session` or on unmanaged threads."""
+    sched = _ACTIVE
+    if sched is not None:
+        sched.perform(("step", label))
+
+
+@contextlib.contextmanager  # noqa: E302
+def mc_session(sched: MCScheduler) -> Iterator[MCScheduler]:
+    """Install ``sched`` as the process's model-checking context:
+    the lockrank factory seam hands out cooperative primitives, every
+    ``FAULTS.fire`` yields, and ``TPUSHARE_MC=1`` is set for code that
+    wants to know. Restores everything on exit — including on the
+    explorer's abandon paths."""
+    import os
+
+    from gpushare_device_plugin_tpu.utils import lockrank
+    from gpushare_device_plugin_tpu.utils.faults import FAULTS
+
+    global _ACTIVE
+    if _ACTIVE is not None:
+        raise RuntimeError("nested mc_session")
+    _ACTIVE = sched
+    lockrank.set_mc_factory(sched.factory())
+
+    def fire_hook(point: str) -> None:
+        sched.perform(("fire", point))
+
+    FAULTS.set_yield_hook(fire_hook)
+    os.environ["TPUSHARE_MC"] = "1"
+    try:
+        yield sched
+    finally:
+        os.environ.pop("TPUSHARE_MC", None)
+        FAULTS.set_yield_hook(None)
+        lockrank.set_mc_factory(None)
+        _ACTIVE = None
+
+
