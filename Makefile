@@ -1,7 +1,7 @@
 PY ?= python
 PROTOC ?= protoc
 
-.PHONY: proto native test test-fast test-slow test-stress chaos chaos-restart chaos-move chaos-shard mc mc-smoke lint lint-strict typecheck bench bench-smoke bench-serve-smoke bench-multichip-smoke bench-paged-smoke bench-defrag-smoke bench-interference-smoke bench-scale bench-scale-smoke bench-wal bench-trace bench-decisions trace-smoke decisions-smoke e2e-kind
+.PHONY: proto native test test-fast test-slow test-stress chaos chaos-restart chaos-move chaos-shard chaos-handoff mc mc-smoke lint lint-strict typecheck bench bench-smoke bench-serve-smoke bench-multichip-smoke bench-paged-smoke bench-defrag-smoke bench-interference-smoke bench-disagg-smoke bench-scale bench-scale-smoke bench-wal bench-trace bench-decisions trace-smoke decisions-smoke e2e-kind
 
 # Regenerate protobuf message classes (gRPC bindings are hand-written in
 # gpushare_device_plugin_tpu/plugin/api/api_grpc.py; grpc_tools is not
@@ -69,6 +69,20 @@ chaos-restart:
 # suite alone with the lock-order witness on.
 chaos-move:
 	TPUSHARE_LOCK_WITNESS=1 $(PY) -m pytest tests/test_defrag.py -x -q
+
+# Prefill/decode KV-handoff chaos (docs/robustness.md, docs/serving.md):
+# the daemon is SIGKILLed at every handoff-journal step (handoff.export/
+# transfer/import/commit), in BOTH --wal-fsync modes, with the decode
+# tier surviving AND with the decode tier restarted empty. The
+# reconciler must converge — no lost request, no duplicated delivery,
+# no leaked/double-booked destination page, no pending handoff entry —
+# and the engine-level tests gate greedy tokens BIT-IDENTICAL to a
+# unified engine (transfer, forced-fallback re-prefill, and prefill-
+# tier-outage paths) with zero retraces. The protocol half runs inside
+# tier-1 ('not slow'); this target runs the whole suite alone with the
+# lock-order witness on.
+chaos-handoff:
+	TPUSHARE_LOCK_WITNESS=1 $(PY) -m pytest tests/test_handoff.py -x -q
 
 # Sharded-extender 2PC chaos (docs/robustness.md): SIGKILL (simulated
 # crash) at every "gang2pc" journal step — prepare, reserve, decide,
@@ -197,6 +211,17 @@ bench-defrag-smoke:
 # tests/test_bench_interference_smoke.py. See docs/observability.md.
 bench-interference-smoke:
 	$(PY) bench_mfu.py --interference-smoke
+
+# Disaggregated-serving smoke (CPU, seconds): ONLY the serve_disagg
+# section — a prefill tier + decode tier joined by the journaled KV
+# handoff vs a unified engine at EQUAL total HBM on a bimodal
+# long-prefill trace. Hard gates even in smoke: token parity (transfer
+# AND forced re-prefill fallback), zero retraces, zero dropped
+# requests; the TTFT/TPOT p99 deltas are reported, gated in the full
+# run. Tier-1 runs it via tests/test_bench_disagg_smoke.py. See
+# docs/serving.md.
+bench-disagg-smoke:
+	$(PY) bench_mfu.py --disagg-smoke
 
 # Group-commit WAL A/B: the 16-way admission storm with the journal in
 # per-record-fsync ('always') then group-commit ('batch') mode. Reports

@@ -1332,6 +1332,30 @@ def prefix_hit_guard(ratio: float | None, repo: Path) -> str | None:
     )
 
 
+def disagg_ttft_guard(p99_ms: float | None, repo: Path) -> str | None:
+    """Failure message when the disaggregated plane's end-to-end TTFT
+    p99 (``disagg_ttft_p99_ms``, the serve_disagg section) grew
+    >P99_GUARD_PCT over the newest committed record carrying it; None
+    when within budget or no history. The improvement-vs-unified bar is
+    hard-gated inside bench_mfu on the full run; this guards the trend —
+    a handoff change that still "wins" but ships first tokens later than
+    it used to is a regression."""
+    return _pct_trend_guard(
+        p99_ms, repo, field="disagg_ttft_p99_ms",
+        label="disagg ttft_p99", fmt=".2f", unit="ms",
+    )
+
+
+def disagg_tpot_guard(p99_ms: float | None, repo: Path) -> str | None:
+    """Same budget for the decode tier's inter-token latency tail
+    (``disagg_tpot_p99_ms``): the other half of the disaggregation
+    contract — prefill stays off the decode tier's step clock."""
+    return _pct_trend_guard(
+        p99_ms, repo, field="disagg_tpot_p99_ms",
+        label="disagg tpot_p99", fmt=".2f", unit="ms",
+    )
+
+
 def interference_guard(pct: float | None, repo: Path) -> str | None:
     """Failure message when the interference bench's governor-OFF p99
     inflation (``interference_p99_inflation_pct``) DROPPED >25% vs the
@@ -1947,6 +1971,14 @@ def main(argv=None) -> int:
         .get("paged", {}).get("goodput_tokens_per_s"),
         "serve_prefix_hit_ratio": compute.get("serve_paged", {})
         .get("prefix_hit_ratio"),
+        # Disaggregated-serving numbers (serve_disagg section), hoisted
+        # for the trend guards: end-to-end TTFT p99 and decode-tier TPOT
+        # p99 across the journaled KV handoff (the parity/zero-retrace/
+        # zero-drop invariants hard-gate inside bench_mfu itself).
+        "disagg_ttft_p99_ms": compute.get("serve_disagg", {})
+        .get("disagg_ttft_p99_ms"),
+        "disagg_tpot_p99_ms": compute.get("serve_disagg", {})
+        .get("disagg_tpot_p99_ms"),
         # Interference bench numbers (serve_interference section),
         # hoisted for the trend guard: the governor-OFF inflation is the
         # scenario's signal strength (the governed/overhead bounds hard-
@@ -2001,6 +2033,8 @@ def main(argv=None) -> int:
         msgs.append(interference_guard(
             record["interference_p99_inflation_pct"], repo
         ))
+        msgs.append(disagg_ttft_guard(record["disagg_ttft_p99_ms"], repo))
+        msgs.append(disagg_tpot_guard(record["disagg_tpot_p99_ms"], repo))
         msgs.append(gang_storm_guard(record["gang_throughput_gangs_s"], repo))
         msgs.append(defrag_stranded_guard(record["defrag_stranded_after_pct"], repo))
         msgs.append(defrag_binpack_guard(record["defrag_binpack_after_pct"], repo))

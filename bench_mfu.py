@@ -1449,6 +1449,204 @@ def bench_serve_interference(report: dict, smoke: bool = False) -> None:
         )
 
 
+def bench_serve_disagg(report: dict, smoke: bool = False) -> None:
+    """Disaggregated prefill/decode serving vs ONE unified paged engine
+    at EQUAL total HBM (the two tiers together hold exactly the unified
+    engine's page budget), on a bimodal long-prefill Poisson trace — the
+    workload disaggregation exists for: in a unified engine every long
+    prefill chunk steals decode steps from all in-flight requests (TPOT
+    inflation) and queues behind them (TTFT inflation); a dedicated
+    prefill tier absorbs the long prompts and ships finished KV through
+    the journaled export→transfer→import→commit handoff
+    (``serving/handoffproto.py``).
+
+    Hard gates (smoke included): zero dropped requests, zero retraces on
+    every engine, >= 1 KV transfer actually delivered, and tokens
+    BIT-IDENTICAL to the unified engine — both on the live transfer path
+    AND with the transfer path forced dead (``BrokenTransport`` →
+    retry → re-prefill fallback; the degradation ladder loses latency,
+    never requests or token identity). The full TPU run additionally
+    gates the point of the architecture: end-to-end TTFT p99 AND TPOT
+    p99 both improve vs unified at equal total HBM. The row's
+    ``disagg_ttft_p99_ms`` / ``disagg_tpot_p99_ms`` feed bench.py's 25%
+    trend guards.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from gpushare_device_plugin_tpu.serving import (
+        BrokenTransport,
+        DisaggServer,
+        PagedSlotEngine,
+        Request,
+    )
+    from gpushare_device_plugin_tpu.serving.engine import ceil_rank_quantile
+    from gpushare_device_plugin_tpu.workloads.quant import cast_decoder
+    from gpushare_device_plugin_tpu.workloads.transformer import (
+        TransformerConfig,
+        init_params,
+    )
+
+    if smoke:
+        cfg = TransformerConfig(
+            vocab=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+            d_ff=64, max_seq=64, compute_dtype=jnp.float32,
+        )
+        params = init_params(jax.random.key(0), cfg)
+        max_len, page, chunk = 32, 4, 4
+        n_req, rate = 10, 0.3
+        short, long_, mix = (2, 8), (12, 20), (2, 4, 8)
+        p_slots, d_slots = 2, 4
+    else:
+        cfg = _bench_cfg(smoke)
+        params = jax.jit(lambda k: cast_decoder(init_params(k, cfg)))(
+            jax.random.key(0)
+        )
+        max_len, page, chunk = 1024, 64, 256
+        n_req, rate = 24, 0.1
+        short, long_, mix = (16, 64), (512, 768), (16, 32, 128)
+        p_slots, d_slots = 4, 8
+    eos = 2
+    # Bimodal long-prefill trace (hand-built: poisson_trace draws
+    # prompt lengths uniformly, this workload is exactly NOT uniform):
+    # every 4th request is a long-document prompt, the rest are chat-
+    # length. Same trace for all engines — parity is per-request.
+    rng = np.random.RandomState(17)
+    reqs = []
+    t = 0.0
+    for rid in range(n_req):
+        t += float(rng.exponential(1.0 / rate))
+        lo, hi = long_ if rid % 4 == 3 else short
+        plen = int(rng.randint(lo, hi + 1))
+        reqs.append(Request(
+            rid=rid,
+            prompt=tuple(int(x) for x in rng.randint(0, cfg.vocab, plen)),
+            max_new=int(mix[int(rng.randint(len(mix)))]),
+            arrival=t,
+        ))
+    pages_per = -(-max_len // page)
+    p_pages, d_pages = p_slots * pages_per, d_slots * pages_per
+
+    def mk_engine(slots, pages):
+        return PagedSlotEngine(
+            params, cfg, slots=slots, max_len=max_len, total_pages=pages,
+            page_size=page, prefill_chunk=chunk, eos_id=eos,
+        )
+
+    # The control: one unified engine with the SAME page budget and the
+    # decode tier's slot count (the disagg side buys its prefill slots
+    # out of the same HBM, not extra).
+    unified = mk_engine(d_slots, p_pages + d_pages)
+    unified.warmup()
+    u_warm = dict(unified.trace_counts)
+    u_stats = unified.run(reqs)
+    u_retraces = sum(unified.trace_counts[k] - u_warm[k] for k in u_warm)
+    u_tokens = {r.rid: list(r.tokens) for r in u_stats.results}
+    u_ttft = [r.ttft_ticks for r in u_stats.results]
+    u_tpot = [r.tpot_ticks for r in u_stats.results if len(r.tokens) > 1]
+
+    def run_disagg(**kw):
+        ds = DisaggServer(
+            mk_engine(p_slots, p_pages), mk_engine(d_slots, d_pages),
+            node="bench", **kw,
+        )
+        ds.warmup()
+        warm = (dict(ds.prefill.trace_counts), dict(ds.decode.trace_counts))
+        out = ds.serve(reqs)
+        retraces = sum(
+            ds.prefill.trace_counts[k] - warm[0][k] for k in warm[0]
+        ) + sum(ds.decode.trace_counts[k] - warm[1][k] for k in warm[1])
+        mismatch = [
+            rid for rid, e in out["results"].items()
+            if e["tokens"] != u_tokens.get(rid)
+        ]
+        return ds, out, retraces, mismatch
+
+    ds, out, retraces, mismatch = run_disagg()
+    fb, fb_out, fb_retraces, fb_mismatch = run_disagg(
+        transport=BrokenTransport(), peer_kwargs={"attempts": 2},
+    )
+    ttft = [
+        e["ttft_ticks"] for e in out["results"].values()
+        if e["ttft_ticks"] is not None
+    ]
+    tpot = [
+        e["tpot_ticks"] for e in out["results"].values()
+        if e["tpot_ticks"] is not None
+    ]
+    ttft_p99 = ceil_rank_quantile(ttft, 0.99)
+    tpot_p99 = ceil_rank_quantile(tpot, 0.99)
+    u_ttft_p99 = ceil_rank_quantile(u_ttft, 0.99)
+    u_tpot_p99 = ceil_rank_quantile(u_tpot, 0.99)
+    # ticks → ms at the measured mean tick duration, so bench.py's trend
+    # guards watch a wall-clock-scaled number (the tick counts themselves
+    # are deterministic; the scale is this run's step cost)
+    pstats, dstats = out["prefill"], out["decode"]
+    wall = (pstats.wall_s if pstats else 0.0) + dstats.wall_s
+    ticks = (pstats.ticks if pstats else 0) + dstats.ticks
+    tick_ms = wall * 1e3 / max(ticks, 1)
+    row = {
+        "requests": n_req,
+        "long_prompt_every": 4,
+        "page_size": page,
+        "total_pages": p_pages + d_pages,
+        "prefill_tier": {"slots": p_slots, "pages": p_pages},
+        "decode_tier": {"slots": d_slots, "pages": d_pages},
+        "unified": {"slots": d_slots, "pages": p_pages + d_pages},
+        "paths": sorted({e["path"] for e in out["results"].values()}),
+        "outcomes": dict(ds.outcomes),
+        "fallback_outcomes": dict(fb.outcomes),
+        "retraces": u_retraces + retraces + fb_retraces,
+        "unified_ttft_p99_ticks": u_ttft_p99,
+        "unified_tpot_p99_ticks": u_tpot_p99,
+        "disagg_ttft_p99_ticks": ttft_p99,
+        "disagg_tpot_p99_ticks": tpot_p99,
+        "disagg_ttft_p99_ms": round(ttft_p99 * tick_ms, 3),
+        "disagg_tpot_p99_ms": round(tpot_p99 * tick_ms, 3),
+        "ttft_p99_ratio": round(ttft_p99 / max(u_ttft_p99, 1e-9), 3),
+        "tpot_p99_ratio": round(tpot_p99 / max(u_tpot_p99, 1e-9), 3),
+    }
+    report["serve_disagg"] = row
+    print(f"serve_disagg {row}", file=sys.stderr)
+    if out["dropped"] or fb_out["dropped"]:
+        raise AssertionError(
+            f"disaggregation dropped requests (transfer run "
+            f"{out['dropped']}, fallback run {fb_out['dropped']}) — the "
+            "degradation ladder may lose latency, never requests"
+        )
+    if mismatch or fb_mismatch:
+        raise AssertionError(
+            f"disagg tokens diverged from unified (transfer run "
+            f"{mismatch[:5]}, forced-fallback run {fb_mismatch[:5]}) — "
+            "migrated KV must be bit-identical, and so must re-prefill"
+        )
+    if row["retraces"]:
+        raise AssertionError(
+            f"{row['retraces']} retraces across the three engines — KV "
+            "handoff is data movement, not a shape change; zero "
+            "recompiles allowed"
+        )
+    if ds.outcomes.get("delivered", 0) < 1:
+        raise AssertionError(
+            "no KV transfer was delivered on the live-transport run — "
+            "the handoff path is dead and the bench degenerated to "
+            "re-prefill"
+        )
+    if fb.outcomes.get("fallback", 0) < 1:
+        raise AssertionError(
+            "BrokenTransport run never took the re-prefill fallback — "
+            "the forced-failure leg is vacuous"
+        )
+    if not smoke and (ttft_p99 >= u_ttft_p99 or tpot_p99 >= u_tpot_p99):
+        raise AssertionError(
+            f"disaggregation did not beat unified at equal HBM: TTFT "
+            f"p99 {ttft_p99} vs {u_ttft_p99} ticks, TPOT p99 {tpot_p99} "
+            f"vs {u_tpot_p99} ticks — the two-tier split must improve "
+            "BOTH on the bimodal long-prefill trace"
+        )
+
+
 def bench_sweep(report: dict, smoke: bool = False) -> None:
     """Flash block-size sweep (opt-in via --sweep): honest-timed wall per
     (block_q, block_k) at the bench shapes, to re-tune the defaults that
@@ -1581,6 +1779,16 @@ def parse_args(argv: list[str] | None = None) -> argparse.Namespace:
         "tests/test_bench_interference_smoke.py)",
     )
     p.add_argument(
+        "--disagg-smoke", action="store_true",
+        help="CPU disaggregated-serving smoke: ONLY the serve_disagg "
+        "section (prefill/decode tiers vs one unified engine at equal "
+        "total HBM, bimodal long-prefill trace; hard-fails on dropped "
+        "requests, token divergence on the transfer OR forced-fallback "
+        "path, retraces, or a dead transfer path) (make "
+        "bench-disagg-smoke; tier-1 via "
+        "tests/test_bench_disagg_smoke.py)",
+    )
+    p.add_argument(
         "--backend-init-timeout", type=float, default=60.0,
         help="seconds the subprocess backend-init probe may take before "
         "the run is skipped with an explicit reason (the old in-process "
@@ -1594,6 +1802,7 @@ def main(argv: list[str] | None = None) -> int:
     smoke = (
         args.smoke or args.serve_smoke or args.multichip_smoke
         or args.paged_smoke or args.interference_smoke
+        or args.disagg_smoke
     )
     if smoke:
         # Force, don't default: an inherited JAX_PLATFORMS (axon/tpu) would
@@ -1697,6 +1906,7 @@ def main(argv: list[str] | None = None) -> int:
         ("serve_tp", bench_serve_tp),
         ("serve_paged", bench_serve_paged),
         ("serve_interference", bench_serve_interference),
+        ("serve_disagg", bench_serve_disagg),
     ]
     if args.serve_smoke:
         # ONLY serve_engine, by contract (the smoke test and the verify
@@ -1712,6 +1922,9 @@ def main(argv: list[str] | None = None) -> int:
     elif args.interference_smoke:
         # ONLY serve_interference, same single-section contract
         sections = [("serve_interference", bench_serve_interference)]
+    elif args.disagg_smoke:
+        # ONLY serve_disagg, same single-section contract
+        sections = [("serve_disagg", bench_serve_disagg)]
     else:
         if args.ablate:
             sections.append(("ablate", bench_ablate))

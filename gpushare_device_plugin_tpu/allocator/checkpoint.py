@@ -735,6 +735,17 @@ def replay_checkpoint(ckpt: AllocationCheckpoint, assume: AssumeCache) -> int:
             except (KeyError, TypeError, ValueError):
                 log.warning("checkpoint replay: malformed move entry for %s", key)
                 continue
+        elif kind == "handoff":
+            # a prefill->decode KV handoff died mid-protocol
+            # (serving/handoffproto.py). Nothing to re-install in the
+            # chip ledger: the destination pages live inside the decode
+            # engine's own refcounted page pool (its import ledger holds
+            # or releases them), not in per-chip HBM accounting. The
+            # entry itself stays pending — that IS the protection — and
+            # the reconciler resolves it by phase: roll forward
+            # (re-deliver idempotently by handoff id) at or past
+            # "import", roll back to a local re-prefill before it.
+            pass
         else:
             log.warning("checkpoint replay: unknown entry kind %r for %s", kind, key)
             continue

@@ -128,7 +128,10 @@ def _engine_cell(row: dict[str, float]) -> str:
     occupancy, radix prefix-cache hit ratio, and the preemption count —
     the ``tpushare_engine_*`` families scraped from the pod's
     ``/metrics`` endpoint (``inspect.parse_engine_metrics`` keys, prefix
-    already stripped)."""
+    already stripped). A disaggregated pod's ``tpushare_handoff_*``
+    counters (folded into the row under ``handoff_*`` keys) append the
+    KV-handoff story: transfers delivered, re-prefill fallbacks, pages
+    still staged in flight."""
     parts = []
     total = row.get("kv_pages_total")
     if total is not None:
@@ -142,6 +145,19 @@ def _engine_cell(row: dict[str, float]) -> str:
     pre = row.get("preemptions_total", row.get("preemptions"))
     if pre is not None:
         parts.append(f"preempt {int(pre)}")
+    if any(k.startswith("handoff_") for k in row):
+        parts.append(
+            f"handoff {int(row.get('handoff_transfers_total_delivered', 0))}"
+        )
+        reprefill = sum(
+            v for k, v in row.items()
+            if k.startswith("handoff_fallback_reprefill_total")
+        )
+        if reprefill:
+            parts.append(f"reprefill {int(reprefill)}")
+        inflight = row.get("handoff_pages_in_flight", 0.0)
+        if inflight:
+            parts.append(f"inflight {int(inflight)}")
     return " · ".join(parts) or "-"
 
 
@@ -325,6 +341,11 @@ def _score_cell(sv: dict) -> str:
 
 def _placement_cell(placement: dict) -> str:
     parts = []
+    if placement.get("group"):
+        cell = f"group {placement['group']}"
+        if placement.get("members"):
+            cell += f" ({placement['members']} members)"
+        parts.append(cell)
     if "chip" in placement:
         parts.append(f"chip {placement['chip']}")
     if "chips" in placement:
@@ -337,6 +358,20 @@ def _placement_cell(placement: dict) -> str:
         parts.append(f"{placement['units']} units")
     if "per_chip" in placement:
         parts.append(f"{placement['per_chip']} units/chip")
+    if placement.get("tier"):
+        parts.append(f"tier {placement['tier']}")
+    if placement.get("tiers"):
+        # a disaggregated two-tier slice: the group's composition,
+        # prefill first (the catalog order), then anything else by name
+        order = {t: i for i, t in enumerate(const.SERVING_TIERS)}
+        names = sorted(
+            placement["tiers"], key=lambda t: (order.get(t, len(order)), t)
+        )
+        parts.append(
+            "tiers " + " + ".join(
+                f"{placement['tiers'][t]} {t}" for t in names
+            )
+        )
     if placement.get("source"):
         parts.append(f"[{placement['source']}]")
     return " · ".join(parts) or "-"
@@ -653,9 +688,15 @@ def render_details(
             p.workload_class != const.WORKLOAD_LATENCY_CRITICAL
             for p in info.pods
         )
+        # likewise the TIER column: only when some pod declares a
+        # disaggregated-serving tier (serving/handoff.py) — unified
+        # fleets keep the reference layout
+        any_tier = any(p.serving_tier for p in info.pods)
         header = ["NAMESPACE", "NAME", f"TPU MEMORY ({unit})", "CHIPS"]
         if any_class:
             header.append("CLASS")
+        if any_tier:
+            header.append("TIER")
         if any_gang:
             header.append("GANG (shape @ coords)")
         if any_engine:
@@ -669,6 +710,8 @@ def render_details(
             row = [pod.namespace, pod.name, str(pod.total_units), chips]
             if any_class:
                 row.append(pod.workload_class)
+            if any_tier:
+                row.append(pod.serving_tier or "-")
             if any_gang:
                 row.append(_gang_cell(pod, info, unit) if pod.is_gang else "-")
             if any_engine:

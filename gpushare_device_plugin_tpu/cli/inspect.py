@@ -35,6 +35,7 @@ from ..utils.metric_catalog import (
     BUILD_INFO,
     PREFIX_ENGINE,
     PREFIX_GOVERNOR,
+    PREFIX_HANDOFF,
     PREFIX_SLO,
 )
 from ..utils.retry import retry
@@ -104,10 +105,22 @@ def parse_engine_metrics(text: str) -> dict[str, dict[str, float]]:
     label (``""`` for unlabeled engines). Families: KV page occupancy
     (``kv_pages_total/used/free``), ``prefix_hit_ratio``,
     ``prefix_cached_pages``, and the ``preemptions`` gauge /
-    ``preemptions_total`` counter."""
+    ``preemptions_total`` counter.
+
+    The disaggregated-serving ``tpushare_handoff_*`` families
+    (utils/metric_catalog.py) fold into the same per-pod row under
+    ``handoff_*`` keys — an ``outcome``/``reason`` label folds into the
+    key (``handoff_transfers_total_delivered``); histogram buckets are
+    skipped, the ``_sum``/``_count`` samples carry what the CLI shows."""
     out: dict[str, dict[str, float]] = {}
     for line in text.splitlines():
-        if not line.startswith(PREFIX_ENGINE) or line.startswith("#"):
+        if line.startswith("#"):
+            continue
+        if line.startswith(PREFIX_ENGINE):
+            prefix, fold = PREFIX_ENGINE, ""
+        elif line.startswith(PREFIX_HANDOFF):
+            prefix, fold = PREFIX_HANDOFF, "handoff_"
+        else:
             continue
         try:
             metric, value = line.rsplit(None, 1)
@@ -116,10 +129,17 @@ def parse_engine_metrics(text: str) -> dict[str, dict[str, float]]:
             continue
         pod = ""
         name = metric
+        labels: dict[str, str] = {}
         if "{" in metric:
             name, raw = metric.split("{", 1)
-            pod = _parse_prom_labels(raw.rstrip("}")).get("pod", "")
-        short = name[len(PREFIX_ENGINE):]
+            labels = _parse_prom_labels(raw.rstrip("}"))
+            pod = labels.get("pod", "")
+        if name.endswith("_bucket") or "le" in labels:
+            continue
+        short = fold + name[len(prefix):]
+        for extra in ("outcome", "reason"):
+            if labels.get(extra):
+                short += f"_{labels[extra]}"
         out.setdefault(pod, {})[short] = val
     return out
 
@@ -693,6 +713,14 @@ def render_json(
                     "name": p.name,
                     "units_by_chip": {str(k): v for k, v in p.units_by_chip.items()},
                     "workload_class": p.workload_class,
+                    # disaggregated-serving tier: emitted only when the
+                    # pod declares one, preserving the no-disagg
+                    # reference document
+                    **(
+                        {"serving_tier": p.serving_tier}
+                        if p.serving_tier
+                        else {}
+                    ),
                     **(
                         {
                             "gang_shape": p.gang_shape,

@@ -35,6 +35,11 @@ class PodUsage:
     # interference plane's victim/aggressor split, rendered as a CLASS
     # column when any pod on the node is best-effort
     workload_class: str = const.WORKLOAD_LATENCY_CRITICAL
+    # disaggregated-serving tier (tpushare.aliyun.com/serving-tier:
+    # prefill/decode); "" for unified serving pods — the TIER column
+    # appears only when some pod on the report declares one, preserving
+    # the no-disagg reference layout
+    serving_tier: str = ""
 
     @property
     def total_units(self) -> int:
@@ -179,6 +184,7 @@ def build_node_info(
                 gang_shape=P.annotations(pod).get(const.ENV_GANG_SHAPE, ""),
                 gang_per_chip=P.gang_per_chip_units(pod),
                 workload_class=P.workload_class(pod),
+                serving_tier=P.serving_tier(pod),
             )
         )
         for idx, units in usage.items():

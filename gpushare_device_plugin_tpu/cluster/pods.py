@@ -169,6 +169,16 @@ def gang_group(pod: Pod) -> str:
     return str(annotations(pod).get(const.ANN_GANG_GROUP, "") or "")
 
 
+def serving_tier(pod: Pod) -> str:
+    """The pod's disaggregated-serving tier (``ANN_SERVING_TIER``:
+    "prefill" or "decode"), "" for unified serving pods or unknown
+    values. One helper so group admission, the inspect CLI's TIER
+    column, and `inspect why`'s two-tier composition can never disagree
+    about which side of the KV handoff a member serves."""
+    v = str(annotations(pod).get(const.ANN_SERVING_TIER, "") or "").strip()
+    return v if v in const.SERVING_TIERS else ""
+
+
 def gang_chips_from_annotation(pod: Pod) -> list[int]:
     """Member chip indices of a GRANTED gang (``ENV_GANG_CHIPS``), [] when
     absent/garbled — same tolerance as ``core_ids_from_annotation``."""

@@ -86,6 +86,8 @@ class DriftReconciler:
         interval_s: float = DEFAULT_INTERVAL_S,
         on_fenced: Callable[[], None] | None = None,
         move_restore_fn: Callable[[PodKey, dict | None], None] | None = None,
+        handoff_deliver_fn: Callable[[str, dict], str] | None = None,
+        handoff_abort_fn: Callable[[str], Any] | None = None,
     ) -> None:
         """``kubelet_grants_fn() -> dict[PodKey, list[str]]`` supplies
         kubelet's granted device IDs per pod when a feed exists (the fake
@@ -93,7 +95,12 @@ class DriftReconciler:
         skips that diff. ``on_fenced()`` fires once when this instance
         discovers it was superseded. ``move_restore_fn(pod_key, snapshot)``
         re-admits a drained engine snapshot on the destination slice when
-        a defragmentation move is rolled forward (allocator/defrag.py)."""
+        a defragmentation move is rolled forward (allocator/defrag.py).
+        ``handoff_deliver_fn(handoff_id, record)`` /
+        ``handoff_abort_fn(handoff_id)`` are the decode tier's idempotent
+        delivery sink and staging release for journaled KV handoffs found
+        mid-protocol (serving/handoffproto.py); without a deliver hook a
+        handoff entry stays pending — protective, never resolved blind."""
         self._api = api
         self._pods = pod_source
         self._assume = assume
@@ -104,6 +111,8 @@ class DriftReconciler:
         self._interval = interval_s
         self._on_fenced = on_fenced
         self._move_restore = move_restore_fn
+        self._handoff_deliver = handoff_deliver_fn
+        self._handoff_abort = handoff_abort_fn
         self._fenced_notified = False
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -255,6 +264,26 @@ class DriftReconciler:
                 )
                 if outcome is not None:
                     drift(f"move_{outcome}", repaired=True)
+                continue
+            if data.get("kind") == "handoff":
+                # a prefill->decode KV handoff found mid-protocol:
+                # resolved by phase — roll forward (re-deliver,
+                # idempotent by handoff id) at or past "import", roll
+                # back to a local re-prefill before it. BOTH directions
+                # end in a delivery through the decode tier's sink, so
+                # the request is served exactly once whatever step the
+                # crash hit (serving/handoffproto.py owns the rules).
+                if self._handoff_deliver is None:
+                    continue  # no decode tier wired: stay protective
+                from ..serving import handoffproto
+
+                outcome = handoffproto.resolve_handoff(
+                    self._ckpt, self._assume, key, data,
+                    deliver_fn=self._handoff_deliver,
+                    abort_fn=self._handoff_abort,
+                )
+                if outcome is not None:
+                    drift(f"handoff_{outcome}", repaired=True)
                 continue
             pod, authoritative = self._fetch_pod(key)
             if not authoritative:

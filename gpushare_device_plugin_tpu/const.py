@@ -73,6 +73,18 @@ ANN_GANG_SHAPE = "tpushare.aliyun.com/gang-shape"
 # reservation before any member binds, and a leader decision commits or
 # aborts the whole group.
 ANN_GANG_GROUP = "tpushare.aliyun.com/gang-group"
+# Disaggregated-serving tier of a group member (serving/handoff.py): a
+# two-tier slice is admitted as ONE gang group — a prefill gang plus a
+# decode gang, all-or-nothing through the same cross-shard two-phase
+# reserve — with each member pod declaring which tier it serves. The
+# SLO router scales the tiers independently (TTFT pressure -> prefill
+# capacity, TPOT pressure -> decode capacity); the inspect CLI renders
+# the composition as a TIER column and in `inspect why`. Absent = a
+# unified (non-disaggregated) serving pod; unknown values are ignored.
+ANN_SERVING_TIER = "tpushare.aliyun.com/serving-tier"
+SERVING_TIER_PREFILL = "prefill"
+SERVING_TIER_DECODE = "decode"
+SERVING_TIERS = (SERVING_TIER_PREFILL, SERVING_TIER_DECODE)
 # Persisted gang decision (annotations on the pod, mirrored into env):
 # comma-separated member chip indices, the normalized shape, and the HBM
 # units claimed on EACH member chip. A gang is only ever persisted whole

@@ -1056,16 +1056,32 @@ class ShardRouter:
         aborts every prepared member (presumed abort); a crash anywhere
         is resolved by :func:`resolve_gang2pc` with zero partial
         gangs."""
+        # a refused group deserves a "why" as much as an admitted one —
+        # every exit below emits a decision record (error or per-member)
         if not pods:
+            DECISIONS.emit(
+                "", "gang-group", outcome="error",
+                reason="empty gang group",
+            )
             return {"error": "empty gang group", "members": []}
         group = P.gang_group(pods[0])
+        meta = pods[0].get("metadata", {})
+        first_key = f"{meta.get('namespace', 'default')}/{meta.get('name', '')}"
         if not group or any(P.gang_group(p) != group for p in pods):
+            DECISIONS.emit(
+                first_key, "gang-group", outcome="error",
+                reason="pods do not share one gang-group id",
+            )
             return {
                 "error": "pods do not share one gang-group id",
                 "members": [],
             }
         plan, plan_err = self._plan_group(pods)
         if plan_err:
+            DECISIONS.emit(
+                first_key, "gang-group", outcome="error",
+                reason=plan_err,
+            )
             return {"error": plan_err, "members": [], "group": group}
         coordinator_id = self._ring.owner(f"gang-group:{group}")
         epoch = self._lease.acquire(group, coordinator_id)
@@ -1106,6 +1122,12 @@ class ShardRouter:
                             done["name"], done["shard"], e,
                         )
                 self._lease.forget(group)
+                DECISIONS.emit(
+                    f"{member['ns']}/{member['name']}", "gang-group",
+                    outcome="error", node=member["node"],
+                    reason=f"prepare failed: {reason}",
+                    shard=member["shard"],
+                )
                 return {
                     "error": f"prepare failed for {member['name']}: {reason}",
                     "members": [], "group": group,
@@ -1131,6 +1153,10 @@ class ShardRouter:
                     # back (or will, next pass)
                     pass
             self._lease.forget(group)
+            DECISIONS.emit(
+                first_key, "gang-group", outcome="error",
+                reason=f"fenced at the decision point: {e}",
+            )
             return {
                 "error": f"fenced at the decision point: {e}",
                 "members": [], "group": group,
@@ -1154,48 +1180,88 @@ class ShardRouter:
         REGISTRY.counter_inc(
             TWOPC_METRIC, TWOPC_HELP, phase="decide", outcome="commit",
         )
+        # Decision provenance, per member, once the group's commit record
+        # is durable: `inspect why` renders the all-or-nothing GROUP
+        # admission — and for a disaggregated two-tier slice, which tier
+        # each member serves and the group's tier composition.
+        tiers: dict[str, int] = {}
+        for m in plan:
+            if m.get("tier"):
+                tiers[m["tier"]] = tiers.get(m["tier"], 0) + 1
+        for m in plan:
+            DECISIONS.emit(
+                f"{m['ns']}/{m['name']}", "gang-group",
+                node=m["node"],
+                placement={
+                    "group": group,
+                    "members": len(plan),
+                    "chips": list(m["chips"]),
+                    "shape": m["shape"],
+                    "per_chip": m["units"],
+                    **({"tier": m["tier"]} if m.get("tier") else {}),
+                    **({"tiers": tiers} if tiers else {}),
+                },
+                seq=decision_seq,
+                shard=m["shard"],
+            )
         errors: list[str] = []
-        for member in plan:
-            shard = self._shards[member["shard"]]
-            try:
-                ok, reason = shard.commit_gang(
-                    group, member["ns"], member["name"], epoch,
-                    total_request=member["request"],
-                )
-            except (ShardUnavailable, ApiError, OSError,
-                    StaleCoordinator) as e:
-                # the decision is durable — a member whose shard dropped
-                # out (or fenced this driver) mid-commit is the
-                # reconciler's to roll forward, never a raised error:
-                # later members still get their commit attempted now
-                ok, reason = False, str(e)
-            if not ok:
-                errors.append(f"{member['name']}: {reason}")
-        if errors:
-            # the decision is durable: the members that did not commit
-            # are the reconciler's to roll forward — the entry stays
-            # pending so resolve_gang2pc finds it
+        try:
+            for member in plan:
+                shard = self._shards[member["shard"]]
+                try:
+                    ok, reason = shard.commit_gang(
+                        group, member["ns"], member["name"], epoch,
+                        total_request=member["request"],
+                    )
+                except (ShardUnavailable, ApiError, OSError,
+                        StaleCoordinator) as e:
+                    # the decision is durable — a member whose shard
+                    # dropped out (or fenced this driver) mid-commit is
+                    # the reconciler's to roll forward, never a raised
+                    # error: later members still get their commit
+                    # attempted now
+                    ok, reason = False, str(e)
+                if not ok:
+                    errors.append(f"{member['name']}: {reason}")
+            if errors:
+                # the decision is durable: the members that did not
+                # commit are the reconciler's to roll forward — the
+                # entry stays pending so resolve_gang2pc finds it
+                self._lease.forget(group)
+                coordinator._drop_finished_epoch(group)
+                return {
+                    "error": "",
+                    "group": group,
+                    "members": [m["name"] for m in plan],
+                    "pending_rollforward": errors,
+                }
+            coordinator._resolve_2pc("commit", decision_key, decision_seq)
+            FAULTS.fire("gang2pc.done")
             self._lease.forget(group)
+            # the decision-point epoch check noted the group on the
+            # coordinator shard; a memberless coordinator has no
+            # side-state whose release would prune it, so drop it here
+            # (no-op while any member side-state still references the
+            # group)
             coordinator._drop_finished_epoch(group)
             return {
-                "error": "",
-                "group": group,
+                "error": "", "group": group,
                 "members": [m["name"] for m in plan],
-                "pending_rollforward": errors,
+                "pending_rollforward": [],
             }
-        coordinator._resolve_2pc("commit", decision_key, decision_seq)
-        FAULTS.fire("gang2pc.done")
-        self._lease.forget(group)
-        # the decision-point epoch check noted the group on the
-        # coordinator shard; a memberless coordinator has no side-state
-        # whose release would prune it, so drop it here (no-op while
-        # any member side-state still references the group)
-        coordinator._drop_finished_epoch(group)
-        return {
-            "error": "", "group": group,
-            "members": [m["name"] for m in plan],
-            "pending_rollforward": [],
-        }
+        finally:
+            # group-level outcome record, keyed under the gang pseudo-
+            # namespace (member pods keep the reference record shape
+            # above): one "why" for the group as a whole, on every exit
+            DECISIONS.emit(
+                f"gang/{group}", "gang-group",
+                reason="; ".join(errors),
+                placement={
+                    "group": group, "members": len(plan),
+                    **({"tiers": tiers} if tiers else {}),
+                },
+                seq=decision_seq,
+            )
 
     def _plan_group(
         self, pods: Sequence[dict]
@@ -1269,6 +1335,10 @@ class ShardRouter:
                 "units": per_chip,
                 "shape": shape,
                 "request": request,
+                # disaggregated-serving tier (serving/handoff.py): a
+                # two-tier slice is one group — prefill gang + decode
+                # gang — and `inspect why` shows the composition
+                "tier": P.serving_tier(pod),
             })
         return plan, ""
 

@@ -12,6 +12,13 @@ cache, and SLO-tiered admission with best-effort preemption
 are the serving half of the interference observability plane: per-slice
 decode-step profiling and the Tally-style best-effort step throttle
 (``docs/observability.md``, interference plane).
+
+``handoffproto`` + ``handoff`` split the engine into a prefill tier and
+a decode tier: the journaled export→transfer→import→commit KV-handoff
+protocol (jax-free core, model-checked by ``tools/tpumc``) and its
+engine binding — page serialization, the :class:`~.handoff.DisaggServer`
+two-tier plane with the re-prefill degradation ladder
+(``docs/serving.md``, disaggregation section).
 """
 
 from .engine import (  # noqa: F401
@@ -32,6 +39,23 @@ from .engine import (  # noqa: F401
     slots_from_pod_env,
 )
 from .governor import StepGovernor  # noqa: F401
+from .handoff import (  # noqa: F401
+    BrokenTransport,
+    DisaggServer,
+    build_handoff_plan,
+    decode_page,
+    encode_page,
+)
+from .handoffproto import (  # noqa: F401
+    HANDOFF_KIND,
+    HANDOFF_PHASES,
+    HandoffImportLedger,
+    HandoffMover,
+    HandoffPeerClient,
+    HandoffPlan,
+    HandoffSink,
+    resolve_handoff,
+)
 from .pages import (  # noqa: F401
     PageAllocator,
     PagedPlan,

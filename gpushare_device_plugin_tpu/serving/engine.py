@@ -799,6 +799,16 @@ class PagedSlotEngine(SlotEngine):
         # moves of a deterministic workload can legitimately carry
         # byte-identical snapshots, and both must serve.
         self._restored_ids: deque[str] = deque(maxlen=16)
+        # Disaggregated prefill/decode serving (serving/handoff.py). On a
+        # PREFILL-tier engine, a request that finishes its prompt with
+        # decode work left is exported (row + KV page bytes) to the sink
+        # instead of decoding here. On a DECODE-tier engine,
+        # _import_seeds maps rid -> a staged KV import (pages already
+        # owned by this engine's allocator, bytes already written by
+        # import_kv_pages); the admission loop adopts the seed straight
+        # into decode state, skipping prefill entirely.
+        self._handoff_sink = None
+        self._import_seeds: dict[int, dict] = {}
 
     def _make_cache(self, kv_dtype: str | None):
         # +1: physical page 0 is the scratch write sink (pages.SCRATCH)
@@ -1040,6 +1050,138 @@ class PagedSlotEngine(SlotEngine):
         if snap_id is not None:
             self._restored_ids.append(snap_id)
         return stats
+
+    # --- disaggregated prefill/decode handoff (serving/handoff.py) --------
+
+    def set_handoff_sink(self, sink) -> None:
+        """Arm (or clear, with None) the prefill-tier export sink:
+        ``sink(export_dict)`` is called synchronously from :meth:`run`
+        for every request that completes its prompt with decode work
+        remaining — AFTER the row retired here (its pages are already
+        fetched to host inside the dict). The sink side is
+        ``serving/handoff.py``: it serializes the pages and drives the
+        journaled handoff to the decode tier."""
+        self._handoff_sink = sink
+
+    def export_kv_pages(self, page_ids: Sequence[int]) -> list[dict]:
+        """Fetch the KV contents of ``page_ids`` to host, one dict of
+        numpy arrays per page (every cache buffer except the per-slot
+        ``len`` vector, sliced on the page axis). Pages are read, never
+        mutated — radix-shared pages export safely."""
+        out = []
+        for p in page_ids:
+            out.append({
+                key: np.asarray(val[:, int(p)])
+                for key, val in self.cache.items() if key != "len"
+            })
+        return out
+
+    def import_kv_pages(self, page_ids: Sequence[int], blobs: Sequence[dict]) -> None:
+        """Write transferred page contents (as produced by
+        :meth:`export_kv_pages` on the source engine) into this engine's
+        pages ``page_ids``. Raises ``ValueError`` on any geometry
+        mismatch BEFORE touching the cache — the handoff sink degrades
+        such a delivery to local re-prefill rather than adopting pages
+        that would decode garbage. One eager batched scatter per cache
+        buffer: off the jit'd hot path, so zero retraces."""
+        if len(page_ids) != len(blobs):
+            raise ValueError(
+                f"import_kv_pages: {len(page_ids)} pages but "
+                f"{len(blobs)} payloads"
+            )
+        if not page_ids:
+            return
+        ids = jnp.asarray([int(p) for p in page_ids], jnp.int32)
+        staged = {}
+        for key, val in self.cache.items():
+            if key == "len":
+                continue
+            try:
+                stacked = np.stack(
+                    [np.asarray(b[key]) for b in blobs], axis=1
+                )
+            except KeyError as e:
+                raise ValueError(
+                    f"import_kv_pages: payload missing cache buffer {e}"
+                ) from None
+            expected = (val.shape[0], len(blobs)) + tuple(val.shape[2:])
+            if tuple(stacked.shape) != expected:
+                raise ValueError(
+                    f"import_kv_pages: buffer {key!r} shape "
+                    f"{stacked.shape} does not fit this engine's "
+                    f"{expected} (source engine geometry differs)"
+                )
+            staged[key] = stacked
+        for key, stacked in staged.items():
+            self.cache[key] = self.cache[key].at[:, ids].set(
+                jnp.asarray(stacked, self.cache[key].dtype)
+            )
+
+    def seed_handoff_import(
+        self, rid: int, *, pages: Sequence[int], pos: int, last: int,
+        prompt: Sequence[int],
+    ) -> None:
+        """Stage one imported request for the next :meth:`run`: when a
+        request with this rid reaches the head of admission it adopts
+        ``pages`` (whose KV must already be written via
+        :meth:`import_kv_pages`, covering logical positions
+        ``[0, pos)``) directly into decode state with ``last`` as its
+        next input token. Page ownership transfers to the row — retire
+        or preemption releases them through this engine's allocator, so
+        the caller must have allocated them there."""
+        self._import_seeds[int(rid)] = {
+            "pages": [int(p) for p in pages],
+            "pos": int(pos),
+            "last": int(last),
+            "prompt": tuple(int(t) for t in prompt),
+        }
+
+    def seed_restore_tokens(self, seeds: dict) -> None:
+        """Seed already-generated tokens for rids the next :meth:`run`
+        will serve (the restore-path re-admission math): each request's
+        result starts with these tokens and admission re-prefills
+        ``prompt + tokens``, so the retired token list is the combined
+        stream — bit-identical by greedy determinism. The handoff path
+        uses this for every handed-off request (the prefill tier's first
+        token), which is also exactly what makes the re-prefill
+        fallback lossless."""
+        for rid, toks in seeds.items():
+            self._restore_tokens[int(rid)] = tuple(int(t) for t in toks)
+
+    def clear_handoff_seeds(self) -> None:
+        """Drop restore-token seeds and any unconsumed import seeds,
+        releasing the latter's pages (a seeded rid that never arrived
+        must not leak its reservation)."""
+        self._restore_tokens = {}
+        leftovers = self._import_seeds
+        self._import_seeds = {}
+        for seed in leftovers.values():
+            if seed["pages"]:
+                self.allocator.release(seed["pages"])
+
+    def _export_handoff(self, s: "_PagedSlot", t: int) -> dict:
+        """Build the prefill-tier export for one just-completed prompt:
+        the JSON-safe request row (the re-prefill guarantee — everything
+        the decode tier needs WITHOUT the KV), engine geometry, and the
+        row's KV pages fetched to host. Called BEFORE retire frees the
+        pages."""
+        row = self._drain_row(s.req, s.result, "handoff")
+        row["prompt"] = list(s.prompt)  # effective prompt the pages hold
+        n = pages_for(s.pos, self.page_size)
+        return {
+            "request": row,
+            "pos": int(s.pos),
+            "first_token": int(t),
+            "first_token_tick": int(self.ticks),
+            "meta": {
+                "page_size": self.page_size,
+                "kv_dtype": self.kv_dtype,
+                "eos_id": self.eos_id,
+                "pos": int(s.pos),
+                "n_pages": n,
+            },
+            "pages": self.export_kv_pages(s.pages[:n]),
+        }
 
     # --- page bookkeeping -------------------------------------------------
 
@@ -1289,6 +1431,44 @@ class PagedSlotEngine(SlotEngine):
                 pending.sort(key=tier_key)
                 req = pending[0]
                 res = live[req.rid]
+                seed = (
+                    self._import_seeds.pop(req.rid, None)
+                    if self._import_seeds else None
+                )
+                if seed is not None:
+                    # handoff import (decode tier): this request's
+                    # prompt KV already sits in this engine's pages —
+                    # adopt it straight into decode state, no prefill.
+                    # Page ownership moves seed -> row: retire or a
+                    # later preemption releases through the allocator
+                    # (a preempted import re-queues and re-prefills
+                    # prompt + tokens — still bit-identical).
+                    pending.pop(0)
+                    idx = free_rows.pop(0)
+                    s = slots[idx]
+                    s.state = "decode"
+                    s.req = req
+                    s.prompt = tuple(seed["prompt"])
+                    s.done = s.pos = int(seed["pos"])
+                    s.result = res
+                    self._grow(s, list(seed["pages"]))
+                    s.shared = 0
+                    s.last = int(seed["last"])
+                    if not res.tokens:
+                        res.tokens.append(s.last)
+                    res.admit_tick = self.ticks
+                    res.admit_s = now()
+                    if res.first_token_tick is None:
+                        # the first token arrived WITH the handoff
+                        res.first_token_tick = self.ticks
+                        res.first_token_s = now()
+                    # seed the device-side row length so the decode
+                    # kernel writes/attends at the right positions
+                    # (eager, off the jit'd path: zero retraces)
+                    self.cache["len"] = self.cache["len"].at[idx].set(
+                        int(seed["pos"])
+                    )
+                    continue
                 eff = req.prompt + tuple(res.tokens)
                 matched, mpages = 0, []
                 if self.radix is not None:
@@ -1400,6 +1580,16 @@ class PagedSlotEngine(SlotEngine):
                             self.eos_id is not None and t == self.eos_id
                         ) or len(s.result.tokens) >= s.req.max_new:
                             retire(idx)
+                        elif self._handoff_sink is not None and s.req.rid >= 0:
+                            # prefill tier: this request's decode belongs
+                            # to the peer engine. Fetch the row's KV to
+                            # host BEFORE retire frees the pages, retire
+                            # (the prompt's pages still adopt into the
+                            # local radix for future prefix hits), then
+                            # hand the export to the sink.
+                            export = self._export_handoff(s, t)
+                            retire(idx)
+                            self._handoff_sink(export)
                         else:
                             s.state = "decode"
                             s.last = t

@@ -38,9 +38,18 @@ Registered points (see docs/robustness.md for the failure-mode matrix):
                         annotation PATCH (the roll-forward boundary)
 ``defrag.resume``       after the "resume" record is durable, before the
                         destination restore + move commit
+``handoff.export``      after the KV handoff's "export" phase record is
+                        durable, before the wire payload materializes
+``handoff.transfer``    after the "transfer" record is durable, before
+                        destination pages stage / page bytes ship
+``handoff.import``      after the "import" record is durable, before the
+                        decode tier adopts (the roll-forward boundary)
+``handoff.commit``      after the "commit" record is durable, before the
+                        entry resolves
 ==========================================================================
 
-The ``checkpoint.*`` / ``allocator.post_persist`` / ``defrag.*`` points
+The ``checkpoint.*`` / ``allocator.post_persist`` / ``defrag.*`` /
+``handoff.*`` points
 sit immediately *after* each journal step takes durable effect, so arming
 them with the ``crash`` mode is the ``crash_after:<site>`` primitive the
 restart-recovery and chaos-move suites drive: the process "dies" with the
@@ -105,6 +114,10 @@ POINTS = (
     "defrag.copy",
     "defrag.switch",
     "defrag.resume",
+    "handoff.export",
+    "handoff.transfer",
+    "handoff.import",
+    "handoff.commit",
 )
 
 

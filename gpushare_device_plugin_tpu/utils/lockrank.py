@@ -235,10 +235,24 @@ RANKS: dict[str, LockRank] = dict(
             "PATCH itself runs outside it.",
         ),
         _r(
+            "handoff.peer", 81, "lock", False,
+            "HandoffPeerClient's transfer counters (calls, retries, "
+            "pages/bytes shipped). Never held across a transport call "
+            "or the circuit breaker (rank 88) — counter flips only.",
+        ),
+        _r(
             "plugin.stream", 82, "condition", False,
             "TpuSharePlugin's ListAndWatch/drain condition: health map, "
             "version counter, in-flight Allocate count. Allocate "
             "releases it before delegating to the allocator.",
+        ),
+        _r(
+            "serving.handoff", 83, "lock", False,
+            "HandoffImportLedger's staging table (destination pages "
+            "reserved per in-flight KV handoff, received page bytes, "
+            "delivered-id dedup window). Staging allocates through the "
+            "page allocator (serving.pages, rank 87) while held — "
+            "strictly up-rank.",
         ),
         _r(
             "manager.health", 84, "lock", False,

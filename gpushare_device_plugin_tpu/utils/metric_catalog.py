@@ -85,6 +85,11 @@ GOVERNOR_ENGAGED = "tpushare_governor_engaged"
 GOVERNOR_ENGAGEMENTS_TOTAL = "tpushare_governor_engagements_total"
 GOVERNOR_THROTTLE_SECONDS_TOTAL = "tpushare_governor_throttle_seconds_total"
 GOVERNOR_THROTTLED_STEPS_TOTAL = "tpushare_governor_throttled_steps_total"
+HANDOFF_BYTES = "tpushare_handoff_bytes"
+HANDOFF_FALLBACK_REPREFILL_TOTAL = "tpushare_handoff_fallback_reprefill_total"
+HANDOFF_PAGES_IN_FLIGHT = "tpushare_handoff_pages_in_flight"
+HANDOFF_TRANSFER_SECONDS = "tpushare_handoff_transfer_seconds"
+HANDOFF_TRANSFERS_TOTAL = "tpushare_handoff_transfers_total"
 HEALTH_EVENTS_TOTAL = "tpushare_health_events_total"
 HEALTH_WATCHER_RESTARTS_TOTAL = "tpushare_health_watcher_restarts_total"
 INFORMER_APPLY_BATCH_EVENTS = "tpushare_informer_apply_batch_events"
@@ -110,6 +115,7 @@ UNHEALTHY_CHIPS = "tpushare_unhealthy_chips"
 PREFIX_ENGINE = "tpushare_engine_"
 PREFIX_SLO = "tpushare_slo_"
 PREFIX_GOVERNOR = "tpushare_governor_"
+PREFIX_HANDOFF = "tpushare_handoff_"
 
 # --- the contract table -----------------------------------------------------
 
@@ -151,6 +157,11 @@ CATALOG: dict[str, MetricSpec] = dict((
     _m(GOVERNOR_ENGAGEMENTS_TOTAL, COUNTER, "pod"),
     _m(GOVERNOR_THROTTLE_SECONDS_TOTAL, COUNTER, "pod"),
     _m(GOVERNOR_THROTTLED_STEPS_TOTAL, COUNTER, "pod"),
+    _m(HANDOFF_BYTES, HISTOGRAM, "pod"),
+    _m(HANDOFF_FALLBACK_REPREFILL_TOTAL, COUNTER, "reason", "pod"),
+    _m(HANDOFF_PAGES_IN_FLIGHT, GAUGE, "pod"),
+    _m(HANDOFF_TRANSFER_SECONDS, HISTOGRAM, "pod"),
+    _m(HANDOFF_TRANSFERS_TOTAL, COUNTER, "outcome", "pod"),
     _m(HEALTH_EVENTS_TOTAL, COUNTER, "health", "severity"),
     _m(HEALTH_WATCHER_RESTARTS_TOTAL, COUNTER),
     _m(INFORMER_APPLY_BATCH_EVENTS, HISTOGRAM, "scope"),

@@ -1,0 +1,28 @@
+"""FIXTURE (never imported): KV-handoff journal violations.
+
+- ``handoff_returns_unresolved``: a return after a ``_journal_handoff``
+  begin with no ``_journal_resolve`` — the entry outlives the handoff,
+  and every later delivery of this id would be treated as a crash
+  re-delivery forever.
+- ``handoff_swallows_transfer_failure``: a broad handler eats the
+  transfer failure without resolving (or re-raising) — the mover
+  reports fallback while the journal still says the handoff is live.
+"""
+
+
+def handoff_returns_unresolved(ckpt, peer, key, base):
+    seq = _journal_handoff(ckpt, key, dict(base, phase="export"))  # noqa: F821
+    if seq is None:
+        return "degraded"
+    peer.deliver(key[1], base)
+    return "delivered"  # WRONG: begun entry left pending on a live path
+
+
+def handoff_swallows_transfer_failure(ckpt, peer, key, base):
+    outcome = "delivered"
+    try:
+        _journal_handoff(ckpt, key, dict(base, phase="transfer"))  # noqa: F821
+        raise RuntimeError("transfer path down")  # the dead-peer path
+    except Exception:
+        outcome = "fallback"  # WRONG: swallowed without resolving
+    return outcome

@@ -1,0 +1,37 @@
+"""FIXTURE (never imported): the KV-handoff journal shapes — all legal.
+
+The handoff mover's real shape (serving/handoffproto.py): each protocol
+phase journals a fresh ``_journal_handoff`` begin for the handoff key,
+every degraded path resolves INLINE with ``_journal_resolve("abort")``,
+the happy path commits, and unhandled exceptions propagate — the pending
+entry is the crash-safety story (the reconciler rolls it forward or
+back).
+"""
+
+
+def execute_handoff(ckpt, peer, fallback, key, base, pages):
+    seq = _journal_handoff(ckpt, key, dict(base, phase="export"))  # noqa: F821
+    blobs = list(pages)
+    seq = _journal_handoff(ckpt, key, dict(base, phase="transfer"))  # noqa: F821
+    try:
+        for i, blob in enumerate(blobs):
+            peer.put_page(key[1], i, blob, 0)
+    except ValueError:
+        fallback(key[1], dict(base))
+        _journal_resolve(ckpt, "abort", key, seq)  # noqa: F821
+        return "fallback"
+    seq = _journal_handoff(ckpt, key, dict(base, phase="import"))  # noqa: F821
+    peer.deliver(key[1], base)
+    seq = _journal_handoff(ckpt, key, dict(base, phase="commit"))  # noqa: F821
+    _journal_resolve(ckpt, "commit", key, seq)  # noqa: F821
+    return "delivered"
+
+
+def resolve_after_crash(ckpt, key, data, deliver):
+    seq = data.get("_seq")
+    try:
+        deliver(key[1], dict(data))
+    except Exception:
+        raise  # entry stays pending for the next pass, by design
+    _journal_resolve(ckpt, "commit", key, seq)  # noqa: F821
+    return "rollforward"

@@ -1,0 +1,637 @@
+"""Disaggregated prefill/decode KV handoff (``serving/handoffproto.py``
++ ``serving/handoff.py``) — the ``make chaos-handoff`` suite.
+
+The acceptance discipline mirrors ``test_defrag.py``: a "crash" is a
+``SimulatedCrash`` injected at a ``handoff.*`` fault point (every journal
+boundary the protocol defines, in both WAL fsync modes), the "restart"
+reconstructs a second daemon from the persisted artifacts only
+(checkpoint reload, ``replay_checkpoint``, one ``DriftReconciler`` pass),
+and the criteria are: **no lost request** (every journaled handoff ends
+in exactly one delivery — KV import or re-prefill fallback), **no
+duplicated delivery** (roll-forward past the ``import`` commit point
+re-delivers idempotently), **no leaked or double-booked destination
+page** (every staging ends in adopt or abort), and — in the engine-level
+tests — every request's greedy tokens BIT-IDENTICAL to a unified engine
+that never disaggregated, with zero retraces, through the whole
+degradation ladder (transfer → forced-fallback → prefill-tier outage).
+"""
+
+import numpy as np
+import pytest
+
+from gpushare_device_plugin_tpu.allocator.assume import AssumeCache
+from gpushare_device_plugin_tpu.allocator.checkpoint import (
+    AllocationCheckpoint,
+    replay_checkpoint,
+)
+from gpushare_device_plugin_tpu.cluster.apiserver import ApiServerClient
+from gpushare_device_plugin_tpu.cluster.podsource import ApiServerPodSource
+from gpushare_device_plugin_tpu.cluster.reconciler import DriftReconciler
+from gpushare_device_plugin_tpu.serving.handoffproto import (
+    ChecksumError,
+    HandoffImportLedger,
+    HandoffMover,
+    HandoffPeerClient,
+    HandoffPlan,
+    HandoffSink,
+    handoff_key,
+    page_crc,
+    resolve_handoff,
+)
+from gpushare_device_plugin_tpu.serving.pages import PageAllocator
+from gpushare_device_plugin_tpu.utils.faults import FAULTS, SimulatedCrash
+
+from fake_apiserver import FakeApiServer
+
+NODE = "node-handoff"
+
+# Every boundary the handoff journal defines, in protocol order; None =
+# the uncrashed control run. ``import`` is the roll-forward boundary.
+HANDOFF_SITES = [
+    None,
+    "handoff.export",    # request row durable, wire payload never built
+    "handoff.transfer",  # transfer record durable, nothing staged yet
+    "handoff.import",    # staging sealed + import record durable,
+                         # delivery never ran — the commit point
+    "handoff.commit",    # delivered, commit record durable, WAL entry
+                         # never resolved
+]
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    FAULTS.clear()
+    yield
+    FAULTS.clear()
+
+
+@pytest.fixture
+def api():
+    srv = FakeApiServer()
+    srv.add_node(NODE)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# jax-free harness: a decode tier is a pool + ledger + sink whose
+# import callback "adopts" pages (and releases them, playing the row's
+# eventual retirement) and whose reprefill callback just records the row
+# ---------------------------------------------------------------------------
+
+
+class DecodeTier:
+    def __init__(self, total_pages=8):
+        self.pool = PageAllocator(total_pages)
+        self.ledger = HandoffImportLedger()
+        self.served: dict[str, list[str]] = {}
+        self.sink = HandoffSink(
+            self.ledger, self.pool.alloc, self.pool.release,
+            self._import_cb, self._reprefill_cb,
+        )
+
+    def _import_cb(self, pages, blobs, meta, record):
+        hid = record["handoff_id"]
+        self.served.setdefault(hid, []).append("kv")
+        # the engine row retires eventually; its release recycles the
+        # adopted pages — modeled eagerly so leak checks are exact
+        self.pool.release(pages)
+
+    def _reprefill_cb(self, record):
+        self.served.setdefault(record["handoff_id"], []).append("reprefill")
+
+    def assert_clean(self):
+        assert self.pool.free_pages == self.pool.total, "leaked pages"
+        assert self.ledger.pages_in_flight == 0
+        assert self.ledger.doc()["staged"] == {}
+
+
+def mk_plan(hid, n_pages=2):
+    return HandoffPlan(
+        handoff_id=hid,
+        request={
+            "rid": 7, "prompt": [1, 2, 3], "tokens": [9], "max_new": 4,
+            "tier": "critical",
+        },
+        meta={"page_size": 4},
+        pages=tuple(f"kv-{hid}-{i}".encode() for i in range(n_pages)),
+    )
+
+
+def mk_mover(tier, path, mode="always"):
+    ckpt = AllocationCheckpoint(str(path), fsync=mode)
+    assume = AssumeCache()
+    peer = HandoffPeerClient(tier.sink, sleep=lambda s: None)
+    return ckpt, assume, HandoffMover(
+        ckpt, assume, peer, fallback_fn=tier.sink.deliver, node=NODE,
+    )
+
+
+# ---------------------------------------------------------------------------
+# chaos: SIGKILL at every journal step, both fsync modes
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("mode", ["always", "batch"])
+@pytest.mark.parametrize("site", HANDOFF_SITES)
+def test_kill_at_every_handoff_step(site, mode, api, tmp_path):
+    """The chaos-handoff acceptance: the prefill daemon dies at each
+    journal boundary; the decode tier (pool, staging ledger, dedup
+    window) survives, as it does when only the peer's daemon is killed.
+    Restart from the WAL alone and prove the reconciler converges — roll
+    forward at/past ``import``, roll back to re-prefill before it, the
+    request served exactly once across BOTH incarnations, zero leaked
+    destination pages, journal empty."""
+    path = tmp_path / "wal.ckpt"
+    tier = DecodeTier()
+    ckpt1, _assume1, mover1 = mk_mover(tier, path, mode=mode)
+    plan = mk_plan("h1")
+
+    # --- incarnation 1: dies (or not) mid-handoff -------------------------
+    if site is None:
+        assert mover1.execute(plan) == "delivered"
+    else:
+        with FAULTS.injected(site, "crash", times=1):
+            with pytest.raises(SimulatedCrash):
+                mover1.execute(plan)
+        ckpt1.abandon()  # SIGKILL-faithful: no flush, no close
+
+    # --- incarnation 2: restart from the persisted artifacts only ---------
+    client2 = ApiServerClient(api.url)
+    source2 = ApiServerPodSource(client2, NODE)
+    ckpt2 = AllocationCheckpoint(str(path), fsync=mode)
+    assume2 = AssumeCache()
+    n = replay_checkpoint(ckpt2, assume2)
+    key = handoff_key("h1")
+    if site is None:
+        assert n == 0
+    else:
+        # the entry replays pending but reserves NOTHING in the chip
+        # ledger: the destination pages live in the decode tier's own
+        # refcounted pool, and the pending entry itself is the protection
+        assert n == 1
+        assert key in ckpt2.pending()
+        claims, mem, core = assume2.snapshot()
+        assert claims == {} and mem == {} and core == {}
+
+    rec = DriftReconciler(
+        api=client2,
+        pod_source=source2,
+        assume=assume2,
+        checkpoint=ckpt2,
+        node_name=NODE,
+        handoff_deliver_fn=tier.sink.deliver,
+        handoff_abort_fn=tier.sink.abort,
+    )
+    drift = rec.reconcile_once()
+
+    rolled_forward = site in ("handoff.import", "handoff.commit")
+    if site is None:
+        assert drift == {}
+    elif rolled_forward:
+        assert drift.get("handoff_rollforward") == 1
+    else:
+        assert drift.get("handoff_rollback") == 1
+
+    # exactly-once delivery, by the right path: the staging sealed before
+    # the import record, so roll-forward adopts KV; before it, nothing
+    # usable is staged and the journaled row re-prefills. A crash after
+    # delivery (commit site) re-delivers into the dedup window — the
+    # duplicate is a no-op, not a second serve.
+    modes = tier.served.get("h1", [])
+    assert len(modes) == 1, f"served {len(modes)} times: {modes}"
+    if site in (None, "handoff.import", "handoff.commit"):
+        assert modes == ["kv"]
+    else:
+        assert modes == ["reprefill"]
+
+    # convergence: journal empty, ledger drained, pages all home, and a
+    # second pass finds nothing left to repair
+    tier.assert_clean()
+    assert ckpt2.pending() == {}
+    claims, mem, core = assume2.snapshot()
+    assert claims == {} and mem == {} and core == {}
+    assert rec.reconcile_once() == {}
+
+
+@pytest.mark.parametrize("site", ["handoff.transfer", "handoff.import"])
+def test_decode_tier_restart_loses_staging_not_requests(site, api, tmp_path):
+    """Harder topology: BOTH sides die — the restarted decode tier comes
+    back with an empty pool/ledger (its staged bytes and dedup window are
+    gone). Every pending entry must still end in exactly one delivery on
+    the NEW tier; with no staging to adopt, even a roll-forward degrades
+    to re-prefill instead of losing the request."""
+    path = tmp_path / "wal.ckpt"
+    tier1 = DecodeTier()
+    ckpt1, _a1, mover1 = mk_mover(tier1, path)
+    with FAULTS.injected(site, "crash", times=1):
+        with pytest.raises(SimulatedCrash):
+            mover1.execute(mk_plan("h1"))
+    ckpt1.abandon()
+
+    tier2 = DecodeTier()  # fresh pool + ledger: the staging died too
+    client2 = ApiServerClient(api.url)
+    source2 = ApiServerPodSource(client2, NODE)
+    ckpt2 = AllocationCheckpoint(str(path))
+    assume2 = AssumeCache()
+    assert replay_checkpoint(ckpt2, assume2) == 1
+    rec = DriftReconciler(
+        api=client2, pod_source=source2, assume=assume2, checkpoint=ckpt2,
+        node_name=NODE,
+        handoff_deliver_fn=tier2.sink.deliver,
+        handoff_abort_fn=tier2.sink.abort,
+    )
+    drift = rec.reconcile_once()
+    expected = (
+        "handoff_rollforward" if site == "handoff.import"
+        else "handoff_rollback"
+    )
+    assert drift.get(expected) == 1
+    assert tier2.served.get("h1") == ["reprefill"]
+    tier2.assert_clean()
+    assert ckpt2.pending() == {}
+    assert rec.reconcile_once() == {}
+
+
+def test_reconciler_without_decode_hook_stays_protective(api, tmp_path):
+    """A reconciler wired without a delivery sink (no decode tier on
+    this node yet) must leave handoff entries pending — resolving blind
+    would delete the journal's only copy of the request row."""
+    path = tmp_path / "wal.ckpt"
+    tier = DecodeTier()
+    ckpt1, _a1, mover1 = mk_mover(tier, path)
+    with FAULTS.injected("handoff.import", "crash", times=1):
+        with pytest.raises(SimulatedCrash):
+            mover1.execute(mk_plan("h1"))
+    ckpt1.abandon()
+
+    client2 = ApiServerClient(api.url)
+    source2 = ApiServerPodSource(client2, NODE)
+    ckpt2 = AllocationCheckpoint(str(path))
+    assume2 = AssumeCache()
+    replay_checkpoint(ckpt2, assume2)
+    rec = DriftReconciler(
+        api=client2, pod_source=source2, assume=assume2, checkpoint=ckpt2,
+        node_name=NODE,
+    )
+    assert rec.reconcile_once().get("handoff_rollforward") is None
+    assert handoff_key("h1") in ckpt2.pending()
+    assert tier.served == {}
+
+
+def test_resolve_stays_pending_when_delivery_fails(tmp_path):
+    """A delivery side effect that raises (decode tier not ready) leaves
+    the entry pending — the next pass, with the tier back, resolves it;
+    the request is delayed, never lost."""
+    tier = DecodeTier()
+    ckpt = AllocationCheckpoint(str(tmp_path / "wal.ckpt"))
+    _a = AssumeCache()
+    key = handoff_key("h1")
+    data = {
+        "kind": "handoff", "handoff_id": "h1", "phase": "import",
+        "request": {"rid": 1}, "n_pages": 1,
+    }
+    seq = ckpt.begin(key, data)
+
+    def dead(hid, record):
+        raise RuntimeError("decode tier rebooting")
+
+    out = resolve_handoff(
+        ckpt, None, key, {**data, "_seq": seq}, deliver_fn=dead,
+    )
+    assert out is None
+    assert key in ckpt.pending()
+    out = resolve_handoff(
+        ckpt, None, key, {**data, "_seq": seq},
+        deliver_fn=tier.sink.deliver, abort_fn=tier.sink.abort,
+    )
+    assert out == "rollforward"
+    assert ckpt.pending() == {}
+    assert tier.served.get("h1") == ["reprefill"]  # nothing was staged
+
+
+# ---------------------------------------------------------------------------
+# ledger + sink + peer unit coverage
+# ---------------------------------------------------------------------------
+
+
+def test_stage_is_idempotent_and_all_or_nothing():
+    tier = DecodeTier(total_pages=3)
+    got = tier.ledger.stage("h1", 2, {}, tier.pool.alloc)
+    assert got is not None and len(got) == 2
+    # re-stage of a live staging returns the SAME pages, allocates none
+    assert tier.ledger.stage("h1", 2, {}, tier.pool.alloc) == got
+    assert tier.ledger.pages_in_flight == 2
+    # only 1 page left: a 2-page staging must not partially reserve
+    assert tier.ledger.stage("h2", 2, {}, tier.pool.alloc) is None
+    assert tier.pool.free_pages == 1
+    assert tier.ledger.abort("h1", tier.pool.release) is True
+    tier.assert_clean()
+    with pytest.raises(ValueError):
+        tier.ledger.stage("h3", 0, {}, tier.pool.alloc)
+
+
+def test_put_page_checksums_and_bounds():
+    tier = DecodeTier()
+    tier.ledger.stage("h1", 2, {}, tier.pool.alloc)
+    blob = b"page-bytes"
+    with pytest.raises(ChecksumError):
+        tier.ledger.put_page("h1", 0, blob, page_crc(blob) ^ 1)
+    with pytest.raises(LookupError):
+        tier.ledger.put_page("nope", 0, blob, page_crc(blob))
+    with pytest.raises(IndexError):
+        tier.ledger.put_page("h1", 5, blob, page_crc(blob))
+    tier.ledger.put_page("h1", 0, blob, page_crc(blob))
+    # partial staging never adopts: the delivery would fall back
+    assert tier.ledger.adopt("h1") is None
+    tier.ledger.put_page("h1", 1, blob, page_crc(blob))
+    got = tier.ledger.adopt("h1")
+    assert got is not None and got[1] == [blob, blob]
+    tier.pool.release(got[0])
+    tier.assert_clean()
+
+
+def test_sink_delivery_is_idempotent_and_degrades():
+    tier = DecodeTier()
+    rec = {"handoff_id": "h1", "request": {"rid": 1}}
+    # nothing staged: the journaled row re-prefills
+    assert tier.sink.deliver("h1", rec) == "reprefill"
+    assert tier.sink.deliver("h1", rec) == "duplicate"
+    assert tier.served["h1"] == ["reprefill"]
+    # a racing transfer that staged after delivery: duplicate releases it
+    tier.ledger._delivered.clear()
+    tier.sink.stage("h2", 2, {})
+    tier.ledger.first_delivery("h2")
+    assert tier.sink.deliver("h2", {"handoff_id": "h2"}) == "duplicate"
+    tier.assert_clean()
+
+
+def test_sink_import_failure_releases_and_reprefills():
+    pool = PageAllocator(4)
+    ledger = HandoffImportLedger()
+    served = []
+
+    def bad_import(pages, blobs, meta, record):
+        raise ValueError("geometry mismatch")
+
+    sink = HandoffSink(
+        ledger, pool.alloc, pool.release, bad_import,
+        lambda record: served.append(record["handoff_id"]),
+    )
+    sink.stage("h1", 2, {})
+    blob = b"kv"
+    sink.put_page("h1", 0, blob, page_crc(blob))
+    sink.put_page("h1", 1, blob, page_crc(blob))
+    assert sink.deliver("h1", {"handoff_id": "h1", "request": {}}) == "reprefill"
+    assert served == ["h1"]
+    assert pool.free_pages == pool.total
+
+
+class FlakyTransport:
+    """Fails the first ``n`` calls of each verb, then delegates."""
+
+    def __init__(self, inner, n=1):
+        self._inner = inner
+        self._n = n
+        self.failures = 0
+
+    def _maybe(self):
+        if self.failures < self._n:
+            self.failures += 1
+            raise ConnectionError("blip")
+
+    def stage(self, *a, **k):
+        self._maybe()
+        return self._inner.stage(*a, **k)
+
+    def put_page(self, *a, **k):
+        self._maybe()
+        return self._inner.put_page(*a, **k)
+
+    def deliver(self, *a, **k):
+        self._maybe()
+        return self._inner.deliver(*a, **k)
+
+    def abort(self, *a, **k):
+        self._maybe()
+        return self._inner.abort(*a, **k)
+
+
+def test_peer_client_retries_through_blips(tmp_path):
+    tier = DecodeTier()
+    flaky = FlakyTransport(tier.sink, n=2)
+    ckpt = AllocationCheckpoint(str(tmp_path / "wal.ckpt"))
+    peer = HandoffPeerClient(flaky, sleep=lambda s: None)
+    mover = HandoffMover(
+        ckpt, AssumeCache(), peer, fallback_fn=tier.sink.deliver, node=NODE,
+    )
+    assert mover.execute(mk_plan("h1")) == "delivered"
+    assert tier.served["h1"] == ["kv"]
+    assert peer.retries >= 2
+    assert peer.sent_pages == 2
+    assert ckpt.pending() == {}
+    tier.assert_clean()
+
+
+def test_mover_skips_handoff_already_claimed(tmp_path):
+    tier = DecodeTier()
+    ckpt, assume, mover = mk_mover(tier, tmp_path / "wal.ckpt")
+    assert assume.claim(handoff_key("h1"))
+    assert mover.execute(mk_plan("h1")) == "skipped"
+    assert tier.served == {}
+    assert ckpt.pending() == {}
+
+
+def test_dead_transport_degrades_inline_and_resolves_journal(tmp_path):
+    """Transfer path fully down: the mover falls back over the control
+    path, the WAL entry resolves inline (no reconciler needed), and the
+    request is served by re-prefill exactly once."""
+    from gpushare_device_plugin_tpu.serving.handoff import BrokenTransport
+
+    tier = DecodeTier()
+    ckpt = AllocationCheckpoint(str(tmp_path / "wal.ckpt"))
+    peer = HandoffPeerClient(
+        BrokenTransport(), attempts=2, sleep=lambda s: None,
+    )
+    mover = HandoffMover(
+        ckpt, AssumeCache(), peer, fallback_fn=tier.sink.deliver, node=NODE,
+    )
+    assert mover.execute(mk_plan("h1")) == "fallback"
+    assert tier.served["h1"] == ["reprefill"]
+    assert ckpt.pending() == {}
+    assert peer.retries >= 1
+    tier.assert_clean()
+
+
+# ---------------------------------------------------------------------------
+# wire format
+# ---------------------------------------------------------------------------
+
+
+def test_page_wire_roundtrip_and_corruption():
+    from gpushare_device_plugin_tpu.serving.handoff import (
+        decode_page,
+        encode_page,
+    )
+
+    blob = {
+        "k": np.arange(24, dtype=np.float32).reshape(2, 3, 4),
+        "v": np.arange(24, dtype=np.float32).reshape(2, 3, 4) * 2,
+        "k_scale": np.ones((2, 3), dtype=np.float16),
+    }
+    wire = encode_page(blob)
+    # content-deterministic: same dict, any insertion order, same bytes
+    assert wire == encode_page(dict(reversed(list(blob.items()))))
+    out = decode_page(wire)
+    assert set(out) == set(blob)
+    for key in blob:
+        assert out[key].dtype == blob[key].dtype
+        np.testing.assert_array_equal(out[key], blob[key])
+    with pytest.raises(ValueError):
+        decode_page(wire[:-3])  # truncated buffer
+    with pytest.raises(ValueError):
+        decode_page(wire + b"xx")  # trailing garbage
+    with pytest.raises(ValueError):
+        decode_page(wire[:2])  # shorter than the header prefix
+
+
+# ---------------------------------------------------------------------------
+# engine-level: tokens bit-identical to a unified engine, zero retraces,
+# through the whole degradation ladder (slow — `make chaos-handoff` runs
+# them; tier-1 gates the same parity via the disagg bench smoke)
+# ---------------------------------------------------------------------------
+
+
+engine_tests = pytest.mark.slow
+
+EOS = 3
+
+
+@pytest.fixture(scope="module")
+def setup():
+    import jax
+    import jax.numpy as jnp
+
+    from gpushare_device_plugin_tpu.serving import poisson_trace
+    from gpushare_device_plugin_tpu.workloads.transformer import (
+        TransformerConfig,
+        init_params,
+    )
+
+    cfg = TransformerConfig(
+        vocab=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2, d_ff=64,
+        max_seq=64, compute_dtype=jnp.float32,
+    )
+    params = init_params(jax.random.key(0), cfg)
+    reqs = poisson_trace(
+        8, seed=3, rate=0.3, vocab=cfg.vocab, prompt_lens=(2, 10),
+        max_new=[2, 4, 9],
+    )
+    return cfg, params, reqs
+
+
+def _unified_tokens(setup):
+    from gpushare_device_plugin_tpu.serving import PagedSlotEngine
+
+    cfg, params, reqs = setup
+    eng = PagedSlotEngine(
+        params, cfg, slots=4, max_len=32, total_pages=32, page_size=4,
+        prefill_chunk=4, eos_id=EOS,
+    )
+    stats = eng.run(reqs)
+    return {r.rid: list(r.tokens) for r in stats.results}
+
+
+def _mk_disagg(setup, **kw):
+    from gpushare_device_plugin_tpu.serving import (
+        DisaggServer,
+        PagedSlotEngine,
+    )
+
+    cfg, params, _reqs = setup
+    # equal total HBM to the unified engine: 16 + 16 = 32 pages
+    prefill = PagedSlotEngine(
+        params, cfg, slots=2, max_len=32, total_pages=16, page_size=4,
+        prefill_chunk=4, eos_id=EOS,
+    )
+    decode = PagedSlotEngine(
+        params, cfg, slots=4, max_len=32, total_pages=16, page_size=4,
+        prefill_chunk=4, eos_id=EOS,
+    )
+    return DisaggServer(prefill, decode, node=NODE, **kw)
+
+
+def _assert_parity_and_no_retrace(ds, out, setup, *, paths):
+    cfg, params, reqs = setup
+    assert out["dropped"] == []
+    got = {rid: e["tokens"] for rid, e in out["results"].items()}
+    assert got == _unified_tokens(setup), "disagg tokens diverged"
+    seen_paths = {e["path"] for e in out["results"].values()}
+    assert seen_paths <= paths, seen_paths
+    # zero leaked destination pages: everything the decode pool still
+    # holds is radix-cached prefix, not a stranded handoff reservation
+    assert ds.ledger.pages_in_flight == 0
+    for eng in (ds.prefill, ds.decode):
+        cached = eng.radix.cached_pages if eng.radix is not None else 0
+        assert eng.allocator.used_pages == cached
+
+
+@engine_tests
+def test_disagg_tokens_match_unified_with_zero_retraces(setup, tmp_path):
+    ds = _mk_disagg(
+        setup,
+        checkpoint=AllocationCheckpoint(str(tmp_path / "wal.ckpt")),
+        assume=AssumeCache(),
+    )
+    ds.warmup()
+    warm = (dict(ds.prefill.trace_counts), dict(ds.decode.trace_counts))
+    out = ds.serve(setup[2])
+    # the transfer path is live: at least one request's KV actually moved
+    assert ds.outcomes.get("delivered", 0) >= 1
+    assert any(
+        e["path"] == "handoff" for e in out["results"].values()
+    )
+    _assert_parity_and_no_retrace(
+        ds, out, setup, paths={"prefill", "handoff", "reprefill"},
+    )
+    assert (
+        dict(ds.prefill.trace_counts), dict(ds.decode.trace_counts)
+    ) == warm, "handoff retraced a compiled program"
+    # protocol fully resolved inline: nothing for a reconciler to find
+    assert ds.mover._ckpt.pending() == {}
+
+
+@engine_tests
+def test_disagg_forced_fallback_is_bit_identical(setup):
+    """Every transfer fails (dead page path): the whole trace degrades
+    to re-prefill on the decode tier — zero lost requests, tokens still
+    bit-identical to the unified engine."""
+    from gpushare_device_plugin_tpu.serving import BrokenTransport
+
+    ds = _mk_disagg(setup, transport=BrokenTransport(), peer_kwargs={
+        "attempts": 2,
+    })
+    ds.warmup()
+    warm = (dict(ds.prefill.trace_counts), dict(ds.decode.trace_counts))
+    out = ds.serve(setup[2])
+    assert ds.outcomes.get("delivered", 0) == 0
+    assert ds.outcomes.get("fallback", 0) >= 1
+    _assert_parity_and_no_retrace(
+        ds, out, setup, paths={"prefill", "reprefill"},
+    )
+    assert (
+        dict(ds.prefill.trace_counts), dict(ds.decode.trace_counts)
+    ) == warm
+
+
+@engine_tests
+def test_disagg_prefill_tier_outage_is_bit_identical(setup):
+    """Prefill tier down entirely: the decode tier serves every request
+    with a full local prefill — the degradation ladder's floor."""
+    ds = _mk_disagg(setup)
+    out = ds.serve(setup[2], prefill_down=True)
+    assert out["dropped"] == []
+    got = {rid: e["tokens"] for rid, e in out["results"].items()}
+    assert got == _unified_tokens(setup)
+    assert {e["path"] for e in out["results"].values()} == {"prefill_down"}

@@ -700,3 +700,177 @@ def test_fetch_shards_dedupes_replica_gangs():
         assert len(doc["shards"]) == 2
     finally:
         server.stop()
+
+
+# --- disaggregated serving: TIER column + handoff counters ------------------
+
+
+def _tier_pods():
+    return [
+        assigned_running_pod(
+            "pf-0", 8, chip_idx=0, node="node-a",
+            annotations={
+                const.ANN_SERVING_TIER: const.SERVING_TIER_PREFILL
+            },
+        ),
+        assigned_running_pod(
+            "dec-0", 8, chip_idx=1, node="node-a",
+            annotations={
+                const.ANN_SERVING_TIER: const.SERVING_TIER_DECODE
+            },
+        ),
+        assigned_running_pod("unified", 4, chip_idx=2, node="node-a"),
+    ]
+
+
+def _handoff_exposition(pod_label: str) -> str:
+    """An exposition carrying the engine families PLUS the
+    ``tpushare_handoff_*`` families, rendered by the real registry (the
+    same bytes a disaggregated decode pod serves)."""
+    from gpushare_device_plugin_tpu.utils import metric_catalog as mc
+    from gpushare_device_plugin_tpu.utils.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    labels = {"pod": pod_label}
+    reg.gauge_set("tpushare_engine_kv_pages_total", 64.0,
+                  help_text="KV pages in the slice pool", **labels)
+    reg.gauge_set("tpushare_engine_kv_pages_used", 48.0,
+                  help_text="KV pages allocated", **labels)
+    reg.counter_inc(mc.HANDOFF_TRANSFERS_TOTAL, "transfers by outcome",
+                    value=3.0, outcome="delivered", **labels)
+    reg.counter_inc(mc.HANDOFF_TRANSFERS_TOTAL, "transfers by outcome",
+                    value=1.0, outcome="duplicate", **labels)
+    reg.counter_inc(mc.HANDOFF_FALLBACK_REPREFILL_TOTAL,
+                    "re-prefill fallbacks", value=1.0,
+                    reason="transfer_failed", **labels)
+    reg.gauge_set(mc.HANDOFF_PAGES_IN_FLIGHT, 2.0,
+                  "pages staged, not yet adopted", **labels)
+    reg.observe(mc.HANDOFF_TRANSFER_SECONDS, 0.125,
+                "transfer wall time", **labels)
+    return reg.render()
+
+
+def test_parse_engine_metrics_folds_handoff_families():
+    rows = inspect_cli.parse_engine_metrics(
+        _handoff_exposition("default/dec-0")
+    )
+    row = rows["default/dec-0"]
+    assert row["kv_pages_total"] == 64.0
+    assert row["handoff_transfers_total_delivered"] == 3.0
+    assert row["handoff_transfers_total_duplicate"] == 1.0
+    assert row["handoff_fallback_reprefill_total_transfer_failed"] == 1.0
+    assert row["handoff_pages_in_flight"] == 2.0
+    # histogram buckets are skipped; the _sum/_count samples land
+    assert row["handoff_transfer_seconds_count"] == 1.0
+    assert row["handoff_transfer_seconds_sum"] == 0.125
+    assert not any(k.endswith("_bucket") for k in row)
+
+
+def test_cli_details_tier_column(api, capsys, monkeypatch):
+    """Pods declaring a serving tier grow the TIER column; unified pods
+    on the same node render the placeholder."""
+    api.nodes["node-a"] = shared_node("node-a")
+    for pod in _tier_pods():
+        api.add_pod(pod)
+    monkeypatch.setattr(inspect_cli, "_client", lambda: ApiServerClient(api.url))
+
+    assert inspect_cli.main(["-d"]) == 0
+    out = capsys.readouterr().out
+    assert "TIER" in out
+    pf_row = next(line for line in out.splitlines() if "pf-0" in line)
+    dec_row = next(line for line in out.splitlines() if "dec-0" in line)
+    uni_row = next(line for line in out.splitlines() if "unified" in line)
+    assert const.SERVING_TIER_PREFILL in pf_row
+    assert const.SERVING_TIER_DECODE in dec_row
+    assert uni_row.rstrip().endswith("-")
+
+
+def test_cli_no_tier_keeps_reference_layout(api, capsys, monkeypatch):
+    """Unified-serving fleets (and garbled tier annotations) keep the
+    reference column set — no TIER header appears."""
+    api.nodes["node-a"] = shared_node("node-a")
+    api.add_pod(assigned_running_pod("r1", 4, chip_idx=0, node="node-a"))
+    api.add_pod(
+        assigned_running_pod(
+            "r2", 4, chip_idx=1, node="node-a",
+            annotations={const.ANN_SERVING_TIER: "bogus-tier"},
+        )
+    )
+    monkeypatch.setattr(inspect_cli, "_client", lambda: ApiServerClient(api.url))
+    assert inspect_cli.main(["-d"]) == 0
+    assert "TIER" not in capsys.readouterr().out
+
+
+def test_cli_details_handoff_counters(api, capsys, monkeypatch):
+    """Scraped ``tpushare_handoff_*`` counters land in the SERVING CACHE
+    cell: delivered transfers, re-prefill fallbacks, pages in flight."""
+    api.nodes["node-a"] = shared_node("node-a")
+    for pod in _tier_pods():
+        api.add_pod(pod)
+    monkeypatch.setattr(inspect_cli, "_client", lambda: ApiServerClient(api.url))
+    monkeypatch.setattr(
+        inspect_cli, "fetch_observability_metrics",
+        lambda urls: inspect_cli.parse_observability_metrics(
+            _handoff_exposition("default/dec-0")
+        ),
+    )
+
+    assert inspect_cli.main(["--metrics-url", "http://x"]) == 0
+    out = capsys.readouterr().out
+    dec_row = next(line for line in out.splitlines() if "dec-0" in line)
+    assert "pages 48/64" in dec_row
+    assert "handoff 3" in dec_row
+    assert "reprefill 1" in dec_row
+    assert "inflight 2" in dec_row
+
+
+def test_cli_json_tier_and_handoff(api, capsys, monkeypatch):
+    api.nodes["node-a"] = shared_node("node-a")
+    for pod in _tier_pods():
+        api.add_pod(pod)
+    monkeypatch.setattr(inspect_cli, "_client", lambda: ApiServerClient(api.url))
+    monkeypatch.setattr(
+        inspect_cli, "fetch_observability_metrics",
+        lambda urls: inspect_cli.parse_observability_metrics(
+            _handoff_exposition("default/dec-0")
+        ),
+    )
+
+    assert inspect_cli.main(["-o", "json", "--metrics-url", "http://x"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    pods = {p["name"]: p for p in doc["nodes"][0]["pods"]}
+    assert pods["pf-0"]["serving_tier"] == const.SERVING_TIER_PREFILL
+    assert pods["dec-0"]["serving_tier"] == const.SERVING_TIER_DECODE
+    # unified pods keep the reference document: no serving_tier key
+    assert "serving_tier" not in pods["unified"]
+    cache = pods["dec-0"]["serving_cache"]
+    assert cache["handoff_transfers_total_delivered"] == 3.0
+    assert cache["handoff_pages_in_flight"] == 2.0
+
+
+def test_render_why_two_tier_group_golden():
+    """The gang-group verb's record renders the two-tier composition —
+    what `inspect why` shows for a disaggregated slice admission."""
+    from gpushare_device_plugin_tpu.cli.display import render_why
+
+    rec = {
+        "id": 4, "verb": "gang-group", "outcome": "ok", "shard": "shard-1",
+        "node": "n1", "seq": 9,
+        "placement": {
+            "group": "slice-a", "members": 3, "chips": [0, 1],
+            "shape": "2x1", "per_chip": 16,
+            "tier": const.SERVING_TIER_PREFILL,
+            "tiers": {
+                const.SERVING_TIER_DECODE: 2,
+                const.SERVING_TIER_PREFILL: 1,
+            },
+        },
+    }
+    out = render_why("default/slice-a-pf0", [rec])
+    assert "[#4] gang-group @shard-1 -> n1" in out
+    assert (
+        "placement: group slice-a (3 members) · chips 0,1 · shape 2x1 "
+        "· 16 units/chip · tier prefill · tiers 1 prefill + 2 decode"
+        in out
+    )
+    assert "wal seq 9" in out

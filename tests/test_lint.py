@@ -136,6 +136,25 @@ def test_wal_rule_accepts_canonical_shapes():
     assert _rules([mod], "wal-protocol") == []
 
 
+def test_wal_rule_flags_handoff_begin_shapes():
+    """The KV-handoff journal's begin form (``_journal_handoff``,
+    serving/handoffproto.py) carries the same domination obligation as a
+    plain ``begin`` — a handoff left pending on a live path, or a
+    swallowed transfer failure, is exactly the defect the chaos suite
+    would otherwise only catch at crash time."""
+    mod = _fixture("wal_handoff_bad.py", PKG + "wal_handoff_bad.py")
+    found = _rules([mod], "wal-protocol")
+    assert len(found) == 2, found
+    messages = " | ".join(f.message for f in found)
+    assert "return without" in messages
+    assert "swallow" in messages
+
+
+def test_wal_rule_accepts_handoff_mover_shape():
+    mod = _fixture("wal_handoff_ok.py", PKG + "wal_handoff_ok.py")
+    assert _rules([mod], "wal-protocol") == []
+
+
 # --- span leak --------------------------------------------------------------
 
 

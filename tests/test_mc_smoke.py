@@ -4,8 +4,10 @@ Every schedule of the small-model protocol harnesses must be clean:
 
 - the drain handshake exhaustively (k=inf — every interleaving up to
   trace equivalence);
-- the gang-2PC and move-protocol models exhaustively within the
-  preemption bound (every schedule with <= k preemptions, POR off);
+- the gang-2PC, move-protocol, and KV-handoff models exhaustively
+  within the preemption bound (every schedule with <= k preemptions,
+  POR off), with the handoff models alone also required to clear the
+  1,000-schedule floor (the disaggregation PR's acceptance gate);
 
 with the combined explored-schedule count reported and required to
 exceed 1,000 — the floor that keeps the suite's coverage from silently
@@ -25,6 +27,7 @@ MIN_COMBINED_SCHEDULES = 1_000
 
 def test_mc_smoke_suite_zero_violations_and_reported_coverage():
     total = 0
+    handoff_total = 0
     summaries: list[str] = []
     for name, k in SMOKE_SUITE:
         result = Explorer(get_model(name), k=k).explore()
@@ -39,11 +42,20 @@ def test_mc_smoke_suite_zero_violations_and_reported_coverage():
             )
         )
         total += result.schedules
+        if name.startswith("handoff"):
+            handoff_total += result.schedules
     report = "\n".join(summaries)
     print(f"\n{report}\ncombined: {total} schedules")
     assert total > MIN_COMBINED_SCHEDULES, (
         f"combined schedule count {total} <= {MIN_COMBINED_SCHEDULES} — "
         f"model-checking coverage collapsed:\n{report}"
+    )
+    # the KV-handoff protocol carries its own floor: the disaggregation
+    # PR's acceptance gate is >1k clean schedules for the handoff models
+    # alone, not diluted into the suite-wide count
+    assert handoff_total > MIN_COMBINED_SCHEDULES, (
+        f"handoff models explored only {handoff_total} schedules "
+        f"(<= {MIN_COMBINED_SCHEDULES}):\n{report}"
     )
 
 
@@ -54,3 +66,5 @@ def test_smoke_suite_shape_documents_bounds():
     assert by_name["drain-handshake"] is None
     assert by_name["gang2pc"] is not None
     assert by_name["move"] is not None
+    assert by_name["handoff"] is not None
+    assert by_name["handoff-crash"] is not None

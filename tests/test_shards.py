@@ -408,6 +408,58 @@ def test_gang_group_commits_across_shards(tmp_path):
         assert_2pc_drained(env)
 
 
+def test_two_tier_group_admission_records_tier_composition(tmp_path):
+    """A disaggregated prefill/decode slice (serving/handoff.py) admits
+    as ONE gang group — all-or-nothing 2PC — and each member's decision
+    record carries its serving tier plus the group's tier composition,
+    so `inspect why` can show the two-tier admission."""
+    with cross_shard_group_env(tmp_path) as env:
+        pods = []
+        for m, tier in enumerate(
+            (const.SERVING_TIER_PREFILL, const.SERVING_TIER_DECODE)
+        ):
+            pod = group_pod(f"xg-tier-m{m}", "xg-tier", 64, "2x1")
+            pod["metadata"]["annotations"][const.ANN_SERVING_TIER] = tier
+            env.api.add_pod(pod)
+            pods.append(pod)
+        result = env.router.admit_gang_group(pods)
+        assert result["error"] == "", result
+        assert result["pending_rollforward"] == []
+        assert all(group_states(env.client, "xg-tier"))
+        for m, tier in enumerate(
+            (const.SERVING_TIER_PREFILL, const.SERVING_TIER_DECODE)
+        ):
+            recs = DECISIONS.records(
+                pod=f"default/xg-tier-m{m}", verb="gang-group"
+            )
+            assert recs, f"no gang-group record for member {m}"
+            placement = recs[-1].placement
+            assert placement["group"] == "xg-tier"
+            assert placement["members"] == 2
+            assert placement["tier"] == tier
+            assert placement["tiers"] == {
+                const.SERVING_TIER_PREFILL: 1,
+                const.SERVING_TIER_DECODE: 1,
+            }
+            assert recs[-1].seq is not None
+
+
+def test_unified_group_admission_records_carry_no_tier(tmp_path):
+    """Gang groups that never declare serving tiers keep the reference
+    decision-record shape: no tier/tiers placement fields."""
+    with cross_shard_group_env(tmp_path) as env:
+        pods = make_group(env, "xg-plain", n_members=2)
+        result = env.router.admit_gang_group(pods)
+        assert result["error"] == "", result
+        recs = DECISIONS.records(
+            pod="default/xg-plain-m0", verb="gang-group"
+        )
+        assert recs
+        placement = recs[-1].placement
+        assert placement["group"] == "xg-plain"
+        assert "tier" not in placement and "tiers" not in placement
+
+
 def test_gang_group_aborts_whole_when_one_member_cannot_fit(tmp_path):
     with cross_shard_group_env(tmp_path) as env:
         # four members, only three single-node slots in the cluster

@@ -24,10 +24,13 @@ The invariant (docs/analysis.md, "WAL begin/commit protocol"): a
 Recognized begin/resolve forms: calls through a checkpoint-hinted
 receiver (``self._ckpt.begin(...)``, ``ckpt.abort(...)``) and the
 thin module delegation helpers — ``_journal_begin``/``_journal_resolve``
-on the admission path and ``_journal_phase``/``_journal_resolve`` on the
-defragmentation move path (record kind ``"move"``: each protocol phase
-journals a fresh begin for the move key, so every ``_journal_phase``
-call site carries the same domination obligation a plain ``begin`` does).
+on the admission path, ``_journal_phase``/``_journal_resolve`` on the
+defragmentation move path (record kind ``"move"``), and
+``_journal_handoff``/``_journal_resolve`` on the prefill/decode
+KV-handoff path (record kind ``"handoff"``, serving/handoffproto.py).
+The phase-style helpers journal a fresh begin for their protocol key at
+every phase, so every call site carries the same domination obligation
+a plain ``begin`` does.
 """
 
 from __future__ import annotations
@@ -37,7 +40,7 @@ import ast
 from .engine import Finding, Module
 
 CKPT_RECEIVERS = ("_ckpt", "ckpt", "checkpoint", "_checkpoint")
-BEGIN_HELPERS = ("_journal_begin", "_journal_phase")
+BEGIN_HELPERS = ("_journal_begin", "_journal_phase", "_journal_handoff")
 RESOLVE_HELPERS = ("_journal_resolve",)
 # Cross-shard two-phase "gang2pc" records (extender/shards.py) have a
 # DIFFERENT obligation than ordinary begins: a prepare legitimately

@@ -26,6 +26,16 @@ standing invariants at the terminal state:
   ``drain-broken`` seeds the pre-PR-13 bug (arming without resetting
   the prior cycle's answer) and exists so the checker provably FINDS
   the lost-capture schedule — the explorer self-tests pin it.
+- **handoff** — two :class:`HandoffMover` instances race the journaled
+  export→transfer→import→commit KV-handoff protocol
+  (``serving/handoffproto.py``) into one decode-tier import ledger over
+  a page pool too small for both stagings, with a reconciler pass
+  interleaved; ``handoff-crash`` seeds pre-crashed journal entries (a
+  partial ``transfer``, a sealed ``import``) the reconciler must roll
+  back/forward. Invariants: every handoff serves its request exactly
+  once (KV import or re-prefill — never lost, never duplicated), the
+  page pool drains to fully free, no pending handoff entry after
+  resolve.
 - **racy-counter** / **indep-workers** — toy models for the explorer's
   own tests: a classic read-modify-write race (found at k>=1), and a
   mostly-independent workload where sleep-set POR must prune schedules
@@ -55,6 +65,17 @@ from gpushare_device_plugin_tpu.extender.shards import (
     resolve_gang2pc,
 )
 from gpushare_device_plugin_tpu.serving.drainproto import DrainHandshake
+from gpushare_device_plugin_tpu.serving.handoffproto import (
+    HandoffImportLedger,
+    HandoffMover,
+    HandoffPeerClient,
+    HandoffPlan,
+    HandoffSink,
+    handoff_key,
+    resolve_handoff,
+)
+from gpushare_device_plugin_tpu.serving.pages import PageAllocator
+from gpushare_device_plugin_tpu.utils.circuit import CircuitBreaker
 from gpushare_device_plugin_tpu.utils.faults import FAULTS
 
 from .memwal import MemJournal
@@ -787,6 +808,147 @@ class MoveModel:
 
 
 # ---------------------------------------------------------------------------
+# KV handoff protocol
+# ---------------------------------------------------------------------------
+
+
+class HandoffModel:
+    """The journaled prefill→decode KV-handoff protocol: movers racing
+    one decode-tier import ledger (and, in the crash variant, a
+    reconciler finishing what a dead incarnation journaled). All real
+    code — :class:`HandoffMover`, :class:`HandoffSink`,
+    :class:`HandoffImportLedger`, :class:`resolve_handoff` — over the
+    in-memory journal; only the decode ENGINE is simulated (import =
+    record + release pages, exactly the retire-side effect)."""
+
+    def __init__(self, crashed: bool = False) -> None:
+        self.name = "handoff-crash" if crashed else "handoff"
+        self.crashed = crashed
+
+    @staticmethod
+    def _plan(hid: str, n_pages: int) -> HandoffPlan:
+        return HandoffPlan(
+            handoff_id=hid,
+            request={"rid": hid, "prompt": [1, 2], "tokens": [3],
+                     "max_new": 4, "tier": "critical"},
+            meta={"page_size": 2},
+            pages=tuple(
+                f"kv-{hid}-{i}".encode() for i in range(n_pages)
+            ),
+        )
+
+    def build(self) -> Harness:
+        assume = AssumeCache()
+        ckpt = MemJournal()
+        # pool sized so two 2-page stagings cannot coexist: whichever
+        # mover stages second degrades to re-prefill (unless the first
+        # already adopted and released — both outcomes are legal; the
+        # invariant is exactly-once either way)
+        pool = PageAllocator(5 if self.crashed else 3)
+        ledger = HandoffImportLedger()
+        served: dict[str, list[str]] = {}
+
+        def import_cb(pages, blobs, meta, record) -> None:
+            # the simulated decode engine: adopting a handoff serves its
+            # request and (the retire-side effect) recycles the pages
+            served.setdefault(str(record["handoff_id"]), []).append("kv")
+            pool.release(pages)
+
+        def reprefill_cb(record) -> None:
+            served.setdefault(
+                str(record["handoff_id"]), []
+            ).append("reprefill")
+
+        sink = HandoffSink(
+            ledger, pool.alloc, pool.release, import_cb, reprefill_cb
+        )
+        # deterministic plumbing: no wall-clock reads may steer control
+        # flow (frozen clock = deadlines/breaker timeouts never fire)
+        peer = HandoffPeerClient(
+            sink, sleep=lambda s: None, clock=lambda: 0.0,
+            breaker=CircuitBreaker("handoff-peer", clock=lambda: 0.0),
+        )
+        mover = HandoffMover(
+            ckpt, assume, peer, fallback_fn=sink.deliver, node="mc",
+        )
+        expected = set()
+
+        def run_one(hid: str, n_pages: int):
+            expected.add(hid)
+            return lambda: mover.execute(self._plan(hid, n_pages))
+
+        def reconcile_pass() -> None:
+            for key, data in ckpt.pending().items():
+                if data.get("kind") != "handoff":
+                    continue
+                if assume.is_claimed(key):
+                    continue  # a live mover owns it
+                resolve_handoff(
+                    ckpt, assume, key, data,
+                    deliver_fn=sink.deliver, abort_fn=sink.abort,
+                )
+
+        if self.crashed:
+            # pre-crash state a dead incarnation left behind: hc1 died
+            # in "transfer" with a partial staging (rolls back to
+            # re-prefill), hc2 died in "import" with a sealed staging
+            # (rolls forward to a KV adopt). Journaled WITHOUT claims —
+            # exactly what restart recovery sees.
+            from gpushare_device_plugin_tpu.serving.handoffproto import page_crc
+
+            for hid, phase, puts in (("hc1", "transfer", 1), ("hc2", "import", 2)):
+                plan = self._plan(hid, 2)
+                expected.add(hid)
+                ckpt.begin(handoff_key(hid), {
+                    "kind": "handoff", "handoff_id": hid,
+                    "request": plan.request, "meta": plan.meta,
+                    "n_pages": 2, "node": "dead", "phase": phase,
+                })
+                ledger.stage(hid, 2, plan.meta, pool.alloc)
+                for i in range(puts):
+                    ledger.put_page(
+                        hid, i, plan.pages[i], page_crc(plan.pages[i])
+                    )
+            tasks = [
+                ("mover", run_one("h3", 1)),
+                ("reconciler", reconcile_pass),
+            ]
+        else:
+            tasks = [
+                ("mover-a", run_one("ha", 2)),
+                ("mover-b", run_one("hb", 2)),
+                ("reconciler", reconcile_pass),
+            ]
+
+        def check() -> None:
+            reconcile_pass()
+            if ckpt.pending():
+                raise InvariantViolation(
+                    f"pending handoff entries after resolve: {ckpt.pending()}"
+                )
+            for hid in expected:
+                modes = served.get(hid, [])
+                if len(modes) != 1:
+                    raise InvariantViolation(
+                        f"handoff {hid} served {len(modes)} times "
+                        f"({modes}): exactly-once violated (all: {served})"
+                    )
+            if pool.free_pages != pool.total or ledger.pages_in_flight:
+                raise InvariantViolation(
+                    f"leaked pages at terminal state: free "
+                    f"{pool.free_pages}/{pool.total}, "
+                    f"{ledger.pages_in_flight} still staged"
+                )
+            claims, mem, core = assume.snapshot()
+            if claims or mem or core or assume.gang_snapshot():
+                raise InvariantViolation(
+                    f"ledger not drained: claims={claims} mem={mem}"
+                )
+
+        return Harness(tasks, check)
+
+
+# ---------------------------------------------------------------------------
 # registry
 # ---------------------------------------------------------------------------
 
@@ -803,6 +965,8 @@ MODELS: dict[str, Callable[[], Any]] = {
     "gang2pc-resolve-ungated": lambda: Gang2pcResolveModel(gated=False),
     "move": MoveModel,
     "move-reconciler": lambda: MoveModel(with_reconciler=True),
+    "handoff": HandoffModel,
+    "handoff-crash": lambda: HandoffModel(crashed=True),
 }
 
 
@@ -826,6 +990,8 @@ SMOKE_SUITE: tuple[tuple[str, int | None], ...] = (
     ("gang2pc-resolve", 1),
     ("move", 2),
     ("move-reconciler", 1),
+    ("handoff", 1),
+    ("handoff-crash", 2),
 )
 
 FULL_SUITE: tuple[tuple[str, int | None], ...] = (
@@ -834,4 +1000,6 @@ FULL_SUITE: tuple[tuple[str, int | None], ...] = (
     ("gang2pc-resolve", 2),
     ("move", 3),
     ("move-reconciler", 2),
+    ("handoff", 2),
+    ("handoff-crash", 2),
 )
