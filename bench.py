@@ -1356,6 +1356,35 @@ def disagg_tpot_guard(p99_ms: float | None, repo: Path) -> str | None:
     )
 
 
+def spec_tokens_guard(tokens_s: float | None, repo: Path) -> str | None:
+    """Failure message when the speculative paged engine's throughput
+    (``spec_tokens_per_s``, the serve_spec section) dropped
+    >P99_GUARD_PCT below the newest committed record carrying it; None
+    when within budget or no history. Lower is worse (throughput). The
+    improvement-vs-plain bar is hard-gated inside bench_mfu on the full
+    run; this guards the trend — a pipeline change that still "wins"
+    but emits tokens slower than it used to is a regression."""
+    return _pct_trend_guard(
+        tokens_s, repo, field="spec_tokens_per_s",
+        label="spec tokens/s", fmt=".1f", unit=" tokens/s",
+        lower_is_worse=True,
+    )
+
+
+def spec_accept_guard(mean_len: float | None, repo: Path) -> str | None:
+    """Same budget for the mean acceptance length
+    (``spec_accept_len_mean``): the bench self-drafts, so this sits at
+    the ceiling k — any drop means the verify/accept math started
+    rejecting tokens the draft got right, which is a correctness smell
+    even while the parity gate still passes (the correction token
+    masks it)."""
+    return _pct_trend_guard(
+        mean_len, repo, field="spec_accept_len_mean",
+        label="spec acceptance length", fmt=".3f", unit=" tokens",
+        lower_is_worse=True,
+    )
+
+
 def interference_guard(pct: float | None, repo: Path) -> str | None:
     """Failure message when the interference bench's governor-OFF p99
     inflation (``interference_p99_inflation_pct``) DROPPED >25% vs the
@@ -1979,6 +2008,15 @@ def main(argv=None) -> int:
         .get("disagg_ttft_p99_ms"),
         "disagg_tpot_p99_ms": compute.get("serve_disagg", {})
         .get("disagg_tpot_p99_ms"),
+        # Speculative-decoding numbers (serve_spec section), hoisted for
+        # the trend guards: spec-engine throughput at equal HBM and the
+        # mean acceptance length (ceiling k under self-draft; the
+        # parity/zero-retrace/budget invariants hard-gate inside
+        # bench_mfu itself).
+        "spec_tokens_per_s": compute.get("serve_spec", {})
+        .get("spec_tokens_per_s"),
+        "spec_accept_len_mean": compute.get("serve_spec", {})
+        .get("spec_accept_len_mean"),
         # Interference bench numbers (serve_interference section),
         # hoisted for the trend guard: the governor-OFF inflation is the
         # scenario's signal strength (the governed/overhead bounds hard-
@@ -2035,6 +2073,8 @@ def main(argv=None) -> int:
         ))
         msgs.append(disagg_ttft_guard(record["disagg_ttft_p99_ms"], repo))
         msgs.append(disagg_tpot_guard(record["disagg_tpot_p99_ms"], repo))
+        msgs.append(spec_tokens_guard(record["spec_tokens_per_s"], repo))
+        msgs.append(spec_accept_guard(record["spec_accept_len_mean"], repo))
         msgs.append(gang_storm_guard(record["gang_throughput_gangs_s"], repo))
         msgs.append(defrag_stranded_guard(record["defrag_stranded_after_pct"], repo))
         msgs.append(defrag_binpack_guard(record["defrag_binpack_after_pct"], repo))

@@ -1647,6 +1647,210 @@ def bench_serve_disagg(report: dict, smoke: bool = False) -> None:
         )
 
 
+def bench_serve_spec(report: dict, smoke: bool = False) -> None:
+    """Speculative decoding inside the paged engine vs the plain paged
+    engine at EQUAL HBM: both plans are sized by ``paged_plan_for_slice``
+    against the SAME ``aliyun.com/tpu-mem`` byte budget — the spec plan
+    buys its draft weights and draft KV pages out of that budget, it
+    does not get extra bytes (``serving/pages.py`` draft accounting).
+
+    The draft is the target itself (self-draft): with greedy decode the
+    proposals match the verify argmax exactly, so acceptance is the
+    ceiling and the bench measures the pipeline itself — the 2-tick
+    draft+verify round emitting up to k+1 tokens — rather than a
+    particular draft model's quality. That makes the speedup an upper
+    bound and the parity/retrace gates exact.
+
+    The trace is decode-dominated (short shared-prefix prompts, long
+    generations, near-simultaneous arrivals) — the workload
+    speculation exists for. Prefill-heavy mixes pay the extra
+    draft+verify dispatches per interleave round without the long
+    decode tail that amortizes them; that regime is
+    ``bench_serve_disagg``'s territory.
+
+    Hard gates (smoke included): per-request tokens BIT-IDENTICAL to
+    the plain paged engine, zero retraces on both engines (acceptance
+    lengths are data, not shapes), a nonempty acceptance histogram
+    (the spec path actually ran and accepted), spec round ticks
+    strictly below plain decode ticks, and the budget accounting
+    closed (target + draft weights + pool <= budget * headroom). The
+    full TPU run additionally gates wall-clock tokens/s improvement.
+    The row's ``spec_tokens_per_s`` / ``spec_accept_len_mean`` feed
+    bench.py's 25% trend guards.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from gpushare_device_plugin_tpu.serving import (
+        TIER_BEST_EFFORT,
+        TIER_CRITICAL,
+        PagedSlotEngine,
+        kv_slot_bytes,
+        paged_plan_for_slice,
+        shared_prefix_trace,
+    )
+    from gpushare_device_plugin_tpu.workloads.quant import cast_decoder
+    from gpushare_device_plugin_tpu.workloads.transformer import (
+        TransformerConfig,
+        init_params,
+    )
+
+    if smoke:
+        cfg = TransformerConfig(
+            vocab=128, d_model=256, n_layers=2, n_heads=4, n_kv_heads=2,
+            d_ff=512, max_seq=128, compute_dtype=jnp.float32,
+        )
+        max_len, chunk, page, spec_k = 64, 8, 8, 3
+        n_req, rate, pre, tails, mix = 8, 2.0, (2, 8), (1, 4), (24, 32, 48)
+        params = init_params(jax.random.key(0), cfg)
+    else:
+        cfg = _bench_cfg(smoke)
+        max_len, chunk, page, spec_k = 1024, 256, 64, 4
+        n_req, rate, pre, tails, mix = 16, 1.0, (3, 128), (8, 64), (64, 128, 192)
+        params = jax.jit(lambda k: cast_decoder(init_params(k, cfg)))(
+            jax.random.key(0)
+        )
+    eos = 2
+    weight_bytes = sum(
+        x.size * x.dtype.itemsize for x in jax.tree_util.tree_leaves(params)
+    )
+    headroom = 0.90
+    # Self-draft doubles the per-page cost and the resident weights, so
+    # the budget must fit two weight copies plus a pool worth ~6
+    # max_len rows at the doubled page cost.
+    page_b = kv_slot_bytes(cfg, page)
+    pages_per = -(-max_len // page)
+    budget = int(
+        (2 * weight_bytes + 6 * pages_per * 2 * page_b) / headroom
+    )
+    spec_plan = paged_plan_for_slice(
+        budget, cfg, max_len, page_size=page, prefill_chunk=chunk,
+        weight_bytes=weight_bytes, draft_cfg=cfg,
+        draft_weight_bytes=weight_bytes,
+    )
+    # The plain side spends the identical budget: no draft to pay for,
+    # so the same bytes buy ~2x the pages. Concurrency is pinned to the
+    # spec plan's slot count on BOTH sides so the tick comparison
+    # measures the draft+verify pipeline, not a batching difference —
+    # the plain engine keeps its page surplus (fewer preemptions, never
+    # a handicap).
+    plain_plan = paged_plan_for_slice(
+        budget, cfg, max_len, page_size=page, prefill_chunk=chunk,
+        weight_bytes=weight_bytes, slots=spec_plan.slots,
+    )
+    tiers = [
+        (TIER_CRITICAL, 0.5, 40.0, 4.0),
+        (TIER_BEST_EFFORT, 0.5, None, None),
+    ]
+    reqs = shared_prefix_trace(
+        n_req, seed=29, rate=rate, vocab=cfg.vocab, prefixes=pre,
+        tail_lens=tails, max_new=list(mix), tiers=tiers,
+    )
+
+    plain = PagedSlotEngine(
+        params, cfg, slots=plain_plan.slots, max_len=max_len,
+        total_pages=plain_plan.total_pages, page_size=page,
+        prefill_chunk=chunk, eos_id=eos,
+    )
+    plain.warmup()
+    plain_warm = dict(plain.trace_counts)
+    plain_stats = plain.run(reqs)
+    plain_retraces = sum(
+        plain.trace_counts[k] - plain_warm[k] for k in plain_warm
+    )
+    plain_tokens = {r.rid: list(r.tokens) for r in plain_stats.results}
+
+    spec = PagedSlotEngine(
+        params, cfg, slots=spec_plan.slots, max_len=max_len,
+        total_pages=spec_plan.total_pages, page_size=page,
+        prefill_chunk=chunk, eos_id=eos, draft_params=params,
+        draft_cfg=cfg, spec_k=spec_k,
+    )
+    spec.warmup()
+    spec_warm = dict(spec.trace_counts)
+    spec_stats = spec.run(reqs)
+    spec_retraces = sum(
+        spec.trace_counts[k] - spec_warm[k] for k in spec_warm
+    )
+    mismatch = [
+        r.rid for r in spec_stats.results
+        if list(r.tokens) != plain_tokens.get(r.rid)
+    ]
+    sinfo = spec_stats.engine_cache["speculative"]
+    emitted = sum(len(r.tokens) for r in spec_stats.results)
+    p_sum, s_sum = plain_stats.summary(), spec_stats.summary()
+    plain_tps = round(emitted / max(plain_stats.wall_s, 1e-9), 2)
+    spec_tps = round(emitted / max(spec_stats.wall_s, 1e-9), 2)
+    row = {
+        "budget_bytes": budget,
+        "weight_bytes": weight_bytes,
+        "draft_weight_bytes": weight_bytes,
+        "page_size": page,
+        "spec_k": spec_k,
+        "requests": n_req,
+        "plain_plan": {
+            "slots": plain_plan.slots, "pages": plain_plan.total_pages,
+        },
+        "spec_plan": {
+            "slots": spec_plan.slots, "pages": spec_plan.total_pages,
+            "draft_page_bytes": spec_plan.draft_page_bytes,
+            "draft_bytes": spec_plan.draft_bytes,
+        },
+        "plain": p_sum,
+        "spec": s_sum,
+        "draft_steps": sinfo["draft_steps"],
+        "rollback_pages": sinfo["rollback_pages"],
+        "retraces": plain_retraces + spec_retraces,
+        "tick_speedup": round(p_sum["ticks"] / max(s_sum["ticks"], 1), 2),
+        "plain_tokens_per_s": plain_tps,
+        "spec_tokens_per_s": spec_tps,
+        "spec_accept_len_mean": round(
+            sinfo["k"] * sinfo["accepted"] / max(sinfo["proposed"], 1), 3
+        ),
+    }
+    report["serve_spec"] = row
+    print(f"serve_spec {row}", file=sys.stderr)
+    if mismatch:
+        raise AssertionError(
+            f"speculative engine diverged from plain paged on requests "
+            f"{mismatch[:5]} — accept/rollback must reproduce the exact "
+            "sequential greedy stream"
+        )
+    if row["retraces"]:
+        raise AssertionError(
+            f"{row['retraces']} retraces across the two engines — "
+            "acceptance lengths are data, not shapes; the spec machinery "
+            "must compile exactly once per program (5 total)"
+        )
+    if sinfo["draft_steps"] < 1 or sinfo["accepted"] < 1:
+        raise AssertionError(
+            f"acceptance histogram empty (draft_steps="
+            f"{sinfo['draft_steps']}, accepted={sinfo['accepted']}) — "
+            "the speculative path never ran or never accepted; the "
+            "comparison is vacuous"
+        )
+    spec_resident = 2 * weight_bytes + spec_plan.pool_bytes
+    if spec_resident > int(budget * headroom):
+        raise AssertionError(
+            f"spec plan oversubscribes the slice: weights+draft+pool "
+            f"{spec_resident} > {int(budget * headroom)} usable of the "
+            f"{budget}-byte budget — the draft must be charged against "
+            "the same aliyun.com/tpu-mem slice, not ride for free"
+        )
+    if s_sum["ticks"] >= p_sum["ticks"]:
+        raise AssertionError(
+            f"spec ticks {s_sum['ticks']} >= plain {p_sum['ticks']} — "
+            "at ceiling acceptance the 2-tick draft+verify round must "
+            "beat one-token-per-tick decode"
+        )
+    if not smoke and spec_tps <= plain_tps:
+        raise AssertionError(
+            f"spec tokens/s {spec_tps} <= plain {plain_tps} at equal "
+            "HBM — the speculative pipeline must convert ceiling "
+            "acceptance into wall-clock throughput on real hardware"
+        )
+
+
 def bench_sweep(report: dict, smoke: bool = False) -> None:
     """Flash block-size sweep (opt-in via --sweep): honest-timed wall per
     (block_q, block_k) at the bench shapes, to re-tune the defaults that
@@ -1789,6 +1993,16 @@ def parse_args(argv: list[str] | None = None) -> argparse.Namespace:
         "tests/test_bench_disagg_smoke.py)",
     )
     p.add_argument(
+        "--spec-smoke", action="store_true",
+        help="CPU speculative-decoding smoke: ONLY the serve_spec "
+        "section (draft/verify pipeline inside the paged engine vs the "
+        "plain paged engine at equal HBM, self-draft for ceiling "
+        "acceptance; hard-fails on token divergence, retraces, an "
+        "empty acceptance histogram, oversubscribed budget, or spec "
+        "ticks not beating plain) (make bench-spec-smoke; tier-1 via "
+        "tests/test_bench_spec_smoke.py)",
+    )
+    p.add_argument(
         "--backend-init-timeout", type=float, default=60.0,
         help="seconds the subprocess backend-init probe may take before "
         "the run is skipped with an explicit reason (the old in-process "
@@ -1802,7 +2016,7 @@ def main(argv: list[str] | None = None) -> int:
     smoke = (
         args.smoke or args.serve_smoke or args.multichip_smoke
         or args.paged_smoke or args.interference_smoke
-        or args.disagg_smoke
+        or args.disagg_smoke or args.spec_smoke
     )
     if smoke:
         # Force, don't default: an inherited JAX_PLATFORMS (axon/tpu) would
@@ -1907,6 +2121,7 @@ def main(argv: list[str] | None = None) -> int:
         ("serve_paged", bench_serve_paged),
         ("serve_interference", bench_serve_interference),
         ("serve_disagg", bench_serve_disagg),
+        ("serve_spec", bench_serve_spec),
     ]
     if args.serve_smoke:
         # ONLY serve_engine, by contract (the smoke test and the verify
@@ -1925,6 +2140,9 @@ def main(argv: list[str] | None = None) -> int:
     elif args.disagg_smoke:
         # ONLY serve_disagg, same single-section contract
         sections = [("serve_disagg", bench_serve_disagg)]
+    elif args.spec_smoke:
+        # ONLY serve_spec, same single-section contract
+        sections = [("serve_spec", bench_serve_spec)]
     else:
         if args.ablate:
             sections.append(("ablate", bench_ablate))

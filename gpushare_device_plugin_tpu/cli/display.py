@@ -131,7 +131,11 @@ def _engine_cell(row: dict[str, float]) -> str:
     already stripped). A disaggregated pod's ``tpushare_handoff_*``
     counters (folded into the row under ``handoff_*`` keys) append the
     KV-handoff story: transfers delivered, re-prefill fallbacks, pages
-    still staged in flight."""
+    still staged in flight. A speculative engine
+    (``tpushare_engine_spec_*`` families) appends its summary — draft
+    length, mean tokens emitted per verify dispatch, rollback pages —
+    e.g. ``spec k=4 · acc 2.7/step · rb 12``; pods that export no spec
+    families show nothing extra."""
     parts = []
     total = row.get("kv_pages_total")
     if total is not None:
@@ -158,7 +162,42 @@ def _engine_cell(row: dict[str, float]) -> str:
         inflight = row.get("handoff_pages_in_flight", 0.0)
         if inflight:
             parts.append(f"inflight {int(inflight)}")
+    if row.get("spec_enabled"):
+        spec = f"spec k={int(row.get('spec_k', 0))}"
+        cnt = row.get("spec_accepted_tokens_per_step_count", 0.0)
+        if cnt:
+            mean = row.get("spec_accepted_tokens_per_step_sum", 0.0) / cnt
+            spec += f" · acc {mean:.1f}/step"
+        spec += f" · rb {int(row.get('spec_rollback_pages_total', 0.0))}"
+        parts.append(spec)
     return " · ".join(parts) or "-"
+
+
+def spec_row_for(row: dict[str, float] | None) -> dict | None:
+    """The ``speculative`` JSON sub-document for one scraped engine row
+    (``-o json``): draft length, dispatch/rollback counters, and the
+    acceptance means recovered from the histograms' ``_sum``/``_count``
+    samples. ``None`` when the pod exports no spec families — the
+    no-speculation reference document gains no key."""
+    if not row or not row.get("spec_enabled"):
+        return None
+    out: dict = {
+        "enabled": True,
+        "k": int(row.get("spec_k", 0.0)),
+        "draft_steps": int(row.get("spec_draft_steps_total", 0.0)),
+        "rollback_pages": int(row.get("spec_rollback_pages_total", 0.0)),
+    }
+    cnt = row.get("spec_acceptance_len_count", 0.0)
+    if cnt:
+        out["acceptance_len_mean"] = round(
+            row.get("spec_acceptance_len_sum", 0.0) / cnt, 3
+        )
+    cnt = row.get("spec_accepted_tokens_per_step_count", 0.0)
+    if cnt:
+        out["accepted_tokens_per_step_mean"] = round(
+            row.get("spec_accepted_tokens_per_step_sum", 0.0) / cnt, 3
+        )
+    return out
 
 
 def engine_row_for(pod, engine: dict[str, dict[str, float]] | None):

@@ -104,8 +104,11 @@ def parse_engine_metrics(text: str) -> dict[str, dict[str, float]]:
     exposition: ``tpushare_engine_*`` samples keyed by their ``pod``
     label (``""`` for unlabeled engines). Families: KV page occupancy
     (``kv_pages_total/used/free``), ``prefix_hit_ratio``,
-    ``prefix_cached_pages``, and the ``preemptions`` gauge /
-    ``preemptions_total`` counter.
+    ``prefix_cached_pages``, the ``preemptions`` gauge /
+    ``preemptions_total`` counter, and the speculative-decoding
+    ``spec_*`` group (``spec_enabled``/``spec_k`` gauges,
+    ``spec_draft_steps_total``/``spec_rollback_pages_total`` counters,
+    plus the ``_sum``/``_count`` of the acceptance histograms).
 
     The disaggregated-serving ``tpushare_handoff_*`` families
     (utils/metric_catalog.py) fold into the same per-pod row under
@@ -653,10 +656,12 @@ def render_json(
     """Machine-readable report: the same numbers the tables show,
     including the north-star cluster utilization line. ``engine``
     (``fetch_engine_metrics`` output) attaches each serving pod's cache
-    telemetry as a ``serving_cache`` sub-document."""
+    telemetry as a ``serving_cache`` sub-document, plus a
+    ``speculative`` sub-document for pods whose engine exports the
+    ``tpushare_engine_spec_*`` families."""
     import json
 
-    from .display import engine_row_for
+    from .display import engine_row_for, spec_row_for
     from .nodeinfo import infer_unit
 
     total = sum(n.total_units for n in infos)
@@ -738,6 +743,18 @@ def render_json(
                     **(
                         {"serving_cache": engine_row_for(p, engine)}
                         if engine_row_for(p, engine)
+                        else {}
+                    ),
+                    # speculative-decoding summary: emitted only when
+                    # the pod's engine exports the spec families, so the
+                    # no-speculation reference document is unchanged
+                    **(
+                        {
+                            "speculative": spec_row_for(
+                                engine_row_for(p, engine)
+                            )
+                        }
+                        if spec_row_for(engine_row_for(p, engine))
                         else {}
                     ),
                 }

@@ -8,7 +8,12 @@ for the slot-cache primitives it composes. ``pages`` + ``radix`` +
 :class:`~.engine.PagedSlotEngine` replace the per-request ``max_len``
 row with reference-counted fixed-size KV pages, a shared-prefix radix
 cache, and SLO-tiered admission with best-effort preemption
-(``docs/serving.md``, paged KV section). ``profiler`` + ``governor``
+(``docs/serving.md``, paged KV section). The paged engine optionally
+runs a draft model out of the same refcounted pool for greedy
+speculative decoding — draft proposes k tokens per slot, target
+verifies the block in one forward, accept/rollback by page refcount
+keeps tokens bit-identical to plain decode (``docs/serving.md``,
+speculative section). ``profiler`` + ``governor``
 are the serving half of the interference observability plane: per-slice
 decode-step profiling and the Tally-style best-effort step throttle
 (``docs/observability.md``, interference plane).

@@ -61,6 +61,12 @@ from ..utils.metric_catalog import (
     ENGINE_PREFIX_CACHED_PAGES,
     ENGINE_PREFIX_HIT_RATIO,
     ENGINE_PREFIX_HIT_TOKENS,
+    ENGINE_SPEC_ACCEPTANCE_LEN,
+    ENGINE_SPEC_ACCEPTED_TOKENS_PER_STEP,
+    ENGINE_SPEC_DRAFT_STEPS_TOTAL,
+    ENGINE_SPEC_ENABLED,
+    ENGINE_SPEC_K,
+    ENGINE_SPEC_ROLLBACK_PAGES_TOTAL,
 )
 from ..utils.metrics import REGISTRY
 from ..utils.tracing import TRACER
@@ -201,7 +207,10 @@ class ServeStats:
         """Per-SLO-tier latency + attainment rows (tick clock: the
         deterministic one the trace driver's targets are set on)."""
         out: dict = {}
-        for tier in sorted({r.tier for r in self.results}):
+        spec_tiers = (
+            self.engine_cache.get("speculative") or {}
+        ).get("tiers") or {}
+        for tier in sorted({r.tier for r in self.results} | set(spec_tiers)):
             rs = [r for r in self.results if r.tier == tier]
             ttft = [r.ttft_ticks for r in rs]
             tpot = [r.tpot_ticks for r in rs if len(r.tokens) > 1]
@@ -219,6 +228,12 @@ class ServeStats:
                 "slo_attainment": round(sum(scored) / len(scored), 3)
                 if scored else None,
             }
+            if tier in spec_tiers:
+                # Accepted-vs-proposed speculation breakdown per tier:
+                # which SLO class the draft model's lookahead is
+                # actually paying off for.
+                out[tier]["spec_proposed"] = spec_tiers[tier]["proposed"]
+                out[tier]["spec_accepted"] = spec_tiers[tier]["accepted"]
         return out
 
     def summary(self) -> dict:
@@ -246,7 +261,7 @@ class ServeStats:
         if any(
             r.tier != TIER_CRITICAL or r.meets_slo() is not None
             for r in self.results
-        ):
+        ) or (self.engine_cache.get("speculative") or {}).get("tiers"):
             out["tiers"] = self.tier_summary()
         if self.engine_cache:
             out["cache"] = dict(self.engine_cache)
@@ -355,18 +370,20 @@ class SlotEngine:
             self.cfg, self.n_slots, self.max_len, kv_dtype=kv_dtype
         )
 
-    def _shard_cache(self, cache):
+    def _shard_cache(self, cache, cfg: TransformerConfig | None = None):
         """Place the slot-pool cache tensor-parallel: K/V (and int8
         scales) shard their kv-heads axis over tp — each gang chip pins
         ``kv_slot_bytes / tp`` per row, which is what lets a gang's
         per-chip HBM share hold a pool no single chip could
         (:func:`slots_for_gang`). A kv-head count tp does not divide
         falls back to replication for that buffer (the
-        ``prune_unshardable`` rule), keeping correctness over memory."""
+        ``prune_unshardable`` rule), keeping correctness over memory.
+        ``cfg`` overrides whose kv-head count is checked (the paged
+        engine's draft-model pool shards by the DRAFT config)."""
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         tp = self.mesh.shape["tp"]
-        divisible = self.cfg.kv_heads % tp == 0
+        divisible = (cfg or self.cfg).kv_heads % tp == 0
 
         def spec_for(name: str, ndim: int):
             if name == "len" or not divisible:
@@ -701,6 +718,11 @@ class _PagedSlot:
     pages: list[int] = dataclasses.field(default_factory=list)
     shared: int = 0  # leading pages matched from the radix tree (read-only)
     table: np.ndarray | None = None  # [row_pages] int32 physical page ids
+    # True when the row's draft-pool KV is not trustworthy (handoff
+    # import seeds carry only target KV): the row plain-decodes forever
+    # and retire() must not adopt its pages into the radix tree, where a
+    # future prefix match would speculate over garbage draft state.
+    draft_stale: bool = False
 
 
 class PagedSlotEngine(SlotEngine):
@@ -729,6 +751,22 @@ class PagedSlotEngine(SlotEngine):
     whole chunks) and ``total_pages`` must cover one ``max_len`` row
     (the progress guarantee: a lone request can always finish after the
     pool drains around it).
+
+    **Speculative decoding** (``draft_params``/``draft_cfg``): a small
+    draft model proposes ``spec_k`` tokens per decoding row per round;
+    the target verifies the whole proposal in ONE forward
+    (``paged_verify_block``) and greedy accept/rollback keeps emitted
+    tokens bit-identical to the plain engine — the verify argmax IS the
+    sequential decode stream. Draft KV lives as parallel paged tensors
+    indexed by the SAME page ids/tables out of the same refcounted
+    allocator, so one page's cost is target + draft slot bytes
+    (:func:`~.pages.paged_plan_for_slice` charges both against the
+    slice budget). Lookahead pages come from plain ``allocator.alloc``
+    — drafts sit BELOW adapters and KV in the eviction ladder and never
+    evict radix pages or preempt rows; on rejection the tail pages roll
+    back by refcount release. Per-row acceptance lengths are data, not
+    shapes: exactly five compiled programs (prefill, extend, decode,
+    draft, verify), zero retraces across churn.
     """
 
     def __init__(
@@ -749,9 +787,26 @@ class PagedSlotEngine(SlotEngine):
         slo_budget=None,
         governor=None,
         profiler_capacity: int = 1024,
+        draft_params=None,
+        draft_cfg: TransformerConfig | None = None,
+        spec_k: int = 4,
     ):
         if page_size < 1:
             raise ValueError(f"page_size must be >= 1, got {page_size}")
+        if (draft_params is None) != (draft_cfg is None):
+            raise ValueError(
+                "draft_params and draft_cfg enable speculative decoding "
+                "together — passing one without the other is a config bug"
+            )
+        if draft_cfg is not None:
+            if draft_cfg.vocab != cfg.vocab:
+                raise ValueError(
+                    f"draft vocab {draft_cfg.vocab} != target vocab "
+                    f"{cfg.vocab} — draft proposals could never be compared "
+                    "token-for-token against target greedy picks"
+                )
+            if spec_k < 1:
+                raise ValueError(f"spec_k must be >= 1, got {spec_k}")
         if prefill_chunk % page_size != 0:
             raise ValueError(
                 f"prefill_chunk {prefill_chunk} must be a multiple of "
@@ -773,6 +828,16 @@ class PagedSlotEngine(SlotEngine):
         # JAX's index clamping fold those writes into the last REAL page.
         # row_span_for keeps this width and the sizing math's in lockstep.
         self.row_pages = row_span_for(max_len, prefill_chunk) // page_size
+        # Speculative-decoding state must exist BEFORE super().__init__:
+        # the overridden _build_fns (called from there) shapes its
+        # programs on whether a draft model rides along.
+        self.draft_params = draft_params
+        self.draft_cfg = draft_cfg
+        self.spec_k = int(spec_k)
+        # escape hatch: True parks every row on the plain decode path
+        # (tests pin that a suspended spec engine is bitwise the plain
+        # engine; both paths are compiled by warmup either way)
+        self._spec_suspended = False
         super().__init__(
             params, cfg, slots=slots, max_len=max_len,
             prefill_chunk=prefill_chunk, eos_id=eos_id, kv_dtype=kv_dtype,
@@ -782,6 +847,40 @@ class PagedSlotEngine(SlotEngine):
         self.allocator = PageAllocator(total_pages)
         self.radix = RadixCache(page_size, self.allocator) if radix else None
         self.preemptions = 0
+        # Draft-model KV: a parallel paged pool indexed by the SAME page
+        # ids and per-row tables as the target's — one allocator, one
+        # refcount table, so a page's slice cost is target + draft bytes
+        # (PagedPlan.draft_bytes) and releasing a page frees both models'
+        # stale KV at once. Radix-shared pages carry valid draft KV too:
+        # the combined prefill writes both pools in one dispatch.
+        if draft_params is not None:
+            self.trace_counts.update({"draft": 0, "verify": 0})
+            self.draft_cache = G.init_paged_cache(
+                draft_cfg, slots, total_pages + 1, page_size,
+                kv_dtype=kv_dtype,
+            )
+            if self.mesh is not None:
+                self.draft_params = shard_params(
+                    draft_params, self.mesh, draft_cfg
+                )
+                self.draft_cache = self._shard_cache(
+                    self.draft_cache, draft_cfg
+                )
+            self._spec_draft_steps = 0
+            self._spec_rollback_pages = 0
+            # published-counter watermarks: publish_metrics exports
+            # counter DELTAS, so back-to-back runs never double-count
+            self._spec_pub = {"draft_steps": 0, "rollback": 0}
+            # histogram accumulators, value -> multiplicity: bounded by
+            # the k+1 distinct acceptance lengths (and slots*(k+1)
+            # distinct per-round totals), flushed once per run — never
+            # a per-step registry call
+            self._spec_accept_hist: dict[int, int] = {}
+            self._spec_step_hist: dict[int, int] = {}
+            self._spec_tiers: dict[str, dict[str, int]] = {}
+            self._spec_lookahead_high = 0
+        else:
+            self.draft_cache = None
         # Live-defragmentation drain (allocator/defrag.py move protocol):
         # request_drain() quiesces the current run() at its next iteration
         # boundary — in-flight requests are captured into a JSON-safe
@@ -819,41 +918,178 @@ class PagedSlotEngine(SlotEngine):
 
     def _build_fns(self) -> None:
         cfg = self.cfg
+        if self.draft_params is None:
 
-        def prefill_fn(params, tokens, cache, slot, table, n_real):
+            def prefill_fn(params, tokens, cache, slot, table, n_real):
+                self.trace_counts["prefill"] += 1
+                logits, cache = G.paged_prefill_slot(
+                    params, tokens, cache, cfg, slot=slot, page_table=table,
+                    n_real=n_real,
+                )
+                return jnp.argmax(logits[0], -1).astype(jnp.int32), cache
+
+            def extend_fn(params, tokens, cache, slot, table, pos, n_real):
+                self.trace_counts["extend"] += 1
+                logits, cache = G.paged_extend_slot(
+                    params, tokens, cache, cfg, slot=slot, page_table=table,
+                    pos=pos, n_real=n_real,
+                )
+                return jnp.argmax(logits[0], -1).astype(jnp.int32), cache
+
+            def decode_fn(params, tokens, cache, tables, active):
+                self.trace_counts["decode"] += 1
+                logits, new = G.paged_decode_step(
+                    params, tokens, cache, cfg, page_tables=tables
+                )
+                nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+                new = {
+                    **new, "len": jnp.where(active, new["len"], cache["len"]),
+                }
+                return nxt, new
+
+            self._prefill = jax.jit(prefill_fn, donate_argnums=(2,))
+            self._extend = jax.jit(extend_fn, donate_argnums=(2,))
+            self._decode = jax.jit(decode_fn, donate_argnums=(2,))
+            return
+
+        # Speculative mode: every program that materializes KV runs the
+        # draft model IN THE SAME DISPATCH (same chunk, same page table),
+        # so the draft pool never falls out of lockstep with the target —
+        # through governor throttling, page pressure, preemption churn —
+        # with zero extra dispatches and the target subgraph (and so its
+        # argmax tokens) unchanged. The two spec-only programs are the
+        # draft lookahead scan and the one-forward verify; acceptance
+        # lengths flow through them as DATA, so the compiled-program
+        # count stays at five regardless of what gets accepted.
+        dcfg = self.draft_cfg
+        k = self.spec_k
+
+        def prefill_fn(params, dparams, tokens, cache, dcache, slot, table,
+                       n_real):
             self.trace_counts["prefill"] += 1
             logits, cache = G.paged_prefill_slot(
                 params, tokens, cache, cfg, slot=slot, page_table=table,
                 n_real=n_real,
             )
-            return jnp.argmax(logits[0], -1).astype(jnp.int32), cache
+            _, dcache = G.paged_prefill_slot(
+                dparams, tokens, dcache, dcfg, slot=slot, page_table=table,
+                n_real=n_real,
+            )
+            return jnp.argmax(logits[0], -1).astype(jnp.int32), cache, dcache
 
-        def extend_fn(params, tokens, cache, slot, table, pos, n_real):
+        def extend_fn(params, dparams, tokens, cache, dcache, slot, table,
+                      pos, n_real):
             self.trace_counts["extend"] += 1
             logits, cache = G.paged_extend_slot(
                 params, tokens, cache, cfg, slot=slot, page_table=table,
                 pos=pos, n_real=n_real,
             )
-            return jnp.argmax(logits[0], -1).astype(jnp.int32), cache
+            _, dcache = G.paged_extend_slot(
+                dparams, tokens, dcache, dcfg, slot=slot, page_table=table,
+                pos=pos, n_real=n_real,
+            )
+            return jnp.argmax(logits[0], -1).astype(jnp.int32), cache, dcache
 
-        def decode_fn(params, tokens, cache, tables, active):
+        def decode_fn(params, dparams, tokens, cache, dcache, tables, active):
             self.trace_counts["decode"] += 1
             logits, new = G.paged_decode_step(
                 params, tokens, cache, cfg, page_tables=tables
             )
             nxt = jnp.argmax(logits, -1).astype(jnp.int32)
             new = {**new, "len": jnp.where(active, new["len"], cache["len"])}
-            return nxt, new
+            _, dnew = G.paged_decode_step(
+                dparams, tokens, dcache, dcfg, page_tables=tables
+            )
+            dnew = {
+                **dnew, "len": jnp.where(active, dnew["len"], dcache["len"]),
+            }
+            return nxt, new, dnew
 
-        self._prefill = jax.jit(prefill_fn, donate_argnums=(2,))
-        self._extend = jax.jit(extend_fn, donate_argnums=(2,))
-        self._decode = jax.jit(decode_fn, donate_argnums=(2,))
+        def draft_fn(dparams, tokens, dcache, tables, active):
+            self.trace_counts["draft"] += 1
+            lens0 = dcache["len"]
+
+            def step(carry, _):
+                tok, c = carry
+                logits, c = G.paged_decode_step(
+                    dparams, tok, c, dcfg, page_tables=tables
+                )
+                nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+                return (nxt, c), nxt
+
+            # k+1 steps for k proposals: the extra step writes the last
+            # proposal's OWN KV entry — an unwritten (zero) entry there
+            # would silently poison every later draft prediction once
+            # that token is accepted.
+            (_, dcache), props = jax.lax.scan(
+                step, (tokens, dcache), None, length=k + 1
+            )
+            drafts = jnp.transpose(props[:k])  # [k+1, B] -> [B, k]
+            dcache = {
+                **dcache,
+                "len": jnp.where(active, lens0 + k + 1, lens0),
+            }
+            return drafts, dcache
+
+        def verify_fn(params, block, cache, dcache, tables, active):
+            self.trace_counts["verify"] += 1
+            pos0 = cache["len"]
+            dlen0 = dcache["len"]
+            logits, new = G.paged_verify_block(
+                params, block, cache, cfg, page_tables=tables
+            )
+            greedy = jnp.argmax(logits, -1).astype(jnp.int32)  # [B, k+1]
+            # greedy accept: the longest draft prefix matching the
+            # target's own picks; everything after position `a` is
+            # rejected and its KV rewound past by the new lengths
+            match = (block[:, 1:] == greedy[:, :k]).astype(jnp.int32)
+            a = jnp.sum(jnp.cumprod(match, axis=1), axis=1)  # [B] in [0, k]
+            new_len = jnp.where(active, pos0 + a + 1, pos0)
+            new = {**new, "len": new_len}
+            dcache = {**dcache, "len": jnp.where(active, pos0 + a + 1, dlen0)}
+            return greedy, a, new, dcache
+
+        self._prefill = jax.jit(prefill_fn, donate_argnums=(3, 4))
+        self._extend = jax.jit(extend_fn, donate_argnums=(3, 4))
+        self._decode = jax.jit(decode_fn, donate_argnums=(3, 4))
+        self._draft = jax.jit(draft_fn, donate_argnums=(2,))
+        self._verify = jax.jit(verify_fn, donate_argnums=(2, 3))
 
     def warmup(self) -> None:
-        """Compile all three paged programs off the clock, then flush the
-        synthetic request's footprint: radix adoptions, telemetry, and
-        the preemption counter all reset to a cold start."""
+        """Compile every paged program off the clock, then flush the
+        synthetic requests' footprint: radix adoptions, telemetry, and
+        the preemption counter all reset to a cold start.
+
+        A speculative engine needs TWO synthetic passes: the parent's
+        2-token request always falls below the speculation threshold
+        (one remaining token never drafts), compiling prefill/extend and
+        the plain decode program; a second request with ``spec_k + 2``
+        token budget then forces one draft/verify round. Without both, a
+        mid-run first trace of whichever path warmup skipped would break
+        the zero-retrace gate."""
         super().warmup()
+        if self.draft_params is not None:
+            plen = self.chunk + 1
+            if max(2 * self.chunk, plen + self.spec_k + 2) > self.max_len:
+                plen = min(self.chunk, self.max_len - (self.spec_k + 2))
+            if plen >= 1:
+                self._warming = True
+                try:
+                    self.run([Request(
+                        rid=-1, prompt=tuple(range(1, plen + 1)),
+                        max_new=self.spec_k + 2, arrival=0.0,
+                    )])
+                finally:
+                    self._warming = False
+            self.ticks = 0
+            self.profiler.reset()
+            self._spec_draft_steps = 0
+            self._spec_rollback_pages = 0
+            self._spec_pub = {"draft_steps": 0, "rollback": 0}
+            self._spec_accept_hist = {}
+            self._spec_step_hist = {}
+            self._spec_tiers = {}
+            self._spec_lookahead_high = 0
         if self.radix is not None:
             self.radix.clear()
             self.radix.reset_stats()
@@ -883,6 +1119,81 @@ class PagedSlotEngine(SlotEngine):
             "Requests preempted by page eviction since engine start",
             **labels,
         )
+        self._publish_spec(labels)
+
+    def _publish_spec(self, labels: dict) -> None:
+        """Batch-flush the speculative-decoding families (never per
+        step): counter deltas since the last flush plus the accumulated
+        acceptance histograms, wrapped in short ``serve.draft`` /
+        ``serve.verify`` spans so the buckets carry trace-id exemplars
+        (the ``serve.step_flush`` pattern)."""
+        if self.draft_params is None or self._warming:
+            # warmup's synthetic draft round must never reach /metrics
+            # (counters cannot be un-published; same rule as the step
+            # profiler's suppressed flush)
+            return
+        REGISTRY.gauge_set(
+            ENGINE_SPEC_ENABLED, 1.0,
+            "1 when this engine decodes speculatively (draft model loaded)",
+            **labels,
+        )
+        REGISTRY.gauge_set(
+            ENGINE_SPEC_K, float(self.spec_k),
+            "Draft proposal length per speculative round", **labels,
+        )
+        delta = self._spec_draft_steps - self._spec_pub["draft_steps"]
+        if delta:
+            REGISTRY.counter_inc(
+                ENGINE_SPEC_DRAFT_STEPS_TOTAL,
+                "Draft-model lookahead dispatches (one per speculative "
+                "round)", value=float(delta), **labels,
+            )
+            self._spec_pub["draft_steps"] = self._spec_draft_steps
+        delta = self._spec_rollback_pages - self._spec_pub["rollback"]
+        if delta:
+            REGISTRY.counter_inc(
+                ENGINE_SPEC_ROLLBACK_PAGES_TOTAL,
+                "KV pages released by rejected-draft rollback (both "
+                "pools' entries freed by refcount)",
+                value=float(delta), **labels,
+            )
+            self._spec_pub["rollback"] = self._spec_rollback_pages
+        accept, self._spec_accept_hist = self._spec_accept_hist, {}
+        if accept:
+            with TRACER.span(
+                "serve.draft",
+                attributes={
+                    "rounds": sum(accept.values()), "k": self.spec_k,
+                },
+            ):
+                for val, n in sorted(accept.items()):
+                    for _ in range(n):
+                        REGISTRY.observe(
+                            ENGINE_SPEC_ACCEPTANCE_LEN, float(val),
+                            "Accepted draft tokens per row per "
+                            "speculative round (0..k; the emitted "
+                            "correction token is not counted)",
+                            buckets=(0.0, 1.0, 2.0, 3.0, 4.0, 6.0, 8.0,
+                                     16.0),
+                            **labels,
+                        )
+        steps, self._spec_step_hist = self._spec_step_hist, {}
+        if steps:
+            with TRACER.span(
+                "serve.verify",
+                attributes={"steps": sum(steps.values())},
+            ):
+                for val, n in sorted(steps.items()):
+                    for _ in range(n):
+                        REGISTRY.observe(
+                            ENGINE_SPEC_ACCEPTED_TOKENS_PER_STEP,
+                            float(val),
+                            "Tokens emitted per verify dispatch, summed "
+                            "over the round's speculating rows",
+                            buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0,
+                                     64.0, 128.0),
+                            **labels,
+                        )
 
     def cache_stats(self) -> dict:
         """The engine-cache telemetry row (``ServeStats.engine_cache``)."""
@@ -903,6 +1214,24 @@ class PagedSlotEngine(SlotEngine):
             )
         if self.governor is not None:
             out["governor"] = self.governor.stats()
+        if self.draft_params is not None:
+            tiers = {
+                t: dict(row) for t, row in sorted(self._spec_tiers.items())
+            }
+            out["speculative"] = {
+                "enabled": True,
+                "k": self.spec_k,
+                "draft_steps": self._spec_draft_steps,
+                "proposed": sum(r["proposed"] for r in tiers.values()),
+                "accepted": sum(r["accepted"] for r in tiers.values()),
+                "rollback_pages": self._spec_rollback_pages,
+                # Draft-pool occupancy IS KV occupancy — one table, one
+                # refcount, two parallel pools — reported beside it so
+                # dashboards can see the draft rode along at page parity.
+                "draft_pool_used_pages": self.allocator.used_pages,
+                "lookahead_high_water_pages": self._spec_lookahead_high,
+                "tiers": tiers,
+            }
         return out
 
     # --- drain/restore: the defrag move protocol's engine hand-off --------
@@ -1342,7 +1671,12 @@ class PagedSlotEngine(SlotEngine):
             # mix in generated content and are simply freed). The tree
             # takes its own reference, so releasing the engine's below
             # recycles only the unshared tail.
-            if self.radix is not None and s.req.rid >= 0:
+            # draft_stale rows never adopt: their pages' draft-pool
+            # entries were never prefilled (handoff imports carry target
+            # KV only), and a future prefix match would speculate over
+            # garbage draft state — silently wrong proposals cost
+            # acceptance, and the cache poisoning would outlive the row
+            if self.radix is not None and s.req.rid >= 0 and not s.draft_stale:
                 full = len(s.req.prompt) // ps
                 if full:
                     self.radix.insert(
@@ -1468,6 +1802,17 @@ class PagedSlotEngine(SlotEngine):
                     self.cache["len"] = self.cache["len"].at[idx].set(
                         int(seed["pos"])
                     )
+                    if self.draft_params is not None:
+                        # imported pages carry TARGET KV only: park the
+                        # row on the plain decode path for its lifetime
+                        # (a preempted import re-prefills BOTH pools on
+                        # re-admission and speculates again)
+                        s.draft_stale = True
+                        self.draft_cache["len"] = (
+                            self.draft_cache["len"].at[idx].set(
+                                int(seed["pos"])
+                            )
+                        )
                     continue
                 eff = req.prompt + tuple(res.tokens)
                 matched, mpages = 0, []
@@ -1555,17 +1900,42 @@ class PagedSlotEngine(SlotEngine):
                     buf = np.zeros((self.chunk,), np.int32)
                     buf[:n_real] = real
                     table = jnp.asarray(s.table)
+                    # spec mode runs the draft model over the same chunk
+                    # in the SAME dispatch (combined programs), so the
+                    # draft pool tracks the target pool in lockstep —
+                    # still one model dispatch, one tick
                     if s.done == 0:
-                        tok, self.cache = self._prefill(
-                            self.params, jnp.asarray(buf), self.cache,
-                            np.int32(idx), table, np.int32(n_real),
-                        )
+                        if self.draft_params is not None:
+                            tok, self.cache, self.draft_cache = (
+                                self._prefill(
+                                    self.params, self.draft_params,
+                                    jnp.asarray(buf), self.cache,
+                                    self.draft_cache, np.int32(idx),
+                                    table, np.int32(n_real),
+                                )
+                            )
+                        else:
+                            tok, self.cache = self._prefill(
+                                self.params, jnp.asarray(buf), self.cache,
+                                np.int32(idx), table, np.int32(n_real),
+                            )
                     else:
-                        tok, self.cache = self._extend(
-                            self.params, jnp.asarray(buf), self.cache,
-                            np.int32(idx), table, np.int32(s.done),
-                            np.int32(n_real),
-                        )
+                        if self.draft_params is not None:
+                            tok, self.cache, self.draft_cache = (
+                                self._extend(
+                                    self.params, self.draft_params,
+                                    jnp.asarray(buf), self.cache,
+                                    self.draft_cache, np.int32(idx),
+                                    table, np.int32(s.done),
+                                    np.int32(n_real),
+                                )
+                            )
+                        else:
+                            tok, self.cache = self._extend(
+                                self.params, jnp.asarray(buf), self.cache,
+                                np.int32(idx), table, np.int32(s.done),
+                                np.int32(n_real),
+                            )
                     self.ticks += 1
                     dispatched = True
                     s.done += n_real
@@ -1594,8 +1964,155 @@ class PagedSlotEngine(SlotEngine):
                             s.state = "decode"
                             s.last = t
 
-            # --- pool-wide decode over page-backed rows
-            dec = [idx for idx, s in enumerate(slots) if s.state == "decode"]
+            # --- speculative rounds: one draft dispatch proposes k
+            # lookahead tokens for every eligible decoding row, one
+            # verify dispatch scores the whole block — up to k+1 tokens
+            # per row for 2 dispatches (2 ticks). Eligibility is
+            # per-row data, never a shape: a row that is ineligible (or
+            # page-starved for lookahead) simply plain-decodes below.
+            spec_set: set[int] = set()
+            if (
+                self.draft_params is not None
+                and not self._spec_suspended
+                # governor engaged: shed DRAFT dispatches first — the
+                # lookahead is optional work; the target step below is
+                # not. Tokens stay bit-identical either way. Warmup
+                # bypasses the shed: draft/verify must compile even on
+                # an engine born throttled, or their first trace lands
+                # mid-run the moment the governor disengages.
+                and (
+                    self._warming
+                    or self.governor is None
+                    or not self.governor.engaged
+                )
+            ):
+                k = self.spec_k
+                row_cap = min(self.row_pages * ps, self.max_len)
+                lookahead = 0
+                for idx, s in enumerate(slots):
+                    if s.state != "decode" or s.draft_stale:
+                        continue
+                    # a round can emit at most k+1 tokens but costs 2
+                    # dispatches: with <2 tokens of budget left the
+                    # plain path is strictly cheaper
+                    if s.req.max_new - len(s.result.tokens) < 2:
+                        continue
+                    # verify writes positions pos..pos+k: the whole
+                    # block must fit the row (RoPE bound included)
+                    if s.pos + k + 1 > row_cap:
+                        continue
+                    need = pages_for(s.pos + k + 1, ps) - len(s.pages)
+                    if need > 0:
+                        # PLAIN alloc, no escalation: drafts sit below
+                        # adapters and KV in the eviction ladder — a
+                        # lookahead never evicts radix pages or preempts
+                        # a row. Starved rows fall back to plain decode.
+                        got = self.allocator.alloc(need)
+                        if got is None:
+                            continue
+                        self._grow(s, got)
+                        lookahead += need
+                    spec_set.add(idx)
+                self._spec_lookahead_high = max(
+                    self._spec_lookahead_high, lookahead
+                )
+            if spec_set:
+                spec_rows = sorted(spec_set)
+                toks = np.zeros((self.n_slots,), np.int32)
+                active = np.zeros((self.n_slots,), bool)
+                tables = np.full(
+                    (self.n_slots, self.row_pages), SCRATCH, np.int32
+                )
+                for idx in spec_rows:
+                    tables[idx] = slots[idx].table
+                    toks[idx] = slots[idx].last
+                    active[idx] = True
+                if self.governor is not None:
+                    self.governor.before_step()
+                _step_t0 = time.perf_counter()
+                drafts, self.draft_cache = self._draft(
+                    self.draft_params, jnp.asarray(toks), self.draft_cache,
+                    jnp.asarray(tables), jnp.asarray(active),
+                )
+                self.ticks += 1
+                self._spec_draft_steps += 1
+                if self.governor is not None:
+                    self.governor.before_step()
+                block = jnp.concatenate(
+                    [jnp.asarray(toks)[:, None], drafts], axis=1
+                )
+                greedy, acc, self.cache, self.draft_cache = self._verify(
+                    self.params, block, self.cache, self.draft_cache,
+                    jnp.asarray(tables), jnp.asarray(active),
+                )
+                self.ticks += 1
+                dispatched = True
+                drafts_np = np.asarray(drafts)
+                greedy_np = np.asarray(greedy)
+                acc_np = np.asarray(acc)
+                emitted_total = 0
+                for idx in spec_rows:
+                    s = slots[idx]
+                    a_i = int(acc_np[idx])
+                    self._spec_accept_hist[a_i] = (
+                        self._spec_accept_hist.get(a_i, 0) + 1
+                    )
+                    trow = self._spec_tiers.setdefault(
+                        s.req.tier, {"proposed": 0, "accepted": 0}
+                    )
+                    trow["proposed"] += k
+                    trow["accepted"] += a_i
+                    retired = False
+                    # emit accepted drafts then the correction token —
+                    # exactly the sequential greedy stream (the verify
+                    # argmax at position pos+j IS what a plain decode
+                    # step at pos+j would have sampled)
+                    for j in range(a_i + 1):
+                        t = (
+                            int(drafts_np[idx, j]) if j < a_i
+                            else int(greedy_np[idx, a_i])
+                        )
+                        s.pos += 1
+                        s.result.tokens.append(t)
+                        s.last = t
+                        emitted_total += 1
+                        if (
+                            self.eos_id is not None and t == self.eos_id
+                        ) or len(s.result.tokens) >= s.req.max_new:
+                            retired = True
+                            break
+                    if retired:
+                        retire(idx)
+                    else:
+                        # rollback: rejected tokens' KV pages release by
+                        # refcount. Tail pages past pages_for(pos) are
+                        # always this row's fresh lookahead (shared
+                        # pages are a prefix <= done <= pos), so the
+                        # release never touches radix-shared state;
+                        # stale KV inside kept pages beyond pos is
+                        # invisible (the decode visibility mask stops at
+                        # each row's len).
+                        keep = pages_for(s.pos, ps)
+                        tail = s.pages[keep:]
+                        if tail:
+                            self.allocator.release(tail)
+                            del s.pages[keep:]
+                            s.table[keep:] = SCRATCH
+                            self._spec_rollback_pages += len(tail)
+                self._spec_step_hist[emitted_total] = (
+                    self._spec_step_hist.get(emitted_total, 0) + 1
+                )
+                self.profiler.record(
+                    time.perf_counter() - _step_t0,
+                    tokens=emitted_total / len(spec_rows),
+                )
+
+            # --- pool-wide decode over page-backed rows (spec-round
+            # rows already advanced this iteration and sit the step out)
+            dec = [
+                idx for idx, s in enumerate(slots)
+                if s.state == "decode" and idx not in spec_set
+            ]
             for idx in dec:
                 s = slots[idx]
                 # a try_pages below may preempt a best-effort row LATER
@@ -1633,10 +2150,21 @@ class PagedSlotEngine(SlotEngine):
                     # dispatch, never a skip — tokens stay bit-identical
                     self.governor.before_step()
                 _step_t0 = time.perf_counter()
-                nxt, self.cache = self._decode(
-                    self.params, jnp.asarray(toks), self.cache,
-                    jnp.asarray(tables), jnp.asarray(active),
-                )
+                if self.draft_params is not None:
+                    # combined program: the draft model decodes the same
+                    # token in the same dispatch so its pool never falls
+                    # out of lockstep (the target subgraph and its
+                    # argmax are unchanged — bit-identity holds)
+                    nxt, self.cache, self.draft_cache = self._decode(
+                        self.params, self.draft_params, jnp.asarray(toks),
+                        self.cache, self.draft_cache, jnp.asarray(tables),
+                        jnp.asarray(active),
+                    )
+                else:
+                    nxt, self.cache = self._decode(
+                        self.params, jnp.asarray(toks), self.cache,
+                        jnp.asarray(tables), jnp.asarray(active),
+                    )
                 self.ticks += 1
                 dispatched = True
                 nxt = np.asarray(nxt)
@@ -2032,6 +2560,8 @@ def paged_plan_from_pod_env(
     headroom: float = 0.90,
     unit: MemoryUnit = MemoryUnit.GiB,
     slots: int | None = None,
+    draft_cfg: TransformerConfig | None = None,
+    draft_weight_bytes: int = 0,
 ) -> PagedPlan:
     """The paged mode of :func:`slots_from_pod_env`: size a
     :class:`PagedSlotEngine` pool (dispatch rows + KV pages) for THIS
@@ -2043,7 +2573,10 @@ def paged_plan_from_pod_env(
     PER-CHIP share with page bytes sharded on the kv-heads axis, exactly
     as :func:`slots_for_gang`. Raises when the slice cannot cover even
     one ``max_len`` row of pages — the paged engine's progress guarantee
-    needs at least that many."""
+    needs at least that many. ``draft_cfg``/``draft_weight_bytes``
+    (speculative decoding) charge the draft model's weights and its
+    per-page KV slab against the SAME slice budget — a spec engine asks
+    for nothing beyond its ``aliyun.com/tpu-mem`` request."""
     pod = env if env is not None else PodTpuEnv.from_env()
     if pod.is_gang:
         per_chip_bytes = pod.gang_container_per_chip_bytes(unit)
@@ -2052,6 +2585,7 @@ def paged_plan_from_pod_env(
             prefill_chunk=prefill_chunk, weight_bytes=weight_bytes,
             kv_dtype=kv_dtype, headroom=headroom, slots=slots,
             n_chips=len(pod.gang_chips),
+            draft_cfg=draft_cfg, draft_weight_bytes=draft_weight_bytes,
         )
         slice_desc = (
             f"gang slice of {per_chip_bytes / unit.num_bytes:g} "
@@ -2062,6 +2596,7 @@ def paged_plan_from_pod_env(
             pod.mem_bytes(unit), cfg, max_len, page_size=page_size,
             prefill_chunk=prefill_chunk, weight_bytes=weight_bytes,
             kv_dtype=kv_dtype, headroom=headroom, slots=slots,
+            draft_cfg=draft_cfg, draft_weight_bytes=draft_weight_bytes,
         )
         slice_desc = f"slice of {pod.mem_units_container} {unit.value}"
     if plan.total_pages < pages_for(max_len, page_size):

@@ -192,7 +192,14 @@ class PagedPlan:
     fields are the exact accounting the budget test pins: weights +
     ``kv_bytes`` (pages incl. the scratch page) + ``table_bytes`` (int32
     page tables + per-row len) + ``freelist_bytes`` never exceed the
-    slice at the chosen headroom."""
+    slice at the chosen headroom.
+
+    A speculative-decoding engine carries a second, draft-model KV pool
+    indexed by the SAME page ids (``serving/engine.py``): every page the
+    allocator hands out then costs ``page_bytes + draft_page_bytes``,
+    and ``draft_bytes`` charges the whole draft pool (incl. its scratch
+    page) against the same slice budget. Both stay 0 for plans sized
+    without a draft."""
 
     slots: int
     total_pages: int
@@ -201,6 +208,8 @@ class PagedPlan:
     kv_bytes: int
     table_bytes: int
     freelist_bytes: int
+    draft_page_bytes: int = 0
+    draft_bytes: int = 0
 
     @property
     def max_pages_per_row(self) -> int:
@@ -212,7 +221,10 @@ class PagedPlan:
     @property
     def pool_bytes(self) -> int:
         """Everything the paged pool itself pins against the slice."""
-        return self.kv_bytes + self.table_bytes + self.freelist_bytes
+        return (
+            self.kv_bytes + self.table_bytes + self.freelist_bytes
+            + self.draft_bytes
+        )
 
 
 def pages_for(length: int, page_size: int) -> int:
@@ -241,6 +253,8 @@ def paged_plan_for_slice(
     headroom: float = 0.90,
     slots: int | None = None,
     n_chips: int = 1,
+    draft_cfg=None,
+    draft_weight_bytes: int = 0,
 ) -> PagedPlan:
     """Size a paged pool for a ``slice_bytes`` HBM slice.
 
@@ -254,6 +268,14 @@ def paged_plan_for_slice(
     least one page. ``n_chips > 1`` sizes over a tensor-parallel gang's
     PER-CHIP share: page bytes and weights divide by the gang size when
     the kv-heads axis shards (mirror of :func:`~.engine.slots_for_gang`).
+
+    ``draft_cfg`` sizes a speculative-decoding draft pool alongside: the
+    draft model's weights (``draft_weight_bytes``) come off the top with
+    the target's, and every page additionally charges the draft model's
+    KV bytes for the same ``page_size`` positions — the two pools share
+    one page-id space, so a page either exists in both or neither. tp>1
+    shards draft page bytes on the kv-heads axis exactly like the main
+    pool (only when ``draft_cfg.kv_heads`` divides evenly).
 
     ``total_pages == 0`` means the slice cannot hold even one page —
     callers must reject, not round up.
@@ -280,13 +302,24 @@ def paged_plan_for_slice(
         page_b = -(-page_b // n_chips)
         row_b = -(-row_b // n_chips)
         weight_bytes = -(-weight_bytes // n_chips)
+    dpage_b = 0
+    if draft_cfg is not None:
+        if draft_weight_bytes < 0:
+            raise ValueError(
+                f"draft_weight_bytes must be >= 0, got {draft_weight_bytes}"
+            )
+        dpage_b = kv_slot_bytes(draft_cfg, page_size, kv_dtype)
+        if n_chips > 1 and draft_cfg.kv_heads % n_chips == 0:
+            dpage_b = -(-dpage_b // n_chips)
+            draft_weight_bytes = -(-draft_weight_bytes // n_chips)
+        weight_bytes += draft_weight_bytes
     # Per-row page-table entries: row_span_for is the exact width
     # PagedSlotEngine allocates, so table_bytes is exact.
     row_span = row_span_for(max_len, prefill_chunk)
     max_pages = pages_for(row_span, page_size)
 
     def zero() -> PagedPlan:
-        return PagedPlan(0, 0, page_size, page_b, 0, 0, 0)
+        return PagedPlan(0, 0, page_size, page_b, 0, 0, 0, dpage_b, 0)
 
     usable = int(slice_bytes * headroom) - weight_bytes
     if usable <= 0:
@@ -294,12 +327,13 @@ def paged_plan_for_slice(
 
     def pages_at(n_slots: int) -> int:
         table = n_slots * (max_pages * 4 + 4)
-        # scratch page off the top, then each page costs its KV bytes
-        # plus its free-list/refcount bookkeeping share
-        left = usable - table - page_b
+        # scratch page off the top (target + draft), then each page costs
+        # its KV bytes in BOTH pools plus its free-list/refcount
+        # bookkeeping share
+        left = usable - table - (page_b + dpage_b)
         if left <= 0:
             return 0
-        return left // (page_b + FREELIST_BYTES_PER_PAGE)
+        return left // (page_b + dpage_b + FREELIST_BYTES_PER_PAGE)
 
     if slots is None:
         contiguous = max(usable // row_b, 1)
@@ -322,4 +356,6 @@ def paged_plan_for_slice(
         kv_bytes=(int(pages) + 1) * page_b,
         table_bytes=int(slots) * (max_pages * 4 + 4),
         freelist_bytes=int(pages) * FREELIST_BYTES_PER_PAGE,
+        draft_page_bytes=dpage_b,
+        draft_bytes=(int(pages) + 1) * dpage_b if dpage_b else 0,
     )

@@ -78,15 +78,23 @@ class StepProfiler:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
         self._lock = make_lock("serving.profiler")
         self._ring: list[float] = [0.0] * capacity
+        # Tokens emitted by each recorded step: 1.0 for a plain decode
+        # dispatch, the batch-mean accepted length for a speculative
+        # verify round — so interference verdicts and SLO budgets can
+        # normalize step time by the work a step actually retired.
+        self._tokens: list[float] = [1.0] * capacity
         self._cap = capacity
         self._count = 0  # total steps ever recorded
         self._flushed = 0  # steps already exported to the histogram
 
-    def record(self, seconds: float) -> None:
-        """One decode step's wall time. O(1): a ring write and a counter
-        bump under the near-leaf lock — no allocation."""
+    def record(self, seconds: float, tokens: float = 1.0) -> None:
+        """One decode step's wall time (and the tokens it emitted per
+        slot — >1 when a speculative verify accepted a run). O(1): ring
+        writes and a counter bump under the near-leaf lock — no
+        allocation."""
         with self._lock:
             self._ring[self._count % self._cap] = seconds
+            self._tokens[self._count % self._cap] = tokens
             self._count += 1
 
     @property
@@ -100,6 +108,16 @@ class StepProfiler:
         with self._lock:
             n = min(self._count, self._cap)
             return self._ring[:n]
+
+    def tokens_per_step(self) -> float:
+        """Rolling mean tokens-per-slot-per-step over the window: 1.0
+        for a plain engine, the mean accepted length (>= 1) when
+        speculative verify rounds dominate; nan with no samples."""
+        with self._lock:
+            n = min(self._count, self._cap)
+            if not n:
+                return float("nan")
+            return sum(self._tokens[:n]) / n
 
     def quantile(self, q: float) -> float:
         """Rolling quantile over the window; nan with no samples (same

@@ -74,6 +74,14 @@ ENGINE_PREEMPTIONS_TOTAL = "tpushare_engine_preemptions_total"
 ENGINE_PREFIX_CACHED_PAGES = "tpushare_engine_prefix_cached_pages"
 ENGINE_PREFIX_HIT_RATIO = "tpushare_engine_prefix_hit_ratio"
 ENGINE_PREFIX_HIT_TOKENS = "tpushare_engine_prefix_hit_tokens"
+ENGINE_SPEC_ACCEPTANCE_LEN = "tpushare_engine_spec_acceptance_len"
+ENGINE_SPEC_ACCEPTED_TOKENS_PER_STEP = (
+    "tpushare_engine_spec_accepted_tokens_per_step"
+)
+ENGINE_SPEC_DRAFT_STEPS_TOTAL = "tpushare_engine_spec_draft_steps_total"
+ENGINE_SPEC_ENABLED = "tpushare_engine_spec_enabled"
+ENGINE_SPEC_K = "tpushare_engine_spec_k"
+ENGINE_SPEC_ROLLBACK_PAGES_TOTAL = "tpushare_engine_spec_rollback_pages_total"
 ENGINE_STEP_P50_SECONDS = "tpushare_engine_step_p50_seconds"
 ENGINE_STEP_P99_SECONDS = "tpushare_engine_step_p99_seconds"
 ENGINE_STEP_SECONDS = "tpushare_engine_step_seconds"
@@ -146,6 +154,12 @@ CATALOG: dict[str, MetricSpec] = dict((
     _m(ENGINE_PREFIX_CACHED_PAGES, GAUGE, "pod"),
     _m(ENGINE_PREFIX_HIT_RATIO, GAUGE, "pod"),
     _m(ENGINE_PREFIX_HIT_TOKENS, HISTOGRAM, "pod"),
+    _m(ENGINE_SPEC_ACCEPTANCE_LEN, HISTOGRAM, "pod"),
+    _m(ENGINE_SPEC_ACCEPTED_TOKENS_PER_STEP, HISTOGRAM, "pod"),
+    _m(ENGINE_SPEC_DRAFT_STEPS_TOTAL, COUNTER, "pod"),
+    _m(ENGINE_SPEC_ENABLED, GAUGE, "pod"),
+    _m(ENGINE_SPEC_K, GAUGE, "pod"),
+    _m(ENGINE_SPEC_ROLLBACK_PAGES_TOTAL, COUNTER, "pod"),
     _m(ENGINE_STEP_P50_SECONDS, GAUGE, "pod"),
     _m(ENGINE_STEP_P99_SECONDS, GAUGE, "pod"),
     _m(ENGINE_STEP_SECONDS, HISTOGRAM, "pod"),

@@ -313,6 +313,50 @@ def paged_decode_step(
     return logits[:, 0], out
 
 
+def paged_verify_block(
+    params: Any,
+    block: jax.Array,
+    cache: KVCache,
+    cfg: TransformerConfig,
+    *,
+    page_tables: jax.Array,
+) -> tuple[jax.Array, KVCache]:
+    """Pool-wide T-token verify step through per-row page tables: the
+    target-model half of the paged engine's speculative decode. ``block``
+    ``[B, T]`` is each row's last verified token followed by its draft
+    proposal; the row's logical view is gathered from its pages, the
+    shared :func:`decode_block` scores every block position in ONE
+    forward (logits at position ``t`` are bitwise what ``t`` sequential
+    :func:`paged_decode_step` calls would produce — the greedy-accept
+    comparison that makes speculative decoding lossless), and ALL ``T``
+    new KV entries scatter back through the tables. The engine rewinds
+    ``len`` past rejected positions afterwards — their stale KV sits
+    beyond every later read's visibility mask and is overwritten in
+    place when the row advances. Rows whose table points at the scratch
+    page write garbage there, never read. Returns logits ``[B, T,
+    vocab]`` f32 and the cache with ``len`` advanced by ``T`` (the
+    engine freezes idle rows' entries, as in :func:`paged_decode_step`).
+    """
+    pos0 = cache["len"]
+    B, T = block.shape
+    ps = cache["k"].shape[2]
+    view = _gather_paged(cache, page_tables)
+    view["len"] = pos0
+    logits, new_view = decode_block(params, block, view, cfg)
+    logical = pos0[:, None] + jnp.arange(T)[None, :]  # [B, T]
+    pids = jnp.take_along_axis(page_tables, logical // ps, axis=1)
+    offs = logical % ps
+    out = dict(cache)
+    for key, val in new_view.items():
+        if key == "len":
+            continue
+        idx = logical.reshape((1, B, T) + (1,) * (val.ndim - 3))
+        tok_kv = jnp.take_along_axis(val, idx, axis=2)  # [L, B, T, ...]
+        out[key] = cache[key].at[:, pids, offs].set(tok_kv)
+    out["len"] = pos0 + T
+    return logits, out
+
+
 def _cache_is_q8(cache: KVCache) -> bool:
     return "k_scale" in cache
 

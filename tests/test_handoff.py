@@ -635,3 +635,87 @@ def test_disagg_prefill_tier_outage_is_bit_identical(setup):
     got = {rid: e["tokens"] for rid, e in out["results"].items()}
     assert got == _unified_tokens(setup)
     assert {e["path"] for e in out["results"].values()} == {"prefill_down"}
+
+
+@engine_tests
+def test_disagg_spec_decode_tier_bit_identical(setup):
+    """Speculative decode tier behind the KV handoff: imported rows
+    carry TARGET KV only, so the engine pins them to the plain decode
+    path (draft_stale) and keeps their pages out of the radix tree —
+    rows that degrade to local re-prefill still speculate with valid
+    draft KV. Tokens stay bit-identical to the unified engine, with
+    zero retraces on either tier and zero draft-page leaks."""
+    from gpushare_device_plugin_tpu.serving import (
+        DisaggServer,
+        PagedSlotEngine,
+    )
+
+    cfg, params, reqs = setup
+    prefill = PagedSlotEngine(
+        params, cfg, slots=2, max_len=32, total_pages=16, page_size=4,
+        prefill_chunk=4, eos_id=EOS,
+    )
+    decode = PagedSlotEngine(
+        params, cfg, slots=4, max_len=32, total_pages=16, page_size=4,
+        prefill_chunk=4, eos_id=EOS,
+        draft_params=params, draft_cfg=cfg, spec_k=3,
+    )
+    ds = DisaggServer(prefill, decode, node=NODE)
+    ds.warmup()
+    warm = (dict(ds.prefill.trace_counts), dict(ds.decode.trace_counts))
+    out = ds.serve(reqs)
+    assert ds.outcomes.get("delivered", 0) >= 1
+    _assert_parity_and_no_retrace(
+        ds, out, setup, paths={"prefill", "handoff", "reprefill"},
+    )
+    assert (
+        dict(ds.prefill.trace_counts), dict(ds.decode.trace_counts)
+    ) == warm, "spec decode tier retraced a compiled program"
+
+
+@engine_tests
+def test_spec_drain_restores_across_engine_kinds(setup):
+    """The move-protocol case for speculation: a drain landing mid-run
+    on a speculating engine carries ONLY verified tokens (every token in
+    the snapshot is a prefix of the reference stream), the source frees
+    every draft/lookahead page, and the snapshot restores bit-identically
+    onto a NON-speculative engine — and a plain engine's snapshot onto a
+    speculative one — because both ends emit the same greedy stream."""
+    from gpushare_device_plugin_tpu.serving import PagedSlotEngine
+
+    cfg, params, reqs = setup
+
+    def mk(spec):
+        extra = (
+            dict(draft_params=params, draft_cfg=cfg, spec_k=4)
+            if spec else {}
+        )
+        return PagedSlotEngine(
+            params, cfg, slots=2, max_len=32, total_pages=24, page_size=4,
+            prefill_chunk=4, eos_id=EOS, **extra,
+        )
+
+    ref = {r.rid: r.tokens for r in mk(False).run(reqs).results}
+    for src_spec in (True, False):
+        src = mk(src_spec)
+        if src_spec:
+            src.warmup()
+        part = src.run(reqs, drain_at_tick=6)
+        snap = src.drain_snapshot()
+        assert snap is not None and snap["requests"]
+        for row in snap["requests"]:
+            toks = row["tokens"]
+            assert toks == ref[row["rid"]][: len(toks)], (
+                "unverified draft token leaked into the snapshot"
+            )
+        cached = src.radix.cached_pages if src.radix is not None else 0
+        assert src.allocator.used_pages == cached, "draft pages leaked"
+        dst = mk(not src_spec)
+        if not src_spec:
+            dst.warmup()
+        rest = dst.restore_snapshot(snap)
+        out = {r.rid: r.tokens for r in part.results}
+        out.update({r.rid: r.tokens for r in rest.results})
+        assert out == ref
+        cached = dst.radix.cached_pages if dst.radix is not None else 0
+        assert dst.allocator.used_pages == cached

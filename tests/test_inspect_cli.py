@@ -311,6 +311,123 @@ def test_cli_json_serving_cache(api, capsys, monkeypatch):
     assert "serving_cache" not in pods["batch-1"]
 
 
+def _spec_exposition(pod_label: str) -> str:
+    """An exposition from a SPECULATIVE serving engine: the cache
+    families plus the tpushare_engine_spec_* group, rendered by the real
+    registry exactly as the engine's publish_metrics flushes them."""
+    from gpushare_device_plugin_tpu.utils.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    labels = {"pod": pod_label}
+    reg.gauge_set("tpushare_engine_kv_pages_total", 64.0,
+                  help_text="KV pages in the slice pool", **labels)
+    reg.gauge_set("tpushare_engine_kv_pages_used", 48.0,
+                  help_text="KV pages allocated", **labels)
+    reg.gauge_set("tpushare_engine_prefix_hit_ratio", 0.37,
+                  help_text="radix prefix-cache hit ratio", **labels)
+    reg.gauge_set("tpushare_engine_preemptions", 2.0,
+                  help_text="best-effort preemptions", **labels)
+    reg.gauge_set("tpushare_engine_spec_enabled", 1.0,
+                  help_text="speculative decoding on", **labels)
+    reg.gauge_set("tpushare_engine_spec_k", 4.0,
+                  help_text="draft proposal length", **labels)
+    reg.counter_inc("tpushare_engine_spec_draft_steps_total", value=57.0,
+                    help_text="draft dispatches", **labels)
+    reg.counter_inc("tpushare_engine_spec_rollback_pages_total", value=12.0,
+                    help_text="rollback page releases", **labels)
+    for v in (1.0, 2.0, 2.0):
+        reg.observe("tpushare_engine_spec_acceptance_len", v,
+                    help_text="accepted drafts per row per round",
+                    buckets=(0.0, 1.0, 2.0, 4.0), **labels)
+    for v in (2.4, 3.0):
+        reg.observe("tpushare_engine_spec_accepted_tokens_per_step", v,
+                    help_text="tokens per verify dispatch",
+                    buckets=(1.0, 2.0, 4.0, 8.0), **labels)
+    return reg.render()
+
+
+def test_parse_engine_metrics_spec_families_fold_in():
+    rows = inspect_cli.parse_engine_metrics(_spec_exposition("ns/spec-1"))
+    row = rows["ns/spec-1"]
+    assert row["spec_enabled"] == 1.0 and row["spec_k"] == 4.0
+    assert row["spec_draft_steps_total"] == 57.0
+    assert row["spec_rollback_pages_total"] == 12.0
+    # histogram buckets are skipped; _sum/_count carry the CLI's means
+    assert row["spec_acceptance_len_count"] == 3.0
+    assert row["spec_acceptance_len_sum"] == pytest.approx(5.0)
+    assert row["spec_accepted_tokens_per_step_count"] == 2.0
+    assert row["spec_accepted_tokens_per_step_sum"] == pytest.approx(5.4)
+    assert not any(k.endswith("_bucket") for k in row)
+
+
+def test_cli_details_spec_summary_in_serving_cache_cell(
+    api, capsys, monkeypatch
+):
+    """A speculative pod's SERVING CACHE cell appends the spec summary;
+    pods without spec families keep the reference cell (pinned above in
+    test_cli_details_serving_cache_column — nothing spec-shaped leaks
+    into non-spec rows)."""
+    api.nodes["node-a"] = shared_node("node-a")
+    api.add_pod(assigned_running_pod("spec-1", 16, chip_idx=0, node="node-a"))
+    monkeypatch.setattr(inspect_cli, "_client", lambda: ApiServerClient(api.url))
+    monkeypatch.setattr(
+        inspect_cli, "fetch_observability_metrics",
+        lambda urls: inspect_cli.parse_observability_metrics(
+            _spec_exposition("default/spec-1")
+        ),
+    )
+    assert inspect_cli.main(["-d", "--metrics-url", "http://x"]) == 0
+    out = capsys.readouterr().out
+    assert "spec k=4 · acc 2.7/step · rb 12" in out
+
+
+def test_cli_json_speculative_subdoc(api, capsys, monkeypatch):
+    api.nodes["node-a"] = shared_node("node-a")
+    api.add_pod(assigned_running_pod("spec-1", 16, chip_idx=0, node="node-a"))
+    api.add_pod(assigned_running_pod("batch-1", 4, chip_idx=1, node="node-a"))
+    monkeypatch.setattr(inspect_cli, "_client", lambda: ApiServerClient(api.url))
+    monkeypatch.setattr(
+        inspect_cli, "fetch_observability_metrics",
+        lambda urls: inspect_cli.parse_observability_metrics(
+            _spec_exposition("default/spec-1")
+        ),
+    )
+    assert inspect_cli.main(["-o", "json", "--metrics-url", "http://x"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    pods = {p["name"]: p for p in doc["nodes"][0]["pods"]}
+    spec = pods["spec-1"]["speculative"]
+    assert spec == {
+        "enabled": True,
+        "k": 4,
+        "draft_steps": 57,
+        "rollback_pages": 12,
+        "acceptance_len_mean": pytest.approx(5.0 / 3, abs=1e-3),
+        "accepted_tokens_per_step_mean": 2.7,
+    }
+    assert "speculative" not in pods["batch-1"]
+
+
+def test_cli_json_no_spec_families_no_speculative_key(
+    api, capsys, monkeypatch
+):
+    """A plain serving engine's pod document gains no speculative key —
+    the no-speculation reference document is unchanged."""
+    api.nodes["node-a"] = shared_node("node-a")
+    api.add_pod(assigned_running_pod("serve-1", 16, chip_idx=0, node="node-a"))
+    monkeypatch.setattr(inspect_cli, "_client", lambda: ApiServerClient(api.url))
+    monkeypatch.setattr(
+        inspect_cli, "fetch_observability_metrics",
+        lambda urls: inspect_cli.parse_observability_metrics(
+            _engine_exposition("default/serve-1")
+        ),
+    )
+    assert inspect_cli.main(["-o", "json", "--metrics-url", "http://x"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    pod = doc["nodes"][0]["pods"][0]
+    assert "speculative" not in pod
+    assert "spec" not in inspect_cli.render_json([], None)
+
+
 def test_cli_no_metrics_url_keeps_reference_layout(api, capsys, monkeypatch):
     """Without --metrics-url the details table keeps the reference
     column set — no SERVING CACHE header appears."""

@@ -210,6 +210,25 @@ def test_profiler_rolling_quantiles_and_ring_bound():
     assert prof.count == 0 and prof.window() == []
 
 
+def test_profiler_tokens_per_step_weights_speculative_rounds():
+    """The tokens ring normalizes step time by the work a step retired:
+    1.0 for plain decode dispatches, the batch-mean accepted length for
+    a speculative verify round — and the rolling mean tracks the same
+    window (and reset) as the latency quantiles."""
+    prof = StepProfiler(capacity=4)
+    assert prof.tokens_per_step() != prof.tokens_per_step()  # nan empty
+    prof.record(0.002)  # plain decode: tokens defaults to 1.0
+    prof.record(0.003, tokens=4.0)  # verify round: k+1 accepted
+    assert prof.tokens_per_step() == pytest.approx(2.5)
+    # overflow: only the newest `capacity` samples answer, same window
+    # as the latency ring
+    for _ in range(4):
+        prof.record(0.002, tokens=3.0)
+    assert prof.tokens_per_step() == pytest.approx(3.0)
+    prof.reset()
+    assert prof.tokens_per_step() != prof.tokens_per_step()
+
+
 def test_profiler_flush_exports_histogram_and_gauges():
     reg = MetricsRegistry()
     prof = StepProfiler(capacity=64)

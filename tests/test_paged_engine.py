@@ -834,3 +834,263 @@ def test_paged_governor_bit_identity_and_drain(setup):
     assert {r.rid: r.tokens for r in stats.results} == reference
     assert sum(governed.trace_counts[k] - warm[k] for k in warm) == 0
     assert gov.engaged and gov.throttled_steps > 0
+
+
+# ---------------------------------------------------------------------------
+# speculative decoding: draft/verify rounds, rollback, drain, governor
+# ---------------------------------------------------------------------------
+
+
+def _dcfg(**kw):
+    base = dict(
+        vocab=64, d_model=16, n_layers=1, n_heads=2, n_kv_heads=1, d_ff=32,
+        max_seq=64, compute_dtype=jnp.float32,
+    )
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def spec_setup(setup):
+    cfg, params = setup
+    dcfg = _dcfg()
+    dparams = init_params(jax.random.key(7), dcfg)
+    return cfg, params, dcfg, dparams
+
+
+def _assert_no_page_leak(eng):
+    """Allocator audit: after a quiesced run the only held refs are the
+    radix tree's — every spec lookahead/rollback page came back."""
+    cached = eng.radix.cached_pages if eng.radix is not None else 0
+    assert eng.allocator.used_pages == cached, (
+        eng.allocator.used_pages, cached,
+    )
+
+
+def test_spec_bit_identical_incl_churn(spec_setup):
+    """THE speculative contract: an independent draft model (arbitrary,
+    mostly-rejected proposals) changes HOW tokens are produced, never
+    WHAT comes out — bit-identical to the plain paged engine across
+    mid-flight admissions, shared prefixes, tiers, and page churn."""
+    cfg, params, dcfg, dparams = spec_setup
+    reqs = shared_prefix_trace(
+        10, seed=13, rate=0.4, vocab=cfg.vocab, prefixes=(2, 8),
+        tail_lens=(1, 4), max_new=[3, 6, 12],
+        tiers=[(TIER_CRITICAL, 0.5, 40.0, 8.0), (TIER_BEST_EFFORT, 0.5, None, None)],
+    )
+    ref = {r.rid: r.tokens for r in _paged(params, cfg).run(reqs).results}
+    spec = _paged(params, cfg, draft_params=dparams, draft_cfg=dcfg,
+                  spec_k=3)
+    spec.warmup()
+    warm = dict(spec.trace_counts)
+    stats = spec.run(reqs)
+    assert {r.rid: r.tokens for r in stats.results} == ref
+    # zero retraces: exactly five programs, all compiled by warmup
+    assert set(warm) == {"prefill", "extend", "decode", "draft", "verify"}
+    assert dict(spec.trace_counts) == warm
+    _assert_no_page_leak(spec)
+    row = stats.engine_cache["speculative"]
+    assert row["draft_steps"] > 0 and row["proposed"] > 0
+    assert 0 <= row["accepted"] <= row["proposed"]
+
+
+def test_spec_self_draft_accepts_and_saves_ticks(spec_setup):
+    """Draft == target: every proposal verifies, so each 2-dispatch
+    round retires ~k+1 tokens and the tick count drops below the plain
+    engine's — the acceptance math's upper bound, and the accept path's
+    bit-identity proof (mid-acceptance EOS/max_new truncation
+    included)."""
+    cfg, params, _, _ = spec_setup
+    reqs = shared_prefix_trace(
+        8, seed=5, rate=0.3, vocab=cfg.vocab, prefixes=(2, 8),
+        tail_lens=(1, 4), max_new=(4, 12),
+    )
+    plain = _paged(params, cfg)
+    plain.warmup()
+    ref = plain.run(reqs)
+    spec = _paged(params, cfg, draft_params=params, draft_cfg=cfg, spec_k=4)
+    spec.warmup()
+    stats = spec.run(reqs)
+    assert {r.rid: r.tokens for r in stats.results} == {
+        r.rid: r.tokens for r in ref.results
+    }
+    assert stats.ticks < ref.ticks
+    row = stats.engine_cache["speculative"]
+    assert row["accepted"] > 0
+    assert row["accepted"] == row["proposed"]  # self-draft: all accept
+    _assert_no_page_leak(spec)
+    # tier breakdown reaches the summary rows
+    tiers = stats.summary()["tiers"]
+    assert any("spec_accepted" in t for t in tiers.values())
+
+
+def test_spec_rollback_releases_every_page(spec_setup):
+    """Rejected lookahead KV rolls back by page-refcount release: an
+    independent draft (near-zero acceptance) must rack up rollback pages
+    while the allocator audit stays clean after every run."""
+    cfg, params, dcfg, dparams = spec_setup
+    reqs = poisson_trace(
+        6, seed=9, rate=0.5, vocab=cfg.vocab, prompt_lens=(1, 8),
+        max_new=(4, 10),
+    )
+    spec = _paged(params, cfg, draft_params=dparams, draft_cfg=dcfg,
+                  spec_k=4)
+    spec.warmup()
+    stats = spec.run(reqs)
+    row = stats.engine_cache["speculative"]
+    assert row["rollback_pages"] > 0
+    assert row["lookahead_high_water_pages"] >= 1
+    _assert_no_page_leak(spec)
+    ref = {r.rid: r.tokens for r in _paged(params, cfg).run(reqs).results}
+    assert {r.rid: r.tokens for r in stats.results} == ref
+
+
+def test_spec_suspended_is_bitwise_plain(spec_setup):
+    """The escape hatch: a suspended spec engine never dispatches draft
+    or verify and emits the plain engine's exact stream."""
+    cfg, params, dcfg, dparams = spec_setup
+    reqs = poisson_trace(
+        4, seed=2, rate=0.5, vocab=cfg.vocab, prompt_lens=(2, 6),
+        max_new=(3, 8),
+    )
+    ref = {r.rid: r.tokens for r in _paged(params, cfg).run(reqs).results}
+    spec = _paged(params, cfg, draft_params=dparams, draft_cfg=dcfg)
+    spec.warmup()
+    spec._spec_suspended = True
+    warm = dict(spec.trace_counts)
+    stats = spec.run(reqs)
+    assert {r.rid: r.tokens for r in stats.results} == ref
+    assert dict(spec.trace_counts) == warm
+    assert stats.engine_cache["speculative"]["draft_steps"] == 0
+
+
+def test_spec_engine_rejects_bad_draft_config(spec_setup):
+    cfg, params, dcfg, dparams = spec_setup
+    with pytest.raises(ValueError, match="without the other"):
+        _paged(params, cfg, draft_params=dparams)
+    with pytest.raises(ValueError, match="vocab"):
+        _paged(params, cfg, draft_params=dparams,
+               draft_cfg=_dcfg(vocab=32))
+    with pytest.raises(ValueError, match="spec_k"):
+        _paged(params, cfg, draft_params=dparams, draft_cfg=dcfg, spec_k=0)
+
+
+def test_spec_drain_kill_at_every_boundary(spec_setup):
+    """Kill-at-boundary sweep with in-flight speculation: wherever the
+    drain lands, the snapshot carries ONLY verified tokens (a rejected
+    draft can never leak into a moved request), the source frees every
+    draft/lookahead page, and the restore is bit-identical — onto a
+    NON-speculative destination and, from a plain source, onto a
+    speculative one (spec <-> non-spec moves are symmetric because both
+    ends emit the same greedy stream)."""
+    cfg, params, dcfg, dparams = spec_setup
+    reqs = shared_prefix_trace(
+        6, seed=3, rate=0.4, vocab=cfg.vocab, prefixes=(1, 8),
+        tail_lens=(1, 4), max_new=[4, 9],
+    )
+    ref = {r.rid: r.tokens for r in _paged(params, cfg).run(reqs).results}
+    for tick in range(1, 14, 3):
+        src = _paged(params, cfg, draft_params=params, draft_cfg=cfg,
+                     spec_k=4)
+        src.warmup()
+        part = src.run(reqs, drain_at_tick=tick)
+        snap = src.drain_snapshot()
+        _assert_no_page_leak(src)
+        if snap is None:
+            assert {r.rid: r.tokens for r in part.results} == ref
+            continue
+        emitted = {r.rid: r.tokens for r in part.results}
+        for row in snap["requests"]:
+            # a drained row's tokens must be a prefix of the reference
+            # stream: only VERIFIED tokens travel
+            toks = row["tokens"]
+            assert toks == ref[row["rid"]][: len(toks)]
+        dst = _paged(params, cfg)  # plain destination
+        rest = dst.restore_snapshot(snap)
+        emitted.update({r.rid: r.tokens for r in rest.results})
+        assert emitted == ref, f"drain at tick {tick} diverged"
+    # and the reverse move: plain source -> speculative destination
+    src = _paged(params, cfg)
+    part = src.run(reqs, drain_at_tick=7)
+    snap = src.drain_snapshot()
+    assert snap is not None and snap["requests"]
+    dst = _paged(params, cfg, draft_params=params, draft_cfg=cfg, spec_k=4)
+    dst.warmup()
+    rest = dst.restore_snapshot(snap)
+    out = {r.rid: r.tokens for r in part.results}
+    out.update({r.rid: r.tokens for r in rest.results})
+    assert out == ref
+    assert rest.engine_cache["speculative"]["draft_steps"] > 0
+    _assert_no_page_leak(dst)
+
+
+def test_spec_governor_sheds_draft_dispatches_first(spec_setup):
+    """Fake-clock governor under page severity: the engine sheds DRAFT
+    dispatches before target steps — decode keeps flowing (throttled),
+    zero draft rounds run, and tokens stay bit-identical to plain."""
+    from gpushare_device_plugin_tpu.serving import StepGovernor
+    from gpushare_device_plugin_tpu.utils.metrics import MetricsRegistry
+
+    cfg, params, dcfg, dparams = spec_setup
+    reqs = poisson_trace(
+        6, seed=5, rate=1.0, vocab=cfg.vocab, prompt_lens=(2, 6),
+        max_new=(3, 6),
+    )
+    ref = {r.rid: r.tokens for r in _paged(params, cfg).run(reqs).results}
+    t = [0.0]
+    gov = StepGovernor(
+        lambda: "page", throttled_steps_per_s=100.0, poll_interval_steps=1,
+        registry=MetricsRegistry(), clock=lambda: t[0],
+        sleep=lambda s: t.__setitem__(0, t[0] + s),
+    )
+    spec = _paged(params, cfg, draft_params=dparams, draft_cfg=dcfg,
+                  governor=gov)
+    spec.warmup()  # compiles draft/verify even while throttled
+    assert spec.trace_counts["draft"] == 1
+    warm = dict(spec.trace_counts)
+    stats = spec.run(reqs)
+    assert {r.rid: r.tokens for r in stats.results} == ref
+    assert dict(spec.trace_counts) == warm  # zero retraces either way
+    assert stats.engine_cache["speculative"]["draft_steps"] == 0
+    assert gov.engaged and gov.throttled_steps > 0
+
+
+def test_spec_metrics_published_on_run(spec_setup):
+    """The /metrics satellite: spec gauges, delta counters, and both
+    acceptance histograms land in the registry under the pod label —
+    flushed once per run, never per step."""
+    from gpushare_device_plugin_tpu.utils import tracing
+    from gpushare_device_plugin_tpu.utils.metrics import REGISTRY
+
+    cfg, params, _, _ = spec_setup
+    tracing.TRACER.configure(sample_ratio=1.0)
+    try:
+        reqs = poisson_trace(
+            4, seed=4, rate=0.5, vocab=cfg.vocab, prompt_lens=(2, 6),
+            max_new=(4, 8),
+        )
+        eng = _paged(params, cfg, draft_params=params, draft_cfg=cfg,
+                     spec_k=4, metrics_pod="ns/spec-0")
+        eng.warmup()
+        eng.run(reqs)
+        text = REGISTRY.render()
+        assert 'tpushare_engine_spec_enabled{pod="ns/spec-0"} 1' in text
+        assert 'tpushare_engine_spec_k{pod="ns/spec-0"} 4' in text
+        assert 'tpushare_engine_spec_draft_steps_total{pod="ns/spec-0"}' in text
+        count, total = REGISTRY.histogram_stats(
+            "tpushare_engine_spec_acceptance_len"
+        )
+        assert count >= 1
+        count, total = REGISTRY.histogram_stats(
+            "tpushare_engine_spec_accepted_tokens_per_step"
+        )
+        assert count >= 1 and total >= 1
+        # the CLI parser folds every spec family into the pod's row
+        from gpushare_device_plugin_tpu.cli.inspect import parse_engine_metrics
+
+        row = parse_engine_metrics(text)["ns/spec-0"]
+        assert row["spec_enabled"] == 1.0 and row["spec_k"] == 4.0
+        assert row["spec_draft_steps_total"] >= 1
+        assert "spec_acceptance_len_sum" in row
+    finally:
+        tracing.STORE.clear()

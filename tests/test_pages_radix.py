@@ -243,6 +243,64 @@ class TestPagedPlanBudget:
                     plan.total_pages * FREELIST_BYTES_PER_PAGE
                 )
 
+    def test_exact_budget_accounting_sweep_with_draft(self):
+        """The speculative extension of the slice-safety invariant:
+        target weights + draft weights + everything BOTH pools pin
+        (each granted page costs target + draft KV bytes, both scratch
+        pages included) still never exceed the slice. A spec engine asks
+        for nothing beyond its ``aliyun.com/tpu-mem`` request."""
+        cfg = _cfg()
+        dcfg = _cfg(d_model=16, n_layers=1, n_heads=2, n_kv_heads=1, d_ff=32)
+        row_b = kv_slot_bytes(cfg, 64)
+        w = 3 * row_b
+        dw = row_b // 2
+        for budget in range(int(0.5 * row_b), 40 * row_b, row_b // 3):
+            for headroom in (1.0, 0.9):
+                plan = paged_plan_for_slice(
+                    budget, cfg, 64, page_size=8, prefill_chunk=8,
+                    weight_bytes=w, headroom=headroom,
+                    draft_cfg=dcfg, draft_weight_bytes=dw,
+                )
+                if plan.total_pages == 0:
+                    continue
+                assert plan.draft_page_bytes == kv_slot_bytes(dcfg, 8)
+                assert plan.draft_bytes == (
+                    (plan.total_pages + 1) * plan.draft_page_bytes
+                )
+                assert plan.pool_bytes == (
+                    plan.kv_bytes + plan.table_bytes + plan.freelist_bytes
+                    + plan.draft_bytes
+                )
+                assert w + dw + plan.pool_bytes <= int(budget * headroom), (
+                    budget, headroom, plan,
+                )
+                # at equal budget the draft rides by shrinking the page
+                # count, never by overflowing the slice
+                bare = paged_plan_for_slice(
+                    budget, cfg, 64, page_size=8, prefill_chunk=8,
+                    weight_bytes=w, headroom=headroom,
+                )
+                assert plan.total_pages <= bare.total_pages
+
+    def test_draft_page_bytes_shard_on_gang_kv_heads(self):
+        """tp>1: the draft pool's page bytes (and its weights) divide by
+        the gang size exactly like the main pool's when the draft's
+        kv-heads axis shards evenly."""
+        cfg = _cfg()
+        dcfg = _cfg(d_model=16, n_layers=1, n_kv_heads=2)
+        row_b = kv_slot_bytes(cfg, 64)
+        solo = paged_plan_for_slice(
+            20 * row_b, cfg, 64, page_size=8, prefill_chunk=8,
+            weight_bytes=row_b, draft_cfg=dcfg, draft_weight_bytes=0,
+        )
+        gang = paged_plan_for_slice(
+            20 * row_b, cfg, 64, page_size=8, prefill_chunk=8,
+            weight_bytes=row_b, draft_cfg=dcfg, draft_weight_bytes=0,
+            n_chips=2,
+        )
+        assert gang.draft_page_bytes == -(-solo.draft_page_bytes // 2)
+        assert gang.total_pages > solo.total_pages
+
     def test_paged_pool_admits_more_rows_than_contiguous(self):
         """The tentpole's capacity claim at the sizing layer: on the same
         byte budget the paged plan's dispatch rows are >= 2x the
