@@ -1,7 +1,7 @@
 PY ?= python
 PROTOC ?= protoc
 
-.PHONY: proto native test test-fast test-slow test-stress chaos chaos-restart chaos-move chaos-shard chaos-handoff mc mc-smoke lint lint-strict typecheck bench bench-smoke bench-serve-smoke bench-multichip-smoke bench-paged-smoke bench-defrag-smoke bench-interference-smoke bench-disagg-smoke bench-spec-smoke bench-scale bench-scale-smoke bench-wal bench-trace bench-decisions trace-smoke decisions-smoke e2e-kind
+.PHONY: proto native test test-fast test-slow test-stress chaos chaos-restart chaos-move chaos-shard chaos-handoff chaos-fleet mc mc-smoke lint lint-strict typecheck bench bench-smoke bench-serve-smoke bench-multichip-smoke bench-paged-smoke bench-defrag-smoke bench-interference-smoke bench-disagg-smoke bench-spec-smoke bench-fleet-smoke bench-scale bench-scale-smoke bench-wal bench-trace bench-decisions trace-smoke decisions-smoke e2e-kind
 
 # Regenerate protobuf message classes (gRPC bindings are hand-written in
 # gpushare_device_plugin_tpu/plugin/api/api_grpc.py; grpc_tools is not
@@ -83,6 +83,20 @@ chaos-move:
 # lock-order witness on.
 chaos-handoff:
 	TPUSHARE_LOCK_WITNESS=1 $(PY) -m pytest tests/test_handoff.py -x -q
+
+# Fleet front-door chaos (docs/robustness.md, docs/serving.md): the
+# router is SIGKILLed at every scale-down journal phase (scale.cordon/
+# drain/migrate/release), in BOTH --wal-fsync modes, plus an engine
+# dying mid-decode with its requests re-prefilled on survivors and the
+# router itself restarted mid-trace (table reseeded from engine ground
+# truth). The reconciler must converge — zero dropped requests, zero
+# double-served, no pending scale entry — and the engine-level tests
+# gate greedy tokens BIT-IDENTICAL to a unified engine through live
+# scale-down, engine death, and router restart. The protocol half runs
+# inside tier-1 ('not slow'); this target runs the whole suite alone
+# with the lock-order witness on.
+chaos-fleet:
+	TPUSHARE_LOCK_WITNESS=1 $(PY) -m pytest tests/test_fleet.py -x -q
 
 # Sharded-extender 2PC chaos (docs/robustness.md): SIGKILL (simulated
 # crash) at every "gang2pc" journal step — prepare, reserve, decide,
@@ -225,6 +239,17 @@ bench-disagg-smoke:
 
 bench-spec-smoke:
 	$(PY) bench_mfu.py --spec-smoke
+
+# Fleet-router CPU smoke: ONLY the serve_fleet section — shared-prefix
+# trace across 3 paged engines behind the prefix-affinity router vs the
+# affinity-blind spread policy, plus a journaled mid-trace scale-down.
+# Hard gates even in smoke: zero dropped (including during the live
+# scale-down), zero double-served, tokens bit-identical to one unified
+# engine, scale journal resolved, and affinity's prefix-hit ratio
+# strictly above spread's. Tier-1 runs it via
+# tests/test_bench_fleet_smoke.py. See docs/serving.md.
+bench-fleet-smoke:
+	$(PY) bench_mfu.py --fleet-smoke
 
 # Group-commit WAL A/B: the 16-way admission storm with the journal in
 # per-record-fsync ('always') then group-commit ('batch') mode. Reports

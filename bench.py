@@ -1385,6 +1385,35 @@ def spec_accept_guard(mean_len: float | None, repo: Path) -> str | None:
     )
 
 
+def fleet_goodput_guard(tokens_s: float | None, repo: Path) -> str | None:
+    """Failure message when the fleet router's goodput
+    (``fleet_goodput_tokens_per_s``, the serve_fleet section) dropped
+    >P99_GUARD_PCT below the newest committed record carrying it; None
+    when within budget or no history. Lower is worse (throughput). The
+    zero-drop/parity/exactly-once invariants hard-gate inside bench_mfu
+    itself; this guards the trend — a router change that still routes
+    correctly but serves the fleet slower is a regression."""
+    return _pct_trend_guard(
+        tokens_s, repo, field="fleet_goodput_tokens_per_s",
+        label="fleet goodput", fmt=".1f", unit=" tokens/s",
+        lower_is_worse=True,
+    )
+
+
+def fleet_prefix_guard(ratio: float | None, repo: Path) -> str | None:
+    """Same budget for the fleet-global prefix-hit ratio
+    (``fleet_prefix_hit_ratio``): the affinity plane's whole point is
+    concentrating shared prefixes on warm replicas — the beats-spread
+    bar hard-gates inside bench_mfu, this guards the trend (a policy
+    change that still "wins" but re-pays more shared prefill than it
+    used to is a regression)."""
+    return _pct_trend_guard(
+        ratio, repo, field="fleet_prefix_hit_ratio",
+        label="fleet prefix-hit ratio", fmt=".4f", unit="",
+        lower_is_worse=True,
+    )
+
+
 def interference_guard(pct: float | None, repo: Path) -> str | None:
     """Failure message when the interference bench's governor-OFF p99
     inflation (``interference_p99_inflation_pct``) DROPPED >25% vs the
@@ -2017,6 +2046,15 @@ def main(argv=None) -> int:
         .get("spec_tokens_per_s"),
         "spec_accept_len_mean": compute.get("serve_spec", {})
         .get("spec_accept_len_mean"),
+        # Fleet-router numbers (serve_fleet section), hoisted for the
+        # trend guards: fleet goodput across the pool and the global
+        # prefix-hit ratio under the affinity policy (the zero-drop/
+        # parity/beats-spread invariants hard-gate inside bench_mfu
+        # itself).
+        "fleet_goodput_tokens_per_s": compute.get("serve_fleet", {})
+        .get("fleet_goodput_tokens_per_s"),
+        "fleet_prefix_hit_ratio": compute.get("serve_fleet", {})
+        .get("fleet_prefix_hit_ratio"),
         # Interference bench numbers (serve_interference section),
         # hoisted for the trend guard: the governor-OFF inflation is the
         # scenario's signal strength (the governed/overhead bounds hard-
@@ -2075,6 +2113,10 @@ def main(argv=None) -> int:
         msgs.append(disagg_tpot_guard(record["disagg_tpot_p99_ms"], repo))
         msgs.append(spec_tokens_guard(record["spec_tokens_per_s"], repo))
         msgs.append(spec_accept_guard(record["spec_accept_len_mean"], repo))
+        msgs.append(fleet_goodput_guard(
+            record["fleet_goodput_tokens_per_s"], repo
+        ))
+        msgs.append(fleet_prefix_guard(record["fleet_prefix_hit_ratio"], repo))
         msgs.append(gang_storm_guard(record["gang_throughput_gangs_s"], repo))
         msgs.append(defrag_stranded_guard(record["defrag_stranded_after_pct"], repo))
         msgs.append(defrag_binpack_guard(record["defrag_binpack_after_pct"], repo))

@@ -1851,6 +1851,191 @@ def bench_serve_spec(report: dict, smoke: bool = False) -> None:
         )
 
 
+def bench_serve_fleet(report: dict, smoke: bool = False) -> None:
+    """The fleet front door: a shared-prefix Poisson trace routed across
+    N small paged engines by the prefix-affinity router
+    (``serving/router.py`` + ``serving/fleet.py``) vs the same fleet
+    under the affinity-blind ``spread`` policy. Affinity pins each
+    shared system prompt's request stream to the replica already
+    caching it, so the fleet-global radix hit ratio (summed hit tokens
+    over summed lookup tokens) must come out strictly ABOVE the spread
+    run, which re-pays every prefix's cold prefill once per replica it
+    lands on.
+
+    A third run drains one replica mid-trace through the journaled
+    cordon→drain→migrate→release scale-down (real WAL on disk): its
+    in-flight requests restore onto a survivor from the drain snapshot.
+
+    Hard gates (smoke included): zero dropped and zero double-served
+    requests on ALL THREE runs — including during the live scale-down —
+    tokens BIT-IDENTICAL to a unified engine that was never fleeted (on
+    every run: routing and draining are placement, never arithmetic),
+    the scale journal fully resolved, and affinity's prefix-hit ratio
+    strictly above spread's. The row's ``fleet_goodput_tokens_per_s`` /
+    ``fleet_prefix_hit_ratio`` feed bench.py's 25% trend guards.
+    """
+    import tempfile
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    from gpushare_device_plugin_tpu.allocator.assume import AssumeCache
+    from gpushare_device_plugin_tpu.allocator.checkpoint import (
+        AllocationCheckpoint,
+    )
+    from gpushare_device_plugin_tpu.serving import (
+        FleetServer,
+        PagedSlotEngine,
+        shared_prefix_trace,
+    )
+    from gpushare_device_plugin_tpu.workloads.quant import cast_decoder
+    from gpushare_device_plugin_tpu.workloads.transformer import (
+        TransformerConfig,
+        init_params,
+    )
+
+    if smoke:
+        cfg = TransformerConfig(
+            vocab=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+            d_ff=64, max_seq=64, compute_dtype=jnp.float32,
+        )
+        params = init_params(jax.random.key(0), cfg)
+        max_len, page, chunk = 32, 4, 4
+        # capacity >= trace size: the router assigns the whole trace
+        # up-front, and only non-overflow placements are affinity-aware
+        n_eng, slots = 3, 6
+        n_req, rate = 16, 0.3
+        prefixes, tails, mix = (3, 12), (1, 4), [2, 4, 8]
+    else:
+        cfg = _bench_cfg(smoke)
+        params = jax.jit(lambda k: cast_decoder(init_params(k, cfg)))(
+            jax.random.key(0)
+        )
+        max_len, page, chunk = 1024, 64, 256
+        n_eng, slots = 3, 8
+        n_req, rate = 24, 0.15
+        prefixes, tails, mix = (3, 384), (16, 64), [16, 32, 128]
+    eos = 2
+    reqs = shared_prefix_trace(
+        n_req, seed=23, rate=rate, vocab=cfg.vocab, prefixes=prefixes,
+        tail_lens=tails, max_new=mix,
+    )
+    pages_per = -(-max_len // page)
+    eng_pages = slots * pages_per
+
+    def mk_engine(n_slots, pages):
+        return PagedSlotEngine(
+            params, cfg, slots=n_slots, max_len=max_len,
+            total_pages=pages, page_size=page, prefill_chunk=chunk,
+            eos_id=eos,
+        )
+
+    # parity reference: one engine, never fleeted — greedy determinism
+    # makes every routing/draining variant's tokens equal to this
+    unified = mk_engine(slots * n_eng, eng_pages * n_eng)
+    u_tokens = {
+        r.rid: list(r.tokens) for r in unified.run(reqs).results
+    }
+
+    def run_fleet(policy, scale_down=None, checkpoint=None, assume=None):
+        fleet = FleetServer(
+            {f"e{i}": mk_engine(slots, eng_pages) for i in range(n_eng)},
+            policy=policy, checkpoint=checkpoint, assume=assume,
+            node="bench",
+        )
+        t0 = _time.perf_counter()
+        out = fleet.serve(reqs, scale_down=scale_down)
+        wall = _time.perf_counter() - t0
+        mismatch = [
+            rid for rid, e in out["results"].items()
+            if e["tokens"] != u_tokens.get(rid)
+        ]
+        return fleet, out, wall, mismatch
+
+    aff, aff_out, aff_wall, aff_mismatch = run_fleet("prefix-affinity")
+    rr, rr_out, _rr_wall, rr_mismatch = run_fleet("spread")
+    ckpt = AllocationCheckpoint(
+        os.path.join(
+            tempfile.mkdtemp(prefix="bench-fleet-"), "wal.ckpt"
+        )
+    )
+    sc, sc_out, _sc_wall, sc_mismatch = run_fleet(
+        "prefix-affinity", scale_down=("e0", 3),
+        checkpoint=ckpt, assume=AssumeCache(),
+    )
+    tokens_out = sum(
+        len(e["tokens"]) for e in aff_out["results"].values()
+    )
+    row = {
+        "requests": n_req,
+        "engines": n_eng,
+        "slots_per_engine": slots,
+        "pages_per_engine": eng_pages,
+        "shared_prefixes": prefixes[0],
+        "policy": "prefix-affinity",
+        "router_outcomes": dict(aff_out["router"]["outcomes"]),
+        "affinity_hit_ratio": aff_out["router"]["affinity_hit_ratio"],
+        "rr_prefix_hit_ratio": round(rr_out["prefix_hit_ratio"], 4),
+        "fleet_prefix_hit_ratio": round(aff_out["prefix_hit_ratio"], 4),
+        "fleet_goodput_tokens_per_s": round(tokens_out / aff_wall, 3),
+        "scale_down": {
+            "victim": "e0",
+            "migrated_requests": sc.executor.migrated_requests,
+            "ops": sc.executor.completed_ops,
+            "paths": sorted(
+                {e["path"] for e in sc_out["results"].values()}
+            ),
+        },
+    }
+    report["serve_fleet"] = row
+    print(f"serve_fleet {row}", file=sys.stderr)
+    dropped = {
+        "affinity": aff_out["dropped"], "spread": rr_out["dropped"],
+        "scale_down": sc_out["dropped"],
+    }
+    if any(dropped.values()):
+        raise AssertionError(
+            f"fleet dropped requests: {dropped} — the front door may "
+            "shed best-effort under pressure, never drop admitted work "
+            "(and a live scale-down must be zero-loss)"
+        )
+    doubles = (
+        aff_out["double_served"] + rr_out["double_served"]
+        + sc_out["double_served"]
+    )
+    if doubles:
+        raise AssertionError(
+            f"fleet double-served rids {doubles} — migrate/re-queue "
+            "must dedup by rid and snapshot_id"
+        )
+    if aff_mismatch or rr_mismatch or sc_mismatch:
+        raise AssertionError(
+            f"fleet tokens diverged from unified (affinity "
+            f"{aff_mismatch[:5]}, spread {rr_mismatch[:5]}, scale-down "
+            f"{sc_mismatch[:5]}) — routing and draining are placement, "
+            "never arithmetic"
+        )
+    if ckpt.pending():
+        raise AssertionError(
+            f"scale journal left pending after the drain: "
+            f"{ckpt.pending()} — the protocol must resolve inline when "
+            "nothing crashes"
+        )
+    if sc.executor.completed_ops != 1:
+        raise AssertionError(
+            f"scale-down ran {sc.executor.completed_ops} ops, expected "
+            "exactly 1"
+        )
+    if row["fleet_prefix_hit_ratio"] <= row["rr_prefix_hit_ratio"]:
+        raise AssertionError(
+            f"prefix-affinity routing did not beat spread: hit ratio "
+            f"{row['fleet_prefix_hit_ratio']} vs "
+            f"{row['rr_prefix_hit_ratio']} — the affinity plane is dead "
+            "and the fleet re-pays every shared prefix per replica"
+        )
+
+
 def bench_sweep(report: dict, smoke: bool = False) -> None:
     """Flash block-size sweep (opt-in via --sweep): honest-timed wall per
     (block_q, block_k) at the bench shapes, to re-tune the defaults that
@@ -2003,6 +2188,18 @@ def parse_args(argv: list[str] | None = None) -> argparse.Namespace:
         "tests/test_bench_spec_smoke.py)",
     )
     p.add_argument(
+        "--fleet-smoke", action="store_true",
+        help="CPU fleet-router smoke: ONLY the serve_fleet section "
+        "(shared-prefix Poisson trace across 3 paged engines behind "
+        "the prefix-affinity router vs the same fleet under the "
+        "affinity-blind spread policy, plus a journaled mid-trace "
+        "scale-down; hard-fails on dropped or double-served requests, "
+        "token divergence from one unified engine, an unresolved scale "
+        "journal, or affinity's prefix-hit ratio not strictly beating "
+        "spread's) (make bench-fleet-smoke; tier-1 via "
+        "tests/test_bench_fleet_smoke.py)",
+    )
+    p.add_argument(
         "--backend-init-timeout", type=float, default=60.0,
         help="seconds the subprocess backend-init probe may take before "
         "the run is skipped with an explicit reason (the old in-process "
@@ -2016,7 +2213,7 @@ def main(argv: list[str] | None = None) -> int:
     smoke = (
         args.smoke or args.serve_smoke or args.multichip_smoke
         or args.paged_smoke or args.interference_smoke
-        or args.disagg_smoke or args.spec_smoke
+        or args.disagg_smoke or args.spec_smoke or args.fleet_smoke
     )
     if smoke:
         # Force, don't default: an inherited JAX_PLATFORMS (axon/tpu) would
@@ -2122,6 +2319,7 @@ def main(argv: list[str] | None = None) -> int:
         ("serve_interference", bench_serve_interference),
         ("serve_disagg", bench_serve_disagg),
         ("serve_spec", bench_serve_spec),
+        ("serve_fleet", bench_serve_fleet),
     ]
     if args.serve_smoke:
         # ONLY serve_engine, by contract (the smoke test and the verify
@@ -2143,6 +2341,9 @@ def main(argv: list[str] | None = None) -> int:
     elif args.spec_smoke:
         # ONLY serve_spec, same single-section contract
         sections = [("serve_spec", bench_serve_spec)]
+    elif args.fleet_smoke:
+        # ONLY serve_fleet, same single-section contract
+        sections = [("serve_fleet", bench_serve_fleet)]
     else:
         if args.ablate:
             sections.append(("ablate", bench_ablate))

@@ -746,6 +746,17 @@ def replay_checkpoint(ckpt: AllocationCheckpoint, assume: AssumeCache) -> int:
             # (re-deliver idempotently by handoff id) at or past
             # "import", roll back to a local re-prefill before it.
             pass
+        elif kind == "scale":
+            # a fleet scale-down died mid-protocol (serving/router.py).
+            # Nothing to re-install in the chip ledger: the drained
+            # requests and snapshot live inside the journal record
+            # itself and the engines' own refcounted page pools. The
+            # entry stays pending — that IS the protection — and the
+            # reconciler resolves it by phase: roll forward (re-deliver
+            # the snapshot to a survivor, idempotent by snapshot_id) at
+            # or past "migrate", roll back (un-cordon or re-queue the
+            # journaled rows) before it.
+            pass
         else:
             log.warning("checkpoint replay: unknown entry kind %r for %s", kind, key)
             continue

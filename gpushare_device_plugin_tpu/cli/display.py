@@ -589,6 +589,59 @@ def render_shards(doc: dict) -> str:
     return buf.getvalue()
 
 
+def render_fleet(doc: dict) -> str:
+    """Render a ``/fleet`` document (``FleetServer.fleet_doc``): one
+    row per replica with its lifecycle state, health (consecutive
+    scrape misses), headroom, queue depth and fingerprint count, then
+    the router's routing/shed outcomes and the scale-down drain status.
+    Deterministic for a given document (golden-tested like
+    ``render_shards``)."""
+    buf = StringIO()
+    replicas = (doc or {}).get("replicas") or {}
+    router = (doc or {}).get("router") or {}
+    scale = (doc or {}).get("scale") or {}
+    ratio = (doc or {}).get("prefix_hit_ratio")
+    buf.write(
+        f"fleet — {len(replicas)} replica(s), policy "
+        f"{router.get('policy', '?')}, global prefix-hit ratio "
+        f"{ratio if ratio is not None else '?'}\n"
+    )
+    if not replicas:
+        buf.write("(no replicas)\n")
+        return buf.getvalue()
+    name_w = max(len("REPLICA"), max(len(str(n)) for n in replicas))
+    header = (
+        f"{'REPLICA'.ljust(name_w)}  STATE      MISSES  FREE  CAP  "
+        f"QUEUE  PREFIXES"
+    )
+    buf.write(header + "\n")
+    for name in sorted(replicas):
+        r = replicas[name] or {}
+        buf.write(
+            f"{str(name).ljust(name_w)}  "
+            f"{str(r.get('state', '?')).ljust(9)}  "
+            f"{str(r.get('misses', 0)).rjust(6)}  "
+            f"{str(r.get('free_slots', 0)).rjust(4)}  "
+            f"{str(r.get('capacity', 0)).rjust(3)}  "
+            f"{str(r.get('queue_depth', 0)).rjust(5)}  "
+            f"{str(r.get('fingerprints', 0)).rjust(8)}\n"
+        )
+    outcomes = router.get("outcomes") or {}
+    if outcomes:
+        parts = [f"{k}={outcomes[k]}" for k in sorted(outcomes)]
+        buf.write(
+            f"router: {' '.join(parts)} "
+            f"inflight={router.get('inflight', 0)} "
+            f"affinity_hit_ratio="
+            f"{round(router.get('affinity_hit_ratio', 0.0) or 0.0, 4)}\n"
+        )
+    buf.write(
+        f"scale: ops={scale.get('ops', 0)} "
+        f"migrated_requests={scale.get('migrated_requests', 0)}\n"
+    )
+    return buf.getvalue()
+
+
 def render_trace(spans: list[dict]) -> str:
     """Render one admission/serving trace as an offset/duration tree.
 

@@ -34,8 +34,10 @@ from ..cluster.apiserver import ApiServerClient
 from ..utils.metric_catalog import (
     BUILD_INFO,
     PREFIX_ENGINE,
+    PREFIX_FLEET,
     PREFIX_GOVERNOR,
     PREFIX_HANDOFF,
+    PREFIX_ROUTER,
     PREFIX_SLO,
 )
 from ..utils.retry import retry
@@ -113,8 +115,12 @@ def parse_engine_metrics(text: str) -> dict[str, dict[str, float]]:
     The disaggregated-serving ``tpushare_handoff_*`` families
     (utils/metric_catalog.py) fold into the same per-pod row under
     ``handoff_*`` keys — an ``outcome``/``reason`` label folds into the
-    key (``handoff_transfers_total_delivered``); histogram buckets are
-    skipped, the ``_sum``/``_count`` samples carry what the CLI shows."""
+    key (``handoff_transfers_total_delivered``); the fleet router's
+    ``tpushare_fleet_*`` / ``tpushare_router_*`` families fold the same
+    way under ``fleet_*`` / ``router_*`` keys (``engine``/``tier``/
+    ``state`` labels fold too: ``router_shed_total_best_effort``,
+    ``fleet_replicas_ready``); histogram buckets are skipped, the
+    ``_sum``/``_count`` samples carry what the CLI shows."""
     out: dict[str, dict[str, float]] = {}
     for line in text.splitlines():
         if line.startswith("#"):
@@ -123,6 +129,10 @@ def parse_engine_metrics(text: str) -> dict[str, dict[str, float]]:
             prefix, fold = PREFIX_ENGINE, ""
         elif line.startswith(PREFIX_HANDOFF):
             prefix, fold = PREFIX_HANDOFF, "handoff_"
+        elif line.startswith(PREFIX_FLEET):
+            prefix, fold = PREFIX_FLEET, "fleet_"
+        elif line.startswith(PREFIX_ROUTER):
+            prefix, fold = PREFIX_ROUTER, "router_"
         else:
             continue
         try:
@@ -140,7 +150,7 @@ def parse_engine_metrics(text: str) -> dict[str, dict[str, float]]:
         if name.endswith("_bucket") or "le" in labels:
             continue
         short = fold + name[len(prefix):]
-        for extra in ("outcome", "reason"):
+        for extra in ("outcome", "reason", "tier", "state", "engine"):
             if labels.get(extra):
                 short += f"_{labels[extra]}"
         out.setdefault(pod, {})[short] = val
@@ -446,6 +456,68 @@ def shards_main(argv: list[str]) -> int:
     return 0
 
 
+def fetch_fleet(urls: list[str]) -> dict:
+    """Fetch + merge ``/fleet`` documents (a fleet may front several
+    router replicas; replica rows merge by name, later endpoints
+    winning ties). Unreachable endpoints warn but do not fail."""
+    merged: dict = {
+        "replicas": {}, "router": None, "scale": None,
+        "prefix_hit_ratio": None,
+    }
+    for doc in _fetch_json_docs(urls, "/fleet"):
+        for name, row in (doc.get("replicas") or {}).items():
+            merged["replicas"][name] = row
+        if merged["router"] is None:
+            merged["router"] = doc.get("router")
+            merged["scale"] = doc.get("scale")
+            merged["prefix_hit_ratio"] = doc.get("prefix_hit_ratio")
+    return {
+        "replicas": {
+            name: merged["replicas"][name]
+            for name in sorted(merged["replicas"])
+        },
+        "router": merged["router"] or {},
+        "scale": merged["scale"] or {},
+        "prefix_hit_ratio": merged["prefix_hit_ratio"],
+    }
+
+
+def fleet_main(argv: list[str]) -> int:
+    """``kubectl-inspect-tpushare fleet``: render the fleet router's
+    replica map — per-replica health/state/queue depth, router routing
+    outcomes and shed counts, scale-down drain status, and the global
+    prefix-hit ratio (docs/serving.md, fleet section)."""
+    from .display import render_fleet
+
+    p = argparse.ArgumentParser(
+        prog="kubectl-inspect-tpushare fleet",
+        description="Fleet router replica map + routing outcomes",
+    )
+    p.add_argument("--fleet-url", action="append", default=[],
+                   metavar="URL",
+                   help="a /fleet endpoint (the fleet router's "
+                   "--metrics-port); repeatable — replica rows are "
+                   "merged by name")
+    p.add_argument("-o", "--output", default="table",
+                   choices=["table", "json"])
+    args = p.parse_args(argv)
+    if not args.fleet_url:
+        print(
+            "error: no --fleet-url given — point me at the fleet "
+            "router's metrics port (e.g. --fleet-url "
+            "http://router:9114)",
+            file=sys.stderr,
+        )
+        return 1
+    doc = fetch_fleet(args.fleet_url)
+    if args.output == "json":
+        json.dump(doc, sys.stdout, indent=2)
+        print()
+        return 0
+    sys.stdout.write(render_fleet(doc))
+    return 0
+
+
 def trace_main(argv: list[str]) -> int:
     p = argparse.ArgumentParser(
         prog="kubectl-inspect-tpushare trace",
@@ -603,6 +675,8 @@ def main(argv=None) -> int:
         return timeline_main(argv[1:])
     if argv and argv[0] == "shards":
         return shards_main(argv[1:])
+    if argv and argv[0] == "fleet":
+        return fleet_main(argv[1:])
     p = argparse.ArgumentParser(
         prog="kubectl-inspect-tpushare",
         description="Display TPU-share HBM utilization across the cluster",

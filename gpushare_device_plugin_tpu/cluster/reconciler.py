@@ -88,6 +88,8 @@ class DriftReconciler:
         move_restore_fn: Callable[[PodKey, dict | None], None] | None = None,
         handoff_deliver_fn: Callable[[str, dict], str] | None = None,
         handoff_abort_fn: Callable[[str], Any] | None = None,
+        scale_deliver_fn: Callable[[str, dict], Any] | None = None,
+        scale_requeue_fn: Callable[[str, dict], Any] | None = None,
     ) -> None:
         """``kubelet_grants_fn() -> dict[PodKey, list[str]]`` supplies
         kubelet's granted device IDs per pod when a feed exists (the fake
@@ -100,7 +102,12 @@ class DriftReconciler:
         ``handoff_abort_fn(handoff_id)`` are the decode tier's idempotent
         delivery sink and staging release for journaled KV handoffs found
         mid-protocol (serving/handoffproto.py); without a deliver hook a
-        handoff entry stays pending — protective, never resolved blind."""
+        handoff entry stays pending — protective, never resolved blind.
+        ``scale_deliver_fn(scale_id, record)`` /
+        ``scale_requeue_fn(scale_id, record)`` are the fleet binding's
+        survivor-restore and un-cordon/re-queue hooks for journaled
+        scale-downs found mid-protocol (serving/router.py); same
+        protective default without a deliver hook."""
         self._api = api
         self._pods = pod_source
         self._assume = assume
@@ -113,6 +120,8 @@ class DriftReconciler:
         self._move_restore = move_restore_fn
         self._handoff_deliver = handoff_deliver_fn
         self._handoff_abort = handoff_abort_fn
+        self._scale_deliver = scale_deliver_fn
+        self._scale_requeue = scale_requeue_fn
         self._fenced_notified = False
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -284,6 +293,27 @@ class DriftReconciler:
                 )
                 if outcome is not None:
                     drift(f"handoff_{outcome}", repaired=True)
+                continue
+            if data.get("kind") == "scale":
+                # a fleet scale-down found mid-protocol: resolved by
+                # phase — roll forward (re-deliver the journaled drain
+                # snapshot to a survivor, idempotent by snapshot_id) at
+                # or past "migrate", roll back (un-cordon the replica
+                # or re-queue the journaled rows on survivors) before
+                # it. BOTH directions end with every in-flight request
+                # scheduled exactly once (serving/router.py owns the
+                # rules).
+                if self._scale_deliver is None:
+                    continue  # no fleet wired: stay protective
+                from ..serving import router as fleet_router
+
+                outcome = fleet_router.resolve_scale(
+                    self._ckpt, self._assume, key, data,
+                    deliver_fn=self._scale_deliver,
+                    requeue_fn=self._scale_requeue,
+                )
+                if outcome is not None:
+                    drift(f"scale_{outcome}", repaired=True)
                 continue
             pod, authoritative = self._fetch_pod(key)
             if not authoritative:

@@ -156,6 +156,24 @@ ENV_WORKLOAD_CLASS = "ALIYUN_COM_TPU_WORKLOAD_CLASS"
 SLO_TIER_CRITICAL = "critical"
 SLO_TIER_BEST_EFFORT = "best_effort"
 
+# Fleet replica lifecycle states (serving/router.py's membership table;
+# they live here so the jax-free CLI can render a replica map without
+# importing the router). ready -> routable; cordoned -> serving its
+# in-flight work but closed to new routes (the scale-down protocol's
+# first durable step); draining -> snapshot capture in progress;
+# dead -> failure detector evicted it (consecutive scrape misses) or
+# scale-down released it.
+FLEET_REPLICA_READY = "ready"
+FLEET_REPLICA_CORDONED = "cordoned"
+FLEET_REPLICA_DRAINING = "draining"
+FLEET_REPLICA_DEAD = "dead"
+FLEET_REPLICA_STATES = (
+    FLEET_REPLICA_READY,
+    FLEET_REPLICA_CORDONED,
+    FLEET_REPLICA_DRAINING,
+    FLEET_REPLICA_DEAD,
+)
+
 # Node annotation carrying the interference detector's latest verdicts as
 # JSON ({"chips": {chip: {"victim", "aggressors", "ratio"}}, "time_unix"})
 # — written best-effort each detector pass so kubectl-inspect-tpushare

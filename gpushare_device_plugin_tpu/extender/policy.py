@@ -60,6 +60,10 @@ class PolicyView:
     stranded: int | None = None
     broken: int | None = None
     tie_break: int | None = None
+    # Fleet routing candidates (serving.router) add the number of radix
+    # pages the candidate engine already holds for the request's prompt
+    # prefix; placement candidates leave it None.
+    affinity_pages: int | None = None
 
     def slack(self) -> float:
         """Leftover fraction on the decisive chip after placement."""
@@ -245,6 +249,45 @@ class LearnedStubPolicy(PlacementPolicy):
         )
 
 
+class PrefixAffinityPolicy(PlacementPolicy):
+    """Fleet-router scorer: prefer the engine already holding the
+    request's prompt prefix in its radix cache, tempered by headroom.
+
+    ``view.affinity_pages`` carries how many prefix pages the candidate
+    engine's exported fingerprint set matched; ``free_units``/``capacity``
+    carry its admission headroom (free concurrency slots). The affinity
+    term saturates (one long cached prefix should not outvote a nearly
+    full engine forever) and the headroom term breaks ties among equally
+    warm candidates, so the policy degrades to load balancing when no
+    candidate holds the prefix — exactly the fall-back the router needs
+    when fingerprints are stale or a scrape failed (affinity_pages=None
+    scores the same as 0)."""
+
+    name = "prefix-affinity"
+
+    def __init__(self, w_affinity: float = 0.7, w_headroom: float = 0.3,
+                 saturation_pages: int = 8) -> None:
+        self._w_affinity = w_affinity
+        self._w_headroom = w_headroom
+        self._sat = max(1, saturation_pages)
+
+    def score(self, view: PolicyView) -> ScoreVector:
+        if view.capacity <= 0 or view.free_units < view.request_units:
+            return self._infeasible(view)
+        pages = view.affinity_pages or 0
+        aff = min(1.0, pages / float(self._sat))
+        headroom = view.free_units / float(view.capacity)
+        raw = 10.0 * (self._w_affinity * aff + self._w_headroom * headroom)
+        return ScoreVector(
+            policy=self.name, raw=max(0.0, min(10.0, raw)),
+            free_units=view.free_units, request_units=view.request_units,
+            binpack=headroom, ici_hops=view.ici_hops,
+            stranded=view.stranded, broken=view.broken,
+            tie_break=(view.tie_break if view.tie_break is not None
+                       else pages),
+        )
+
+
 # --- registry ---------------------------------------------------------------
 
 _REGISTRY: dict[str, Callable[[], PlacementPolicy]] = {}
@@ -286,5 +329,6 @@ def resolve(policy: "str | PlacementPolicy") -> PlacementPolicy:
 register_policy("greedy-binpack", GreedyBinpackPolicy)
 register_policy("multi-objective", MultiObjectivePolicy)
 register_policy("learned", LearnedStubPolicy)
+register_policy("prefix-affinity", PrefixAffinityPolicy)
 for _legacy in ("best-fit", "first-fit", "spread"):
     register_policy(_legacy, lambda n=_legacy: _LegacyPolicy(n))

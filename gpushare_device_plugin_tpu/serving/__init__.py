@@ -24,6 +24,16 @@ protocol (jax-free core, model-checked by ``tools/tpumc``) and its
 engine binding — page serialization, the :class:`~.handoff.DisaggServer`
 two-tier plane with the re-prefill degradation ladder
 (``docs/serving.md``, disaggregation section).
+
+``router`` + ``fleet`` put a pool of paged engines behind one front
+door: the prefix-affinity :class:`~.router.FleetRouter` (radix
+fingerprints via the metrics plane, SLO-aware best-effort shedding,
+health-checked membership with consecutive-miss eviction) and the
+journaled cordon→drain→migrate→release scale-down protocol (jax-free
+core like ``handoffproto``; engine binding
+:class:`~.fleet.FleetServer`) — an engine dies or scales away, its
+in-flight requests land on a survivor with tokens bit-identical and
+zero dropped (``docs/serving.md``, fleet section).
 """
 
 from .engine import (  # noqa: F401
@@ -61,6 +71,7 @@ from .handoffproto import (  # noqa: F401
     HandoffSink,
     resolve_handoff,
 )
+from .fleet import FleetServer  # noqa: F401
 from .pages import (  # noqa: F401
     PageAllocator,
     PagedPlan,
@@ -68,4 +79,15 @@ from .pages import (  # noqa: F401
     pages_for,
 )
 from .profiler import StepProfiler  # noqa: F401
-from .radix import RadixCache  # noqa: F401
+from .radix import RadixCache, prefix_fingerprints  # noqa: F401
+from .router import (  # noqa: F401
+    SCALE_KIND,
+    SCALE_PHASES,
+    EngineScrapeClient,
+    FleetMembership,
+    FleetRouter,
+    RouteDecision,
+    ScaleExecutor,
+    resolve_scale,
+    scale_key,
+)

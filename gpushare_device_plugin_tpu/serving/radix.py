@@ -37,10 +37,38 @@ pinned against solo ``generate()`` in ``tests/test_paged_engine.py``.
 from __future__ import annotations
 
 import dataclasses
+import struct
+import zlib
 from typing import Iterator
 
 from ..utils.lockrank import make_lock
 from .pages import PageAllocator
+
+
+def prefix_fingerprints(
+    tokens: tuple[int, ...], page_size: int
+) -> list[int]:
+    """Chained CRC32 fingerprint of each full-page prefix of ``tokens``.
+
+    ``fp[i]`` hashes pages ``0..i`` — each page's CRC is seeded with its
+    parent's, so a fingerprint commits to the whole path from the root,
+    not just one page's tokens (two different prefixes can never collide
+    into sharing a fingerprint chain by agreeing on a single page).
+    This is the request-side half of the fleet router's affinity signal:
+    an engine exports the same chained values for its cached radix paths
+    (:meth:`RadixCache.fingerprints`), and the overlap length is exactly
+    the number of pages a candidate engine would serve from cache.
+    Tokens hash as 4-byte little-endian; CRC32 keeps the export compact
+    (one small int per cached page) and dependency-free."""
+    if page_size < 1:
+        raise ValueError(f"page_size must be >= 1, got {page_size}")
+    out: list[int] = []
+    crc = 0
+    for i in range(0, len(tokens) - len(tokens) % page_size, page_size):
+        chunk = tokens[i : i + page_size]
+        crc = zlib.crc32(struct.pack(f"<{len(chunk)}i", *chunk), crc)
+        out.append(crc)
+    return out
 
 
 @dataclasses.dataclass
@@ -247,6 +275,27 @@ class RadixCache:
         if pages:
             self._alloc.release(pages)
         return len(pages)
+
+    def fingerprints(self) -> list[int]:
+        """Chained CRC32 fingerprints of every cached page path (the
+        engine-side half of :func:`prefix_fingerprints`): one value per
+        cached node, each committing to the full root-to-node token
+        path. Exported through the metrics plane for the fleet router's
+        prefix-affinity scoring; sorted for a deterministic wire doc."""
+        out: list[int] = []
+        with self._lock:
+            stack: list[tuple[_Node, int]] = [
+                (n, 0) for n in self._root.values()
+            ]
+            while stack:
+                node, parent_crc = stack.pop()
+                crc = zlib.crc32(
+                    struct.pack(f"<{len(node.tokens)}i", *node.tokens),
+                    parent_crc,
+                )
+                out.append(crc)
+                stack.extend((c, crc) for c in node.children.values())
+        return sorted(out)
 
     def _walk_all(self) -> list[_Node]:
         out: list[_Node] = []

@@ -46,10 +46,19 @@ Registered points (see docs/robustness.md for the failure-mode matrix):
                         decode tier adopts (the roll-forward boundary)
 ``handoff.commit``      after the "commit" record is durable, before the
                         entry resolves
+``scale.cordon``        after the fleet scale-down's "cordon" phase
+                        record is durable, before routing stops
+``scale.drain``         after the "drain" record (in-flight request rows
+                        included) is durable, before the engine quiesce
+``scale.migrate``       after the "migrate" record (drained snapshot
+                        included) is durable, before the survivor
+                        restore (the roll-forward boundary)
+``scale.release``       after the "release" record is durable, before
+                        the replica leaves the membership
 ==========================================================================
 
 The ``checkpoint.*`` / ``allocator.post_persist`` / ``defrag.*`` /
-``handoff.*`` points
+``handoff.*`` / ``scale.*`` points
 sit immediately *after* each journal step takes durable effect, so arming
 them with the ``crash`` mode is the ``crash_after:<site>`` primitive the
 restart-recovery and chaos-move suites drive: the process "dies" with the
@@ -118,6 +127,10 @@ POINTS = (
     "handoff.transfer",
     "handoff.import",
     "handoff.commit",
+    "scale.cordon",
+    "scale.drain",
+    "scale.migrate",
+    "scale.release",
 )
 
 

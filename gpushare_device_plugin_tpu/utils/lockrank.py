@@ -230,6 +230,34 @@ RANKS: dict[str, LockRank] = dict(
             "(that ordering is the point of the two-lock split).",
         ),
         _r(
+            "fleet.router", 76, "lock", False,
+            "FleetRouter's routing table (rid -> engine assignment, "
+            "round-robin cursor, per-path counters). Pure memory: SLO "
+            "severity reads (slo.budget, rank 64) and decision-record "
+            "emission (decisions.ring, rank 65) both run BEFORE the lock "
+            "is taken / after it is dropped — they sit down-rank by "
+            "design. Membership snapshots (fleet.membership, rank 77) "
+            "nest strictly up-rank.",
+        ),
+        _r(
+            "fleet.membership", 77, "lock", False,
+            "FleetMembership's replica table (health, consecutive "
+            "scrape misses, cordon flags, prefix fingerprints, load "
+            "estimates). Held around table flips only — never across a "
+            "scrape transport call or its circuit breaker (rank 88); "
+            "replica-state gauges publish (metrics.registry, rank 95) "
+            "under it, strictly up-rank.",
+        ),
+        _r(
+            "fleet.scale", 78, "lock", False,
+            "ScaleExecutor's in-flight scale-op state (scale_id -> "
+            "phase, migrated-request counters). Counter/state flips "
+            "only: the journal write (checkpoint.journal, rank 40) and "
+            "the engine drain handshake (serving.drain, rank 89) both "
+            "run with this lock released — the protocol's I/O and "
+            "engine calls are never under it, mirroring defrag.moves.",
+        ),
+        _r(
             "apiserver.coalescer", 80, "lock", False,
             "Lazy construction of the node-PATCH coalescer; the merged "
             "PATCH itself runs outside it.",

@@ -88,6 +88,11 @@ ENGINE_STEP_SECONDS = "tpushare_engine_step_seconds"
 EXTENDER_VERB_SECONDS = "tpushare_extender_verb_seconds"
 EXTENDER_VERB_TOTAL = "tpushare_extender_verb_total"
 EXTENDER_VIEW_TOTAL = "tpushare_extender_view_total"
+FLEET_DRAIN_MIGRATED_REQUESTS_TOTAL = (
+    "tpushare_fleet_drain_migrated_requests_total"
+)
+FLEET_REPLICAS = "tpushare_fleet_replicas"
+FLEET_SCALE_OPS_TOTAL = "tpushare_fleet_scale_ops_total"
 GANG2PC_TOTAL = "tpushare_gang2pc_total"
 GOVERNOR_ENGAGED = "tpushare_governor_engaged"
 GOVERNOR_ENGAGEMENTS_TOTAL = "tpushare_governor_engagements_total"
@@ -112,6 +117,11 @@ RECONCILE_DRIFT_TOTAL = "tpushare_reconcile_drift_total"
 RECONCILE_REPAIRS_TOTAL = "tpushare_reconcile_repairs_total"
 RECONCILE_RUNS_TOTAL = "tpushare_reconcile_runs_total"
 RECONCILE_SECONDS = "tpushare_reconcile_seconds"
+ROUTER_PREFIX_AFFINITY_HITS_TOTAL = (
+    "tpushare_router_prefix_affinity_hits_total"
+)
+ROUTER_ROUTED_TOTAL = "tpushare_router_routed_total"
+ROUTER_SHED_TOTAL = "tpushare_router_shed_total"
 SLO_BURN_RATE = "tpushare_slo_burn_rate"
 SLO_ERROR_BUDGET_REMAINING = "tpushare_slo_error_budget_remaining"
 SLO_SEVERITY = "tpushare_slo_severity"
@@ -124,6 +134,8 @@ PREFIX_ENGINE = "tpushare_engine_"
 PREFIX_SLO = "tpushare_slo_"
 PREFIX_GOVERNOR = "tpushare_governor_"
 PREFIX_HANDOFF = "tpushare_handoff_"
+PREFIX_FLEET = "tpushare_fleet_"
+PREFIX_ROUTER = "tpushare_router_"
 
 # --- the contract table -----------------------------------------------------
 
@@ -166,6 +178,9 @@ CATALOG: dict[str, MetricSpec] = dict((
     _m(EXTENDER_VERB_SECONDS, HISTOGRAM, "verb"),
     _m(EXTENDER_VERB_TOTAL, COUNTER, "verb", "outcome"),
     _m(EXTENDER_VIEW_TOTAL, COUNTER, "outcome"),
+    _m(FLEET_DRAIN_MIGRATED_REQUESTS_TOTAL, COUNTER, "pod"),
+    _m(FLEET_REPLICAS, GAUGE, "state", "pod"),
+    _m(FLEET_SCALE_OPS_TOTAL, COUNTER, "outcome", "pod"),
     _m(GANG2PC_TOTAL, COUNTER, "phase", "outcome"),
     _m(GOVERNOR_ENGAGED, GAUGE, "pod"),
     _m(GOVERNOR_ENGAGEMENTS_TOTAL, COUNTER, "pod"),
@@ -190,6 +205,9 @@ CATALOG: dict[str, MetricSpec] = dict((
     _m(RECONCILE_REPAIRS_TOTAL, COUNTER, "kind"),
     _m(RECONCILE_RUNS_TOTAL, COUNTER, "outcome"),
     _m(RECONCILE_SECONDS, HISTOGRAM),
+    _m(ROUTER_PREFIX_AFFINITY_HITS_TOTAL, COUNTER, "pod"),
+    _m(ROUTER_ROUTED_TOTAL, COUNTER, "engine", "outcome", "pod"),
+    _m(ROUTER_SHED_TOTAL, COUNTER, "tier", "pod"),
     _m(SLO_BURN_RATE, GAUGE, "tier", "window", "pod"),
     _m(SLO_ERROR_BUDGET_REMAINING, GAUGE, "tier", "pod"),
     _m(SLO_SEVERITY, GAUGE, "tier", "pod"),

@@ -348,7 +348,10 @@ class MetricsServer:
     ``/shards`` serves the shard router's shard map (ring ownership,
     per-shard WAL seq + queue depth, 2PC gangs in flight — what
     ``inspect shards`` fetches) when ``shards_doc_fn`` is wired, 404
-    otherwise. ``/healthz`` is liveness (200 while the server thread
+    otherwise; ``/fleet`` serves the fleet router's replica map, router
+    outcomes, scale state and global prefix-hit ratio (what ``inspect
+    fleet`` fetches) when ``fleet_doc_fn`` is wired, same default.
+    ``/healthz`` is liveness (200 while the server thread
     runs);
     ``/readyz`` consults ``ready_fn`` — 200 when it returns truthy, 503
     otherwise (deploy probes gate on informer sync + WAL replay for the
@@ -360,7 +363,8 @@ class MetricsServer:
                  decisions: Any = None,
                  timeline: Any = None,
                  ready_fn: Callable[[], bool] | None = None,
-                 shards_doc_fn: Callable[[], dict] | None = None) -> None:
+                 shards_doc_fn: Callable[[], dict] | None = None,
+                 fleet_doc_fn: Callable[[], dict] | None = None) -> None:
         self._registry = registry
         self._host = host
         self._port = port
@@ -377,6 +381,7 @@ class MetricsServer:
         self._timeline = timeline
         self._ready_fn = ready_fn
         self._shards_doc_fn = shards_doc_fn
+        self._fleet_doc_fn = fleet_doc_fn
         self._server: ThreadingHTTPServer | None = None
 
     @property
@@ -391,6 +396,7 @@ class MetricsServer:
         timeline = self._timeline
         ready_fn = self._ready_fn
         shards_doc_fn = self._shards_doc_fn
+        fleet_doc_fn = self._fleet_doc_fn
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
@@ -431,6 +437,9 @@ class MetricsServer:
                     ctype = "application/json"
                 elif url.path == "/shards" and shards_doc_fn is not None:
                     body = _json.dumps(shards_doc_fn()).encode()
+                    ctype = "application/json"
+                elif url.path == "/fleet" and fleet_doc_fn is not None:
+                    body = _json.dumps(fleet_doc_fn()).encode()
                     ctype = "application/json"
                 elif url.path == "/healthz":
                     body = b"ok\n"

@@ -1,0 +1,30 @@
+"""Fixture: fleet-router decision emission NOT dominated on all outcome
+paths — every function here must be flagged by the ``decision-outcome``
+rule. These are the provenance holes the router refactor must never
+reintroduce: a request refused (shed) or silently queued with no record
+saying why.
+"""
+
+
+class _Log:
+    def emit(self, *a, **k):
+        pass
+
+
+DECISIONS = _Log()
+
+
+def bad_shed_without_record(rid, severity, tier):
+    """The shed branch returns before any emit: the dropped request has
+    no 'why' record."""
+    if severity == "page" and tier == "best_effort":
+        return None  # WRONG: shed with no fleet_shed record
+    DECISIONS.emit(f"req/{rid}", "fleet_route", outcome="balanced")
+    return rid
+
+
+def bad_no_replicas_fallthrough(rid, candidates):
+    """Only the routed branch emits; the empty-fleet path completes
+    normally silent."""
+    if candidates:
+        DECISIONS.emit(f"req/{rid}", "fleet_route", outcome="affinity")

@@ -1,0 +1,37 @@
+"""Fixture: the fleet router's decision-emission shapes (verbs
+``fleet_route`` / ``fleet_shed``) — none of these may be flagged by the
+``decision-outcome`` rule.
+
+The real router (serving/router.py) funnels every outcome — affinity
+hit, balanced fallback, overflow queueing, shed — through a single emit
+before its single return; these fixtures pin the shapes the rule must
+keep accepting.
+"""
+
+
+class _Log:
+    def emit(self, *a, **k):
+        pass
+
+
+DECISIONS = _Log()
+
+
+def ok_route_single_exit(rid, candidates, pick):
+    """The router's funnel shape: decide outcome, one emit, one return."""
+    if not candidates:
+        outcome, engine = "no_replicas", ""
+    else:
+        outcome, engine = pick(candidates)
+    DECISIONS.emit(f"req/{rid}", "fleet_route", outcome=outcome, node=engine)
+    return engine or None
+
+
+def ok_shed_branch_emits(rid, severity, tier):
+    """Both the shed branch and the admit branch leave a 'why' record."""
+    if severity == "page" and tier == "best_effort":
+        DECISIONS.emit(f"req/{rid}", "fleet_shed", outcome="shed",
+                       reason="burn-rate page")
+        return None
+    DECISIONS.emit(f"req/{rid}", "fleet_route", outcome="balanced")
+    return rid

@@ -1,0 +1,28 @@
+"""FIXTURE (never imported): fleet scale-down journal violations.
+
+- ``scale_returns_unresolved``: a return after a ``_journal_scale``
+  begin with no ``_journal_resolve`` — the scale entry outlives the
+  drain, and every reconciler pass would re-deliver the migrated
+  snapshot forever.
+- ``scale_swallows_migrate_failure``: a broad handler eats the migrate
+  failure without resolving (or re-raising) — the executor reports
+  success while the journal still says the drain is live.
+"""
+
+
+def scale_returns_unresolved(ckpt, engine, key, base, drain):
+    seq = _journal_scale(ckpt, key, dict(base, phase="drain"))  # noqa: F821
+    if seq is None:
+        return "degraded"
+    drain(engine)
+    return "drained"  # WRONG: begun entry left pending on a live path
+
+
+def scale_swallows_migrate_failure(ckpt, key, base):
+    outcome = "scaled"
+    try:
+        _journal_scale(ckpt, key, dict(base, phase="migrate"))  # noqa: F821
+        raise RuntimeError("no survivor with headroom")
+    except Exception:
+        outcome = "failed"  # WRONG: swallowed without resolving
+    return outcome

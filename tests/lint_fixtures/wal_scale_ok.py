@@ -1,0 +1,44 @@
+"""FIXTURE (never imported): the fleet scale-down journal shapes — all
+legal.
+
+The scale executor's real shape (serving/router.py): each drain phase
+(cordon → drain → migrate → release) journals a fresh ``_journal_scale``
+begin for the scale key, the stale-claim path resolves INLINE with
+``_journal_resolve("abort")`` before re-raising, the happy path commits
+after release, and unhandled exceptions propagate — the pending entry is
+the crash-safety story (the reconciler rolls it forward past the migrate
+commit point or back before it).
+"""
+
+
+class _Stale(RuntimeError):
+    pass
+
+
+def execute_scale(ckpt, engine, key, base, cordon, drain, migrate, release):
+    seq = _journal_scale(ckpt, key, dict(base, phase="cordon"))  # noqa: F821
+    cordon(engine)
+    seq = _journal_scale(ckpt, key, dict(base, phase="drain"))  # noqa: F821
+    snapshot = drain(engine)
+    seq = _journal_scale(  # noqa: F821
+        ckpt, key, dict(base, phase="migrate", snapshot=snapshot)
+    )
+    try:
+        moved = migrate(snapshot)
+    except _Stale:
+        _journal_resolve(ckpt, "abort", key, seq)  # noqa: F821
+        raise
+    seq = _journal_scale(ckpt, key, dict(base, phase="release"))  # noqa: F821
+    release(engine)
+    _journal_resolve(ckpt, "commit", key, seq)  # noqa: F821
+    return moved
+
+
+def resolve_scale_after_crash(ckpt, key, data, deliver):
+    seq = data.get("_seq")
+    try:
+        deliver(key[1], dict(data))
+    except Exception:
+        raise  # entry stays pending for the next pass, by design
+    _journal_resolve(ckpt, "commit", key, seq)  # noqa: F821
+    return "rollforward"

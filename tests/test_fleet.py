@@ -1,0 +1,685 @@
+"""Fleet front door (``serving/router.py`` + ``serving/fleet.py``) —
+the ``make chaos-fleet`` suite.
+
+The acceptance discipline mirrors ``test_handoff.py``: a "crash" is a
+``SimulatedCrash`` injected at a ``scale.*`` fault point (every journal
+boundary the scale-down protocol defines, in both WAL fsync modes), the
+"restart" reconstructs a second daemon from the persisted artifacts only
+(checkpoint reload, ``replay_checkpoint``, one ``DriftReconciler`` pass
+wired with the fleet's scale hooks), and the criteria are: **no lost
+request** (every in-flight row on the drained replica ends served
+exactly once — migrated snapshot, re-queued re-prefill, or finished at
+the source after rollback), **no duplicated serve** (roll-forward past
+the ``migrate`` commit point re-delivers idempotently by snapshot_id),
+**journal empty after resolve**, and — in the engine-level tests —
+every request's greedy tokens BIT-IDENTICAL to a unified engine that
+was never fleeted, through live scale-down, engine death mid-decode,
+and a router restart.
+"""
+
+import pytest
+
+from gpushare_device_plugin_tpu.allocator.assume import AssumeCache
+from gpushare_device_plugin_tpu.allocator.checkpoint import (
+    AllocationCheckpoint,
+    replay_checkpoint,
+)
+from gpushare_device_plugin_tpu.cluster.apiserver import ApiServerClient
+from gpushare_device_plugin_tpu.cluster.podsource import ApiServerPodSource
+from gpushare_device_plugin_tpu.cluster.reconciler import DriftReconciler
+from gpushare_device_plugin_tpu.extender.policy import (
+    PolicyView,
+    resolve as resolve_policy,
+)
+from gpushare_device_plugin_tpu.serving.radix import prefix_fingerprints
+from gpushare_device_plugin_tpu.serving.router import (
+    EngineScrapeClient,
+    FleetMembership,
+    FleetRouter,
+    ScaleExecutor,
+    resolve_scale,
+    scale_key,
+)
+from gpushare_device_plugin_tpu.utils.faults import FAULTS, SimulatedCrash
+from gpushare_device_plugin_tpu.utils.slo import SEVERITY_PAGE, SloBudget
+
+from fake_apiserver import FakeApiServer
+
+NODE = "node-fleet"
+
+# Every boundary the scale-down journal defines, in protocol order;
+# None = the uncrashed control run. ``migrate`` is the commit point.
+SCALE_SITES = [
+    None,
+    "scale.cordon",   # cordon intent durable, replica never closed
+    "scale.drain",    # in-flight rows durable, engine never drained
+    "scale.migrate",  # drained snapshot durable, survivor never
+                      # adopted — the commit point
+    "scale.release",  # migrated, release intent durable, replica
+                      # never decommissioned, WAL entry never resolved
+]
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    FAULTS.clear()
+    yield
+    FAULTS.clear()
+
+
+@pytest.fixture
+def api():
+    srv = FakeApiServer()
+    srv.add_node(NODE)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# jax-free harness: the fleet is host dicts with exactly the side-effect
+# shape serving/fleet.py binds — drain pops rows into a snapshot,
+# migrate adopts idempotently by snapshot_id, requeue re-prefills
+# rid-deduped. The dicts PERSIST across daemon incarnations (the
+# engines outlive the router process; only the router's WAL restarts).
+# ---------------------------------------------------------------------------
+
+
+class FleetState:
+    def __init__(self):
+        self.inflight = {"e0": [{"rid": "r0"}, {"rid": "r1"}], "e1": []}
+        self.routable = {"e0": True, "e1": True}
+        self.served: dict[str, list[str]] = {}
+        self.adopted: set[str] = set()
+
+    def adopt(self, snapshot: dict) -> int:
+        sid = str((snapshot or {}).get("snapshot_id", ""))
+        rows = (snapshot or {}).get("rows") or []
+        if not rows or sid in self.adopted:
+            return 0
+        self.adopted.add(sid)
+        for row in rows:
+            self.served.setdefault(str(row["rid"]), []).append("migrated")
+        return len(rows)
+
+    # --- ScaleExecutor hooks ---------------------------------------------
+
+    def cordon(self, engine: str) -> None:
+        self.routable[engine] = False
+
+    def rows_of(self, engine: str) -> list[dict]:
+        return [dict(r) for r in self.inflight.get(engine, [])]
+
+    def drain(self, engine: str) -> dict:
+        rows = self.inflight.get(engine, [])
+        self.inflight[engine] = []
+        return {
+            "snapshot_id": f"snap-{engine}",
+            "rows": [dict(r) for r in rows],
+        }
+
+    def release(self, engine: str) -> None:
+        self.inflight.pop(engine, None)
+        self.routable.pop(engine, None)
+
+    # --- reconciler hooks -------------------------------------------------
+
+    def deliver(self, scale_id: str, record: dict) -> None:
+        self.adopt(record.get("snapshot") or {})
+        self.release(str(record.get("engine", "")))
+
+    def requeue(self, scale_id: str, record: dict) -> None:
+        engine = str(record.get("engine", ""))
+        if engine in self.routable:
+            self.routable[engine] = True  # replica lives: un-cordon
+            return
+        for row in record.get("rows") or []:
+            rid = str(row["rid"])
+            if rid not in self.served:
+                self.served.setdefault(rid, []).append("requeued")
+
+    # --- terminal accounting ----------------------------------------------
+
+    def finish_sources(self) -> None:
+        """Replicas still holding rows at the end serve them themselves
+        (a rollback re-opened the replica; its queue drains normally)."""
+        for engine in sorted(self.inflight):
+            for row in self.inflight[engine]:
+                rid = str(row["rid"])
+                if rid not in self.served:
+                    self.served.setdefault(rid, []).append("source")
+            self.inflight[engine] = []
+
+    def assert_exactly_once(self, expected: set[str]) -> None:
+        for rid in expected:
+            modes = self.served.get(rid, [])
+            assert len(modes) == 1, (
+                f"request {rid} served {len(modes)} times ({modes}): "
+                f"exactly-once violated (all: {self.served})"
+            )
+
+
+def mk_executor(state, path, mode="always"):
+    ckpt = AllocationCheckpoint(str(path), fsync=mode)
+    assume = AssumeCache()
+    return ckpt, assume, ScaleExecutor(
+        ckpt, assume,
+        cordon_fn=state.cordon,
+        rows_fn=state.rows_of,
+        drain_fn=state.drain,
+        migrate_fn=lambda snap, record: state.adopt(snap),
+        release_fn=state.release,
+        node=NODE,
+    )
+
+
+# ---------------------------------------------------------------------------
+# chaos: SIGKILL at every journal step, both fsync modes
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("mode", ["always", "batch"])
+@pytest.mark.parametrize("site", SCALE_SITES)
+def test_kill_at_every_scale_step(site, mode, api, tmp_path):
+    """The chaos-fleet acceptance: the router daemon dies at each
+    journal boundary of the scale-down; the engines (host dicts here)
+    survive. Restart from the WAL alone and prove the reconciler
+    converges — roll forward at/past ``migrate``, roll back before it,
+    every in-flight request served exactly once across BOTH
+    incarnations, journal empty, a second pass idle."""
+    path = tmp_path / "wal.ckpt"
+    state = FleetState()
+    ckpt1, _a1, ex1 = mk_executor(state, path, mode=mode)
+
+    # --- incarnation 1: dies (or not) mid-scale ---------------------------
+    if site is None:
+        assert ex1.execute("s1", "e0") == "scaled"
+    else:
+        with FAULTS.injected(site, "crash", times=1):
+            with pytest.raises(SimulatedCrash):
+                ex1.execute("s1", "e0")
+        ckpt1.abandon()  # SIGKILL-faithful: no flush, no close
+
+    # --- incarnation 2: restart from the persisted artifacts only ---------
+    client2 = ApiServerClient(api.url)
+    source2 = ApiServerPodSource(client2, NODE)
+    ckpt2 = AllocationCheckpoint(str(path), fsync=mode)
+    assume2 = AssumeCache()
+    n = replay_checkpoint(ckpt2, assume2)
+    key = scale_key("s1")
+    if site is None:
+        assert n == 0
+    else:
+        # the entry replays pending but reserves NOTHING in the chip
+        # ledger: the pending entry itself is the protection
+        assert n == 1
+        assert key in ckpt2.pending()
+        claims, mem, core = assume2.snapshot()
+        assert claims == {} and mem == {} and core == {}
+
+    rec = DriftReconciler(
+        api=client2,
+        pod_source=source2,
+        assume=assume2,
+        checkpoint=ckpt2,
+        node_name=NODE,
+        scale_deliver_fn=state.deliver,
+        scale_requeue_fn=state.requeue,
+    )
+    drift = rec.reconcile_once()
+
+    rolled_forward = site in ("scale.migrate", "scale.release")
+    if site is None:
+        assert drift == {}
+    elif rolled_forward:
+        assert drift.get("scale_rollforward") == 1
+    else:
+        assert drift.get("scale_rollback") == 1
+
+    # exactly-once, by the right path: past the commit point the durable
+    # snapshot migrates (the release site already adopted in incarnation
+    # 1 — re-delivery dedups by snapshot_id); before it the replica
+    # re-opens and finishes its own queue.
+    state.finish_sources()
+    state.assert_exactly_once({"r0", "r1"})
+    modes = sorted(m for v in state.served.values() for m in v)
+    if site in (None, "scale.migrate", "scale.release"):
+        assert modes == ["migrated", "migrated"]
+        assert "e0" not in state.routable, "drained replica not released"
+    else:
+        assert modes == ["source", "source"]
+        assert state.routable.get("e0") is True, "rollback left cordon up"
+
+    # convergence: journal empty, no leaked claim, second pass idle
+    assert ckpt2.pending() == {}
+    claims, mem, core = assume2.snapshot()
+    assert claims == {} and mem == {} and core == {}
+    assert rec.reconcile_once() == {}
+
+
+@pytest.mark.parametrize("site", ["scale.cordon", "scale.drain"])
+def test_rollback_requeues_when_victim_died_too(site, api, tmp_path):
+    """Harder topology: the crash takes the VICTIM replica with it. A
+    pre-commit-point rollback cannot un-cordon a corpse — the journaled
+    rows (durable since the ``drain`` record) re-queue on survivors
+    instead. At the ``cordon`` site the rows were never journaled, and
+    the replica's own queue is gone with it — the entry still resolves,
+    and what was journaled is never double-served."""
+    path = tmp_path / "wal.ckpt"
+    state = FleetState()
+    ckpt1, _a1, _ex1 = mk_executor(state, path)
+    with FAULTS.injected(site, "crash", times=1):
+        with pytest.raises(SimulatedCrash):
+            _ex1.execute("s1", "e0")
+    ckpt1.abandon()
+
+    # the victim dies with the daemon: its queue and state are gone
+    state.inflight.pop("e0", None)
+    state.routable.pop("e0", None)
+
+    client2 = ApiServerClient(api.url)
+    source2 = ApiServerPodSource(client2, NODE)
+    ckpt2 = AllocationCheckpoint(str(path))
+    assume2 = AssumeCache()
+    assert replay_checkpoint(ckpt2, assume2) == 1
+    rec = DriftReconciler(
+        api=client2, pod_source=source2, assume=assume2, checkpoint=ckpt2,
+        node_name=NODE,
+        scale_deliver_fn=state.deliver,
+        scale_requeue_fn=state.requeue,
+    )
+    drift = rec.reconcile_once()
+    assert drift.get("scale_rollback") == 1
+    if site == "scale.drain":
+        # rows were durable: both re-queue on survivors, exactly once
+        state.assert_exactly_once({"r0", "r1"})
+        assert state.served["r0"] == ["requeued"]
+    else:
+        # cordon record carries no rows — nothing journaled to recover,
+        # and nothing is invented or double-served
+        assert state.served == {}
+    assert ckpt2.pending() == {}
+    assert rec.reconcile_once() == {}
+
+
+def test_reconciler_without_fleet_hook_stays_protective(api, tmp_path):
+    """A reconciler wired without the fleet's hooks must leave scale
+    entries pending — resolving blind would delete the journal's only
+    copy of the drained snapshot."""
+    path = tmp_path / "wal.ckpt"
+    state = FleetState()
+    ckpt1, _a1, ex1 = mk_executor(state, path)
+    with FAULTS.injected("scale.migrate", "crash", times=1):
+        with pytest.raises(SimulatedCrash):
+            ex1.execute("s1", "e0")
+    ckpt1.abandon()
+
+    client2 = ApiServerClient(api.url)
+    source2 = ApiServerPodSource(client2, NODE)
+    ckpt2 = AllocationCheckpoint(str(path))
+    assume2 = AssumeCache()
+    replay_checkpoint(ckpt2, assume2)
+    rec = DriftReconciler(
+        api=client2, pod_source=source2, assume=assume2, checkpoint=ckpt2,
+        node_name=NODE,
+    )
+    assert rec.reconcile_once() == {}
+    assert scale_key("s1") in ckpt2.pending()
+    assert state.served == {}
+
+
+def test_resolve_stays_pending_when_delivery_fails(tmp_path):
+    """A roll-forward whose survivor restore fails must NOT commit:
+    committing would delete the journal's only copy of the snapshot."""
+    ckpt = AllocationCheckpoint(str(tmp_path / "wal.ckpt"))
+    assume = AssumeCache()
+    key = scale_key("s1")
+    data = {
+        "kind": "scale", "scale_id": "s1", "engine": "e0",
+        "phase": "migrate",
+        "rows": [{"rid": "r0"}],
+        "snapshot": {"snapshot_id": "snap-e0", "rows": [{"rid": "r0"}]},
+    }
+    seq = ckpt.begin(key, dict(data))
+    data["_seq"] = seq
+
+    def deliver_fails(scale_id, record):
+        raise RuntimeError("no survivor with headroom")
+
+    out = resolve_scale(
+        ckpt, assume, key, data, deliver_fn=deliver_fails,
+    )
+    assert out is None
+    assert key in ckpt.pending()
+
+    # the survivor comes back: the same entry now rolls forward
+    state = FleetState()
+    out = resolve_scale(
+        ckpt, assume, key, data,
+        deliver_fn=state.deliver, requeue_fn=state.requeue,
+    )
+    assert out == "rollforward"
+    assert state.served == {"r0": ["migrated"]}
+    assert ckpt.pending() == {}
+
+
+def test_executor_skips_scale_already_claimed(tmp_path):
+    """A concurrent executor owns the scale id: claim gating turns the
+    duplicate trigger into a no-op instead of a double drain."""
+    state = FleetState()
+    ckpt, assume, ex = mk_executor(state, tmp_path / "wal.ckpt")
+    assert assume.claim(scale_key("s1"))
+    assert ex.execute("s1", "e0") == "skipped"
+    assert state.routable["e0"] is True  # never cordoned
+    assert ckpt.pending() == {}
+
+
+# ---------------------------------------------------------------------------
+# prefix fingerprints: the affinity plane's primitive
+# ---------------------------------------------------------------------------
+
+
+def test_prefix_fingerprints_chain_commits_to_the_path():
+    a = prefix_fingerprints((1, 2, 3, 4, 5, 6, 7, 8), 4)
+    b = prefix_fingerprints((1, 2, 3, 4, 9, 9, 9, 9), 4)
+    assert len(a) == 2 and len(b) == 2
+    # shared first page, diverging second: the chain separates them
+    assert a[0] == b[0]
+    assert a[1] != b[1]
+    # a longer prompt extends the shorter one's chain
+    longer = prefix_fingerprints((1, 2, 3, 4, 5, 6, 7, 8, 1, 1, 1, 1), 4)
+    assert longer[:2] == a
+    # partial trailing pages don't fingerprint
+    assert prefix_fingerprints((1, 2, 3), 4) == []
+    with pytest.raises(ValueError):
+        prefix_fingerprints((1, 2), 0)
+
+
+def test_prefix_affinity_policy_scoring():
+    pol = resolve_policy("prefix-affinity")
+    warm = pol.score(PolicyView(
+        free_units=1, capacity=4, request_units=1, affinity_pages=8,
+    ))
+    cold = pol.score(PolicyView(
+        free_units=3, capacity=4, request_units=1, affinity_pages=0,
+    ))
+    # a saturated-warm replica outranks a roomier cold one: affinity
+    # carries 0.7 of the score
+    assert warm.raw > cold.raw
+    full = pol.score(PolicyView(
+        free_units=0, capacity=4, request_units=1, affinity_pages=8,
+    ))
+    assert full.raw <= 0.0  # infeasible however warm
+
+
+# ---------------------------------------------------------------------------
+# membership: heartbeat, consecutive-miss eviction, stale fallback
+# ---------------------------------------------------------------------------
+
+
+def _flaky_client(fail_flag):
+    def scrape():
+        if fail_flag["down"]:
+            raise RuntimeError("replica unreachable")
+        return {
+            "free_slots": 2, "capacity": 2, "queue_depth": 0,
+            "fingerprints": [11, 22],
+        }
+
+    return EngineScrapeClient(
+        scrape, attempts=1, sleep=lambda s: None, clock=lambda: 0.0,
+    )
+
+
+def test_membership_evicts_after_consecutive_misses():
+    fail = {"down": False}
+    mem = FleetMembership(miss_threshold=2)
+    mem.add("e0", _flaky_client(fail), capacity=2)
+    assert mem.scrape_once() == {"e0": True}
+    assert mem.doc()["replicas"]["e0"]["fingerprints"] == 2
+
+    fail["down"] = True
+    assert mem.scrape_once() == {"e0": False}
+    # one miss: degraded but alive, last-known fingerprints kept (the
+    # router keeps planning affinity on stale-but-recent data)
+    row = mem.doc()["replicas"]["e0"]
+    assert row["state"] == "ready" and row["misses"] == 1
+    assert row["fingerprints"] == 2
+
+    assert mem.scrape_once() == {"e0": False}
+    assert mem.doc()["replicas"]["e0"]["state"] == "dead"
+    # dead replicas are not scraped again
+    assert mem.scrape_once() == {}
+
+
+def test_membership_miss_counter_resets_on_recovery():
+    fail = {"down": True}
+    mem = FleetMembership(miss_threshold=3)
+    mem.add("e0", _flaky_client(fail), capacity=2)
+    mem.scrape_once()
+    mem.scrape_once()
+    assert mem.doc()["replicas"]["e0"]["misses"] == 2
+    fail["down"] = False
+    mem.scrape_once()
+    assert mem.doc()["replicas"]["e0"]["misses"] == 0
+    fail["down"] = True
+    mem.scrape_once()
+    assert mem.doc()["replicas"]["e0"]["state"] == "ready"
+
+
+# ---------------------------------------------------------------------------
+# routing: affinity, balance, overflow, shed, restart seeding
+# ---------------------------------------------------------------------------
+
+
+def _mk_router(caps: dict[str, int], **kw) -> tuple[FleetMembership, FleetRouter]:
+    mem = FleetMembership()
+    for name, cap in caps.items():
+        mem.add(name, None, capacity=cap)
+    return mem, FleetRouter(mem, page_size=4, **kw)
+
+
+def test_route_prefers_warm_replica_and_sticks():
+    mem, router = _mk_router({"a": 4, "b": 4})
+    prompt = (5, 6, 7, 8, 9, 10, 11, 12)
+    d1 = router.route("1", prompt)
+    assert d1.outcome == "balanced" and d1.engine is not None
+    # note_routed credited the pages: the same prefix now has affinity
+    d2 = router.route("2", prompt)
+    assert d2.outcome == "affinity"
+    assert d2.engine == d1.engine
+    assert d2.affinity_pages == 2
+    doc = router.doc()
+    assert doc["outcomes"] == {"affinity": 1, "balanced": 1}
+    assert doc["affinity_hit_ratio"] == 0.5
+
+
+def test_route_overflow_queues_least_loaded_never_drops():
+    mem, router = _mk_router({"a": 0, "b": 0})
+    d = router.route("1", (1, 2, 3, 4))
+    assert d.outcome == "overflow"
+    assert d.engine == "a"  # least loaded, name-tiebroken
+    d2 = router.route("2", (1, 2, 3, 4))
+    assert d2.outcome == "overflow"
+    assert d2.engine == "b"  # "a" now carries the first assignment
+
+
+def test_route_no_replicas_when_all_cordoned():
+    mem, router = _mk_router({"a": 4})
+    mem.cordon("a")
+    d = router.route("1", (1, 2, 3, 4))
+    assert d.engine is None and d.outcome == "no_replicas"
+    mem.uncordon("a")
+    assert router.route("2", (1, 2, 3, 4)).engine == "a"
+
+
+def test_best_effort_sheds_under_burn_rate_page():
+    clock = {"t": 1000.0}
+    budget = SloBudget(clock=lambda: clock["t"])
+    for _ in range(50):
+        budget.record("critical", False)
+    assert budget.severity("critical") == SEVERITY_PAGE
+    mem, router = _mk_router({"a": 4}, slo_budget=budget)
+    shed = router.route("1", (1, 2, 3, 4), tier="best_effort")
+    assert shed.shed and shed.engine is None
+    # critical is NEVER shed — it routes through the same pressure
+    crit = router.route("2", (1, 2, 3, 4), tier="critical")
+    assert crit.engine == "a"
+    assert router.doc()["outcomes"]["shed"] == 1
+
+
+def test_best_effort_sheds_on_queue_depth():
+    mem, router = _mk_router({"a": 1}, shed_queue_depth=1)
+    assert router.route("1", (1, 2, 3, 4), tier="critical").engine == "a"
+    shed = router.route("2", (1, 2, 3, 4), tier="best_effort")
+    assert shed.shed
+    # critical overflows instead of shedding
+    crit = router.route("3", (1, 2, 3, 4), tier="critical")
+    assert crit.outcome == "overflow" and crit.engine == "a"
+
+
+def test_router_restart_seeds_inflight_from_ground_truth():
+    mem, router = _mk_router({"a": 4, "b": 4})
+    router.route("1", (1, 2, 3, 4))
+    table = {"1": "a", "7": "b"}
+    mem2, router2 = _mk_router({"a": 4, "b": 4})
+    router2.seed_inflight(table)
+    assert router2.doc()["inflight"] == 2
+    assert router2.forget_engine("b") == ["7"]
+    router2.complete("1")
+    assert router2.doc()["inflight"] == 0
+
+
+# ---------------------------------------------------------------------------
+# engine-level: tokens bit-identical to a unified engine through live
+# scale-down, engine death, and router restart (slow — `make
+# chaos-fleet` runs them; tier-1 gates the same parity via the fleet
+# bench smoke)
+# ---------------------------------------------------------------------------
+
+
+engine_tests = pytest.mark.slow
+
+EOS = 3
+
+
+@pytest.fixture(scope="module")
+def setup():
+    import jax
+    import jax.numpy as jnp
+
+    from gpushare_device_plugin_tpu.serving import poisson_trace
+    from gpushare_device_plugin_tpu.workloads.transformer import (
+        TransformerConfig,
+        init_params,
+    )
+
+    cfg = TransformerConfig(
+        vocab=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2, d_ff=64,
+        max_seq=64, compute_dtype=jnp.float32,
+    )
+    params = init_params(jax.random.key(0), cfg)
+    reqs = poisson_trace(
+        8, seed=3, rate=0.3, vocab=cfg.vocab, prompt_lens=(2, 10),
+        max_new=[2, 4, 9],
+    )
+    return cfg, params, reqs
+
+
+def _unified_tokens(setup):
+    from gpushare_device_plugin_tpu.serving import PagedSlotEngine
+
+    cfg, params, reqs = setup
+    eng = PagedSlotEngine(
+        params, cfg, slots=4, max_len=32, total_pages=32, page_size=4,
+        prefill_chunk=4, eos_id=EOS,
+    )
+    stats = eng.run(reqs)
+    return {r.rid: list(r.tokens) for r in stats.results}
+
+
+def _mk_fleet(setup, n=2, **kw):
+    from gpushare_device_plugin_tpu.serving import (
+        FleetServer,
+        PagedSlotEngine,
+    )
+
+    cfg, params, _reqs = setup
+    engines = {
+        f"e{i}": PagedSlotEngine(
+            params, cfg, slots=2, max_len=32, total_pages=16, page_size=4,
+            prefill_chunk=4, eos_id=EOS,
+        )
+        for i in range(n)
+    }
+    return FleetServer(engines, node=NODE, **kw)
+
+
+def _assert_parity(fleet, out, setup, *, paths):
+    assert out["dropped"] == []
+    assert out["shed"] == []
+    assert out["double_served"] == []
+    got = {rid: e["tokens"] for rid, e in out["results"].items()}
+    assert got == _unified_tokens(setup), "fleet tokens diverged"
+    seen_paths = {e["path"] for e in out["results"].values()}
+    assert seen_paths <= paths, seen_paths
+    assert out["router"]["inflight"] == 0
+
+
+@engine_tests
+def test_fleet_tokens_match_unified(setup):
+    fleet = _mk_fleet(setup, n=2)
+    out = fleet.serve(setup[2])
+    _assert_parity(fleet, out, setup, paths={"fleet"})
+    # the trace was actually spread: no engine served everything
+    engines_used = {e["engine"] for e in out["results"].values()}
+    assert len(engines_used) > 1
+
+
+@engine_tests
+def test_fleet_scale_down_mid_trace_zero_loss(setup, tmp_path):
+    """A replica drains mid-trace through the journaled protocol: its
+    snapshot restores onto a survivor, tokens bit-identical, zero
+    dropped, journal resolved, the replica gone from the pool."""
+    ckpt = AllocationCheckpoint(str(tmp_path / "wal.ckpt"))
+    fleet = _mk_fleet(setup, n=3, checkpoint=ckpt, assume=AssumeCache())
+    out = fleet.serve(setup[2], scale_down=("e0", 3))
+    _assert_parity(
+        fleet, out, setup, paths={"fleet", "drained", "migrated"},
+    )
+    assert "e0" not in fleet.engines
+    assert fleet.executor.completed_ops == 1
+    assert ckpt.pending() == {}
+    assert out["replicas"]["e0"]["state"] == "dead"
+
+
+@engine_tests
+def test_fleet_engine_death_reprefills_on_survivors(setup):
+    """The victim dies mid-decode — no snapshot survives. The router's
+    in-flight table re-queues every unfinished request as a fresh
+    admission (full re-prefill); greedy determinism keeps the tokens
+    bit-identical, zero dropped."""
+    fleet = _mk_fleet(setup, n=2)
+    out = fleet.serve(setup[2], kill_engine=("e0", 3))
+    _assert_parity(fleet, out, setup, paths={"fleet", "requeued"})
+    assert "e0" not in fleet.engines
+    assert any(
+        e["path"] == "requeued" for e in out["results"].values()
+    ), "the kill drill never exercised re-queue"
+
+
+@engine_tests
+def test_fleet_router_restart_mid_trace(setup):
+    fleet = _mk_fleet(setup, n=2)
+    out = fleet.serve(setup[2], restart_router_after=4)
+    _assert_parity(fleet, out, setup, paths={"fleet"})
+
+
+@engine_tests
+def test_fleet_doc_and_prefix_ratio(setup):
+    fleet = _mk_fleet(setup, n=2)
+    fleet.serve(setup[2])
+    doc = fleet.fleet_doc()
+    assert set(doc["replicas"]) == {"e0", "e1"}
+    assert doc["router"]["policy"] == "prefix-affinity"
+    assert 0.0 <= doc["prefix_hit_ratio"] <= 1.0

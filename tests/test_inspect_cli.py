@@ -991,3 +991,151 @@ def test_render_why_two_tier_group_golden():
         in out
     )
     assert "wal seq 9" in out
+
+
+# --- fleet router: replica map + routing outcomes ---------------------------
+
+FLEET_DOC = {
+    "replicas": {
+        "engine-0": {"state": "ready", "misses": 0, "free_slots": 2,
+                     "capacity": 4, "queue_depth": 1, "fingerprints": 12},
+        "engine-1": {"state": "draining", "misses": 2, "free_slots": 0,
+                     "capacity": 4, "queue_depth": 3, "fingerprints": 7},
+    },
+    "router": {
+        "policy": "prefix-affinity",
+        "outcomes": {"affinity": 5, "balanced": 2, "shed": 1},
+        "inflight": 3,
+        "affinity_hits": 5,
+        "affinity_hit_ratio": 0.7143,
+    },
+    "scale": {"ops": 1, "migrated_requests": 4},
+    "prefix_hit_ratio": 0.4182,
+}
+
+FLEET_GOLDEN = (
+    "fleet — 2 replica(s), policy prefix-affinity, "
+    "global prefix-hit ratio 0.4182\n"
+    "REPLICA   STATE      MISSES  FREE  CAP  QUEUE  PREFIXES\n"
+    "engine-0  ready           0     2    4      1        12\n"
+    "engine-1  draining        2     0    4      3         7\n"
+    "router: affinity=5 balanced=2 shed=1 inflight=3 "
+    "affinity_hit_ratio=0.7143\n"
+    "scale: ops=1 migrated_requests=4\n"
+)
+
+
+def test_render_fleet_golden():
+    from gpushare_device_plugin_tpu.cli.display import render_fleet
+
+    assert render_fleet(FLEET_DOC) == FLEET_GOLDEN
+
+
+def test_render_fleet_empty():
+    from gpushare_device_plugin_tpu.cli.display import render_fleet
+
+    out = render_fleet({"replicas": {}})
+    assert "(no replicas)" in out
+
+
+def test_cli_fleet_end_to_end(capsys):
+    """`inspect fleet --fleet-url` against a real MetricsServer with a
+    fleet document wired in through ``fleet_doc_fn``."""
+    from gpushare_device_plugin_tpu.utils.metrics import MetricsServer
+
+    server = MetricsServer(
+        host="127.0.0.1", fleet_doc_fn=lambda: FLEET_DOC
+    ).start()
+    try:
+        url = f"http://127.0.0.1:{server.port}"
+        rc = inspect_cli.main(["fleet", "--fleet-url", url])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert out == FLEET_GOLDEN
+        rc = inspect_cli.main(["fleet", "--fleet-url", url, "-o", "json"])
+        doc = json.loads(capsys.readouterr().out)
+        assert sorted(doc["replicas"]) == ["engine-0", "engine-1"]
+        assert doc["router"]["policy"] == "prefix-affinity"
+        assert doc["scale"]["migrated_requests"] == 4
+    finally:
+        server.stop()
+
+
+def test_cli_fleet_requires_url(capsys):
+    rc = inspect_cli.main(["fleet"])
+    assert rc == 1
+    assert "--fleet-url" in capsys.readouterr().err
+
+
+def test_fetch_fleet_merges_replica_rows():
+    """Two router replicas fronting overlapping engine pools merge by
+    replica name; router/scale rollups come from the first reachable
+    endpoint (they are fleet-global, not additive)."""
+    from gpushare_device_plugin_tpu.utils.metrics import MetricsServer
+
+    other = {
+        "replicas": {
+            "engine-1": {"state": "ready", "misses": 0, "free_slots": 4,
+                         "capacity": 4, "queue_depth": 0,
+                         "fingerprints": 0},
+            "engine-2": {"state": "ready", "misses": 0, "free_slots": 4,
+                         "capacity": 4, "queue_depth": 0,
+                         "fingerprints": 0},
+        },
+        "router": {"policy": "spread", "outcomes": {}, "inflight": 0},
+        "scale": {"ops": 0, "migrated_requests": 0},
+        "prefix_hit_ratio": 0.0,
+    }
+    s1 = MetricsServer(host="127.0.0.1", fleet_doc_fn=lambda: FLEET_DOC)
+    s2 = MetricsServer(host="127.0.0.1", fleet_doc_fn=lambda: other)
+    s1.start()
+    s2.start()
+    try:
+        urls = [
+            f"http://127.0.0.1:{s1.port}", f"http://127.0.0.1:{s2.port}",
+        ]
+        doc = inspect_cli.fetch_fleet(urls)
+        assert sorted(doc["replicas"]) == [
+            "engine-0", "engine-1", "engine-2",
+        ]
+        # later endpoint wins the overlapping replica row
+        assert doc["replicas"]["engine-1"]["state"] == "ready"
+        # rollups come from the FIRST endpoint
+        assert doc["router"]["policy"] == "prefix-affinity"
+        assert doc["prefix_hit_ratio"] == 0.4182
+    finally:
+        s1.stop()
+        s2.stop()
+
+
+def test_parse_engine_metrics_folds_fleet_router_families():
+    """The ``tpushare_fleet_*`` / ``tpushare_router_*`` families fold
+    into the same per-pod row the engine families land in."""
+    from gpushare_device_plugin_tpu.utils import metric_catalog as mc
+    from gpushare_device_plugin_tpu.utils.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    labels = {"pod": "default/router-0"}
+    reg.gauge_set(mc.FLEET_REPLICAS, 2.0, "replicas by state",
+                  state="ready", **labels)
+    reg.gauge_set(mc.FLEET_REPLICAS, 1.0, "replicas by state",
+                  state="dead", **labels)
+    reg.counter_inc(mc.FLEET_SCALE_OPS_TOTAL, "scale ops", value=1.0,
+                    outcome="scaled", **labels)
+    reg.counter_inc(mc.FLEET_DRAIN_MIGRATED_REQUESTS_TOTAL, "migrated",
+                    value=4.0, **labels)
+    reg.counter_inc(mc.ROUTER_ROUTED_TOTAL, "routed", value=5.0,
+                    engine="e0", outcome="affinity", **labels)
+    reg.counter_inc(mc.ROUTER_SHED_TOTAL, "shed", value=1.0,
+                    tier="best_effort", **labels)
+    reg.counter_inc(mc.ROUTER_PREFIX_AFFINITY_HITS_TOTAL, "hits",
+                    value=5.0, **labels)
+    rows = inspect_cli.parse_engine_metrics(reg.render())
+    row = rows["default/router-0"]
+    assert row["fleet_replicas_ready"] == 2.0
+    assert row["fleet_replicas_dead"] == 1.0
+    assert row["fleet_scale_ops_total_scaled"] == 1.0
+    assert row["fleet_drain_migrated_requests_total"] == 4.0
+    assert row["router_routed_total_affinity_e0"] == 5.0
+    assert row["router_shed_total_best_effort"] == 1.0
+    assert row["router_prefix_affinity_hits_total"] == 5.0

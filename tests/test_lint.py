@@ -155,6 +155,25 @@ def test_wal_rule_accepts_handoff_mover_shape():
     assert _rules([mod], "wal-protocol") == []
 
 
+def test_wal_rule_flags_scale_begin_shapes():
+    """The fleet scale-down journal's begin form (``_journal_scale``,
+    serving/router.py) carries the same domination obligation as a plain
+    ``begin`` — a drain left pending on a live path, or a swallowed
+    migrate failure, would re-deliver the snapshot on every reconciler
+    pass forever."""
+    mod = _fixture("wal_scale_bad.py", PKG + "wal_scale_bad.py")
+    found = _rules([mod], "wal-protocol")
+    assert len(found) == 2, found
+    messages = " | ".join(f.message for f in found)
+    assert "return without" in messages
+    assert "swallow" in messages
+
+
+def test_wal_rule_accepts_scale_executor_shape():
+    mod = _fixture("wal_scale_ok.py", PKG + "wal_scale_ok.py")
+    assert _rules([mod], "wal-protocol") == []
+
+
 # --- span leak --------------------------------------------------------------
 
 
@@ -188,6 +207,23 @@ def test_decision_rule_flags_all_bad_shapes():
 
 def test_decision_rule_accepts_canonical_shapes():
     mod = _fixture("decision_ok.py", PKG + "decision_ok.py")
+    assert _rules([mod], "decision-outcome") == []
+
+
+def test_decision_rule_flags_router_verb_holes():
+    """The fleet router's verbs (``fleet_route``/``fleet_shed``) are
+    admission verbs: a shed with no record, or an empty-fleet path that
+    completes silently, is a provenance hole the rule must flag."""
+    mod = _fixture("decision_route_bad.py", PKG + "decision_route_bad.py")
+    found = _rules([mod], "decision-outcome")
+    assert len(found) == 2, found
+    names = " | ".join(f.message for f in found)
+    assert "bad_shed_without_record" in names
+    assert "bad_no_replicas_fallthrough" in names
+
+
+def test_decision_rule_accepts_router_funnel_shapes():
+    mod = _fixture("decision_route_ok.py", PKG + "decision_route_ok.py")
     assert _rules([mod], "decision-outcome") == []
 
 

@@ -25,9 +25,11 @@ Recognized begin/resolve forms: calls through a checkpoint-hinted
 receiver (``self._ckpt.begin(...)``, ``ckpt.abort(...)``) and the
 thin module delegation helpers — ``_journal_begin``/``_journal_resolve``
 on the admission path, ``_journal_phase``/``_journal_resolve`` on the
-defragmentation move path (record kind ``"move"``), and
+defragmentation move path (record kind ``"move"``),
 ``_journal_handoff``/``_journal_resolve`` on the prefill/decode
-KV-handoff path (record kind ``"handoff"``, serving/handoffproto.py).
+KV-handoff path (record kind ``"handoff"``, serving/handoffproto.py),
+and ``_journal_scale``/``_journal_resolve`` on the fleet scale-down
+drain path (record kind ``"scale"``, serving/router.py).
 The phase-style helpers journal a fresh begin for their protocol key at
 every phase, so every call site carries the same domination obligation
 a plain ``begin`` does.
@@ -40,7 +42,9 @@ import ast
 from .engine import Finding, Module
 
 CKPT_RECEIVERS = ("_ckpt", "ckpt", "checkpoint", "_checkpoint")
-BEGIN_HELPERS = ("_journal_begin", "_journal_phase", "_journal_handoff")
+BEGIN_HELPERS = (
+    "_journal_begin", "_journal_phase", "_journal_handoff", "_journal_scale",
+)
 RESOLVE_HELPERS = ("_journal_resolve",)
 # Cross-shard two-phase "gang2pc" records (extender/shards.py) have a
 # DIFFERENT obligation than ordinary begins: a prepare legitimately

@@ -1,4 +1,4 @@
-"""Small-model harnesses for the three journaled protocols.
+"""Small-model harnesses for the journaled protocols.
 
 Each model builds a FRESH harness per schedule (under the mc_session, so
 every lock in the object graph is cooperative) and checks the repo's
@@ -36,6 +36,17 @@ standing invariants at the terminal state:
   once (KV import or re-prefill — never lost, never duplicated), the
   page pool drains to fully free, no pending handoff entry after
   resolve.
+- **scale** — one :class:`ScaleExecutor` drains a fleet replica through
+  the journaled cordon→drain→migrate→release protocol
+  (``serving/router.py``) while a rival executor races the same scale
+  id (claim gating) and a reconciler pass interleaves;
+  ``scale-crash`` seeds pre-crashed entries a dead incarnation left in
+  ``drain`` (rolls back: journaled rows re-queued on survivors) and
+  ``migrate`` (rolls forward: the drained snapshot re-delivered).
+  Invariants: every in-flight request on the drained replica is served
+  exactly once (migrated or re-queued — never lost, never duplicated),
+  the replica ends closed to routes, no pending scale entry after
+  resolve, no leaked claim.
 - **racy-counter** / **indep-workers** — toy models for the explorer's
   own tests: a classic read-modify-write race (found at k>=1), and a
   mostly-independent workload where sleep-set POR must prune schedules
@@ -75,8 +86,14 @@ from gpushare_device_plugin_tpu.serving.handoffproto import (
     resolve_handoff,
 )
 from gpushare_device_plugin_tpu.serving.pages import PageAllocator
+from gpushare_device_plugin_tpu.serving.router import (
+    ScaleExecutor,
+    resolve_scale,
+    scale_key,
+)
 from gpushare_device_plugin_tpu.utils.circuit import CircuitBreaker
 from gpushare_device_plugin_tpu.utils.faults import FAULTS
+from gpushare_device_plugin_tpu.utils.metrics import MetricsRegistry
 
 from .memwal import MemJournal
 from .sched import InvariantViolation, mc_step
@@ -949,6 +966,168 @@ class HandoffModel:
 
 
 # ---------------------------------------------------------------------------
+# fleet scale-down protocol
+# ---------------------------------------------------------------------------
+
+
+class ScaleModel:
+    """The journaled fleet scale-down protocol (cordon → drain →
+    migrate → release, ``serving/router.py``): one
+    :class:`ScaleExecutor` drains a replica onto a survivor while a
+    rival executor races the same scale id and a reconciler pass
+    interleaves. All real protocol code — :class:`ScaleExecutor`,
+    :func:`resolve_scale` — over the in-memory journal; only the fleet
+    binding is simulated (drain = pop rows into a snapshot, migrate =
+    idempotent adopt by snapshot_id, requeue = rid-deduped re-prefill),
+    exactly the side-effect shape ``serving/fleet.py`` provides.
+
+    The crash variant seeds pre-crashed journal entries a dead
+    incarnation left behind: one in ``drain`` on a replica that no
+    longer exists (rolls back — the journaled rows re-queue on
+    survivors) and one in ``migrate`` (rolls forward — the drained
+    snapshot re-delivers, idempotently)."""
+
+    def __init__(self, crashed: bool = False) -> None:
+        self.name = "scale-crash" if crashed else "scale"
+        self.crashed = crashed
+
+    def build(self) -> Harness:
+        assume = AssumeCache()
+        ckpt = MemJournal()
+        registry = MetricsRegistry()
+        # the simulated fleet: per-replica frozen in-flight rows, and
+        # which replicas are open to new routes
+        inflight: dict[str, list[dict]] = {
+            "e0": [{"rid": "r0"}, {"rid": "r1"}],
+            "e1": [],
+        }
+        routable: dict[str, bool] = {"e0": True, "e1": True}
+        served: dict[str, list[str]] = {}
+        adopted: set[str] = set()
+        expected = {"r0", "r1"}
+
+        def adopt(snapshot: dict) -> int:
+            # the survivor's restore: idempotent by snapshot_id, exactly
+            # PagedSlotEngine.restore_snapshot's dedup contract
+            sid = str(snapshot.get("snapshot_id", ""))
+            rows = snapshot.get("rows") or []
+            if not rows or sid in adopted:
+                return 0
+            adopted.add(sid)
+            for row in rows:
+                served.setdefault(str(row["rid"]), []).append("migrated")
+            return len(rows)
+
+        def cordon(engine: str) -> None:
+            routable[engine] = False
+
+        def rows_of(engine: str) -> list[dict]:
+            return [dict(r) for r in inflight.get(engine, [])]
+
+        def drain(engine: str) -> dict:
+            rows = inflight.get(engine, [])
+            inflight[engine] = []
+            return {
+                "snapshot_id": f"snap-{engine}",
+                "rows": [dict(r) for r in rows],
+            }
+
+        def release(engine: str) -> None:
+            inflight.pop(engine, None)
+            routable.pop(engine, None)
+
+        executor = ScaleExecutor(
+            ckpt, assume,
+            cordon_fn=cordon, rows_fn=rows_of, drain_fn=drain,
+            migrate_fn=lambda snap, record: adopt(snap),
+            release_fn=release, node="mc", registry=registry,
+        )
+
+        def deliver(scale_id: str, record: dict) -> None:
+            adopt(record.get("snapshot") or {})
+            release(str(record.get("engine", "")))
+
+        def requeue(scale_id: str, record: dict) -> None:
+            engine = str(record.get("engine", ""))
+            if engine in routable:
+                routable[engine] = True  # replica lives: just un-cordon
+                return
+            for row in record.get("rows") or []:
+                rid = str(row["rid"])
+                if rid not in served:  # rid-deduped, as in the fleet
+                    served.setdefault(rid, []).append("requeued")
+
+        def reconcile_pass() -> None:
+            for key, data in ckpt.pending().items():
+                if data.get("kind") != "scale":
+                    continue
+                if assume.is_claimed(key):
+                    continue  # a live executor owns it
+                resolve_scale(
+                    ckpt, assume, key, data,
+                    deliver_fn=deliver, requeue_fn=requeue,
+                )
+
+        def run_exec() -> None:
+            executor.execute("s1", "e0")
+
+        if self.crashed:
+            # pre-crash state without claims — exactly what restart
+            # recovery sees: sc1 died in "drain" on a replica that is
+            # gone (rolls back: rows re-queue), sc2 died in "migrate"
+            # (rolls forward: snapshot re-delivers)
+            expected.update({"rc1", "rc2"})
+            ckpt.begin(scale_key("sc1"), {
+                "kind": "scale", "scale_id": "sc1", "engine": "gone",
+                "node": "dead", "phase": "drain",
+                "rows": [{"rid": "rc1"}],
+            })
+            ckpt.begin(scale_key("sc2"), {
+                "kind": "scale", "scale_id": "sc2", "engine": "e9",
+                "node": "dead", "phase": "migrate",
+                "rows": [{"rid": "rc2"}],
+                "snapshot": {"snapshot_id": "snap-e9",
+                             "rows": [{"rid": "rc2"}]},
+            })
+            tasks = [
+                ("executor", run_exec),
+                ("reconciler", reconcile_pass),
+            ]
+        else:
+            tasks = [
+                ("executor", run_exec),
+                ("rival", run_exec),
+                ("reconciler", reconcile_pass),
+            ]
+
+        def check() -> None:
+            reconcile_pass()
+            if ckpt.pending():
+                raise InvariantViolation(
+                    f"pending scale entries after resolve: {ckpt.pending()}"
+                )
+            for rid in expected:
+                modes = served.get(rid, [])
+                if len(modes) != 1:
+                    raise InvariantViolation(
+                        f"request {rid} served {len(modes)} times "
+                        f"({modes}): exactly-once violated (all: {served})"
+                    )
+            if routable.get("e0"):
+                raise InvariantViolation(
+                    "drained replica still open to routes at terminal "
+                    f"state: {routable}"
+                )
+            claims, mem, core = assume.snapshot()
+            if claims or mem or core:
+                raise InvariantViolation(
+                    f"ledger not drained: claims={claims} mem={mem}"
+                )
+
+        return Harness(tasks, check)
+
+
+# ---------------------------------------------------------------------------
 # registry
 # ---------------------------------------------------------------------------
 
@@ -967,6 +1146,8 @@ MODELS: dict[str, Callable[[], Any]] = {
     "move-reconciler": lambda: MoveModel(with_reconciler=True),
     "handoff": HandoffModel,
     "handoff-crash": lambda: HandoffModel(crashed=True),
+    "scale": ScaleModel,
+    "scale-crash": lambda: ScaleModel(crashed=True),
 }
 
 
@@ -992,6 +1173,8 @@ SMOKE_SUITE: tuple[tuple[str, int | None], ...] = (
     ("move-reconciler", 1),
     ("handoff", 1),
     ("handoff-crash", 2),
+    ("scale", 1),
+    ("scale-crash", 2),
 )
 
 FULL_SUITE: tuple[tuple[str, int | None], ...] = (
@@ -1002,4 +1185,6 @@ FULL_SUITE: tuple[tuple[str, int | None], ...] = (
     ("move-reconciler", 2),
     ("handoff", 2),
     ("handoff-crash", 2),
+    ("scale", 2),
+    ("scale-crash", 2),
 )
