@@ -1,7 +1,7 @@
 PY ?= python
 PROTOC ?= protoc
 
-.PHONY: proto native test test-fast test-slow test-stress chaos chaos-restart chaos-move chaos-shard chaos-handoff chaos-fleet mc mc-smoke lint lint-strict typecheck bench bench-smoke bench-serve-smoke bench-multichip-smoke bench-paged-smoke bench-defrag-smoke bench-interference-smoke bench-disagg-smoke bench-spec-smoke bench-fleet-smoke bench-scale bench-scale-smoke bench-wal bench-trace bench-decisions trace-smoke decisions-smoke e2e-kind
+.PHONY: proto native test test-fast test-slow test-stress chaos chaos-restart chaos-move chaos-shard chaos-handoff chaos-fleet mc mc-smoke lint lint-strict typecheck bench bench-smoke bench-serve-smoke bench-multichip-smoke bench-paged-smoke bench-defrag-smoke bench-interference-smoke bench-disagg-smoke bench-spec-smoke bench-fleet-smoke bench-lora-smoke bench-scale bench-scale-smoke bench-wal bench-trace bench-decisions trace-smoke decisions-smoke e2e-kind
 
 # Regenerate protobuf message classes (gRPC bindings are hand-written in
 # gpushare_device_plugin_tpu/plugin/api/api_grpc.py; grpc_tools is not
@@ -250,6 +250,16 @@ bench-spec-smoke:
 # tests/test_bench_fleet_smoke.py. See docs/serving.md.
 bench-fleet-smoke:
 	$(PY) bench_mfu.py --fleet-smoke
+
+# Multi-LoRA CPU smoke: ONLY the serve_lora section — one paged-engine
+# plan with the adapter slab charged to the budget, a shared-prefix
+# Poisson trace run with N distinct adapters vs the same trace on one
+# adapter. Hard gates even in smoke: tokens bit-identical to merge_lora
+# + solo generate, zero retraces, a live adapter hit/miss ledger, a
+# populated miss-stall histogram, and closed budget accounting. Tier-1
+# runs it via tests/test_bench_lora_smoke.py. See docs/serving.md.
+bench-lora-smoke:
+	$(PY) bench_mfu.py --lora-smoke
 
 # Group-commit WAL A/B: the 16-way admission storm with the journal in
 # per-record-fsync ('always') then group-commit ('batch') mode. Reports

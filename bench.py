@@ -1414,6 +1414,35 @@ def fleet_prefix_guard(ratio: float | None, repo: Path) -> str | None:
     )
 
 
+def lora_goodput_guard(tokens_s: float | None, repo: Path) -> str | None:
+    """Failure message when the multi-LoRA engine's N-adapter goodput
+    (``lora_goodput_tokens_per_s``, the serve_lora section) dropped
+    >P99_GUARD_PCT below the newest committed record carrying it; None
+    when within budget or no history. Lower is worse (throughput). The
+    bit-identity / zero-retrace / >=0.9x-of-one-adapter bars hard-gate
+    inside bench_mfu itself; this guards the trend — a dispatch change
+    that still passes parity but serves heterogeneous tenants slower
+    than it used to is a regression."""
+    return _pct_trend_guard(
+        tokens_s, repo, field="lora_goodput_tokens_per_s",
+        label="lora goodput", fmt=".1f", unit=" tokens/s",
+        lower_is_worse=True,
+    )
+
+
+def adapter_hit_guard(ratio: float | None, repo: Path) -> str | None:
+    """Same budget for the adapter admission hit ratio
+    (``adapter_hit_ratio``): load-on-admission prefetch plus LRU
+    residency exist to make repeat tenants hits — a cache-policy change
+    that still serves correctly but re-loads adapters it used to keep
+    resident is a regression even while every hard gate passes."""
+    return _pct_trend_guard(
+        ratio, repo, field="adapter_hit_ratio",
+        label="adapter hit ratio", fmt=".4f", unit="",
+        lower_is_worse=True,
+    )
+
+
 def interference_guard(pct: float | None, repo: Path) -> str | None:
     """Failure message when the interference bench's governor-OFF p99
     inflation (``interference_p99_inflation_pct``) DROPPED >25% vs the
@@ -2055,6 +2084,15 @@ def main(argv=None) -> int:
         .get("fleet_goodput_tokens_per_s"),
         "fleet_prefix_hit_ratio": compute.get("serve_fleet", {})
         .get("fleet_prefix_hit_ratio"),
+        # Multi-LoRA numbers (serve_lora section), hoisted for the trend
+        # guards: N-adapter goodput at equal HBM and the adapter
+        # admission hit ratio (the bit-identity / zero-retrace /
+        # >=0.9x-of-one-adapter invariants hard-gate inside bench_mfu
+        # itself).
+        "lora_goodput_tokens_per_s": compute.get("serve_lora", {})
+        .get("lora_goodput_tokens_per_s"),
+        "adapter_hit_ratio": compute.get("serve_lora", {})
+        .get("adapter_hit_ratio"),
         # Interference bench numbers (serve_interference section),
         # hoisted for the trend guard: the governor-OFF inflation is the
         # scenario's signal strength (the governed/overhead bounds hard-
@@ -2117,6 +2155,10 @@ def main(argv=None) -> int:
             record["fleet_goodput_tokens_per_s"], repo
         ))
         msgs.append(fleet_prefix_guard(record["fleet_prefix_hit_ratio"], repo))
+        msgs.append(lora_goodput_guard(
+            record["lora_goodput_tokens_per_s"], repo
+        ))
+        msgs.append(adapter_hit_guard(record["adapter_hit_ratio"], repo))
         msgs.append(gang_storm_guard(record["gang_throughput_gangs_s"], repo))
         msgs.append(defrag_stranded_guard(record["defrag_stranded_after_pct"], repo))
         msgs.append(defrag_binpack_guard(record["defrag_binpack_after_pct"], repo))

@@ -1851,6 +1851,234 @@ def bench_serve_spec(report: dict, smoke: bool = False) -> None:
         )
 
 
+def bench_serve_lora(report: dict, smoke: bool = False) -> None:
+    """Multi-tenant multi-LoRA serving inside the paged engine: the SAME
+    engine plan (sized by ``paged_plan_for_slice(..., lora=True)``, so
+    the adapter slab is charged against the ``aliyun.com/tpu-mem``
+    budget) runs one shared-prefix Poisson trace twice — once with every
+    request tagged one of N distinct adapters, once with every request
+    tagged the SAME adapter. Equal HBM by construction; the only
+    difference is adapter heterogeneity, which the gathered BGMV
+    dispatch must absorb as page-table DATA (``serving/adapters.py``,
+    ``workloads/generate.py:lora_bgmv_views``).
+
+    Hard gates (smoke included): per-request tokens BIT-IDENTICAL to
+    ``merge_lora`` + solo generate for that request's adapter (the
+    whole point — paged gather-BGMV is an exact rewrite of the merged
+    matmul), zero retraces across both runs (adapter identity is never
+    a shape), a populated adapter-miss stall histogram and a non-vacuous
+    hit/miss ledger (the AdapterCache actually cycled), and the budget
+    accounting closed (weights + pool incl. slab <= budget * headroom).
+    The full TPU run additionally gates N-adapter goodput >= 0.9x
+    same-adapter goodput — heterogeneity must not fragment the batch.
+    The row's ``lora_goodput_tokens_per_s`` / ``adapter_hit_ratio``
+    feed bench.py's 25% trend guards.
+    """
+    import dataclasses as _dc
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from gpushare_device_plugin_tpu.serving import (
+        TIER_BEST_EFFORT,
+        TIER_CRITICAL,
+        PagedSlotEngine,
+        kv_slot_bytes,
+        paged_plan_for_slice,
+        shared_prefix_trace,
+    )
+    from gpushare_device_plugin_tpu.utils.metric_catalog import (
+        ENGINE_ADAPTER_MISS_STALL_SECONDS,
+    )
+    from gpushare_device_plugin_tpu.utils.metrics import REGISTRY
+    from gpushare_device_plugin_tpu.workloads import generate as G
+    from gpushare_device_plugin_tpu.workloads.lora import (
+        LoraConfig,
+        init_lora,
+        lora_flat_len,
+        merge_lora,
+    )
+    from gpushare_device_plugin_tpu.workloads.transformer import (
+        TransformerConfig,
+        init_params,
+    )
+
+    if smoke:
+        cfg = TransformerConfig(
+            vocab=128, d_model=256, n_layers=2, n_heads=4, n_kv_heads=2,
+            d_ff=512, max_seq=128, compute_dtype=jnp.float32,
+        )
+        max_len, chunk, page = 64, 8, 8
+        n_req, n_adapters, rate = 16, 8, 2.0
+        pre, tails, mix = (2, 8), (1, 4), (16, 24, 32)
+        lcfg = LoraConfig(rank=4, alpha=8.0)
+        params = init_params(jax.random.key(0), cfg)
+        verify_n = n_req
+    else:
+        cfg = _bench_cfg(smoke)
+        max_len, chunk, page = 1024, 256, 64
+        n_req, n_adapters, rate = 150, 100, 4.0
+        pre, tails, mix = (3, 128), (8, 64), (64, 128, 192)
+        lcfg = LoraConfig(rank=8, alpha=16.0)
+        params = init_params(jax.random.key(0), cfg)
+        verify_n = 8
+
+    def rand_lora(seed: int):
+        # init_lora zeroes ``b`` (merged model starts at base), which
+        # would make every adapter a no-op; randomize the whole tree so
+        # each tenant's deltas are distinct and nonzero.
+        tree = init_lora(jax.random.key(seed), cfg, lcfg)
+        return jax.tree_util.tree_map(
+            lambda x: jax.random.normal(
+                jax.random.key(seed + 10_000), x.shape, x.dtype
+            ) * 0.02,
+            tree,
+        )
+
+    ids = [f"t{i:03d}" for i in range(n_adapters)]
+    store = {aid: rand_lora(i) for i, aid in enumerate(ids)}
+    weight_bytes = sum(
+        x.size * x.dtype.itemsize for x in jax.tree_util.tree_leaves(params)
+    )
+    headroom = 0.90
+    page_b = kv_slot_bytes(cfg, page)
+    apage_b = page * cfg.d_model * 4
+    pages_per_row = -(-max_len // page)
+    a_pages = max(1, -(-lora_flat_len(cfg, lcfg) // (page * cfg.d_model)))
+    # ~8 KV rows plus ~5 resident adapters at the combined (KV + slab)
+    # per-page cost: enough concurrency to batch heterogeneous tenants,
+    # small enough that N distinct adapters churn the cache (the LRU /
+    # eviction counters must not be vacuous).
+    budget = int(
+        (weight_bytes + (8 * pages_per_row + 5 * a_pages)
+         * (page_b + apage_b)) / headroom
+    )
+    plan = paged_plan_for_slice(
+        budget, cfg, max_len, page_size=page, prefill_chunk=chunk,
+        weight_bytes=weight_bytes, lora=True,
+    )
+    tiers = [
+        (TIER_CRITICAL, 0.5, 40.0, 4.0),
+        (TIER_BEST_EFFORT, 0.5, None, None),
+    ]
+    multi_reqs = shared_prefix_trace(
+        n_req, seed=31, rate=rate, vocab=cfg.vocab, prefixes=pre,
+        tail_lens=tails, max_new=list(mix), tiers=tiers, adapters=ids,
+    )
+    # Same trace, every request on ONE adapter: prompts, arrivals, and
+    # lengths identical — the only variable is adapter heterogeneity.
+    single_reqs = [
+        _dc.replace(r, adapter_id=ids[0]) for r in multi_reqs
+    ]
+
+    def run_engine(reqs):
+        eng = PagedSlotEngine(
+            params, cfg, slots=plan.slots, max_len=max_len,
+            total_pages=plan.total_pages, page_size=page,
+            prefill_chunk=chunk, lora_store=store, lora_cfg=lcfg,
+        )
+        eng.warmup()
+        warm = dict(eng.trace_counts)
+        stats = eng.run(reqs)
+        retraces = sum(eng.trace_counts[k] - warm[k] for k in warm)
+        return eng, stats, retraces
+
+    single_eng, single_stats, single_retraces = run_engine(single_reqs)
+    multi_eng, multi_stats, multi_retraces = run_engine(multi_reqs)
+
+    # -- bit-identity vs merge_lora + solo generate ---------------------
+    by_rid = {r.rid: r for r in multi_reqs}
+    gens: dict[int, object] = {}
+    mismatch = []
+    for res in sorted(multi_stats.results, key=lambda r: r.rid)[:verify_n]:
+        req = by_rid[res.rid]
+        merged = merge_lora(params, store[req.adapter_id], lcfg)
+        gen = gens.setdefault(
+            req.max_new, G.make_generate(cfg, max_new=req.max_new, padded=True)
+        )
+        ref = np.asarray(gen(
+            merged, jnp.asarray([list(req.prompt)], jnp.int32),
+            jnp.asarray([len(req.prompt)], jnp.int32), jax.random.key(0),
+        ))[0][:req.max_new]
+        if list(res.tokens) != [int(x) for x in ref]:
+            mismatch.append(res.rid)
+
+    multi_eng.publish_metrics()
+    stall_count = 0.0
+    for line in REGISTRY.render().splitlines():
+        if line.startswith(f"{ENGINE_ADAPTER_MISS_STALL_SECONDS}_count"):
+            stall_count = float(line.rsplit(None, 1)[1])
+    ainfo = multi_stats.engine_cache["adapters"]
+    m_sum, s_sum = multi_stats.summary(), single_stats.summary()
+    multi_tps = m_sum["goodput_tokens_per_s"] or 0.0
+    single_tps = s_sum["goodput_tokens_per_s"] or 0.0
+    row = {
+        "budget_bytes": budget,
+        "weight_bytes": weight_bytes,
+        "page_size": page,
+        "requests": n_req,
+        "n_adapters": n_adapters,
+        "pages_per_adapter": a_pages,
+        "plan": {
+            "slots": plan.slots, "pages": plan.total_pages,
+            "adapter_page_bytes": plan.adapter_page_bytes,
+            "adapter_bytes": plan.adapter_bytes,
+        },
+        "multi": m_sum,
+        "single": s_sum,
+        "retraces": single_retraces + multi_retraces,
+        "verified_requests": verify_n,
+        "adapter_hits": ainfo["hits"],
+        "adapter_misses": ainfo["misses"],
+        "adapter_evictions": ainfo["evictions"],
+        "adapter_hit_ratio": round(ainfo["hit_ratio"], 4),
+        "miss_stall_observations": stall_count,
+        "lora_goodput_tokens_per_s": multi_tps,
+        "single_goodput_tokens_per_s": single_tps,
+        "goodput_ratio": round(multi_tps / max(single_tps, 1e-9), 3),
+    }
+    report["serve_lora"] = row
+    print(f"serve_lora {row}", file=sys.stderr)
+    if mismatch:
+        raise AssertionError(
+            f"multi-LoRA engine diverged from merge_lora + solo generate "
+            f"on requests {mismatch[:5]} — the gathered BGMV dispatch "
+            "must be an exact rewrite of the merged matmul"
+        )
+    if row["retraces"]:
+        raise AssertionError(
+            f"{row['retraces']} retraces across the two runs — adapter "
+            "identity is page-table data, never a shape; a batch mixing "
+            f"{n_adapters} adapters must reuse the same compiled programs"
+        )
+    if ainfo["misses"] < 1 or (ainfo["hits"] + ainfo["misses"]) < 2:
+        raise AssertionError(
+            f"adapter ledger vacuous (hits={ainfo['hits']}, "
+            f"misses={ainfo['misses']}) — the cache never cycled and the "
+            "comparison proves nothing"
+        )
+    if stall_count < 1:
+        raise AssertionError(
+            "adapter-miss stall histogram empty after "
+            f"{ainfo['misses']} misses — load stalls must be observed "
+            "(bench.py trend-guards the mean)"
+        )
+    if weight_bytes + plan.pool_bytes > int(budget * headroom):
+        raise AssertionError(
+            f"lora plan oversubscribes the slice: weights+pool "
+            f"{weight_bytes + plan.pool_bytes} > {int(budget * headroom)} "
+            f"usable of the {budget}-byte budget — the adapter slab must "
+            "be charged against the same aliyun.com/tpu-mem slice"
+        )
+    if not smoke and multi_tps < 0.9 * single_tps:
+        raise AssertionError(
+            f"{n_adapters}-adapter goodput {multi_tps} < 0.9x same-"
+            f"adapter goodput {single_tps} at equal HBM — heterogeneous "
+            "adapters must not fragment the continuous batch"
+        )
+
+
 def bench_serve_fleet(report: dict, smoke: bool = False) -> None:
     """The fleet front door: a shared-prefix Poisson trace routed across
     N small paged engines by the prefix-affinity router
@@ -2200,6 +2428,17 @@ def parse_args(argv: list[str] | None = None) -> argparse.Namespace:
         "tests/test_bench_fleet_smoke.py)",
     )
     p.add_argument(
+        "--lora-smoke", action="store_true",
+        help="CPU multi-LoRA smoke: ONLY the serve_lora section (one "
+        "paged-engine plan with the adapter slab charged to the budget, "
+        "a shared-prefix Poisson trace run with N distinct adapters vs "
+        "the same trace on one adapter; hard-fails on token divergence "
+        "from merge_lora + solo generate, retraces, a vacuous adapter "
+        "hit/miss ledger, an empty miss-stall histogram, or an "
+        "oversubscribed budget) (make bench-lora-smoke; tier-1 via "
+        "tests/test_bench_lora_smoke.py)",
+    )
+    p.add_argument(
         "--backend-init-timeout", type=float, default=60.0,
         help="seconds the subprocess backend-init probe may take before "
         "the run is skipped with an explicit reason (the old in-process "
@@ -2214,6 +2453,7 @@ def main(argv: list[str] | None = None) -> int:
         args.smoke or args.serve_smoke or args.multichip_smoke
         or args.paged_smoke or args.interference_smoke
         or args.disagg_smoke or args.spec_smoke or args.fleet_smoke
+        or args.lora_smoke
     )
     if smoke:
         # Force, don't default: an inherited JAX_PLATFORMS (axon/tpu) would
@@ -2319,6 +2559,7 @@ def main(argv: list[str] | None = None) -> int:
         ("serve_interference", bench_serve_interference),
         ("serve_disagg", bench_serve_disagg),
         ("serve_spec", bench_serve_spec),
+        ("serve_lora", bench_serve_lora),
         ("serve_fleet", bench_serve_fleet),
     ]
     if args.serve_smoke:
@@ -2344,6 +2585,9 @@ def main(argv: list[str] | None = None) -> int:
     elif args.fleet_smoke:
         # ONLY serve_fleet, same single-section contract
         sections = [("serve_fleet", bench_serve_fleet)]
+    elif args.lora_smoke:
+        # ONLY serve_lora, same single-section contract
+        sections = [("serve_lora", bench_serve_lora)]
     else:
         if args.ablate:
             sections.append(("ablate", bench_ablate))

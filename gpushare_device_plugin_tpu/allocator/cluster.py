@@ -299,6 +299,9 @@ class ClusterAllocator:
             asp.set_attribute("pod", f"{P.namespace(pod)}/{P.name(pod)}")
             workload_class = P.workload_class(pod)
             asp.set_attribute("workload_class", workload_class)
+            lora_adapter = P.lora_adapter(pod)
+            if lora_adapter:
+                asp.set_attribute("lora_adapter", lora_adapter)
             with TRACER.span("allocator.env", child_only=True):
                 if isinstance(placement, GangPlacement):
                     asp.set_attribute("chips", list(placement.chips))
@@ -322,6 +325,7 @@ class ClusterAllocator:
                             container_units=n,
                             disable_isolation=self._disable_isolation,
                             workload_class=workload_class,
+                            lora_adapter=lora_adapter,
                         )
                         for n in container_units
                     ]
@@ -341,6 +345,7 @@ class ClusterAllocator:
                         container_units=n,
                         disable_isolation=self._disable_isolation,
                         workload_class=workload_class,
+                        lora_adapter=lora_adapter,
                     )
                     for n in container_units
                 ]
@@ -556,6 +561,10 @@ class ClusterAllocator:
         # inspect CLI) then sees one canonical value even when the pod
         # declared nothing or garbage.
         annotations[const.ANN_WORKLOAD_CLASS] = P.workload_class(pod)
+        if P.lora_adapter(pod):
+            # Persist the stripped adapter id alongside the class so the
+            # same PATCH carries the full serving identity of the pod.
+            annotations[const.ANN_LORA_ADAPTER] = P.lora_adapter(pod)
         # Decision provenance: built from values the placement already
         # computed (the ledger snapshot and the chosen chip) — the
         # breakdown re-derives one chip's slack from numbers in hand.
@@ -664,6 +673,8 @@ class ClusterAllocator:
             raise
         annotations[const.ENV_ASSUME_TIME] = str(time.time_ns())
         annotations[const.ANN_WORKLOAD_CLASS] = P.workload_class(pod)
+        if P.lora_adapter(pod):
+            annotations[const.ANN_LORA_ADAPTER] = P.lora_adapter(pod)
         # Decision provenance: branch B carries the winning slice's full
         # multi-objective breakdown (ICI hops, stranded slivers, broken
         # chips); branch A honors the extender's persisted decision, so

@@ -54,13 +54,17 @@ def build_mem_allocation(
     container_units: int,
     disable_isolation: bool = False,
     workload_class: str = "",
+    lora_adapter: str = "",
 ) -> ContainerAllocation:
     """Payload for a fractional-HBM container pinned to one chip.
 
     ``workload_class`` (the pod's normalized QoS class) is mirrored into
     the container env so the workload inside — the serving engine's
     governor, a training loop deciding to self-pace — knows which side
-    of the interference plane it is on."""
+    of the interference plane it is on. ``lora_adapter`` (the pod's
+    requested adapter id, empty for the base model) rides along the same
+    way so the serving engine can tag its requests without re-reading
+    pod annotations."""
     envs = {
         const.ENV_TPU_VISIBLE_CHIPS: str(chip.index),
         # one process, one chip: the standard TPU-VM carve-out
@@ -73,6 +77,8 @@ def build_mem_allocation(
     }
     if workload_class:
         envs[const.ENV_WORKLOAD_CLASS] = workload_class
+    if lora_adapter:
+        envs[const.ENV_LORA_ADAPTER] = lora_adapter
     if disable_isolation:
         envs["CTPU_DISABLE"] = "true"
     elif chip_total_units > 0:
@@ -122,6 +128,7 @@ def build_gang_allocation(
     container_units: int,
     disable_isolation: bool = False,
     workload_class: str = "",
+    lora_adapter: str = "",
 ) -> ContainerAllocation:
     """Payload for a topology-aware multi-chip gang container: every
     member chip visible, the granted slice shape as the single-process
@@ -131,7 +138,8 @@ def build_gang_allocation(
     ``container_units`` is this container's share of the pod's TOTAL
     (cross-chip) request; its per-chip fraction scales accordingly so a
     two-container gang pod cannot double-claim a chip's slice.
-    ``workload_class`` mirrors the pod's QoS class into the env (see
+    ``workload_class`` and ``lora_adapter`` mirror the pod's QoS class
+    and requested adapter id into the env (see
     :func:`build_mem_allocation`).
     """
     from ..topology import format_shape, pad3
@@ -152,6 +160,8 @@ def build_gang_allocation(
     }
     if workload_class:
         envs[const.ENV_WORKLOAD_CLASS] = workload_class
+    if lora_adapter:
+        envs[const.ENV_LORA_ADAPTER] = lora_adapter
     if disable_isolation:
         envs["CTPU_DISABLE"] = "true"
     elif chip_total_units > 0 and chips:

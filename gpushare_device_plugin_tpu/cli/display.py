@@ -173,6 +173,55 @@ def _engine_cell(row: dict[str, float]) -> str:
     return " · ".join(parts) or "-"
 
 
+def _adapter_cell(row: dict[str, float] | None) -> str:
+    """One multi-LoRA pod's ADAPTERS cell: resident adapters and the
+    pool pages they hold, the admission hit ratio, and the eviction
+    count — the ``tpushare_engine_adapter_*`` families. "-" when the
+    pod's engine serves only the base model."""
+    if not row or not row.get("adapter_enabled"):
+        return "-"
+    parts = [
+        f"{int(row.get('adapter_resident', 0.0))} resident",
+        f"{int(row.get('adapter_cache_pages', 0.0))} pages",
+    ]
+    hits = row.get("adapter_hits_total", 0.0)
+    misses = row.get("adapter_misses_total", 0.0)
+    if hits + misses:
+        parts.append(f"hit {100.0 * hits / (hits + misses):.0f}%")
+    ev = row.get("adapter_evictions_total", 0.0)
+    if ev:
+        parts.append(f"evict {int(ev)}")
+    return " · ".join(parts)
+
+
+def adapter_row_for(row: dict[str, float] | None) -> dict | None:
+    """The ``adapters`` JSON sub-document for one scraped engine row
+    (``-o json``): residency gauges, hit/miss/eviction counters with the
+    recovered hit ratio, and the mean adapter-miss stall from the
+    histogram's ``_sum``/``_count`` samples. ``None`` when the pod
+    exports no adapter families — a base-model-only reference document
+    gains no key (the ``speculative`` precedent)."""
+    if not row or not row.get("adapter_enabled"):
+        return None
+    out: dict = {
+        "enabled": True,
+        "resident": int(row.get("adapter_resident", 0.0)),
+        "cache_pages": int(row.get("adapter_cache_pages", 0.0)),
+        "hits": int(row.get("adapter_hits_total", 0.0)),
+        "misses": int(row.get("adapter_misses_total", 0.0)),
+        "evictions": int(row.get("adapter_evictions_total", 0.0)),
+    }
+    total = out["hits"] + out["misses"]
+    if total:
+        out["hit_ratio"] = round(out["hits"] / total, 3)
+    cnt = row.get("adapter_miss_stall_seconds_count", 0.0)
+    if cnt:
+        out["miss_stall_mean_s"] = round(
+            row.get("adapter_miss_stall_seconds_sum", 0.0) / cnt, 6
+        )
+    return out
+
+
 def spec_row_for(row: dict[str, float] | None) -> dict | None:
     """The ``speculative`` JSON sub-document for one scraped engine row
     (``-o json``): draft length, dispatch/rollback counters, and the
@@ -773,6 +822,12 @@ def render_details(
         any_engine = engine is not None and any(
             engine_row_for(p, engine) for p in info.pods
         )
+        # the ADAPTERS column appears only when some pod's engine serves
+        # LoRA tenants — base-model fleets keep the reference layout
+        any_adapter = engine is not None and any(
+            (engine_row_for(p, engine) or {}).get("adapter_enabled")
+            for p in info.pods
+        )
         # the CLASS column appears only when a non-default class is
         # present, preserving the reference layout for fleets that never
         # declare workload classes
@@ -793,6 +848,8 @@ def render_details(
             header.append("GANG (shape @ coords)")
         if any_engine:
             header.append("SERVING CACHE")
+        if any_adapter:
+            header.append("ADAPTERS")
         rows = [header]
         for pod in sorted(info.pods, key=lambda p: (p.namespace, p.name)):
             chips = ", ".join(
@@ -809,6 +866,8 @@ def render_details(
             if any_engine:
                 erow = engine_row_for(pod, engine)
                 row.append(_engine_cell(erow) if erow else "-")
+            if any_adapter:
+                row.append(_adapter_cell(engine_row_for(pod, engine)))
             rows.append(row)
         buf.write(_table(rows))
         buf.write("\n")

@@ -110,7 +110,10 @@ def parse_engine_metrics(text: str) -> dict[str, dict[str, float]]:
     ``preemptions_total`` counter, and the speculative-decoding
     ``spec_*`` group (``spec_enabled``/``spec_k`` gauges,
     ``spec_draft_steps_total``/``spec_rollback_pages_total`` counters,
-    plus the ``_sum``/``_count`` of the acceptance histograms).
+    plus the ``_sum``/``_count`` of the acceptance histograms), and the
+    multi-LoRA ``adapter_*`` group (``adapter_enabled``/``resident``/
+    ``cache_pages`` gauges, hit/miss/eviction counters, plus the
+    ``_sum``/``_count`` of the miss-stall histogram).
 
     The disaggregated-serving ``tpushare_handoff_*`` families
     (utils/metric_catalog.py) fold into the same per-pod row under
@@ -732,10 +735,12 @@ def render_json(
     (``fetch_engine_metrics`` output) attaches each serving pod's cache
     telemetry as a ``serving_cache`` sub-document, plus a
     ``speculative`` sub-document for pods whose engine exports the
-    ``tpushare_engine_spec_*`` families."""
+    ``tpushare_engine_spec_*`` families and an ``adapters`` sub-document
+    for pods whose engine exports the multi-LoRA
+    ``tpushare_engine_adapter_*`` families."""
     import json
 
-    from .display import engine_row_for, spec_row_for
+    from .display import adapter_row_for, engine_row_for, spec_row_for
     from .nodeinfo import infer_unit
 
     total = sum(n.total_units for n in infos)
@@ -829,6 +834,18 @@ def render_json(
                             )
                         }
                         if spec_row_for(engine_row_for(p, engine))
+                        else {}
+                    ),
+                    # multi-LoRA residency summary: same rule — only a
+                    # pod whose engine exports the adapter families
+                    # gains the key
+                    **(
+                        {
+                            "adapters": adapter_row_for(
+                                engine_row_for(p, engine)
+                            )
+                        }
+                        if adapter_row_for(engine_row_for(p, engine))
                         else {}
                     ),
                 }

@@ -248,6 +248,14 @@ def is_best_effort(pod: Pod) -> bool:
     return workload_class(pod) == const.WORKLOAD_BEST_EFFORT
 
 
+def lora_adapter(pod: Pod) -> str:
+    """The pod's requested LoRA adapter id (``ANN_LORA_ADAPTER``),
+    stripped; empty string means the base model. One helper so the
+    decision PATCH, the env injection, and the inspect CLI can never
+    disagree about which adapter a pod asked for."""
+    return str(annotations(pod).get(const.ANN_LORA_ADAPTER, "") or "").strip()
+
+
 def assume_time_from_annotation(pod: Pod) -> int:
     v = annotations(pod).get(const.ENV_ASSUME_TIME)
     try:

@@ -148,6 +148,16 @@ WORKLOAD_BEST_EFFORT = "best-effort"
 WORKLOAD_CLASSES = (WORKLOAD_LATENCY_CRITICAL, WORKLOAD_BEST_EFFORT)
 ENV_WORKLOAD_CLASS = "ALIYUN_COM_TPU_WORKLOAD_CLASS"
 
+# Per-tenant LoRA adapter id (serving/adapters.py): the pod declares
+# which fine-tune its requests decode through; admission re-persists the
+# id with the decision PATCH (the workload-class precedent) and
+# Allocate mirrors it into the container env so the serving engine can
+# default its requests' adapter — and prefetch the adapter's paged slab
+# load — straight from PodTpuEnv. Free-form id, empty = base model; the
+# engine validates it against its lora_store at request admission.
+ANN_LORA_ADAPTER = "tpushare.aliyun.com/lora-adapter"
+ENV_LORA_ADAPTER = "ALIYUN_COM_TPU_LORA_ADAPTER"
+
 # The serving engine's SLO tier names (serving/engine.py aliases these —
 # they live here so jax-free control-plane code, e.g. the daemon's
 # per-tier trace-sampling flags, can name a tier without importing the

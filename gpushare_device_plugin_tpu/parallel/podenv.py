@@ -35,6 +35,25 @@ def _env_int(e: Mapping[str, str], key: str, default: int) -> int:
         return default
 
 
+def _env_str(e: Mapping[str, str], key: str, default: str = "") -> str:
+    """One canonical string read: absent, None-ish, and whitespace-only
+    all normalize to ``default`` — every annotation-mirrored var parses
+    through here so a new field cannot drift from the gang/class/mem
+    precedents by hand-rolling its own ``e.get`` dance."""
+    val = str(e.get(key, "") or "").strip()
+    return val if val else default
+
+
+def _env_choice(
+    e: Mapping[str, str], key: str, choices: tuple[str, ...], default: str
+) -> str:
+    """:func:`_env_str` constrained to an enumerated wire value; anything
+    off-list normalizes to ``default`` (for the workload class that is
+    the protect-never-throttle rule: unknown -> latency-critical)."""
+    val = _env_str(e, key)
+    return val if val in choices else default
+
+
 @dataclasses.dataclass(frozen=True)
 class PodTpuEnv:
     """Parsed view of the plugin-injected container env."""
@@ -57,6 +76,13 @@ class PodTpuEnv:
     # (ALIYUN_COM_TPU_WORKLOAD_CLASS): latency-critical | best-effort.
     # The serving side attaches a step governor to best-effort engines.
     workload_class: str = const.WORKLOAD_LATENCY_CRITICAL
+    # Per-tenant LoRA adapter id mirrored from the pod's
+    # tpushare.aliyun.com/lora-adapter annotation
+    # (ALIYUN_COM_TPU_LORA_ADAPTER): the fine-tune this pod's requests
+    # decode through by default; "" = the base model. The serving engine
+    # validates the id against its lora_store and prefetches the
+    # adapter's paged slab load at startup.
+    lora_adapter: str = ""
 
     @property
     def is_best_effort(self) -> bool:
@@ -123,7 +149,7 @@ class PodTpuEnv:
         gang_chips = _int_list(const.ENV_GANG_CHIPS)
         gang_per_chip = _int(const.ENV_GANG_PER_CHIP, 0)
         gang_shape: tuple[int, ...] = ()
-        shape_raw = e.get(const.ENV_GANG_SHAPE, "")
+        shape_raw = _env_str(e, const.ENV_GANG_SHAPE)
         if shape_raw:
             from ..topology import parse_shape
 
@@ -153,24 +179,25 @@ class PodTpuEnv:
             fraction = min(explicit, derived) if explicit is not None else derived
         else:
             fraction = explicit if explicit is not None else 1.0
-        wl = str(e.get(const.ENV_WORKLOAD_CLASS, "") or "").strip()
-        if wl not in const.WORKLOAD_CLASSES:
-            wl = const.WORKLOAD_LATENCY_CRITICAL
         return cls(
             visible_chips=visible,
             chip_index=_int(const.ENV_MEM_IDX, -1),
             mem_units_container=container_units,
             mem_units_chip=chip_units,
-            process_bounds=e.get(const.ENV_TPU_PROCESS_BOUNDS, ""),
-            chips_per_process_bounds=e.get(
-                const.ENV_TPU_CHIPS_PER_PROCESS_BOUNDS, ""
+            process_bounds=_env_str(e, const.ENV_TPU_PROCESS_BOUNDS),
+            chips_per_process_bounds=_env_str(
+                e, const.ENV_TPU_CHIPS_PER_PROCESS_BOUNDS
             ),
             hbm_fraction=fraction,
             gang_chips=gang_chips,
             gang_shape=gang_shape,
             gang_per_chip=gang_per_chip,
             mem_units_pod=_int(const.ENV_MEM_POD, 0),
-            workload_class=wl,
+            workload_class=_env_choice(
+                e, const.ENV_WORKLOAD_CLASS, const.WORKLOAD_CLASSES,
+                const.WORKLOAD_LATENCY_CRITICAL,
+            ),
+            lora_adapter=_env_str(e, const.ENV_LORA_ADAPTER),
         )
 
 

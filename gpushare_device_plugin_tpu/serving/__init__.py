@@ -25,6 +25,16 @@ engine binding — page serialization, the :class:`~.handoff.DisaggServer`
 two-tier plane with the re-prefill degradation ladder
 (``docs/serving.md``, disaggregation section).
 
+``adapters`` is the multi-tenant LoRA plane: per-tenant low-rank
+fine-tunes live as paged tensors in the SAME refcounted page pool as KV
+and draft KV (:class:`~.adapters.AdapterCache` — refcount-pinned while
+any slot uses them, LRU-evicted below KV in the ladder, SLO-tier
+shielded), and the paged engine decodes a batch mixing ANY number of
+distinct adapters in one gathered BGMV dispatch — adapter identity is
+page-table data, never a shape, and greedy tokens stay bit-identical to
+``merge_lora`` + solo generate (``docs/serving.md``, multi-LoRA
+section).
+
 ``router`` + ``fleet`` put a pool of paged engines behind one front
 door: the prefix-affinity :class:`~.router.FleetRouter` (radix
 fingerprints via the metrics plane, SLO-aware best-effort shedding,
@@ -36,6 +46,7 @@ in-flight requests land on a survivor with tokens bit-identical and
 zero dropped (``docs/serving.md``, fleet section).
 """
 
+from .adapters import AdapterCache  # noqa: F401
 from .engine import (  # noqa: F401
     TIER_BEST_EFFORT,
     TIER_CRITICAL,

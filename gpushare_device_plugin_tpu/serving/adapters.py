@@ -1,0 +1,311 @@
+"""Refcounted LRU cache of paged LoRA adapters for the serving engine.
+
+Thousands of per-tenant fine-tunes share ONE base model (ROADMAP open
+item 2; the ParvaGPU spatial-sharing argument, PAPERS.md 2409.14447,
+applied at the adapter level). The naive paths fragment the continuous
+batch — ``merge_lora`` per tenant forks the weights, micro-batching per
+adapter forks the dispatch — so instead adapters live as paged tensors
+in the SAME refcounted :class:`~.pages.PageAllocator` pool as KV and
+draft KV, and decode gathers each slot's adapter by page table
+(``workloads/generate.py:lora_bgmv_views``): adapter identity is data,
+never a shape.
+
+This module is the host-side residency ledger:
+
+- **One flat vector per adapter** (``workloads/lora.py:flatten_lora``),
+  striped across ``pages_per_adapter`` pages of the shared pool. The
+  ENGINE owns the device slab (``[total_pages + 1, page_size * d_model]``
+  f32) and performs the actual row writes with this cache's lock
+  released; the cache only decides which pages hold which adapter.
+- **Pin while used, LRU when idle.** :meth:`acquire` pins an adapter for
+  one slot (load-on-admission; the engine prefetches while the request
+  waits in queue); :meth:`release` unpins at retire/preempt/drain. An
+  unpinned adapter STAYS resident — the next request for it is a hit —
+  until page pressure evicts it, least-recently-acquired first.
+- **Below KV in the eviction ladder, SLO-tier-aware.** Adapter loads may
+  self-evict other unpinned adapters but never touch the radix cache or
+  preempt a request (adapters sit below KV: a cached prefix or a live
+  row is always worth more than an idle adapter, which can be re-read
+  from the store). KV allocation, conversely, reclaims idle adapters
+  BEFORE radix pages (``engine._try_pages``). A best-effort requester
+  cannot evict an adapter last used by a latency-critical request —
+  the Tally-style tiered contention rule (PAPERS.md 2410.07381).
+
+Pin counts are the adapter analog of the allocator's refcounts and are
+deliberately private (the PR 6 double-booking lesson — tpulint's
+ledger-encapsulation rule covers them): the allocator sees exactly ONE
+reference per resident adapter page, held by this cache; slot pins
+never touch allocator refcounts, so a pinned adapter simply refuses to
+appear in :meth:`evictable` / :meth:`evict`.
+
+Thread-safety: the engine loop acquires/releases; the ``/metrics``
+scrape reads occupancy from another thread. Everything sits behind the
+ranked ``serving.adapters`` lock (79), which allocates and releases
+through ``serving.pages`` (87) while held — strictly up-rank, the
+``serving.handoff`` precedent.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from .. import const
+from ..utils.lockrank import make_lock
+from ..utils.metric_catalog import (
+    ENGINE_ADAPTER_CACHE_PAGES,
+    ENGINE_ADAPTER_EVICTIONS_TOTAL,
+    ENGINE_ADAPTER_HITS_TOTAL,
+    ENGINE_ADAPTER_MISSES_TOTAL,
+    ENGINE_ADAPTER_RESIDENT,
+)
+from ..utils.metrics import REGISTRY, MetricsRegistry
+from .pages import PageAllocator
+
+
+@dataclasses.dataclass
+class _Entry:
+    """One resident adapter: the slab pages holding its flat vector (in
+    stripe order), how many live slots pin it, when it was last
+    acquired, and whether a latency-critical request used it last (the
+    tier shield best-effort eviction respects)."""
+
+    pages: list[int]
+    pins: int = 0
+    last_use: int = 0
+    critical: bool = False
+
+
+class AdapterCache:
+    """Host-side residency table: adapter id -> slab pages + pins.
+
+    ``acquire`` returns ``(pages, loaded)`` — ``loaded=True`` means the
+    pages are freshly allocated and the CALLER must write the adapter's
+    flat vector into the device slab rows (in list order) before any
+    slot decodes against it. ``None`` means the pool cannot hold the
+    adapter even after evicting everything this requester's tier may
+    touch — the engine leaves the request queued and retries.
+    """
+
+    def __init__(
+        self, allocator: PageAllocator, pages_per_adapter: int
+    ) -> None:
+        if pages_per_adapter < 1:
+            raise ValueError(
+                f"pages_per_adapter must be >= 1, got {pages_per_adapter}"
+            )
+        self._lock = make_lock("serving.adapters")
+        self._alloc = allocator
+        self.pages_per_adapter = pages_per_adapter
+        self._entries: dict[str, _Entry] = {}
+        self._clock = 0
+        # telemetry (cumulative; reset_stats zeroes for warmup)
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    # -- residency ----------------------------------------------------------
+
+    @property
+    def resident_count(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    @property
+    def cached_pages(self) -> int:
+        with self._lock:
+            return len(self._entries) * self.pages_per_adapter
+
+    def resident(self, adapter_id: str) -> bool:
+        with self._lock:
+            return adapter_id in self._entries
+
+    def pins(self, adapter_id: str) -> int:
+        with self._lock:
+            e = self._entries.get(adapter_id)
+            return 0 if e is None else e.pins
+
+    def pages_of(self, adapter_id: str) -> list[int] | None:
+        """The adapter's slab pages in stripe order (None if absent) —
+        what the engine turns into a slot's adapter page table."""
+        with self._lock:
+            e = self._entries.get(adapter_id)
+            return None if e is None else list(e.pages)
+
+    def pages(self) -> list[int]:
+        """Every page the cache holds (pinned or not) — the engine's
+        escalation gate subtracts these from what preemption could free."""
+        with self._lock:
+            return [p for e in self._entries.values() for p in e.pages]
+
+    # -- pin lifecycle ------------------------------------------------------
+
+    def acquire(
+        self, adapter_id: str, *, tier: str = const.WORKLOAD_LATENCY_CRITICAL
+    ) -> tuple[list[int], bool] | None:
+        """Pin ``adapter_id`` for one slot, loading it if absent.
+
+        Hit: bumps the pin count and LRU clock, returns
+        ``(pages, False)``. Miss: allocates ``pages_per_adapter`` pages —
+        evicting unpinned LRU adapters this ``tier`` may claim if the
+        free list is short — and returns ``(pages, True)`` with the pin
+        already taken; the caller writes the slab rows. ``None``: no
+        capacity; nothing is counted (the engine retries each tick, and
+        a stall must not inflate the miss rate — the miss is counted
+        once, when the load lands)."""
+        if not adapter_id:
+            raise ValueError("adapter_id must be non-empty")
+        critical = tier == const.WORKLOAD_LATENCY_CRITICAL
+        with self._lock:
+            self._clock += 1
+            e = self._entries.get(adapter_id)
+            if e is not None:
+                e.pins += 1
+                e.last_use = self._clock
+                e.critical = e.critical or critical
+                self.hits += 1
+                return list(e.pages), False
+            got = self._alloc.alloc(self.pages_per_adapter)
+            while got is None:
+                if not self._evict_one_locked(critical):
+                    return None
+                got = self._alloc.alloc(self.pages_per_adapter)
+            self._entries[adapter_id] = _Entry(
+                pages=got, pins=1, last_use=self._clock, critical=critical
+            )
+            self.misses += 1
+            return list(got), True
+
+    def release(self, adapter_id: str) -> None:
+        """Unpin one slot's reference. The adapter stays resident (a
+        future request is a hit) but becomes evictable at zero pins."""
+        with self._lock:
+            e = self._entries.get(adapter_id)
+            if e is None or e.pins < 1:
+                raise ValueError(
+                    f"release of unpinned adapter {adapter_id!r}"
+                )
+            e.pins -= 1
+
+    # -- eviction ladder ----------------------------------------------------
+
+    def _victims_locked(self, critical: bool) -> list[tuple[str, _Entry]]:
+        """Unpinned entries this requester tier may evict, LRU first.
+        Best-effort requesters cannot claim adapters a latency-critical
+        request used last (the tier shield)."""
+        out = [
+            (aid, e)
+            for aid, e in self._entries.items()
+            if e.pins == 0 and (critical or not e.critical)
+        ]
+        out.sort(key=lambda kv: kv[1].last_use)
+        return out
+
+    def _evict_one_locked(self, critical: bool) -> bool:
+        victims = self._victims_locked(critical)
+        if not victims:
+            return False
+        aid, e = victims[0]
+        del self._entries[aid]
+        self._alloc.release(e.pages)
+        self.evictions += 1
+        return True
+
+    def evictable(
+        self, *, tier: str = const.WORKLOAD_LATENCY_CRITICAL
+    ) -> list[list[int]]:
+        """Page groups (one per evictable adapter) a ``tier`` requester
+        could reclaim — the :meth:`~.pages.PageAllocator.freeable` input
+        for the engine's escalation gate."""
+        critical = tier == const.WORKLOAD_LATENCY_CRITICAL
+        with self._lock:
+            return [list(e.pages) for _, e in self._victims_locked(critical)]
+
+    def evict(
+        self, n_pages: int, *, tier: str = const.WORKLOAD_LATENCY_CRITICAL
+    ) -> int:
+        """Evict unpinned LRU adapters (whole adapters — a half-resident
+        adapter is useless) until at least ``n_pages`` pages went back to
+        the free list or nothing ``tier`` may touch remains. Returns
+        pages released. The engine's KV-allocation rung: idle adapters
+        reclaim BEFORE radix pages and preemption."""
+        if n_pages <= 0:
+            return 0
+        critical = tier == const.WORKLOAD_LATENCY_CRITICAL
+        released = 0
+        with self._lock:
+            while released < n_pages:
+                if not self._evict_one_locked(critical):
+                    break
+                released += self.pages_per_adapter
+        return released
+
+    def clear(self) -> int:
+        """Release every UNPINNED adapter (engine warmup flush — warmup
+        traffic must not pre-warm the measured hit ratio). Returns pages
+        released; pinned adapters (live slots) stay."""
+        with self._lock:
+            victims = [
+                (aid, e) for aid, e in self._entries.items() if e.pins == 0
+            ]
+            for aid, e in victims:
+                del self._entries[aid]
+                self._alloc.release(e.pages)
+            return len(victims) * self.pages_per_adapter
+
+    # -- telemetry ----------------------------------------------------------
+
+    def hit_ratio(self) -> float:
+        with self._lock:
+            total = self.hits + self.misses
+            return self.hits / total if total else 0.0
+
+    def reset_stats(self) -> None:
+        """Zero hit/miss/eviction telemetry (engine warmup flush); the
+        residency table is untouched."""
+        with self._lock:
+            self.hits = 0
+            self.misses = 0
+            self.evictions = 0
+
+    def stats(self) -> dict:
+        with self._lock:
+            total = self.hits + self.misses
+            return {
+                "resident": len(self._entries),
+                "cached_pages": len(self._entries) * self.pages_per_adapter,
+                "pinned": sum(1 for e in self._entries.values() if e.pins),
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+                "hit_ratio": self.hits / total if total else 0.0,
+            }
+
+    def publish(
+        self, registry: MetricsRegistry = REGISTRY, pod: str = ""
+    ) -> None:
+        """Export residency gauges (reads under the adapters lock, writes
+        to the registry outside it — same discipline as
+        :meth:`~.pages.PageAllocator.publish`). The engine publishes the
+        hit/miss/eviction counters and the miss-stall histogram itself
+        (delta-watermarked with its other families)."""
+        with self._lock:
+            resident = len(self._entries)
+        labels = {"pod": pod} if pod else {}
+        registry.gauge_set(
+            ENGINE_ADAPTER_RESIDENT, resident,
+            "LoRA adapters resident in the paged slab", **labels,
+        )
+        registry.gauge_set(
+            ENGINE_ADAPTER_CACHE_PAGES, resident * self.pages_per_adapter,
+            "Pool pages holding resident LoRA adapters", **labels,
+        )
+
+
+# Re-exported so callers needing only the counter names for parsing do
+# not import the engine: the counter families the ENGINE publishes for
+# this cache (see PagedSlotEngine._publish_adapters).
+ADAPTER_COUNTER_FAMILIES = (
+    ENGINE_ADAPTER_HITS_TOTAL,
+    ENGINE_ADAPTER_MISSES_TOTAL,
+    ENGINE_ADAPTER_EVICTIONS_TOTAL,
+)

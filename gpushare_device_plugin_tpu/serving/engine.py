@@ -52,10 +52,21 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..const import SLO_TIER_BEST_EFFORT, SLO_TIER_CRITICAL, MemoryUnit
+from ..const import (
+    SLO_TIER_BEST_EFFORT,
+    SLO_TIER_CRITICAL,
+    WORKLOAD_BEST_EFFORT,
+    WORKLOAD_LATENCY_CRITICAL,
+    MemoryUnit,
+)
 from ..parallel.podenv import PodTpuEnv
 from ..utils.log import get_logger
 from ..utils.metric_catalog import (
+    ENGINE_ADAPTER_ENABLED,
+    ENGINE_ADAPTER_EVICTIONS_TOTAL,
+    ENGINE_ADAPTER_HITS_TOTAL,
+    ENGINE_ADAPTER_MISS_STALL_SECONDS,
+    ENGINE_ADAPTER_MISSES_TOTAL,
     ENGINE_PREEMPTIONS,
     ENGINE_PREEMPTIONS_TOTAL,
     ENGINE_PREFIX_CACHED_PAGES,
@@ -71,7 +82,9 @@ from ..utils.metric_catalog import (
 from ..utils.metrics import REGISTRY
 from ..utils.tracing import TRACER
 from ..workloads import generate as G
+from ..workloads.lora import LoraConfig, flatten_lora, lora_flat_len
 from ..workloads.transformer import TransformerConfig, shard_params
+from .adapters import AdapterCache
 from .pages import (
     SCRATCH,
     PageAllocator,
@@ -94,6 +107,12 @@ log = get_logger("serving.engine")
 TIER_CRITICAL = SLO_TIER_CRITICAL
 TIER_BEST_EFFORT = SLO_TIER_BEST_EFFORT
 _TIERS = (TIER_CRITICAL, TIER_BEST_EFFORT)
+# The AdapterCache speaks workload-class names (it is engine-agnostic);
+# the 1:1 tier mapping lives in const's docstring and is pinned here.
+_TIER_CLASS = {
+    TIER_CRITICAL: WORKLOAD_LATENCY_CRITICAL,
+    TIER_BEST_EFFORT: WORKLOAD_BEST_EFFORT,
+}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -104,7 +123,15 @@ class Request:
     :data:`TIER_BEST_EFFORT` and may evict its pages under pressure);
     ``slo_ttft_ticks`` / ``slo_tpot_ticks`` are the tier's latency
     targets on the deterministic tick clock, set by the trace driver and
-    scored in :meth:`ServeStats.summary`."""
+    scored in :meth:`ServeStats.summary`.
+
+    ``adapter_id`` names the tenant's LoRA fine-tune (the
+    ``tpushare.aliyun.com/lora-adapter`` pod annotation, threaded through
+    the container env): a :class:`PagedSlotEngine` built with a
+    ``lora_store`` pins the adapter's paged weights for the request's
+    lifetime and decodes it through the gathered BGMV dispatch — greedy
+    tokens bit-identical to ``merge_lora`` + solo ``generate()``. Empty
+    means the base model (the null adapter)."""
 
     rid: int
     prompt: tuple[int, ...]
@@ -113,6 +140,7 @@ class Request:
     tier: str = TIER_CRITICAL
     slo_ttft_ticks: float | None = None
     slo_tpot_ticks: float | None = None
+    adapter_id: str = ""
 
     def __post_init__(self):
         if len(self.prompt) < 1:
@@ -718,6 +746,10 @@ class _PagedSlot:
     pages: list[int] = dataclasses.field(default_factory=list)
     shared: int = 0  # leading pages matched from the radix tree (read-only)
     table: np.ndarray | None = None  # [row_pages] int32 physical page ids
+    # [pages_per_adapter] int32 adapter-slab page ids (None when the
+    # engine serves no LoRA store; all-SCRATCH = the null adapter — slab
+    # row 0 is permanently zero, so the gathered delta is exactly zero)
+    atable: np.ndarray | None = None
     # True when the row's draft-pool KV is not trustworthy (handoff
     # import seeds carry only target KV): the row plain-decodes forever
     # and retire() must not adopt its pages into the radix tree, where a
@@ -790,9 +822,16 @@ class PagedSlotEngine(SlotEngine):
         draft_params=None,
         draft_cfg: TransformerConfig | None = None,
         spec_k: int = 4,
+        lora_store: dict | None = None,
+        lora_cfg: LoraConfig | None = None,
     ):
         if page_size < 1:
             raise ValueError(f"page_size must be >= 1, got {page_size}")
+        if (lora_store is None) != (lora_cfg is None):
+            raise ValueError(
+                "lora_store and lora_cfg enable multi-LoRA serving "
+                "together — passing one without the other is a config bug"
+            )
         if (draft_params is None) != (draft_cfg is None):
             raise ValueError(
                 "draft_params and draft_cfg enable speculative decoding "
@@ -834,6 +873,20 @@ class PagedSlotEngine(SlotEngine):
         self.draft_params = draft_params
         self.draft_cfg = draft_cfg
         self.spec_k = int(spec_k)
+        # Multi-LoRA state likewise: _build_fns threads the adapter-slab
+        # gather through every target program when a store is attached
+        # (ALWAYS — adapter identity is page-table data, so a batch
+        # mixing 100 tenants and the base model is still one dispatch).
+        self.lora_store = lora_store
+        self.lora_cfg = lora_cfg
+        if lora_cfg is not None:
+            # one slab row per pool page: [page_size * d_model] f32 —
+            # the flat adapter vector (workloads/lora.py layout) stripes
+            # across ceil(len / row) pages of the SAME allocator id space
+            self._adapter_page_floats = page_size * cfg.d_model
+            self.pages_per_adapter = max(1, -(
+                -lora_flat_len(cfg, lora_cfg) // self._adapter_page_floats
+            ))
         # escape hatch: True parks every row on the plain decode path
         # (tests pin that a suspended spec engine is bitwise the plain
         # engine; both paths are compiled by warmup either way)
@@ -847,6 +900,42 @@ class PagedSlotEngine(SlotEngine):
         self.allocator = PageAllocator(total_pages)
         self.radix = RadixCache(page_size, self.allocator) if radix else None
         self.preemptions = 0
+        # Paged LoRA adapters (serving/adapters.py): per-tenant low-rank
+        # weights live as flat f32 vectors striped across pages of the
+        # SAME refcounted pool as KV and draft KV — the slab's +1 row 0
+        # is the scratch/null adapter and stays all-zero forever, so a
+        # base-model row's gathered delta is exactly zero. The slab is a
+        # device buffer indexed by per-slot adapter page tables at
+        # decode; the AdapterCache is the host residency ledger.
+        if lora_cfg is not None:
+            self.adapters = AdapterCache(
+                self.allocator, self.pages_per_adapter
+            )
+            self._lora_slab = jnp.zeros(
+                (total_pages + 1, self._adapter_page_floats), jnp.float32
+            )
+            if self.mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                # shard the flat-feature axis over tp when it divides —
+                # the same condition paged_plan_for_slice charges the
+                # sharded per-chip adapter page bytes under
+                spec = (
+                    P(None, "tp")
+                    if cfg.d_model % self.mesh.shape["tp"] == 0 else P()
+                )
+                self._lora_slab = jax.device_put(
+                    self._lora_slab, NamedSharding(self.mesh, spec)
+                )
+            # published-counter watermarks + per-load stall seconds
+            # (flushed once per run, the _spec_pub pattern)
+            self._adapter_pub = {"hits": 0, "misses": 0, "evictions": 0}
+            self._adapter_stalls: list[float] = []
+            # rid -> perf_counter of the first head-blocked acquire, so
+            # the eventual landing charges the whole wait as stall
+            self._adapter_waits: dict[int, float] = {}
+        else:
+            self.adapters = None
         # Draft-model KV: a parallel paged pool indexed by the SAME page
         # ids and per-row tables as the target's — one allocator, one
         # refcount table, so a page's slice cost is target + draft bytes
@@ -918,28 +1007,49 @@ class PagedSlotEngine(SlotEngine):
 
     def _build_fns(self) -> None:
         cfg = self.cfg
+        lcfg = self.lora_cfg
+
+        def lora_kw(lw: tuple) -> dict:
+            # LoRA threading: when the engine carries an adapter store,
+            # every TARGET program takes two trailing args — the device
+            # slab and the batch's adapter page tables — and gathers
+            # per-slot low-rank views inside the jit (one dispatch no
+            # matter how many distinct adapters the batch mixes; adapter
+            # identity is table DATA, never a shape). Draft programs
+            # never take them: proposals are guesses the target verifies,
+            # and the verify/decode argmax carries the adapter.
+            if not lw:
+                return {}
+            slab, atab = lw
+            return {
+                "lora": G.lora_bgmv_views(slab, atab, cfg, lcfg),
+                "lora_scale": lcfg.scale,
+            }
+
         if self.draft_params is None:
 
-            def prefill_fn(params, tokens, cache, slot, table, n_real):
+            def prefill_fn(params, tokens, cache, slot, table, n_real, *lw):
                 self.trace_counts["prefill"] += 1
                 logits, cache = G.paged_prefill_slot(
                     params, tokens, cache, cfg, slot=slot, page_table=table,
-                    n_real=n_real,
+                    n_real=n_real, **lora_kw(lw),
                 )
                 return jnp.argmax(logits[0], -1).astype(jnp.int32), cache
 
-            def extend_fn(params, tokens, cache, slot, table, pos, n_real):
+            def extend_fn(params, tokens, cache, slot, table, pos, n_real,
+                          *lw):
                 self.trace_counts["extend"] += 1
                 logits, cache = G.paged_extend_slot(
                     params, tokens, cache, cfg, slot=slot, page_table=table,
-                    pos=pos, n_real=n_real,
+                    pos=pos, n_real=n_real, **lora_kw(lw),
                 )
                 return jnp.argmax(logits[0], -1).astype(jnp.int32), cache
 
-            def decode_fn(params, tokens, cache, tables, active):
+            def decode_fn(params, tokens, cache, tables, active, *lw):
                 self.trace_counts["decode"] += 1
                 logits, new = G.paged_decode_step(
-                    params, tokens, cache, cfg, page_tables=tables
+                    params, tokens, cache, cfg, page_tables=tables,
+                    **lora_kw(lw),
                 )
                 nxt = jnp.argmax(logits, -1).astype(jnp.int32)
                 new = {
@@ -965,11 +1075,11 @@ class PagedSlotEngine(SlotEngine):
         k = self.spec_k
 
         def prefill_fn(params, dparams, tokens, cache, dcache, slot, table,
-                       n_real):
+                       n_real, *lw):
             self.trace_counts["prefill"] += 1
             logits, cache = G.paged_prefill_slot(
                 params, tokens, cache, cfg, slot=slot, page_table=table,
-                n_real=n_real,
+                n_real=n_real, **lora_kw(lw),
             )
             _, dcache = G.paged_prefill_slot(
                 dparams, tokens, dcache, dcfg, slot=slot, page_table=table,
@@ -978,11 +1088,11 @@ class PagedSlotEngine(SlotEngine):
             return jnp.argmax(logits[0], -1).astype(jnp.int32), cache, dcache
 
         def extend_fn(params, dparams, tokens, cache, dcache, slot, table,
-                      pos, n_real):
+                      pos, n_real, *lw):
             self.trace_counts["extend"] += 1
             logits, cache = G.paged_extend_slot(
                 params, tokens, cache, cfg, slot=slot, page_table=table,
-                pos=pos, n_real=n_real,
+                pos=pos, n_real=n_real, **lora_kw(lw),
             )
             _, dcache = G.paged_extend_slot(
                 dparams, tokens, dcache, dcfg, slot=slot, page_table=table,
@@ -990,10 +1100,12 @@ class PagedSlotEngine(SlotEngine):
             )
             return jnp.argmax(logits[0], -1).astype(jnp.int32), cache, dcache
 
-        def decode_fn(params, dparams, tokens, cache, dcache, tables, active):
+        def decode_fn(params, dparams, tokens, cache, dcache, tables, active,
+                      *lw):
             self.trace_counts["decode"] += 1
             logits, new = G.paged_decode_step(
-                params, tokens, cache, cfg, page_tables=tables
+                params, tokens, cache, cfg, page_tables=tables,
+                **lora_kw(lw),
             )
             nxt = jnp.argmax(logits, -1).astype(jnp.int32)
             new = {**new, "len": jnp.where(active, new["len"], cache["len"])}
@@ -1031,12 +1143,12 @@ class PagedSlotEngine(SlotEngine):
             }
             return drafts, dcache
 
-        def verify_fn(params, block, cache, dcache, tables, active):
+        def verify_fn(params, block, cache, dcache, tables, active, *lw):
             self.trace_counts["verify"] += 1
             pos0 = cache["len"]
             dlen0 = dcache["len"]
             logits, new = G.paged_verify_block(
-                params, block, cache, cfg, page_tables=tables
+                params, block, cache, cfg, page_tables=tables, **lora_kw(lw),
             )
             greedy = jnp.argmax(logits, -1).astype(jnp.int32)  # [B, k+1]
             # greedy accept: the longest draft prefix matching the
@@ -1054,6 +1166,92 @@ class PagedSlotEngine(SlotEngine):
         self._decode = jax.jit(decode_fn, donate_argnums=(3, 4))
         self._draft = jax.jit(draft_fn, donate_argnums=(2,))
         self._verify = jax.jit(verify_fn, donate_argnums=(2, 3))
+
+    # --- multi-LoRA adapters (serving/adapters.py) ------------------------
+
+    def validate(self, req: Request) -> None:
+        super().validate(req)
+        if req.adapter_id:
+            if self.lora_cfg is None:
+                raise ValueError(
+                    f"request {req.rid}: adapter {req.adapter_id!r} on an "
+                    "engine with no lora_store — the router sent a tenant "
+                    "request to a base-model replica"
+                )
+            if req.adapter_id not in self.lora_store:
+                raise ValueError(
+                    f"request {req.rid}: unknown adapter "
+                    f"{req.adapter_id!r} — not in this engine's lora_store"
+                )
+
+    def _write_adapter_pages(
+        self, adapter_id: str, pages: list[int]
+    ) -> None:
+        """Stripe the adapter's flat vector (``workloads/lora.py``
+        layout, zero-padded to whole slab rows) into freshly-allocated
+        slab pages — the device half of an :class:`AdapterCache` miss.
+        One eager batched scatter with the adapters lock released, off
+        the jit'd hot path: zero retraces."""
+        flat = np.asarray(
+            flatten_lora(
+                self.lora_store[adapter_id], self.cfg, self.lora_cfg
+            ),
+            np.float32,
+        )
+        buf = np.zeros(
+            (len(pages), self._adapter_page_floats), np.float32
+        )
+        buf.reshape(-1)[: flat.size] = flat
+        ids = jnp.asarray(pages, jnp.int32)
+        self._lora_slab = self._lora_slab.at[ids].set(jnp.asarray(buf))
+
+    def _admit_adapter(self, req: Request) -> list[int] | None:
+        """Pin ``req``'s adapter for one slot, loading it on a miss.
+        None means the pool cannot hold the adapter right now — the
+        caller leaves the request at the head of the queue (strict
+        admission order holds) and retries next iteration. Stall seconds
+        (synchronous load time plus any head-blocked wait) accumulate
+        into the miss-stall histogram, flushed by
+        :meth:`_publish_adapters`."""
+        t0 = time.perf_counter()
+        got = self.adapters.acquire(
+            req.adapter_id, tier=_TIER_CLASS[req.tier]
+        )
+        if got is None:
+            self._adapter_waits.setdefault(req.rid, t0)
+            return None
+        pages, loaded = got
+        if loaded:
+            self._write_adapter_pages(req.adapter_id, pages)
+        waited = t0 - self._adapter_waits.pop(req.rid, t0)
+        stall = waited + (time.perf_counter() - t0 if loaded else 0.0)
+        if loaded or waited > 0.0:
+            self._adapter_stalls.append(stall)
+        return pages
+
+    def _prefetch_adapter(self, req: Request) -> None:
+        """Load-on-arrival: overlap the adapter's slab load with the
+        request's queue wait. The adapter is resident-but-unpinned
+        afterwards (admission's acquire is a hit) and the prefetch is
+        never destructive — it only claims FREE pages, evicting
+        nothing."""
+        if self.adapters.resident(req.adapter_id):
+            return
+        if self.allocator.free_pages < self.pages_per_adapter:
+            return
+        got = self.adapters.acquire(
+            req.adapter_id, tier=_TIER_CLASS[req.tier]
+        )
+        if got is None:
+            return
+        pages, loaded = got
+        t0 = time.perf_counter()
+        if loaded:
+            self._write_adapter_pages(req.adapter_id, pages)
+            # the load happened off the admission path, but it IS a miss
+            # load — the histogram counts every slab load the store paid
+            self._adapter_stalls.append(time.perf_counter() - t0)
+        self.adapters.release(req.adapter_id)
 
     def warmup(self) -> None:
         """Compile every paged program off the clock, then flush the
@@ -1093,6 +1291,14 @@ class PagedSlotEngine(SlotEngine):
         if self.radix is not None:
             self.radix.clear()
             self.radix.reset_stats()
+        if self.adapters is not None:
+            # warmup traffic must not pre-warm the measured hit ratio
+            # (the radix clear/reset rule, applied to adapters)
+            self.adapters.clear()
+            self.adapters.reset_stats()
+            self._adapter_pub = {"hits": 0, "misses": 0, "evictions": 0}
+            self._adapter_stalls = []
+            self._adapter_waits = {}
         self.allocator.reset_stats()
         self.preemptions = 0
 
@@ -1120,6 +1326,51 @@ class PagedSlotEngine(SlotEngine):
             **labels,
         )
         self._publish_spec(labels)
+        self._publish_adapters(labels)
+
+    def _publish_adapters(self, labels: dict) -> None:
+        """Batch-flush the adapter-cache families (the
+        :meth:`_publish_spec` pattern, never per step): residency gauges,
+        counter DELTAS since the last flush, and the accumulated per-load
+        miss-stall seconds wrapped in a short ``serve.adapter_load`` span
+        so the histogram buckets carry trace-id exemplars."""
+        if self.adapters is None or self._warming:
+            return
+        REGISTRY.gauge_set(
+            ENGINE_ADAPTER_ENABLED, 1.0,
+            "1 when this engine serves per-request LoRA adapters "
+            "(a lora_store is attached)", **labels,
+        )
+        self.adapters.publish(REGISTRY, pod=self.metrics_pod)
+        for fam, cur, key, help_ in (
+            (ENGINE_ADAPTER_HITS_TOTAL, self.adapters.hits, "hits",
+             "Adapter acquisitions served from the resident slab"),
+            (ENGINE_ADAPTER_MISSES_TOTAL, self.adapters.misses, "misses",
+             "Adapter acquisitions that had to load from the store"),
+            (ENGINE_ADAPTER_EVICTIONS_TOTAL, self.adapters.evictions,
+             "evictions",
+             "Idle adapters evicted from the slab (LRU, tier-shielded)"),
+        ):
+            delta = cur - self._adapter_pub[key]
+            if delta:
+                REGISTRY.counter_inc(
+                    fam, help_, value=float(delta), **labels
+                )
+                self._adapter_pub[key] = cur
+        stalls, self._adapter_stalls = self._adapter_stalls, []
+        if stalls:
+            with TRACER.span(
+                "serve.adapter_load", attributes={"loads": len(stalls)},
+            ):
+                for v in stalls:
+                    REGISTRY.observe(
+                        ENGINE_ADAPTER_MISS_STALL_SECONDS, float(v),
+                        "Seconds a request stalled on (or its queue wait "
+                        "overlapped with) its adapter's slab load",
+                        buckets=(0.0005, 0.002, 0.01, 0.05, 0.25, 1.0,
+                                 5.0),
+                        **labels,
+                    )
 
     def _publish_spec(self, labels: dict) -> None:
         """Batch-flush the speculative-decoding families (never per
@@ -1214,6 +1465,12 @@ class PagedSlotEngine(SlotEngine):
             )
         if self.governor is not None:
             out["governor"] = self.governor.stats()
+        if self.adapters is not None:
+            out["adapters"] = {
+                "enabled": True,
+                "pages_per_adapter": self.adapters.pages_per_adapter,
+                **self.adapters.stats(),
+            }
         if self.draft_params is not None:
             tiers = {
                 t: dict(row) for t, row in sorted(self._spec_tiers.items())
@@ -1298,6 +1555,10 @@ class PagedSlotEngine(SlotEngine):
             "tier": req.tier,
             "slo_ttft_ticks": req.slo_ttft_ticks,
             "slo_tpot_ticks": req.slo_tpot_ticks,
+            # the destination engine re-pins the tenant's adapter at
+            # re-admission (its own cache/slab — ids, never weights,
+            # cross the move)
+            "adapter_id": req.adapter_id,
             "tokens": list(res.tokens) if res is not None else [],
         }
 
@@ -1365,6 +1626,7 @@ class PagedSlotEngine(SlotEngine):
                 tier=str(row.get("tier", TIER_CRITICAL)),
                 slo_ttft_ticks=row.get("slo_ttft_ticks"),
                 slo_tpot_ticks=row.get("slo_tpot_ticks"),
+                adapter_id=str(row.get("adapter_id") or ""),
             )
             reqs.append(req)
             seeds[req.rid] = tuple(int(t) for t in row.get("tokens") or ())
@@ -1515,9 +1777,17 @@ class PagedSlotEngine(SlotEngine):
     # --- page bookkeeping -------------------------------------------------
 
     def _fresh_slot(self) -> _PagedSlot:
-        return _PagedSlot(
+        s = _PagedSlot(
             table=np.full((self.row_pages,), SCRATCH, np.int32)
         )
+        if self.adapters is not None:
+            # all-SCRATCH adapter table = the null adapter: slab row 0
+            # is permanently zero, so base-model rows gather an
+            # exactly-zero delta through the same one dispatch
+            s.atable = np.full(
+                (self.pages_per_adapter,), SCRATCH, np.int32
+            )
+        return s
 
     def _grow(self, s: _PagedSlot, got: list[int]) -> None:
         """Append freshly-granted pages to a row and map them in its
@@ -1573,11 +1843,38 @@ class PagedSlotEngine(SlotEngine):
             return (0 if req.tier == TIER_CRITICAL else 1, req.arrival,
                     req.rid)
 
+        # LoRA trailing args for the jitted programs: the device slab
+        # plus the dispatch's adapter page tables ([1, AP] for the
+        # single-row prefill/extend, [n_slots, AP] for pool-wide steps;
+        # idle rows gather the null adapter). Always passed when the
+        # engine carries a store — mixed-tenant batches are one dispatch
+        # and the adapter mix can never retrace.
+        lora_on = self.adapters is not None
+
+        def slot_lw(s: _PagedSlot) -> tuple:
+            return (self._lora_slab, jnp.asarray(s.atable[None]))
+
+        def pool_lw(rows) -> tuple:
+            at = np.full(
+                (self.n_slots, self.pages_per_adapter), SCRATCH, np.int32
+            )
+            for idx in rows:
+                at[idx] = slots[idx].atable
+            return (self._lora_slab, jnp.asarray(at))
+
         def release_row(s: _PagedSlot) -> None:
             if s.pages:
                 self.allocator.release(s.pages)
             s.pages = []
             s.table[:] = SCRATCH
+            if (
+                self.adapters is not None and s.req is not None
+                and s.req.adapter_id
+            ):
+                # unpin the tenant's adapter: it stays resident (the
+                # next request for it is a hit) but becomes evictable
+                self.adapters.release(s.req.adapter_id)
+                s.atable[:] = SCRATCH
 
         def preempt_one(critical_only: bool = True,
                         protect: int | None = None) -> bool:
@@ -1633,6 +1930,10 @@ class PagedSlotEngine(SlotEngine):
             if got is not None:
                 return got
             groups: list[list[int]] = []
+            if self.adapters is not None:
+                groups.extend(
+                    self.adapters.evictable(tier=_TIER_CLASS[tier])
+                )
             if self.radix is not None:
                 groups.append(self.radix.pages())
             if tier == TIER_CRITICAL:
@@ -1644,6 +1945,20 @@ class PagedSlotEngine(SlotEngine):
                 groups
             ) < n:
                 return None
+            # eviction ladder for KV: idle adapters reclaim FIRST — an
+            # unpinned adapter can be re-read from the store for one
+            # load, a cached prefix costs a re-prefill, a preempted row
+            # loses live decode progress
+            if self.adapters is not None:
+                while self.allocator.free_pages < n:
+                    if not self.adapters.evict(
+                        n - self.allocator.free_pages,
+                        tier=_TIER_CLASS[tier],
+                    ):
+                        break
+                got = self.allocator.alloc(n)
+                if got is not None:
+                    return got
             if self.radix is not None:
                 while self.allocator.free_pages < n:
                     if not self.radix.evict(n - self.allocator.free_pages):
@@ -1744,6 +2059,9 @@ class PagedSlotEngine(SlotEngine):
                     slo_tpot_ticks=req.slo_tpot_ticks,
                 )
                 pending.append(req)
+                if self.adapters is not None and req.adapter_id:
+                    # overlap the slab load with the queue wait
+                    self._prefetch_adapter(req)
                 i += 1
             busy = any(s.state != "free" for s in slots)
             if not busy and not pending:
@@ -1765,6 +2083,16 @@ class PagedSlotEngine(SlotEngine):
                 pending.sort(key=tier_key)
                 req = pending[0]
                 res = live[req.rid]
+                apages = None
+                if self.adapters is not None and req.adapter_id:
+                    # pin the tenant's adapter BEFORE any KV is granted:
+                    # a pinned adapter is shielded from the KV rungs'
+                    # eviction below. None = no slab capacity — the head
+                    # blocks the line (strict admission order holds, the
+                    # page-starved-head rule) and retries next iteration.
+                    apages = self._admit_adapter(req)
+                    if apages is None:
+                        break
                 seed = (
                     self._import_seeds.pop(req.rid, None)
                     if self._import_seeds else None
@@ -1785,6 +2113,8 @@ class PagedSlotEngine(SlotEngine):
                     s.prompt = tuple(seed["prompt"])
                     s.done = s.pos = int(seed["pos"])
                     s.result = res
+                    if apages is not None:
+                        s.atable[:] = apages
                     self._grow(s, list(seed["pages"]))
                     s.shared = 0
                     s.last = int(seed["last"])
@@ -1836,6 +2166,12 @@ class PagedSlotEngine(SlotEngine):
                 if fresh is None:
                     if mpages:
                         self.allocator.release(mpages)
+                    if apages is not None:
+                        # the KV grant failed after the adapter pinned:
+                        # unpin so the idle adapter stays evictable for
+                        # whoever CAN make progress (re-pinning on the
+                        # retry is a hit while it stays resident)
+                        self.adapters.release(req.adapter_id)
                     break
                 pending.pop(0)
                 if self.radix is not None:
@@ -1848,6 +2184,8 @@ class PagedSlotEngine(SlotEngine):
                 s.done = matched
                 s.pos = matched
                 s.result = res
+                if apages is not None:
+                    s.atable[:] = apages
                 self._grow(s, mpages)
                 s.shared = len(mpages)
                 self._grow(s, fresh)
@@ -1900,6 +2238,7 @@ class PagedSlotEngine(SlotEngine):
                     buf = np.zeros((self.chunk,), np.int32)
                     buf[:n_real] = real
                     table = jnp.asarray(s.table)
+                    lw = slot_lw(s) if lora_on else ()
                     # spec mode runs the draft model over the same chunk
                     # in the SAME dispatch (combined programs), so the
                     # draft pool tracks the target pool in lockstep —
@@ -1911,13 +2250,14 @@ class PagedSlotEngine(SlotEngine):
                                     self.params, self.draft_params,
                                     jnp.asarray(buf), self.cache,
                                     self.draft_cache, np.int32(idx),
-                                    table, np.int32(n_real),
+                                    table, np.int32(n_real), *lw,
                                 )
                             )
                         else:
                             tok, self.cache = self._prefill(
                                 self.params, jnp.asarray(buf), self.cache,
                                 np.int32(idx), table, np.int32(n_real),
+                                *lw,
                             )
                     else:
                         if self.draft_params is not None:
@@ -1927,14 +2267,14 @@ class PagedSlotEngine(SlotEngine):
                                     jnp.asarray(buf), self.cache,
                                     self.draft_cache, np.int32(idx),
                                     table, np.int32(s.done),
-                                    np.int32(n_real),
+                                    np.int32(n_real), *lw,
                                 )
                             )
                         else:
                             tok, self.cache = self._extend(
                                 self.params, jnp.asarray(buf), self.cache,
                                 np.int32(idx), table, np.int32(s.done),
-                                np.int32(n_real),
+                                np.int32(n_real), *lw,
                             )
                     self.ticks += 1
                     dispatched = True
@@ -2044,6 +2384,7 @@ class PagedSlotEngine(SlotEngine):
                 greedy, acc, self.cache, self.draft_cache = self._verify(
                     self.params, block, self.cache, self.draft_cache,
                     jnp.asarray(tables), jnp.asarray(active),
+                    *(pool_lw(spec_rows) if lora_on else ()),
                 )
                 self.ticks += 1
                 dispatched = True
@@ -2150,6 +2491,7 @@ class PagedSlotEngine(SlotEngine):
                     # dispatch, never a skip — tokens stay bit-identical
                     self.governor.before_step()
                 _step_t0 = time.perf_counter()
+                lw = pool_lw(dec) if lora_on else ()
                 if self.draft_params is not None:
                     # combined program: the draft model decodes the same
                     # token in the same dispatch so its pool never falls
@@ -2158,12 +2500,12 @@ class PagedSlotEngine(SlotEngine):
                     nxt, self.cache, self.draft_cache = self._decode(
                         self.params, self.draft_params, jnp.asarray(toks),
                         self.cache, self.draft_cache, jnp.asarray(tables),
-                        jnp.asarray(active),
+                        jnp.asarray(active), *lw,
                     )
                 else:
                     nxt, self.cache = self._decode(
                         self.params, jnp.asarray(toks), self.cache,
-                        jnp.asarray(tables), jnp.asarray(active),
+                        jnp.asarray(tables), jnp.asarray(active), *lw,
                     )
                 self.ticks += 1
                 dispatched = True
@@ -2229,6 +2571,7 @@ def poisson_trace(
     vocab: int,
     prompt_lens: tuple[int, int],
     max_new: tuple[int, int] | Sequence[int],
+    adapters: Sequence[str] | None = None,
 ) -> list[Request]:
     """Mixed-length Poisson arrival trace: exponential inter-arrival gaps
     at ``rate`` requests/tick, prompt lengths uniform over the (lo, hi)
@@ -2238,8 +2581,10 @@ def poisson_trace(
     generations, e.g. ``[4, 4, 4, 40]``) that exposes lockstep's
     short-subsidizes-long waste. The type, not the length, disambiguates
     — a two-mode choices list like ``[4, 40]`` stays expressible.
-    Deterministic per seed — the replay driver is ``[Request(...)]``
-    literals."""
+    ``adapters`` assigns each request a LoRA adapter id drawn uniformly
+    from the list (the multi-tenant mix; ``""`` entries mean the base
+    model). Deterministic per seed — the replay driver is
+    ``[Request(...)]`` literals."""
     if isinstance(max_new, tuple) and len(max_new) != 2:
         raise ValueError(
             f"max_new tuple must be (lo, hi), got {max_new!r}; pass a list "
@@ -2262,6 +2607,10 @@ def poisson_trace(
                 prompt=tuple(int(x) for x in rng.randint(0, vocab, size=plen)),
                 max_new=mn,
                 arrival=t,
+                adapter_id=(
+                    "" if adapters is None
+                    else str(adapters[rng.randint(len(adapters))])
+                ),
             )
         )
     return out
@@ -2277,6 +2626,7 @@ def shared_prefix_trace(
     tail_lens: tuple[int, int],
     max_new: tuple[int, int] | Sequence[int],
     tiers: Sequence[tuple[str, float, float | None, float | None]] | None = None,
+    adapters: Sequence[str] | None = None,
 ) -> list[Request]:
     """Poisson arrivals whose prompts share system prompts: ``prefixes``
     is ``(count, length)`` — ``count`` distinct shared prefixes of
@@ -2290,7 +2640,11 @@ def shared_prefix_trace(
     targets ride on each :class:`Request` and are scored per tier in
     ``ServeStats.summary()``. None keeps every request
     :data:`TIER_CRITICAL` with no targets. ``max_new`` follows
-    :func:`poisson_trace`'s tuple-range / choices-list convention.
+    :func:`poisson_trace`'s tuple-range / choices-list convention;
+    ``adapters`` assigns per-request LoRA adapter ids drawn uniformly
+    (the multi-tenant mix — shared system prompts ACROSS tenants is
+    exactly where paged adapters beat per-tenant engine forks, since the
+    radix prefix pages stay shared while the deltas differ).
     Deterministic per seed."""
     n_pre, pre_len = prefixes
     if n_pre < 1 or pre_len < 0:
@@ -2324,6 +2678,10 @@ def shared_prefix_trace(
         out.append(Request(
             rid=rid, prompt=pre + tail, max_new=mn, arrival=t, tier=tier,
             slo_ttft_ticks=slo_ttft, slo_tpot_ticks=slo_tpot,
+            adapter_id=(
+                "" if adapters is None
+                else str(adapters[rng.randint(len(adapters))])
+            ),
         ))
     return out
 

@@ -199,7 +199,15 @@ class PagedPlan:
     allocator hands out then costs ``page_bytes + draft_page_bytes``,
     and ``draft_bytes`` charges the whole draft pool (incl. its scratch
     page) against the same slice budget. Both stay 0 for plans sized
-    without a draft."""
+    without a draft.
+
+    A multi-LoRA engine additionally carries the paged **adapter slab**
+    (``[total_pages + 1, page_size * d_model]`` f32, same page-id
+    space — any page can hold KV positions or adapter floats, the
+    allocator does not care which): every page then also costs
+    ``adapter_page_bytes``, and ``adapter_bytes`` charges the whole slab
+    (incl. its permanently-zero scratch row, the null adapter) against
+    the same slice. Zero for plans sized without LoRA."""
 
     slots: int
     total_pages: int
@@ -210,6 +218,8 @@ class PagedPlan:
     freelist_bytes: int
     draft_page_bytes: int = 0
     draft_bytes: int = 0
+    adapter_page_bytes: int = 0
+    adapter_bytes: int = 0
 
     @property
     def max_pages_per_row(self) -> int:
@@ -223,7 +233,7 @@ class PagedPlan:
         """Everything the paged pool itself pins against the slice."""
         return (
             self.kv_bytes + self.table_bytes + self.freelist_bytes
-            + self.draft_bytes
+            + self.draft_bytes + self.adapter_bytes
         )
 
 
@@ -255,6 +265,7 @@ def paged_plan_for_slice(
     n_chips: int = 1,
     draft_cfg=None,
     draft_weight_bytes: int = 0,
+    lora: bool = False,
 ) -> PagedPlan:
     """Size a paged pool for a ``slice_bytes`` HBM slice.
 
@@ -276,6 +287,13 @@ def paged_plan_for_slice(
     one page-id space, so a page either exists in both or neither. tp>1
     shards draft page bytes on the kv-heads axis exactly like the main
     pool (only when ``draft_cfg.kv_heads`` divides evenly).
+
+    ``lora=True`` sizes the multi-LoRA adapter slab alongside: every
+    page additionally charges ``page_size * d_model`` f32 slab floats
+    (same shared page-id space as the draft pool — a page either exists
+    in every device buffer or none). tp>1 shards slab bytes on the
+    FEATURE axis (adapter fan-in/out dims all derive from d_model), so
+    they divide by the gang only when ``cfg.d_model`` does.
 
     ``total_pages == 0`` means the slice cannot hold even one page —
     callers must reject, not round up.
@@ -313,13 +331,18 @@ def paged_plan_for_slice(
             dpage_b = -(-dpage_b // n_chips)
             draft_weight_bytes = -(-draft_weight_bytes // n_chips)
         weight_bytes += draft_weight_bytes
+    apage_b = 0
+    if lora:
+        apage_b = page_size * cfg.d_model * 4  # f32 slab floats per page
+        if n_chips > 1 and cfg.d_model % n_chips == 0:
+            apage_b = -(-apage_b // n_chips)
     # Per-row page-table entries: row_span_for is the exact width
     # PagedSlotEngine allocates, so table_bytes is exact.
     row_span = row_span_for(max_len, prefill_chunk)
     max_pages = pages_for(row_span, page_size)
 
     def zero() -> PagedPlan:
-        return PagedPlan(0, 0, page_size, page_b, 0, 0, 0, dpage_b, 0)
+        return PagedPlan(0, 0, page_size, page_b, 0, 0, 0, dpage_b, 0, apage_b, 0)
 
     usable = int(slice_bytes * headroom) - weight_bytes
     if usable <= 0:
@@ -327,13 +350,13 @@ def paged_plan_for_slice(
 
     def pages_at(n_slots: int) -> int:
         table = n_slots * (max_pages * 4 + 4)
-        # scratch page off the top (target + draft), then each page costs
-        # its KV bytes in BOTH pools plus its free-list/refcount
-        # bookkeeping share
-        left = usable - table - (page_b + dpage_b)
+        # scratch page off the top (target + draft + adapter slab row),
+        # then each page costs its bytes in EVERY pool plus its
+        # free-list/refcount bookkeeping share
+        left = usable - table - (page_b + dpage_b + apage_b)
         if left <= 0:
             return 0
-        return left // (page_b + dpage_b + FREELIST_BYTES_PER_PAGE)
+        return left // (page_b + dpage_b + apage_b + FREELIST_BYTES_PER_PAGE)
 
     if slots is None:
         contiguous = max(usable // row_b, 1)
@@ -358,4 +381,6 @@ def paged_plan_for_slice(
         freelist_bytes=int(pages) * FREELIST_BYTES_PER_PAGE,
         draft_page_bytes=dpage_b,
         draft_bytes=(int(pages) + 1) * dpage_b if dpage_b else 0,
+        adapter_page_bytes=apage_b,
+        adapter_bytes=(int(pages) + 1) * apage_b if apage_b else 0,
     )

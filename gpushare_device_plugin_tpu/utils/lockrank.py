@@ -258,6 +258,15 @@ RANKS: dict[str, LockRank] = dict(
             "engine calls are never under it, mirroring defrag.moves.",
         ),
         _r(
+            "serving.adapters", 79, "lock", False,
+            "AdapterCache's residency table (adapter id -> slab pages, "
+            "pin counts, LRU clock, hit/miss/eviction/stall telemetry). "
+            "Loads and evictions allocate/release through the page "
+            "allocator (serving.pages, rank 87) while held — strictly "
+            "up-rank, the serving.handoff precedent. Device slab writes "
+            "happen in the engine loop with this lock released.",
+        ),
+        _r(
             "apiserver.coalescer", 80, "lock", False,
             "Lazy construction of the node-PATCH coalescer; the merged "
             "PATCH itself runs outside it.",

@@ -66,6 +66,13 @@ DEFRAG_MOVE_SECONDS = "tpushare_defrag_move_seconds"
 DEFRAG_MOVES_TOTAL = "tpushare_defrag_moves_total"
 DEFRAG_STRANDED_PCT = "tpushare_defrag_stranded_pct"
 DEFRAG_STRANDED_UNITS = "tpushare_defrag_stranded_units"
+ENGINE_ADAPTER_CACHE_PAGES = "tpushare_engine_adapter_cache_pages"
+ENGINE_ADAPTER_ENABLED = "tpushare_engine_adapter_enabled"
+ENGINE_ADAPTER_EVICTIONS_TOTAL = "tpushare_engine_adapter_evictions_total"
+ENGINE_ADAPTER_HITS_TOTAL = "tpushare_engine_adapter_hits_total"
+ENGINE_ADAPTER_MISS_STALL_SECONDS = "tpushare_engine_adapter_miss_stall_seconds"
+ENGINE_ADAPTER_MISSES_TOTAL = "tpushare_engine_adapter_misses_total"
+ENGINE_ADAPTER_RESIDENT = "tpushare_engine_adapter_resident"
 ENGINE_KV_PAGES_FREE = "tpushare_engine_kv_pages_free"
 ENGINE_KV_PAGES_TOTAL = "tpushare_engine_kv_pages_total"
 ENGINE_KV_PAGES_USED = "tpushare_engine_kv_pages_used"
@@ -158,6 +165,13 @@ CATALOG: dict[str, MetricSpec] = dict((
     _m(DEFRAG_MOVES_TOTAL, COUNTER, "outcome"),
     _m(DEFRAG_STRANDED_PCT, GAUGE),
     _m(DEFRAG_STRANDED_UNITS, GAUGE),
+    _m(ENGINE_ADAPTER_CACHE_PAGES, GAUGE, "pod"),
+    _m(ENGINE_ADAPTER_ENABLED, GAUGE, "pod"),
+    _m(ENGINE_ADAPTER_EVICTIONS_TOTAL, COUNTER, "pod"),
+    _m(ENGINE_ADAPTER_HITS_TOTAL, COUNTER, "pod"),
+    _m(ENGINE_ADAPTER_MISS_STALL_SECONDS, HISTOGRAM, "pod"),
+    _m(ENGINE_ADAPTER_MISSES_TOTAL, COUNTER, "pod"),
+    _m(ENGINE_ADAPTER_RESIDENT, GAUGE, "pod"),
     _m(ENGINE_KV_PAGES_FREE, GAUGE, "pod"),
     _m(ENGINE_KV_PAGES_TOTAL, GAUGE, "pod"),
     _m(ENGINE_KV_PAGES_USED, GAUGE, "pod"),

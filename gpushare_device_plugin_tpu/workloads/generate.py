@@ -38,13 +38,20 @@ import jax.numpy as jnp
 from ..ops import flash_attention
 from ..parallel.ring import grouped_attention
 from .attention import chunk_prefill_attention, flash_or_plain, use_flash
+from .lora import LoraConfig, lora_flat_len, unflatten_lora
 from .quant import (
     dequantize_kv,
     embed_lookup,
     matmul_weight,
     quantize_kv,
 )
-from .transformer import TransformerConfig, _mlp_block, _project_qkv, _rms_norm
+from .transformer import (
+    TransformerConfig,
+    _bgmv_delta,
+    _mlp_block,
+    _project_qkv,
+    _rms_norm,
+)
 
 # {"k","v"}: [L, B, Smax, Hkv, Dh]; "len": [] (batch caches) or [B]
 # (slot-pool caches, one independent sequence length per row — the
@@ -156,6 +163,48 @@ def _paged_write(
     return out
 
 
+def lora_bgmv_views(
+    slab: jax.Array,
+    tables: jax.Array,
+    cfg: TransformerConfig,
+    lcfg: LoraConfig,
+) -> dict[str, tuple[jax.Array, jax.Array]]:
+    """Gather per-slot adapters from the paged slab into BGMV scan views.
+
+    ``slab``: ``[pages, page_floats]`` f32 — every adapter's canonical
+    flat vector (``lora.flatten_lora``) striped across pages of the SAME
+    id space as the KV pool; row 0 is the scratch page and stays
+    permanently zero. ``tables``: ``[B, AP]`` int32 per-slot adapter page
+    ids — a base-model slot's all-scratch table gathers an all-zero
+    vector, whose low-rank delta is exactly zero (the null adapter).
+
+    Returns ``{target: (a [L, B, fi, r], b [L, B, r, fo])}``, layer-major
+    so the views ride :func:`decode_block`'s ``lax.scan`` as xs. Adapter
+    identity lives entirely in the gathered VALUES: swapping which
+    adapter a slot runs changes ``tables`` (data), never a shape, so a
+    batch mixing arbitrary adapters — or none — is one compiled dispatch.
+    """
+    B = tables.shape[0]
+    F = lora_flat_len(cfg, lcfg)
+    flat = jnp.take(slab, tables, axis=0)  # [B, AP, page_floats]
+    flat = flat.reshape(B, -1)[:, :F]  # [B, F] (tail page slack dropped)
+    views = unflatten_lora(flat, cfg, lcfg)  # {t: ([B,L,fi,r], [B,L,r,fo])}
+    return {
+        name: (jnp.moveaxis(a, 1, 0), jnp.moveaxis(b, 1, 0))
+        for name, (a, b) in views.items()
+    }
+
+
+def _lora_wo_delta(attn, lora_l, lora_scale: float, dt):
+    """The wo-projection BGMV hook at decode/prefill wo einsum sites:
+    attn [B, T, H, Dh] -> [B, T, d] delta (or None without a wo target)."""
+    if lora_l is None or "wo" not in lora_l:
+        return None
+    a, b = lora_l["wo"]
+    flat = attn.reshape(attn.shape[0], attn.shape[1], -1)  # [B, T, H*Dh]
+    return _bgmv_delta(flat, a, b, lora_scale, dt)
+
+
 def paged_prefill_slot(
     params: Any,
     tokens: jax.Array,
@@ -165,6 +214,8 @@ def paged_prefill_slot(
     slot: jax.Array,
     page_table: jax.Array,
     n_real: jax.Array,
+    lora: dict[str, tuple[jax.Array, jax.Array]] | None = None,
+    lora_scale: float = 1.0,
 ) -> tuple[jax.Array, KVCache]:
     """:func:`prefill_slot` through a page table: pack one request's
     OPENING prompt chunk (``tokens`` [C] right-padded, ``n_real`` real)
@@ -176,6 +227,9 @@ def paged_prefill_slot(
     contiguous row, so the row only pins the pages its tokens occupy.
     Returns the last real position's logits ``[1, vocab]`` f32 and the
     updated cache, bitwise :func:`prefill_slot`'s for the same tokens.
+    ``lora``: the slot's B=1 adapter views (:func:`lora_bgmv_views` on a
+    ``[1, AP]`` table), applied at every projection site as in
+    :func:`decode_block`.
     """
     dt = cfg.compute_dtype
     C = tokens.shape[0]
@@ -183,16 +237,27 @@ def paged_prefill_slot(
     x = embed_lookup(params["embed"], tokens[None, :], dt)  # [1, C, d]
 
     def layer(x, xs):
-        lp, _ = xs
+        if lora is not None:
+            lp, _, lora_l = xs
+        else:
+            lp, _ = xs
+            lora_l = None
         h = _rms_norm(x, lp["ln1"])
-        q, k, v = _project_qkv(h, lp, cfg, positions)
+        q, k, v = _project_qkv(
+            h, lp, cfg, positions, lora=lora_l, lora_scale=lora_scale
+        )
         attn = chunk_prefill_attention(q, k, v, n_real=n_real, attention=cfg.attention)
-        x = x + jnp.einsum("bthn,hnd->btd", attn, matmul_weight(lp["wo"], dt))
-        return _mlp_block(x, lp, cfg), (k, v)
+        wo = jnp.einsum("bthn,hnd->btd", attn, matmul_weight(lp["wo"], dt))
+        wo_delta = _lora_wo_delta(attn, lora_l, lora_scale, dt)
+        if wo_delta is not None:
+            wo = wo + wo_delta
+        x = x + wo
+        return _mlp_block(x, lp, cfg, lora=lora_l, lora_scale=lora_scale), (k, v)
 
-    x, (ks, vs) = jax.lax.scan(
-        layer, x, (params["layers"], jnp.arange(cfg.n_layers))
-    )
+    xs = (params["layers"], jnp.arange(cfg.n_layers))
+    if lora is not None:
+        xs = xs + (lora,)
+    x, (ks, vs) = jax.lax.scan(layer, x, xs)
     # ks/vs: [L, 1, C, Hkv, Dh] -> pages of `page_table`, offsets 0..C-1.
     slot = jnp.asarray(slot, jnp.int32)
     logical = jnp.arange(C)
@@ -237,6 +302,8 @@ def paged_extend_slot(
     page_table: jax.Array,
     pos: jax.Array,
     n_real: jax.Array,
+    lora: dict[str, tuple[jax.Array, jax.Array]] | None = None,
+    lora_scale: float = 1.0,
 ) -> tuple[jax.Array, KVCache]:
     """:func:`extend_slot` through a page table: continue row ``slot``
     with its next prompt chunk against the prefix its pages already
@@ -258,7 +325,9 @@ def paged_extend_slot(
     C = tokens.shape[0]
     row = _gather_paged(cache, page_table[None, :])  # [L, 1, V, ...]
     row["len"] = pos[None]
-    logits, row = decode_block(params, tokens[None, :], row, cfg)
+    logits, row = decode_block(
+        params, tokens[None, :], row, cfg, lora=lora, lora_scale=lora_scale
+    )
     logical = pos + jnp.arange(C)
     new = {
         key: jnp.take(row[key], logical, axis=2)[:, 0]
@@ -282,6 +351,8 @@ def paged_decode_step(
     cfg: TransformerConfig,
     *,
     page_tables: jax.Array,
+    lora: dict[str, tuple[jax.Array, jax.Array]] | None = None,
+    lora_scale: float = 1.0,
 ) -> tuple[jax.Array, KVCache]:
     """Pool-wide decode step through per-row page tables: gather every
     row's logical view ``[L, B, MP*ps, ...]`` from its pages, run the
@@ -299,7 +370,9 @@ def paged_decode_step(
     ps = cache["k"].shape[2]
     view = _gather_paged(cache, page_tables)
     view["len"] = pos0
-    logits, new_view = decode_block(params, token[:, None], view, cfg)
+    logits, new_view = decode_block(
+        params, token[:, None], view, cfg, lora=lora, lora_scale=lora_scale
+    )
     pids = jnp.take_along_axis(page_tables, (pos0 // ps)[:, None], axis=1)[:, 0]
     offs = pos0 % ps
     out = dict(cache)
@@ -320,6 +393,8 @@ def paged_verify_block(
     cfg: TransformerConfig,
     *,
     page_tables: jax.Array,
+    lora: dict[str, tuple[jax.Array, jax.Array]] | None = None,
+    lora_scale: float = 1.0,
 ) -> tuple[jax.Array, KVCache]:
     """Pool-wide T-token verify step through per-row page tables: the
     target-model half of the paged engine's speculative decode. ``block``
@@ -342,7 +417,9 @@ def paged_verify_block(
     ps = cache["k"].shape[2]
     view = _gather_paged(cache, page_tables)
     view["len"] = pos0
-    logits, new_view = decode_block(params, block, view, cfg)
+    logits, new_view = decode_block(
+        params, block, view, cfg, lora=lora, lora_scale=lora_scale
+    )
     logical = pos0[:, None] + jnp.arange(T)[None, :]  # [B, T]
     pids = jnp.take_along_axis(page_tables, logical // ps, axis=1)
     offs = logical % ps
@@ -675,9 +752,19 @@ def decode_block(
     cache: KVCache,
     cfg: TransformerConfig,
     start: jax.Array | None = None,
+    lora: dict[str, tuple[jax.Array, jax.Array]] | None = None,
+    lora_scale: float = 1.0,
 ) -> tuple[jax.Array, KVCache]:
     """Cached decode of a T-token block: tokens [B, T] -> (logits
     [B, T, vocab] f32, cache advanced by T).
+
+    ``lora`` (serving): :func:`lora_bgmv_views` output — per-slot
+    layer-major adapter views ``{target: (a [L,B,fi,r], b [L,B,r,fo])}``
+    that ride the layer scan as xs; every projection site adds its slot's
+    gathered low-rank delta (``transformer._bgmv_delta``). Whether lora
+    is passed is a Python-level (trace-time) property of the compiled
+    program — the multi-LoRA engine ALWAYS passes it (null adapters for
+    base slots), so adapter mix never retraces.
 
     Block position t attends to everything already in the cache plus
     block positions <= t; :func:`decode_step` is the T=1 case. One
@@ -725,12 +812,17 @@ def decode_block(
             vis = vis & (idx[None, None, :] >= start[:, None, None])
 
     def layer(x, xs):
+        lora_l = None
+        if lora is not None:
+            *xs, lora_l = xs
         if q8:
             lp, k_cache, v_cache, k_scale, v_scale = xs
         else:
             lp, k_cache, v_cache = xs
         h = _rms_norm(x, lp["ln1"])
-        q, k, v = _project_qkv(h, lp, cfg, positions)
+        q, k, v = _project_qkv(
+            h, lp, cfg, positions, lora=lora_l, lora_scale=lora_scale
+        )
         if q8:
             kq8, ks_new = quantize_kv(k)
             vq8, vs_new = quantize_kv(v)
@@ -768,22 +860,29 @@ def decode_block(
             q, k_mat, v_mat, causal=False,
             mask=jnp.broadcast_to(vis, (B, T, Smax)),
         )
-        x = x + jnp.einsum("bthn,hnd->btd", attn, matmul_weight(lp["wo"], dt))
-        return _mlp_block(x, lp, cfg), carry
+        wo = jnp.einsum("bthn,hnd->btd", attn, matmul_weight(lp["wo"], dt))
+        wo_delta = _lora_wo_delta(attn, lora_l, lora_scale, dt)
+        if wo_delta is not None:
+            wo = wo + wo_delta
+        x = x + wo
+        return _mlp_block(x, lp, cfg, lora=lora_l, lora_scale=lora_scale), carry
 
     if q8:
         xs = (
             params["layers"], cache["k"], cache["v"],
             cache["k_scale"], cache["v_scale"],
         )
+    else:
+        xs = (params["layers"], cache["k"], cache["v"])
+    if lora is not None:
+        xs = xs + (lora,)
+    if q8:
         x, (ks, vs, kss, vss) = jax.lax.scan(layer, x, xs)
         cache = {
             "k": ks, "v": vs, "k_scale": kss, "v_scale": vss, "len": pos0 + T,
         }
     else:
-        x, (ks, vs) = jax.lax.scan(
-            layer, x, (params["layers"], cache["k"], cache["v"])
-        )
+        x, (ks, vs) = jax.lax.scan(layer, x, xs)
         cache = {"k": ks, "v": vs, "len": pos0 + T}
     x = _rms_norm(x, params["final_norm"])
     logits = jnp.einsum("btd,dv->btv", x, matmul_weight(params["out"], dt))

@@ -174,3 +174,80 @@ def make_lora_train_step(
 
 def lora_param_count(lora: Params) -> int:
     return sum(x.size for x in jax.tree.leaves(lora))
+
+
+# --- canonical flat layout (paged adapter serving) --------------------------
+# The multi-LoRA serving path (serving/adapters.py + generate.py's BGMV
+# hooks) stores each adapter as ONE flat f32 vector striped across KV-pool
+# pages. The layout below is the contract between the host-side loader
+# (flatten) and the in-kernel gather (unflatten): targets in lcfg.targets
+# order, each target's ``a`` then ``b``, raveled in C order — exactly the
+# element order ``_delta``'s reshape sees, so the unflattened views feed
+# the same low-rank contraction ``merge_lora`` bakes into the weights.
+
+
+def _target_flat_dims(
+    cfg: TransformerConfig, name: str
+) -> tuple[int, int]:
+    """(fan-in, fan-out) of one target's projection, flattened."""
+    dims = _dims(cfg)
+    in_names, out_names = _TARGET_SHAPES[name]
+    fi = 1
+    for n in in_names:
+        fi *= dims[n]
+    fo = 1
+    for n in out_names:
+        fo *= dims[n]
+    return fi, fo
+
+
+def lora_flat_len(cfg: TransformerConfig, lcfg: LoraConfig) -> int:
+    """Float count of one adapter in the canonical flat layout."""
+    L, r = cfg.n_layers, lcfg.rank
+    total = 0
+    for name in lcfg.targets:
+        fi, fo = _target_flat_dims(cfg, name)
+        total += L * fi * r + L * r * fo
+    return total
+
+
+def flatten_lora(
+    lora: Params, cfg: TransformerConfig, lcfg: LoraConfig
+) -> jax.Array:
+    """One adapter tree -> the canonical flat f32 vector
+    (``[lora_flat_len]``); raises on a tree missing a configured target
+    (a half-loaded adapter must fail at load, not decode garbage)."""
+    parts = []
+    for name in lcfg.targets:
+        try:
+            ab = lora[name]
+        except KeyError:
+            raise ValueError(
+                f"adapter tree has no {name!r} entry but lcfg.targets="
+                f"{lcfg.targets}"
+            ) from None
+        parts.append(ab["a"].astype(jnp.float32).reshape(-1))
+        parts.append(ab["b"].astype(jnp.float32).reshape(-1))
+    return jnp.concatenate(parts)
+
+
+def unflatten_lora(
+    flat: jax.Array, cfg: TransformerConfig, lcfg: LoraConfig
+) -> dict[str, tuple[jax.Array, jax.Array]]:
+    """Flat vector(s) -> {target: (a [..., L, in, r], b [..., L, r, out])}
+    with the in/out dims FLATTENED (the shape ``_delta`` contracts over).
+    Works batched: any leading dims of ``flat`` ride through, so a
+    gathered per-slot ``[B, F]`` slab read yields per-slot views."""
+    L, r = cfg.n_layers, lcfg.rank
+    lead = flat.shape[:-1]
+    out: dict[str, tuple[jax.Array, jax.Array]] = {}
+    off = 0
+    for name in lcfg.targets:
+        fi, fo = _target_flat_dims(cfg, name)
+        na, nb = L * fi * r, L * r * fo
+        a = flat[..., off:off + na].reshape(*lead, L, fi, r)
+        off += na
+        b = flat[..., off:off + nb].reshape(*lead, L, r, fo)
+        off += nb
+        out[name] = (a, b)
+    return out

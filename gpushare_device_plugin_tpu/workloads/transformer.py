@@ -190,16 +190,42 @@ def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
 
 
-def _project_qkv(h, lp, cfg: TransformerConfig, positions):
+def _bgmv_delta(x, a, b, scale: float, dt) -> jax.Array:
+    """Per-slot low-rank delta (gathered BGMV): ``(x @ A) @ B * scale``.
+
+    x: [B, T, fi]; a: [B, fi, r]; b: [B, r, fo] -> [B, T, fo]. Every slot
+    contracts against ITS OWN adapter pair, so a batch mixing arbitrary
+    adapters is still one einsum — adapter identity is data (the gathered
+    a/b values), never a shape, preserving the zero-retrace invariant.
+    A null slot (a/b gathered from the zero scratch page) contributes an
+    exactly-zero delta, so base-model requests ride the same dispatch.
+    """
+    xa = jnp.einsum("btd,bdr->btr", x, a.astype(dt))
+    return jnp.einsum("btr,bro->bto", xa, b.astype(dt)) * scale
+
+
+def _project_qkv(h, lp, cfg: TransformerConfig, positions, lora=None,
+                 lora_scale: float = 1.0):
     """ln1-normalized hidden -> RoPE'd (q [B,T,H,Dh], k, v [B,T,Hkv,Dh]).
 
     Shared by the training forward and the cached decode path
     (``generate.py``) so the layer math exists exactly once — cached
     decode's contract is token-exactness with this forward.
+
+    ``lora`` (serving only): {target: (a [B,fi,r], b [B,r,fo])} per-slot
+    adapter views for THIS layer; deltas are added to the raw projections
+    BEFORE RoPE — the same order ``merge_lora`` bakes in (merged weights
+    project, then rotate).
     """
     dt = cfg.compute_dtype
     q = jnp.einsum("btd,dhn->bthn", h, matmul_weight(lp["wq"], dt))
     kv = jnp.einsum("btd,dchn->btchn", h, matmul_weight(lp["wkv"], dt))
+    if lora is not None and "wq" in lora:
+        a, b = lora["wq"]
+        q = q + _bgmv_delta(h, a, b, lora_scale, dt).reshape(q.shape)
+    if lora is not None and "wkv" in lora:
+        a, b = lora["wkv"]
+        kv = kv + _bgmv_delta(h, a, b, lora_scale, dt).reshape(kv.shape)
     k, v = kv[:, :, 0], kv[:, :, 1]
     # Saved under remat_policy="dots". RoPE is linear in its input at
     # fixed positions, so its VJP needs only cos/sin (recomputed from
@@ -211,17 +237,28 @@ def _project_qkv(h, lp, cfg: TransformerConfig, positions):
     )
 
 
-def _mlp_block(x, lp, cfg: TransformerConfig):
+def _mlp_block(x, lp, cfg: TransformerConfig, lora=None,
+               lora_scale: float = 1.0):
     """Residual SwiGLU MLP (ln2 -> gate/up -> silu -> down). Shared with
-    ``generate.py`` (same single-source rationale as ``_project_qkv``)."""
+    ``generate.py`` (same single-source rationale as ``_project_qkv``).
+    ``lora``: per-slot (a, b) views for this layer, as in _project_qkv."""
     dt = cfg.compute_dtype
     h = _rms_norm(x, lp["ln2"])
     gate_up = checkpoint_name(
         jnp.einsum("btd,dcf->btcf", h, matmul_weight(lp["wi"], dt)),
         "mlp_gate_up",
     )
+    if lora is not None and "wi" in lora:
+        a, b = lora["wi"]
+        gate_up = gate_up + _bgmv_delta(h, a, b, lora_scale, dt).reshape(
+            gate_up.shape
+        )
     ff = jax.nn.silu(gate_up[:, :, 0]) * gate_up[:, :, 1]
-    return x + jnp.einsum("btf,fd->btd", ff, matmul_weight(lp["wdown"], dt))
+    down = jnp.einsum("btf,fd->btd", ff, matmul_weight(lp["wdown"], dt))
+    if lora is not None and "wdown" in lora:
+        a, b = lora["wdown"]
+        down = down + _bgmv_delta(ff, a, b, lora_scale, dt)
+    return x + down
 
 
 def _layer(x, lp, cfg: TransformerConfig, positions, mesh: Mesh | None):

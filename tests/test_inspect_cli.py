@@ -428,6 +428,118 @@ def test_cli_json_no_spec_families_no_speculative_key(
     assert "spec" not in inspect_cli.render_json([], None)
 
 
+def _adapter_exposition(pod_label: str) -> str:
+    """An exposition from a multi-LoRA serving engine: the cache
+    families plus the tpushare_engine_adapter_* group, rendered by the
+    real registry exactly as the engine's publish_metrics flushes them."""
+    from gpushare_device_plugin_tpu.utils.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    labels = {"pod": pod_label}
+    reg.gauge_set("tpushare_engine_kv_pages_total", 64.0,
+                  help_text="KV pages in the slice pool", **labels)
+    reg.gauge_set("tpushare_engine_kv_pages_used", 48.0,
+                  help_text="KV pages allocated", **labels)
+    reg.gauge_set("tpushare_engine_prefix_hit_ratio", 0.37,
+                  help_text="radix prefix-cache hit ratio", **labels)
+    reg.gauge_set("tpushare_engine_adapter_enabled", 1.0,
+                  help_text="multi-LoRA serving on", **labels)
+    reg.gauge_set("tpushare_engine_adapter_resident", 3.0,
+                  help_text="adapters resident in the paged slab", **labels)
+    reg.gauge_set("tpushare_engine_adapter_cache_pages", 42.0,
+                  help_text="pool pages holding adapters", **labels)
+    reg.counter_inc("tpushare_engine_adapter_hits_total", value=6.0,
+                    help_text="admissions finding the adapter resident",
+                    **labels)
+    reg.counter_inc("tpushare_engine_adapter_misses_total", value=2.0,
+                    help_text="admissions that loaded the adapter", **labels)
+    reg.counter_inc("tpushare_engine_adapter_evictions_total", value=1.0,
+                    help_text="idle adapters reclaimed", **labels)
+    for v in (0.004, 0.016):
+        reg.observe("tpushare_engine_adapter_miss_stall_seconds", v,
+                    help_text="admission stall on an adapter miss",
+                    buckets=(0.002, 0.01, 0.05, 0.25), **labels)
+    return reg.render()
+
+
+def test_parse_engine_metrics_adapter_families_fold_in():
+    rows = inspect_cli.parse_engine_metrics(_adapter_exposition("ns/lora-1"))
+    row = rows["ns/lora-1"]
+    assert row["adapter_enabled"] == 1.0 and row["adapter_resident"] == 3.0
+    assert row["adapter_cache_pages"] == 42.0
+    assert row["adapter_hits_total"] == 6.0
+    assert row["adapter_misses_total"] == 2.0
+    assert row["adapter_evictions_total"] == 1.0
+    # histogram buckets are skipped; _sum/_count carry the CLI's mean
+    assert row["adapter_miss_stall_seconds_count"] == 2.0
+    assert row["adapter_miss_stall_seconds_sum"] == pytest.approx(0.02)
+    assert not any(k.endswith("_bucket") for k in row)
+
+
+def test_cli_details_adapters_column(api, capsys, monkeypatch):
+    """A multi-LoRA pod's row gains the ADAPTERS cell; a plain serving
+    pod on the same node shows '-' and a fleet with no adapter families
+    at all never grows the column (test_cli_details_serving_cache_column
+    pins that layout)."""
+    api.nodes["node-a"] = shared_node("node-a")
+    api.add_pod(assigned_running_pod("lora-1", 16, chip_idx=0, node="node-a"))
+    api.add_pod(assigned_running_pod("batch-1", 4, chip_idx=1, node="node-a"))
+    monkeypatch.setattr(inspect_cli, "_client", lambda: ApiServerClient(api.url))
+    monkeypatch.setattr(
+        inspect_cli, "fetch_observability_metrics",
+        lambda urls: inspect_cli.parse_observability_metrics(
+            _adapter_exposition("default/lora-1")
+        ),
+    )
+    assert inspect_cli.main(["-d", "--metrics-url", "http://x"]) == 0
+    out = capsys.readouterr().out
+    assert "ADAPTERS" in out
+    assert "3 resident · 42 pages · hit 75% · evict 1" in out
+
+
+def test_cli_details_no_adapter_families_no_column(api, capsys, monkeypatch):
+    api.nodes["node-a"] = shared_node("node-a")
+    api.add_pod(assigned_running_pod("serve-1", 16, chip_idx=0, node="node-a"))
+    monkeypatch.setattr(inspect_cli, "_client", lambda: ApiServerClient(api.url))
+    monkeypatch.setattr(
+        inspect_cli, "fetch_observability_metrics",
+        lambda urls: inspect_cli.parse_observability_metrics(
+            _engine_exposition("default/serve-1")
+        ),
+    )
+    assert inspect_cli.main(["-d", "--metrics-url", "http://x"]) == 0
+    assert "ADAPTERS" not in capsys.readouterr().out
+
+
+def test_cli_json_adapters_subdoc(api, capsys, monkeypatch):
+    api.nodes["node-a"] = shared_node("node-a")
+    api.add_pod(assigned_running_pod("lora-1", 16, chip_idx=0, node="node-a"))
+    api.add_pod(assigned_running_pod("batch-1", 4, chip_idx=1, node="node-a"))
+    monkeypatch.setattr(inspect_cli, "_client", lambda: ApiServerClient(api.url))
+    monkeypatch.setattr(
+        inspect_cli, "fetch_observability_metrics",
+        lambda urls: inspect_cli.parse_observability_metrics(
+            _adapter_exposition("default/lora-1")
+        ),
+    )
+    assert inspect_cli.main(["-o", "json", "--metrics-url", "http://x"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    pods = {p["name"]: p for p in doc["nodes"][0]["pods"]}
+    assert pods["lora-1"]["adapters"] == {
+        "enabled": True,
+        "resident": 3,
+        "cache_pages": 42,
+        "hits": 6,
+        "misses": 2,
+        "evictions": 1,
+        "hit_ratio": 0.75,
+        "miss_stall_mean_s": 0.01,
+    }
+    # a base-model pod's document gains no adapters key — the reference
+    # document is unchanged
+    assert "adapters" not in pods["batch-1"]
+
+
 def test_cli_no_metrics_url_keeps_reference_layout(api, capsys, monkeypatch):
     """Without --metrics-url the details table keeps the reference
     column set — no SERVING CACHE header appears."""

@@ -1094,3 +1094,301 @@ def test_spec_metrics_published_on_run(spec_setup):
         assert "spec_acceptance_len_sum" in row
     finally:
         tracing.STORE.clear()
+
+
+# ---------------------------------------------------------------------------
+# multi-tenant multi-LoRA: paged adapters, ONE heterogeneous-batch dispatch
+# ---------------------------------------------------------------------------
+
+
+from gpushare_device_plugin_tpu.workloads.lora import (  # noqa: E402
+    LoraConfig,
+    init_lora,
+    merge_lora,
+)
+
+
+def _rand_lora(cfg, lcfg, seed):
+    # init_lora zeros `b` (standard LoRA init -> exact no-op); randomize
+    # the whole tree so every adapter produces a DISTINCT token stream
+    tree = init_lora(jax.random.key(seed), cfg, lcfg)
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    keys = jax.random.split(jax.random.key(seed + 10_000), len(leaves))
+    return jax.tree_util.tree_unflatten(
+        treedef,
+        [jax.random.normal(k, x.shape, x.dtype) * 0.02
+         for k, x in zip(keys, leaves)],
+    )
+
+
+@pytest.fixture(scope="module")
+def lora_setup(setup):
+    cfg, params = setup
+    lcfg = LoraConfig(rank=2, alpha=4.0)
+    store = {aid: _rand_lora(cfg, lcfg, 40 + i)
+             for i, aid in enumerate(("t0", "t1", "t2"))}
+    return cfg, params, lcfg, store
+
+
+def _lora_paged(params, cfg, lcfg, store, **kw):
+    base = dict(total_pages=32, lora_store=store, lora_cfg=lcfg)
+    base.update(kw)
+    return _paged(params, cfg, **base)
+
+
+def assert_lora_parity(reqs, stats, params, cfg, lcfg, store, kv_dtype=None):
+    """Every request's greedy tokens == merge_lora + SOLO generate with
+    that request's adapter folded into the dense weights (base params
+    for the null adapter) — the multi-tenant bit-identity contract."""
+    by_rid = {r.rid: r for r in reqs}
+    assert len(stats.results) == len(reqs)
+    for res in stats.results:
+        req = by_rid[res.rid]
+        merged = (
+            merge_lora(params, store[req.adapter_id], lcfg)
+            if req.adapter_id else params
+        )
+        got = res.tokens
+        assert 1 <= len(got) <= req.max_new
+        expect = got + [EOS] * (req.max_new - len(got))
+        solo = solo_tokens(merged, cfg, req, kv_dtype=kv_dtype)
+        assert solo == expect, (res.rid, req.adapter_id, got, solo)
+
+
+def test_lora_mixed_batch_matches_merged_solo(lora_setup):
+    """A batch mixing three tenants AND base-model rows, admissions
+    mid-flight: one fused dispatch per step (adapter identity is page-
+    table DATA — zero retraces past warmup), tokens bit-identical to
+    merging each adapter into the dense weights and generating solo."""
+    cfg, params, lcfg, store = lora_setup
+    reqs = shared_prefix_trace(
+        10, seed=13, rate=0.4, vocab=cfg.vocab, prefixes=(2, 8),
+        tail_lens=(1, 4), max_new=[2, 6, 10],
+        adapters=["t0", "t1", "t2", ""],
+    )
+    assert len({r.adapter_id for r in reqs}) >= 3  # the mix actually mixes
+    eng = _lora_paged(params, cfg, lcfg, store)
+    eng.warmup()
+    warm = dict(eng.trace_counts)
+    assert warm == {"prefill": 1, "extend": 1, "decode": 1}
+    stats = eng.run(reqs)
+    assert_lora_parity(reqs, stats, params, cfg, lcfg, store)
+    assert dict(eng.trace_counts) == warm, (
+        f"adapter heterogeneity retraced: {eng.trace_counts} vs {warm}"
+    )
+    row = stats.engine_cache["adapters"]
+    assert row["enabled"] and row["misses"] >= 1
+    assert row["pages_per_adapter"] >= 1
+    # a second identical run is all hits, still zero retraces
+    stats2 = eng.run(reqs)
+    assert {r.rid: r.tokens for r in stats2.results} == {
+        r.rid: r.tokens for r in stats.results
+    }
+    assert dict(eng.trace_counts) == warm
+    assert stats2.engine_cache["adapters"]["hits"] > row["hits"]
+
+
+def test_lora_int8_kv_pages_match_merged_solo_int8(lora_setup):
+    """Quantized KV under multi-LoRA: the adapter delta rides the f32
+    activations while K/V quantize — parity against merge_lora + solo
+    int8-cache generation per tenant."""
+    cfg, params, lcfg, store = lora_setup
+    reqs = poisson_trace(
+        6, seed=5, rate=0.3, vocab=cfg.vocab, prompt_lens=(1, 8),
+        max_new=(2, 8), adapters=["t0", "t2", ""],
+    )
+    eng = _lora_paged(params, cfg, lcfg, store, slots=3, kv_dtype="int8")
+    stats = eng.run(reqs)
+    assert_lora_parity(reqs, stats, params, cfg, lcfg, store,
+                       kv_dtype="int8")
+
+
+def test_lora_tp2_tokens_identical():
+    """Tensor-parallel gang slice: the adapter slab shards its feature
+    axis with the gang (d_model divisible), page tables stay replicated
+    int32 data — tokens BIT-IDENTICAL to the single-chip lora engine
+    with zero retraces."""
+    from gpushare_device_plugin_tpu.parallel.podenv import PodTpuEnv, gang_mesh
+
+    cfg = _cfg(n_kv_heads=4)
+    params = init_params(jax.random.key(1), cfg)
+    lcfg = LoraConfig(rank=2, alpha=4.0)
+    store = {aid: _rand_lora(cfg, lcfg, 60 + i)
+             for i, aid in enumerate(("t0", "t1"))}
+    reqs = shared_prefix_trace(
+        8, seed=7, rate=0.3, vocab=cfg.vocab, prefixes=(1, 8),
+        tail_lens=(1, 6), max_new=[3, 4, 10], adapters=["t0", "t1", ""],
+    )
+    kw = dict(slots=3, max_len=48, total_pages=40, page_size=8,
+              prefill_chunk=8, eos_id=EOS, lora_store=store, lora_cfg=lcfg)
+    solo = PagedSlotEngine(params, cfg, **kw)
+    solo.warmup()
+    s = solo.run(reqs)
+    assert_lora_parity(reqs, s, params, cfg, lcfg, store)
+    env = PodTpuEnv.from_env({
+        "TPU_VISIBLE_CHIPS": "0,1",
+        "ALIYUN_COM_TPU_GANG_CHIPS": "0,1",
+        "ALIYUN_COM_TPU_GANG_SHAPE": "2x1x1",
+        "ALIYUN_COM_TPU_GANG_PER_CHIP": "1",
+        "ALIYUN_COM_TPU_MEM_CONTAINER": "2",
+        "ALIYUN_COM_TPU_MEM_DEV": "16",
+    })
+    mesh = gang_mesh(env, devices=jax.devices()[:2])
+    eng = PagedSlotEngine(params, cfg, mesh=mesh, **kw)
+    eng.warmup()
+    warm = dict(eng.trace_counts)
+    t = eng.run(reqs)
+    assert sum(eng.trace_counts[k] - warm[k] for k in warm) == 0
+    assert {r.rid: r.tokens for r in t.results} == {
+        r.rid: r.tokens for r in s.results
+    }
+
+
+def test_lora_composes_with_spec_decode(lora_setup, spec_setup):
+    """Speculation under multi-LoRA: the draft proposes with the BASE
+    model while verify carries each row's adapter — acceptance drops,
+    correctness doesn't. Tokens match the plain lora engine; every
+    lookahead/rollback page returns (pool audit counts radix + resident
+    adapter stripes)."""
+    cfg, params, lcfg, store = lora_setup
+    _, _, dcfg, dparams = spec_setup
+    reqs = shared_prefix_trace(
+        8, seed=17, rate=0.4, vocab=cfg.vocab, prefixes=(2, 8),
+        tail_lens=(1, 4), max_new=[3, 6, 10], adapters=["t0", "t1", ""],
+    )
+    ref = _lora_paged(params, cfg, lcfg, store).run(reqs)
+    assert_lora_parity(reqs, ref, params, cfg, lcfg, store)
+    spec = _lora_paged(params, cfg, lcfg, store, total_pages=40,
+                       draft_params=dparams, draft_cfg=dcfg, spec_k=3)
+    spec.warmup()
+    warm = dict(spec.trace_counts)
+    assert set(warm) == {"prefill", "extend", "decode", "draft", "verify"}
+    stats = spec.run(reqs)
+    assert {r.rid: r.tokens for r in stats.results} == {
+        r.rid: r.tokens for r in ref.results
+    }
+    assert dict(spec.trace_counts) == warm
+    assert stats.engine_cache["speculative"]["draft_steps"] > 0
+    cached = spec.radix.cached_pages if spec.radix is not None else 0
+    assert spec.allocator.used_pages == cached + spec.adapters.cached_pages
+
+
+def test_lora_drain_restore_carries_adapter_id(lora_setup):
+    """A tenant's request drained mid-decode restores on a fresh engine
+    (its own AdapterCache, cold) and finishes bit-identically — the
+    snapshot row must carry ``adapter_id`` or the destination serves the
+    base model and silently diverges."""
+    cfg, params, lcfg, store = lora_setup
+    reqs = [
+        Request(rid=0, prompt=tuple(range(1, 7)), max_new=8, arrival=0.0,
+                adapter_id="t0"),
+        Request(rid=1, prompt=(7, 8, 9), max_new=8, arrival=0.0,
+                adapter_id="t1"),
+        Request(rid=2, prompt=(11, 12), max_new=6, arrival=0.0),
+    ]
+    ref = {
+        r.rid: r.tokens
+        for r in _lora_paged(params, cfg, lcfg, store).run(reqs).results
+    }
+    src = _lora_paged(params, cfg, lcfg, store)
+    part = src.run(reqs, drain_at_tick=3)
+    snap = src.drain_snapshot()
+    assert snap["requests"]
+    rows = {r["rid"]: r for r in snap["requests"]}
+    assert any(r["adapter_id"] for r in rows.values())
+    for rid, row in rows.items():
+        assert row["adapter_id"] == {0: "t0", 1: "t1", 2: ""}[rid]
+    # the drained source released every adapter pin
+    assert all(src.adapters.pins(a) == 0 for a in ("t0", "t1"))
+    rest = _lora_paged(params, cfg, lcfg, store).restore_snapshot(snap)
+    out = {r.rid: r.tokens for r in part.results}
+    out.update({r.rid: r.tokens for r in rest.results})
+    assert out == ref
+
+
+def test_lora_preemption_releases_adapter_pin(lora_setup):
+    """Page pressure across BOTH pools: a critical arrival (its own
+    adapter) preempts a best-effort tenant mid-decode; the victim's
+    adapter pin drops with its pages, it re-admits (adapter re-pinned,
+    cache hit) and still emits bit-identical tokens."""
+    cfg, params, lcfg, store = lora_setup
+    reqs = [
+        Request(rid=0, prompt=tuple(range(5, 13)), max_new=16, arrival=0.0,
+                tier=TIER_BEST_EFFORT, adapter_id="t0"),
+        Request(rid=1, prompt=tuple(range(20, 26)), max_new=16, arrival=4.0,
+                tier=TIER_CRITICAL, adapter_id="t1"),
+    ]
+    eng = _lora_paged(params, cfg, lcfg, store, total_pages=18, radix=False)
+    eng.warmup()
+    warm = dict(eng.trace_counts)
+    stats = eng.run(reqs)
+    assert_lora_parity(reqs, stats, params, cfg, lcfg, store)
+    assert sum(eng.trace_counts[k] - warm[k] for k in warm) == 0
+    victim = [r for r in stats.results if r.rid == 0][0]
+    assert victim.preemptions and victim.tier == TIER_BEST_EFFORT
+    # quiesced: no pins left, adapters may stay resident (cache-warm)
+    assert eng.adapters.pins("t0") == 0 and eng.adapters.pins("t1") == 0
+
+
+def test_lora_eviction_under_adapter_pressure(lora_setup):
+    """More tenants than the slab can hold at once: idle adapters evict
+    LRU to admit new ones (evictions counted), tokens stay bit-identical
+    for every tenant — capacity churn is invisible to correctness."""
+    cfg, params, lcfg, store = lora_setup
+    wide = dict(store)
+    wide["t3"] = _rand_lora(cfg, lcfg, 55)
+    wide["t4"] = _rand_lora(cfg, lcfg, 56)
+    reqs = [
+        Request(rid=i, prompt=tuple(range(3 + i, 9 + i)), max_new=4,
+                arrival=float(3 * i), adapter_id=f"t{i}")
+        for i in range(5)
+    ]
+    # slots=1 serializes tenants; 18 pages hold ~2 resident stripes
+    # (4 pages each) beside one row's KV -> the 3rd tenant must evict
+    eng = _lora_paged(params, cfg, lcfg, wide, slots=1, total_pages=18,
+                      radix=False)
+    stats = eng.run(reqs)
+    assert_lora_parity(reqs, stats, params, cfg, lcfg, wide)
+    row = stats.engine_cache["adapters"]
+    assert row["evictions"] >= 1
+    assert row["misses"] >= 3
+
+
+def test_lora_unknown_or_unconfigured_adapter_rejected(lora_setup):
+    """Up-front admission validation: a tenant id the store doesn't hold
+    — or ANY tenant id on an engine with no store — fails loudly before
+    pages move, instead of silently serving the base model."""
+    cfg, params, lcfg, store = lora_setup
+    eng = _lora_paged(params, cfg, lcfg, store)
+    with pytest.raises(ValueError, match="unknown adapter"):
+        eng.run([Request(rid=0, prompt=(1, 2), max_new=2, arrival=0.0,
+                         adapter_id="nope")])
+    bare = _paged(params, cfg)
+    with pytest.raises(ValueError, match="no lora_store"):
+        bare.run([Request(rid=0, prompt=(1, 2), max_new=2, arrival=0.0,
+                          adapter_id="t0")])
+
+
+def test_lora_metrics_published_on_run(lora_setup):
+    """The /metrics satellite: adapter residency gauges, hit/miss/evict
+    counters, and the miss-stall histogram land under the pod label, and
+    the CLI parser folds them into the pod's adapter_* row keys."""
+    from gpushare_device_plugin_tpu.cli.inspect import parse_engine_metrics
+    from gpushare_device_plugin_tpu.utils.metrics import REGISTRY
+
+    cfg, params, lcfg, store = lora_setup
+    reqs = poisson_trace(
+        5, seed=3, rate=0.5, vocab=cfg.vocab, prompt_lens=(2, 6),
+        max_new=(2, 5), adapters=["t0", "t1"],
+    )
+    eng = _lora_paged(params, cfg, lcfg, store, slots=3,
+                      metrics_pod="ns/lora-0")
+    eng.run(reqs)
+    text = REGISTRY.render()
+    assert 'tpushare_engine_adapter_enabled{pod="ns/lora-0"} 1' in text
+    assert 'tpushare_engine_adapter_misses_total{pod="ns/lora-0"}' in text
+    row = parse_engine_metrics(text)["ns/lora-0"]
+    assert row["adapter_enabled"] == 1.0
+    assert row["adapter_resident"] >= 1
+    assert row["adapter_misses_total"] >= 1
+    assert row["adapter_miss_stall_seconds_count"] >= 1

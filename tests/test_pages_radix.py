@@ -428,3 +428,214 @@ class TestPagedPlanBudget:
         assert p4.total_pages >= 3 * p1.total_pages
         # per-chip budget holds the per-chip shares of everything
         assert -(-w // 4) + p4.pool_bytes <= gang.gang_container_per_chip_bytes()
+
+
+# ---------------------------------------------------------------------------
+# AdapterCache (the multi-LoRA residency ledger, serving/adapters.py)
+# ---------------------------------------------------------------------------
+
+
+class TestAdapterCache:
+    def _cache(self, total_pages=16, per=2):
+        from gpushare_device_plugin_tpu.serving import AdapterCache
+
+        alloc = PageAllocator(total_pages)
+        return alloc, AdapterCache(alloc, per)
+
+    def test_miss_loads_hit_pins_release_keeps_resident(self):
+        alloc, c = self._cache()
+        pages, loaded = c.acquire("a")
+        assert loaded and len(pages) == 2 and c.pins("a") == 1
+        # second slot on the same tenant: a hit, same stripe, pin bumps
+        again, loaded2 = c.acquire("a")
+        assert not loaded2 and again == pages and c.pins("a") == 2
+        assert c.pages_of("a") == pages
+        c.release("a")
+        c.release("a")
+        # unpinned but STILL resident — the next request is a hit
+        assert c.pins("a") == 0 and c.resident("a")
+        assert alloc.used_pages == 2
+        assert (c.hits, c.misses) == (1, 1)
+
+    def test_release_of_unpinned_raises(self):
+        _, c = self._cache()
+        with pytest.raises(ValueError, match="unpinned"):
+            c.release("ghost")
+        c.acquire("a")
+        c.release("a")
+        with pytest.raises(ValueError, match="unpinned"):
+            c.release("a")
+
+    def test_lru_eviction_least_recently_acquired_first(self):
+        # pool holds exactly 3 adapters; touch order a, b, c then re-touch
+        # a — loading d must evict b (LRU), not a
+        alloc, c = self._cache(total_pages=6, per=2)
+        for aid in ("a", "b", "c"):
+            c.acquire(aid)
+            c.release(aid)
+        c.acquire("a")
+        c.release("a")
+        pages, loaded = c.acquire("d")
+        assert loaded and len(pages) == 2
+        assert not c.resident("b")
+        assert c.resident("a") and c.resident("c") and c.resident("d")
+        assert c.evictions == 1
+
+    def test_pinned_adapter_never_evicted_acquire_returns_none(self):
+        # every page pinned: a new tenant cannot evict a live slot's
+        # adapter — the engine must leave the request queued
+        alloc, c = self._cache(total_pages=4, per=2)
+        c.acquire("a")
+        c.acquire("b")
+        assert c.acquire("d") is None
+        assert c.resident("a") and c.resident("b")
+        # a stall is not a miss: nothing was counted for "d"
+        assert c.misses == 2 and c.evictions == 0
+        # releasing one pin unblocks the load via eviction
+        c.release("b")
+        pages, loaded = c.acquire("d")
+        assert loaded and not c.resident("b")
+
+    def test_tier_shield_best_effort_cannot_claim_critical_adapter(self):
+        from gpushare_device_plugin_tpu.const import (
+            WORKLOAD_BEST_EFFORT,
+            WORKLOAD_LATENCY_CRITICAL,
+        )
+
+        alloc, c = self._cache(total_pages=4, per=2)
+        c.acquire("crit", tier=WORKLOAD_LATENCY_CRITICAL)
+        c.release("crit")
+        c.acquire("be", tier=WORKLOAD_BEST_EFFORT)
+        c.release("be")
+        # a best-effort requester may evict only the best-effort-last
+        # adapter; the critical one is shielded
+        assert c.evictable(tier=WORKLOAD_BEST_EFFORT) == [c.pages_of("be")]
+        pages, loaded = c.acquire("be2", tier=WORKLOAD_BEST_EFFORT)
+        assert loaded and c.resident("crit") and not c.resident("be")
+        c.release("be2")
+        # a critical requester may claim anything unpinned
+        groups = c.evictable(tier=WORKLOAD_LATENCY_CRITICAL)
+        assert len(groups) == 2
+        pages, loaded = c.acquire("crit2", tier=WORKLOAD_LATENCY_CRITICAL)
+        assert loaded
+
+    def test_evict_frees_whole_stripes_for_kv(self):
+        # the engine's KV rung: evict(n) returns whole adapters' pages
+        # (a half-resident adapter is useless) until n pages freed
+        alloc, c = self._cache(total_pages=8, per=2)
+        for aid in ("a", "b", "c"):
+            c.acquire(aid)
+            c.release(aid)
+        freed = c.evict(3)
+        assert freed == 4  # two whole stripes to cover 3 pages
+        assert alloc.free_pages == 8 - 2
+        assert c.evict(0) == 0
+
+    def test_clear_releases_unpinned_only(self):
+        alloc, c = self._cache(total_pages=8, per=2)
+        c.acquire("pinned")
+        c.acquire("idle")
+        c.release("idle")
+        assert c.clear() == 2
+        assert c.resident("pinned") and not c.resident("idle")
+        assert alloc.used_pages == 2
+
+    def test_stats_and_reset(self):
+        _, c = self._cache()
+        c.acquire("a")
+        c.acquire("a")
+        c.release("a")
+        s = c.stats()
+        assert s["resident"] == 1 and s["pinned"] == 1
+        assert s["hits"] == 1 and s["misses"] == 1
+        assert s["hit_ratio"] == pytest.approx(0.5)
+        assert c.hit_ratio() == pytest.approx(0.5)
+        c.reset_stats()
+        assert c.stats()["hits"] == 0 and c.resident("a")
+
+    def test_publish_exports_residency_gauges(self):
+        from gpushare_device_plugin_tpu.utils.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        _, c = self._cache(total_pages=8, per=3)
+        c.acquire("a")
+        c.publish(reg, pod="ns/pod-a")
+        text = reg.render()
+        assert 'tpushare_engine_adapter_resident{pod="ns/pod-a"} 1' in text
+        assert 'tpushare_engine_adapter_cache_pages{pod="ns/pod-a"} 3' in text
+
+    def test_pages_lists_every_resident_page(self):
+        _, c = self._cache(total_pages=8, per=2)
+        c.acquire("a")
+        c.acquire("b")
+        c.release("b")
+        assert sorted(c.pages()) == sorted(
+            c.pages_of("a") + c.pages_of("b")
+        )
+
+    def test_pages_per_adapter_must_be_positive(self):
+        from gpushare_device_plugin_tpu.serving import AdapterCache
+
+        with pytest.raises(ValueError, match="pages_per_adapter"):
+            AdapterCache(PageAllocator(4), 0)
+
+
+class TestPagedPlanLoraBudget:
+    def test_exact_budget_accounting_sweep_with_lora(self):
+        """The multi-LoRA extension of the slice-safety invariant:
+        weights + everything the pool pins INCLUDING the adapter slab
+        (every page costs KV + slab floats, both scratch rows included)
+        still never exceed the slice. A lora engine asks for nothing
+        beyond its ``aliyun.com/tpu-mem`` request."""
+        cfg = _cfg()
+        row_b = kv_slot_bytes(cfg, 64)
+        w = 3 * row_b
+        for budget in range(int(0.5 * row_b), 40 * row_b, row_b // 3):
+            for headroom in (1.0, 0.9):
+                plan = paged_plan_for_slice(
+                    budget, cfg, 64, page_size=8, prefill_chunk=8,
+                    weight_bytes=w, headroom=headroom, lora=True,
+                )
+                if plan.total_pages == 0:
+                    continue
+                assert plan.adapter_page_bytes == 8 * cfg.d_model * 4
+                assert plan.adapter_bytes == (
+                    (plan.total_pages + 1) * plan.adapter_page_bytes
+                )
+                assert plan.pool_bytes == (
+                    plan.kv_bytes + plan.table_bytes + plan.freelist_bytes
+                    + plan.adapter_bytes
+                )
+                assert w + plan.pool_bytes <= int(budget * headroom), (
+                    budget, headroom, plan,
+                )
+                # at equal budget the slab rides by shrinking the page
+                # count, never by overflowing the slice
+                bare = paged_plan_for_slice(
+                    budget, cfg, 64, page_size=8, prefill_chunk=8,
+                    weight_bytes=w, headroom=headroom,
+                )
+                assert plan.total_pages <= bare.total_pages
+
+    def test_adapter_page_bytes_shard_on_gang_feature_axis(self):
+        """tp>1: slab page bytes divide by the gang only when d_model
+        does (adapter dims all derive from the feature axis) — the
+        engine shards the slab under the same condition."""
+        cfg = _cfg()  # d_model=32, divides 2
+        row_b = kv_slot_bytes(cfg, 64)
+        solo = paged_plan_for_slice(
+            20 * row_b, cfg, 64, page_size=8, prefill_chunk=8,
+            weight_bytes=row_b, lora=True,
+        )
+        gang = paged_plan_for_slice(
+            20 * row_b, cfg, 64, page_size=8, prefill_chunk=8,
+            weight_bytes=row_b, lora=True, n_chips=2,
+        )
+        assert gang.adapter_page_bytes == -(-solo.adapter_page_bytes // 2)
+        assert gang.total_pages > solo.total_pages
+        # indivisible feature axis: the slab replicates, full bytes
+        odd = paged_plan_for_slice(
+            20 * row_b, _cfg(d_model=32), 64, page_size=8, prefill_chunk=8,
+            weight_bytes=row_b, lora=True, n_chips=3,
+        )
+        assert odd.adapter_page_bytes == solo.adapter_page_bytes

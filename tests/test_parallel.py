@@ -63,6 +63,40 @@ def test_podenv_whole_chip_is_exclusive():
     assert pod.exclusive
 
 
+def test_podenv_string_envs_share_one_parser():
+    # Regression pin for the consolidated annotation→env string parsing:
+    # gang shape, topology bounds, workload class, and the LoRA adapter id
+    # must all read absent/blank values as their defaults and strip
+    # whitespace the same way — a new env var cannot drift from the
+    # gang/class/mem precedents.
+    env = injected_env()
+    env[const.ENV_TPU_PROCESS_BOUNDS] = "  2,2,1  "
+    env[const.ENV_GANG_SHAPE] = " 2x2 "
+    env[const.ENV_WORKLOAD_CLASS] = f"  {const.WORKLOAD_BEST_EFFORT}  "
+    env[const.ENV_LORA_ADAPTER] = "  tenant-a  "
+    pod = PodTpuEnv.from_env(env)
+    assert pod.process_bounds == "2,2,1"
+    assert pod.gang_shape == (2, 2)
+    assert pod.workload_class == const.WORKLOAD_BEST_EFFORT
+    assert pod.lora_adapter == "tenant-a"
+
+
+def test_podenv_string_envs_default_when_absent_or_garbled():
+    pod = PodTpuEnv.from_env(injected_env())
+    assert pod.process_bounds == ""
+    assert pod.gang_shape == ()
+    assert pod.workload_class == const.WORKLOAD_LATENCY_CRITICAL
+    assert pod.lora_adapter == ""
+    # A garbled class falls back to the protective default, never raises —
+    # same rule as cluster.pods.workload_class on the annotation side.
+    env = injected_env()
+    env[const.ENV_WORKLOAD_CLASS] = "turbo"
+    env[const.ENV_LORA_ADAPTER] = "   "
+    pod = PodTpuEnv.from_env(env)
+    assert pod.workload_class == const.WORKLOAD_LATENCY_CRITICAL
+    assert pod.lora_adapter == ""
+
+
 def test_configure_jax_sets_mem_fraction(monkeypatch):
     monkeypatch.delenv("XLA_PYTHON_CLIENT_MEM_FRACTION", raising=False)
     settings = configure_jax_from_env(injected_env(container=8, dev=32))

@@ -36,6 +36,13 @@ PROTECTED: dict[str, tuple[str, frozenset[str]]] = {
         "cluster/usage.py",
         frozenset({"_mem_used", "_core_refs"}),
     ),
+    # the multi-LoRA residency ledger: pin counts and the LRU clock are
+    # the same class of state as the allocator refcounts above — a read
+    # outside the lock is a torn hit-ratio, a write is a double-release
+    "AdapterCache": (
+        "serving/adapters.py",
+        frozenset({"_entries", "_clock"}),
+    ),
 }
 
 _ATTR_TO_CLASS: dict[str, str] = {
